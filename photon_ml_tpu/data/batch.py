@@ -1,0 +1,164 @@
+"""Statically-shaped example batches — the TPU-native `RDD[LabeledPoint]`.
+
+Reference data model: photon-ml .../data/LabeledPoint.scala (label, Breeze
+sparse/dense features, offset, weight; margin = features . coef + offset).
+
+On TPU everything must be static-shape, so a batch of sparse examples is a
+padded gather-format block ("padded COO rows", ELL-like):
+
+- ``indices[n, k]`` int32 — feature ids per row, padded with 0
+- ``values[n, k]`` float — feature values per row, padded with 0.0
+  (a padded slot contributes ``0.0 * w[0] = 0`` to every reduction)
+- ``labels/offsets/weights[n]`` — padded ROWS carry ``weight == 0``, which
+  zeroes their contribution to loss/gradient/Hessian and to weighted metrics.
+
+Dense batches (small feature dims, MF latent factors) use a plain matrix and
+ride the MXU.
+
+Both are NamedTuples, hence pytrees: they jit, vmap, shard (batch axis = axis
+0) and donate cleanly.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+
+class SparseBatch(NamedTuple):
+    """Padded sparse example block. Row i: sum_j values[i,j] * w[indices[i,j]]."""
+
+    indices: Array  # int32 [n, k]
+    values: Array  # float  [n, k]
+    labels: Array  # float  [n]
+    offsets: Array  # float [n]
+    weights: Array  # float [n] ; 0 for padding rows
+
+    @property
+    def num_rows(self) -> int:
+        return self.indices.shape[0]
+
+    @property
+    def nnz_per_row(self) -> int:
+        return self.indices.shape[1]
+
+
+class DenseBatch(NamedTuple):
+    """Dense example block. Row i: features[i] . w."""
+
+    features: Array  # [n, d]
+    labels: Array
+    offsets: Array
+    weights: Array
+
+    @property
+    def num_rows(self) -> int:
+        return self.features.shape[0]
+
+
+Batch = Union[SparseBatch, DenseBatch]
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def make_sparse_batch(
+    rows: Sequence[Tuple[Sequence[int], Sequence[float]]],
+    labels: Sequence[float],
+    offsets: Optional[Sequence[float]] = None,
+    weights: Optional[Sequence[float]] = None,
+    *,
+    pad_rows_to: int = 8,
+    pad_nnz_to: int = 8,
+    max_nnz: Optional[int] = None,
+    dtype=np.float32,
+) -> SparseBatch:
+    """Build a padded SparseBatch from per-row (indices, values) lists.
+
+    ``pad_rows_to`` / ``pad_nnz_to`` round shapes up to multiples so XLA sees
+    a small set of distinct shapes (recompilation control) and tiles align
+    with the (8, 128) float32 TPU layout.
+    """
+    n = len(rows)
+    if n == 0:
+        raise ValueError("empty batch")
+    k = max((len(ix) for ix, _ in rows), default=1)
+    if max_nnz is not None:
+        k = min(k, max_nnz)
+    k = max(_round_up(max(k, 1), pad_nnz_to), pad_nnz_to)
+    n_pad = max(_round_up(n, pad_rows_to), pad_rows_to)
+
+    indices = np.zeros((n_pad, k), dtype=np.int32)
+    values = np.zeros((n_pad, k), dtype=dtype)
+    for i, (ix, vs) in enumerate(rows):
+        m = min(len(ix), k)
+        indices[i, :m] = np.asarray(ix[:m], dtype=np.int32)
+        values[i, :m] = np.asarray(vs[:m], dtype=dtype)
+
+    lab = np.zeros((n_pad,), dtype=dtype)
+    lab[:n] = np.asarray(labels, dtype=dtype)
+    off = np.zeros((n_pad,), dtype=dtype)
+    if offsets is not None:
+        off[:n] = np.asarray(offsets, dtype=dtype)
+    wgt = np.zeros((n_pad,), dtype=dtype)
+    wgt[:n] = 1.0 if weights is None else np.asarray(weights, dtype=dtype)
+
+    return SparseBatch(
+        indices=jnp.asarray(indices),
+        values=jnp.asarray(values),
+        labels=jnp.asarray(lab),
+        offsets=jnp.asarray(off),
+        weights=jnp.asarray(wgt),
+    )
+
+
+def make_dense_batch(
+    features: np.ndarray,
+    labels: Sequence[float],
+    offsets: Optional[Sequence[float]] = None,
+    weights: Optional[Sequence[float]] = None,
+    *,
+    pad_rows_to: int = 8,
+    dtype=np.float32,
+) -> DenseBatch:
+    features = np.asarray(features, dtype=dtype)
+    n, d = features.shape
+    n_pad = max(_round_up(n, pad_rows_to), pad_rows_to)
+    f = np.zeros((n_pad, d), dtype=dtype)
+    f[:n] = features
+    lab = np.zeros((n_pad,), dtype=dtype)
+    lab[:n] = np.asarray(labels, dtype=dtype)
+    off = np.zeros((n_pad,), dtype=dtype)
+    if offsets is not None:
+        off[:n] = np.asarray(offsets, dtype=dtype)
+    wgt = np.zeros((n_pad,), dtype=dtype)
+    wgt[:n] = 1.0 if weights is None else np.asarray(weights, dtype=dtype)
+    return DenseBatch(
+        features=jnp.asarray(f),
+        labels=jnp.asarray(lab),
+        offsets=jnp.asarray(off),
+        weights=jnp.asarray(wgt),
+    )
+
+
+def sparse_dot(batch: SparseBatch, w_eff: Array) -> Array:
+    """Per-row sparse dot product: [n]. The hot gather of the whole library."""
+    return jnp.sum(batch.values * jnp.take(w_eff, batch.indices, axis=0), axis=-1)
+
+
+def sparse_scatter_add(batch: SparseBatch, row_coef: Array, dim: int) -> Array:
+    """Accumulate sum_i row_coef[i] * x_i into a dense [dim] vector.
+
+    The TPU-native analog of the reference's per-datum
+    ``axpy(coef, features, vectorSum)`` accumulation
+    (ValueAndGradientAggregator.scala:133-154): one scatter-add over the
+    flattened (row, nnz) pairs.
+    """
+    contrib = (batch.values * row_coef[:, None]).reshape(-1)
+    flat_ix = batch.indices.reshape(-1)
+    return jnp.zeros((dim,), dtype=batch.values.dtype).at[flat_ix].add(contrib)

@@ -1,0 +1,160 @@
+"""Pointwise GLM loss kernels: ``(margin, label) -> (loss, d/dz loss, d2/dz2 loss)``.
+
+These are the scalar kernels at the bottom of every objective evaluation.
+Reference: photon-ml .../function/glm/PointwiseLossFunction.scala:36-54
+(`lossAndDzLoss`, `DzzLoss`) and its implementations
+LogisticLossFunction.scala:122-141, SquaredLossFunction.scala,
+PoissonLossFunction.scala, and .../function/svm/SmoothedHingeLossFunction.scala.
+
+All functions are elementwise over arrays of margins/labels, jit- and
+vmap-safe, and written for numerical stability in float32 (the reference gets
+float64 for free on the JVM; here stable forms matter).
+
+Label conventions match the reference:
+- logistic: labels in {0, 1}; margin is the log-odds.
+- squared/poisson: real / non-negative labels.
+- smoothed hinge: labels in {0, 1}, internally mapped to {-1, +1}
+  (reference: SmoothedHingeLossFunction.scala maps via 2*y - 1).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.task import TaskType
+
+Array = jnp.ndarray
+
+
+class PointwiseLoss(NamedTuple):
+    """A pointwise loss: value, first and second derivative w.r.t. margin.
+
+    ``d2`` (the reference's `DzzLoss`) powers Hessian-vector products and
+    Hessian diagonals; losses that are only once-differentiable (smoothed
+    hinge) set ``has_hessian=False`` and their ``d2`` must not be trusted.
+    """
+
+    name: str
+    value: Callable[[Array, Array], Array]
+    d1: Callable[[Array, Array], Array]
+    d2: Callable[[Array, Array], Array]
+    # mean function: margin -> E[y]  (GeneralizedLinearModel.computeMean)
+    mean: Callable[[Array], Array]
+    has_hessian: bool = True
+
+
+def _sigmoid(z: Array) -> Array:
+    return jnp.where(
+        z >= 0,
+        1.0 / (1.0 + jnp.exp(-z)),
+        jnp.exp(z) / (1.0 + jnp.exp(z)),
+    )
+
+
+def _log1pexp(z: Array) -> Array:
+    """log(1 + exp(z)), stable for large |z|."""
+    return jnp.where(z > 0, z + jnp.log1p(jnp.exp(-z)), jnp.log1p(jnp.exp(z)))
+
+
+# --- logistic --------------------------------------------------------------
+# loss(z, y) = log(1 + e^z) - y z      (y in {0,1})
+# d1 = sigmoid(z) - y ;  d2 = sigmoid(z) (1 - sigmoid(z))
+# Stable form mirrors LogisticLossFunction.scala:122-141.
+
+def _logistic_value(z: Array, y: Array) -> Array:
+    return _log1pexp(z) - y * z
+
+
+def _logistic_d1(z: Array, y: Array) -> Array:
+    return _sigmoid(z) - y
+
+
+def _logistic_d2(z: Array, y: Array) -> Array:
+    s = _sigmoid(z)
+    return s * (1.0 - s)
+
+
+LOGISTIC = PointwiseLoss(
+    name="logistic",
+    value=_logistic_value,
+    d1=_logistic_d1,
+    d2=_logistic_d2,
+    mean=_sigmoid,
+)
+
+
+# --- squared ---------------------------------------------------------------
+# loss = 0.5 (z - y)^2  (SquaredLossFunction.scala)
+
+def _squared_value(z: Array, y: Array) -> Array:
+    d = z - y
+    return 0.5 * d * d
+
+
+LINEAR = PointwiseLoss(
+    name="squared",
+    value=_squared_value,
+    d1=lambda z, y: z - y,
+    d2=lambda z, y: jnp.ones_like(z),
+    mean=lambda z: z,
+)
+
+
+# --- poisson ---------------------------------------------------------------
+# loss = e^z - y z  (negative Poisson log-likelihood up to const,
+# PoissonLossFunction.scala)
+
+POISSON = PointwiseLoss(
+    name="poisson",
+    value=lambda z, y: jnp.exp(z) - y * z,
+    d1=lambda z, y: jnp.exp(z) - y,
+    d2=lambda z, y: jnp.exp(z),
+    mean=lambda z: jnp.exp(z),
+)
+
+
+# --- smoothed hinge (Rennie) ----------------------------------------------
+# With t = (2y - 1) z:
+#   t >= 1: 0 ;  t <= 0: 0.5 - t ;  else 0.5 (1 - t)^2
+# (SmoothedHingeLossFunction.scala; only once-differentiable, so TRON is
+# rejected for this task by OptimizerFactory — same rule enforced in
+# photon_ml_tpu.optim.factory.)
+
+def _hinge_t(z: Array, y: Array) -> Array:
+    return (2.0 * y - 1.0) * z
+
+
+def _smoothed_hinge_value(z: Array, y: Array) -> Array:
+    t = _hinge_t(z, y)
+    return jnp.where(t >= 1.0, 0.0, jnp.where(t <= 0.0, 0.5 - t, 0.5 * (1.0 - t) ** 2))
+
+
+def _smoothed_hinge_d1(z: Array, y: Array) -> Array:
+    s = 2.0 * y - 1.0
+    t = s * z
+    dt = jnp.where(t >= 1.0, 0.0, jnp.where(t <= 0.0, -1.0, t - 1.0))
+    return s * dt
+
+
+SMOOTHED_HINGE = PointwiseLoss(
+    name="smoothed_hinge",
+    value=_smoothed_hinge_value,
+    d1=_smoothed_hinge_d1,
+    d2=lambda z, y: jnp.zeros_like(z),
+    mean=lambda z: z,  # raw margin score (classification threshold applied later)
+    has_hessian=False,
+)
+
+
+LOSSES_BY_TASK = {
+    TaskType.LOGISTIC_REGRESSION: LOGISTIC,
+    TaskType.LINEAR_REGRESSION: LINEAR,
+    TaskType.POISSON_REGRESSION: POISSON,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: SMOOTHED_HINGE,
+}
+
+
+def loss_for_task(task: TaskType) -> PointwiseLoss:
+    return LOSSES_BY_TASK[task]

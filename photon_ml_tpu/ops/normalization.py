@@ -1,0 +1,129 @@
+"""Feature normalization as (shift, factor) algebra — never densifying data.
+
+Reference: photon-ml .../normalization/NormalizationContext.scala:119-157 and
+NormalizationType.java {NONE, SCALE_WITH_STANDARD_DEVIATION,
+SCALE_WITH_MAX_MAGNITUDE, STANDARDIZATION}.
+
+The key trick preserved from the reference (ValueAndGradientAggregator.
+scala:36-80): normalization ``x -> (x - shift) * factor`` is applied
+*algebraically inside the objective kernels*, so sparse data is never
+transformed or densified:
+
+    margin      = x . (factor * w) - shift . (factor * w)
+    grad        = factor * (sum_i c_i x_i  -  shift * sum_i c_i)
+
+with ``c_i = weight_i * dzLoss_i``. The intercept column (if any) has
+``shift = 0, factor = 1``.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+
+class NormalizationType(enum.Enum):
+    NONE = "NONE"
+    SCALE_WITH_STANDARD_DEVIATION = "SCALE_WITH_STANDARD_DEVIATION"
+    SCALE_WITH_MAX_MAGNITUDE = "SCALE_WITH_MAX_MAGNITUDE"
+    STANDARDIZATION = "STANDARDIZATION"
+
+
+class NormalizationContext(NamedTuple):
+    """Optional shift/factor vectors; None means identity (no-op).
+
+    A pytree — flows freely through jit/shard_map; replicated on the mesh.
+    """
+
+    factor: Optional[Array] = None  # [d] or None
+    shift: Optional[Array] = None  # [d] or None
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factor is None and self.shift is None
+
+    def effective_coefficients(self, coef: Array) -> Array:
+        """w_eff = factor * w (margin side)."""
+        return coef if self.factor is None else coef * self.factor
+
+    def shift_dot(self, coef_eff: Array) -> Array:
+        """shift . w_eff, the scalar subtracted from every margin."""
+        if self.shift is None:
+            return jnp.zeros((), dtype=coef_eff.dtype)
+        return jnp.dot(self.shift, coef_eff)
+
+    def unshift_gradient(self, vector_sum: Array, prefactor_sum: Array) -> Array:
+        """Driver-side un-shifting: (vectorSum - shift*prefactor) * factor.
+
+        Mirrors ValueAndGradientAggregator.scala:199-221.
+        """
+        g = vector_sum
+        if self.shift is not None:
+            g = g - self.shift * prefactor_sum
+        if self.factor is not None:
+            g = g * self.factor
+        return g
+
+    def model_to_original_space(self, coef: Array) -> Array:
+        """De-normalize trained coefficients back to the raw-feature space.
+
+        If training saw x' = (x - shift)*factor, then w_orig = factor * w'
+        and the intercept absorbs ``- (shift*factor) . w'``
+        (NormalizationContext.scala:72-84). Intercept handling is done by the
+        caller, which knows the intercept slot.
+        """
+        return coef if self.factor is None else coef * self.factor
+
+    def intercept_adjustment(self, coef: Array) -> Array:
+        """Amount to add to the intercept when mapping back to original space."""
+        if self.shift is None:
+            return jnp.zeros((), dtype=coef.dtype)
+        eff = self.effective_coefficients(coef)
+        return -jnp.dot(self.shift, eff)
+
+
+def identity_context() -> NormalizationContext:
+    return NormalizationContext(None, None)
+
+
+def build_normalization(
+    norm_type: NormalizationType,
+    *,
+    mean: Array,
+    std: Array,
+    max_magnitude: Array,
+    intercept_index: Optional[int] = None,
+) -> NormalizationContext:
+    """Build shift/factor from feature summary stats.
+
+    Mirrors NormalizationContext.scala:119-157; the intercept slot is kept
+    untouched (factor 1, shift 0).
+    """
+    mean = jnp.asarray(mean)
+    std = jnp.asarray(std)
+    max_magnitude = jnp.asarray(max_magnitude)
+    one = jnp.ones_like(mean)
+
+    safe_std = jnp.where(std > 0, std, 1.0)
+    safe_max = jnp.where(max_magnitude > 0, max_magnitude, 1.0)
+
+    if norm_type == NormalizationType.NONE:
+        return identity_context()
+    if norm_type == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+        factor, shift = one / safe_std, None
+    elif norm_type == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+        factor, shift = one / safe_max, None
+    elif norm_type == NormalizationType.STANDARDIZATION:
+        factor, shift = one / safe_std, mean
+    else:  # pragma: no cover
+        raise ValueError(norm_type)
+
+    if intercept_index is not None:
+        factor = factor.at[intercept_index].set(1.0)
+        if shift is not None:
+            shift = shift.at[intercept_index].set(0.0)
+    return NormalizationContext(factor=factor, shift=shift)

@@ -1,0 +1,32 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The reference tests distribution with partitioned local-mode Spark
+(photon-test SparkTestUtils.scala:27-70 — `local[4]`, never a real cluster);
+we do the same with XLA host devices: 8 virtual CPU devices so every
+shard_map / pjit path executes real collectives without TPU hardware.
+
+Must run before any jax import in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The container's sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS=axon (real TPU tunnel); override before any backend is used.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

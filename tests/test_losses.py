@@ -1,0 +1,68 @@
+"""Pointwise loss kernels vs numeric oracles (reference:
+photon-ml .../function/glm/*LossFunction* unit tests)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.task import TaskType
+
+ALL = [losses.LOGISTIC, losses.LINEAR, losses.POISSON, losses.SMOOTHED_HINGE]
+
+
+def _num_d1(f, z, y, eps=1e-3):
+    # eps large enough to dominate float32 quantization noise
+    return (f(z + eps, y) - f(z - eps, y)) / (2 * eps)
+
+
+@pytest.mark.parametrize("loss", ALL, ids=lambda l: l.name)
+def test_d1_matches_finite_difference(loss):
+    z = jnp.asarray(np.linspace(-4, 4, 41), dtype=jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    for y in (0.0, 1.0, 3.0) if loss.name in ("squared", "poisson") else (0.0, 1.0):
+        yv = jnp.full_like(z, y)
+        got = loss.d1(z, yv)
+        want = _num_d1(loss.value, z, yv)
+        np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
+
+
+@pytest.mark.parametrize("loss", [losses.LOGISTIC, losses.LINEAR, losses.POISSON], ids=lambda l: l.name)
+def test_d2_matches_finite_difference(loss):
+    z = jnp.asarray(np.linspace(-3, 3, 31))
+    yv = jnp.ones_like(z)
+    got = loss.d2(z, yv)
+    want = _num_d1(loss.d1, z, yv)
+    np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
+
+
+def test_logistic_stability_extreme_margins():
+    z = jnp.asarray([-500.0, -50.0, 0.0, 50.0, 500.0])
+    y = jnp.asarray([0.0, 1.0, 1.0, 0.0, 1.0])
+    v = losses.LOGISTIC.value(z, y)
+    assert bool(jnp.all(jnp.isfinite(v)))
+    # loss(z, y=1) ~ 0 for large positive margin; ~|z| for mismatched sign
+    np.testing.assert_allclose(float(v[4]), 0.0, atol=1e-5)
+    np.testing.assert_allclose(float(v[3]), 50.0, rtol=1e-5)
+    d = losses.LOGISTIC.d1(z, y)
+    assert bool(jnp.all(jnp.isfinite(d)))
+
+
+def test_smoothed_hinge_regions():
+    # label 1 -> s=+1: t=z. Regions: z>=1 -> 0 ; z<=0 -> 0.5 - z ; else quad
+    y = jnp.ones((5,))
+    z = jnp.asarray([-2.0, 0.0, 0.5, 1.0, 3.0])
+    v = losses.SMOOTHED_HINGE.value(z, y)
+    np.testing.assert_allclose(np.asarray(v), [2.5, 0.5, 0.125, 0.0, 0.0], atol=1e-6)
+
+
+def test_mean_functions():
+    z = jnp.asarray([0.0, 1.0])
+    np.testing.assert_allclose(losses.LOGISTIC.mean(z), [0.5, 1 / (1 + np.exp(-1))], rtol=1e-6)
+    np.testing.assert_allclose(losses.POISSON.mean(z), [1.0, np.e], rtol=1e-6)
+    np.testing.assert_allclose(losses.LINEAR.mean(z), [0.0, 1.0], rtol=1e-6)
+
+
+def test_loss_for_task():
+    assert losses.loss_for_task(TaskType.LOGISTIC_REGRESSION) is losses.LOGISTIC
+    assert not losses.loss_for_task(TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM).has_hessian

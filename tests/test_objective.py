@@ -1,0 +1,174 @@
+"""GLMObjective vs dense numpy oracles, incl. normalization algebra and
+sparse==dense equivalence (reference: function/DistributedGLMLossFunction and
+aggregator integration tests)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from photon_ml_tpu.data.batch import make_dense_batch, make_sparse_batch
+from photon_ml_tpu.ops import losses
+from photon_ml_tpu.ops.normalization import (
+    NormalizationContext,
+    NormalizationType,
+    build_normalization,
+)
+from photon_ml_tpu.ops.objective import GLMObjective
+
+DIM = 12
+N = 37
+
+
+def _data(rng, sparse_frac=0.6):
+    x = rng.normal(size=(N, DIM)).astype(np.float32)
+    mask = rng.uniform(size=(N, DIM)) < sparse_frac
+    x = np.where(mask, 0.0, x)
+    x[:, 0] = 1.0  # intercept column
+    y = (rng.uniform(size=N) < 0.5).astype(np.float32)
+    off = rng.normal(size=N).astype(np.float32) * 0.1
+    w = rng.uniform(0.5, 2.0, size=N).astype(np.float32)
+    return x, y, off, w
+
+
+def _to_sparse_rows(x):
+    rows = []
+    for i in range(x.shape[0]):
+        ix = np.nonzero(x[i])[0]
+        rows.append((ix.tolist(), x[i, ix].tolist()))
+    return rows
+
+
+def _np_oracle(x, y, off, w, coef, loss, l2, factor=None, shift=None):
+    """Dense numpy objective on explicitly transformed features."""
+    xe = x.copy()
+    if shift is not None:
+        xe = xe - shift[None, :]
+    if factor is not None:
+        xe = xe * factor[None, :]
+    z = xe @ coef + off
+    if loss is losses.LOGISTIC:
+        lv = np.logaddexp(0, z) - y * z
+        s = 1 / (1 + np.exp(-z))
+        d1 = s - y
+        d2 = s * (1 - s)
+    elif loss is losses.LINEAR:
+        lv = 0.5 * (z - y) ** 2
+        d1 = z - y
+        d2 = np.ones_like(z)
+    else:
+        lv = np.exp(z) - y * z
+        d1 = np.exp(z) - y
+        d2 = np.exp(z)
+    val = np.sum(w * lv) + 0.5 * l2 * coef @ coef
+    grad = xe.T @ (w * d1) + l2 * coef
+    hdiag = (xe**2).T @ (w * d2) + l2
+    return val, grad, d2, xe, hdiag
+
+
+@pytest.mark.parametrize("loss", [losses.LOGISTIC, losses.LINEAR, losses.POISSON], ids=lambda l: l.name)
+@pytest.mark.parametrize("norm", ["none", "scale", "standardize"])
+def test_value_grad_hv_hdiag_vs_oracle(rng, loss, norm):
+    x, y, off, w = _data(rng)
+    coef = rng.normal(size=DIM).astype(np.float32) * 0.3
+    d = rng.normal(size=DIM).astype(np.float32)
+    l2 = 0.7
+
+    factor = shift = None
+    ctx = NormalizationContext()
+    if norm == "scale":
+        factor = (1.0 / (np.abs(x).max(axis=0) + 0.5)).astype(np.float32)
+        ctx = NormalizationContext(factor=jnp.asarray(factor), shift=None)
+    elif norm == "standardize":
+        factor = (1.0 / (x.std(axis=0) + 0.5)).astype(np.float32)
+        shift = x.mean(axis=0).astype(np.float32)
+        shift[0] = 0.0
+        factor[0] = 1.0
+        ctx = NormalizationContext(factor=jnp.asarray(factor), shift=jnp.asarray(shift))
+
+    val_o, grad_o, d2_o, xe, hdiag_o = _np_oracle(x, y, off, w, coef, loss, l2, factor, shift)
+    hv_o = xe.T @ ((w * d2_o) * (xe @ d)) + l2 * d
+
+    obj = GLMObjective(loss=loss, dim=DIM, norm=ctx)
+    batch = make_sparse_batch(_to_sparse_rows(x), y, off, w)
+
+    val = obj.value(jnp.asarray(coef), batch, l2)
+    v2, grad = obj.value_and_gradient(jnp.asarray(coef), batch, l2)
+    hv = obj.hessian_vector(jnp.asarray(coef), jnp.asarray(d), batch, l2)
+    hdiag = obj.hessian_diagonal(jnp.asarray(coef), batch, l2)
+
+    np.testing.assert_allclose(float(val), val_o, rtol=2e-4)
+    np.testing.assert_allclose(float(v2), val_o, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(grad), grad_o, rtol=3e-3, atol=3e-3)
+    np.testing.assert_allclose(np.asarray(hv), hv_o, rtol=3e-3, atol=3e-3)
+    np.testing.assert_allclose(np.asarray(hdiag), hdiag_o, rtol=3e-3, atol=3e-3)
+
+
+def test_sparse_equals_dense(rng):
+    x, y, off, w = _data(rng)
+    coef = jnp.asarray(rng.normal(size=DIM).astype(np.float32))
+    obj = GLMObjective(loss=losses.LOGISTIC, dim=DIM)
+    sb = make_sparse_batch(_to_sparse_rows(x), y, off, w)
+    db = make_dense_batch(x, y, off, w)
+    vs, gs = obj.value_and_gradient(coef, sb, 0.1)
+    vd, gd = obj.value_and_gradient(coef, db, 0.1)
+    np.testing.assert_allclose(float(vs), float(vd), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gd), rtol=1e-4, atol=1e-5)
+
+
+def test_padding_rows_are_inert(rng):
+    x, y, off, w = _data(rng)
+    obj = GLMObjective(loss=losses.LOGISTIC, dim=DIM)
+    coef = jnp.asarray(rng.normal(size=DIM).astype(np.float32))
+    b_tight = make_sparse_batch(_to_sparse_rows(x), y, off, w, pad_rows_to=1)
+    b_padded = make_sparse_batch(_to_sparse_rows(x), y, off, w, pad_rows_to=64)
+    assert b_padded.num_rows > b_tight.num_rows
+    v1, g1 = obj.value_and_gradient(coef, b_tight, 0.3)
+    v2, g2 = obj.value_and_gradient(coef, b_padded, 0.3)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_matches_jax_autodiff(rng):
+    """The hand-fused gradient must equal jax.grad of the value."""
+    x, y, off, w = _data(rng)
+    ctx = NormalizationContext(
+        factor=jnp.asarray(rng.uniform(0.5, 2, DIM).astype(np.float32)),
+        shift=jnp.asarray(rng.normal(size=DIM).astype(np.float32) * 0.1),
+    )
+    obj = GLMObjective(loss=losses.LOGISTIC, dim=DIM, norm=ctx)
+    batch = make_sparse_batch(_to_sparse_rows(x), y, off, w)
+    coef = jnp.asarray(rng.normal(size=DIM).astype(np.float32))
+    g_auto = jax.grad(lambda c: obj.value(c, batch, 0.5))(coef)
+    _, g_manual = obj.value_and_gradient(coef, batch, 0.5)
+    np.testing.assert_allclose(np.asarray(g_manual), np.asarray(g_auto), rtol=1e-4, atol=1e-5)
+
+
+def test_hessian_vector_matches_autodiff(rng):
+    x, y, off, w = _data(rng)
+    obj = GLMObjective(loss=losses.POISSON, dim=DIM)
+    batch = make_sparse_batch(_to_sparse_rows(x), y, off, w)
+    coef = jnp.asarray(rng.normal(size=DIM).astype(np.float32) * 0.1)
+    d = jnp.asarray(rng.normal(size=DIM).astype(np.float32))
+    hv_auto = jax.jvp(jax.grad(lambda c: obj.value(c, batch, 0.2)), (coef,), (d,))[1]
+    hv_manual = obj.hessian_vector(coef, d, batch, 0.2)
+    np.testing.assert_allclose(np.asarray(hv_manual), np.asarray(hv_auto), rtol=2e-3, atol=2e-3)
+
+
+def test_build_normalization_types(rng):
+    mean = np.asarray([1.0, 2.0, 0.0], np.float32)
+    std = np.asarray([2.0, 0.0, 1.0], np.float32)
+    mx = np.asarray([4.0, 2.0, 0.0], np.float32)
+    ctx = build_normalization(
+        NormalizationType.STANDARDIZATION, mean=mean, std=std, max_magnitude=mx, intercept_index=2
+    )
+    np.testing.assert_allclose(np.asarray(ctx.factor), [0.5, 1.0, 1.0])
+    np.testing.assert_allclose(np.asarray(ctx.shift), [1.0, 2.0, 0.0])
+    ctx2 = build_normalization(
+        NormalizationType.SCALE_WITH_MAX_MAGNITUDE, mean=mean, std=std, max_magnitude=mx
+    )
+    assert ctx2.shift is None
+    np.testing.assert_allclose(np.asarray(ctx2.factor), [0.25, 0.5, 1.0])
+    assert build_normalization(
+        NormalizationType.NONE, mean=mean, std=std, max_magnitude=mx
+    ).is_identity
