@@ -1,0 +1,46 @@
+"""Optimizers: L-BFGS / OWL-QN / TRON as jit-once, vmap-able while_loop
+programs. See individual modules for reference citations."""
+
+from photon_ml_tpu.optim.common import (
+    BoxConstraints,
+    CONVERGENCE_REASON_NAMES,
+    FUNCTION_VALUES_WITHIN_TOLERANCE,
+    GRADIENT_WITHIN_TOLERANCE,
+    MAX_ITERATIONS,
+    NOT_CONVERGED,
+    OptResult,
+    Tracker,
+    project_coefficients_to_hypercube,
+)
+from photon_ml_tpu.optim.config import (
+    GLMOptimizationConfiguration,
+    OptimizerConfig,
+    OptimizerType,
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_ml_tpu.optim.factory import make_optimizer, validate_optimizer_choice
+from photon_ml_tpu.optim.lbfgs import minimize_lbfgs, minimize_owlqn
+from photon_ml_tpu.optim.tron import minimize_tron
+
+__all__ = [
+    "BoxConstraints",
+    "CONVERGENCE_REASON_NAMES",
+    "FUNCTION_VALUES_WITHIN_TOLERANCE",
+    "GRADIENT_WITHIN_TOLERANCE",
+    "MAX_ITERATIONS",
+    "NOT_CONVERGED",
+    "OptResult",
+    "Tracker",
+    "project_coefficients_to_hypercube",
+    "GLMOptimizationConfiguration",
+    "OptimizerConfig",
+    "OptimizerType",
+    "RegularizationContext",
+    "RegularizationType",
+    "make_optimizer",
+    "validate_optimizer_choice",
+    "minimize_lbfgs",
+    "minimize_owlqn",
+    "minimize_tron",
+]

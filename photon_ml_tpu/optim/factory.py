@@ -1,0 +1,108 @@
+"""Optimizer selection rules.
+
+Reference: photon-ml .../optimization/OptimizerFactory.scala:49-86 —
+(LBFGS, L1/ELASTIC_NET) -> OWLQN; (LBFGS, L2/NONE) -> LBFGS;
+(TRON, L2/NONE) -> TRON; TRON + any L1 rejected. Additionally the
+smoothed-hinge loss has no Hessian, so TRON is rejected for it
+(Params.validate in the reference, Params.scala:200-222).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.optim.common import BoxConstraints, OptResult, ValueAndGrad
+from photon_ml_tpu.optim.config import (
+    OptimizerConfig,
+    OptimizerType,
+    RegularizationContext,
+)
+from photon_ml_tpu.optim.lbfgs import minimize_lbfgs, minimize_owlqn
+from photon_ml_tpu.optim.tron import minimize_tron
+
+Array = jnp.ndarray
+
+
+def validate_optimizer_choice(
+    config: OptimizerConfig,
+    regularization: RegularizationContext,
+    *,
+    loss_has_hessian: bool = True,
+) -> None:
+    if config.optimizer_type == OptimizerType.TRON:
+        if regularization.has_l1:
+            raise ValueError(
+                "TRON does not support L1/ELASTIC_NET regularization "
+                "(OptimizerFactory.scala:49-86)"
+            )
+        if not loss_has_hessian:
+            raise ValueError(
+                "TRON requires a twice-differentiable loss; the smoothed "
+                "hinge loss is only once-differentiable"
+            )
+
+
+def make_optimizer(
+    config: OptimizerConfig,
+    regularization: RegularizationContext,
+    *,
+    loss_has_hessian: bool = True,
+    box: Optional[BoxConstraints] = None,
+    l1_mask: Optional[Array] = None,
+) -> Callable[..., OptResult]:
+    """Build ``optimize(value_and_grad_fn, w0, l1_weight=0.0, hvp_fn=None)``.
+
+    The returned callable has a uniform signature across LBFGS/OWLQN/TRON so
+    problem layers stay optimizer-agnostic; l1/l2 weights are runtime values
+    (one compilation per lambda-grid).
+    """
+    validate_optimizer_choice(config, regularization, loss_has_hessian=loss_has_hessian)
+    use_owlqn = regularization.has_l1
+    if use_owlqn and box is not None:
+        raise ValueError(
+            "box constraints are not supported with L1/ELASTIC_NET "
+            "regularization (OWL-QN's orthant projection and the hypercube "
+            "projection conflict); use L2/NONE with LBFGS or TRON"
+        )
+
+    def optimize(
+        value_and_grad_fn: ValueAndGrad,
+        w0: Array,
+        *,
+        l1_weight=0.0,
+        hvp_fn=None,
+    ) -> OptResult:
+        if config.optimizer_type == OptimizerType.TRON:
+            if hvp_fn is None:
+                raise ValueError("TRON requires hvp_fn")
+            return minimize_tron(
+                value_and_grad_fn,
+                hvp_fn,
+                w0,
+                max_iter=config.max_iter,
+                tol=config.tolerance,
+                max_cg=config.tron_max_cg,
+                box=box,
+            )
+        if use_owlqn:
+            return minimize_owlqn(
+                value_and_grad_fn,
+                w0,
+                l1_weight,
+                max_iter=config.max_iter,
+                tol=config.tolerance,
+                history=config.lbfgs_history,
+                l1_mask=l1_mask,
+            )
+        return minimize_lbfgs(
+            value_and_grad_fn,
+            w0,
+            max_iter=config.max_iter,
+            tol=config.tolerance,
+            history=config.lbfgs_history,
+            box=box,
+        )
+
+    return optimize

@@ -1,0 +1,216 @@
+"""Optimizer tests: closed-form quadratics, GLM convergence, L1 sparsity,
+box constraints, vmap-ability.
+
+Mirrors the reference's unit strategy (optimization/LBFGSTest, OWLQNTest,
+TRONTest against `TestObjective` closed forms) — validator-style checks, no
+golden numbers.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_ml_tpu.data.batch import make_dense_batch
+from photon_ml_tpu.ops.losses import LOGISTIC
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.optim import (
+    BoxConstraints,
+    GLMOptimizationConfiguration,
+    MAX_ITERATIONS,
+    NOT_CONVERGED,
+    OptimizerConfig,
+    OptimizerType,
+    RegularizationContext,
+    RegularizationType,
+    make_optimizer,
+    minimize_lbfgs,
+    minimize_owlqn,
+    minimize_tron,
+    validate_optimizer_choice,
+)
+
+
+def quad_vg(center, scales):
+    center = jnp.asarray(center)
+    scales = jnp.asarray(scales)
+
+    def vg(w):
+        d = w - center
+        return 0.5 * jnp.sum(scales * d * d), scales * d
+
+    return vg
+
+
+def quad_hvp(scales):
+    scales = jnp.asarray(scales)
+    return lambda w, d: scales * d
+
+
+CENTER = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+SCALES = np.array([1.0, 4.0, 0.5, 2.0], np.float32)
+
+
+class TestLBFGS:
+    def test_quadratic(self):
+        res = minimize_lbfgs(quad_vg(CENTER, SCALES), jnp.zeros(4))
+        np.testing.assert_allclose(np.asarray(res.coefficients), CENTER, atol=1e-4)
+        assert int(res.reason) != NOT_CONVERGED
+
+    def test_tracker_monotone(self):
+        res = minimize_lbfgs(quad_vg(CENTER, SCALES), jnp.zeros(4))
+        n = int(res.tracker.count)
+        vals = np.asarray(res.tracker.values)[:n]
+        assert vals[-1] <= vals[0]
+        assert n == int(res.iterations) + 1
+
+    def test_max_iter(self):
+        res = minimize_lbfgs(quad_vg(CENTER, SCALES), jnp.zeros(4), max_iter=2)
+        assert int(res.iterations) <= 2
+
+    def test_box_constraints(self):
+        box = BoxConstraints(
+            lower=jnp.array([-0.5, -0.5, -0.5, -0.5]),
+            upper=jnp.array([0.5, 0.5, 0.5, 0.5]),
+        )
+        res = minimize_lbfgs(quad_vg(CENTER, SCALES), jnp.zeros(4), box=box)
+        w = np.asarray(res.coefficients)
+        assert np.all(w >= -0.5 - 1e-6) and np.all(w <= 0.5 + 1e-6)
+        # Unconstrained optimum is outside the box on dims 0-2 → clamp there.
+        np.testing.assert_allclose(w[0], 0.5, atol=1e-3)
+        np.testing.assert_allclose(w[1], -0.5, atol=1e-3)
+
+    def test_jit_and_vmap(self):
+        centers = jnp.stack([jnp.asarray(CENTER), -jnp.asarray(CENTER)])
+
+        @jax.jit
+        @jax.vmap
+        def solve(center):
+            return minimize_lbfgs(quad_vg(center, SCALES), jnp.zeros(4)).coefficients
+
+        out = solve(centers)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(centers), atol=1e-3)
+
+    def test_zero_gradient_start(self):
+        res = minimize_lbfgs(quad_vg(CENTER, SCALES), jnp.asarray(CENTER))
+        np.testing.assert_allclose(np.asarray(res.coefficients), CENTER, atol=1e-6)
+
+
+class TestOWLQN:
+    def test_l1_produces_sparsity(self):
+        # min 0.5||w - c||^2 + l1*||w||_1 has closed form soft(c, l1).
+        vg = quad_vg(CENTER, np.ones(4, np.float32))
+        res = minimize_owlqn(vg, jnp.zeros(4), l1_weight=0.7)
+        expect = np.sign(CENTER) * np.maximum(np.abs(CENTER) - 0.7, 0.0)
+        np.testing.assert_allclose(np.asarray(res.coefficients), expect, atol=1e-3)
+        assert np.asarray(res.coefficients)[3] == pytest.approx(0.0, abs=1e-6)
+
+    def test_zero_l1_matches_lbfgs(self):
+        vg = quad_vg(CENTER, SCALES)
+        res = minimize_owlqn(vg, jnp.zeros(4), l1_weight=0.0)
+        np.testing.assert_allclose(np.asarray(res.coefficients), CENTER, atol=1e-3)
+
+    def test_l1_mask_exempts_intercept(self):
+        vg = quad_vg(CENTER, np.ones(4, np.float32))
+        mask = jnp.array([1.0, 1.0, 1.0, 0.0])
+        res = minimize_owlqn(vg, jnp.zeros(4), l1_weight=0.7, l1_mask=mask)
+        w = np.asarray(res.coefficients)
+        np.testing.assert_allclose(w[3], CENTER[3], atol=1e-3)  # unpenalized
+
+
+class TestTRON:
+    def test_quadratic(self):
+        res = minimize_tron(
+            quad_vg(CENTER, SCALES), quad_hvp(SCALES), jnp.zeros(4)
+        )
+        np.testing.assert_allclose(np.asarray(res.coefficients), CENTER, atol=1e-4)
+
+    def test_logistic_matches_lbfgs(self, rng):
+        n, d = 256, 8
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        w_true = rng.normal(size=d).astype(np.float32)
+        y = (1 / (1 + np.exp(-x @ w_true)) > rng.uniform(size=n)).astype(np.float32)
+        batch = make_dense_batch(x, y)
+        obj = GLMObjective(LOGISTIC, d)
+        vg = lambda w: obj.value_and_gradient(w, batch, l2_weight=0.1)
+        hvp = lambda w, dd: obj.hessian_vector(w, dd, batch, l2_weight=0.1)
+        r_tron = minimize_tron(vg, hvp, jnp.zeros(d), max_iter=50)
+        r_lbfgs = minimize_lbfgs(vg, jnp.zeros(d))
+        np.testing.assert_allclose(
+            np.asarray(r_tron.coefficients), np.asarray(r_lbfgs.coefficients),
+            atol=2e-3,
+        )
+
+    def test_vmap(self):
+        centers = jnp.stack([jnp.asarray(CENTER), 2 * jnp.asarray(CENTER)])
+
+        @jax.vmap
+        def solve(c):
+            return minimize_tron(quad_vg(c, SCALES), quad_hvp(SCALES), jnp.zeros(4)).coefficients
+
+        np.testing.assert_allclose(np.asarray(solve(centers)), np.asarray(centers), atol=1e-3)
+
+
+class TestFactory:
+    def test_tron_l1_rejected(self):
+        with pytest.raises(ValueError):
+            validate_optimizer_choice(
+                OptimizerConfig(OptimizerType.TRON),
+                RegularizationContext(RegularizationType.L1),
+            )
+
+    def test_tron_no_hessian_rejected(self):
+        with pytest.raises(ValueError):
+            validate_optimizer_choice(
+                OptimizerConfig(OptimizerType.TRON),
+                RegularizationContext(RegularizationType.NONE),
+                loss_has_hessian=False,
+            )
+
+    def test_lbfgs_l1_is_owlqn(self):
+        opt = make_optimizer(
+            OptimizerConfig(OptimizerType.LBFGS),
+            RegularizationContext(RegularizationType.L1),
+        )
+        vg = quad_vg(CENTER, np.ones(4, np.float32))
+        res = opt(vg, jnp.zeros(4), l1_weight=0.7)
+        expect = np.sign(CENTER) * np.maximum(np.abs(CENTER) - 0.7, 0.0)
+        np.testing.assert_allclose(np.asarray(res.coefficients), expect, atol=1e-3)
+
+    def test_elastic_net_split(self):
+        ctx = RegularizationContext(RegularizationType.ELASTIC_NET, 0.25)
+        l1, l2 = ctx.split(4.0)
+        assert l1 == pytest.approx(1.0) and l2 == pytest.approx(3.0)
+
+    def test_config_string_roundtrip(self):
+        cfg = GLMOptimizationConfiguration.parse("50,1e-6,0.3,0.5,TRON,L2")
+        assert cfg.optimizer_config.max_iter == 50
+        assert cfg.optimizer_config.optimizer_type == OptimizerType.TRON
+        assert cfg.regularization.reg_type == RegularizationType.L2
+        assert cfg.reg_weight == pytest.approx(0.3)
+        assert cfg.down_sampling_rate == pytest.approx(0.5)
+        cfg2 = GLMOptimizationConfiguration.parse(cfg.render())
+        assert cfg2 == cfg
+
+    def test_bad_config_strings(self):
+        for s in ["1,2,3", "0,1e-6,0,1,LBFGS,NONE", "10,1e-6,-1,1,LBFGS,NONE",
+                  "10,1e-6,0,0,LBFGS,NONE", "10,1e-6,0,1,ADAM,NONE"]:
+            with pytest.raises((ValueError, KeyError)):
+                GLMOptimizationConfiguration.parse(s)
+
+
+class TestRegressions:
+    def test_zero_gradient_start_reports_gradient_convergence(self):
+        from photon_ml_tpu.optim import GRADIENT_WITHIN_TOLERANCE
+        res = minimize_lbfgs(quad_vg(CENTER, SCALES), jnp.asarray(CENTER))
+        assert int(res.reason) == GRADIENT_WITHIN_TOLERANCE
+        assert int(res.iterations) == 0
+
+    def test_owlqn_box_rejected(self):
+        box = BoxConstraints(lower=jnp.zeros(4), upper=jnp.ones(4))
+        with pytest.raises(ValueError):
+            make_optimizer(
+                OptimizerConfig(OptimizerType.LBFGS),
+                RegularizationContext(RegularizationType.L1),
+                box=box,
+            )
