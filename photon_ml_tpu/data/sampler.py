@@ -1,0 +1,50 @@
+"""Down-samplers as on-device stateless-RNG weight masking.
+
+Reference: photon-ml .../sampler/DownSampler.scala,
+BinaryClassificationDownSampler.scala:89-109 (negative-only down-sampling
+with weight rescale 1/rate), DefaultDownSampler.scala (uniform sampling for
+regression tasks).
+
+Instead of materializing a smaller RDD, rows are masked in place: a dropped
+row gets weight 0 (padding semantics — contributes nothing to any
+reduction) and kept rows get their weight rescaled by 1/rate so the
+objective stays an unbiased estimate. Shapes stay static — no recompilation,
+and the mask composes with sharding.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.batch import Batch
+from photon_ml_tpu.task import TaskType
+
+Array = jnp.ndarray
+
+
+def default_down_sample(key: Array, batch: Batch, rate) -> Batch:
+    """Uniform row down-sampling with 1/rate weight rescale."""
+    keep = jax.random.bernoulli(key, rate, batch.weights.shape)
+    new_w = jnp.where(keep, batch.weights / rate, 0.0)
+    return batch._replace(weights=new_w)
+
+
+def binary_classification_down_sample(key: Array, batch: Batch, rate) -> Batch:
+    """Keep all positives; keep negatives with probability ``rate`` and
+    rescale their weight by 1/rate (BinaryClassificationDownSampler)."""
+    keep_draw = jax.random.bernoulli(key, rate, batch.weights.shape)
+    is_positive = batch.labels > 0.5
+    new_w = jnp.where(
+        is_positive,
+        batch.weights,
+        jnp.where(keep_draw, batch.weights / rate, 0.0),
+    )
+    return batch._replace(weights=new_w)
+
+
+def down_sample(key: Array, batch: Batch, rate, task: TaskType) -> Batch:
+    """Task-dispatching sampler (DownSampler factory semantics)."""
+    if task == TaskType.LOGISTIC_REGRESSION or task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+        return binary_classification_down_sample(key, batch, rate)
+    return default_down_sample(key, batch, rate)

@@ -1,0 +1,35 @@
+"""Evaluation: device-side metrics, evaluator types, model selection."""
+
+from photon_ml_tpu.evaluation.evaluator import (
+    Evaluator,
+    EvaluatorType,
+    select_best_model,
+)
+from photon_ml_tpu.evaluation.metrics import (
+    akaike_information_criterion,
+    area_under_precision_recall_curve,
+    area_under_roc_curve,
+    f1_score,
+    mean_pointwise_loss,
+    precision_at_k,
+    root_mean_squared_error,
+    sharded_auc,
+    sharded_precision_at_k,
+    total_pointwise_loss,
+)
+
+__all__ = [
+    "Evaluator",
+    "EvaluatorType",
+    "select_best_model",
+    "akaike_information_criterion",
+    "area_under_precision_recall_curve",
+    "area_under_roc_curve",
+    "f1_score",
+    "mean_pointwise_loss",
+    "precision_at_k",
+    "root_mean_squared_error",
+    "sharded_auc",
+    "sharded_precision_at_k",
+    "total_pointwise_loss",
+]

@@ -1,0 +1,226 @@
+"""Device-side evaluation metrics: AUC, AUPR, RMSE, weighted losses,
+precision@k — all weighted, padding-aware (weight 0 rows vanish), jit-safe.
+
+Reference: photon-ml Evaluation.scala:54-125 (MetricsMap: AUC/AUPR/RMSE/
+log-likelihood/AIC via Spark MLlib BinaryClassificationMetrics),
+evaluation/AreaUnderROCCurveLocalEvaluator.scala:1-65,
+PrecisionAtKLocalEvaluator.scala, RMSEEvaluator.scala and the loss
+evaluators (LogisticLossEvaluator.scala etc).
+
+The MLlib sort-and-sweep becomes one device sort + cumulative sums with
+exact tie handling (average-rank / trapezoidal semantics, matching MLlib's
+grouped-by-threshold curves).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.ops.losses import PointwiseLoss
+
+Array = jnp.ndarray
+
+
+def _tie_groups(sorted_keys: Array) -> Array:
+    """Group index per element of a sorted array; equal keys share a group."""
+    new_group = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_keys[1:] != sorted_keys[:-1]]
+    )
+    return jnp.cumsum(new_group) - 1  # int, in [0, n)
+
+
+def area_under_roc_curve(scores: Array, labels: Array, weights: Array) -> Array:
+    """Weighted AUC with exact tie handling (Mann-Whitney U / total mass).
+
+    AUC = sum_pos w_p * (W_neg_below(p) + 0.5 * W_neg_tied(p)) / (Wp * Wn).
+    Returns NaN when either class has zero weight (reference returns NaN via
+    MLlib on degenerate input).
+    """
+    n = scores.shape[0]
+    order = jnp.argsort(scores)
+    s, y, w = scores[order], labels[order], weights[order]
+    pos_w = w * (y > 0.5)
+    neg_w = w * (y <= 0.5)
+    g = _tie_groups(s)
+    group_neg = jnp.zeros((n,), w.dtype).at[g].add(neg_w)
+    excl_cum_neg = jnp.cumsum(group_neg) - group_neg  # neg mass strictly below group
+    credit = excl_cum_neg[g] + 0.5 * group_neg[g]
+    u = jnp.sum(pos_w * credit)
+    wp = jnp.sum(pos_w)
+    wn = jnp.sum(neg_w)
+    return u / (wp * wn)
+
+
+def area_under_precision_recall_curve(
+    scores: Array, labels: Array, weights: Array
+) -> Array:
+    """Weighted AUPR with threshold-grouped points and linear interpolation
+    between recall levels (MLlib PRCurve semantics: one point per distinct
+    score, area by trapezoid with first point (0, p@max))."""
+    n = scores.shape[0]
+    order = jnp.argsort(-scores)  # descending
+    s, y, w = scores[order], labels[order], weights[order]
+    pos_w = w * (y > 0.5)
+    g = _tie_groups(s)
+    group_pos = jnp.zeros((n,), w.dtype).at[g].add(pos_w)
+    group_tot = jnp.zeros((n,), w.dtype).at[g].add(w)
+    # Per tie-group cumulative (inclusive) true positives / predicted mass.
+    cum_pos_g = jnp.cumsum(group_pos)
+    cum_tot_g = jnp.cumsum(group_tot)
+    wp = jnp.sum(pos_w)
+    is_real_group = group_tot > 0  # empty trailing group slots
+    precision = jnp.where(cum_tot_g > 0, cum_pos_g / jnp.maximum(cum_tot_g, 1e-30), 0.0)
+    recall = jnp.where(wp > 0, cum_pos_g / jnp.maximum(wp, 1e-30), 0.0)
+    # Trapezoid over (recall, precision) points, prepending (0, P_first).
+    prev_recall = jnp.concatenate([jnp.zeros((1,), recall.dtype), recall[:-1]])
+    prev_precision = jnp.concatenate([precision[:1], precision[:-1]])
+    seg_area = jnp.where(
+        is_real_group,
+        (recall - prev_recall) * 0.5 * (precision + prev_precision),
+        0.0,
+    )
+    return jnp.sum(seg_area)
+
+
+def root_mean_squared_error(
+    predictions: Array, labels: Array, weights: Array
+) -> Array:
+    d = predictions - labels
+    return jnp.sqrt(jnp.sum(weights * d * d) / jnp.maximum(jnp.sum(weights), 1e-30))
+
+
+def mean_pointwise_loss(
+    loss: PointwiseLoss,
+    margins: Array,
+    labels: Array,
+    weights: Array,
+) -> Array:
+    """Weighted mean of a pointwise loss over margins (the reference's
+    per-datum loss evaluators divide by total weight)."""
+    total = jnp.sum(weights * loss.value(margins, labels))
+    return total / jnp.maximum(jnp.sum(weights), 1e-30)
+
+
+def total_pointwise_loss(
+    loss: PointwiseLoss, margins: Array, labels: Array, weights: Array
+) -> Array:
+    return jnp.sum(weights * loss.value(margins, labels))
+
+
+def akaike_information_criterion(
+    log_likelihood_total: Array, num_parameters: Array
+) -> Array:
+    """AIC = 2k - 2 ln L; the reference feeds total log-loss as -ln L
+    (Evaluation.scala)."""
+    return 2.0 * num_parameters + 2.0 * log_likelihood_total
+
+
+def precision_at_k(
+    k: int, scores: Array, labels: Array, weights: Array
+) -> Array:
+    """Unweighted precision@k over one group: fraction of positives among
+    the top-k scored items (PrecisionAtKLocalEvaluator; ranking is by score
+    descending, weights only gate row validity)."""
+    valid = weights > 0
+    masked = jnp.where(valid, scores, -jnp.inf)
+    order = jnp.argsort(-masked)
+    topk = order[:k]
+    hits = (labels[topk] > 0.5) & valid[topk]
+    denom = jnp.minimum(jnp.sum(valid), k)
+    return jnp.sum(hits) / jnp.maximum(denom, 1)
+
+
+def f1_score(
+    predictions: Array, labels: Array, weights: Array
+) -> Array:
+    """Weighted F1 for binary 0/1 predictions."""
+    tp = jnp.sum(weights * (predictions > 0.5) * (labels > 0.5))
+    fp = jnp.sum(weights * (predictions > 0.5) * (labels <= 0.5))
+    fn = jnp.sum(weights * (predictions <= 0.5) * (labels > 0.5))
+    return 2.0 * tp / jnp.maximum(2.0 * tp + fp + fn, 1e-30)
+
+
+# ---------------------------------------------------------------------------
+# Sharded (grouped-by-id) metrics — the reference's ShardedEvaluator family.
+# ---------------------------------------------------------------------------
+
+
+def sharded_auc(
+    group_ids: Array,
+    scores: Array,
+    labels: Array,
+    weights: Array,
+    num_groups: int,
+) -> Array:
+    """Mean per-group AUC over groups that have both classes.
+
+    Reference: evaluation/ShardedAreaUnderROCCurveEvaluator — groupBy
+    document id, local AUC per group, unweighted average. Here the groupBy
+    is a lexsort + segmented cumulative sums; ``group_ids`` must be dense
+    ints in [0, num_groups).
+    """
+    n = scores.shape[0]
+    order = jnp.lexsort((scores, group_ids))
+    gid, s, y, w = group_ids[order], scores[order], labels[order], weights[order]
+    pos_w = w * (y > 0.5)
+    neg_w = w * (y <= 0.5)
+    # Tie groups keyed by (group, score): new group when either changes.
+    new_group = jnp.concatenate(
+        [
+            jnp.ones((1,), bool),
+            (gid[1:] != gid[:-1]) | (s[1:] != s[:-1]),
+        ]
+    )
+    tg = jnp.cumsum(new_group) - 1
+    group_neg = jnp.zeros((n,), w.dtype).at[tg].add(neg_w)
+    glob_excl = jnp.cumsum(group_neg) - group_neg
+    # Per-id segment totals and their exclusive prefix (base at segment start).
+    seg_neg_total = jnp.zeros((num_groups,), w.dtype).at[gid].add(neg_w)
+    seg_base = jnp.cumsum(seg_neg_total) - seg_neg_total
+    # Which id-segment each tie-group belongs to.
+    tg_seg = jnp.zeros((n,), gid.dtype).at[tg].max(gid)
+    within_excl = glob_excl - seg_base[tg_seg]
+    credit = within_excl[tg] + 0.5 * group_neg[tg]
+    seg_u = jnp.zeros((num_groups,), w.dtype).at[gid].add(pos_w * credit)
+    seg_pos = jnp.zeros((num_groups,), w.dtype).at[gid].add(pos_w)
+    valid = (seg_pos > 0) & (seg_neg_total > 0)
+    auc = jnp.where(
+        valid, seg_u / jnp.maximum(seg_pos * seg_neg_total, 1e-30), 0.0
+    )
+    return jnp.sum(auc) / jnp.maximum(jnp.sum(valid), 1)
+
+
+def sharded_precision_at_k(
+    k: int,
+    group_ids: Array,
+    scores: Array,
+    labels: Array,
+    weights: Array,
+    num_groups: int,
+) -> Array:
+    """Mean per-group precision@k (ShardedPrecisionAtKEvaluator)."""
+    n = scores.shape[0]
+    valid_row = weights > 0
+    masked = jnp.where(valid_row, scores, -jnp.inf)
+    order = jnp.lexsort((-masked, group_ids))
+    gid, y, v = group_ids[order], labels[order], valid_row[order]
+    # Rank within group = position - first position of the group.
+    pos = jnp.arange(n)
+    is_first = jnp.concatenate([jnp.ones((1,), bool), gid[1:] != gid[:-1]])
+    seg_start = jnp.full((num_groups,), n, pos.dtype).at[gid].min(
+        jnp.where(is_first, pos, n)
+    )
+    rank = pos - seg_start[gid]
+    in_topk = (rank < k) & v
+    seg_hits = jnp.zeros((num_groups,), jnp.float32).at[gid].add(
+        (in_topk & (y > 0.5)).astype(jnp.float32)
+    )
+    seg_count = jnp.zeros((num_groups,), jnp.float32).at[gid].add(
+        v.astype(jnp.float32)
+    )
+    denom = jnp.minimum(seg_count, float(k))
+    group_exists = seg_count > 0
+    prec = jnp.where(group_exists, seg_hits / jnp.maximum(denom, 1.0), 0.0)
+    return jnp.sum(prec) / jnp.maximum(jnp.sum(group_exists), 1)

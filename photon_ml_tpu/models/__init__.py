@@ -1,0 +1,29 @@
+"""Model classes: GLM coefficients + per-family wrappers (GAME models live
+in photon_ml_tpu.game)."""
+
+from photon_ml_tpu.models.coefficients import CoefficientSummary, Coefficients
+from photon_ml_tpu.models.glm import (
+    GeneralizedLinearModel,
+    compute_margins,
+    compute_means,
+    compute_scores,
+    create_model,
+    linear_regression_model,
+    logistic_regression_model,
+    poisson_regression_model,
+    smoothed_hinge_svm_model,
+)
+
+__all__ = [
+    "CoefficientSummary",
+    "Coefficients",
+    "GeneralizedLinearModel",
+    "compute_margins",
+    "compute_means",
+    "compute_scores",
+    "create_model",
+    "linear_regression_model",
+    "logistic_regression_model",
+    "poisson_regression_model",
+    "smoothed_hinge_svm_model",
+]

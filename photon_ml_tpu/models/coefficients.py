@@ -1,0 +1,73 @@
+"""Model coefficients: means + optional per-coefficient variances.
+
+Reference: photon-ml .../model/Coefficients.scala:33 (Coefficients(means,
+variancesOption)) and supervised/model/CoefficientSummary.scala.
+
+A NamedTuple pytree: flows through jit/vmap/shard_map; a *bank* of entity
+models is simply a Coefficients whose arrays carry a leading entity axis.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+
+class Coefficients(NamedTuple):
+    means: Array  # [d] (or [entities, d] for banks)
+    variances: Optional[Array] = None  # same shape as means, or None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    def l2_norm(self) -> Array:
+        return jnp.linalg.norm(self.means, axis=-1)
+
+    def l1_norm(self) -> Array:
+        return jnp.sum(jnp.abs(self.means), axis=-1)
+
+    @staticmethod
+    def zeros(dim: int, dtype=jnp.float32) -> "Coefficients":
+        return Coefficients(means=jnp.zeros((dim,), dtype))
+
+
+class CoefficientSummary(NamedTuple):
+    """Running summary of one coefficient across bootstrap replicates
+    (CoefficientSummary.scala): min/max/mean/variance estimates."""
+
+    count: Array
+    mean: Array
+    m2: Array  # sum of squared deviations (Welford)
+    min: Array
+    max: Array
+
+    @staticmethod
+    def empty(dtype=jnp.float32) -> "CoefficientSummary":
+        return CoefficientSummary(
+            count=jnp.zeros((), dtype),
+            mean=jnp.zeros((), dtype),
+            m2=jnp.zeros((), dtype),
+            min=jnp.full((), jnp.inf, dtype),
+            max=jnp.full((), -jnp.inf, dtype),
+        )
+
+    def accumulate(self, x: Array) -> "CoefficientSummary":
+        count = self.count + 1.0
+        delta = x - self.mean
+        mean = self.mean + delta / count
+        m2 = self.m2 + delta * (x - mean)
+        return CoefficientSummary(
+            count=count,
+            mean=mean,
+            m2=m2,
+            min=jnp.minimum(self.min, x),
+            max=jnp.maximum(self.max, x),
+        )
+
+    @property
+    def variance(self) -> Array:
+        return jnp.where(self.count > 1, self.m2 / (self.count - 1.0), 0.0)

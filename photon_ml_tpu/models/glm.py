@@ -1,0 +1,99 @@
+"""Generalized linear model classes.
+
+Reference: photon-ml .../supervised/model/GeneralizedLinearModel.scala
+(computeScore = features.coef at :47, computeMeanFunctionWithOffset at
+:56-66), supervised/classification/{LogisticRegressionModel,
+SmoothedHingeLossLinearSVMModel}.scala (predictClassWithThreshold),
+supervised/regression/{LinearRegressionModel,PoissonRegressionModel}.scala.
+
+Scoring is a pure function of (coefficients, batch) so it runs inside jit
+and under any sharding; the model classes are thin host-side wrappers that
+carry the task type and expose the reference's API surface.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.batch import Batch, SparseBatch, sparse_dot
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.task import TaskType
+
+Array = jnp.ndarray
+
+
+def compute_scores(coef: Array, batch: Batch) -> Array:
+    """Raw margins WITHOUT offsets: features . coef
+    (GeneralizedLinearModel.computeScore)."""
+    if isinstance(batch, SparseBatch):
+        return sparse_dot(batch, coef)
+    return batch.features @ coef
+
+
+def compute_margins(coef: Array, batch: Batch) -> Array:
+    """Margins including offsets: features . coef + offset."""
+    return compute_scores(coef, batch) + batch.offsets
+
+
+def compute_means(task: TaskType, coef: Array, batch: Batch) -> Array:
+    """Mean response with offsets (computeMeanFunctionWithOffset):
+    sigmoid / identity / exp / raw margin per task."""
+    return loss_for_task(task).mean(compute_margins(coef, batch))
+
+
+@dataclass(frozen=True)
+class GeneralizedLinearModel:
+    """task + coefficients; subclasses fix the task type for API parity."""
+
+    task: TaskType
+    coefficients: Coefficients
+
+    @property
+    def means(self) -> Array:
+        return self.coefficients.means
+
+    def score(self, batch: Batch) -> Array:
+        return compute_scores(self.means, batch)
+
+    def mean(self, batch: Batch) -> Array:
+        return compute_means(self.task, self.means, batch)
+
+    def update_coefficients(self, coefficients: Coefficients) -> "GeneralizedLinearModel":
+        return replace(self, coefficients=coefficients)
+
+    def predict_class(self, batch: Batch, threshold: float = 0.5) -> Array:
+        """Binary 0/1 prediction (predictClassWithThreshold); only valid for
+        classification tasks."""
+        if not self.task.is_classification:
+            raise ValueError(f"{self.task} is not a classification task")
+        if self.task == TaskType.LOGISTIC_REGRESSION:
+            return (self.mean(batch) > threshold).astype(jnp.float32)
+        # SVM: threshold on the raw margin at 0 (probability threshold 0.5
+        # maps to margin 0 for the hinge model).
+        return (compute_margins(self.means, batch) > 0.0).astype(jnp.float32)
+
+
+def logistic_regression_model(coefficients: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(TaskType.LOGISTIC_REGRESSION, coefficients)
+
+
+def linear_regression_model(coefficients: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(TaskType.LINEAR_REGRESSION, coefficients)
+
+
+def poisson_regression_model(coefficients: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(TaskType.POISSON_REGRESSION, coefficients)
+
+
+def smoothed_hinge_svm_model(coefficients: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(
+        TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM, coefficients
+    )
+
+
+def create_model(task: TaskType, coefficients: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(task, coefficients)

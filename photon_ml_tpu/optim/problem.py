@@ -1,0 +1,168 @@
+"""GLM optimization problems: optimizer + objective + model construction.
+
+Reference: photon-ml .../optimization/GeneralizedLinearOptimizationProblem.
+scala (run at :112-121, coefficient de-normalization at :89-95),
+DistributedOptimizationProblem.scala (variance computation 1/(Hdiag+eps) at
+:79-93, updateRegularizationWeight at :59-70, runWithSampling at :112-124)
+and SingleNodeOptimizationProblem.scala.
+
+The Distributed/SingleNode split disappears on TPU: the same problem object
+runs single-chip or under shard_map depending on the objective's
+``axis_name``; "single node" per-entity solves are the vmapped variant
+(photon_ml_tpu.game.random_effect).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.batch import Batch
+from photon_ml_tpu.data.sampler import down_sample
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import GeneralizedLinearModel, create_model
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.normalization import NormalizationContext, identity_context
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.optim.common import BoxConstraints, OptResult
+from photon_ml_tpu.optim.config import (
+    OptimizerConfig,
+    OptimizerType,
+    RegularizationContext,
+)
+from photon_ml_tpu.optim.factory import make_optimizer
+from photon_ml_tpu.task import TaskType
+
+Array = jnp.ndarray
+
+# Reference adds a small epsilon when inverting the Hessian diagonal
+# (DistributedOptimizationProblem.scala:79-93).
+_VARIANCE_EPSILON = 1e-12
+
+
+@dataclass(frozen=True)
+class GLMOptimizationProblem:
+    """One (task, optimizer, regularization) training problem over a
+    coefficient dimension. Reusable across a whole lambda grid: the
+    regularization weight is a runtime argument."""
+
+    task: "TaskType"
+    objective: GLMObjective
+    config: OptimizerConfig = field(default_factory=OptimizerConfig)
+    regularization: RegularizationContext = field(
+        default_factory=RegularizationContext
+    )
+    compute_variances: bool = False
+    box: Optional[BoxConstraints] = None
+    intercept_index: Optional[int] = None
+
+    def _l1_mask(self) -> Optional[Array]:
+        if self.intercept_index is None:
+            return None
+        return jnp.ones((self.objective.dim,)).at[self.intercept_index].set(0.0)
+
+    def run(
+        self,
+        batch: Batch,
+        initial: Optional[Array] = None,
+        reg_weight: float = 0.0,
+    ) -> Tuple[Coefficients, OptResult]:
+        """Optimize and build coefficients (+ variances if requested).
+
+        Mirrors GeneralizedLinearOptimizationProblem.run:112-121.
+        """
+        w0 = (
+            jnp.zeros((self.objective.dim,), jnp.float32)
+            if initial is None
+            else jnp.asarray(initial)
+        )
+        l1, l2 = self.regularization.split(reg_weight)
+        optimize = make_optimizer(
+            self.config,
+            self.regularization,
+            loss_has_hessian=self.objective.loss.has_hessian,
+            box=self.box,
+            l1_mask=self._l1_mask(),
+        )
+
+        def vg(w):
+            return self.objective.value_and_gradient(w, batch, l2)
+
+        def hvp(w, d):
+            return self.objective.hessian_vector(w, d, batch, l2)
+
+        needs_hvp = self.config.optimizer_type == OptimizerType.TRON
+        result = optimize(vg, w0, l1_weight=l1, hvp_fn=hvp if needs_hvp else None)
+
+        variances = None
+        if self.compute_variances:
+            hdiag = self.objective.hessian_diagonal(result.coefficients, batch, l2)
+            variances = 1.0 / (hdiag + _VARIANCE_EPSILON)
+        return Coefficients(result.coefficients, variances), result
+
+    def run_with_sampling(
+        self,
+        batch: Batch,
+        key: Array,
+        down_sampling_rate: float,
+        initial: Optional[Array] = None,
+        reg_weight: float = 0.0,
+    ) -> Tuple[Coefficients, OptResult]:
+        """Apply the task's down-sampler first (runWithSampling:112-124)."""
+        if down_sampling_rate < 1.0:
+            batch = down_sample(key, batch, down_sampling_rate, self.task)
+        return self.run(batch, initial, reg_weight)
+
+    def create_model(
+        self,
+        coefficients: Coefficients,
+        norm: Optional[NormalizationContext] = None,
+    ) -> GeneralizedLinearModel:
+        """Build the model, de-normalizing coefficients back to the raw
+        feature space (GeneralizedLinearOptimizationProblem.scala:89-95)."""
+        norm = norm if norm is not None else identity_context()
+        if not norm.is_identity:
+            means = norm.model_to_original_space(coefficients.means)
+            if self.intercept_index is not None:
+                # The intercept absorbs -shift.(factor*w'); its own slot has
+                # factor 1 / shift 0 by construction in build_normalization.
+                means = means.at[self.intercept_index].add(
+                    norm.intercept_adjustment(coefficients.means)
+                )
+            coefficients = Coefficients(means, coefficients.variances)
+        return create_model(self.task, coefficients)
+
+
+def create_glm_problem(
+    task,
+    dim: int,
+    *,
+    config: Optional[OptimizerConfig] = None,
+    regularization: Optional[RegularizationContext] = None,
+    norm: Optional[NormalizationContext] = None,
+    axis_name: Optional[str] = None,
+    compute_variances: bool = False,
+    box: Optional[BoxConstraints] = None,
+    intercept_index: Optional[int] = None,
+) -> GLMOptimizationProblem:
+    """Convenience factory mirroring DistributedGLMLossFunction.create +
+    DistributedOptimizationProblem.create (ModelTraining.scala:123-169)."""
+    objective = GLMObjective(
+        loss_for_task(task),
+        dim,
+        norm if norm is not None else identity_context(),
+        axis_name,
+    )
+    return GLMOptimizationProblem(
+        task=task,
+        objective=objective,
+        config=config if config is not None else OptimizerConfig(),
+        regularization=(
+            regularization if regularization is not None else RegularizationContext()
+        ),
+        compute_variances=compute_variances,
+        box=box,
+        intercept_index=intercept_index,
+    )

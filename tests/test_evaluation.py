@@ -1,0 +1,208 @@
+"""Evaluation tests: metrics vs brute-force oracles (incl. sklearn-free
+pairwise AUC), sharded evaluators, evaluator-type parsing, model selection.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.evaluation import (
+    Evaluator,
+    EvaluatorType,
+    area_under_precision_recall_curve,
+    area_under_roc_curve,
+    f1_score,
+    mean_pointwise_loss,
+    precision_at_k,
+    root_mean_squared_error,
+    select_best_model,
+    sharded_auc,
+    sharded_precision_at_k,
+)
+from photon_ml_tpu.ops.losses import LOGISTIC
+
+
+def brute_force_auc(scores, labels, weights):
+    pos = [(s, w) for s, y, w in zip(scores, labels, weights) if y > 0.5 and w > 0]
+    neg = [(s, w) for s, y, w in zip(scores, labels, weights) if y <= 0.5 and w > 0]
+    num = 0.0
+    for sp, wp in pos:
+        for sn, wn in neg:
+            if sp > sn:
+                num += wp * wn
+            elif sp == sn:
+                num += 0.5 * wp * wn
+    den = sum(w for _, w in pos) * sum(w for _, w in neg)
+    return num / den
+
+
+class TestAUC:
+    def test_matches_brute_force(self, rng):
+        n = 64
+        scores = rng.normal(size=n).astype(np.float32)
+        labels = (rng.uniform(size=n) > 0.4).astype(np.float32)
+        weights = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+        got = float(area_under_roc_curve(
+            jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights)))
+        assert got == pytest.approx(brute_force_auc(scores, labels, weights), abs=1e-5)
+
+    def test_ties(self, rng):
+        scores = np.array([1.0, 1.0, 0.0, 0.0], np.float32)
+        labels = np.array([1.0, 0.0, 1.0, 0.0], np.float32)
+        weights = np.ones(4, np.float32)
+        got = float(area_under_roc_curve(
+            jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights)))
+        assert got == pytest.approx(brute_force_auc(scores, labels, weights), abs=1e-6)
+
+    def test_perfect_separation(self):
+        scores = jnp.array([3.0, 2.0, -1.0, -2.0])
+        labels = jnp.array([1.0, 1.0, 0.0, 0.0])
+        w = jnp.ones(4)
+        assert float(area_under_roc_curve(scores, labels, w)) == pytest.approx(1.0)
+
+    def test_padding_rows_ignored(self, rng):
+        scores = np.array([1.0, -1.0, 99.0], np.float32)
+        labels = np.array([1.0, 0.0, 0.0], np.float32)
+        weights = np.array([1.0, 1.0, 0.0], np.float32)  # last row = padding
+        got = float(area_under_roc_curve(
+            jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights)))
+        assert got == pytest.approx(1.0)
+
+
+class TestOtherMetrics:
+    def test_rmse(self, rng):
+        p = rng.normal(size=32).astype(np.float32)
+        y = rng.normal(size=32).astype(np.float32)
+        w = rng.uniform(0.1, 2.0, size=32).astype(np.float32)
+        expect = np.sqrt(np.sum(w * (p - y) ** 2) / np.sum(w))
+        got = float(root_mean_squared_error(jnp.asarray(p), jnp.asarray(y), jnp.asarray(w)))
+        assert got == pytest.approx(expect, rel=1e-5)
+
+    def test_mean_logistic_loss(self):
+        z = jnp.array([0.0, 2.0])
+        y = jnp.array([1.0, 0.0])
+        w = jnp.array([1.0, 1.0])
+        expect = (np.log(2.0) + np.log1p(np.exp(2.0))) / 2.0
+        assert float(mean_pointwise_loss(LOGISTIC, z, y, w)) == pytest.approx(expect, rel=1e-5)
+
+    def test_precision_at_k(self):
+        scores = jnp.array([5.0, 4.0, 3.0, 2.0, 1.0])
+        labels = jnp.array([1.0, 0.0, 1.0, 1.0, 1.0])
+        w = jnp.ones(5)
+        assert float(precision_at_k(3, scores, labels, w)) == pytest.approx(2 / 3)
+
+    def test_precision_at_k_ignores_padding(self):
+        scores = jnp.array([5.0, 4.0, 3.0])
+        labels = jnp.array([1.0, 1.0, 1.0])
+        w = jnp.array([1.0, 0.0, 1.0])
+        assert float(precision_at_k(2, scores, labels, w)) == pytest.approx(1.0)
+
+    def test_aupr_sane(self, rng):
+        n = 128
+        scores = rng.normal(size=n).astype(np.float32)
+        labels = (scores + 0.5 * rng.normal(size=n) > 0).astype(np.float32)
+        w = np.ones(n, np.float32)
+        aupr = float(area_under_precision_recall_curve(
+            jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(w)))
+        base = labels.mean()
+        assert base < aupr <= 1.0
+
+    def test_f1(self):
+        pred = jnp.array([1.0, 1.0, 0.0, 0.0])
+        lab = jnp.array([1.0, 0.0, 1.0, 0.0])
+        w = jnp.ones(4)
+        assert float(f1_score(pred, lab, w)) == pytest.approx(0.5)
+
+
+class TestSharded:
+    def test_sharded_auc_mean_of_groups(self, rng):
+        # Two groups with known local AUCs.
+        gids = np.array([0, 0, 0, 0, 1, 1, 1, 1], np.int32)
+        scores = np.array([3, 2, 1, 0, 3, 2, 1, 0], np.float32)
+        labels = np.array([1, 1, 0, 0, 0, 1, 0, 1], np.float32)
+        w = np.ones(8, np.float32)
+        local0 = brute_force_auc(scores[:4], labels[:4], w[:4])
+        local1 = brute_force_auc(scores[4:], labels[4:], w[4:])
+        got = float(sharded_auc(jnp.asarray(gids), jnp.asarray(scores),
+                                jnp.asarray(labels), jnp.asarray(w), 2))
+        assert got == pytest.approx((local0 + local1) / 2, abs=1e-5)
+
+    def test_sharded_auc_skips_single_class_groups(self):
+        gids = jnp.array([0, 0, 1, 1], jnp.int32)
+        scores = jnp.array([2.0, 1.0, 2.0, 1.0])
+        labels = jnp.array([1.0, 0.0, 1.0, 1.0])  # group 1 all-positive
+        w = jnp.ones(4)
+        assert float(sharded_auc(gids, scores, labels, w, 2)) == pytest.approx(1.0)
+
+    def test_sharded_auc_random_matches_per_group_brute_force(self, rng):
+        n, G = 96, 7
+        gids = rng.integers(0, G, size=n).astype(np.int32)
+        scores = rng.normal(size=n).astype(np.float32)
+        labels = (rng.uniform(size=n) > 0.5).astype(np.float32)
+        w = rng.uniform(0.5, 1.5, size=n).astype(np.float32)
+        locals_ = []
+        for g in range(G):
+            m = gids == g
+            if m.sum() and labels[m].max() > 0.5 and labels[m].min() <= 0.5:
+                locals_.append(brute_force_auc(scores[m], labels[m], w[m]))
+        got = float(sharded_auc(jnp.asarray(gids), jnp.asarray(scores),
+                                jnp.asarray(labels), jnp.asarray(w), G))
+        assert got == pytest.approx(np.mean(locals_), abs=1e-5)
+
+    def test_sharded_precision_at_k(self):
+        gids = jnp.array([0, 0, 0, 1, 1, 1], jnp.int32)
+        scores = jnp.array([3.0, 2.0, 1.0, 3.0, 2.0, 1.0])
+        labels = jnp.array([1.0, 0.0, 1.0, 1.0, 1.0, 0.0])
+        w = jnp.ones(6)
+        got = float(sharded_precision_at_k(2, gids, scores, labels, w, 2))
+        assert got == pytest.approx((0.5 + 1.0) / 2)
+
+
+class TestEvaluatorTypes:
+    def test_parse_simple(self):
+        assert EvaluatorType.parse("AUC").name == "AUC"
+        assert EvaluatorType.parse("rmse").name == "RMSE"
+        assert EvaluatorType.parse("LOGISTIC_LOSS").name == "LOGISTIC_LOSS"
+
+    def test_parse_sharded(self):
+        et = EvaluatorType.parse("precision@5:queryId")
+        assert et.name == "PRECISION_AT_K" and et.k == 5 and et.id_type == "queryId"
+        et2 = EvaluatorType.parse("AUC:documentId")
+        assert et2.name == "AUC" and et2.id_type == "documentId"
+
+    def test_render_roundtrip(self):
+        for s in ["AUC", "RMSE", "PRECISION@5:queryId", "AUC:docId"]:
+            assert EvaluatorType.parse(EvaluatorType.parse(s).render()) == EvaluatorType.parse(s)
+
+    def test_parse_unknown(self):
+        with pytest.raises(ValueError):
+            EvaluatorType.parse("NDCG")
+
+    def test_direction(self):
+        assert EvaluatorType.parse("AUC").better_than(0.9, 0.8)
+        assert EvaluatorType.parse("RMSE").better_than(0.1, 0.2)
+
+    def test_evaluator_dispatch(self, rng):
+        n = 32
+        scores = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        labels = jnp.asarray((rng.uniform(size=n) > 0.5).astype(np.float32))
+        w = jnp.ones(n)
+        ev = Evaluator(EvaluatorType.parse("AUC"))
+        assert 0.0 <= float(ev.evaluate(scores, labels, w)) <= 1.0
+        with pytest.raises(ValueError):
+            Evaluator(EvaluatorType.parse("AUC:qid")).evaluate(scores, labels, w)
+
+
+class TestModelSelection:
+    def test_select_best(self):
+        models = {0.1: "m1", 1.0: "m2", 10.0: "m3"}
+        metrics = {"m1": 0.7, "m2": 0.9, "m3": 0.8}
+        lam, model, metric = select_best_model(
+            models, lambda m: metrics[m], maximize=True
+        )
+        assert (lam, model, metric) == (1.0, "m2", 0.9)
+        lam, model, metric = select_best_model(
+            models, lambda m: metrics[m], maximize=False
+        )
+        assert model == "m1"
