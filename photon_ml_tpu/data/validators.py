@@ -1,0 +1,92 @@
+"""Input data validation.
+
+Reference: photon-ml .../data/DataValidators.scala:139 — per-task row
+validators (finite features/offsets/labels, binary labels for
+classification, non-negative labels for Poisson) run at
+``VALIDATE_FULL`` / ``VALIDATE_SAMPLE`` / ``VALIDATE_DISABLED`` levels
+(sanity checks fail the job with a summary of violations).
+
+Device-side: each check is a vectorized reduction over the batch; the
+driver raises with counts instead of per-row messages.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.batch import Batch, SparseBatch
+from photon_ml_tpu.task import TaskType
+
+Array = jnp.ndarray
+
+
+class DataValidationType(enum.Enum):
+    VALIDATE_FULL = "VALIDATE_FULL"
+    VALIDATE_SAMPLE = "VALIDATE_SAMPLE"
+    VALIDATE_DISABLED = "VALIDATE_DISABLED"
+
+    @classmethod
+    def parse(cls, s: str) -> "DataValidationType":
+        return cls(s.strip().upper())
+
+
+class DataValidationError(ValueError):
+    pass
+
+
+def _sample(batch: Batch, fraction: float = 0.1) -> Batch:
+    """Deterministic head-sample (the reference samples a fraction for
+    VALIDATE_SAMPLE; determinism matters more than randomness here)."""
+    n = max(8, int(batch.weights.shape[0] * fraction))
+    import jax
+
+    return jax.tree.map(lambda a: a[:n], batch)
+
+
+def validation_failures(batch: Batch, task: TaskType) -> Dict[str, int]:
+    """-> {check name: violation count}, empty when clean."""
+    real = batch.weights > 0
+    failures: Dict[str, int] = {}
+
+    if isinstance(batch, SparseBatch):
+        row_bad_features = jnp.any(~jnp.isfinite(batch.values), axis=-1)
+    else:
+        row_bad_features = jnp.any(~jnp.isfinite(batch.features), axis=-1)
+    checks = {
+        "features_finite": row_bad_features,
+        "offsets_finite": ~jnp.isfinite(batch.offsets),
+        "labels_finite": ~jnp.isfinite(batch.labels),
+        "weights_finite": ~jnp.isfinite(batch.weights),
+    }
+    if task in (TaskType.LOGISTIC_REGRESSION, TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM):
+        checks["labels_binary"] = ~(
+            (batch.labels == 0.0) | (batch.labels == 1.0)
+        )
+    if task == TaskType.POISSON_REGRESSION:
+        checks["labels_non_negative"] = batch.labels < 0
+    for name, bad in checks.items():
+        count = int(jnp.sum(bad & real))
+        if count:
+            failures[name] = count
+    return failures
+
+
+def sanity_check_data(
+    batch: Batch,
+    task: TaskType,
+    level: DataValidationType = DataValidationType.VALIDATE_FULL,
+) -> None:
+    """Raise DataValidationError listing violated checks
+    (DataValidators.sanityCheckData)."""
+    if level == DataValidationType.VALIDATE_DISABLED:
+        return
+    if level == DataValidationType.VALIDATE_SAMPLE:
+        batch = _sample(batch)
+    failures = validation_failures(batch, task)
+    if failures:
+        desc = ", ".join(f"{k}: {v} rows" for k, v in sorted(failures.items()))
+        raise DataValidationError(f"input data failed validation ({desc})")

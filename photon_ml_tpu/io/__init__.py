@@ -1,0 +1,25 @@
+"""I/O: Avro codec + Photon schemas, LibSVM, input formats, model I/O."""
+
+from photon_ml_tpu.io.avro_codec import (
+    read_avro_records,
+    read_container,
+    write_container,
+)
+from photon_ml_tpu.io.input_format import (
+    AvroInputDataFormat,
+    LibSVMInputDataFormat,
+    LoadedData,
+    create_input_format,
+    parse_constraint_string,
+)
+
+__all__ = [
+    "read_avro_records",
+    "read_container",
+    "write_container",
+    "AvroInputDataFormat",
+    "LibSVMInputDataFormat",
+    "LoadedData",
+    "create_input_format",
+    "parse_constraint_string",
+]

@@ -1,0 +1,248 @@
+"""Input data formats: Avro / LibSVM -> IndexMap + padded SparseBatch.
+
+Reference: photon-ml .../io/GLMSuite.scala (Avro -> LabeledPoint with
+name+TAB+term keys, intercept injection, selected-features filter, JSON
+box-constraint parsing at :190-245, index map build/load at :98-187),
+InputDataFormat.scala:26-51, AvroInputDataFormat.scala,
+LibSVMInputDataFormat.scala:43-75, InputFormatFactory.scala.
+
+The Spark RDD[LabeledPoint] becomes one padded SparseBatch (or a list of
+equally-shaped shards for streaming); everything downstream is static-shape.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.data.batch import SparseBatch, make_sparse_batch
+from photon_ml_tpu.io.avro_codec import read_avro_records
+from photon_ml_tpu.io.libsvm import read_libsvm
+from photon_ml_tpu.optim.common import BoxConstraints
+from photon_ml_tpu.utils.index_map import (
+    IndexMap,
+    feature_key,
+    intercept_key,
+)
+
+import jax.numpy as jnp
+
+
+@dataclass
+class LoadedData:
+    """One loaded dataset: batch + vocabulary + optional constraints."""
+
+    batch: SparseBatch
+    index_map: IndexMap
+    num_features: int
+    intercept_index: Optional[int]
+    constraints: Optional[BoxConstraints] = None
+
+
+def parse_constraint_string(
+    constraint_string: Optional[str],
+    index_map: IndexMap,
+    num_features: int,
+    intercept_index: Optional[int],
+) -> Optional[BoxConstraints]:
+    """JSON array of {name, term, lowerBound, upperBound} -> box arrays.
+
+    Wildcard "*" in name (with any term) applies the bound to every
+    non-intercept feature; overlapping constraints are rejected
+    (GLMSuite.createConstraintFeatureMap:190-245).
+    """
+    if not constraint_string:
+        return None
+    entries = json.loads(constraint_string)
+    lower = np.full((num_features,), -np.inf, np.float32)
+    upper = np.full((num_features,), np.inf, np.float32)
+    seen: Dict[int, Tuple[float, float]] = {}
+    wildcard: Optional[Tuple[float, float]] = None
+    for entry in entries:
+        if "name" not in entry or "term" not in entry:
+            raise ValueError(
+                f"constraint entry must contain name and term: {entry}"
+            )
+        name = entry["name"]
+        term = entry["term"]
+        lo = float(entry.get("lowerBound", -math.inf))
+        hi = float(entry.get("upperBound", math.inf))
+        if lo > hi:
+            raise ValueError(f"lowerBound > upperBound in constraint {entry}")
+        if name == "*":
+            if wildcard is not None or seen:
+                raise ValueError(
+                    "conflicting constraints: wildcard plus other constraints"
+                )
+            wildcard = (lo, hi)
+        else:
+            if wildcard is not None:
+                raise ValueError(
+                    "conflicting constraints: wildcard plus other constraints"
+                )
+            idx = index_map.get_index(feature_key(name, term))
+            if idx < 0:
+                continue  # constraint on a feature absent from the data
+            if idx in seen and seen[idx] != (lo, hi):
+                raise ValueError(
+                    f"conflicting constraints for feature ({name},{term})"
+                )
+            seen[idx] = (lo, hi)
+            lower[idx], upper[idx] = lo, hi
+    if wildcard is not None:
+        lower[:], upper[:] = wildcard
+        if intercept_index is not None:
+            lower[intercept_index], upper[intercept_index] = -np.inf, np.inf
+    elif not seen:
+        return None
+    return BoxConstraints(lower=jnp.asarray(lower), upper=jnp.asarray(upper))
+
+
+def _rows_to_batch(
+    rows: List[Tuple[List[int], List[float]]],
+    labels: List[float],
+    offsets: List[float],
+    weights: List[float],
+    *,
+    pad_rows_to: int = 8,
+    pad_nnz_to: int = 8,
+) -> SparseBatch:
+    return make_sparse_batch(
+        rows,
+        labels,
+        offsets,
+        weights,
+        pad_rows_to=pad_rows_to,
+        pad_nnz_to=pad_nnz_to,
+    )
+
+
+class AvroInputDataFormat:
+    """TrainingExampleAvro reader (GLMSuite Avro path).
+
+    ``selected_features``: optional set of feature keys to keep
+    (GLMSuite.featureKeySet filtering); ``add_intercept`` appends the
+    constant-1 intercept feature to every row (GLMSuite.addIntercept).
+    """
+
+    def __init__(
+        self,
+        *,
+        add_intercept: bool = True,
+        selected_features: Optional[Sequence[str]] = None,
+    ):
+        self.add_intercept = add_intercept
+        self.selected = set(selected_features) if selected_features else None
+
+    def _record_pairs(self, record: dict) -> Iterable[Tuple[str, float]]:
+        for f in record["features"]:
+            key = feature_key(f["name"], f["term"])
+            if self.selected is None or key in self.selected:
+                yield key, float(f["value"])
+
+    def build_index_map(self, paths) -> IndexMap:
+        keys = (
+            key
+            for record in read_avro_records(paths)
+            for key, _ in self._record_pairs(record)
+        )
+        return IndexMap.build(keys, add_intercept=self.add_intercept)
+
+    def load(
+        self,
+        paths,
+        index_map: Optional[IndexMap] = None,
+        constraint_string: Optional[str] = None,
+    ) -> LoadedData:
+        if index_map is None:
+            index_map = self.build_index_map(paths)
+        dim = index_map.size
+        icept = index_map.get_index(intercept_key()) if self.add_intercept else -1
+        intercept_index = icept if icept >= 0 else None
+
+        rows, labels, offsets, weights = [], [], [], []
+        for record in read_avro_records(paths):
+            ix: List[int] = []
+            vs: List[float] = []
+            for key, value in self._record_pairs(record):
+                i = index_map.get_index(key)
+                if i >= 0:
+                    ix.append(i)
+                    vs.append(value)
+            if intercept_index is not None:
+                ix.append(intercept_index)
+                vs.append(1.0)
+            rows.append((ix, vs))
+            labels.append(float(record["label"]))
+            offsets.append(float(record.get("offset") or 0.0))
+            weights.append(float(record.get("weight") or 1.0))
+
+        batch = _rows_to_batch(rows, labels, offsets, weights)
+        constraints = parse_constraint_string(
+            constraint_string, index_map, dim, intercept_index
+        )
+        return LoadedData(batch, index_map, dim, intercept_index, constraints)
+
+
+class LibSVMInputDataFormat:
+    """LibSVM text reader (LibSVMInputDataFormat.scala analog)."""
+
+    def __init__(self, *, add_intercept: bool = True, zero_based: bool = False):
+        self.add_intercept = add_intercept
+        self.zero_based = zero_based
+
+    def build_index_map(self, paths) -> IndexMap:
+        keys = (
+            feature_key(str(idx))
+            for _, pairs in read_libsvm(paths, zero_based=self.zero_based)
+            for idx, _ in pairs
+        )
+        return IndexMap.build(keys, add_intercept=self.add_intercept)
+
+    def load(
+        self,
+        paths,
+        index_map: Optional[IndexMap] = None,
+        constraint_string: Optional[str] = None,
+    ) -> LoadedData:
+        if index_map is None:
+            index_map = self.build_index_map(paths)
+        dim = index_map.size
+        icept = index_map.get_index(intercept_key()) if self.add_intercept else -1
+        intercept_index = icept if icept >= 0 else None
+
+        rows, labels, offsets, weights = [], [], [], []
+        for label, pairs in read_libsvm(paths, zero_based=self.zero_based):
+            ix, vs = [], []
+            for idx, value in pairs:
+                i = index_map.get_index(feature_key(str(idx)))
+                if i >= 0:
+                    ix.append(i)
+                    vs.append(value)
+            if intercept_index is not None:
+                ix.append(intercept_index)
+                vs.append(1.0)
+            rows.append((ix, vs))
+            labels.append(label)
+            offsets.append(0.0)
+            weights.append(1.0)
+
+        batch = _rows_to_batch(rows, labels, offsets, weights)
+        constraints = parse_constraint_string(
+            constraint_string, index_map, dim, intercept_index
+        )
+        return LoadedData(batch, index_map, dim, intercept_index, constraints)
+
+
+def create_input_format(kind: str, **kwargs):
+    """InputFormatFactory analog: kind in {AVRO, LIBSVM}."""
+    k = kind.strip().upper()
+    if k == "AVRO":
+        return AvroInputDataFormat(**kwargs)
+    if k == "LIBSVM":
+        return LibSVMInputDataFormat(**kwargs)
+    raise ValueError(f"unknown input format: {kind}")

@@ -1,0 +1,127 @@
+"""Feature index maps: bidirectional name<->index lookup.
+
+Reference: photon-ml .../util/IndexMap.scala:23-44 (getIndex /
+getFeatureName), DefaultIndexMap(|Loader).scala (in-heap
+collect-distinct-zipWithIndex build, GLMSuite.scala:160-187) and the
+off-heap PalDBIndexMap.scala (partitioned stores + offsets) whose
+TPU-native replacement is the mmap store in
+photon_ml_tpu.utils.native_index (C++, built by FeatureIndexingJob analog).
+
+Feature keys are ``name + "\\t" + term`` (Utils.getFeatureKey semantics);
+the intercept uses ``("(INTERCEPT)", "")``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional
+
+DELIMITER = "\t"
+INTERCEPT_NAME = "(INTERCEPT)"
+INTERCEPT_TERM = ""
+
+
+def feature_key(name: str, term: str = "") -> str:
+    """name TAB term (Utils.getFeatureKey)."""
+    return f"{name}{DELIMITER}{term}"
+
+
+def intercept_key() -> str:
+    return feature_key(INTERCEPT_NAME, INTERCEPT_TERM)
+
+
+def split_feature_key(key: str):
+    name, _, term = key.partition(DELIMITER)
+    return name, term
+
+
+class IndexMap:
+    """Bidirectional map feature-key <-> dense index."""
+
+    def __init__(self, name_to_index: Dict[str, int]):
+        self._fwd = name_to_index
+        self._rev: Optional[List[Optional[str]]] = None
+
+    def __len__(self) -> int:
+        return len(self._fwd)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._fwd
+
+    @property
+    def size(self) -> int:
+        return len(self._fwd)
+
+    def get_index(self, key: str, default: int = -1) -> int:
+        return self._fwd.get(key, default)
+
+    def get_feature_name(self, index: int) -> Optional[str]:
+        if self._rev is None:
+            rev: List[Optional[str]] = [None] * (max(self._fwd.values(), default=-1) + 1)
+            for k, i in self._fwd.items():
+                rev[i] = k
+            self._rev = rev
+        if 0 <= index < len(self._rev):
+            return self._rev[index]
+        return None
+
+    def items(self):
+        return self._fwd.items()
+
+    @staticmethod
+    def build(
+        keys: Iterable[str],
+        *,
+        add_intercept: bool = False,
+    ) -> "IndexMap":
+        """Deterministic build: sorted distinct keys -> [0, n)
+        (the collect-distinct-zipWithIndex of GLMSuite.scala:160-187, made
+        order-independent by sorting). The intercept, when requested, gets
+        the LAST index so feature blocks stay contiguous."""
+        distinct = sorted(set(keys) - {intercept_key()})
+        fwd = {k: i for i, k in enumerate(distinct)}
+        if add_intercept:
+            fwd[intercept_key()] = len(distinct)
+        return IndexMap(fwd)
+
+    # -- persistence (a light text store; the native mmap store in
+    #    utils/native_index.py handles the >200k-vocabulary PalDB case) ----
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self._fwd, f)
+
+    @staticmethod
+    def load(path: str) -> "IndexMap":
+        with open(path, "r", encoding="utf-8") as f:
+            return IndexMap(json.load(f))
+
+
+class IdentityIndexMap:
+    """Index map for pre-indexed data (IdentityIndexMapLoader analog):
+    keys ARE stringified indices."""
+
+    def __init__(self, size: int):
+        self._size = size
+
+    def __len__(self) -> int:
+        return self._size
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def get_index(self, key: str, default: int = -1) -> int:
+        name, _term = split_feature_key(key) if DELIMITER in key else (key, "")
+        try:
+            i = int(name)
+        except ValueError:
+            return default
+        return i if 0 <= i < self._size else default
+
+    def get_feature_name(self, index: int) -> Optional[str]:
+        if 0 <= index < self._size:
+            return feature_key(str(index))
+        return None

@@ -1,0 +1,300 @@
+"""I/O tests: Avro codec round-trips (null+deflate), TrainingExampleAvro
+parity fields, LibSVM parsing, index maps, constraints, feature stats,
+validators.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.stats import compute_summary
+from photon_ml_tpu.data.validators import (
+    DataValidationError,
+    DataValidationType,
+    sanity_check_data,
+)
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro_codec import (
+    read_avro_records,
+    read_container,
+    write_container,
+)
+from photon_ml_tpu.io.input_format import (
+    AvroInputDataFormat,
+    LibSVMInputDataFormat,
+    parse_constraint_string,
+)
+from photon_ml_tpu.io.libsvm import parse_libsvm_line
+from photon_ml_tpu.data.batch import make_dense_batch, make_sparse_batch
+from photon_ml_tpu.task import TaskType
+from photon_ml_tpu.utils.index_map import (
+    IdentityIndexMap,
+    IndexMap,
+    feature_key,
+    intercept_key,
+)
+
+
+def example_records(n=10):
+    recs = []
+    for i in range(n):
+        recs.append(
+            {
+                "uid": f"uid{i}",
+                "label": float(i % 2),
+                "features": [
+                    {"name": f"f{j}", "term": "t", "value": float(j) + 0.5}
+                    for j in range(1 + i % 3)
+                ],
+                "metadataMap": {"q": str(i // 2)},
+                "weight": 1.0 + 0.1 * i,
+                "offset": 0.01 * i,
+            }
+        )
+    return recs
+
+
+class TestAvroCodec:
+    @pytest.mark.parametrize("codec", ["null", "deflate"])
+    def test_roundtrip_training_examples(self, tmp_path, codec):
+        path = str(tmp_path / "data.avro")
+        recs = example_records()
+        n = write_container(path, schemas.TRAINING_EXAMPLE_AVRO, recs, codec=codec)
+        assert n == len(recs)
+        _, it = read_container(path)
+        got = list(it)
+        assert got == recs
+
+    def test_roundtrip_all_schemas(self, tmp_path):
+        cases = [
+            (schemas.BAYESIAN_LINEAR_MODEL_AVRO, {
+                "modelId": "global",
+                "modelClass": "com.linkedin.photon.ml.supervised.classification.LogisticRegressionModel",
+                "means": [{"name": "a", "term": "", "value": 1.5}],
+                "variances": [{"name": "a", "term": "", "value": 0.1}],
+                "lossFunction": None,
+            }),
+            (schemas.LATENT_FACTOR_AVRO, {
+                "effectId": "user1", "latentFactor": [0.1, -0.2, 0.3],
+            }),
+            (schemas.SCORING_RESULT_AVRO, {
+                "uid": None, "label": 1.0, "modelId": "m",
+                "predictionScore": 0.75, "weight": None, "metadataMap": None,
+            }),
+            (schemas.FEATURE_SUMMARIZATION_RESULT_AVRO, {
+                "featureName": "f", "featureTerm": "t",
+                "metrics": {"mean": 0.5, "max": 2.0},
+            }),
+        ]
+        for i, (schema, rec) in enumerate(cases):
+            path = str(tmp_path / f"s{i}.avro")
+            write_container(path, schema, [rec])
+            _, it = read_container(path)
+            assert list(it) == [rec]
+
+    def test_multi_block_and_dir_read(self, tmp_path):
+        d = tmp_path / "data"
+        d.mkdir()
+        recs = example_records(100)
+        write_container(
+            str(d / "part-0.avro"), schemas.TRAINING_EXAMPLE_AVRO, recs[:50],
+            sync_interval=256,
+        )
+        write_container(
+            str(d / "part-1.avro"), schemas.TRAINING_EXAMPLE_AVRO, recs[50:],
+            sync_interval=256,
+        )
+        got = list(read_avro_records(str(d)))
+        assert got == recs
+
+    def test_negative_numbers_zigzag(self, tmp_path):
+        schema = {
+            "name": "T", "type": "record",
+            "fields": [{"name": "x", "type": "long"}],
+        }
+        recs = [{"x": v} for v in [0, -1, 1, -2**40, 2**40, 63, -64]]
+        path = str(tmp_path / "z.avro")
+        write_container(path, schema, recs)
+        _, it = read_container(path)
+        assert list(it) == recs
+
+
+class TestLibSVM:
+    def test_parse(self):
+        lab, pairs = parse_libsvm_line("-1 3:0.5 10:1.25 # comment")
+        assert lab == 0.0
+        assert pairs == [(2, 0.5), (9, 1.25)]
+        assert parse_libsvm_line("# only comment") is None
+
+    def test_load_builds_batch(self, tmp_path):
+        p = tmp_path / "a1a.txt"
+        p.write_text("+1 1:1 3:2\n-1 2:1\n+1 1:0.5 2:0.5 3:0.5\n")
+        fmt = LibSVMInputDataFormat(add_intercept=True)
+        data = fmt.load(str(p))
+        assert data.num_features == 4  # 3 features + intercept
+        assert data.intercept_index is not None
+        lab = np.asarray(data.batch.labels)
+        w = np.asarray(data.batch.weights)
+        assert lab[np.where(w > 0)].tolist() == [1.0, 0.0, 1.0]
+
+
+class TestAvroInput:
+    def test_load(self, tmp_path):
+        path = str(tmp_path / "train.avro")
+        write_container(path, schemas.TRAINING_EXAMPLE_AVRO, example_records())
+        fmt = AvroInputDataFormat(add_intercept=True)
+        data = fmt.load(path)
+        assert intercept_key() in data.index_map
+        # f0..f2 with term t plus intercept
+        assert data.num_features == 4
+        w = np.asarray(data.batch.weights)
+        real = w > 0
+        assert real.sum() == 10
+        np.testing.assert_allclose(
+            np.asarray(data.batch.offsets)[real][:3], [0.0, 0.01, 0.02], atol=1e-6
+        )
+
+    def test_selected_features(self, tmp_path):
+        path = str(tmp_path / "train.avro")
+        write_container(path, schemas.TRAINING_EXAMPLE_AVRO, example_records())
+        fmt = AvroInputDataFormat(
+            add_intercept=False, selected_features=[feature_key("f0", "t")]
+        )
+        data = fmt.load(path)
+        assert data.num_features == 1
+
+
+class TestIndexMap:
+    def test_build_deterministic(self):
+        m1 = IndexMap.build(["b\t", "a\t", "b\t"], add_intercept=True)
+        m2 = IndexMap.build(["a\t", "b\t"], add_intercept=True)
+        assert dict(m1.items()) == dict(m2.items())
+        assert m1.get_index("a\t") == 0
+        assert m1.get_index(intercept_key()) == 2
+
+    def test_reverse_lookup(self):
+        m = IndexMap.build(["x\t1", "y\t2"])
+        for k, i in m.items():
+            assert m.get_feature_name(i) == k
+        assert m.get_feature_name(99) is None
+        assert m.get_index("missing\t") == -1
+
+    def test_save_load(self, tmp_path):
+        m = IndexMap.build(["x\t", "y\t"], add_intercept=True)
+        p = str(tmp_path / "index" / "map.json")
+        m.save(p)
+        m2 = IndexMap.load(p)
+        assert dict(m2.items()) == dict(m.items())
+
+    def test_identity(self):
+        m = IdentityIndexMap(5)
+        assert m.get_index("3\t") == 3
+        assert m.get_index(feature_key("7")) == -1
+        assert m.get_feature_name(2) == feature_key("2")
+
+
+class TestConstraints:
+    def _imap(self):
+        return IndexMap.build(
+            [feature_key("a", ""), feature_key("b", "")], add_intercept=True
+        )
+
+    def test_explicit(self):
+        im = self._imap()
+        box = parse_constraint_string(
+            '[{"name": "a", "term": "", "lowerBound": -1, "upperBound": 1}]',
+            im, 3, im.get_index(intercept_key()),
+        )
+        lo = np.asarray(box.lower)
+        ia = im.get_index(feature_key("a", ""))
+        assert lo[ia] == -1.0
+        assert np.isinf(lo[im.get_index(feature_key("b", ""))])
+
+    def test_wildcard_excludes_intercept(self):
+        im = self._imap()
+        box = parse_constraint_string(
+            '[{"name": "*", "term": "*", "lowerBound": 0, "upperBound": 2}]',
+            im, 3, im.get_index(intercept_key()),
+        )
+        icept = im.get_index(intercept_key())
+        assert np.isinf(np.asarray(box.upper)[icept])
+        others = [i for i in range(3) if i != icept]
+        assert np.all(np.asarray(box.upper)[others] == 2.0)
+
+    def test_conflicts_rejected(self):
+        im = self._imap()
+        with pytest.raises(ValueError):
+            parse_constraint_string(
+                '[{"name": "*", "term": "*", "lowerBound": 0, "upperBound": 2},'
+                ' {"name": "a", "term": "", "lowerBound": 0, "upperBound": 1}]',
+                im, 3, None,
+            )
+        with pytest.raises(ValueError):
+            parse_constraint_string(
+                '[{"name": "a", "term": "", "lowerBound": 5, "upperBound": 1}]',
+                im, 3, None,
+            )
+
+
+class TestStats:
+    def test_dense_matches_numpy(self, rng):
+        x = rng.normal(size=(50, 4)).astype(np.float32)
+        batch = make_dense_batch(x, np.zeros(50))
+        s = compute_summary(batch, 4)
+        np.testing.assert_allclose(np.asarray(s.mean), x.mean(0), atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(s.variance), x.var(0, ddof=1), rtol=1e-4
+        )
+        assert float(s.count) == 50
+        np.testing.assert_allclose(np.asarray(s.max), x.max(0), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(s.min), x.min(0), atol=1e-6)
+
+    def test_sparse_implicit_zeros(self):
+        # 3 rows, dim 3: feature 0 appears twice (values 2, -1), feature 1
+        # once (value 3), feature 2 never.
+        batch = make_sparse_batch(
+            [([0], [2.0]), ([0, 1], [-1.0, 3.0]), ([1], [0.0])],
+            [0.0, 0.0, 0.0],
+        )
+        # NOTE row 3's explicit 0.0 for feature 1 counts as a slot but has
+        # value 0 → not a nonzero.
+        s = compute_summary(batch, 3)
+        np.testing.assert_allclose(np.asarray(s.mean), [1 / 3, 1.0, 0.0], atol=1e-6)
+        assert np.asarray(s.num_nonzeros).tolist() == [2.0, 1.0, 0.0]
+        np.testing.assert_allclose(np.asarray(s.max), [2.0, 3.0, 0.0])
+        np.testing.assert_allclose(np.asarray(s.min), [-1.0, 0.0, 0.0])
+
+
+class TestValidators:
+    def test_clean_passes(self, rng):
+        x = rng.normal(size=(16, 3)).astype(np.float32)
+        y = (rng.uniform(size=16) > 0.5).astype(np.float32)
+        sanity_check_data(make_dense_batch(x, y), TaskType.LOGISTIC_REGRESSION)
+
+    def test_nonbinary_labels_fail_classification(self, rng):
+        x = rng.normal(size=(8, 3)).astype(np.float32)
+        y = np.array([0, 1, 2, 0, 1, 0, 1, 0], np.float32)
+        with pytest.raises(DataValidationError, match="labels_binary"):
+            sanity_check_data(make_dense_batch(x, y), TaskType.LOGISTIC_REGRESSION)
+
+    def test_negative_labels_fail_poisson(self, rng):
+        x = rng.normal(size=(8, 3)).astype(np.float32)
+        y = np.array([1, -1, 2, 0, 1, 0, 1, 0], np.float32)
+        with pytest.raises(DataValidationError, match="labels_non_negative"):
+            sanity_check_data(make_dense_batch(x, y), TaskType.POISSON_REGRESSION)
+
+    def test_nan_features_fail(self):
+        x = np.array([[1.0, np.nan], [0.0, 1.0]], np.float32)
+        with pytest.raises(DataValidationError, match="features_finite"):
+            sanity_check_data(
+                make_dense_batch(x, [0.0, 1.0]), TaskType.LINEAR_REGRESSION
+            )
+
+    def test_disabled_skips(self):
+        x = np.array([[np.nan]], np.float32)
+        sanity_check_data(
+            make_dense_batch(x, [0.0]),
+            TaskType.LINEAR_REGRESSION,
+            DataValidationType.VALIDATE_DISABLED,
+        )
