@@ -1,0 +1,84 @@
+"""Benchmark: fused GLM objective throughput (examples/sec/chip).
+
+Runs the L-BFGS hot kernel — fused margins -> loss derivatives -> gradient
+(photon_ml_tpu.ops.objective) — at an ads-scale shape and prints ONE JSON
+line.
+
+Measurement protocol (see PERF_NOTES.md): the axon tunnel makes
+block_until_ready unreliable and host round-trips cost ~300ms, so the
+kernel is timed with an in-jit fori_loop with a loop-carried dependency,
+differencing two loop lengths to cancel the dispatch constant.
+
+The reference publishes no numbers (SURVEY §6, BASELINE.md); `vs_baseline`
+is 1.0 until cross-runs of the reference exist.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from photon_ml_tpu.data.batch import SparseBatch
+    from photon_ml_tpu.ops.losses import LOGISTIC
+    from photon_ml_tpu.ops.objective import GLMObjective
+
+    rng = np.random.default_rng(0)
+    n, k, d = 1 << 18, 64, 1 << 20  # 262k examples x 64 nnz, 1M features
+    batch = SparseBatch(
+        indices=jnp.asarray(rng.integers(0, d, size=(n, k), dtype=np.int32)),
+        values=jnp.asarray(rng.normal(size=(n, k)).astype(np.float32)),
+        labels=jnp.asarray((rng.uniform(size=n) > 0.5).astype(np.float32)),
+        offsets=jnp.zeros((n,), jnp.float32),
+        weights=jnp.ones((n,), jnp.float32),
+    )
+    obj = GLMObjective(LOGISTIC, d)
+
+    @jax.jit
+    def loop(m, w0):
+        def body(i, carry):
+            w, acc = carry
+            v, g = obj.value_and_gradient(w, batch, 0.1)
+            return (w - 1e-9 * g, acc + v)
+
+        return lax.fori_loop(0, m, body, (w0, jnp.float32(0.0)))
+
+    w0 = jnp.zeros((d,), jnp.float32)
+
+    def timed(m):
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out = loop(m, w0)
+            _ = float(out[1])
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    _ = timed(1)  # compile + warm
+    iters = 21
+    dt = (timed(iters) - timed(1)) / (iters - 1)
+    examples_per_sec = n / dt
+
+    result = {
+        "metric": "fused_value_and_gradient_examples_per_sec_per_chip",
+        "value": round(examples_per_sec),
+        "unit": "examples/sec/chip",
+        "vs_baseline": 1.0,
+        "detail": {
+            "n": n,
+            "nnz_per_row": k,
+            "dim": d,
+            "ms_per_eval": round(dt * 1e3, 3),
+            "device": str(jax.devices()[0]),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

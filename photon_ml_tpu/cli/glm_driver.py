@@ -1,0 +1,466 @@
+"""GLM training driver: the end-to-end single-model pipeline + CLI.
+
+Reference: photon-ml Driver.scala — staged pipeline
+INIT -> PREPROCESSED -> TRAINED -> VALIDATED -> DIAGNOSED
+(DriverStage.scala:47-51; stage methods at Driver.scala:267-292 preprocess,
+294-327 train, 329-413 validate, 525-552 diagnose, 618-638 report, main at
+590-616), PhotonMLCmdLineParser.scala + OptionNames.scala (CLI option
+names kept verbatim), Params.scala:200-222 (cross-field validation).
+
+The Spark context is replaced by a jax device context; everything between
+load and model write-out runs on device.
+"""
+
+from __future__ import annotations
+
+import argparse
+import enum
+import json
+import os
+import shutil
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from photon_ml_tpu.data.stats import compute_summary
+from photon_ml_tpu.data.validators import DataValidationType, sanity_check_data
+from photon_ml_tpu.evaluation import (
+    Evaluator,
+    EvaluatorType,
+    area_under_roc_curve,
+    mean_pointwise_loss,
+    root_mean_squared_error,
+)
+from photon_ml_tpu.events import (
+    EventEmitter,
+    PhotonOptimizationLogEvent,
+    TrainingFinishEvent,
+    TrainingStartEvent,
+)
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro_codec import write_container
+from photon_ml_tpu.io.input_format import create_input_format
+from photon_ml_tpu.io.model_io import save_glm_models_avro, write_models_in_text
+from photon_ml_tpu.models.glm import compute_margins, compute_means
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.ops.normalization import (
+    NormalizationContext,
+    NormalizationType,
+    build_normalization,
+)
+from photon_ml_tpu.optim import CONVERGENCE_REASON_NAMES, OptimizerType, RegularizationType
+from photon_ml_tpu.task import TaskType
+from photon_ml_tpu.training import train_generalized_linear_model
+from photon_ml_tpu.utils.index_map import split_feature_key
+from photon_ml_tpu.utils.logging_util import PhotonLogger, Timer
+
+
+class DriverStage(enum.IntEnum):
+    INIT = 0
+    PREPROCESSED = 1
+    TRAINED = 2
+    VALIDATED = 3
+    DIAGNOSED = 4
+
+
+class DiagnosticMode(enum.Enum):
+    NONE = "NONE"
+    TRAIN = "TRAIN"
+    VALIDATE = "VALIDATE"
+    ALL = "ALL"
+
+    @classmethod
+    def parse(cls, s: str) -> "DiagnosticMode":
+        return cls(s.strip().upper())
+
+
+@dataclass
+class GLMParams:
+    """Mirror of the reference's Params bean (Params.scala)."""
+
+    train_dir: str = ""
+    output_dir: str = ""
+    validate_dir: Optional[str] = None
+    task: TaskType = TaskType.LOGISTIC_REGRESSION
+    input_format: str = "AVRO"  # AVRO | LIBSVM
+    add_intercept: bool = True
+    regularization_weights: List[float] = field(default_factory=lambda: [0.0])
+    regularization_type: RegularizationType = RegularizationType.L2
+    elastic_net_alpha: Optional[float] = None
+    optimizer_type: OptimizerType = OptimizerType.LBFGS
+    max_num_iterations: Optional[int] = None
+    tolerance: Optional[float] = None
+    normalization_type: NormalizationType = NormalizationType.NONE
+    data_validation_type: DataValidationType = DataValidationType.VALIDATE_FULL
+    constraint_string: Optional[str] = None
+    selected_features_file: Optional[str] = None
+    summarization_output_dir: Optional[str] = None
+    diagnostic_mode: DiagnosticMode = DiagnosticMode.NONE
+    compute_variances: bool = False
+    delete_output_dirs_if_exist: bool = False
+    job_name: str = "photon-ml-tpu"
+    event_listeners: List[str] = field(default_factory=list)
+
+    def validate(self) -> None:
+        """Cross-field checks (Params.validate, Params.scala:200-222)."""
+        if not self.train_dir:
+            raise ValueError("training-data-directory is required")
+        if not self.output_dir:
+            raise ValueError("output-directory is required")
+        if self.optimizer_type == OptimizerType.TRON and self.regularization_type in (
+            RegularizationType.L1,
+            RegularizationType.ELASTIC_NET,
+        ):
+            raise ValueError(
+                f"Combination of optimizer {self.optimizer_type.value} and "
+                f"regularization {self.regularization_type.value} is not allowed"
+            )
+        if (
+            self.task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM
+            and self.optimizer_type == OptimizerType.TRON
+        ):
+            raise ValueError("TRON is not supported for the smoothed hinge loss")
+        if self.constraint_string is not None and self.normalization_type != NormalizationType.NONE:
+            raise ValueError(
+                "box constraints with normalization are not supported"
+            )
+        if any(w < 0 for w in self.regularization_weights):
+            raise ValueError("regularization weights must be non-negative")
+
+
+class GLMDriver:
+    """Staged GLM pipeline. After run(): ``stage_history`` lists completed
+    stages, ``models`` maps lambda->model, ``best_model`` /
+    ``validation_metrics`` filled when a validation dir was given."""
+
+    def __init__(
+        self,
+        params: GLMParams,
+        logger: Optional[PhotonLogger] = None,
+        emitter: Optional[EventEmitter] = None,
+    ):
+        params.validate()
+        self.params = params
+        # Output-dir guard must precede logger creation (the logger opens
+        # photon.log inside the output dir) — IOUtils.processOutputDir
+        # analog (Driver.scala:148-151).
+        if os.path.isdir(params.output_dir):
+            if params.delete_output_dirs_if_exist:
+                shutil.rmtree(params.output_dir)
+            elif os.listdir(params.output_dir):
+                raise ValueError(
+                    f"output directory {params.output_dir} exists and is "
+                    "non-empty (pass --delete-output-dirs-if-exist to "
+                    "overwrite)"
+                )
+        os.makedirs(params.output_dir, exist_ok=True)
+        self.logger = logger or PhotonLogger(params.output_dir)
+        self.emitter = emitter or EventEmitter()
+        for name in params.event_listeners:
+            self.emitter.register_by_name(name)
+        self.timer = Timer()
+        self.stage = DriverStage.INIT
+        self.stage_history: List[DriverStage] = []
+        self.models = {}
+        self.results = {}
+        self.best_model = None
+        self.best_lambda: Optional[float] = None
+        self.validation_metrics: Dict[float, Dict[str, float]] = {}
+        self._data = None
+        self._norm: Optional[NormalizationContext] = None
+        self._summary = None
+
+    # -- stages ------------------------------------------------------------
+
+    def _advance(self, stage: DriverStage) -> None:
+        self.stage_history.append(stage)
+        self.stage = stage
+
+    def preprocess(self) -> None:
+        p = self.params
+        with self.timer.time("preprocess"):
+            selected = None
+            if p.selected_features_file:
+                with open(p.selected_features_file) as f:
+                    selected = [line.strip() for line in f if line.strip()]
+            fmt = create_input_format(
+                p.input_format,
+                add_intercept=p.add_intercept,
+                selected_features=selected,
+            )
+            self._fmt = fmt
+            data = fmt.load(p.train_dir, constraint_string=p.constraint_string)
+            self._data = data
+            self.logger.info(
+                "loaded %d examples, %d features",
+                int(np.asarray(data.batch.weights > 0).sum()),
+                data.num_features,
+            )
+            sanity_check_data(data.batch, p.task, p.data_validation_type)
+            self._summary = compute_summary(data.batch, data.num_features)
+            self._norm = build_normalization(
+                p.normalization_type,
+                mean=self._summary.mean,
+                std=self._summary.std,
+                max_magnitude=self._summary.max_magnitude,
+                intercept_index=data.intercept_index,
+            )
+            if p.summarization_output_dir:
+                self._write_summary(p.summarization_output_dir)
+        self._advance(DriverStage.PREPROCESSED)
+
+    def train(self) -> None:
+        p = self.params
+        self.emitter.send(TrainingStartEvent(p.job_name))
+        with self.timer.time("train"):
+            data = self._data
+            self.models, self.results = train_generalized_linear_model(
+                data.batch,
+                p.task,
+                data.num_features,
+                optimizer_type=p.optimizer_type,
+                regularization_type=p.regularization_type,
+                regularization_weights=p.regularization_weights,
+                elastic_net_alpha=p.elastic_net_alpha,
+                max_iter=p.max_num_iterations,
+                tolerance=p.tolerance,
+                normalization=self._norm,
+                compute_variances=p.compute_variances,
+                box=data.constraints,
+                intercept_index=data.intercept_index,
+            )
+            for lam, res in self.results.items():
+                self.emitter.send(
+                    PhotonOptimizationLogEvent(
+                        reg_weight=lam,
+                        iterations=int(res.iterations),
+                        convergence_reason=CONVERGENCE_REASON_NAMES.get(
+                            int(res.reason), "?"
+                        ),
+                        final_value=float(res.value),
+                    )
+                )
+                self.logger.info(
+                    "lambda=%g: %d iters, f=%g, reason=%s",
+                    lam,
+                    int(res.iterations),
+                    float(res.value),
+                    CONVERGENCE_REASON_NAMES.get(int(res.reason), "?"),
+                )
+        self.emitter.send(TrainingFinishEvent(p.job_name))
+        self._advance(DriverStage.TRAINED)
+
+    def _metrics_for(self, model, batch) -> Dict[str, float]:
+        task = self.params.task
+        margins = compute_margins(model.means, batch)
+        loss = loss_for_task(task)
+        metrics = {
+            f"{loss.name}_loss": float(
+                mean_pointwise_loss(loss, margins, batch.labels, batch.weights)
+            )
+        }
+        if task == TaskType.LOGISTIC_REGRESSION or (
+            task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM
+        ):
+            metrics["AUC"] = float(
+                area_under_roc_curve(margins, batch.labels, batch.weights)
+            )
+        if task in (TaskType.LINEAR_REGRESSION, TaskType.POISSON_REGRESSION):
+            means = compute_means(task, model.means, batch)
+            metrics["RMSE"] = float(
+                root_mean_squared_error(means, batch.labels, batch.weights)
+            )
+        return metrics
+
+    def validate(self) -> None:
+        p = self.params
+        with self.timer.time("validate"):
+            vdata = self._fmt.load(p.validate_dir, index_map=self._data.index_map)
+            sanity_check_data(vdata.batch, p.task, p.data_validation_type)
+            self._validation_data = vdata
+            # Select by AUC for classification, RMSE/loss otherwise
+            # (ModelSelection.scala:36-63).
+            maximize = p.task == TaskType.LOGISTIC_REGRESSION
+            best = None
+            for lam, model in self.models.items():
+                metrics = self._metrics_for(model, vdata.batch)
+                self.validation_metrics[lam] = metrics
+                key = (
+                    "AUC"
+                    if maximize
+                    else ("RMSE" if "RMSE" in metrics else next(iter(metrics)))
+                )
+                score = metrics[key]
+                self.logger.info("lambda=%g validation %s", lam, metrics)
+                if (
+                    best is None
+                    or (maximize and score > best[2])
+                    or (not maximize and score < best[2])
+                ):
+                    best = (lam, model, score)
+            self.best_lambda, self.best_model, _ = best
+        self._advance(DriverStage.VALIDATED)
+
+    def diagnose(self) -> None:
+        """Model diagnostics + HTML report (Driver.scala:525-552, 618-638)."""
+        from photon_ml_tpu.diagnostics.report import run_glm_diagnostics
+
+        with self.timer.time("diagnose"):
+            run_glm_diagnostics(self)
+        self._advance(DriverStage.DIAGNOSED)
+
+    # -- outputs -----------------------------------------------------------
+
+    def _write_summary(self, out_dir: str) -> None:
+        os.makedirs(out_dir, exist_ok=True)
+        s = self._summary
+        records = []
+        for key, i in self._data.index_map.items():
+            name, term = split_feature_key(key)
+            records.append(
+                {
+                    "featureName": name,
+                    "featureTerm": term,
+                    "metrics": {
+                        "mean": float(s.mean[i]),
+                        "variance": float(s.variance[i]),
+                        "numNonzeros": float(s.num_nonzeros[i]),
+                        "max": float(s.max[i]),
+                        "min": float(s.min[i]),
+                        "normL1": float(s.norm_l1[i]),
+                        "normL2": float(s.norm_l2[i]),
+                        "meanAbs": float(s.mean_abs[i]),
+                    },
+                }
+            )
+        write_container(
+            os.path.join(out_dir, "part-00000.avro"),
+            schemas.FEATURE_SUMMARIZATION_RESULT_AVRO,
+            records,
+        )
+
+    def _write_outputs(self) -> None:
+        p = self.params
+        out = p.output_dir
+        os.makedirs(out, exist_ok=True)
+        self._data.index_map.save(os.path.join(out, "feature-index", "index.json"))
+        write_models_in_text(
+            self.models, os.path.join(out, "models-text"), self._data.index_map
+        )
+        save_glm_models_avro(
+            self.models, os.path.join(out, "models", "models.avro"),
+            self._data.index_map,
+        )
+        if self.best_model is not None:
+            save_glm_models_avro(
+                {self.best_lambda: self.best_model},
+                os.path.join(out, "best-model", "model.avro"),
+                self._data.index_map,
+            )
+        with open(os.path.join(out, "metrics.json"), "w") as f:
+            json.dump(
+                {
+                    "validation": {
+                        str(k): v for k, v in self.validation_metrics.items()
+                    },
+                    "best_lambda": self.best_lambda,
+                    "timers": self.timer.durations,
+                },
+                f,
+                indent=2,
+            )
+
+    def run(self) -> None:
+        p = self.params
+        self.preprocess()
+        self.train()
+        if p.validate_dir:
+            self.validate()
+        if p.diagnostic_mode != DiagnosticMode.NONE:
+            self.diagnose()
+        self._write_outputs()
+        self.logger.info("stages: %s", [s.name for s in self.stage_history])
+        self.logger.info("timers:\n%s", self.timer.summary())
+        self.emitter.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI (option names from OptionNames.scala)
+# ---------------------------------------------------------------------------
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="photon-ml-tpu glm",
+        description="TPU-native GLM training driver (Photon ML parity)",
+    )
+    ap.add_argument("--training-data-directory", required=True)
+    ap.add_argument("--output-directory", required=True)
+    ap.add_argument("--validating-data-directory", default=None)
+    ap.add_argument("--task", default="LOGISTIC_REGRESSION")
+    ap.add_argument("--format", default="AVRO", help="AVRO | LIBSVM")
+    ap.add_argument("--intercept", default="true")
+    ap.add_argument("--regularization-weights", default="0")
+    ap.add_argument("--regularization-type", default="L2")
+    ap.add_argument("--elastic-net-alpha", type=float, default=None)
+    ap.add_argument("--optimizer", default="LBFGS")
+    ap.add_argument("--num-iterations", type=int, default=None)
+    ap.add_argument("--convergence-tolerance", type=float, default=None)
+    ap.add_argument("--normalization-type", default="NONE")
+    ap.add_argument("--data-validation-type", default="VALIDATE_FULL")
+    ap.add_argument("--coefficient-box-constraints", default=None)
+    ap.add_argument("--selected-features-file", default=None)
+    ap.add_argument("--summarization-output-dir", default=None)
+    ap.add_argument("--diagnostic-mode", default="NONE")
+    ap.add_argument("--compute-variances", default="false")
+    ap.add_argument("--delete-output-dirs-if-exist", default="false")
+    ap.add_argument("--job-name", default="photon-ml-tpu")
+    ap.add_argument("--event-listeners", default=None)
+    return ap
+
+
+def _bool(s) -> bool:
+    return str(s).strip().lower() in ("true", "1", "yes")
+
+
+def params_from_args(argv=None) -> GLMParams:
+    ns = build_arg_parser().parse_args(argv)
+    return GLMParams(
+        train_dir=ns.training_data_directory,
+        output_dir=ns.output_directory,
+        validate_dir=ns.validating_data_directory,
+        task=TaskType.parse(ns.task),
+        input_format=ns.format,
+        add_intercept=_bool(ns.intercept),
+        regularization_weights=[
+            float(x) for x in ns.regularization_weights.split(",") if x
+        ],
+        regularization_type=RegularizationType.parse(ns.regularization_type),
+        elastic_net_alpha=ns.elastic_net_alpha,
+        optimizer_type=OptimizerType.parse(ns.optimizer),
+        max_num_iterations=ns.num_iterations,
+        tolerance=ns.convergence_tolerance,
+        normalization_type=NormalizationType(ns.normalization_type.strip().upper()),
+        data_validation_type=DataValidationType.parse(ns.data_validation_type),
+        constraint_string=ns.coefficient_box_constraints,
+        selected_features_file=ns.selected_features_file,
+        summarization_output_dir=ns.summarization_output_dir,
+        diagnostic_mode=DiagnosticMode.parse(ns.diagnostic_mode),
+        compute_variances=_bool(ns.compute_variances),
+        delete_output_dirs_if_exist=_bool(ns.delete_output_dirs_if_exist),
+        job_name=ns.job_name,
+        event_listeners=(
+            ns.event_listeners.split(",") if ns.event_listeners else []
+        ),
+    )
+
+
+def main(argv=None) -> None:
+    params = params_from_args(argv)
+    driver = GLMDriver(params)
+    driver.run()
+
+
+if __name__ == "__main__":
+    main()

@@ -189,17 +189,29 @@ class AvroInputDataFormat:
 
 
 class LibSVMInputDataFormat:
-    """LibSVM text reader (LibSVMInputDataFormat.scala analog)."""
+    """LibSVM text reader (LibSVMInputDataFormat.scala analog).
 
-    def __init__(self, *, add_intercept: bool = True, zero_based: bool = False):
+    ``selected_features``: optional feature-key filter, matching the Avro
+    format's semantics (keys are ``str(index) + TAB``).
+    """
+
+    def __init__(
+        self,
+        *,
+        add_intercept: bool = True,
+        zero_based: bool = False,
+        selected_features: Optional[Sequence[str]] = None,
+    ):
         self.add_intercept = add_intercept
         self.zero_based = zero_based
+        self.selected = set(selected_features) if selected_features else None
 
     def build_index_map(self, paths) -> IndexMap:
         keys = (
-            feature_key(str(idx))
+            key
             for _, pairs in read_libsvm(paths, zero_based=self.zero_based)
-            for idx, _ in pairs
+            for key in (feature_key(str(idx)) for idx, _ in pairs)
+            if self.selected is None or key in self.selected
         )
         return IndexMap.build(keys, add_intercept=self.add_intercept)
 

@@ -1,0 +1,151 @@
+"""GLM model persistence: text + BayesianLinearModelAvro.
+
+Reference: photon-ml .../util/IOUtils.scala:206-259 (writeModelsInText —
+per-lambda files of ``name TAB term TAB value TAB lambda`` rows sorted by
+coefficient value descending) and avro/AvroUtils / ModelProcessingUtils'
+BayesianLinearModelAvro conversion (means/variances as NameTermValue lists,
+modelClass = the reference's GLM class names for cross-compat).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro_codec import read_container, write_container
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import GeneralizedLinearModel, create_model
+from photon_ml_tpu.task import TaskType
+from photon_ml_tpu.utils.index_map import IndexMap, split_feature_key
+
+# Cross-compat class names (the reference writes/reads these in
+# BayesianLinearModelAvro.modelClass).
+_MODEL_CLASS_BY_TASK = {
+    TaskType.LOGISTIC_REGRESSION:
+        "com.linkedin.photon.ml.supervised.classification.LogisticRegressionModel",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+        "com.linkedin.photon.ml.supervised.classification.SmoothedHingeLossLinearSVMModel",
+    TaskType.LINEAR_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.LinearRegressionModel",
+    TaskType.POISSON_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.PoissonRegressionModel",
+}
+_TASK_BY_MODEL_CLASS = {v: k for k, v in _MODEL_CLASS_BY_TASK.items()}
+
+
+def write_models_in_text(
+    models: Dict[float, GeneralizedLinearModel],
+    model_dir: str,
+    index_map: IndexMap,
+) -> None:
+    """One ``<lambda>.txt`` per model; rows sorted by value descending
+    (IOUtils.writeModelsInText parity)."""
+    os.makedirs(model_dir, exist_ok=True)
+    for lam, model in models.items():
+        means = np.asarray(model.means)
+        order = np.argsort(-means)
+        lines = []
+        for i in order:
+            key = index_map.get_feature_name(int(i))
+            if key is None:
+                continue
+            name, term = split_feature_key(key)
+            lines.append(f"{name}\t{term}\t{means[i]}\t{lam}")
+        with open(os.path.join(model_dir, f"{lam}.txt"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+
+def model_to_bayesian_avro(
+    model: GeneralizedLinearModel,
+    model_id: str,
+    index_map: IndexMap,
+) -> dict:
+    means = np.asarray(model.coefficients.means)
+    variances = (
+        None
+        if model.coefficients.variances is None
+        else np.asarray(model.coefficients.variances)
+    )
+
+    def ntv_list(values: np.ndarray):
+        out = []
+        for i, v in enumerate(values):
+            key = index_map.get_feature_name(int(i))
+            if key is None:
+                continue
+            name, term = split_feature_key(key)
+            out.append({"name": name, "term": term, "value": float(v)})
+        return out
+
+    return {
+        "modelId": model_id,
+        "modelClass": _MODEL_CLASS_BY_TASK[model.task],
+        "means": ntv_list(means),
+        "variances": None if variances is None else ntv_list(variances),
+        "lossFunction": None,
+    }
+
+
+def bayesian_avro_to_model(
+    record: dict,
+    index_map: IndexMap,
+    *,
+    task: Optional[TaskType] = None,
+    dim: Optional[int] = None,
+) -> Tuple[str, GeneralizedLinearModel]:
+    """-> (modelId, model). Unknown feature keys are dropped (reference
+    behavior when loading with a narrower index map)."""
+    import jax.numpy as jnp
+    from photon_ml_tpu.utils.index_map import feature_key
+
+    d = dim if dim is not None else index_map.size
+    means = np.zeros((d,), np.float32)
+    for ntv in record["means"]:
+        i = index_map.get_index(feature_key(ntv["name"], ntv["term"]))
+        if 0 <= i < d:
+            means[i] = ntv["value"]
+    variances = None
+    if record.get("variances"):
+        variances = np.zeros((d,), np.float32)
+        for ntv in record["variances"]:
+            i = index_map.get_index(feature_key(ntv["name"], ntv["term"]))
+            if 0 <= i < d:
+                variances[i] = ntv["value"]
+    if task is None:
+        cls = record.get("modelClass")
+        task = _TASK_BY_MODEL_CLASS.get(cls, TaskType.LINEAR_REGRESSION)
+    coefficients = Coefficients(
+        jnp.asarray(means),
+        None if variances is None else jnp.asarray(variances),
+    )
+    return record["modelId"], create_model(task, coefficients)
+
+
+def save_glm_models_avro(
+    models: Dict[float, GeneralizedLinearModel],
+    path: str,
+    index_map: IndexMap,
+) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    records = [
+        model_to_bayesian_avro(model, str(lam), index_map)
+        for lam, model in models.items()
+    ]
+    write_container(path, schemas.BAYESIAN_LINEAR_MODEL_AVRO, records)
+
+
+def load_glm_models_avro(
+    path: str,
+    index_map: IndexMap,
+    *,
+    task: Optional[TaskType] = None,
+) -> Dict[str, GeneralizedLinearModel]:
+    _, it = read_container(path)
+    out = {}
+    for record in it:
+        model_id, model = bayesian_avro_to_model(record, index_map, task=task)
+        out[model_id] = model
+    return out

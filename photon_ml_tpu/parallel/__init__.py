@@ -1,0 +1,36 @@
+"""Distributed runtime: meshes, sharded objectives, feature-axis sharding.
+
+The XLA-collective replacement for the reference's Spark layer (SURVEY
+sect. 2.4): psum = treeAggregate, replicated sharding = broadcast,
+all_to_all/sorts = shuffle.
+"""
+
+from photon_ml_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_sharding,
+    make_mesh,
+    replicate,
+    replicated,
+    shard_batch,
+)
+from photon_ml_tpu.parallel.distributed import (
+    data_parallel_fit_lbfgs,
+    data_parallel_value_and_grad,
+    feature_sharded_fit,
+    feature_sharded_value_and_grad,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "data_sharding",
+    "make_mesh",
+    "replicate",
+    "replicated",
+    "shard_batch",
+    "data_parallel_fit_lbfgs",
+    "data_parallel_value_and_grad",
+    "feature_sharded_fit",
+    "feature_sharded_value_and_grad",
+]

@@ -1,0 +1,65 @@
+"""Device mesh construction + batch sharding helpers.
+
+This layer replaces the reference's entire "distributed runtime" — Spark
+partitioning/broadcast/treeAggregate over netty (SURVEY §2.4; photon-ml
+RDDLike.scala:26-61, BroadcastLike.scala:26) — with a jax.sharding.Mesh and
+XLA collectives over ICI:
+
+- treeAggregate(depth)        -> lax.psum over the "data" axis
+- sc.broadcast(coefficients)  -> replicated sharding (PartitionSpec())
+- feature-dimension scale-out -> coefficient sharding over the "model" axis
+  (the design addition for >HBM models, SURVEY §2.3 row "absent")
+- entity re-sharding shuffle  -> all_to_all / sorted gathers ("entity" axis)
+
+Axis names: "data" (examples), "model" (features/coefficients); the
+random-effect bank shards entities over "data" as well (entities are the
+expert-parallel analog, SURVEY P2).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Sequence[str] = (DATA_AXIS,),
+    devices=None,
+) -> Mesh:
+    """Build a Mesh over available devices; default 1-D data mesh."""
+    devices = devices if devices is not None else jax.devices()
+    if shape is None:
+        shape = (len(devices),)
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(f"mesh shape {shape} != #devices {len(devices)}")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def data_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Leading-axis (example) sharding."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = DATA_AXIS):
+    """Place a batch pytree with rows sharded over ``axis``; row counts must
+    divide the mesh axis (pad first — make_sparse_batch pads to multiples)."""
+    sharding = data_sharding(mesh, axis)
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
+
+
+def replicate(tree, mesh: Mesh):
+    return jax.tree.map(lambda a: jax.device_put(a, replicated(mesh)), tree)

@@ -1,0 +1,199 @@
+"""End-to-end GLM driver tests (DriverIntegTest analog): full pipeline runs
+on Avro + LibSVM fixtures, asserting stage history, outputs and failure
+modes; interop test against the reference's Java-written heart.avro.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli.glm_driver import (
+    DriverStage,
+    GLMDriver,
+    GLMParams,
+    params_from_args,
+)
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro_codec import read_container, write_container
+from photon_ml_tpu.io.model_io import load_glm_models_avro
+from photon_ml_tpu.optim import OptimizerType, RegularizationType
+from photon_ml_tpu.ops.normalization import NormalizationType
+from photon_ml_tpu.task import TaskType
+
+REF_INPUT = "/root/reference/photon-ml/src/integTest/resources/DriverIntegTest/input"
+
+
+def synth_avro(path, rng, n=200, d=8, seed_offset=0):
+    w = np.linspace(-1, 1, d)
+    recs = []
+    for i in range(n):
+        ix = rng.choice(d, size=4, replace=False)
+        vs = rng.normal(size=4)
+        z = float(np.sum(w[ix] * vs))
+        label = float(1 / (1 + np.exp(-z)) > rng.uniform())
+        recs.append({
+            "uid": f"u{i}",
+            "label": label,
+            "features": [
+                {"name": f"f{j}", "term": "", "value": float(v)}
+                for j, v in zip(ix, vs)
+            ],
+            "metadataMap": None,
+            "weight": None,
+            "offset": None,
+        })
+    write_container(path, schemas.TRAINING_EXAMPLE_AVRO, recs)
+
+
+@pytest.fixture
+def avro_dirs(tmp_path, rng):
+    train = tmp_path / "train"
+    val = tmp_path / "val"
+    train.mkdir(); val.mkdir()
+    synth_avro(str(train / "part-0.avro"), rng, n=300)
+    synth_avro(str(val / "part-0.avro"), rng, n=100)
+    return str(train), str(val)
+
+
+class TestGLMDriverEndToEnd:
+    def test_full_pipeline_avro(self, tmp_path, avro_dirs):
+        train, val = avro_dirs
+        out = str(tmp_path / "out")
+        params = GLMParams(
+            train_dir=train,
+            validate_dir=val,
+            output_dir=out,
+            task=TaskType.LOGISTIC_REGRESSION,
+            regularization_weights=[0.1, 1.0, 10.0],
+            regularization_type=RegularizationType.L2,
+            compute_variances=True,
+            summarization_output_dir=str(tmp_path / "summary"),
+        )
+        driver = GLMDriver(params)
+        driver.run()
+        assert driver.stage_history == [
+            DriverStage.PREPROCESSED, DriverStage.TRAINED, DriverStage.VALIDATED,
+        ]
+        assert set(driver.models) == {0.1, 1.0, 10.0}
+        assert driver.best_model is not None
+        # AUC on validation should beat random for all lambdas
+        for lam, metrics in driver.validation_metrics.items():
+            assert metrics["AUC"] > 0.6, (lam, metrics)
+        # outputs on disk
+        assert os.path.isfile(os.path.join(out, "models", "models.avro"))
+        assert os.path.isfile(os.path.join(out, "best-model", "model.avro"))
+        assert os.path.isfile(os.path.join(out, "metrics.json"))
+        assert len(os.listdir(os.path.join(out, "models-text"))) == 3
+        # model avro roundtrip with variances
+        from photon_ml_tpu.utils.index_map import IndexMap
+        imap = IndexMap.load(os.path.join(out, "feature-index", "index.json"))
+        loaded = load_glm_models_avro(
+            os.path.join(out, "models", "models.avro"), imap
+        )
+        assert set(loaded) == {"0.1", "1.0", "10.0"}
+        m = loaded["0.1"]
+        assert m.task == TaskType.LOGISTIC_REGRESSION
+        np.testing.assert_allclose(
+            np.asarray(m.means), np.asarray(driver.models[0.1].means), atol=1e-6
+        )
+        assert m.coefficients.variances is not None
+        # summarization written
+        schema, it = read_container(
+            str(tmp_path / "summary" / "part-00000.avro")
+        )
+        summary = list(it)
+        assert len(summary) == 9  # 8 features + intercept
+        # metrics.json sane
+        metrics = json.load(open(os.path.join(out, "metrics.json")))
+        assert metrics["best_lambda"] is not None
+
+    def test_output_dir_guard(self, tmp_path, avro_dirs):
+        train, _ = avro_dirs
+        out = tmp_path / "out"
+        out.mkdir()
+        (out / "junk.txt").write_text("x")
+        params = GLMParams(
+            train_dir=train, output_dir=str(out),
+            regularization_weights=[1.0],
+        )
+        with pytest.raises(ValueError, match="exists"):
+            GLMDriver(params).run()
+        params.delete_output_dirs_if_exist = True
+        GLMDriver(params).run()  # now succeeds
+
+    def test_libsvm_pipeline_with_normalization(self, tmp_path, rng):
+        # a1a-style libsvm input
+        train = tmp_path / "a1a.txt"
+        lines = []
+        d = 20
+        w = np.linspace(-2, 2, d)
+        for _ in range(300):
+            ix = np.sort(rng.choice(d, size=5, replace=False))
+            z = float(np.sum(w[ix]))
+            y = 1 if 1 / (1 + np.exp(-z)) > rng.uniform() else -1
+            lines.append(
+                f"{y:+d} " + " ".join(f"{i+1}:1" for i in ix)
+            )
+        train.write_text("\n".join(lines) + "\n")
+        out = str(tmp_path / "out")
+        params = GLMParams(
+            train_dir=str(train), output_dir=out,
+            input_format="LIBSVM",
+            task=TaskType.LOGISTIC_REGRESSION,
+            regularization_weights=[0.5],
+            normalization_type=NormalizationType.STANDARDIZATION,
+        )
+        driver = GLMDriver(params)
+        driver.run()
+        assert 0.5 in driver.models
+
+    def test_cli_arg_parsing(self):
+        params = params_from_args([
+            "--training-data-directory", "/tmp/train",
+            "--output-directory", "/tmp/out",
+            "--task", "poisson_regression",
+            "--format", "LIBSVM",
+            "--regularization-weights", "0.1,1,10",
+            "--regularization-type", "ELASTIC_NET",
+            "--elastic-net-alpha", "0.5",
+            "--optimizer", "LBFGS",
+            "--num-iterations", "50",
+            "--intercept", "false",
+            "--normalization-type", "STANDARDIZATION",
+        ])
+        assert params.task == TaskType.POISSON_REGRESSION
+        assert params.regularization_weights == [0.1, 1.0, 10.0]
+        assert params.elastic_net_alpha == 0.5
+        assert not params.add_intercept
+        params.validate()
+
+    def test_params_validation(self):
+        p = GLMParams(train_dir="t", output_dir="o",
+                      optimizer_type=OptimizerType.TRON,
+                      regularization_type=RegularizationType.L1)
+        with pytest.raises(ValueError, match="not allowed"):
+            p.validate()
+
+
+@pytest.mark.skipif(
+    not os.path.isdir(REF_INPUT), reason="reference fixtures unavailable"
+)
+class TestReferenceFixtureInterop:
+    def test_heart_dataset_trains(self, tmp_path):
+        """Train on the reference's Java-written heart.avro and beat the
+        majority baseline on its validation file."""
+        out = str(tmp_path / "out")
+        params = GLMParams(
+            train_dir=os.path.join(REF_INPUT, "heart.avro"),
+            validate_dir=os.path.join(REF_INPUT, "heart_validation.avro"),
+            output_dir=out,
+            task=TaskType.LOGISTIC_REGRESSION,
+            regularization_weights=[0.1, 1.0],
+            normalization_type=NormalizationType.STANDARDIZATION,
+        )
+        driver = GLMDriver(params)
+        driver.run()
+        best = driver.validation_metrics[driver.best_lambda]
+        assert best["AUC"] > 0.75, best
