@@ -1,0 +1,444 @@
+"""GAME coordinates: fixed effect, random effect, factored RE, MF.
+
+Reference: photon-ml .../algorithm/Coordinate.scala:82 (score /
+initializeModel / updateModel / regTerm over its dataset),
+FixedEffectCoordinate.scala:137-164, RandomEffectCoordinate.scala:104-199,
+RandomEffectCoordinateInProjectedSpace.scala:30-140,
+FactoredRandomEffectCoordinate.scala:99-289 (alternating latent-space RE
+solves and a distributed projection-matrix fit).
+
+The KeyValueScore residual currency is a row-aligned [n] array here; every
+``updateModel(model, partialScore)`` first folds the residual into offsets
+(dataSet.addScoresToOffsets analog) by passing ``offsets + residual``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.data.batch import SparseBatch
+from photon_ml_tpu.game.config import FactoredRandomEffectConfiguration
+from photon_ml_tpu.game.data import GameDataset
+from photon_ml_tpu.game.model import (
+    DatumScoringModel,
+    FixedEffectModel,
+    MatrixFactorizationModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.game.random_effect import (
+    RandomEffectOptimizationProblem,
+    RandomEffectTracker,
+    score_random_effect,
+)
+from photon_ml_tpu.game.random_effect_data import RandomEffectDataset
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import create_model
+from photon_ml_tpu.optim.common import OptResult
+from photon_ml_tpu.optim.problem import GLMOptimizationProblem
+from photon_ml_tpu.task import TaskType
+
+Array = jnp.ndarray
+
+
+class Coordinate:
+    """One block of the coordinate descent (Coordinate.scala)."""
+
+    name: str
+
+    def initialize_model(self) -> DatumScoringModel:
+        raise NotImplementedError
+
+    def update_model(
+        self, model: DatumScoringModel, residual: Optional[Array]
+    ) -> Tuple[DatumScoringModel, object]:
+        raise NotImplementedError
+
+    def score(self, model: DatumScoringModel) -> Array:
+        raise NotImplementedError
+
+    def regularization_term(self, model: DatumScoringModel) -> float:
+        raise NotImplementedError
+
+
+@dataclass
+class FixedEffectCoordinate(Coordinate):
+    """Global GLM block (FixedEffectCoordinate.scala:137-164)."""
+
+    name: str
+    dataset: GameDataset
+    problem: GLMOptimizationProblem
+    feature_shard_id: str
+    reg_weight: float = 0.0
+    down_sampling_rate: float = 1.0
+    sampler_seed: int = 0
+
+    def initialize_model(self) -> FixedEffectModel:
+        dim = self.dataset.shards[self.feature_shard_id].dim
+        return FixedEffectModel(
+            create_model(self.problem.task, Coefficients.zeros(dim)),
+            self.feature_shard_id,
+        )
+
+    def _batch(self, residual: Optional[Array]) -> SparseBatch:
+        offsets = self.dataset.offsets
+        if residual is not None:
+            offsets = offsets + np.asarray(residual)
+        return self.dataset.batch_for_shard(self.feature_shard_id, offsets)
+
+    def update_model(self, model, residual=None):
+        batch = self._batch(residual)
+        initial = model.model.means if model is not None else None
+        if self.down_sampling_rate < 1.0:
+            coefficients, result = self.problem.run_with_sampling(
+                batch,
+                jax.random.PRNGKey(self.sampler_seed),
+                self.down_sampling_rate,
+                initial=initial,
+                reg_weight=self.reg_weight,
+            )
+        else:
+            coefficients, result = self.problem.run(
+                batch, initial=initial, reg_weight=self.reg_weight
+            )
+        return (
+            FixedEffectModel(
+                self.problem.create_model(coefficients), self.feature_shard_id
+            ),
+            result,
+        )
+
+    def score(self, model: FixedEffectModel) -> Array:
+        return model.score(self.dataset)
+
+    def regularization_term(self, model: FixedEffectModel) -> float:
+        l1, l2 = self.problem.regularization.split(self.reg_weight)
+        w = model.model.means
+        term = 0.5 * l2 * float(jnp.vdot(w, w))
+        if l1:
+            term += l1 * float(jnp.sum(jnp.abs(w)))
+        return term
+
+
+@dataclass
+class RandomEffectCoordinate(Coordinate):
+    """Per-entity block (RandomEffectCoordinate[InProjectedSpace])."""
+
+    name: str
+    dataset: GameDataset
+    re_dataset: RandomEffectDataset
+    problem: RandomEffectOptimizationProblem
+
+    def initialize_model(self) -> RandomEffectModel:
+        bank = jnp.zeros(
+            (self.re_dataset.num_entities, self.re_dataset.local_dim),
+            jnp.float32,
+        )
+        return RandomEffectModel(
+            bank,
+            self.re_dataset,
+            self.re_dataset.config.random_effect_type,
+            self.re_dataset.config.feature_shard_id,
+        )
+
+    def update_model(self, model, residual=None):
+        offsets = self.dataset.offsets
+        if residual is not None:
+            offsets = offsets + np.asarray(residual)
+        bank, tracker = self.problem.update_bank(
+            model.bank, self.re_dataset, residual_offsets=offsets
+        )
+        return replace(model, bank=bank), tracker
+
+    def score(self, model: RandomEffectModel) -> Array:
+        return score_random_effect(model.bank, self.re_dataset)
+
+    def regularization_term(self, model: RandomEffectModel) -> float:
+        return self.problem.regularization_term(model.bank)
+
+
+@dataclass
+class FactoredRandomEffectCoordinate(Coordinate):
+    """Random effects in a LEARNED latent projection: alternate
+    (1) per-entity solves in latent space and (2) a distributed fit of the
+    shared projection matrix (FactoredRandomEffectCoordinate.scala:99-289).
+
+    Model state: RandomEffectModel whose re_dataset is a latent-space view,
+    plus the projection matrix B [d, L] kept on this coordinate's model via
+    the MatrixFactorization-style composition below.
+    """
+
+    name: str
+    dataset: GameDataset
+    re_dataset: RandomEffectDataset  # IDENTITY-projected base view
+    problem: RandomEffectOptimizationProblem
+    projection_problem: GLMOptimizationProblem  # over flattened B
+    config: FactoredRandomEffectConfiguration
+    reg_weight_projection: float = 0.0
+    seed: int = 0
+
+    def initialize_model(self) -> "FactoredRandomEffectModel":
+        d = self.re_dataset.local_dim
+        L = self.config.latent_space_dimension
+        rng = np.random.default_rng(self.seed)
+        projection = jnp.asarray(
+            rng.normal(0.0, 1.0 / np.sqrt(L), size=(d, L)).astype(np.float32)
+        )
+        bank = jnp.zeros((self.re_dataset.num_entities, L), jnp.float32)
+        return FactoredRandomEffectModel(
+            bank=bank,
+            projection=projection,
+            re_dataset=self.re_dataset,
+            random_effect_type=self.re_dataset.config.random_effect_type,
+            feature_shard_id=self.re_dataset.config.feature_shard_id,
+        )
+
+    def _latent_rows(self, projection: Array) -> Tuple[Array, Array]:
+        """Project every row into latent space: dense [n, L] values with
+        identity local indices."""
+        ix = jnp.asarray(self.re_dataset.row_local_indices)
+        v = jnp.asarray(self.re_dataset.row_local_values)
+        # x_lat = sum_s v_s * B[ix_s]  -> [n, L]
+        return jnp.einsum("nk,nkl->nl", v, jnp.take(projection, ix, axis=0))
+
+    def update_model(self, model, residual=None):
+        offsets_np = self.dataset.offsets
+        if residual is not None:
+            offsets_np = offsets_np + np.asarray(residual)
+        bank = model.bank
+        projection = model.projection
+        L = self.config.latent_space_dimension
+        tracker = None
+        for _ in range(self.config.num_inner_iterations):
+            # (1) latent-space per-entity solves over re-projected buckets
+            x_lat = np.asarray(self._latent_rows(projection))
+            lat_view = _latent_view(self.re_dataset, x_lat)
+            bank, tracker = self.problem.update_bank(
+                bank, lat_view, residual_offsets=offsets_np
+            )
+            # (2) distributed projection-matrix fit with per-row features
+            # outer(x_i, w_e(i)) flattened to d*L (updateLatentProjection
+            # Matrix analog: a plain GLM over vec(B)).
+            projection = self._update_projection(bank, projection, offsets_np)
+        new_model = replace(model, bank=bank, projection=projection)
+        return new_model, tracker
+
+    def _update_projection(
+        self, bank: Array, projection: Array, offsets_np: np.ndarray
+    ) -> Array:
+        d = self.re_dataset.local_dim
+        L = self.config.latent_space_dimension
+        ix = jnp.asarray(self.re_dataset.row_local_indices)  # [n, k]
+        v = jnp.asarray(self.re_dataset.row_local_values)
+        codes = jnp.maximum(jnp.asarray(self.re_dataset.row_entity_codes), 0)
+        w_rows = jnp.take(bank, codes, axis=0)  # [n, L]
+        n, k = ix.shape
+        # flattened sparse features: index (j*L + l), value v_s * w_l
+        flat_ix = (ix[:, :, None] * L + jnp.arange(L)[None, None, :]).reshape(n, k * L)
+        flat_v = (v[:, :, None] * w_rows[:, None, :]).reshape(n, k * L)
+        valid = jnp.asarray(self.re_dataset.row_entity_codes >= 0)
+        batch = SparseBatch(
+            indices=flat_ix.astype(jnp.int32),
+            values=jnp.where(valid[:, None], flat_v, 0.0),
+            labels=jnp.asarray(self.dataset.labels),
+            offsets=jnp.asarray(offsets_np),
+            weights=jnp.asarray(self.dataset.weights),
+        )
+        coefficients, _ = self.projection_problem.run(
+            batch,
+            initial=projection.reshape(-1),
+            reg_weight=self.reg_weight_projection,
+        )
+        return coefficients.means.reshape(d, L)
+
+    def score(self, model) -> Array:
+        x_lat = self._latent_rows(model.projection)  # [n, L]
+        codes = jnp.maximum(jnp.asarray(self.re_dataset.row_entity_codes), 0)
+        valid = jnp.asarray(self.re_dataset.row_entity_codes >= 0)
+        w_rows = jnp.take(model.bank, codes, axis=0)
+        return jnp.where(valid, jnp.sum(x_lat * w_rows, axis=-1), 0.0)
+
+    def regularization_term(self, model) -> float:
+        return self.problem.regularization_term(model.bank)
+
+
+@dataclass
+class FactoredRandomEffectModel(DatumScoringModel):
+    """Latent bank [E, L] + shared projection [d, L]
+    (FactoredRandomEffectModel.scala:75)."""
+
+    bank: Array
+    projection: Array
+    re_dataset: RandomEffectDataset
+    random_effect_type: str
+    feature_shard_id: str
+
+    def score(self, dataset: GameDataset) -> Array:
+        ix = jnp.asarray(self.re_dataset.row_local_indices)
+        v = jnp.asarray(self.re_dataset.row_local_values)
+        x_lat = jnp.einsum("nk,nkl->nl", v, jnp.take(self.projection, ix, axis=0))
+        codes = jnp.maximum(jnp.asarray(self.re_dataset.row_entity_codes), 0)
+        valid = jnp.asarray(self.re_dataset.row_entity_codes >= 0)
+        w_rows = jnp.take(self.bank, codes, axis=0)
+        return jnp.where(valid, jnp.sum(x_lat * w_rows, axis=-1), 0.0)
+
+
+def _latent_view(
+    base: RandomEffectDataset, x_lat: np.ndarray
+) -> RandomEffectDataset:
+    """Re-project a RandomEffectDataset's rows into latent space: dense
+    identity-local features of width L, same entity grouping/buckets."""
+    from dataclasses import replace as dc_replace
+
+    L = x_lat.shape[1]
+    n = base.row_local_indices.shape[0]
+    row_ix = np.tile(np.arange(L, dtype=np.int32)[None, :], (n, 1))
+    buckets = []
+    for b in base.buckets:
+        safe = np.maximum(b.row_index, 0)
+        bix = np.tile(
+            np.arange(L, dtype=np.int32)[None, None, :],
+            (b.num_entities, b.capacity, 1),
+        )
+        bv = x_lat[safe].astype(np.float32)
+        bv = np.where((b.row_index >= 0)[:, :, None], bv, 0.0)
+        buckets.append(dc_replace(b, indices=bix, values=bv))
+    return dc_replace(
+        base,
+        local_dim=L,
+        projection=np.tile(np.arange(L, dtype=np.int32)[None, :], (base.num_entities, 1)),
+        row_local_indices=row_ix,
+        row_local_values=x_lat.astype(np.float32),
+        buckets=buckets,
+        random_projection=None,
+    )
+
+
+@dataclass
+class MatrixFactorizationCoordinate(Coordinate):
+    """MF block trained by alternating least squares on residuals: row
+    factors solve a K-dim GLM with features = colLatent[col_i] (a
+    random-effect solve in disguise), then columns symmetrically.
+
+    The reference trains factored models via FactoredRandomEffect and
+    scores external MF models (MatrixFactorizationModel.scala); training
+    in-tree here completes the GAME loop for MovieLens-style benchmarks.
+    """
+
+    name: str
+    dataset: GameDataset
+    row_effect_type: str
+    col_effect_type: str
+    num_latent_factors: int
+    problem: RandomEffectOptimizationProblem
+    num_inner_iterations: int = 1
+    seed: int = 0
+
+    def initialize_model(self) -> MatrixFactorizationModel:
+        rng = np.random.default_rng(self.seed)
+        R = self.dataset.entity_indexes[self.row_effect_type].num_entities
+        C = self.dataset.entity_indexes[self.col_effect_type].num_entities
+        K = self.num_latent_factors
+        return MatrixFactorizationModel(
+            self.row_effect_type,
+            self.col_effect_type,
+            jnp.asarray(rng.normal(0, 0.1, size=(R, K)).astype(np.float32)),
+            jnp.asarray(rng.normal(0, 0.1, size=(C, K)).astype(np.float32)),
+        )
+
+    def _als_side(
+        self,
+        solve_codes: np.ndarray,  # [n] entity codes of the side being solved
+        fixed_codes: np.ndarray,
+        fixed_latent: Array,  # [F, K]
+        bank: Array,  # [S, K] current factors of the solved side
+        offsets_np: np.ndarray,
+        num_solved: int,
+    ) -> Array:
+        from photon_ml_tpu.game.config import (
+            ProjectorType,
+            RandomEffectDataConfiguration,
+        )
+        from photon_ml_tpu.game.random_effect_data import (
+            RandomEffectBucket,
+            RandomEffectDataset,
+        )
+
+        K = self.num_latent_factors
+        n = self.dataset.num_rows
+        real = (self.dataset.weights > 0) & (solve_codes >= 0) & (fixed_codes >= 0)
+        x = np.asarray(jnp.take(fixed_latent, jnp.maximum(jnp.asarray(fixed_codes), 0), axis=0))
+        x = np.where(real[:, None], x, 0.0).astype(np.float32)
+        row_ix = np.tile(np.arange(K, dtype=np.int32)[None, :], (n, 1))
+
+        rows_of = [[] for _ in range(num_solved)]
+        for i in np.nonzero(real)[0]:
+            rows_of[int(solve_codes[i])].append(int(i))
+        counts = np.asarray([len(r) for r in rows_of])
+        caps = np.asarray([
+            0 if c == 0 else 1 << int(np.ceil(np.log2(max(c, 1)))) for c in counts
+        ])
+        buckets = []
+        for S in sorted(set(c for c in caps if c > 0)):
+            members = np.nonzero(caps == S)[0]
+            E_b = len(members)
+            b_rows = np.full((E_b, S), -1, np.int32)
+            for bi, e in enumerate(members):
+                for si, i in enumerate(rows_of[e]):
+                    b_rows[bi, si] = i
+            safe = np.maximum(b_rows, 0)
+            ok = b_rows >= 0
+            buckets.append(RandomEffectBucket(
+                entity_codes=members.astype(np.int32),
+                row_index=b_rows,
+                indices=np.tile(np.arange(K, dtype=np.int32)[None, None, :], (E_b, S, 1)),
+                values=np.where(ok[:, :, None], x[safe], 0.0),
+                labels=np.where(ok, self.dataset.labels[safe], 0.0),
+                offsets=np.where(ok, self.dataset.offsets[safe], 0.0),
+                weights=np.where(ok, self.dataset.weights[safe], 0.0),
+            ))
+        view = RandomEffectDataset(
+            config=RandomEffectDataConfiguration(
+                random_effect_type="__mf__",
+                feature_shard_id="__latent__",
+                projector_type=ProjectorType.IDENTITY,
+            ),
+            num_entities=num_solved,
+            local_dim=K,
+            projection=np.tile(np.arange(K, dtype=np.int32)[None, :], (num_solved, 1)),
+            row_local_indices=row_ix,
+            row_local_values=x,
+            row_entity_codes=np.where(real, solve_codes, -1).astype(np.int32),
+            buckets=buckets,
+            num_active_rows=int(counts.sum()),
+            num_passive_rows=0,
+        )
+        new_bank, _ = self.problem.update_bank(bank, view, residual_offsets=offsets_np)
+        return new_bank
+
+    def update_model(self, model, residual=None):
+        offsets_np = self.dataset.offsets
+        if residual is not None:
+            offsets_np = offsets_np + np.asarray(residual)
+        rows = self.dataset.entity_codes[self.row_effect_type]
+        cols = self.dataset.entity_codes[self.col_effect_type]
+        R = self.dataset.entity_indexes[self.row_effect_type].num_entities
+        C = self.dataset.entity_indexes[self.col_effect_type].num_entities
+        row_latent, col_latent = model.row_latent, model.col_latent
+        for _ in range(self.num_inner_iterations):
+            row_latent = self._als_side(rows, cols, col_latent, row_latent, offsets_np, R)
+            col_latent = self._als_side(cols, rows, row_latent, col_latent, offsets_np, C)
+        return replace(model, row_latent=row_latent, col_latent=col_latent), None
+
+    def score(self, model: MatrixFactorizationModel) -> Array:
+        return model.score(self.dataset)
+
+    def regularization_term(self, model: MatrixFactorizationModel) -> float:
+        l1, l2 = self.problem.regularization.split(self.problem.reg_weight)
+        return 0.5 * l2 * float(
+            jnp.sum(model.row_latent**2) + jnp.sum(model.col_latent**2)
+        )

@@ -1,0 +1,114 @@
+"""GAME model classes.
+
+Reference: photon-ml .../model/GAMEModel.scala:93-95 (Map[coordinateName ->
+DatumScoringModel], score = sum of submodel scores), FixedEffectModel.scala
+:29-104 (Broadcast[GLM] + featureShardId), RandomEffectModel.scala:126-168
+(RDD[(entityId, GLM)] scored via join), RandomEffectModelInProjectedSpace
+.scala, MatrixFactorizationModel.scala:141-178 (double-cogroup latent
+scoring), DatumScoringModel.scala.
+
+TPU-native: every model scores a GameDataset into a row-aligned [n] array;
+the RDD-of-models becomes a dense [E, D] coefficient bank; the MF cogroup
+becomes two row gathers + a dot.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.data import GameDataset
+from photon_ml_tpu.game.random_effect import score_random_effect
+from photon_ml_tpu.game.random_effect_data import RandomEffectDataset
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.task import TaskType
+
+Array = jnp.ndarray
+
+
+class DatumScoringModel:
+    """score(dataset) -> row-aligned [n] raw scores (no offsets)."""
+
+    def score(self, dataset: GameDataset) -> Array:  # pragma: no cover
+        raise NotImplementedError
+
+
+@dataclass
+class FixedEffectModel(DatumScoringModel):
+    """Global GLM over one feature shard (FixedEffectModel.scala)."""
+
+    model: GeneralizedLinearModel
+    feature_shard_id: str
+
+    def score(self, dataset: GameDataset) -> Array:
+        batch = dataset.batch_for_shard(self.feature_shard_id)
+        return self.model.score(batch)
+
+
+@dataclass
+class RandomEffectModel(DatumScoringModel):
+    """Per-entity coefficient bank [E, D] over a local projection
+    (RandomEffectModel + RandomEffectModelInProjectedSpace)."""
+
+    bank: Array  # [E, D]
+    re_dataset: RandomEffectDataset
+    random_effect_type: str
+    feature_shard_id: str
+
+    def score(self, dataset: GameDataset) -> Array:
+        # The bank's projection is tied to re_dataset; scoring another
+        # dataset requires a re-projected view built by the data layer.
+        return score_random_effect(self.bank, self.re_dataset)
+
+    def score_rows(self, re_view: RandomEffectDataset) -> Array:
+        return score_random_effect(self.bank, re_view)
+
+
+@dataclass
+class MatrixFactorizationModel(DatumScoringModel):
+    """score_i = rowLatent[rowId_i] . colLatent[colId_i]
+    (MatrixFactorizationModel.scala:141-178)."""
+
+    row_effect_type: str
+    col_effect_type: str
+    row_latent: Array  # [R, K]
+    col_latent: Array  # [C, K]
+
+    @property
+    def num_latent_factors(self) -> int:
+        return self.row_latent.shape[1]
+
+    def score(self, dataset: GameDataset) -> Array:
+        rows = dataset.entity_codes[self.row_effect_type]
+        cols = dataset.entity_codes[self.col_effect_type]
+        valid = jnp.asarray((rows >= 0) & (cols >= 0))
+        r = jnp.take(self.row_latent, jnp.maximum(jnp.asarray(rows), 0), axis=0)
+        c = jnp.take(self.col_latent, jnp.maximum(jnp.asarray(cols), 0), axis=0)
+        return jnp.where(valid, jnp.sum(r * c, axis=-1), 0.0)
+
+
+@dataclass
+class GameModel:
+    """Ordered coordinate name -> submodel; total score = sum
+    (GAMEModel.scala:93-95)."""
+
+    models: Dict[str, DatumScoringModel] = field(default_factory=dict)
+    task: TaskType = TaskType.LOGISTIC_REGRESSION
+
+    def get_model(self, name: str) -> Optional[DatumScoringModel]:
+        return self.models.get(name)
+
+    def update_model(self, name: str, model: DatumScoringModel) -> "GameModel":
+        new = dict(self.models)
+        new[name] = model
+        return GameModel(new, self.task)
+
+    def score(self, dataset: GameDataset) -> Array:
+        total = jnp.zeros((dataset.num_rows,), jnp.float32)
+        for m in self.models.values():
+            total = total + m.score(dataset)
+        return total

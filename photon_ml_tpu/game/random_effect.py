@@ -1,0 +1,240 @@
+"""Random-effect solver: per-entity GLM solves as vmapped while_loop banks.
+
+Reference: photon-ml .../algorithm/RandomEffectCoordinate.scala:104-128 —
+``activeData.join(optimizationProblems).join(modelsRDD).mapValues { local
+optimizer.optimize }`` i.e. millions of independent single-node solves —
+and optimization/game/RandomEffectOptimizationProblem.scala:41-130 (one
+problem per entity, co-partitioned) with tracker aggregation
+(RandomEffectOptimizationTracker.scala).
+
+TPU-native: each bucket of equal-capacity entities is ONE
+``jax.vmap(minimize_lbfgs)`` program over the entity axis — zero
+cross-entity communication, matching the reference's key scalability
+property, but with the per-entity JVM loop replaced by a single fused XLA
+while_loop over [E_b, ...] blocks. Shard the entity axis over the mesh
+("data" axis) for multi-chip (expert-parallel analog).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.game.random_effect_data import (
+    RandomEffectBucket,
+    RandomEffectDataset,
+)
+from photon_ml_tpu.ops.losses import PointwiseLoss
+from photon_ml_tpu.optim.common import (
+    CONVERGENCE_REASON_NAMES,
+    OptResult,
+)
+from photon_ml_tpu.optim.config import (
+    OptimizerConfig,
+    OptimizerType,
+    RegularizationContext,
+)
+from photon_ml_tpu.optim.lbfgs import minimize_lbfgs, minimize_owlqn
+from photon_ml_tpu.optim.tron import minimize_tron
+
+Array = jnp.ndarray
+
+
+@dataclass
+class RandomEffectTracker:
+    """Aggregated per-entity convergence stats
+    (RandomEffectOptimizationTracker analog)."""
+
+    num_entities: int
+    iterations_mean: float
+    iterations_max: int
+    reason_counts: Dict[str, int]
+
+
+def _bucket_solver(
+    loss: PointwiseLoss,
+    config: OptimizerConfig,
+    regularization: RegularizationContext,
+):
+    """Build jit(solve)(bank_slice, bucket arrays, offsets, l1, l2)."""
+
+    def entity_objective(ix, v, lab, off, w):
+        def vg(coef):
+            z = jnp.sum(v * jnp.take(coef, ix, axis=0), axis=-1) + off
+            lv = loss.value(z, lab)
+            ld = loss.d1(z, lab)
+            c = w * ld
+            val = jnp.sum(w * lv)
+            grad = jnp.zeros_like(coef).at[ix.reshape(-1)].add(
+                (v * c[:, None]).reshape(-1)
+            )
+            return val, grad
+
+        def hvp(coef, direction):
+            z = jnp.sum(v * jnp.take(coef, ix, axis=0), axis=-1) + off
+            zd = jnp.sum(v * jnp.take(direction, ix, axis=0), axis=-1)
+            c = w * loss.d2(z, lab) * zd
+            return jnp.zeros_like(coef).at[ix.reshape(-1)].add(
+                (v * c[:, None]).reshape(-1)
+            )
+
+        return vg, hvp
+
+    use_tron = config.optimizer_type == OptimizerType.TRON
+    use_owlqn = regularization.has_l1
+
+    @jax.jit
+    def solve(bank, ix, v, lab, off, w, l1, l2):
+        def one(coef0, ix_e, v_e, lab_e, off_e, w_e):
+            vg_raw, hvp_raw = entity_objective(ix_e, v_e, lab_e, off_e, w_e)
+
+            def vg(c):
+                val, g = vg_raw(c)
+                return val + 0.5 * l2 * jnp.vdot(c, c), g + l2 * c
+
+            if use_tron:
+                def hvp(c, d):
+                    return hvp_raw(c, d) + l2 * d
+
+                return minimize_tron(
+                    vg, hvp, coef0,
+                    max_iter=config.max_iter, tol=config.tolerance,
+                    max_cg=config.tron_max_cg,
+                )
+            if use_owlqn:
+                return minimize_owlqn(
+                    vg, coef0, l1,
+                    max_iter=config.max_iter, tol=config.tolerance,
+                    history=config.lbfgs_history,
+                )
+            return minimize_lbfgs(
+                vg, coef0,
+                max_iter=config.max_iter, tol=config.tolerance,
+                history=config.lbfgs_history,
+            )
+
+        res = jax.vmap(one)(bank, ix, v, lab, off, w)
+        return res.coefficients, res.iterations, res.reason
+
+    return solve
+
+
+@dataclass
+class RandomEffectOptimizationProblem:
+    """One solver config shared by all entities (the reference materializes
+    an RDD of identical per-entity problems; here the per-entity state is
+    just the bank row)."""
+
+    loss: PointwiseLoss
+    config: OptimizerConfig
+    regularization: RegularizationContext
+    reg_weight: float = 0.0
+
+    def __post_init__(self):
+        self._solver = _bucket_solver(self.loss, self.config, self.regularization)
+
+    def update_bank(
+        self,
+        bank: Array,  # [E, D]
+        dataset: RandomEffectDataset,
+        residual_offsets: Optional[np.ndarray] = None,  # [n] replaces offsets
+    ) -> Tuple[Array, RandomEffectTracker]:
+        """Solve every entity against its active data; returns the new bank
+        and an aggregated tracker."""
+        l1, l2 = self.regularization.split(self.reg_weight)
+        iters_all: List[np.ndarray] = []
+        reasons_all: List[np.ndarray] = []
+        for bucket in dataset.buckets:
+            off = bucket.offsets
+            if residual_offsets is not None:
+                safe_rows = np.maximum(bucket.row_index, 0)
+                off = residual_offsets[safe_rows].astype(np.float32)
+                off = np.where(bucket.row_index >= 0, off, 0.0)
+            sl = bank[jnp.asarray(bucket.entity_codes)]
+            new_sl, iters, reasons = self._solver(
+                sl,
+                jnp.asarray(bucket.indices),
+                jnp.asarray(bucket.values),
+                jnp.asarray(bucket.labels),
+                jnp.asarray(off),
+                jnp.asarray(bucket.weights),
+                jnp.float32(l1),
+                jnp.float32(l2),
+            )
+            bank = bank.at[jnp.asarray(bucket.entity_codes)].set(new_sl)
+            iters_all.append(np.asarray(iters))
+            reasons_all.append(np.asarray(reasons))
+        if iters_all:
+            iters = np.concatenate(iters_all)
+            reasons = np.concatenate(reasons_all)
+            counts: Dict[str, int] = {}
+            for code, cnt in zip(*np.unique(reasons, return_counts=True)):
+                counts[CONVERGENCE_REASON_NAMES.get(int(code), "?")] = int(cnt)
+            tracker = RandomEffectTracker(
+                num_entities=len(iters),
+                iterations_mean=float(iters.mean()),
+                iterations_max=int(iters.max()),
+                reason_counts=counts,
+            )
+        else:
+            tracker = RandomEffectTracker(0, 0.0, 0, {})
+        return bank, tracker
+
+    def regularization_term(self, bank: Array) -> float:
+        """Sum of per-entity reg terms (Coordinate.regTerm analog)."""
+        l1, l2 = self.regularization.split(self.reg_weight)
+        term = 0.5 * l2 * float(jnp.sum(bank * bank))
+        if l1:
+            term += l1 * float(jnp.sum(jnp.abs(bank)))
+        return term
+
+
+def score_random_effect(
+    bank: Array,  # [E, D]
+    dataset: RandomEffectDataset,
+) -> Array:
+    """Row-aligned scores [n]: score_i = x_i(local) . bank[entity_i].
+
+    Covers active AND passive rows (passive scoring with locally-projected
+    features is equivalent to the reference's back-projected model scoring:
+    features unseen in the entity's active data have zero coefficients,
+    RandomEffectCoordinate.scala:178-199)."""
+    codes = jnp.maximum(jnp.asarray(dataset.row_entity_codes), 0)
+    valid = jnp.asarray(dataset.row_entity_codes >= 0)
+    w_rows = jnp.take(bank, codes, axis=0)  # [n, D]
+    ix = jnp.asarray(dataset.row_local_indices)
+    v = jnp.asarray(dataset.row_local_values)
+    score = jnp.sum(v * jnp.take_along_axis(w_rows, ix, axis=1), axis=-1)
+    return jnp.where(valid, score, 0.0)
+
+
+def dryrun_entity_bank(mesh) -> None:
+    """Tiny entity-sharded vmapped solve for the multi-chip dry run:
+    bank rows sharded over the mesh's first axis (expert-parallel analog)."""
+    from functools import partial
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from photon_ml_tpu.ops.losses import LOGISTIC
+
+    axis = mesh.axis_names[0]
+    n_dev = mesh.devices.size
+    E, S, K, D = 2 * n_dev, 4, 4, 8
+    rng = np.random.default_rng(0)
+    solver = _bucket_solver(
+        LOGISTIC, OptimizerConfig(max_iter=3), RegularizationContext()
+    )
+    sharding = NamedSharding(mesh, P(axis))
+    bank = jax.device_put(jnp.zeros((E, D), jnp.float32), sharding)
+    args = (
+        jax.device_put(jnp.asarray(rng.integers(0, D, size=(E, S, K), dtype=np.int32)), sharding),
+        jax.device_put(jnp.asarray(rng.normal(size=(E, S, K)).astype(np.float32)), sharding),
+        jax.device_put(jnp.asarray((rng.uniform(size=(E, S)) > 0.5).astype(np.float32)), sharding),
+        jax.device_put(jnp.zeros((E, S), jnp.float32), sharding),
+        jax.device_put(jnp.ones((E, S), jnp.float32), sharding),
+    )
+    new_bank, iters, reasons = solver(bank, *args, jnp.float32(0.0), jnp.float32(0.1))
+    assert bool(jnp.all(jnp.isfinite(new_bank)))

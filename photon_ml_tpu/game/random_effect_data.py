@@ -1,0 +1,323 @@
+"""Random-effect dataset: per-entity data as bucketed dense blocks.
+
+Reference: photon-ml .../data/RandomEffectDataSet.scala (activeData grouped
+per entity with reservoir cap + weight rescale at :254-317, passive split
+at :328-369), data/LocalDataSet.scala (Pearson feature filter :116-130,
+scorer :202+), projector/IndexMapProjector.scala:83-105 (per-entity dense
+re-indexing), ProjectionMatrix.scala:90-119 (shared Gaussian random
+projection, intercept-preserving), RandomEffectDataSetPartitioner.scala
+(entity load balancing).
+
+TPU-native shape: the groupByKey shuffle becomes a host-side stable sort;
+entities are packed into BUCKETS of equal sample capacity (power-of-two)
+so per-entity solves vmap over [E_b, S_b, k] dense blocks with weight-0
+padding — the "millions of tiny LBFGS solves" run as ONE XLA program per
+bucket (SURVEY P2: entities are the expert-parallel analog).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.game.config import (
+    ProjectorType,
+    RandomEffectDataConfiguration,
+)
+from photon_ml_tpu.game.data import GameDataset, ShardData
+
+
+@dataclass
+class RandomEffectBucket:
+    """Entities with <= capacity active samples, dense-packed."""
+
+    entity_codes: np.ndarray  # int32 [E_b]
+    row_index: np.ndarray  # int32 [E_b, S_b] global row id, -1 pad
+    indices: np.ndarray  # int32 [E_b, S_b, k] LOCAL feature indices, 0 pad
+    values: np.ndarray  # float32 [E_b, S_b, k]
+    labels: np.ndarray  # float32 [E_b, S_b]
+    offsets: np.ndarray  # float32 [E_b, S_b]
+    weights: np.ndarray  # float32 [E_b, S_b] (0 pad; reservoir-rescaled)
+
+    @property
+    def num_entities(self) -> int:
+        return self.entity_codes.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.row_index.shape[1]
+
+
+@dataclass
+class RandomEffectDataset:
+    """Active data bucketed per entity + row-aligned local projections."""
+
+    config: RandomEffectDataConfiguration
+    num_entities: int
+    local_dim: int  # D: width of the entity model bank
+    # per-entity projection: global feature id per local slot, -1 pad
+    projection: np.ndarray  # int32 [E, D]
+    # Row-aligned views over the FULL dataset (active + passive + unseen):
+    # local feature indices per row (0 pad; unseen features dropped).
+    row_local_indices: np.ndarray  # int32 [n, k]
+    row_local_values: np.ndarray  # float32 [n, k]
+    row_entity_codes: np.ndarray  # int32 [n] (-1 for padding rows)
+    buckets: List[RandomEffectBucket]
+    num_active_rows: int
+    num_passive_rows: int
+    # RANDOM projector only: [d_global, D] projection matrix
+    random_projection: Optional[np.ndarray] = None
+
+    @property
+    def intercept_local_index(self) -> Optional[int]:
+        return self._intercept_local
+
+    _intercept_local: Optional[int] = None
+
+
+def _pearson_keep_mask(
+    rows_ix: List[np.ndarray],
+    rows_v: List[np.ndarray],
+    labels: np.ndarray,
+    dim: int,
+    num_keep: int,
+    intercept_index: Optional[int],
+) -> np.ndarray:
+    """Top-|Pearson(feature, label)| feature mask over one entity's rows
+    (LocalDataSet.filterFeaturesByPearsonCorrelationScore:116-130; the
+    intercept is always kept)."""
+    m = len(rows_ix)
+    x_sum = np.zeros(dim)
+    x2_sum = np.zeros(dim)
+    xy_sum = np.zeros(dim)
+    y = labels - labels.mean()
+    for r in range(m):
+        np.add.at(x_sum, rows_ix[r], rows_v[r])
+        np.add.at(x2_sum, rows_ix[r], rows_v[r] ** 2)
+        np.add.at(xy_sum, rows_ix[r], rows_v[r] * y[r])
+    x_mean = x_sum / m
+    x_var = x2_sum / m - x_mean**2
+    y_var = float((y**2).mean())
+    denom = np.sqrt(np.maximum(x_var * y_var, 1e-30))
+    corr = np.where(denom > 1e-15, np.abs(xy_sum / m) / denom, 0.0)
+    if intercept_index is not None:
+        corr[intercept_index] = np.inf  # always keep
+    order = np.argsort(-corr)
+    keep = np.zeros(dim, bool)
+    keep[order[:num_keep]] = True
+    return keep
+
+
+def build_random_effect_dataset(
+    dataset: GameDataset,
+    config: RandomEffectDataConfiguration,
+    *,
+    seed: int = 0,
+) -> RandomEffectDataset:
+    """GameDataset + config -> bucketed per-entity dataset.
+
+    Mirrors RandomEffectDataSet.buildWithConfiguration: group by entity,
+    reservoir-cap active data with weight rescale cnt/cap, passive split,
+    optional Pearson filter, per-entity index (or shared random)
+    projection.
+    """
+    shard: ShardData = dataset.shards[config.feature_shard_id]
+    codes = dataset.entity_codes[config.random_effect_type]
+    eindex = dataset.entity_indexes[config.random_effect_type]
+    E = eindex.num_entities
+    n = dataset.num_rows
+    k = shard.indices.shape[1]
+    rng = np.random.default_rng(seed)
+
+    real = dataset.weights > 0
+    # --- group rows by entity (the groupByKey analog: stable sort) -------
+    rows_of: List[List[int]] = [[] for _ in range(E)]
+    for i in np.nonzero(real)[0]:
+        c = codes[i]
+        if c >= 0:
+            rows_of[int(c)].append(int(i))
+
+    cap = config.active_data_upper_bound
+    active_rows: List[List[int]] = []
+    active_weight_scale: List[float] = []
+    num_passive = 0
+    for e in range(E):
+        rows = rows_of[e]
+        if cap is not None and len(rows) > cap:
+            chosen = rng.choice(len(rows), size=cap, replace=False)
+            active = [rows[j] for j in np.sort(chosen)]
+            # weight rescale cumCount/size (RandomEffectDataSet.scala:254-317)
+            scale = len(rows) / cap
+            num_passive += len(rows) - cap
+        else:
+            active = rows
+            scale = 1.0
+        active_rows.append(active)
+        active_weight_scale.append(scale)
+
+    # --- per-entity feature selection + local projection -----------------
+    dim = shard.dim
+    proj_type = config.projector_type
+    random_projection = None
+    if proj_type == ProjectorType.RANDOM:
+        D = int(config.random_projection_dim)
+        # Gaussian N(0, 1/D), intercept column preserved
+        # (ProjectionMatrix.scala:90-119).
+        random_projection = rng.normal(
+            0.0, 1.0 / np.sqrt(D), size=(dim, D)
+        ).astype(np.float32)
+        if shard.intercept_index is not None:
+            random_projection[shard.intercept_index, :] = 0.0
+            random_projection[:, D - 1] = np.where(
+                np.arange(dim) == shard.intercept_index, 1.0, 0.0
+            )
+
+    local_maps: List[Dict[int, int]] = []
+    local_dims: List[int] = []
+    projections: List[np.ndarray] = []
+    intercept_local: Optional[int] = None
+    if proj_type == ProjectorType.IDENTITY or proj_type == ProjectorType.RANDOM:
+        D = dim if proj_type == ProjectorType.IDENTITY else int(
+            config.random_projection_dim
+        )
+        local_maps = None  # identity/matrix handled row-wise below
+    else:  # INDEX_MAP
+        for e in range(E):
+            feats = set()
+            rows = active_rows[e]
+            m = len(rows)
+            if m and config.features_to_samples_ratio is not None:
+                num_keep = max(1, int(np.ceil(config.features_to_samples_ratio * m)))
+                rows_ix = [shard.indices[i][shard.values[i] != 0] for i in rows]
+                rows_v = [shard.values[i][shard.values[i] != 0] for i in rows]
+                keep = _pearson_keep_mask(
+                    rows_ix, rows_v, dataset.labels[rows], dim, num_keep,
+                    shard.intercept_index,
+                )
+            else:
+                keep = None
+            for i in rows:
+                for s in range(k):
+                    v = shard.values[i, s]
+                    if v != 0:
+                        j = int(shard.indices[i, s])
+                        if keep is None or keep[j]:
+                            feats.add(j)
+            if shard.intercept_index is not None:
+                feats.add(shard.intercept_index)
+            ordered = sorted(feats)
+            local_maps.append({g: l for l, g in enumerate(ordered)})
+            local_dims.append(len(ordered))
+            projections.append(np.asarray(ordered, np.int32))
+        D = max(local_dims) if local_dims else 1
+
+    D = max(D, 1)
+    projection = np.full((E, D), -1, np.int32)
+    if proj_type == ProjectorType.INDEX_MAP:
+        for e in range(E):
+            projection[e, : local_dims[e]] = projections[e]
+    elif proj_type == ProjectorType.IDENTITY:
+        projection[:] = np.arange(D, dtype=np.int32)[None, :]
+        if shard.intercept_index is not None:
+            intercept_local = shard.intercept_index
+    if proj_type == ProjectorType.RANDOM and shard.intercept_index is not None:
+        intercept_local = D - 1
+
+    # --- row-aligned local features over the FULL table ------------------
+    row_local_ix = np.zeros((n, k), np.int32)
+    row_local_v = np.zeros((n, k), np.float32)
+    if proj_type == ProjectorType.IDENTITY:
+        row_local_ix = shard.indices.copy()
+        row_local_v = shard.values.copy()
+    elif proj_type == ProjectorType.RANDOM:
+        # dense projected rows: x_local = x . P  [D]; store as dense slots
+        if D > k:
+            row_local_ix = np.zeros((n, D), np.int32)
+            row_local_v = np.zeros((n, D), np.float32)
+        else:
+            row_local_ix = np.zeros((n, max(k, D)), np.int32)
+            row_local_v = np.zeros((n, max(k, D)), np.float32)
+        row_local_ix[:, :D] = np.arange(D, dtype=np.int32)[None, :]
+        for i in range(n):
+            if not real[i]:
+                continue
+            nz = shard.values[i] != 0
+            x_proj = random_projection[shard.indices[i][nz]].T @ shard.values[i][nz]
+            row_local_v[i, :D] = x_proj
+    else:  # INDEX_MAP
+        for i in range(n):
+            c = int(codes[i])
+            if not real[i] or c < 0:
+                continue
+            lm = local_maps[c]
+            for s in range(k):
+                v = shard.values[i, s]
+                if v != 0:
+                    l = lm.get(int(shard.indices[i, s]))
+                    if l is not None:
+                        row_local_ix[i, s] = l
+                        row_local_v[i, s] = v
+
+    # --- bucketed active data -------------------------------------------
+    counts = np.asarray([len(r) for r in active_rows])
+    caps: List[int] = []
+    for c in counts:
+        if c > 0:
+            s = 1
+            while s < c:
+                s *= 2
+            caps.append(s)
+        else:
+            caps.append(0)
+    caps_arr = np.asarray(caps)
+    buckets: List[RandomEffectBucket] = []
+    kk = row_local_ix.shape[1]
+    num_active = int(counts.sum())
+    for S in sorted(set(c for c in caps if c > 0)):
+        members = np.nonzero(caps_arr == S)[0]
+        E_b = len(members)
+        b_rows = np.full((E_b, S), -1, np.int32)
+        b_ix = np.zeros((E_b, S, kk), np.int32)
+        b_v = np.zeros((E_b, S, kk), np.float32)
+        b_lab = np.zeros((E_b, S), np.float32)
+        b_off = np.zeros((E_b, S), np.float32)
+        b_w = np.zeros((E_b, S), np.float32)
+        for bi, e in enumerate(members):
+            rows = active_rows[e]
+            scale = active_weight_scale[e]
+            for si, i in enumerate(rows):
+                b_rows[bi, si] = i
+                b_ix[bi, si] = row_local_ix[i]
+                b_v[bi, si] = row_local_v[i]
+                b_lab[bi, si] = dataset.labels[i]
+                b_off[bi, si] = dataset.offsets[i]
+                b_w[bi, si] = dataset.weights[i] * scale
+        buckets.append(
+            RandomEffectBucket(
+                entity_codes=members.astype(np.int32),
+                row_index=b_rows,
+                indices=b_ix,
+                values=b_v,
+                labels=b_lab,
+                offsets=b_off,
+                weights=b_w,
+            )
+        )
+
+    ds = RandomEffectDataset(
+        config=config,
+        num_entities=E,
+        local_dim=D,
+        projection=projection,
+        row_local_indices=row_local_ix,
+        row_local_values=row_local_v,
+        row_entity_codes=np.where(real, codes, -1).astype(np.int32),
+        buckets=buckets,
+        num_active_rows=num_active,
+        num_passive_rows=num_passive,
+        random_projection=random_projection,
+    )
+    ds._intercept_local = intercept_local
+    return ds

@@ -1,0 +1,377 @@
+"""GAME layer tests: dataset building, entity grouping + reservoir cap,
+Pearson filter, projections, vmapped RE solves vs direct per-entity
+solves, coordinate descent objective decrease, factored RE + MF.
+
+Mirrors GameIntegTest/GameTestUtils validator-style checks.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.game import (
+    CoordinateDescent,
+    FactoredRandomEffectConfiguration,
+    FactoredRandomEffectCoordinate,
+    FeatureShardConfiguration,
+    FixedEffectCoordinate,
+    GameModel,
+    MatrixFactorizationCoordinate,
+    ProjectorType,
+    RandomEffectCoordinate,
+    RandomEffectDataConfiguration,
+    RandomEffectOptimizationProblem,
+    build_game_dataset,
+    build_random_effect_dataset,
+    score_random_effect,
+)
+from photon_ml_tpu.ops.losses import LINEAR, LOGISTIC
+from photon_ml_tpu.optim import (
+    OptimizerConfig,
+    RegularizationContext,
+    RegularizationType,
+    minimize_lbfgs,
+)
+from photon_ml_tpu.optim.problem import create_glm_problem
+from photon_ml_tpu.task import TaskType
+
+
+def make_records(rng, n=200, n_users=10, d_global=6, d_user=4):
+    """Synthetic GLMix data: global effect + per-user effect."""
+    w_global = np.linspace(-1, 1, d_global)
+    w_user = rng.normal(size=(n_users, d_user)).astype(np.float32)
+    recs = []
+    for i in range(n):
+        u = int(rng.integers(0, n_users))
+        xg = rng.normal(size=d_global).astype(np.float32)
+        xu = rng.normal(size=d_user).astype(np.float32)
+        z = float(xg @ w_global + xu @ w_user[u])
+        y = float(1 / (1 + np.exp(-z)) > rng.uniform())
+        recs.append({
+            "uid": f"r{i}",
+            "response": y,
+            "userId": f"user{u:03d}",
+            "features": [
+                {"name": f"g{j}", "term": "", "value": float(xg[j])}
+                for j in range(d_global)
+            ],
+            "userFeatures": [
+                {"name": f"u{j}", "term": "", "value": float(xu[j])}
+                for j in range(d_user)
+            ],
+        })
+    return recs, w_global, w_user
+
+
+SHARDS = [
+    FeatureShardConfiguration("globalShard", ["features"], add_intercept=True),
+    FeatureShardConfiguration("userShard", ["userFeatures"], add_intercept=True),
+]
+
+
+class TestGameDataset:
+    def test_build(self, rng):
+        recs, _, _ = make_records(rng)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        assert ds.num_real_rows == 200
+        assert ds.shards["globalShard"].dim == 7  # 6 + intercept
+        assert ds.shards["userShard"].dim == 5
+        assert ds.entity_indexes["userId"].num_entities == 10
+        codes = ds.entity_codes["userId"]
+        assert codes[:200].min() >= 0 and codes[:200].max() == 9
+        # padding rows have weight 0 and code -1
+        assert np.all(ds.weights[200:] == 0)
+
+    def test_metadata_map_ids(self, rng):
+        recs = [
+            {"response": 1.0, "metadataMap": {"queryId": "q1"}, "features": []},
+            {"response": 0.0, "metadataMap": {"queryId": "q2"}, "features": []},
+        ]
+        ds = build_game_dataset(
+            recs, [FeatureShardConfiguration("g", ["features"])], ["queryId"]
+        )
+        assert ds.entity_indexes["queryId"].num_entities == 2
+
+    def test_scoring_mode_no_response(self):
+        recs = [{"features": [{"name": "a", "term": "", "value": 1.0}]}]
+        with pytest.raises(ValueError):
+            build_game_dataset(recs, [FeatureShardConfiguration("g", ["features"])])
+        ds = build_game_dataset(
+            recs, [FeatureShardConfiguration("g", ["features"])],
+            is_response_required=False,
+        )
+        assert ds.labels[0] == 0.0
+
+
+class TestRandomEffectDataset:
+    def test_grouping_and_buckets(self, rng):
+        recs, _, _ = make_records(rng)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        red = build_random_effect_dataset(
+            ds,
+            RandomEffectDataConfiguration("userId", "userShard"),
+        )
+        assert red.num_entities == 10
+        # every real row appears exactly once across buckets
+        seen = []
+        for b in red.buckets:
+            seen.extend(b.row_index[b.row_index >= 0].tolist())
+        assert sorted(seen) == sorted(
+            np.nonzero((ds.weights > 0) & (ds.entity_codes["userId"] >= 0))[0].tolist()
+        )
+        # bucket capacities are powers of two and weights pad with 0
+        for b in red.buckets:
+            assert (b.capacity & (b.capacity - 1)) == 0
+            assert np.all(b.weights[b.row_index < 0] == 0)
+
+    def test_reservoir_cap_rescales_weights(self, rng):
+        recs, _, _ = make_records(rng, n=300, n_users=3)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        red = build_random_effect_dataset(
+            ds,
+            RandomEffectDataConfiguration(
+                "userId", "userShard", active_data_upper_bound=16
+            ),
+        )
+        assert red.num_active_rows == 3 * 16
+        assert red.num_passive_rows == 300 - 48
+        # weight mass approximately preserved per entity
+        for b in red.buckets:
+            for e in range(b.num_entities):
+                cnt_total = np.sum(
+                    ds.entity_codes["userId"][:300] == b.entity_codes[e]
+                )
+                mass = b.weights[e].sum()
+                assert mass == pytest.approx(cnt_total, rel=1e-5)
+
+    def test_pearson_filter_bounds_dim(self, rng):
+        recs, _, _ = make_records(rng, n=60, n_users=30)  # ~2 rows/user
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        red = build_random_effect_dataset(
+            ds,
+            RandomEffectDataConfiguration(
+                "userId", "userShard", features_to_samples_ratio=1.0
+            ),
+        )
+        # with ratio 1 and ~2 samples, local dims stay small (<= samples+icept)
+        assert red.local_dim <= 8
+
+    def test_random_projection(self, rng):
+        recs, _, _ = make_records(rng)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        red = build_random_effect_dataset(
+            ds,
+            RandomEffectDataConfiguration(
+                "userId", "userShard",
+                projector_type=ProjectorType.RANDOM,
+                random_projection_dim=3,
+            ),
+        )
+        assert red.local_dim == 3
+        assert red.random_projection.shape == (5, 3)
+        # intercept column preserved: last latent dim is the intercept slot
+        icept = ds.shards["userShard"].intercept_index
+        col = red.random_projection[:, 2]
+        expect = np.zeros(5); expect[icept] = 1.0
+        np.testing.assert_allclose(col, expect)
+
+
+class TestRandomEffectSolver:
+    def test_matches_direct_solves(self, rng):
+        recs, _, _ = make_records(rng, n=120, n_users=5)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        red = build_random_effect_dataset(
+            ds, RandomEffectDataConfiguration("userId", "userShard")
+        )
+        problem = RandomEffectOptimizationProblem(
+            LOGISTIC, OptimizerConfig(max_iter=100),
+            RegularizationContext(RegularizationType.L2), reg_weight=1.0,
+        )
+        bank = jnp.zeros((red.num_entities, red.local_dim), jnp.float32)
+        bank, tracker = problem.update_bank(bank, red)
+        assert tracker.num_entities == 5
+
+        # direct per-entity solve from the raw rows must agree
+        codes = ds.entity_codes["userId"]
+        for e in range(3):
+            rows = np.nonzero((codes == e) & (ds.weights > 0))[0]
+            proj = red.projection[e]
+            D_e = int((proj >= 0).sum())
+            gl2loc = {int(g): l for l, g in enumerate(proj[:D_e])}
+            sd = ds.shards["userShard"]
+            def vg(w):
+                val = 0.0
+                grad = jnp.zeros(D_e)
+                for i in rows:
+                    ix = [gl2loc[int(g)] for g, v in zip(sd.indices[i], sd.values[i]) if v != 0]
+                    vs = [float(v) for v in sd.values[i] if v != 0]
+                    z = sum(v * w[l] for l, v in zip(ix, vs)) + ds.offsets[i]
+                    val = val + ds.weights[i] * LOGISTIC.value(z, ds.labels[i])
+                    d1 = ds.weights[i] * LOGISTIC.d1(z, ds.labels[i])
+                    for l, v in zip(ix, vs):
+                        grad = grad.at[l].add(d1 * v)
+                return val + 0.5 * jnp.vdot(w, w), grad + w
+            direct = minimize_lbfgs(vg, jnp.zeros(D_e))
+            np.testing.assert_allclose(
+                np.asarray(bank[e][:D_e]), np.asarray(direct.coefficients),
+                atol=5e-3,
+            )
+
+    def test_scores_cover_all_rows(self, rng):
+        recs, _, _ = make_records(rng)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        red = build_random_effect_dataset(
+            ds, RandomEffectDataConfiguration("userId", "userShard",
+                                              active_data_upper_bound=8)
+        )
+        bank = jnp.ones((red.num_entities, red.local_dim), jnp.float32)
+        s = np.asarray(score_random_effect(bank, red))
+        # passive rows (beyond cap) must be scored too
+        assert np.count_nonzero(s[:200]) > 150
+
+
+class TestCoordinateDescent:
+    def _setup(self, rng, task=TaskType.LOGISTIC_REGRESSION):
+        recs, _, _ = make_records(rng, n=300, n_users=8)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        red = build_random_effect_dataset(
+            ds, RandomEffectDataConfiguration("userId", "userShard")
+        )
+        fe = FixedEffectCoordinate(
+            name="global",
+            dataset=ds,
+            problem=create_glm_problem(
+                task, ds.shards["globalShard"].dim,
+                config=OptimizerConfig(max_iter=30),
+                regularization=RegularizationContext(RegularizationType.L2),
+            ),
+            feature_shard_id="globalShard",
+            reg_weight=0.1,
+        )
+        re = RandomEffectCoordinate(
+            name="per-user",
+            dataset=ds,
+            re_dataset=red,
+            problem=RandomEffectOptimizationProblem(
+                LOGISTIC if task == TaskType.LOGISTIC_REGRESSION else LINEAR,
+                OptimizerConfig(max_iter=30),
+                RegularizationContext(RegularizationType.L2),
+                reg_weight=1.0,
+            ),
+        )
+        return ds, {"global": fe, "per-user": re}
+
+    def test_objective_decreases(self, rng):
+        ds, coords = self._setup(rng)
+        cd = CoordinateDescent(coords, ds, TaskType.LOGISTIC_REGRESSION)
+        result = cd.run(num_iterations=3)
+        obj = result.objective_history
+        assert len(obj) == 3
+        assert obj[-1] <= obj[0] + 1e-6, obj
+        # mixed model beats fixed-effect-only on training loss
+        cd_fe = CoordinateDescent(
+            {"global": coords["global"]}, ds, TaskType.LOGISTIC_REGRESSION
+        )
+        fe_only = cd_fe.run(num_iterations=1)
+        assert obj[-1] < fe_only.objective_history[-1]
+
+    def test_warm_start_model(self, rng):
+        ds, coords = self._setup(rng)
+        cd = CoordinateDescent(coords, ds, TaskType.LOGISTIC_REGRESSION)
+        r1 = cd.run(num_iterations=2)
+        r2 = cd.run(num_iterations=1, initial_model=r1.model)
+        assert r2.objective_history[-1] <= r1.objective_history[0] + 1e-6
+
+    def test_update_sequence_validation(self, rng):
+        ds, coords = self._setup(rng)
+        with pytest.raises(ValueError, match="unknown"):
+            CoordinateDescent(
+                coords, ds, TaskType.LOGISTIC_REGRESSION,
+                update_sequence=["global", "nope"],
+            )
+
+
+class TestFactoredRandomEffect:
+    def test_trains_and_scores(self, rng):
+        recs, _, _ = make_records(rng, n=200, n_users=6)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        red = build_random_effect_dataset(
+            ds,
+            RandomEffectDataConfiguration(
+                "userId", "userShard", projector_type=ProjectorType.IDENTITY
+            ),
+        )
+        fre = FactoredRandomEffectCoordinate(
+            name="factored",
+            dataset=ds,
+            re_dataset=red,
+            problem=RandomEffectOptimizationProblem(
+                LOGISTIC, OptimizerConfig(max_iter=15),
+                RegularizationContext(RegularizationType.L2), reg_weight=1.0,
+            ),
+            projection_problem=create_glm_problem(
+                TaskType.LOGISTIC_REGRESSION,
+                red.local_dim * 2,
+                config=OptimizerConfig(max_iter=15),
+                regularization=RegularizationContext(RegularizationType.L2),
+            ),
+            config=FactoredRandomEffectConfiguration(
+                latent_space_dimension=2, num_inner_iterations=1
+            ),
+            reg_weight_projection=0.1,
+        )
+        model = fre.initialize_model()
+        assert model.projection.shape == (red.local_dim, 2)
+        new_model, _ = fre.update_model(model, None)
+        s = fre.score(new_model)
+        assert np.all(np.isfinite(np.asarray(s)))
+        # training reduced the loss vs initial (zero bank scores 0)
+        loss0 = float(jnp.sum(jnp.asarray(ds.weights) * LOGISTIC.value(
+            jnp.zeros(ds.num_rows), jnp.asarray(ds.labels))))
+        loss1 = float(jnp.sum(jnp.asarray(ds.weights) * LOGISTIC.value(
+            s + jnp.asarray(ds.offsets), jnp.asarray(ds.labels))))
+        assert loss1 < loss0
+
+
+class TestMatrixFactorization:
+    def test_als_reduces_loss(self, rng):
+        # rating ~ user . item latent
+        n_users, n_items, K = 12, 15, 3
+        U = rng.normal(size=(n_users, K))
+        V = rng.normal(size=(n_items, K))
+        recs = []
+        for i in range(400):
+            u = int(rng.integers(0, n_users))
+            it = int(rng.integers(0, n_items))
+            recs.append({
+                "response": float(U[u] @ V[it] + 0.1 * rng.normal()),
+                "userId": f"u{u}",
+                "itemId": f"i{it}",
+                "features": [],
+            })
+        ds = build_game_dataset(
+            recs, [FeatureShardConfiguration("g", ["features"])],
+            ["userId", "itemId"],
+        )
+        mf = MatrixFactorizationCoordinate(
+            name="mf",
+            dataset=ds,
+            row_effect_type="userId",
+            col_effect_type="itemId",
+            num_latent_factors=K,
+            problem=RandomEffectOptimizationProblem(
+                LINEAR, OptimizerConfig(max_iter=20),
+                RegularizationContext(RegularizationType.L2), reg_weight=0.1,
+            ),
+            num_inner_iterations=3,
+        )
+        model = mf.initialize_model()
+        lab = jnp.asarray(ds.labels); w = jnp.asarray(ds.weights)
+        def mse(m):
+            s = mf.score(m)
+            return float(jnp.sum(w * (s - lab) ** 2) / jnp.sum(w))
+        before = mse(model)
+        model, _ = mf.update_model(model, None)
+        after = mse(model)
+        assert after < before * 0.5, (before, after)
