@@ -1,0 +1,174 @@
+"""GAME scoring driver: load model dir -> score Avro data -> write
+ScoringResultAvro -> optional evaluation.
+
+Reference: photon-ml .../cli/game/scoring/Driver.scala:171-204 (run:
+prepareFeatureMaps -> prepareGameDataSet(isResponseRequired=false) ->
+loadGameModelFromHDFS -> score -> saveScoresToHDFS -> evaluateScores) and
+cli/game/scoring/Params.scala (option names kept), ScoredItem.scala.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from photon_ml_tpu.evaluation import Evaluator, EvaluatorType
+from photon_ml_tpu.game.data import build_game_dataset
+from photon_ml_tpu.game.config import FeatureShardConfiguration
+from photon_ml_tpu.game.model_io import load_game_model
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro_codec import read_avro_records, write_container
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.task import TaskType
+from photon_ml_tpu.utils.logging_util import PhotonLogger, Timer
+
+
+@dataclass
+class GameScoringParams:
+    input_dirs: List[str] = field(default_factory=list)
+    game_model_input_dir: str = ""
+    output_dir: str = ""
+    task_type: TaskType = TaskType.LOGISTIC_REGRESSION
+    feature_shards: List[FeatureShardConfiguration] = field(default_factory=list)
+    evaluator_types: List[EvaluatorType] = field(default_factory=list)
+    model_id: str = ""
+    has_response: bool = True
+
+    def validate(self):
+        if not self.input_dirs:
+            raise ValueError("input-data-dirs is required")
+        if not self.game_model_input_dir:
+            raise ValueError("game-model-input-dir is required")
+        if not self.output_dir:
+            raise ValueError("output-dir is required")
+
+
+class GameScoringDriver:
+    def __init__(self, params: GameScoringParams, logger=None):
+        params.validate()
+        self.params = params
+        os.makedirs(params.output_dir, exist_ok=True)
+        self.logger = logger or PhotonLogger(params.output_dir)
+        self.timer = Timer()
+        self.metrics: Dict[str, float] = {}
+
+    def run(self) -> None:
+        p = self.params
+        with self.timer.time("load-model"):
+            model = load_game_model(p.game_model_input_dir)
+        self.logger.info("loaded coordinates: %s", model.coordinate_names())
+
+        # id columns needed: RE types + MF types + sharded evaluator ids
+        id_types = set()
+        for _, (re_type, _, _) in model.random_effects.items():
+            id_types.add(re_type)
+        for _, (rt, ct, _, _) in model.matrix_factorizations.items():
+            id_types.update((rt, ct))
+        for et in p.evaluator_types:
+            if et.id_type:
+                id_types.add(et.id_type)
+
+        with self.timer.time("load-data"):
+            dataset = build_game_dataset(
+                read_avro_records(p.input_dirs),
+                p.feature_shards,
+                sorted(id_types),
+                is_response_required=p.has_response,
+            )
+        with self.timer.time("score"):
+            raw_scores = model.score(dataset, p.task_type)
+            scores = raw_scores + jnp.asarray(dataset.offsets)
+        with self.timer.time("write-scores"):
+            self._write_scores(dataset, np.asarray(scores))
+        if p.evaluator_types and p.has_response:
+            with self.timer.time("evaluate"):
+                self._evaluate(dataset, scores)
+            with open(os.path.join(p.output_dir, "metrics.json"), "w") as f:
+                json.dump(self.metrics, f, indent=2)
+        self.logger.info("timers:\n%s", self.timer.summary())
+
+    def _write_scores(self, dataset, scores: np.ndarray) -> None:
+        records = []
+        for i in range(dataset.num_real_rows):
+            records.append({
+                "uid": dataset.uids[i],
+                "label": float(dataset.labels[i]) if self.params.has_response else None,
+                "modelId": self.params.model_id or "game-model",
+                "predictionScore": float(scores[i]),
+                "weight": float(dataset.weights[i]),
+                "metadataMap": None,
+            })
+        write_container(
+            os.path.join(self.params.output_dir, "scores", "part-00000.avro"),
+            schemas.SCORING_RESULT_AVRO,
+            records,
+        )
+
+    def _evaluate(self, dataset, scores) -> None:
+        p = self.params
+        lab = jnp.asarray(dataset.labels)
+        w = jnp.asarray(dataset.weights)
+        loss = loss_for_task(p.task_type)
+        for et in p.evaluator_types:
+            if et.is_sharded:
+                gids = dataset.entity_codes[et.id_type]
+                ev = Evaluator(
+                    et, num_groups=dataset.entity_indexes[et.id_type].num_entities
+                )
+                value = float(
+                    ev.evaluate(scores, lab, w, jnp.maximum(jnp.asarray(gids), 0))
+                )
+            else:
+                metric_in = loss.mean(scores) if et.name == "RMSE" else scores
+                value = float(Evaluator(et).evaluate(metric_in, lab, w))
+            self.metrics[et.render()] = value
+            self.logger.info("%s = %g", et.render(), value)
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="photon-ml-tpu game-scoring")
+    ap.add_argument("--input-data-dirs", required=True)
+    ap.add_argument("--game-model-input-dir", required=True)
+    ap.add_argument("--output-dir", required=True)
+    ap.add_argument("--task-type", default="LOGISTIC_REGRESSION")
+    ap.add_argument("--feature-shard-id-to-feature-section-keys-map", required=True)
+    ap.add_argument("--evaluator-types", default=None)
+    ap.add_argument("--model-id", default="")
+    ap.add_argument("--has-response", default="true")
+    return ap
+
+
+def params_from_args(argv=None) -> GameScoringParams:
+    from photon_ml_tpu.cli.game_training_driver import parse_shard_map
+
+    ns = build_arg_parser().parse_args(argv)
+    return GameScoringParams(
+        input_dirs=ns.input_data_dirs.split(","),
+        game_model_input_dir=ns.game_model_input_dir,
+        output_dir=ns.output_dir,
+        task_type=TaskType.parse(ns.task_type),
+        feature_shards=parse_shard_map(
+            ns.feature_shard_id_to_feature_section_keys_map
+        ),
+        evaluator_types=(
+            [EvaluatorType.parse(s) for s in ns.evaluator_types.split(",")]
+            if ns.evaluator_types
+            else []
+        ),
+        model_id=ns.model_id,
+        has_response=str(ns.has_response).lower() in ("true", "1", "yes"),
+    )
+
+
+def main(argv=None) -> None:
+    GameScoringDriver(params_from_args(argv)).run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,533 @@
+"""GAME training driver + CLI.
+
+Reference: photon-ml .../cli/game/training/Driver.scala:642-757 (run:
+prepareFeatureMaps -> prepareGameDataSet -> prepareTrainingDataSet ->
+evaluators -> train over the config grid -> save models) and
+Params.scala:199-426 (option names kept verbatim: ``train-input-dirs``,
+``feature-shard-id-to-feature-section-keys-map``,
+``fixed-effect-data-configurations``, per-coordinate config maps in the
+``coord1:cfg|coord2:cfg`` string DSL with grid expansion).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+from dataclasses import dataclass, field
+from itertools import product
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from photon_ml_tpu.evaluation import Evaluator, EvaluatorType
+from photon_ml_tpu.game.config import (
+    FactoredRandomEffectConfiguration,
+    FeatureShardConfiguration,
+    FixedEffectDataConfiguration,
+    RandomEffectDataConfiguration,
+)
+from photon_ml_tpu.game.coordinate import (
+    FactoredRandomEffectCoordinate,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.game.coordinate_descent import CoordinateDescent
+from photon_ml_tpu.game.data import GameDataset, build_game_dataset
+from photon_ml_tpu.game.model import GameModel
+from photon_ml_tpu.game.model_io import save_game_model
+from photon_ml_tpu.game.random_effect import RandomEffectOptimizationProblem
+from photon_ml_tpu.game.random_effect_data import build_random_effect_dataset
+from photon_ml_tpu.io.avro_codec import read_avro_records
+from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.optim.config import GLMOptimizationConfiguration
+from photon_ml_tpu.optim.problem import create_glm_problem
+from photon_ml_tpu.task import TaskType
+from photon_ml_tpu.utils.logging_util import PhotonLogger, Timer
+
+
+def parse_keyed_map(s: str) -> Dict[str, str]:
+    """``key1:value1|key2:value2`` -> dict (the per-coordinate DSL)."""
+    out: Dict[str, str] = {}
+    for part in s.split("|"):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, value = part.partition(":")
+        out[key.strip()] = value.strip()
+    return out
+
+
+def parse_shard_map(s: str) -> List[FeatureShardConfiguration]:
+    """``shard1:bag1,bag2|shard2:bag3`` -> shard configs."""
+    return [
+        FeatureShardConfiguration(k, [b.strip() for b in v.split(",") if b.strip()])
+        for k, v in parse_keyed_map(s).items()
+    ]
+
+
+def expand_config_grid(
+    opt_configs: Dict[str, str]
+) -> List[Dict[str, GLMOptimizationConfiguration]]:
+    """Per-coordinate strings may carry comma-grids in regWeight via ';'
+    separated alternatives; the reference expands the cross-product of
+    per-coordinate config lists into one training run each
+    (cli/game/training/Driver.scala:329-347)."""
+    names = list(opt_configs)
+    alternatives: List[List[GLMOptimizationConfiguration]] = []
+    for name in names:
+        opts = [
+            GLMOptimizationConfiguration.parse(alt)
+            for alt in opt_configs[name].split(";")
+            if alt.strip()
+        ]
+        alternatives.append(opts)
+    return [dict(zip(names, combo)) for combo in product(*alternatives)]
+
+
+@dataclass
+class GameTrainingParams:
+    train_input_dirs: List[str] = field(default_factory=list)
+    validate_input_dirs: Optional[List[str]] = None
+    output_dir: str = ""
+    task_type: TaskType = TaskType.LOGISTIC_REGRESSION
+    feature_shards: List[FeatureShardConfiguration] = field(default_factory=list)
+    fixed_effect_data_configs: Dict[str, FixedEffectDataConfiguration] = field(
+        default_factory=dict
+    )
+    fixed_effect_opt_configs: Dict[str, str] = field(default_factory=dict)
+    random_effect_data_configs: Dict[str, RandomEffectDataConfiguration] = field(
+        default_factory=dict
+    )
+    random_effect_opt_configs: Dict[str, str] = field(default_factory=dict)
+    factored_re_configs: Dict[str, FactoredRandomEffectConfiguration] = field(
+        default_factory=dict
+    )
+    updating_sequence: Optional[List[str]] = None
+    num_iterations: int = 1
+    evaluator_types: List[EvaluatorType] = field(default_factory=list)
+    compute_variance: bool = False
+    delete_output_dir_if_exists: bool = False
+
+    def validate(self) -> None:
+        if not self.train_input_dirs:
+            raise ValueError("train-input-dirs is required")
+        if not self.output_dir:
+            raise ValueError("output-dir is required")
+        coords = set(self.fixed_effect_data_configs) | set(
+            self.random_effect_data_configs
+        )
+        if not coords:
+            raise ValueError("at least one coordinate configuration required")
+        for name in self.fixed_effect_data_configs:
+            if name not in self.fixed_effect_opt_configs:
+                raise ValueError(f"missing optimization config for {name}")
+        for name in self.random_effect_data_configs:
+            if name not in self.random_effect_opt_configs:
+                raise ValueError(f"missing optimization config for {name}")
+
+
+class GameTrainingDriver:
+    def __init__(self, params: GameTrainingParams, logger=None):
+        params.validate()
+        self.params = params
+        if os.path.isdir(params.output_dir):
+            if params.delete_output_dir_if_exists:
+                shutil.rmtree(params.output_dir)
+            elif os.listdir(params.output_dir):
+                raise ValueError(
+                    f"output dir {params.output_dir} exists and is non-empty"
+                )
+        os.makedirs(params.output_dir, exist_ok=True)
+        self.logger = logger or PhotonLogger(params.output_dir)
+        self.timer = Timer()
+        self.results = []
+        self.best_result = None
+        self.best_config = None
+
+    # -- data --------------------------------------------------------------
+
+    def _load_dataset(self, dirs: Sequence[str], index_maps=None) -> GameDataset:
+        records = read_avro_records(list(dirs))
+        re_types = [
+            c.random_effect_type
+            for c in self.params.random_effect_data_configs.values()
+        ]
+        # sharded evaluators need their id columns too
+        for et in self.params.evaluator_types:
+            if et.id_type and et.id_type not in re_types:
+                re_types.append(et.id_type)
+        return build_game_dataset(
+            records,
+            self.params.feature_shards,
+            re_types,
+            index_maps=index_maps,
+            is_response_required=True,
+        )
+
+    # -- coordinates -------------------------------------------------------
+
+    def _build_coordinates(
+        self,
+        dataset: GameDataset,
+        re_datasets,
+        opt_combo: Dict[str, GLMOptimizationConfiguration],
+    ):
+        p = self.params
+        coords = {}
+        for name, dcfg in p.fixed_effect_data_configs.items():
+            ocfg = opt_combo[name]
+            dim = dataset.shards[dcfg.feature_shard_id].dim
+            coords[name] = FixedEffectCoordinate(
+                name=name,
+                dataset=dataset,
+                problem=create_glm_problem(
+                    p.task_type,
+                    dim,
+                    config=ocfg.optimizer_config,
+                    regularization=ocfg.regularization,
+                    compute_variances=p.compute_variance,
+                    intercept_index=dataset.shards[dcfg.feature_shard_id].intercept_index,
+                ),
+                feature_shard_id=dcfg.feature_shard_id,
+                reg_weight=ocfg.reg_weight,
+                down_sampling_rate=ocfg.down_sampling_rate,
+            )
+        loss = loss_for_task(p.task_type)
+        for name, dcfg in p.random_effect_data_configs.items():
+            ocfg = opt_combo[name]
+            red = re_datasets[name]
+            problem = RandomEffectOptimizationProblem(
+                loss,
+                ocfg.optimizer_config,
+                ocfg.regularization,
+                reg_weight=ocfg.reg_weight,
+            )
+            if name in p.factored_re_configs:
+                fcfg = p.factored_re_configs[name]
+                coords[name] = FactoredRandomEffectCoordinate(
+                    name=name,
+                    dataset=dataset,
+                    re_dataset=red,
+                    problem=problem,
+                    projection_problem=create_glm_problem(
+                        p.task_type,
+                        red.local_dim * fcfg.latent_space_dimension,
+                        config=ocfg.optimizer_config,
+                        regularization=ocfg.regularization,
+                    ),
+                    config=fcfg,
+                    reg_weight_projection=ocfg.reg_weight,
+                )
+            else:
+                coords[name] = RandomEffectCoordinate(
+                    name=name, dataset=dataset, re_dataset=red, problem=problem
+                )
+        return coords
+
+    # -- validation --------------------------------------------------------
+
+    def _validation_fn(self, vdata: GameDataset):
+        p = self.params
+        loss = loss_for_task(p.task_type)
+        evaluators = p.evaluator_types or [
+            EvaluatorType.parse(
+                "AUC" if p.task_type == TaskType.LOGISTIC_REGRESSION else "RMSE"
+            )
+        ]
+
+        def fn(game_model: GameModel) -> Dict[str, float]:
+            scores = self._score_on(game_model, vdata)
+            z = scores + jnp.asarray(vdata.offsets)
+            lab = jnp.asarray(vdata.labels)
+            w = jnp.asarray(vdata.weights)
+            out = {}
+            for et in evaluators:
+                if et.is_sharded:
+                    gids = vdata.entity_codes[et.id_type]
+                    ev = Evaluator(et, num_groups=vdata.entity_indexes[et.id_type].num_entities)
+                    out[et.render()] = float(
+                        ev.evaluate(z, lab, w, jnp.maximum(jnp.asarray(gids), 0))
+                    )
+                else:
+                    metric_in = loss.mean(z) if et.name == "RMSE" else z
+                    out[et.render()] = float(
+                        Evaluator(et).evaluate(metric_in, lab, w)
+                    )
+            return out
+
+        self._evaluators = evaluators
+        return fn
+
+    def _score_on(self, game_model: GameModel, vdata: GameDataset):
+        """Score a validation dataset: fixed effects score directly; RE
+        coordinates need row views over the validation rows."""
+        total = jnp.zeros((vdata.num_rows,), jnp.float32)
+        from photon_ml_tpu.game.model import (
+            FixedEffectModel,
+            MatrixFactorizationModel,
+            RandomEffectModel,
+        )
+        from photon_ml_tpu.game.coordinate import FactoredRandomEffectModel
+
+        for name, sub in game_model.models.items():
+            if isinstance(sub, (FixedEffectModel, MatrixFactorizationModel)):
+                total = total + sub.score(vdata)
+            elif isinstance(sub, (RandomEffectModel, FactoredRandomEffectModel)):
+                view = self._re_view(sub, vdata)
+                if isinstance(sub, RandomEffectModel):
+                    from photon_ml_tpu.game.random_effect import score_random_effect
+
+                    total = total + score_random_effect(sub.bank, view)
+                else:
+                    ix = jnp.asarray(view.row_local_indices)
+                    v = jnp.asarray(view.row_local_values)
+                    x_lat = jnp.einsum(
+                        "nk,nkl->nl", v, jnp.take(sub.projection, ix, axis=0)
+                    )
+                    codes = jnp.maximum(jnp.asarray(view.row_entity_codes), 0)
+                    valid = jnp.asarray(view.row_entity_codes >= 0)
+                    w_rows = jnp.take(sub.bank, codes, axis=0)
+                    total = total + jnp.where(
+                        valid, jnp.sum(x_lat * w_rows, axis=-1), 0.0
+                    )
+        return total
+
+    def _re_view(self, sub, vdata: GameDataset):
+        """Project validation rows into the model's entity-local spaces.
+
+        Entities are matched by RAW id between train and validation
+        (the reference's join on idTypeToValueMap); unseen entities score 0.
+        """
+        from dataclasses import replace as dc_replace
+
+        base = sub.re_dataset
+        train_eindex = self._train_dataset.entity_indexes[sub.random_effect_type]
+        v_eindex = vdata.entity_indexes[sub.random_effect_type]
+        sd = vdata.shards[sub.feature_shard_id]
+        n, k = sd.indices.shape
+        codes = np.full((n,), -1, np.int32)
+        v_codes = vdata.entity_codes[sub.random_effect_type]
+        for i in range(n):
+            c = v_codes[i]
+            if c >= 0 and vdata.weights[i] > 0:
+                raw = v_eindex.ids[c]
+                tc = train_eindex.code_of.get(raw)
+                if tc is not None:
+                    codes[i] = tc
+        row_ix = np.zeros((n, k), np.int32)
+        row_v = np.zeros((n, k), np.float32)
+        from photon_ml_tpu.game.config import ProjectorType
+
+        ptype = base.config.projector_type
+        if ptype == ProjectorType.IDENTITY:
+            row_ix, row_v = sd.indices.copy(), sd.values.copy()
+        elif ptype == ProjectorType.RANDOM:
+            D = base.local_dim
+            row_ix = np.tile(np.arange(D, dtype=np.int32)[None, :], (n, 1))
+            row_v = np.zeros((n, D), np.float32)
+            for i in range(n):
+                if codes[i] < 0:
+                    continue
+                nz = sd.values[i] != 0
+                row_v[i] = (
+                    base.random_projection[sd.indices[i][nz]].T @ sd.values[i][nz]
+                )
+        else:
+            lmaps = {}
+            for i in range(n):
+                c = int(codes[i])
+                if c < 0:
+                    continue
+                if c not in lmaps:
+                    proj = base.projection[c]
+                    lmaps[c] = {int(g): l for l, g in enumerate(proj) if g >= 0}
+                lm = lmaps[c]
+                for s in range(k):
+                    if sd.values[i, s] != 0:
+                        l = lm.get(int(sd.indices[i, s]))
+                        if l is not None:
+                            row_ix[i, s] = l
+                            row_v[i, s] = sd.values[i, s]
+        return dc_replace(
+            base,
+            row_local_indices=row_ix,
+            row_local_values=row_v,
+            row_entity_codes=codes,
+            buckets=[],
+        )
+
+    # -- run ---------------------------------------------------------------
+
+    def run(self) -> None:
+        p = self.params
+        with self.timer.time("load-train"):
+            dataset = self._load_dataset(p.train_input_dirs)
+        self._train_dataset = dataset
+        self.logger.info(
+            "GAME train data: %d rows, shards %s",
+            dataset.num_real_rows,
+            {s: d.dim for s, d in dataset.shards.items()},
+        )
+        with self.timer.time("re-datasets"):
+            re_datasets = {
+                name: build_random_effect_dataset(dataset, cfg)
+                for name, cfg in p.random_effect_data_configs.items()
+            }
+        vdata = None
+        validation_fn = None
+        if p.validate_input_dirs:
+            with self.timer.time("load-validate"):
+                index_maps = {
+                    s: d.index_map for s, d in dataset.shards.items()
+                }
+                vdata = self._load_dataset(p.validate_input_dirs, index_maps)
+            validation_fn = self._validation_fn(vdata)
+
+        combos = expand_config_grid(
+            {**p.fixed_effect_opt_configs, **p.random_effect_opt_configs}
+        )
+        self.logger.info("training %d configuration combo(s)", len(combos))
+        maximize = p.task_type == TaskType.LOGISTIC_REGRESSION
+        for ci, combo in enumerate(combos):
+            with self.timer.time(f"train-combo-{ci}"):
+                coords = self._build_coordinates(dataset, re_datasets, combo)
+                metric_name = None
+                if validation_fn is not None:
+                    metric_name = (self._evaluators[0].render())
+                cd = CoordinateDescent(
+                    coords,
+                    dataset,
+                    p.task_type,
+                    update_sequence=p.updating_sequence,
+                    validation_fn=validation_fn,
+                    validation_metric=metric_name,
+                    validation_maximize=maximize,
+                    logger=self.logger,
+                )
+                result = cd.run(p.num_iterations)
+            self.results.append((combo, result))
+            metric = result.best_metric
+            if self.best_result is None or (
+                metric is not None
+                and (
+                    (maximize and metric > self.best_result[1])
+                    or (not maximize and metric < self.best_result[1])
+                )
+            ):
+                self.best_result = (result, metric if metric is not None else 0.0)
+                self.best_config = combo
+
+        best = self.best_result[0]
+        with self.timer.time("save-model"):
+            spec = "\n".join(
+                f"{name} -> {cfg.render()}" for name, cfg in self.best_config.items()
+            )
+            save_game_model(
+                best.best_model, dataset,
+                os.path.join(p.output_dir, "best-model"), model_spec=spec,
+            )
+        with open(os.path.join(p.output_dir, "metrics.json"), "w") as f:
+            json.dump(
+                {
+                    "objective_history": best.objective_history,
+                    "validation_history": best.validation_history,
+                    "best_metric": best.best_metric,
+                    "timers": self.timer.durations,
+                },
+                f,
+                indent=2,
+            )
+        self.logger.info("timers:\n%s", self.timer.summary())
+
+
+# ---------------------------------------------------------------------------
+# CLI (option names from cli/game/training/Params.scala)
+# ---------------------------------------------------------------------------
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="photon-ml-tpu game-training")
+    ap.add_argument("--train-input-dirs", required=True)
+    ap.add_argument("--output-dir", required=True)
+    ap.add_argument("--validate-input-dirs", default=None)
+    ap.add_argument("--task-type", default="LOGISTIC_REGRESSION")
+    ap.add_argument("--feature-shard-id-to-feature-section-keys-map", required=True)
+    ap.add_argument("--fixed-effect-data-configurations", default="")
+    ap.add_argument("--fixed-effect-optimization-configurations", default="")
+    ap.add_argument("--random-effect-data-configurations", default="")
+    ap.add_argument("--random-effect-optimization-configurations", default="")
+    ap.add_argument("--factored-random-effect-optimization-configurations", default="")
+    ap.add_argument("--updating-sequence", default=None)
+    ap.add_argument("--num-iterations", type=int, default=1)
+    ap.add_argument("--evaluator-types", default=None)
+    ap.add_argument("--compute-variance", default="false")
+    ap.add_argument("--delete-output-dir-if-exists", default="false")
+    return ap
+
+
+def params_from_args(argv=None) -> GameTrainingParams:
+    ns = build_arg_parser().parse_args(argv)
+
+    def _bool(s):
+        return str(s).lower() in ("true", "1", "yes")
+
+    fe_data = {
+        k: FixedEffectDataConfiguration.parse(v)
+        for k, v in parse_keyed_map(ns.fixed_effect_data_configurations).items()
+    }
+    re_data = {
+        k: RandomEffectDataConfiguration.parse(v)
+        for k, v in parse_keyed_map(ns.random_effect_data_configurations).items()
+    }
+    factored = {}
+    for k, v in parse_keyed_map(
+        ns.factored_random_effect_optimization_configurations
+    ).items():
+        # format: latentDim,numInnerIterations
+        parts = [x.strip() for x in v.split(",")]
+        factored[k] = FactoredRandomEffectConfiguration(
+            latent_space_dimension=int(parts[0]),
+            num_inner_iterations=int(parts[1]) if len(parts) > 1 else 2,
+        )
+    return GameTrainingParams(
+        train_input_dirs=ns.train_input_dirs.split(","),
+        validate_input_dirs=(
+            ns.validate_input_dirs.split(",") if ns.validate_input_dirs else None
+        ),
+        output_dir=ns.output_dir,
+        task_type=TaskType.parse(ns.task_type),
+        feature_shards=parse_shard_map(
+            ns.feature_shard_id_to_feature_section_keys_map
+        ),
+        fixed_effect_data_configs=fe_data,
+        fixed_effect_opt_configs=parse_keyed_map(
+            ns.fixed_effect_optimization_configurations
+        ),
+        random_effect_data_configs=re_data,
+        random_effect_opt_configs=parse_keyed_map(
+            ns.random_effect_optimization_configurations
+        ),
+        factored_re_configs=factored,
+        updating_sequence=(
+            ns.updating_sequence.split(",") if ns.updating_sequence else None
+        ),
+        num_iterations=ns.num_iterations,
+        evaluator_types=(
+            [EvaluatorType.parse(s) for s in ns.evaluator_types.split(",")]
+            if ns.evaluator_types
+            else []
+        ),
+        compute_variance=_bool(ns.compute_variance),
+        delete_output_dir_if_exists=_bool(ns.delete_output_dir_if_exists),
+    )
+
+
+def main(argv=None) -> None:
+    GameTrainingDriver(params_from_args(argv)).run()
+
+
+if __name__ == "__main__":
+    main()

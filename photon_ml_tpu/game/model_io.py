@@ -1,0 +1,295 @@
+"""GAME model persistence with reference directory-layout parity.
+
+Reference: photon-ml .../avro/model/ModelProcessingUtils.scala:44-189 and
+avro/Constants.scala:22-25 —
+
+    <dir>/fixed-effect/<coordinate>/id-info            (feature shard id)
+    <dir>/fixed-effect/<coordinate>/coefficients/part-00000.avro
+    <dir>/random-effect/<coordinate>/id-info           (reType, shardId)
+    <dir>/random-effect/<coordinate>/coefficients/part-00000.avro
+    <dir>/matrix-factorization/<coordinate>/{row,col}-latent/part-00000.avro
+    <dir>/model-spec                                   (human-readable)
+
+Fixed-effect coefficients: ONE BayesianLinearModelAvro (modelId
+"fixed-effect"); random-effect: one record PER ENTITY (modelId = raw
+entity id); MF latent factors as LatentFactorAvro. Files written by the
+reference load here and vice versa (same schemas + layout).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from photon_ml_tpu.game.data import GameDataset
+from photon_ml_tpu.game.model import (
+    FixedEffectModel,
+    GameModel,
+    MatrixFactorizationModel,
+    RandomEffectModel,
+)
+from photon_ml_tpu.game.coordinate import FactoredRandomEffectModel
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro_codec import read_avro_records, write_container
+from photon_ml_tpu.io.model_io import (
+    bayesian_avro_to_model,
+    model_to_bayesian_avro,
+)
+from photon_ml_tpu.models.coefficients import Coefficients
+from photon_ml_tpu.models.glm import create_model
+from photon_ml_tpu.task import TaskType
+from photon_ml_tpu.utils.index_map import IndexMap, split_feature_key
+
+FIXED_EFFECT = "fixed-effect"
+RANDOM_EFFECT = "random-effect"
+MATRIX_FACTORIZATION = "matrix-factorization"
+ID_INFO = "id-info"
+COEFFICIENTS = "coefficients"
+
+
+def _write_lines(path: str, lines: List[str]) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def save_game_model(
+    model: GameModel,
+    dataset: GameDataset,
+    out_dir: str,
+    *,
+    model_spec: Optional[str] = None,
+) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    if model_spec:
+        with open(os.path.join(out_dir, "model-spec"), "w") as f:
+            f.write(model_spec)
+    for name, sub in model.models.items():
+        if isinstance(sub, FixedEffectModel):
+            base = os.path.join(out_dir, FIXED_EFFECT, name)
+            _write_lines(os.path.join(base, ID_INFO), [sub.feature_shard_id])
+            imap = dataset.shards[sub.feature_shard_id].index_map
+            rec = model_to_bayesian_avro(sub.model, FIXED_EFFECT, imap)
+            write_container(
+                os.path.join(base, COEFFICIENTS, "part-00000.avro"),
+                schemas.BAYESIAN_LINEAR_MODEL_AVRO,
+                [rec],
+            )
+        elif isinstance(sub, RandomEffectModel):
+            base = os.path.join(out_dir, RANDOM_EFFECT, name)
+            _write_lines(
+                os.path.join(base, ID_INFO),
+                [sub.random_effect_type, sub.feature_shard_id],
+            )
+            imap = dataset.shards[sub.feature_shard_id].index_map
+            eindex = dataset.entity_indexes[sub.random_effect_type]
+            bank = np.asarray(sub.bank)
+            projection = sub.re_dataset.projection
+            records = []
+            for e in range(sub.re_dataset.num_entities):
+                means = []
+                for local, g in enumerate(projection[e]):
+                    if g < 0:
+                        continue
+                    v = float(bank[e, local])
+                    if v == 0.0:
+                        continue
+                    key = imap.get_feature_name(int(g))
+                    if key is None:
+                        continue
+                    nm, term = split_feature_key(key)
+                    means.append({"name": nm, "term": term, "value": v})
+                records.append({
+                    "modelId": eindex.ids[e],
+                    "modelClass": None,
+                    "means": means,
+                    "variances": None,
+                    "lossFunction": None,
+                })
+            write_container(
+                os.path.join(base, COEFFICIENTS, "part-00000.avro"),
+                schemas.BAYESIAN_LINEAR_MODEL_AVRO,
+                records,
+            )
+        elif isinstance(sub, MatrixFactorizationModel):
+            base = os.path.join(out_dir, MATRIX_FACTORIZATION, name)
+            _write_lines(
+                os.path.join(base, ID_INFO),
+                [sub.row_effect_type, sub.col_effect_type],
+            )
+            for side, latent, id_type in (
+                ("row-latent", sub.row_latent, sub.row_effect_type),
+                ("col-latent", sub.col_latent, sub.col_effect_type),
+            ):
+                eindex = dataset.entity_indexes[id_type]
+                arr = np.asarray(latent)
+                records = [
+                    {
+                        "effectId": eindex.ids[e],
+                        "latentFactor": [float(x) for x in arr[e]],
+                    }
+                    for e in range(arr.shape[0])
+                ]
+                write_container(
+                    os.path.join(base, side, "part-00000.avro"),
+                    schemas.LATENT_FACTOR_AVRO,
+                    records,
+                )
+        elif isinstance(sub, FactoredRandomEffectModel):
+            # Persist as a plain random-effect model in the ORIGINAL space:
+            # bank_global = bank_latent @ projection^T per entity.
+            base = os.path.join(out_dir, RANDOM_EFFECT, name)
+            _write_lines(
+                os.path.join(base, ID_INFO),
+                [sub.random_effect_type, sub.feature_shard_id],
+            )
+            imap = dataset.shards[sub.feature_shard_id].index_map
+            eindex = dataset.entity_indexes[sub.random_effect_type]
+            bank_g = np.asarray(sub.bank @ sub.projection.T)  # [E, d_local]
+            projection = sub.re_dataset.projection
+            records = []
+            for e in range(bank_g.shape[0]):
+                means = []
+                for local, g in enumerate(projection[e]):
+                    if g < 0 or local >= bank_g.shape[1]:
+                        continue
+                    v = float(bank_g[e, local])
+                    if v == 0.0:
+                        continue
+                    key = imap.get_feature_name(int(g))
+                    if key is None:
+                        continue
+                    nm, term = split_feature_key(key)
+                    means.append({"name": nm, "term": term, "value": v})
+                records.append({
+                    "modelId": eindex.ids[e], "modelClass": None,
+                    "means": means, "variances": None, "lossFunction": None,
+                })
+            write_container(
+                os.path.join(base, COEFFICIENTS, "part-00000.avro"),
+                schemas.BAYESIAN_LINEAR_MODEL_AVRO,
+                records,
+            )
+        else:
+            raise ValueError(f"cannot save model type {type(sub)} for {name}")
+
+
+class LoadedGameModel:
+    """Host-side loaded GAME model, scorable against any GameDataset built
+    with compatible shard index maps (loadGameModelFromHDFS analog)."""
+
+    def __init__(self):
+        self.fixed_effects: Dict[str, Tuple[str, "np.ndarray"]] = {}
+        self.random_effects: Dict[str, Tuple[str, str, Dict[str, Dict[str, float]]]] = {}
+        self.matrix_factorizations: Dict[str, Tuple[str, str, Dict[str, np.ndarray], Dict[str, np.ndarray]]] = {}
+
+    def coordinate_names(self) -> List[str]:
+        return (
+            list(self.fixed_effects)
+            + list(self.random_effects)
+            + list(self.matrix_factorizations)
+        )
+
+    def score(self, dataset: GameDataset, task: TaskType) -> jnp.ndarray:
+        total = jnp.zeros((dataset.num_rows,), jnp.float32)
+        for name, (shard_id, means) in self.fixed_effects.items():
+            imap = dataset.shards[shard_id].index_map
+            w = np.zeros((imap.size,), np.float32)
+            for key, v in means.items():
+                i = imap.get_index(key)
+                if i >= 0:
+                    w[i] = v
+            glm = create_model(task, Coefficients(jnp.asarray(w)))
+            total = total + glm.score(dataset.batch_for_shard(shard_id))
+        for name, (re_type, shard_id, per_entity) in self.random_effects.items():
+            imap = dataset.shards[shard_id].index_map
+            eindex = dataset.entity_indexes[re_type]
+            bank = np.zeros((eindex.num_entities, imap.size), np.float32)
+            for raw_id, means in per_entity.items():
+                code = eindex.code_of.get(raw_id)
+                if code is None:
+                    continue  # entity unseen in the scoring data
+                for key, v in means.items():
+                    i = imap.get_index(key)
+                    if i >= 0:
+                        bank[code, i] = v
+            codes = dataset.entity_codes[re_type]
+            valid = jnp.asarray(codes >= 0)
+            w_rows = jnp.take(
+                jnp.asarray(bank), jnp.maximum(jnp.asarray(codes), 0), axis=0
+            )
+            sd = dataset.shards[shard_id]
+            score = jnp.sum(
+                jnp.asarray(sd.values)
+                * jnp.take_along_axis(w_rows, jnp.asarray(sd.indices), axis=1),
+                axis=-1,
+            )
+            total = total + jnp.where(valid, score, 0.0)
+        for name, (row_t, col_t, rows, cols) in self.matrix_factorizations.items():
+            r_index = dataset.entity_indexes[row_t]
+            c_index = dataset.entity_indexes[col_t]
+            K = len(next(iter(rows.values())))
+            R = np.zeros((r_index.num_entities, K), np.float32)
+            C = np.zeros((c_index.num_entities, K), np.float32)
+            for rid, vec in rows.items():
+                code = r_index.code_of.get(rid)
+                if code is not None:
+                    R[code] = vec
+            for cid, vec in cols.items():
+                code = c_index.code_of.get(cid)
+                if code is not None:
+                    C[code] = vec
+            mf = MatrixFactorizationModel(
+                row_t, col_t, jnp.asarray(R), jnp.asarray(C)
+            )
+            total = total + mf.score(dataset)
+        return total
+
+
+def load_game_model(model_dir: str) -> LoadedGameModel:
+    out = LoadedGameModel()
+    fe_dir = os.path.join(model_dir, FIXED_EFFECT)
+    if os.path.isdir(fe_dir):
+        for name in sorted(os.listdir(fe_dir)):
+            base = os.path.join(fe_dir, name)
+            with open(os.path.join(base, ID_INFO)) as f:
+                shard_id = f.read().split()[0]
+            recs = list(read_avro_records(os.path.join(base, COEFFICIENTS)))
+            means = {
+                f"{m['name']}\t{m['term']}": m["value"]
+                for m in recs[0]["means"]
+            }
+            out.fixed_effects[name] = (shard_id, means)
+    re_dir = os.path.join(model_dir, RANDOM_EFFECT)
+    if os.path.isdir(re_dir):
+        for name in sorted(os.listdir(re_dir)):
+            base = os.path.join(re_dir, name)
+            with open(os.path.join(base, ID_INFO)) as f:
+                parts = f.read().split()
+            re_type, shard_id = parts[0], parts[1] if len(parts) > 1 else parts[0]
+            per_entity: Dict[str, Dict[str, float]] = {}
+            for rec in read_avro_records(os.path.join(base, COEFFICIENTS)):
+                per_entity[rec["modelId"]] = {
+                    f"{m['name']}\t{m['term']}": m["value"]
+                    for m in rec["means"]
+                }
+            out.random_effects[name] = (re_type, shard_id, per_entity)
+    mf_dir = os.path.join(model_dir, MATRIX_FACTORIZATION)
+    if os.path.isdir(mf_dir):
+        for name in sorted(os.listdir(mf_dir)):
+            base = os.path.join(mf_dir, name)
+            with open(os.path.join(base, ID_INFO)) as f:
+                row_t, col_t = f.read().split()[:2]
+            rows = {
+                r["effectId"]: np.asarray(r["latentFactor"], np.float32)
+                for r in read_avro_records(os.path.join(base, "row-latent"))
+            }
+            cols = {
+                r["effectId"]: np.asarray(r["latentFactor"], np.float32)
+                for r in read_avro_records(os.path.join(base, "col-latent"))
+            }
+            out.matrix_factorizations[name] = (row_t, col_t, rows, cols)
+    return out

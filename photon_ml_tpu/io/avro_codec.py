@@ -350,6 +350,9 @@ def write_container(
         raise ValueError(f"unsupported codec: {codec}")
     sync = os.urandom(SYNC_SIZE)
     count_total = 0
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
     with open(path, "wb") as f:
         f.write(MAGIC)
         meta_enc = BinaryEncoder(f)

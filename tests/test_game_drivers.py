@@ -1,0 +1,226 @@
+"""GAME driver end-to-end tests (cli/game DriverTest analog): train on
+synthetic Avro, save with reference layout, reload, batch-score, evaluate;
+interop run on the reference's yahoo-music fixture.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.cli.game_scoring_driver import (
+    GameScoringDriver,
+    GameScoringParams,
+)
+from photon_ml_tpu.cli.game_training_driver import (
+    GameTrainingDriver,
+    GameTrainingParams,
+    expand_config_grid,
+    parse_keyed_map,
+    parse_shard_map,
+)
+from photon_ml_tpu.evaluation import EvaluatorType
+from photon_ml_tpu.game.config import (
+    FeatureShardConfiguration,
+    FixedEffectDataConfiguration,
+    RandomEffectDataConfiguration,
+)
+from photon_ml_tpu.game.model_io import load_game_model
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro_codec import read_avro_records, write_container
+from photon_ml_tpu.task import TaskType
+
+GAME_REF = "/root/reference/photon-ml/src/integTest/resources/GameIntegTest"
+
+
+def write_game_avro(path, rng, n=240, n_users=8, d_g=5, d_u=3, seed_shift=0):
+    w_g = np.linspace(-1, 1, d_g)
+    w_u = np.random.default_rng(7).normal(size=(n_users, d_u))
+    recs = []
+    for i in range(n):
+        u = int(rng.integers(0, n_users))
+        xg = rng.normal(size=d_g)
+        xu = rng.normal(size=d_u)
+        z = float(xg @ w_g + xu @ w_u[u])
+        recs.append({
+            "uid": f"s{seed_shift}-{i}",
+            "response": float(1 / (1 + np.exp(-z)) > rng.uniform()),
+            "metadataMap": {"userId": f"user{u}"},
+            "features": [
+                {"name": f"g{j}", "term": "", "value": float(xg[j])}
+                for j in range(d_g)
+            ],
+            "userFeatures": [
+                {"name": f"u{j}", "term": "", "value": float(xu[j])}
+                for j in range(d_u)
+            ],
+        })
+    schema = dict(schemas.TRAINING_EXAMPLE_AVRO)
+    schema = {
+        "name": "GameExample", "type": "record",
+        "fields": [
+            {"name": "uid", "type": ["null", "string"], "default": None},
+            {"name": "response", "type": "double"},
+            {"name": "metadataMap", "type": ["null", {"type": "map", "values": "string"}], "default": None},
+            {"name": "features", "type": {"type": "array", "items": schemas.FEATURE_AVRO}},
+            {"name": "userFeatures", "type": {"type": "array", "items": "FeatureAvro"}},
+        ],
+    }
+    write_container(path, schema, recs)
+
+
+class TestConfigParsing:
+    def test_keyed_map(self):
+        m = parse_keyed_map("a:1,2|b:3,4")
+        assert m == {"a": "1,2", "b": "3,4"}
+
+    def test_shard_map(self):
+        shards = parse_shard_map("global:features|user:userFeatures,extra")
+        assert shards[0].shard_id == "global"
+        assert list(shards[1].feature_bags) == ["userFeatures", "extra"]
+
+    def test_grid_expansion(self):
+        combos = expand_config_grid({
+            "a": "10,1e-4,1.0,1,LBFGS,L2;10,1e-4,10.0,1,LBFGS,L2",
+            "b": "5,1e-4,0.5,1,LBFGS,L2",
+        })
+        assert len(combos) == 2
+        assert {c["a"].reg_weight for c in combos} == {1.0, 10.0}
+
+
+class TestGameTrainingEndToEnd:
+    def _params(self, tmp_path, rng, **kw):
+        train = tmp_path / "train"; train.mkdir()
+        val = tmp_path / "val"; val.mkdir()
+        write_game_avro(str(train / "p0.avro"), rng)
+        write_game_avro(str(val / "p0.avro"), rng, n=120, seed_shift=1)
+        base = dict(
+            train_input_dirs=[str(train)],
+            validate_input_dirs=[str(val)],
+            output_dir=str(tmp_path / "out"),
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            feature_shards=[
+                FeatureShardConfiguration("globalShard", ["features"]),
+                FeatureShardConfiguration("userShard", ["userFeatures"]),
+            ],
+            fixed_effect_data_configs={
+                "global": FixedEffectDataConfiguration("globalShard")
+            },
+            fixed_effect_opt_configs={"global": "30,1e-6,0.1,1,LBFGS,L2"},
+            random_effect_data_configs={
+                "per-user": RandomEffectDataConfiguration("userId", "userShard")
+            },
+            random_effect_opt_configs={"per-user": "30,1e-6,1.0,1,LBFGS,L2"},
+            num_iterations=2,
+            evaluator_types=[EvaluatorType.parse("AUC")],
+        )
+        base.update(kw)
+        return GameTrainingParams(**base)
+
+    def test_train_save_load_score(self, tmp_path, rng):
+        params = self._params(tmp_path, rng)
+        driver = GameTrainingDriver(params)
+        driver.run()
+        out = params.output_dir
+        # objective decreased across CD iterations
+        metrics = json.load(open(os.path.join(out, "metrics.json")))
+        assert len(metrics["objective_history"]) == 2
+        assert metrics["objective_history"][-1] <= metrics["objective_history"][0]
+        assert metrics["validation_history"][-1]["AUC"] > 0.6
+        # reference layout on disk
+        model_dir = os.path.join(out, "best-model")
+        assert os.path.isfile(
+            os.path.join(model_dir, "fixed-effect", "global", "id-info")
+        )
+        assert os.path.isfile(
+            os.path.join(model_dir, "random-effect", "per-user", "coefficients",
+                         "part-00000.avro")
+        )
+        assert os.path.isfile(os.path.join(model_dir, "model-spec"))
+
+        # scoring driver round-trip on the validation data
+        sp = GameScoringParams(
+            input_dirs=params.validate_input_dirs,
+            game_model_input_dir=model_dir,
+            output_dir=str(tmp_path / "scores"),
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            feature_shards=params.feature_shards,
+            evaluator_types=[EvaluatorType.parse("AUC")],
+        )
+        sd = GameScoringDriver(sp)
+        sd.run()
+        assert sd.metrics["AUC"] > 0.6
+        score_recs = list(read_avro_records(str(tmp_path / "scores" / "scores")))
+        assert len(score_recs) == 120
+        assert all(np.isfinite(r["predictionScore"]) for r in score_recs)
+        # scoring metrics match training-side validation metric
+        assert sd.metrics["AUC"] == pytest.approx(
+            metrics["validation_history"][-1]["AUC"], abs=0.05
+        )
+
+    def test_grid_picks_best(self, tmp_path, rng):
+        params = self._params(
+            tmp_path, rng,
+            fixed_effect_opt_configs={
+                "global": "30,1e-6,0.1,1,LBFGS,L2;30,1e-6,1000.0,1,LBFGS,L2"
+            },
+            num_iterations=1,
+        )
+        driver = GameTrainingDriver(params)
+        driver.run()
+        assert len(driver.results) == 2
+        assert driver.best_config["global"].reg_weight == 0.1
+
+    def test_missing_opt_config_rejected(self, tmp_path, rng):
+        with pytest.raises(ValueError, match="missing optimization"):
+            self._params(tmp_path, rng, fixed_effect_opt_configs={}).validate()
+
+
+@pytest.mark.skipif(
+    not os.path.isdir(GAME_REF), reason="reference fixtures unavailable"
+)
+class TestYahooMusicInterop:
+    def test_train_on_reference_fixture(self, tmp_path):
+        """GLMix (global + per-user + per-song) on the reference's
+        yahoo-music fixture — linear regression on ratings."""
+        # the fixture ships only a test split; train and validate on it
+        # (interop check, not a generalization claim)
+        params = GameTrainingParams(
+            train_input_dirs=[os.path.join(GAME_REF, "input", "test")],
+            validate_input_dirs=[os.path.join(GAME_REF, "input", "test")],
+            output_dir=str(tmp_path / "out"),
+            task_type=TaskType.LINEAR_REGRESSION,
+            feature_shards=[
+                FeatureShardConfiguration("globalShard", ["features"]),
+                FeatureShardConfiguration("userShard", ["userFeatures"]),
+                FeatureShardConfiguration("songShard", ["songFeatures"]),
+            ],
+            fixed_effect_data_configs={
+                "global": FixedEffectDataConfiguration("globalShard")
+            },
+            fixed_effect_opt_configs={"global": "20,1e-5,10.0,1,LBFGS,L2"},
+            random_effect_data_configs={
+                "per-user": RandomEffectDataConfiguration("userId", "userShard"),
+                "per-song": RandomEffectDataConfiguration("songId", "songShard"),
+            },
+            random_effect_opt_configs={
+                "per-user": "10,1e-5,1.0,1,LBFGS,L2",
+                "per-song": "10,1e-5,10.0,1,LBFGS,L2",
+            },
+            num_iterations=2,
+            evaluator_types=[EvaluatorType.parse("RMSE")],
+        )
+        driver = GameTrainingDriver(params)
+        driver.run()
+        metrics = json.load(
+            open(os.path.join(params.output_dir, "metrics.json"))
+        )
+        # mixed model must improve training objective monotonically and
+        # beat the label-variance RMSE baseline on validation
+        hist = metrics["objective_history"]
+        assert hist[-1] <= hist[0]
+        rmse = metrics["validation_history"][-1]["RMSE"]
+        assert rmse < 1.4, metrics["validation_history"]
