@@ -1,0 +1,45 @@
+"""Model diagnostics + HTML reporting."""
+
+from photon_ml_tpu.diagnostics.diagnostics import (
+    BootstrapReport,
+    FeatureImportanceReport,
+    FittingReport,
+    HosmerLemeshowReport,
+    KendallTauReport,
+    bootstrap_training_diagnostic,
+    feature_importance_diagnostic,
+    fitting_diagnostic,
+    hosmer_lemeshow_diagnostic,
+    kendall_tau_diagnostic,
+)
+from photon_ml_tpu.diagnostics.reporting import (
+    Chapter,
+    Document,
+    LinePlot,
+    Section,
+    Table,
+    Text,
+    render_html,
+    write_html_report,
+)
+
+__all__ = [
+    "BootstrapReport",
+    "FeatureImportanceReport",
+    "FittingReport",
+    "HosmerLemeshowReport",
+    "KendallTauReport",
+    "bootstrap_training_diagnostic",
+    "feature_importance_diagnostic",
+    "fitting_diagnostic",
+    "hosmer_lemeshow_diagnostic",
+    "kendall_tau_diagnostic",
+    "Chapter",
+    "Document",
+    "LinePlot",
+    "Section",
+    "Table",
+    "Text",
+    "render_html",
+    "write_html_report",
+]

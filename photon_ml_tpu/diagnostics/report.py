@@ -1,0 +1,199 @@
+"""Driver-side diagnostics orchestration + HTML report.
+
+Reference: photon-ml Driver.scala:525-552 (diagnose stage: per-model
+diagnostics over the lambda grid) and :618-638 (model-diagnostic HTML
+report written to <output>/model-diagnostics).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import numpy as np
+
+from photon_ml_tpu.diagnostics.diagnostics import (
+    bootstrap_training_diagnostic,
+    feature_importance_diagnostic,
+    fitting_diagnostic,
+    hosmer_lemeshow_diagnostic,
+    kendall_tau_diagnostic,
+)
+from photon_ml_tpu.diagnostics.reporting import (
+    Chapter,
+    Document,
+    LinePlot,
+    Section,
+    Table,
+    Text,
+    write_html_report,
+)
+from photon_ml_tpu.task import TaskType
+
+
+def run_glm_diagnostics(driver) -> None:
+    """Diagnose the trained lambda grid and write the HTML report.
+    ``driver`` is a GLMDriver after train() (and validate(), if a
+    validation dir was configured)."""
+    from photon_ml_tpu.cli.glm_driver import DiagnosticMode
+
+    p = driver.params
+    data = driver._data
+    summary = driver._summary
+    batch = data.batch
+    vdata = getattr(driver, "_validation_data", None)
+    doc = Document(title=f"Photon ML TPU diagnostics — {p.job_name}")
+
+    # -- per-lambda model diagnostics -------------------------------------
+    for lam, model in driver.models.items():
+        chapter = Chapter(title=f"Model lambda={lam}")
+
+        imp = feature_importance_diagnostic(
+            model,
+            np.asarray(summary.mean),
+            np.asarray(summary.variance),
+        )
+        def feature_name(i: int) -> str:
+            key = data.index_map.get_feature_name(i)
+            return key.replace("\t", " / ") if key else str(i)
+        chapter.sections.append(
+            Section(
+                "Feature importance",
+                [
+                    Table(
+                        ["feature", "|w * E[x]|"],
+                        [[feature_name(i), f"{v:.5g}"] for i, v in imp.expected_magnitude[:10]],
+                        caption="expected-magnitude importance",
+                    ),
+                    Table(
+                        ["feature", "|w| * sd(x)"],
+                        [[feature_name(i), f"{v:.5g}"] for i, v in imp.variance_magnitude[:10]],
+                        caption="variance importance",
+                    ),
+                ],
+            )
+        )
+
+        eval_batch = vdata.batch if vdata is not None else batch
+        if p.task == TaskType.LOGISTIC_REGRESSION:
+            hl = hosmer_lemeshow_diagnostic(model, eval_batch)
+            chapter.sections.append(
+                Section(
+                    "Hosmer-Lemeshow calibration",
+                    [
+                        Text(
+                            f"chi^2 = {hl.chi_square:.4g} with "
+                            f"{hl.degrees_of_freedom} dof, p = {hl.p_value:.4g}"
+                        ),
+                        Table(
+                            ["bin count", "observed+", "expected+", "mean p"],
+                            [
+                                [f"{b['count']:.0f}", f"{b['observed_pos']:.1f}",
+                                 f"{b['expected_pos']:.1f}", f"{b['mean_prob']:.3f}"]
+                                for b in hl.bins
+                            ],
+                        ),
+                        LinePlot(
+                            x=[b["mean_prob"] for b in hl.bins],
+                            series=[
+                                ("observed rate",
+                                 [b["observed_pos"] / max(b["count"], 1e-9) for b in hl.bins]),
+                                ("expected rate",
+                                 [b["expected_pos"] / max(b["count"], 1e-9) for b in hl.bins]),
+                            ],
+                            title="calibration", x_label="predicted", y_label="rate",
+                        ),
+                    ],
+                )
+            )
+
+        kt = kendall_tau_diagnostic(model, eval_batch)
+        chapter.sections.append(
+            Section(
+                "Prediction-error independence (Kendall tau)",
+                [Text(f"tau = {kt.tau:.4g}, p = {kt.p_value:.4g}: {kt.message}")],
+            )
+        )
+        doc.chapters.append(chapter)
+
+    # -- bootstrap + fitting on the selected model ------------------------
+    best_lambda = driver.best_lambda if driver.best_lambda is not None else (
+        sorted(driver.models)[0]
+    )
+
+    def train_fn(b):
+        from photon_ml_tpu.training import train_generalized_linear_model
+
+        models, _ = train_generalized_linear_model(
+            b, p.task, data.num_features,
+            optimizer_type=p.optimizer_type,
+            regularization_type=p.regularization_type,
+            regularization_weights=[best_lambda],
+            elastic_net_alpha=p.elastic_net_alpha,
+            max_iter=p.max_num_iterations,
+            tolerance=p.tolerance,
+            normalization=driver._norm,
+            intercept_index=data.intercept_index,
+        )
+        return models[best_lambda]
+
+    def metrics_fn(model, b=None):
+        return driver._metrics_for(model, b if b is not None else batch)
+
+    boot = bootstrap_training_diagnostic(
+        batch, train_fn, lambda m: metrics_fn(m), num_samples=5
+    )
+    boot_chapter = Chapter("Bootstrap analysis")
+    boot_chapter.sections.append(
+        Section(
+            f"Bootstrap ({boot.num_samples} resamples, lambda={best_lambda})",
+            [
+                Table(
+                    ["metric", "mean", "std"],
+                    [[k, f"{m:.5g}", f"{s:.3g}"]
+                     for k, (m, s) in boot.metrics_distribution.items()],
+                ),
+                Table(
+                    ["feature", "coef mean", "coef std"],
+                    [[data.index_map.get_feature_name(i) or str(i),
+                      f"{m:.5g}", f"{s:.3g}"]
+                     for i, m, s in boot.important_features],
+                    caption="top coefficients across bootstrap replicates",
+                ),
+            ],
+        )
+    )
+    doc.chapters.append(boot_chapter)
+
+    if vdata is not None:
+        fit = fitting_diagnostic(
+            batch, vdata.batch, train_fn, lambda m, b: metrics_fn(m, b),
+            num_portions=5,
+        )
+        metric0 = next(iter(fit.train_metrics))
+        doc.chapters.append(
+            Chapter(
+                "Fitting analysis",
+                [
+                    Section(
+                        "Learning curves",
+                        [
+                            Text(fit.message),
+                            LinePlot(
+                                x=fit.portions,
+                                series=[
+                                    (f"train {metric0}", fit.train_metrics[metric0]),
+                                    (f"test {metric0}", fit.test_metrics[metric0]),
+                                ],
+                                title=f"{metric0} vs training portion",
+                                x_label="portion", y_label=metric0,
+                            ),
+                        ],
+                    )
+                ],
+            )
+        )
+
+    out = os.path.join(p.output_dir, "model-diagnostics", "report.html")
+    write_html_report(doc, out)
+    driver.logger.info("diagnostics report written to %s", out)

@@ -54,6 +54,7 @@ class CoordinateDescent:
         validation_metric: Optional[str] = None,
         validation_maximize: bool = True,
         logger: Optional[PhotonLogger] = None,
+        checkpointer=None,  # photon_ml_tpu.utils.checkpoint.TrainingCheckpointer
     ):
         self.coordinates = coordinates
         self.dataset = dataset
@@ -66,6 +67,7 @@ class CoordinateDescent:
         self.validation_metric = validation_metric
         self.validation_maximize = validation_maximize
         self.logger = logger or PhotonLogger()
+        self.checkpointer = checkpointer
 
     def _objective(self, total_score: Array, models: Dict[str, object]) -> float:
         """loss(sum of scores + offsets) + sum of reg terms
@@ -93,7 +95,18 @@ class CoordinateDescent:
                 models[name] = initial_model.get_model(name)
             else:
                 models[name] = coord.initialize_model()
-            scores[name] = coord.score(models[name])
+
+        start_iteration = 0
+        if self.checkpointer is not None:
+            latest = self.checkpointer.latest_step()
+            if latest is not None:
+                models = self.checkpointer.restore(latest, models)
+                start_iteration = latest
+                self.logger.info(
+                    "resumed coordinate descent from checkpoint step %d", latest
+                )
+        for name in seq:
+            scores[name] = self.coordinates[name].score(models[name])
 
         objective_history: List[float] = []
         trackers: Dict[str, List[object]] = {name: [] for name in seq}
@@ -101,7 +114,7 @@ class CoordinateDescent:
         best_model = None
         best_metric = None
 
-        for it in range(num_iterations):
+        for it in range(start_iteration, num_iterations):
             for name in seq:
                 coord = self.coordinates[name]
                 residual = None
@@ -122,6 +135,8 @@ class CoordinateDescent:
             self.logger.info(
                 "coordinate descent iter %d: objective=%g", it + 1, objective
             )
+            if self.checkpointer is not None:
+                self.checkpointer.save(it + 1, models)
 
             if self.validation_fn is not None:
                 game_model = GameModel(

@@ -1,0 +1,257 @@
+"""Diagnostics tests: bootstrap CIs, Hosmer-Lemeshow on calibrated vs
+miscalibrated models, Kendall tau, importance, fitting curves, HTML
+report rendering, driver DIAGNOSED stage, checkpoint/resume, events.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.batch import make_dense_batch
+from photon_ml_tpu.diagnostics import (
+    Document,
+    Chapter,
+    Section,
+    Table,
+    Text,
+    LinePlot,
+    bootstrap_training_diagnostic,
+    feature_importance_diagnostic,
+    fitting_diagnostic,
+    hosmer_lemeshow_diagnostic,
+    kendall_tau_diagnostic,
+    render_html,
+)
+from photon_ml_tpu.events import (
+    EventEmitter,
+    EventListener,
+    PhotonOptimizationLogEvent,
+    TrainingStartEvent,
+)
+from photon_ml_tpu.models import Coefficients, logistic_regression_model
+from photon_ml_tpu.optim.problem import create_glm_problem
+from photon_ml_tpu.task import TaskType
+
+
+def logistic_batch(rng, n=400, d=5, w=None):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    x[:, -1] = 1.0
+    if w is None:
+        w = rng.normal(size=d).astype(np.float32)
+    y = (1 / (1 + np.exp(-x @ w)) > rng.uniform(size=n)).astype(np.float32)
+    return make_dense_batch(x, y), w
+
+
+def fit(batch, d=5):
+    problem = create_glm_problem(TaskType.LOGISTIC_REGRESSION, d)
+    coefficients, _ = problem.run(batch, reg_weight=1e-3)
+    return problem.create_model(coefficients)
+
+
+class TestHosmerLemeshow:
+    def test_calibrated_model_passes(self, rng):
+        batch, _ = logistic_batch(rng, n=2000)
+        model = fit(batch)
+        hl = hosmer_lemeshow_diagnostic(model, batch)
+        assert hl.degrees_of_freedom == 8
+        assert hl.p_value > 0.01, (hl.chi_square, hl.p_value)
+
+    def test_miscalibrated_model_fails(self, rng):
+        batch, w = logistic_batch(rng, n=2000)
+        bad = logistic_regression_model(
+            Coefficients(jnp.asarray(3.0 * np.asarray(w)))
+        )
+        hl = hosmer_lemeshow_diagnostic(bad, batch)
+        assert hl.p_value < 0.01
+
+    def test_rejects_regression(self, rng):
+        batch, _ = logistic_batch(rng)
+        from photon_ml_tpu.models import linear_regression_model
+
+        bad = linear_regression_model(Coefficients(jnp.zeros(5)))
+        with pytest.raises(ValueError):
+            hosmer_lemeshow_diagnostic(bad, batch)
+
+
+class TestKendallTau:
+    def test_well_specified(self, rng):
+        batch, _ = logistic_batch(rng, n=800)
+        model = fit(batch)
+        kt = kendall_tau_diagnostic(model, batch)
+        assert np.isfinite(kt.tau)
+
+
+class TestImportance:
+    def test_orders_by_magnitude(self):
+        model = logistic_regression_model(
+            Coefficients(jnp.asarray([0.1, -5.0, 1.0]))
+        )
+        rep = feature_importance_diagnostic(
+            model, np.array([1.0, 1.0, 1.0]), np.array([1.0, 1.0, 1.0])
+        )
+        assert rep.expected_magnitude[0][0] == 1
+        assert rep.variance_magnitude[0][0] == 1
+
+
+class TestBootstrap:
+    def test_intervals_cover_estimate(self, rng):
+        batch, _ = logistic_batch(rng, n=600)
+        model = fit(batch)
+        rep = bootstrap_training_diagnostic(
+            batch, fit, lambda m: {"norm": float(jnp.linalg.norm(m.means))},
+            num_samples=5,
+        )
+        assert rep.coefficient_intervals.shape == (5, 4)
+        mean, std, lo, hi = rep.coefficient_intervals.T
+        assert np.all(lo <= hi)
+        # full-data fit should mostly land within the bootstrap ranges
+        w = np.asarray(model.means)
+        inside = np.sum((w >= lo - 3 * std - 1e-3) & (w <= hi + 3 * std + 1e-3))
+        assert inside >= 4
+        assert "norm" in rep.metrics_distribution
+
+
+class TestFitting:
+    def test_curves_monotone_data(self, rng):
+        train, w = logistic_batch(rng, n=600)
+        test, _ = logistic_batch(rng, n=300, w=w)
+
+        def metrics(m, b):
+            from photon_ml_tpu.evaluation import area_under_roc_curve
+            from photon_ml_tpu.models.glm import compute_margins
+
+            z = compute_margins(m.means, b)
+            return {"AUC": float(area_under_roc_curve(z, b.labels, b.weights))}
+
+        rep = fitting_diagnostic(train, test, fit, metrics, num_portions=4)
+        assert len(rep.portions) == 4
+        assert all(len(v) == 4 for v in rep.train_metrics.values())
+        # more data should not hurt test AUC much: last >= first - slack
+        assert rep.test_metrics["AUC"][-1] >= rep.test_metrics["AUC"][0] - 0.1
+
+
+class TestReporting:
+    def test_render_html(self):
+        doc = Document(
+            "t", [Chapter("c", [Section("s", [
+                Text("hello <world>"),
+                Table(["a", "b"], [["1", "2"]], caption="cap"),
+                LinePlot([0, 1, 2], [("s1", [0.0, 1.0, 0.5])], title="p"),
+            ])])]
+        )
+        html = render_html(doc)
+        assert "hello &lt;world&gt;" in html
+        assert "<table>" in html and "<svg" in html and "polyline" in html
+
+
+class TestDriverDiagnoseStage:
+    def test_end_to_end_with_report(self, tmp_path, rng):
+        from tests.test_glm_driver import synth_avro
+        from photon_ml_tpu.cli.glm_driver import (
+            DiagnosticMode,
+            DriverStage,
+            GLMDriver,
+            GLMParams,
+        )
+
+        train = tmp_path / "train"; train.mkdir()
+        val = tmp_path / "val"; val.mkdir()
+        synth_avro(str(train / "p.avro"), rng, n=200)
+        synth_avro(str(val / "p.avro"), rng, n=100)
+        params = GLMParams(
+            train_dir=str(train),
+            validate_dir=str(val),
+            output_dir=str(tmp_path / "out"),
+            regularization_weights=[1.0],
+            diagnostic_mode=DiagnosticMode.ALL,
+        )
+        driver = GLMDriver(params)
+        driver.run()
+        assert DriverStage.DIAGNOSED in driver.stage_history
+        report = tmp_path / "out" / "model-diagnostics" / "report.html"
+        assert report.is_file()
+        content = report.read_text()
+        assert "Hosmer-Lemeshow" in content and "Bootstrap" in content
+        assert "Learning curves" in content
+
+
+class TestCheckpointing:
+    def test_coordinate_descent_resume(self, tmp_path, rng):
+        from tests.test_game import SHARDS, make_records
+        from photon_ml_tpu.game import (
+            CoordinateDescent,
+            FixedEffectCoordinate,
+            RandomEffectCoordinate,
+            RandomEffectDataConfiguration,
+            RandomEffectOptimizationProblem,
+            build_game_dataset,
+            build_random_effect_dataset,
+        )
+        from photon_ml_tpu.ops.losses import LOGISTIC
+        from photon_ml_tpu.optim import OptimizerConfig, RegularizationContext, RegularizationType
+        from photon_ml_tpu.utils.checkpoint import TrainingCheckpointer
+
+        recs, _, _ = make_records(rng, n=150, n_users=5)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        red = build_random_effect_dataset(
+            ds, RandomEffectDataConfiguration("userId", "userShard")
+        )
+        def coords():
+            return {
+                "global": FixedEffectCoordinate(
+                    name="global", dataset=ds,
+                    problem=create_glm_problem(
+                        TaskType.LOGISTIC_REGRESSION,
+                        ds.shards["globalShard"].dim,
+                        config=OptimizerConfig(max_iter=15),
+                        regularization=RegularizationContext(RegularizationType.L2),
+                    ),
+                    feature_shard_id="globalShard", reg_weight=0.1,
+                ),
+                "per-user": RandomEffectCoordinate(
+                    name="per-user", dataset=ds, re_dataset=red,
+                    problem=RandomEffectOptimizationProblem(
+                        LOGISTIC, OptimizerConfig(max_iter=15),
+                        RegularizationContext(RegularizationType.L2), 1.0,
+                    ),
+                ),
+            }
+
+        ckpt_dir = str(tmp_path / "ckpt")
+        cp1 = TrainingCheckpointer(ckpt_dir)
+        cd1 = CoordinateDescent(
+            coords(), ds, TaskType.LOGISTIC_REGRESSION, checkpointer=cp1
+        )
+        r1 = cd1.run(2)
+        cp1.close()
+        assert TrainingCheckpointer(ckpt_dir).latest_step() == 2
+
+        # resume: a fresh run with the same checkpointer continues at iter 2
+        cp2 = TrainingCheckpointer(ckpt_dir)
+        cd2 = CoordinateDescent(
+            coords(), ds, TaskType.LOGISTIC_REGRESSION, checkpointer=cp2
+        )
+        r2 = cd2.run(3)  # only iteration 3 actually runs
+        cp2.close()
+        assert len(r2.objective_history) == 1
+        assert r2.objective_history[-1] <= r1.objective_history[-1] + 1e-5
+
+
+class TestEvents:
+    def test_emitter_and_listener(self):
+        seen = []
+
+        class L(EventListener):
+            def on_event(self, e):
+                seen.append(e)
+
+        em = EventEmitter()
+        em.register(L())
+        em.send(TrainingStartEvent("job"))
+        em.send(PhotonOptimizationLogEvent(reg_weight=1.0, iterations=5))
+        assert len(seen) == 2
+        assert isinstance(seen[0], TrainingStartEvent)
+        em.close()
