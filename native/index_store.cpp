@@ -1,0 +1,227 @@
+// Memory-mapped feature index store — the TPU-native PalDB replacement.
+//
+// Reference behavior being replaced: photon-ml's off-heap PalDB stores
+// (util/PalDBIndexMap.scala:43-130 — partitioned name->index and
+// index->name stores with offset arrays, distributed via SparkFiles) built
+// by FeatureIndexingJob.scala:59-136. At >200k-feature vocabularies an
+// in-heap dict is too slow/large on the JVM; here the same concern applies
+// to the Python host process feeding TPUs, so the store is a flat mmap
+// file with an open-addressing hash table — O(1) bidirectional lookup,
+// zero deserialization, shareable across host processes.
+//
+// File layout (little-endian, 8-byte aligned sections):
+//   [0]  magic  "PIDX" (4 bytes) + version u32
+//   [8]  num_keys u64
+//   [16] num_buckets u64       (power of two, ~2x keys)
+//   [24] entries_offset u64    (start of entry region)
+//   [32] reverse_offset u64    (start of reverse offset array)
+//   [40] bucket table: u64[num_buckets], 0 = empty, else offset of entry
+//   [entries_offset]  entries: u32 key_len, key bytes, padding to 4,
+//                     u32 local_index  (repeated)
+//   [reverse_offset]  u64[num_keys]: entry offset by local index
+//
+// Exposed with a plain C ABI for ctypes.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+#include <string>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x58444950;  // "PIDX"
+constexpr uint32_t kVersion = 1;
+
+inline uint64_t fnv1a(const char* data, uint32_t len) {
+  uint64_t h = 1469598103934665603ULL;
+  for (uint32_t i = 0; i < len; ++i) {
+    h ^= static_cast<unsigned char>(data[i]);
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+inline uint64_t round_up(uint64_t x, uint64_t m) { return (x + m - 1) / m * m; }
+
+struct Header {
+  uint32_t magic;
+  uint32_t version;
+  uint64_t num_keys;
+  uint64_t num_buckets;
+  uint64_t entries_offset;
+  uint64_t reverse_offset;
+};
+
+struct Store {
+  int fd = -1;
+  const char* base = nullptr;
+  size_t size = 0;
+  const Header* header = nullptr;
+  const uint64_t* buckets = nullptr;
+  const uint64_t* reverse = nullptr;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Build a store file from `n` keys (keys[i] has byte length key_lens[i]),
+// local indices 0..n-1. Returns 0 on success, negative errno-style code on
+// failure. Duplicate keys are rejected (-2).
+int pidx_build(const char* path, const char* const* keys,
+               const uint32_t* key_lens, uint64_t n) {
+  uint64_t num_buckets = 16;
+  while (num_buckets < 2 * n) num_buckets <<= 1;
+
+  // entry region layout
+  std::vector<uint64_t> entry_offsets(n);
+  uint64_t entries_size = 0;
+  for (uint64_t i = 0; i < n; ++i) {
+    entry_offsets[i] = entries_size;
+    entries_size += round_up(4 + key_lens[i], 4) + 4;
+  }
+  const uint64_t header_size = sizeof(Header);
+  const uint64_t buckets_off = header_size;
+  const uint64_t entries_off = round_up(buckets_off + 8 * num_buckets, 8);
+  const uint64_t reverse_off = round_up(entries_off + entries_size, 8);
+  const uint64_t total = reverse_off + 8 * n;
+
+  std::vector<char> buf(total, 0);
+  Header* h = reinterpret_cast<Header*>(buf.data());
+  h->magic = kMagic;
+  h->version = kVersion;
+  h->num_keys = n;
+  h->num_buckets = num_buckets;
+  h->entries_offset = entries_off;
+  h->reverse_offset = reverse_off;
+
+  uint64_t* buckets = reinterpret_cast<uint64_t*>(buf.data() + buckets_off);
+  uint64_t* reverse = reinterpret_cast<uint64_t*>(buf.data() + reverse_off);
+
+  for (uint64_t i = 0; i < n; ++i) {
+    const uint64_t eoff = entries_off + entry_offsets[i];
+    char* e = buf.data() + eoff;
+    const uint32_t len = key_lens[i];
+    std::memcpy(e, &len, 4);
+    std::memcpy(e + 4, keys[i], len);
+    const uint32_t local = static_cast<uint32_t>(i);
+    std::memcpy(e + round_up(4 + len, 4), &local, 4);
+    reverse[i] = eoff;
+
+    uint64_t b = fnv1a(keys[i], len) & (num_buckets - 1);
+    for (;;) {
+      if (buckets[b] == 0) {
+        buckets[b] = eoff;
+        break;
+      }
+      // duplicate check
+      const char* other = buf.data() + buckets[b];
+      uint32_t olen;
+      std::memcpy(&olen, other, 4);
+      if (olen == len && std::memcmp(other + 4, keys[i], len) == 0) return -2;
+      b = (b + 1) & (num_buckets - 1);
+    }
+  }
+
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  const size_t written = std::fwrite(buf.data(), 1, total, f);
+  std::fclose(f);
+  return written == total ? 0 : -1;
+}
+
+// Open (mmap) a store; returns an opaque handle or nullptr.
+void* pidx_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < (off_t)sizeof(Header)) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, st.st_size, PROT_READ, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  Store* s = new Store();
+  s->fd = fd;
+  s->base = static_cast<const char*>(base);
+  s->size = st.st_size;
+  s->header = reinterpret_cast<const Header*>(s->base);
+  if (s->header->magic != kMagic || s->header->version != kVersion) {
+    munmap(base, st.st_size);
+    ::close(fd);
+    delete s;
+    return nullptr;
+  }
+  s->buckets = reinterpret_cast<const uint64_t*>(s->base + sizeof(Header));
+  s->reverse =
+      reinterpret_cast<const uint64_t*>(s->base + s->header->reverse_offset);
+  return s;
+}
+
+void pidx_close(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  if (!s) return;
+  munmap(const_cast<char*>(s->base), s->size);
+  ::close(s->fd);
+  delete s;
+}
+
+uint64_t pidx_size(void* handle) {
+  return static_cast<Store*>(handle)->header->num_keys;
+}
+
+// key -> local index, or -1.
+int64_t pidx_get_index(void* handle, const char* key, uint32_t len) {
+  const Store* s = static_cast<Store*>(handle);
+  const uint64_t mask = s->header->num_buckets - 1;
+  uint64_t b = fnv1a(key, len) & mask;
+  for (;;) {
+    const uint64_t eoff = s->buckets[b];
+    if (eoff == 0) return -1;
+    const char* e = s->base + eoff;
+    uint32_t elen;
+    std::memcpy(&elen, e, 4);
+    if (elen == len && std::memcmp(e + 4, key, len) == 0) {
+      uint32_t local;
+      std::memcpy(&local, e + round_up(4 + elen, 4), 4);
+      return static_cast<int64_t>(local);
+    }
+    b = (b + 1) & mask;
+  }
+}
+
+// local index -> key bytes; returns key length or -1 (buffer too small: the
+// required length is returned and nothing is copied when out_len is
+// insufficient — call again with a larger buffer).
+int64_t pidx_get_key(void* handle, uint64_t local_index, char* out,
+                     uint32_t out_len) {
+  const Store* s = static_cast<Store*>(handle);
+  if (local_index >= s->header->num_keys) return -1;
+  const char* e = s->base + s->reverse[local_index];
+  uint32_t len;
+  std::memcpy(&len, e, 4);
+  if (len <= out_len) std::memcpy(out, e + 4, len);
+  return len;
+}
+
+// Batched lookup for hot loops: keys packed back-to-back with an offsets
+// array (offsets[i]..offsets[i+1]); writes indices[i] (or -1).
+void pidx_get_indices(void* handle, const char* packed,
+                      const uint64_t* offsets, uint64_t n, int64_t* out) {
+  for (uint64_t i = 0; i < n; ++i) {
+    const uint64_t off = offsets[i];
+    out[i] = pidx_get_index(handle, packed + off,
+                            static_cast<uint32_t>(offsets[i + 1] - off));
+  }
+}
+
+}  // extern "C"

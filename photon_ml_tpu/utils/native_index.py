@@ -1,0 +1,226 @@
+"""Python binding for the native mmap index store (PalDB analog).
+
+Reference: photon-ml .../util/PalDBIndexMap.scala:43-130 (partitioned
+off-heap stores with offset arrays + per-partition local indices, global
+index = local + partition offset; readers guarded by PALDB_READER_LOCK —
+unnecessary here, the mmap is immutable and lock-free) and
+PalDBIndexMapBuilder.scala / PalDBIndexMapLoader.scala,
+FeatureIndexingJob.scala:59-136 (hash-partitioned vocabulary build).
+
+The .so is compiled from native/index_store.cpp on first use (no pip
+installs in the image); ctypes keeps the binding dependency-free.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "index_store.cpp")
+_LIB_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_LIB = os.path.join(_LIB_DIR, "libindex_store.so")
+_COMPILE_LOCK = threading.Lock()
+_lib_handle = None
+
+
+def _compile_if_needed() -> str:
+    with _COMPILE_LOCK:
+        if os.path.isfile(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+            return _LIB
+        os.makedirs(_LIB_DIR, exist_ok=True)
+        cmd = [
+            "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+            _SRC, "-o", _LIB,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True)
+        return _LIB
+
+
+def _lib():
+    global _lib_handle
+    if _lib_handle is None:
+        lib = ctypes.CDLL(_compile_if_needed())
+        lib.pidx_build.restype = ctypes.c_int
+        lib.pidx_build.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint64,
+        ]
+        lib.pidx_open.restype = ctypes.c_void_p
+        lib.pidx_open.argtypes = [ctypes.c_char_p]
+        lib.pidx_close.argtypes = [ctypes.c_void_p]
+        lib.pidx_size.restype = ctypes.c_uint64
+        lib.pidx_size.argtypes = [ctypes.c_void_p]
+        lib.pidx_get_index.restype = ctypes.c_int64
+        lib.pidx_get_index.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.pidx_get_key.restype = ctypes.c_int64
+        lib.pidx_get_key.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.pidx_get_indices.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        _lib_handle = lib
+    return _lib_handle
+
+
+def build_store(path: str, keys: Sequence[str]) -> None:
+    """Write one partition store; keys get local indices 0..n-1."""
+    lib = _lib()
+    encoded = [k.encode("utf-8") for k in keys]
+    n = len(encoded)
+    arr = (ctypes.c_char_p * n)(*encoded)
+    lens = (ctypes.c_uint32 * n)(*[len(e) for e in encoded])
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    rc = lib.pidx_build(path.encode(), arr, lens, n)
+    if rc == -2:
+        raise ValueError("duplicate keys in index store build")
+    if rc != 0:
+        raise OSError(f"pidx_build failed with code {rc}")
+
+
+class NativeIndexStore:
+    """One open partition store (immutable, lock-free reads)."""
+
+    def __init__(self, path: str):
+        self._lib = _lib()
+        self._handle = self._lib.pidx_open(path.encode())
+        if not self._handle:
+            raise OSError(f"cannot open index store {path}")
+        self.path = path
+
+    def __len__(self) -> int:
+        return self._lib.pidx_size(self._handle)
+
+    def get_index(self, key: str) -> int:
+        e = key.encode("utf-8")
+        return self._lib.pidx_get_index(self._handle, e, len(e))
+
+    def get_key(self, local_index: int) -> Optional[str]:
+        buf = ctypes.create_string_buffer(4096)
+        n = self._lib.pidx_get_key(self._handle, local_index, buf, 4096)
+        if n < 0:
+            return None
+        if n > 4096:
+            buf = ctypes.create_string_buffer(n)
+            self._lib.pidx_get_key(self._handle, local_index, buf, n)
+        return buf.raw[:n].decode("utf-8")
+
+    def get_indices(self, keys: Sequence[str]) -> np.ndarray:
+        encoded = [k.encode("utf-8") for k in keys]
+        packed = b"".join(encoded)
+        offsets = np.zeros(len(encoded) + 1, np.uint64)
+        np.cumsum([len(e) for e in encoded], out=offsets[1:])
+        out = np.empty(len(encoded), np.int64)
+        self._lib.pidx_get_indices(
+            self._handle,
+            packed,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            len(encoded),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        return out
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.pidx_close(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PartitionedIndexMap:
+    """IndexMap API over hash-partitioned native stores
+    (PalDBIndexMap semantics: partition = hash(key) %% P, global index =
+    local + offset[partition])."""
+
+    STORE_PATTERN = "index-partition-{part}.pidx"
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        parts = sorted(
+            f for f in os.listdir(directory) if f.startswith("index-partition-")
+        )
+        if not parts:
+            raise OSError(f"no index partitions in {directory}")
+        self._stores = [
+            NativeIndexStore(os.path.join(directory, f)) for f in parts
+        ]
+        self._offsets = np.zeros(len(self._stores) + 1, np.int64)
+        np.cumsum([len(s) for s in self._stores], out=self._offsets[1:])
+
+    @property
+    def size(self) -> int:
+        return int(self._offsets[-1])
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __contains__(self, key: str) -> bool:
+        return self.get_index(key) >= 0
+
+    def _partition_of(self, key: str) -> int:
+        import zlib
+
+        return zlib.crc32(key.encode("utf-8")) % len(self._stores)
+
+    def get_index(self, key: str, default: int = -1) -> int:
+        p = self._partition_of(key)
+        local = self._stores[p].get_index(key)
+        return int(local + self._offsets[p]) if local >= 0 else default
+
+    def get_feature_name(self, index: int) -> Optional[str]:
+        p = int(np.searchsorted(self._offsets, index, side="right")) - 1
+        if p < 0 or p >= len(self._stores):
+            return None
+        return self._stores[p].get_key(index - int(self._offsets[p]))
+
+    def items(self):
+        for p, store in enumerate(self._stores):
+            base = int(self._offsets[p])
+            for local in range(len(store)):
+                yield store.get_key(local), base + local
+
+    def close(self) -> None:
+        for s in self._stores:
+            s.close()
+
+
+def build_partitioned_index(
+    keys: Iterable[str],
+    directory: str,
+    num_partitions: int = 1,
+) -> PartitionedIndexMap:
+    """The FeatureIndexingJob analog: hash-partition DISTINCT keys, build
+    one native store per partition (sorted within partition for
+    determinism), return the loader."""
+    import zlib
+
+    os.makedirs(directory, exist_ok=True)
+    parts: List[List[str]] = [[] for _ in range(num_partitions)]
+    for key in set(keys):
+        parts[zlib.crc32(key.encode("utf-8")) % num_partitions].append(key)
+    for p, part_keys in enumerate(parts):
+        build_store(
+            os.path.join(
+                directory, PartitionedIndexMap.STORE_PATTERN.format(part=p)
+            ),
+            sorted(part_keys),
+        )
+    return PartitionedIndexMap(directory)

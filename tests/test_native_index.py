@@ -1,0 +1,113 @@
+"""Native mmap index store tests: build/open/lookup/reverse, partitioned
+loader, duplicate rejection, scale smoke test, IndexMap interchangeability.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.utils.index_map import IndexMap, feature_key
+from photon_ml_tpu.utils.native_index import (
+    NativeIndexStore,
+    PartitionedIndexMap,
+    build_partitioned_index,
+    build_store,
+)
+
+
+class TestSingleStore:
+    def test_roundtrip(self, tmp_path):
+        path = str(tmp_path / "s.pidx")
+        keys = [f"feat{i}\tterm{i % 3}" for i in range(100)]
+        build_store(path, keys)
+        store = NativeIndexStore(path)
+        assert len(store) == 100
+        for i, k in enumerate(keys):
+            assert store.get_index(k) == i
+            assert store.get_key(i) == k
+        assert store.get_index("missing\t") == -1
+        assert store.get_key(100) is None
+        store.close()
+
+    def test_batched_lookup(self, tmp_path):
+        path = str(tmp_path / "s.pidx")
+        keys = [f"k{i}" for i in range(50)]
+        build_store(path, keys)
+        store = NativeIndexStore(path)
+        out = store.get_indices(["k3", "nope", "k49"])
+        assert out.tolist() == [3, -1, 49]
+        store.close()
+
+    def test_duplicates_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            build_store(str(tmp_path / "d.pidx"), ["a", "b", "a"])
+
+    def test_unicode_keys(self, tmp_path):
+        path = str(tmp_path / "u.pidx")
+        keys = ["café\trésumé", "日本語\t", "emoji🎉\tx"]
+        build_store(path, keys)
+        store = NativeIndexStore(path)
+        for i, k in enumerate(keys):
+            assert store.get_index(k) == i
+            assert store.get_key(i) == k
+        store.close()
+
+
+class TestPartitionedIndex:
+    def test_build_and_lookup(self, tmp_path):
+        keys = [feature_key(f"f{i}", str(i % 7)) for i in range(500)]
+        pm = build_partitioned_index(keys, str(tmp_path / "idx"), num_partitions=4)
+        assert pm.size == 500
+        for k in keys[::37]:
+            i = pm.get_index(k)
+            assert i >= 0
+            assert pm.get_feature_name(i) == k
+        assert pm.get_index("absent\t") == -1
+        # global indices are a bijection onto [0, size)
+        seen = {i for _, i in pm.items()}
+        assert seen == set(range(500))
+        pm.close()
+
+    def test_interchangeable_with_index_map(self, tmp_path):
+        """PartitionedIndexMap satisfies the IndexMap protocol used by the
+        input formats (get_index / get_feature_name / size)."""
+        from photon_ml_tpu.io.input_format import LibSVMInputDataFormat
+
+        p = tmp_path / "data.txt"
+        p.write_text("+1 1:1 3:2\n-1 2:1\n")
+        fmt = LibSVMInputDataFormat(add_intercept=False)
+        keys = [feature_key(str(i)) for i in range(3)]
+        pm = build_partitioned_index(keys, str(tmp_path / "idx"), num_partitions=2)
+        data = fmt.load(str(p), index_map=pm)
+        assert data.num_features == 3
+        pm.close()
+
+    def test_scale_smoke(self, tmp_path):
+        n = 200_000
+        keys = (f"name{i}\tt{i % 13}" for i in range(n))
+        pm = build_partitioned_index(keys, str(tmp_path / "big"), num_partitions=8)
+        assert pm.size == n
+        rng = np.random.default_rng(0)
+        for i in rng.integers(0, n, size=200):
+            k = f"name{i}\tt{i % 13}"
+            gi = pm.get_index(k)
+            assert gi >= 0 and pm.get_feature_name(gi) == k
+        pm.close()
+
+
+class TestFeatureIndexingJob:
+    def test_avro_job(self, tmp_path, rng):
+        from tests.test_glm_driver import synth_avro
+        from photon_ml_tpu.cli.feature_indexing_driver import run_feature_indexing
+        from photon_ml_tpu.utils.index_map import intercept_key
+
+        train = tmp_path / "train"; train.mkdir()
+        synth_avro(str(train / "p.avro"), rng, n=50)
+        shard_dir = run_feature_indexing(
+            [str(train)], str(tmp_path / "idx"), num_partitions=3
+        )
+        pm = PartitionedIndexMap(shard_dir)
+        assert pm.size == 9  # f0..f7 + intercept
+        assert pm.get_index(intercept_key()) >= 0
+        pm.close()
