@@ -1,0 +1,137 @@
+"""Tiled sparse kernel tests (interpret mode on CPU): schedule invariants
+and exact agreement with the scatter/gather GLMObjective on random
+problems, including duplicates, skewed (intercept-like) features and
+multi-window shapes.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.data.batch import make_sparse_batch
+from photon_ml_tpu.ops.losses import LOGISTIC, LINEAR
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.tiled_sparse import (
+    TileParams,
+    TiledGLMObjective,
+    build_tiled_batch,
+    tiled_batch_from_sparse,
+)
+
+PARAMS = TileParams(s_hi=8, s_lo=8, chunk=32)  # window 64, tiny for tests
+
+
+def random_problem(rng, n=100, d=150, k=6, intercept=True):
+    rows, labels = [], []
+    for i in range(n):
+        nnz = rng.integers(1, k + 1)
+        ix = rng.choice(d - 1, size=nnz, replace=False).tolist()
+        vs = rng.normal(size=nnz).tolist()
+        if intercept:
+            ix.append(d - 1)  # intercept-like skewed feature in EVERY row
+            vs.append(1.0)
+        labels.append(float(rng.uniform() > 0.5))
+        rows.append((ix, vs))
+    return make_sparse_batch(rows, labels, weights=rng.uniform(0.5, 2.0, n)), d
+
+
+class TestSchedule:
+    def test_entries_preserved(self, rng):
+        batch, d = random_problem(rng)
+        tb = tiled_batch_from_sparse(batch, d, params=PARAMS)
+        # every nonzero entry appears exactly once in each schedule
+        nnz = int(np.count_nonzero(np.asarray(batch.values)))
+        assert np.count_nonzero(tb.z_sched.vals) == nnz
+        assert np.count_nonzero(tb.g_sched.vals) == nnz
+        # monotone output blocks
+        assert np.all(np.diff(tb.z_sched.step_out) >= 0)
+        assert np.all(np.diff(tb.g_sched.step_out) >= 0)
+        # init flags exactly at block changes
+        changes = np.nonzero(np.diff(tb.z_sched.step_out) > 0)[0] + 1
+        inits = np.nonzero(tb.z_sched.step_init)[0]
+        assert inits[0] == 0 and set(inits[1:]) == set(changes)
+
+    def test_window_bounds(self, rng):
+        batch, d = random_problem(rng)
+        tb = tiled_batch_from_sparse(batch, d, params=PARAMS)
+        for sched in (tb.z_sched, tb.g_sched):
+            assert sched.out_hi.max() < PARAMS.s_hi
+            assert sched.out_lo.max() < PARAMS.s_lo
+            assert sched.in_hi.max() < PARAMS.s_hi
+            assert sched.in_lo.max() < PARAMS.s_lo
+
+
+class TestAgainstReferenceObjective:
+    def _pair(self, rng, **kw):
+        batch, d = random_problem(rng, **kw)
+        obj = GLMObjective(LOGISTIC, d)
+        tb = tiled_batch_from_sparse(batch, d, params=PARAMS)
+        tobj = TiledGLMObjective(LOGISTIC, tb, interpret=True)
+        return batch, obj, tobj, d
+
+    def test_value_and_gradient(self, rng):
+        batch, obj, tobj, d = self._pair(rng)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        v0, g0 = obj.value_and_gradient(w, batch, 0.3)
+        v1, g1 = tobj.value_and_gradient(w, 0.3)
+        np.testing.assert_allclose(float(v1), float(v0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=2e-4)
+
+    def test_offsets_respected(self, rng):
+        batch, d = random_problem(rng)
+        batch = batch._replace(
+            offsets=jnp.asarray(rng.normal(size=batch.offsets.shape).astype(np.float32))
+        )
+        obj = GLMObjective(LOGISTIC, d)
+        tb = tiled_batch_from_sparse(batch, d, params=PARAMS)
+        tobj = TiledGLMObjective(LOGISTIC, tb, interpret=True)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        v0, g0 = obj.value_and_gradient(w, batch, 0.0)
+        v1, g1 = tobj.value_and_gradient(w, 0.0)
+        np.testing.assert_allclose(float(v1), float(v0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=2e-4)
+
+    def test_hessian_vector(self, rng):
+        batch, obj, tobj, d = self._pair(rng)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        u = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        hv0 = obj.hessian_vector(w, u, batch, 0.2)
+        hv1 = tobj.hessian_vector(w, u, 0.2)
+        np.testing.assert_allclose(np.asarray(hv1), np.asarray(hv0), atol=2e-4)
+
+    def test_hessian_diagonal(self, rng):
+        batch, obj, tobj, d = self._pair(rng)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        h0 = obj.hessian_diagonal(w, batch, 0.1)
+        h1 = tobj.hessian_diagonal(w, 0.1)
+        np.testing.assert_allclose(np.asarray(h1), np.asarray(h0), atol=2e-4)
+
+    def test_linear_loss_and_duplicates(self, rng):
+        # duplicate (row, feature) entries must sum, matching the ELL path
+        batch = make_sparse_batch(
+            [([0, 0, 2], [1.0, 2.0, -1.0]), ([1, 2], [0.5, 0.5])],
+            [1.0, 0.0],
+        )
+        d = 3
+        obj = GLMObjective(LINEAR, d)
+        tb = tiled_batch_from_sparse(batch, d, params=TileParams(4, 4, 8))
+        tobj = TiledGLMObjective(LINEAR, tb, interpret=True)
+        w = jnp.asarray([0.3, -0.2, 0.9], jnp.float32)
+        v0, g0 = obj.value_and_gradient(w, batch, 0.0)
+        v1, g1 = tobj.value_and_gradient(w, 0.0)
+        np.testing.assert_allclose(float(v1), float(v0), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=1e-5)
+
+    def test_multi_window_dims(self, rng):
+        # dimensions spanning several windows on both axes
+        batch, d = random_problem(rng, n=200, d=500, k=10)
+        obj = GLMObjective(LOGISTIC, d)
+        tb = tiled_batch_from_sparse(batch, d, params=PARAMS)
+        assert tb.num_feat_blocks >= 8 and tb.num_row_blocks >= 4
+        tobj = TiledGLMObjective(LOGISTIC, tb, interpret=True)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        v0, g0 = obj.value_and_gradient(w, batch, 0.05)
+        v1, g1 = tobj.value_and_gradient(w, 0.05)
+        np.testing.assert_allclose(float(v1), float(v0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=3e-4)
