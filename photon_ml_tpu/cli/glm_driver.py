@@ -101,6 +101,9 @@ class GLMParams:
     delete_output_dirs_if_exist: bool = False
     job_name: str = "photon-ml-tpu"
     event_listeners: List[str] = field(default_factory=list)
+    # objective kernel: "auto" (tiled Pallas on accelerators, scatter on
+    # CPU), "tiled", or "scatter" — see optim.problem.resolve_kernel
+    kernel: str = "auto"
 
     def validate(self) -> None:
         """Cross-field checks (Params.validate, Params.scala:200-222)."""
@@ -229,6 +232,7 @@ class GLMDriver:
                 compute_variances=p.compute_variances,
                 box=data.constraints,
                 intercept_index=data.intercept_index,
+                kernel=p.kernel,
             )
             for lam, res in self.results.items():
                 self.emitter.send(
@@ -417,6 +421,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--delete-output-dirs-if-exist", default="false")
     ap.add_argument("--job-name", default="photon-ml-tpu")
     ap.add_argument("--event-listeners", default=None)
+    ap.add_argument(
+        "--kernel", default="auto", choices=["auto", "tiled", "scatter"],
+        help="objective kernel (auto: tiled Pallas on accelerators)",
+    )
     return ap
 
 
@@ -450,6 +458,7 @@ def params_from_args(argv=None) -> GLMParams:
         compute_variances=_bool(ns.compute_variances),
         delete_output_dirs_if_exist=_bool(ns.delete_output_dirs_if_exist),
         job_name=ns.job_name,
+        kernel=ns.kernel,
         event_listeners=(
             ns.event_listeners.split(",") if ns.event_listeners else []
         ),

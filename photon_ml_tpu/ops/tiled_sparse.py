@@ -17,22 +17,28 @@ operations are VPU compares and MXU matmuls:
   monotone -> pallas accumulates the z window in VMEM); the grad-pass
   streams the same entries sorted by feature-block.
 
-The schedule (tile assignment, chunking, one-hot index splits) is computed
-ONCE on host per dataset — full-batch GLM training re-evaluates the same
-static structure hundreds of times, so the build cost amortizes to zero.
+The schedule (tile assignment, chunking, window-local index packing) is
+computed ONCE on host per dataset — full-batch GLM training re-evaluates
+the same static structure hundreds of times, so the build cost amortizes
+to zero. Schedules and per-row arrays are pytree leaves: pass the
+TiledSparseBatch *as a jit argument* (exactly like SparseBatch), never a
+closure constant — at ads scale the schedule is hundreds of MB and baking
+it into the executable blows up compilation.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from photon_ml_tpu.ops.normalization import NormalizationContext, identity_context
 
 Array = jnp.ndarray
 
@@ -48,18 +54,23 @@ class TileParams:
         return self.s_hi * self.s_lo
 
 
-@dataclass
-class _Schedule:
-    """One pass's static schedule: chunked entries sorted by output block."""
+class _Schedule(NamedTuple):
+    """One pass's static schedule: chunked entries sorted by output block.
 
-    step_out: np.ndarray  # [G] output block id per step
-    step_in: np.ndarray  # [G] input-window block id per step
-    step_init: np.ndarray  # [G] 1 iff first step of its output block
-    out_hi: np.ndarray  # [G, L] one-hot hi index into the OUTPUT window
-    out_lo: np.ndarray  # [G, L]
-    in_hi: np.ndarray  # [G, L] one-hot hi index into the INPUT window
-    in_lo: np.ndarray  # [G, L]
-    vals: np.ndarray  # [G, L] entry values (0 for padding slots)
+    All fields are arrays (the NamedTuple is a pytree — jit-argument safe).
+    Entry blocks are 2-D rows [G, L]: TPU HBM tiling pads the trailing two
+    dims to (8, 128), so [G, L, 1] would waste 128x HBM (observed: 54 GB
+    for a 528 MB schedule) and [G, 1, L] 8x, while [G, L] is compact. In
+    the kernel each [1, L] row broadcasts against sublane-iota; a
+    [8, L//8] -> [L] reshape would be an unsupported Mosaic relayout.
+    """
+
+    step_out: Array  # int32 [G] output block id per step
+    step_in: Array  # int32 [G] input-window block id per step
+    step_init: Array  # int32 [G] 1 iff first step of its output block
+    out_pos: Array  # int32 [G, L] window-local OUTPUT index in [0, WIN)
+    in_pos: Array  # int32 [G, L] window-local INPUT index in [0, WIN)
+    vals: Array  # float32 [G, L] entry values (0 for padding slots)
 
     @property
     def num_steps(self) -> int:
@@ -73,6 +84,7 @@ def _build_schedule(
     *,
     params: TileParams,
     sort_by_feature_block: bool,
+    num_out_blocks: int,
 ) -> _Schedule:
     win = params.window
     L = params.chunk
@@ -88,70 +100,127 @@ def _build_schedule(
         out_pos, in_pos = rows[order] % win, feats[order] % win
     v = vals[order]
 
-    # tile boundaries: chunk entries so no chunk crosses a tile boundary
-    tile_key = out_blocks.astype(np.int64) * (in_blocks.max() + 1) + in_blocks
-    boundaries = np.nonzero(
-        np.concatenate([[True], tile_key[1:] != tile_key[:-1]])
-    )[0]
-    tile_starts = boundaries
-    tile_ends = np.concatenate([boundaries[1:], [len(v)]])
+    steps = []  # (entry_start, entry_end, out_block) ; start==end: zero step
+    if len(v):
+        # tile boundaries: chunk entries so no chunk crosses a tile boundary
+        tile_key = (
+            out_blocks.astype(np.int64) * (int(in_blocks.max()) + 1)
+            + in_blocks
+        )
+        boundaries = np.nonzero(
+            np.concatenate([[True], tile_key[1:] != tile_key[:-1]])
+        )[0]
+        tile_starts = boundaries
+        tile_ends = np.concatenate([boundaries[1:], [len(v)]])
+        for s, e in zip(tile_starts, tile_ends):
+            for cs in range(s, e, L):
+                steps.append((cs, min(cs + L, e), int(out_blocks[s])))
+    # Every output block needs at least one step: the kernel only writes
+    # blocks named by step_out (out_ref starts as UNINITIALIZED memory on
+    # TPU — interpret mode zero-fills, hiding this), so an output window
+    # with no entries would otherwise return garbage. Insert zero-entry
+    # init steps for the missing blocks, keeping out-block order sorted so
+    # VMEM accumulation stays monotone.
+    present = {ob for (_, _, ob) in steps}
+    for ob in range(num_out_blocks):
+        if ob not in present:
+            steps.append((0, 0, ob))
+    steps.sort(key=lambda t: t[2])
 
-    steps = []
-    for s, e in zip(tile_starts, tile_ends):
-        for cs in range(s, e, L):
-            steps.append((s, cs, min(cs + L, e)))
     G = len(steps)
     step_out = np.zeros(G, np.int32)
     step_in = np.zeros(G, np.int32)
     step_init = np.zeros(G, np.int32)
-    o_hi = np.zeros((G, L), np.int32)
-    o_lo = np.zeros((G, L), np.int32)
-    i_hi = np.zeros((G, L), np.int32)
-    i_lo = np.zeros((G, L), np.int32)
+    o_pos = np.zeros((G, L), np.int32)
+    i_pos = np.zeros((G, L), np.int32)
     sv = np.zeros((G, L), np.float32)
     prev_out = -1
-    for g, (tile_start, cs, ce) in enumerate(steps):
+    for g, (cs, ce, ob) in enumerate(steps):
         m = ce - cs
-        step_out[g] = out_blocks[cs]
-        step_in[g] = in_blocks[cs]
-        step_init[g] = 1 if out_blocks[cs] != prev_out else 0
-        prev_out = out_blocks[cs]
-        o_hi[g, :m] = out_pos[cs:ce] // params.s_lo
-        o_lo[g, :m] = out_pos[cs:ce] % params.s_lo
-        i_hi[g, :m] = in_pos[cs:ce] // params.s_lo
-        i_lo[g, :m] = in_pos[cs:ce] % params.s_lo
-        sv[g, :m] = v[cs:ce]
-    return _Schedule(step_out, step_in, step_init, o_hi, o_lo, i_hi, i_lo, sv)
+        step_out[g] = ob
+        step_in[g] = in_blocks[cs] if m else 0
+        step_init[g] = 1 if ob != prev_out else 0
+        prev_out = ob
+        if m:
+            o_pos[g, :m] = out_pos[cs:ce]
+            i_pos[g, :m] = in_pos[cs:ce]
+            sv[g, :m] = v[cs:ce]
+    # pad the step axis to a multiple of 8: the kernel reads entry rows in
+    # (8, L) blocks (sublane tiling); padded rows are never executed
+    G8 = ((G + 7) // 8) * 8
+    if G8 != G:
+        o_pos = np.concatenate([o_pos, np.zeros((G8 - G, L), np.int32)])
+        i_pos = np.concatenate([i_pos, np.zeros((G8 - G, L), np.int32)])
+        sv = np.concatenate([sv, np.zeros((G8 - G, L), np.float32)])
+    return _Schedule(
+        jnp.asarray(step_out),
+        jnp.asarray(step_in),
+        jnp.asarray(step_init),
+        jnp.asarray(o_pos),
+        jnp.asarray(i_pos),
+        jnp.asarray(sv),
+    )
 
 
-@dataclass
-class TiledSparseBatch:
+class TiledSparseBatch(NamedTuple):
     """Statically tiled sparse batch (replaces SparseBatch on the hot path).
 
     Row space is padded to num_row_blocks * window; feature space to
     num_feat_blocks * window. ``labels/offsets/weights`` live in padded row
-    space (weight 0 padding).
+    space (weight 0 padding). A NamedTuple pytree: ints are leaves too, but
+    they are concrete python ints, so jit sees them as static weak-typed
+    scalars only if hashable — instead we keep them in ``meta`` as a static
+    aux via the _TiledMeta wrapper below.
     """
+
+    meta: "_TiledMeta"
+    z_sched: _Schedule
+    g_sched: _Schedule
+    g_vals_sq: Array  # [G2, L] squared values for hessian_diagonal
+    labels: Array
+    offsets: Array
+    weights: Array
+
+    # convenience passthroughs (static python ints)
+    @property
+    def params(self) -> TileParams:
+        return self.meta.params
+
+    @property
+    def num_rows(self) -> int:
+        return self.meta.num_rows
+
+    @property
+    def dim(self) -> int:
+        return self.meta.dim
+
+    @property
+    def num_real_rows(self) -> int:
+        return self.meta.num_real_rows
+
+    @property
+    def real_dim(self) -> int:
+        return self.meta.real_dim
+
+    @property
+    def num_row_blocks(self) -> int:
+        return self.meta.num_rows // self.meta.params.window
+
+    @property
+    def num_feat_blocks(self) -> int:
+        return self.meta.dim // self.meta.params.window
+
+
+@jax.tree_util.register_static
+@dataclass(frozen=True)
+class _TiledMeta:
+    """Static (hashable) shape metadata for TiledSparseBatch."""
 
     params: TileParams
     num_rows: int  # padded
     dim: int  # padded
     num_real_rows: int
     real_dim: int
-    z_sched: _Schedule
-    g_sched: _Schedule
-    g_vals_sq: np.ndarray  # [G2, L] squared values for hessian_diagonal
-    labels: Array
-    offsets: Array
-    weights: Array
-
-    @property
-    def num_row_blocks(self) -> int:
-        return self.num_rows // self.params.window
-
-    @property
-    def num_feat_blocks(self) -> int:
-        return self.dim // self.params.window
 
 
 def build_tiled_batch(
@@ -175,10 +244,12 @@ def build_tiled_batch(
     d_pad = max(((dim + win - 1) // win) * win, win)
 
     z_sched = _build_schedule(
-        rows, feats, vals, params=params, sort_by_feature_block=False
+        rows, feats, vals, params=params, sort_by_feature_block=False,
+        num_out_blocks=n_pad // win,
     )
     g_sched = _build_schedule(
-        rows, feats, vals, params=params, sort_by_feature_block=True
+        rows, feats, vals, params=params, sort_by_feature_block=True,
+        num_out_blocks=d_pad // win,
     )
     lab = np.zeros(n_pad, np.float32)
     lab[:n] = labels
@@ -187,11 +258,13 @@ def build_tiled_batch(
     wgt = np.zeros(n_pad, np.float32)
     wgt[:n] = weights
     return TiledSparseBatch(
-        params=params,
-        num_rows=n_pad,
-        dim=d_pad,
-        num_real_rows=n,
-        real_dim=dim,
+        meta=_TiledMeta(
+            params=params,
+            num_rows=n_pad,
+            dim=d_pad,
+            num_real_rows=n,
+            real_dim=dim,
+        ),
         z_sched=z_sched,
         g_sched=g_sched,
         g_vals_sq=g_sched.vals**2,
@@ -228,7 +301,7 @@ def _bilinear_pass_kernel(
     # scalar prefetch
     step_out_ref, step_in_ref, step_init_ref,
     # per-step entry blocks [1, L]
-    in_hi_ref, in_lo_ref, out_hi_ref, out_lo_ref, vals_ref,
+    in_pos_ref, out_pos_ref, vals_ref,
     # gathered-from window [1, S_HI, S_LO] (w2d for z-pass, c2d for grad)
     src_ref,
     # output window accumulator [1, S_HI, S_LO]
@@ -237,34 +310,97 @@ def _bilinear_pass_kernel(
     s_hi: int,
     s_lo: int,
     chunk: int,
+    mxu: str,
 ):
-    """One grid step: expand src at (in_hi, in_lo), multiply by vals,
-    bilinear-scatter into the (out_hi, out_lo) output window."""
+    """One grid step: expand src at in_pos, multiply by vals,
+    bilinear-scatter into the out_pos output window.
+
+    Entries live on LANES ([1, L] rows); one-hots are sublane-iota
+    compares, so each one-hot is [S, L] with the entry axis last and both
+    matmuls contract without any transpose relayout.
+    """
     g = pl.program_id(0)
     L = chunk
-    # entry blocks are stored [G, 8, L//8] to satisfy TPU (8, 128) tiling
-    ih = in_hi_ref[0].reshape(L)
-    il = in_lo_ref[0].reshape(L)
-    oh = out_hi_ref[0].reshape(L)
-    ol = out_lo_ref[0].reshape(L)
-    v = vals_ref[0].reshape(L)
+    # Entry blocks are [8, L] (8 steps' rows — sublane dim must tile by 8);
+    # select this step's row with a sublane one-hot mask + reduce (dynamic
+    # sublane slicing would relayout; the mask is cheap VPU work).
+    r = jax.lax.rem(g, 8)
+    row_sel = (
+        jax.lax.broadcasted_iota(jnp.int32, (8, L), 0) == r
+    )
+    ip = jnp.sum(
+        jnp.where(row_sel, in_pos_ref[...], 0), axis=0, keepdims=True
+    )  # [1, L] int32, window-local = hi * s_lo + lo
+    op = jnp.sum(
+        jnp.where(row_sel, out_pos_ref[...], 0), axis=0, keepdims=True
+    )
+    v = jnp.sum(
+        jnp.where(row_sel, vals_ref[...], 0.0), axis=0, keepdims=True
+    )  # [1, L] float32
 
-    hi_iota = jax.lax.broadcasted_iota(jnp.int32, (L, s_hi), 1)
-    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (L, s_lo), 1)
-    oh_in_hi = (ih[:, None] == hi_iota).astype(jnp.float32)  # [L, S_HI]
-    oh_in_lo = (il[:, None] == lo_iota).astype(jnp.float32)  # [L, S_LO]
+    ih = ip // s_lo
+    il = ip - ih * s_lo
+    oh = op // s_lo
+    ol = op - oh * s_lo
 
-    # gather: src_g[p] = src2d[ih[p], il[p]]
-    a = jnp.dot(oh_in_hi, src_ref[0], preferred_element_type=jnp.float32)
-    src_g = jnp.sum(a * oh_in_lo, axis=1)  # [L]
-    contrib = v * src_g
+    hi_iota = jax.lax.broadcasted_iota(jnp.int32, (s_hi, L), 0)
+    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (s_lo, L), 0)
+    dims_in = (((0,), (0,)), ((), ()))
+    dims_out = (((1,), (1,)), ((), ()))
+    if mxu == "bf16x2":
+        # One-hot matrices are 0/1 — EXACT in bf16. Only the data operand
+        # carries mantissa, so instead of Precision.HIGHEST (6 bf16 MXU
+        # passes for f32 x f32) we split the data side into two bf16 terms
+        # (hi + lo, ~16 mantissa bits, ~1e-5 rel error) and run 2
+        # single-pass bf16 matmuls — 3x the MXU throughput at
+        # GLM-sufficient precision.
+        oh_in_hi = (ih == hi_iota).astype(jnp.bfloat16)  # [S_HI, L]
+        oh_in_lo = (il == lo_iota).astype(jnp.float32)  # [S_LO, L]
 
-    oh_out_hi = (oh[:, None] == hi_iota).astype(jnp.float32)
-    oh_out_lo = (ol[:, None] == lo_iota).astype(jnp.float32)
-    update = jnp.dot(
-        (oh_out_hi * contrib[:, None]).T, oh_out_lo,
-        preferred_element_type=jnp.float32,
-    )  # [S_HI, S_LO]
+        def _split(x):
+            hi_part = x.astype(jnp.bfloat16)
+            lo_part = (x - hi_part.astype(jnp.float32)).astype(jnp.bfloat16)
+            return hi_part, lo_part
+
+        # gather: src_g[p] = src2d[ih[p], il[p]]
+        s1, s2 = _split(src_ref[0])
+        a = jax.lax.dot_general(
+            s1, oh_in_hi, dims_in, preferred_element_type=jnp.float32
+        ) + jax.lax.dot_general(
+            s2, oh_in_hi, dims_in, preferred_element_type=jnp.float32
+        )  # [S_LO, L]
+        src_g = jnp.sum(a * oh_in_lo, axis=0, keepdims=True)  # [1, L]
+        contrib = v * src_g  # [1, L]
+
+        oh_out_hi = (oh == hi_iota).astype(jnp.bfloat16)
+        oh_out_lo = (ol == lo_iota).astype(jnp.bfloat16)
+        # A @ B^T via lane/entry contraction. oh_out_lo is 0/1 and the
+        # contrib terms are already bf16, so each product below is exact.
+        c1, c2 = _split(contrib)
+        update = jax.lax.dot_general(
+            oh_out_hi, oh_out_lo * c1, dims_out,
+            preferred_element_type=jnp.float32,
+        ) + jax.lax.dot_general(
+            oh_out_hi, oh_out_lo * c2, dims_out,
+            preferred_element_type=jnp.float32,
+        )  # [S_HI, S_LO]
+    else:  # "highest": full f32 emulation, ~3x slower, ~1e-7 rel error
+        oh_in_hi = (ih == hi_iota).astype(jnp.float32)
+        oh_in_lo = (il == lo_iota).astype(jnp.float32)
+        a = jax.lax.dot_general(
+            src_ref[0], oh_in_hi, dims_in,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        src_g = jnp.sum(a * oh_in_lo, axis=0, keepdims=True)
+        contrib = v * src_g
+        oh_out_hi = (oh == hi_iota).astype(jnp.float32)
+        oh_out_lo = (ol == lo_iota).astype(jnp.float32)
+        update = jax.lax.dot_general(
+            oh_out_hi, oh_out_lo * contrib, dims_out,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
 
     @pl.when(step_init_ref[g] == 1)
     def _():
@@ -283,6 +419,7 @@ def _run_bilinear_pass(
     *,
     vals: Optional[Array] = None,
     interpret: bool = False,
+    mxu: str = "bf16x2",
 ) -> Array:
     """-> [num_out_blocks, S_HI, S_LO] accumulated output."""
     G = sched.num_steps
@@ -292,20 +429,15 @@ def _run_bilinear_pass(
         s_hi=params.s_hi,
         s_lo=params.s_lo,
         chunk=L,
+        mxu=mxu,
     )
-    assert L % 1024 == 0 or L in (8, 32), f"chunk {L} must tile (8,128)"
-    eb = (1, 8, L // 8) if L % 1024 == 0 else (1, 1, L)
-    def eshape(a):
-        return jnp.asarray(a).reshape((G,) + eb[1:])
-    entry_spec = pl.BlockSpec(eb, lambda g, so, si, st: (g, 0, 0))
+    entry_spec = pl.BlockSpec((8, L), lambda g, so, si, st: (g // 8, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(G,),
         in_specs=[
-            entry_spec,  # in_hi
-            entry_spec,  # in_lo
-            entry_spec,  # out_hi
-            entry_spec,  # out_lo
+            entry_spec,  # in_pos
+            entry_spec,  # out_pos
             entry_spec,  # vals
             pl.BlockSpec(
                 (1, params.s_hi, params.s_lo),
@@ -325,102 +457,156 @@ def _run_bilinear_pass(
         ),
         interpret=interpret,
     )(
-        jnp.asarray(sched.step_out),
-        jnp.asarray(sched.step_in),
-        jnp.asarray(sched.step_init),
-        eshape(sched.in_hi),
-        eshape(sched.in_lo),
-        eshape(sched.out_hi),
-        eshape(sched.out_lo),
-        eshape(sched.vals if vals is None else vals),
+        sched.step_out,
+        sched.step_in,
+        sched.step_init,
+        sched.in_pos,
+        sched.out_pos,
+        sched.vals if vals is None else vals,
         src,
     )
     return out
 
 
+@dataclass(frozen=True)
 class TiledGLMObjective:
-    """GLMObjective-compatible fused objective over a TiledSparseBatch.
+    """GLMObjective-compatible fused objective over TiledSparseBatch data.
 
-    Same math contract as photon_ml_tpu.ops.objective.GLMObjective
-    (sum-weighted loss, L2 added once, psum over ``axis_name`` if set), with
-    the margins/gradient passes running the tiled Pallas kernels instead of
-    gather/scatter.
+    Same math and signature contract as
+    photon_ml_tpu.ops.objective.GLMObjective (sum-weighted loss, L2 added
+    once, lazy shift/factor normalization, psum over ``axis_name`` if set),
+    with the margins/gradient passes running the tiled Pallas kernels
+    instead of gather/scatter. Methods take the batch as an argument (pass
+    it through jit — it is a pytree).
     """
 
-    def __init__(self, loss, batch: TiledSparseBatch, *, axis_name=None,
-                 interpret: bool = False):
-        self.loss = loss
-        self.batch = batch
-        self.axis_name = axis_name
-        self.interpret = interpret
-        p = batch.params
-        self._w_shape = (batch.num_feat_blocks, p.s_hi, p.s_lo)
-        self._c_shape = (batch.num_row_blocks, p.s_hi, p.s_lo)
+    loss: object
+    dim: int  # real (unpadded) coefficient dimension
+    norm: NormalizationContext = None
+    axis_name: Optional[str] = None
+    interpret: bool = False
+    mxu: str = "bf16x2"  # "bf16x2" (fast, ~1e-5) | "highest" (~1e-7)
+
+    def __post_init__(self):
+        if self.norm is None:
+            object.__setattr__(self, "norm", identity_context())
 
     def _psum(self, x):
         if self.axis_name is None:
             return x
         return jax.lax.psum(x, self.axis_name)
 
-    def _margins(self, w_padded: Array) -> Array:
-        """z [num_rows] = tiled row-sums + offsets."""
-        b = self.batch
-        w2d = w_padded.reshape(self._w_shape)
-        z = _run_bilinear_pass(
-            b.z_sched, w2d, b.num_row_blocks, b.params,
-            interpret=self.interpret,
-        ).reshape(-1)
-        return z + b.offsets
-
-    def _grad_pass(self, c_rows: Array, vals: Optional[Array] = None) -> Array:
-        b = self.batch
-        c2d = c_rows.reshape(self._c_shape)
-        g = _run_bilinear_pass(
-            b.g_sched, c2d, b.num_feat_blocks, b.params,
-            vals=vals, interpret=self.interpret,
-        ).reshape(-1)
-        return g
-
-    def _pad_w(self, w: Array) -> Array:
-        b = self.batch
-        if w.shape[0] == b.dim:
+    def _pad(self, w: Array, batch: TiledSparseBatch) -> Array:
+        if w.shape[0] == batch.dim:
             return w
-        return jnp.zeros((b.dim,), w.dtype).at[: w.shape[0]].set(w)
+        return jnp.zeros((batch.dim,), w.dtype).at[: w.shape[0]].set(w)
 
-    def value_and_gradient(self, w: Array, l2_weight=0.0) -> Tuple[Array, Array]:
-        b = self.batch
-        d_in = w.shape[0]
-        wp = self._pad_w(w)
-        z = self._margins(wp)
-        lv = self.loss.value(z, b.labels)
-        ld = self.loss.d1(z, b.labels)
-        c = b.weights * ld
-        value = self._psum(jnp.sum(b.weights * lv))
-        grad = self._psum(self._grad_pass(c))[:d_in]
-        value = value + 0.5 * l2_weight * jnp.vdot(w, w)
-        return value, grad + l2_weight * w
+    def _z_pass(self, w_padded: Array, batch: TiledSparseBatch) -> Array:
+        """raw row-sums [num_rows] of the tiled bilinear product."""
+        b = batch
+        p = b.params
+        w2d = w_padded.reshape((b.num_feat_blocks, p.s_hi, p.s_lo))
+        return _run_bilinear_pass(
+            b.z_sched, w2d, b.num_row_blocks, p,
+            interpret=self.interpret, mxu=self.mxu,
+        ).reshape(-1)
 
-    def value(self, w: Array, l2_weight=0.0) -> Array:
-        b = self.batch
-        z = self._margins(self._pad_w(w))
-        value = self._psum(jnp.sum(b.weights * self.loss.value(z, b.labels)))
-        return value + 0.5 * l2_weight * jnp.vdot(w, w)
+    def _grad_pass(
+        self, c_rows: Array, batch: TiledSparseBatch,
+        vals: Optional[Array] = None,
+    ) -> Array:
+        b = batch
+        p = b.params
+        c2d = c_rows.reshape((b.num_row_blocks, p.s_hi, p.s_lo))
+        return _run_bilinear_pass(
+            b.g_sched, c2d, b.num_feat_blocks, p,
+            vals=vals, interpret=self.interpret, mxu=self.mxu,
+        ).reshape(-1)
 
-    def hessian_vector(self, w: Array, direction: Array, l2_weight=0.0) -> Array:
-        b = self.batch
-        d_in = w.shape[0]
-        z = self._margins(self._pad_w(w))
-        zd = self._margins(self._pad_w(direction)) - b.offsets
-        c = b.weights * self.loss.d2(z, b.labels) * zd
-        hv = self._psum(self._grad_pass(c))[:d_in]
+    # -- margins -----------------------------------------------------------
+
+    def margins(self, coef: Array, batch: TiledSparseBatch) -> Array:
+        """z_i = x_eff_i . w_eff + offset_i in padded row space."""
+        w_eff = self.norm.effective_coefficients(coef)
+        raw = self._z_pass(self._pad(w_eff, batch), batch)
+        return raw - self.norm.shift_dot(w_eff) + batch.offsets
+
+    # -- value / gradient --------------------------------------------------
+
+    def value(self, coef: Array, batch: TiledSparseBatch, l2_weight=0.0) -> Array:
+        z = self.margins(coef, batch)
+        val = jnp.sum(batch.weights * self.loss.value(z, batch.labels))
+        val = self._psum(val)
+        return val + 0.5 * l2_weight * jnp.dot(coef, coef)
+
+    def value_and_gradient(
+        self, coef: Array, batch: TiledSparseBatch, l2_weight=0.0
+    ) -> Tuple[Array, Array]:
+        d_in = coef.shape[0]
+        z = self.margins(coef, batch)
+        lv = self.loss.value(z, batch.labels)
+        ld = self.loss.d1(z, batch.labels)
+        c = batch.weights * ld
+        value_sum = jnp.sum(batch.weights * lv)
+        vector_sum = self._grad_pass(c, batch)[:d_in]
+        prefactor_sum = jnp.sum(c)
+        value_sum, vector_sum, prefactor_sum = self._psum(
+            (value_sum, vector_sum, prefactor_sum)
+        )
+        grad = self.norm.unshift_gradient(vector_sum, prefactor_sum)
+        value = value_sum + 0.5 * l2_weight * jnp.dot(coef, coef)
+        return value, grad + l2_weight * coef
+
+    def gradient(self, coef: Array, batch: TiledSparseBatch, l2_weight=0.0) -> Array:
+        return self.value_and_gradient(coef, batch, l2_weight)[1]
+
+    # -- second order ------------------------------------------------------
+
+    def hessian_vector(
+        self, coef: Array, direction: Array, batch: TiledSparseBatch,
+        l2_weight=0.0,
+    ) -> Array:
+        d_in = coef.shape[0]
+        w_eff = self.norm.effective_coefficients(coef)
+        d_eff = self.norm.effective_coefficients(direction)
+        z = (
+            self._z_pass(self._pad(w_eff, batch), batch)
+            - self.norm.shift_dot(w_eff) + batch.offsets
+        )
+        zd = (
+            self._z_pass(self._pad(d_eff, batch), batch)
+            - self.norm.shift_dot(d_eff)
+        )
+        c = batch.weights * self.loss.d2(z, batch.labels) * zd
+        vector_sum = self._grad_pass(c, batch)[:d_in]
+        prefactor_sum = jnp.sum(c)
+        vector_sum, prefactor_sum = self._psum((vector_sum, prefactor_sum))
+        hv = self.norm.unshift_gradient(vector_sum, prefactor_sum)
         return hv + l2_weight * direction
 
-    def hessian_diagonal(self, w: Array, l2_weight=0.0) -> Array:
-        b = self.batch
-        d_in = w.shape[0]
-        z = self._margins(self._pad_w(w))
-        c = b.weights * self.loss.d2(z, b.labels)
-        diag = self._psum(
-            self._grad_pass(c, vals=jnp.asarray(b.g_vals_sq))
-        )[:d_in]
+    def hessian_diagonal(
+        self, coef: Array, batch: TiledSparseBatch, l2_weight=0.0
+    ) -> Array:
+        d_in = coef.shape[0]
+        z = self.margins(coef, batch)
+        c = batch.weights * self.loss.d2(z, batch.labels)
+        s2 = self._grad_pass(c, batch, vals=batch.g_vals_sq)[:d_in]
+        if self.norm.shift is not None:
+            # shifted space needs S1 = sum c x and S0 = sum c as well
+            s1 = self._grad_pass(c, batch)[:d_in]
+            s0 = jnp.sum(c)
+            s0, s1, s2 = self._psum((s0, s1, s2))
+            diag = s2 - 2.0 * self.norm.shift * s1 + (self.norm.shift**2) * s0
+        else:
+            diag = self._psum(s2)
+        if self.norm.factor is not None:
+            diag = diag * self.norm.factor**2
         return diag + l2_weight
+
+    # -- convenience -------------------------------------------------------
+
+    def with_axis(self, axis_name: Optional[str]) -> "TiledGLMObjective":
+        return TiledGLMObjective(
+            self.loss, self.dim, self.norm, axis_name, self.interpret,
+            self.mxu,
+        )

@@ -135,6 +135,28 @@ class GLMOptimizationProblem:
         return create_model(self.task, coefficients)
 
 
+def resolve_kernel(kernel: str, batch=None) -> str:
+    """Resolve the objective-kernel choice: "scatter" | "tiled" | "auto".
+
+    "auto" picks the tiled Pallas kernel pair (7x the scatter throughput,
+    PERF_NOTES.md) when running on TPU with sparse data; the kernels are
+    Mosaic (TPU-only), so every other backend — CPU, GPU — gets scatter.
+    """
+    if kernel not in ("auto", "tiled", "scatter"):
+        raise ValueError(
+            f"unknown kernel {kernel!r}; expected auto | tiled | scatter"
+        )
+    if kernel != "auto":
+        return kernel
+    import jax
+
+    from photon_ml_tpu.data.batch import SparseBatch
+
+    on_tpu = jax.default_backend() == "tpu"
+    sparse_ok = batch is None or isinstance(batch, SparseBatch)
+    return "tiled" if (on_tpu and sparse_ok) else "scatter"
+
+
 def create_glm_problem(
     task,
     dim: int,
@@ -146,15 +168,33 @@ def create_glm_problem(
     compute_variances: bool = False,
     box: Optional[BoxConstraints] = None,
     intercept_index: Optional[int] = None,
+    kernel: str = "scatter",
 ) -> GLMOptimizationProblem:
     """Convenience factory mirroring DistributedGLMLossFunction.create +
-    DistributedOptimizationProblem.create (ModelTraining.scala:123-169)."""
-    objective = GLMObjective(
-        loss_for_task(task),
-        dim,
-        norm if norm is not None else identity_context(),
-        axis_name,
-    )
+    DistributedOptimizationProblem.create (ModelTraining.scala:123-169).
+
+    ``kernel`` selects the objective implementation: "scatter" (gather/
+    scatter GLMObjective, any Batch type) or "tiled" (TiledGLMObjective
+    over a TiledSparseBatch — see photon_ml_tpu.ops.tiled_sparse). Both
+    share the same method contract, so the rest of the problem layer is
+    agnostic.
+    """
+    norm_ctx = norm if norm is not None else identity_context()
+    if kernel == "tiled":
+        import jax
+
+        from photon_ml_tpu.ops.tiled_sparse import TiledGLMObjective
+
+        # Mosaic kernels cannot lower to CPU: an explicit tiled request
+        # there runs in interpret mode (slow, for tests/debugging).
+        objective = TiledGLMObjective(
+            loss_for_task(task), dim, norm_ctx, axis_name,
+            interpret=jax.default_backend() == "cpu",
+        )
+    else:
+        objective = GLMObjective(
+            loss_for_task(task), dim, norm_ctx, axis_name
+        )
     return GLMOptimizationProblem(
         task=task,
         objective=objective,

@@ -25,7 +25,7 @@ from photon_ml_tpu.optim.config import (
     RegularizationContext,
     RegularizationType,
 )
-from photon_ml_tpu.optim.problem import create_glm_problem
+from photon_ml_tpu.optim.problem import create_glm_problem, resolve_kernel
 from photon_ml_tpu.task import TaskType
 
 Array = jnp.ndarray
@@ -49,12 +49,17 @@ def train_generalized_linear_model(
     intercept_index: Optional[int] = None,
     axis_name: Optional[str] = None,
     initial: Optional[Array] = None,
+    kernel: str = "scatter",
 ) -> Tuple[Dict[float, GeneralizedLinearModel], Dict[float, OptResult]]:
     """Train one model per regularization weight with warm starts.
 
     Returns ({lambda: model}, {lambda: OptResult}) — models are in the
     ORIGINAL feature space (normalization un-done), matching
     ModelTraining.trainGeneralizedLinearModel's contract.
+
+    ``kernel``: "scatter" | "tiled" | "auto" — objective implementation
+    (see optim.problem.resolve_kernel). The tiled schedule is built once
+    here and amortized across the whole lambda grid.
     """
     base = OptimizerConfig.default_for(optimizer_type)
     config = OptimizerConfig(
@@ -65,6 +70,22 @@ def train_generalized_linear_model(
         tron_max_cg=base.tron_max_cg,
     )
     regularization = RegularizationContext(regularization_type, elastic_net_alpha)
+    kernel = resolve_kernel(kernel, batch)
+    if kernel == "tiled":
+        from photon_ml_tpu.data.batch import SparseBatch
+        from photon_ml_tpu.ops.tiled_sparse import (
+            TiledSparseBatch,
+            tiled_batch_from_sparse,
+        )
+
+        if isinstance(batch, SparseBatch):
+            batch = tiled_batch_from_sparse(batch, dim)
+        elif not isinstance(batch, TiledSparseBatch):
+            raise TypeError(
+                "kernel='tiled' requires a SparseBatch or TiledSparseBatch, "
+                f"got {type(batch).__name__}; use kernel='scatter' for "
+                "dense batches"
+            )
     problem = create_glm_problem(
         task,
         dim,
@@ -75,6 +96,7 @@ def train_generalized_linear_model(
         compute_variances=compute_variances,
         box=box,
         intercept_index=intercept_index,
+        kernel=kernel,
     )
 
     # Descending order: strongest regularization first, so each warm start

@@ -45,21 +45,23 @@ class TestSchedule:
         assert np.count_nonzero(tb.z_sched.vals) == nnz
         assert np.count_nonzero(tb.g_sched.vals) == nnz
         # monotone output blocks
-        assert np.all(np.diff(tb.z_sched.step_out) >= 0)
-        assert np.all(np.diff(tb.g_sched.step_out) >= 0)
+        z_out = np.asarray(tb.z_sched.step_out)
+        g_out = np.asarray(tb.g_sched.step_out)
+        assert np.all(np.diff(z_out) >= 0)
+        assert np.all(np.diff(g_out) >= 0)
         # init flags exactly at block changes
-        changes = np.nonzero(np.diff(tb.z_sched.step_out) > 0)[0] + 1
-        inits = np.nonzero(tb.z_sched.step_init)[0]
-        assert inits[0] == 0 and set(inits[1:]) == set(changes)
+        changes = np.nonzero(np.diff(z_out) > 0)[0] + 1
+        inits = np.nonzero(np.asarray(tb.z_sched.step_init))[0]
+        assert inits[0] == 0 and set(inits[1:].tolist()) == set(changes.tolist())
 
     def test_window_bounds(self, rng):
         batch, d = random_problem(rng)
         tb = tiled_batch_from_sparse(batch, d, params=PARAMS)
         for sched in (tb.z_sched, tb.g_sched):
-            assert sched.out_hi.max() < PARAMS.s_hi
-            assert sched.out_lo.max() < PARAMS.s_lo
-            assert sched.in_hi.max() < PARAMS.s_hi
-            assert sched.in_lo.max() < PARAMS.s_lo
+            assert int(sched.out_pos.max()) < PARAMS.window
+            assert int(sched.in_pos.max()) < PARAMS.window
+            assert int(sched.out_pos.min()) >= 0
+            assert int(sched.in_pos.min()) >= 0
 
 
 class TestAgainstReferenceObjective:
@@ -67,14 +69,14 @@ class TestAgainstReferenceObjective:
         batch, d = random_problem(rng, **kw)
         obj = GLMObjective(LOGISTIC, d)
         tb = tiled_batch_from_sparse(batch, d, params=PARAMS)
-        tobj = TiledGLMObjective(LOGISTIC, tb, interpret=True)
-        return batch, obj, tobj, d
+        tobj = TiledGLMObjective(LOGISTIC, d, interpret=True, mxu="highest")
+        return batch, obj, tobj, tb, d
 
     def test_value_and_gradient(self, rng):
-        batch, obj, tobj, d = self._pair(rng)
+        batch, obj, tobj, tb, d = self._pair(rng)
         w = jnp.asarray(rng.normal(size=d).astype(np.float32))
         v0, g0 = obj.value_and_gradient(w, batch, 0.3)
-        v1, g1 = tobj.value_and_gradient(w, 0.3)
+        v1, g1 = tobj.value_and_gradient(w, tb, 0.3)
         np.testing.assert_allclose(float(v1), float(v0), rtol=1e-5)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=2e-4)
 
@@ -85,26 +87,26 @@ class TestAgainstReferenceObjective:
         )
         obj = GLMObjective(LOGISTIC, d)
         tb = tiled_batch_from_sparse(batch, d, params=PARAMS)
-        tobj = TiledGLMObjective(LOGISTIC, tb, interpret=True)
+        tobj = TiledGLMObjective(LOGISTIC, d, interpret=True, mxu="highest")
         w = jnp.asarray(rng.normal(size=d).astype(np.float32))
         v0, g0 = obj.value_and_gradient(w, batch, 0.0)
-        v1, g1 = tobj.value_and_gradient(w, 0.0)
+        v1, g1 = tobj.value_and_gradient(w, tb, 0.0)
         np.testing.assert_allclose(float(v1), float(v0), rtol=1e-5)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=2e-4)
 
     def test_hessian_vector(self, rng):
-        batch, obj, tobj, d = self._pair(rng)
+        batch, obj, tobj, tb, d = self._pair(rng)
         w = jnp.asarray(rng.normal(size=d).astype(np.float32))
         u = jnp.asarray(rng.normal(size=d).astype(np.float32))
         hv0 = obj.hessian_vector(w, u, batch, 0.2)
-        hv1 = tobj.hessian_vector(w, u, 0.2)
+        hv1 = tobj.hessian_vector(w, u, tb, 0.2)
         np.testing.assert_allclose(np.asarray(hv1), np.asarray(hv0), atol=2e-4)
 
     def test_hessian_diagonal(self, rng):
-        batch, obj, tobj, d = self._pair(rng)
+        batch, obj, tobj, tb, d = self._pair(rng)
         w = jnp.asarray(rng.normal(size=d).astype(np.float32))
         h0 = obj.hessian_diagonal(w, batch, 0.1)
-        h1 = tobj.hessian_diagonal(w, 0.1)
+        h1 = tobj.hessian_diagonal(w, tb, 0.1)
         np.testing.assert_allclose(np.asarray(h1), np.asarray(h0), atol=2e-4)
 
     def test_linear_loss_and_duplicates(self, rng):
@@ -116,10 +118,10 @@ class TestAgainstReferenceObjective:
         d = 3
         obj = GLMObjective(LINEAR, d)
         tb = tiled_batch_from_sparse(batch, d, params=TileParams(4, 4, 8))
-        tobj = TiledGLMObjective(LINEAR, tb, interpret=True)
+        tobj = TiledGLMObjective(LINEAR, d, interpret=True, mxu="highest")
         w = jnp.asarray([0.3, -0.2, 0.9], jnp.float32)
         v0, g0 = obj.value_and_gradient(w, batch, 0.0)
-        v1, g1 = tobj.value_and_gradient(w, 0.0)
+        v1, g1 = tobj.value_and_gradient(w, tb, 0.0)
         np.testing.assert_allclose(float(v1), float(v0), rtol=1e-6)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=1e-5)
 
@@ -129,9 +131,116 @@ class TestAgainstReferenceObjective:
         obj = GLMObjective(LOGISTIC, d)
         tb = tiled_batch_from_sparse(batch, d, params=PARAMS)
         assert tb.num_feat_blocks >= 8 and tb.num_row_blocks >= 4
-        tobj = TiledGLMObjective(LOGISTIC, tb, interpret=True)
+        tobj = TiledGLMObjective(LOGISTIC, d, interpret=True, mxu="highest")
         w = jnp.asarray(rng.normal(size=d).astype(np.float32))
         v0, g0 = obj.value_and_gradient(w, batch, 0.05)
-        v1, g1 = tobj.value_and_gradient(w, 0.05)
+        v1, g1 = tobj.value_and_gradient(w, tb, 0.05)
         np.testing.assert_allclose(float(v1), float(v0), rtol=1e-5)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=3e-4)
+
+
+class TestNormalizationParity:
+    def test_normalized_matches_scatter_objective(self, rng):
+        from photon_ml_tpu.ops.normalization import NormalizationContext
+
+        batch, d = random_problem(rng)
+        ctx = NormalizationContext(
+            factor=jnp.asarray(rng.uniform(0.5, 2.0, d).astype(np.float32)),
+            shift=jnp.asarray(rng.normal(size=d).astype(np.float32) * 0.1),
+        )
+        obj = GLMObjective(LOGISTIC, d, ctx)
+        tb = tiled_batch_from_sparse(batch, d, params=PARAMS)
+        tobj = TiledGLMObjective(LOGISTIC, d, norm=ctx, interpret=True, mxu="highest")
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        u = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        v0, g0 = obj.value_and_gradient(w, batch, 0.2)
+        v1, g1 = tobj.value_and_gradient(w, tb, 0.2)
+        np.testing.assert_allclose(float(v1), float(v0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=3e-4)
+        hv0 = obj.hessian_vector(w, u, batch, 0.2)
+        hv1 = tobj.hessian_vector(w, u, tb, 0.2)
+        np.testing.assert_allclose(np.asarray(hv1), np.asarray(hv0), atol=3e-4)
+        hd0 = obj.hessian_diagonal(w, batch, 0.1)
+        hd1 = tobj.hessian_diagonal(w, tb, 0.1)
+        np.testing.assert_allclose(np.asarray(hd1), np.asarray(hd0), atol=3e-4)
+
+
+class TestJitArgument:
+    def test_batch_passes_through_jit(self, rng):
+        """The batch must be a pytree jit ARGUMENT (not a baked constant):
+        at ads scale the schedule is hundreds of MB and constant-folding it
+        into the executable breaks compilation."""
+        import jax
+
+        batch, d = random_problem(rng)
+        tb = tiled_batch_from_sparse(batch, d, params=PARAMS)
+        tobj = TiledGLMObjective(LOGISTIC, d, interpret=True, mxu="highest")
+        obj = GLMObjective(LOGISTIC, d)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+
+        fn = jax.jit(tobj.value_and_gradient)
+        v1, g1 = fn(w, tb, 0.1)
+        v0, g0 = obj.value_and_gradient(w, batch, 0.1)
+        np.testing.assert_allclose(float(v1), float(v0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=2e-4)
+
+
+class TestBf16x2Precision:
+    def test_fast_path_within_tolerance(self, rng):
+        """Default bf16x2 MXU mode: ~1e-5 relative error vs exact math."""
+        batch, d = random_problem(rng)
+        tb = tiled_batch_from_sparse(batch, d, params=PARAMS)
+        fast = TiledGLMObjective(LOGISTIC, d, interpret=True)
+        obj = GLMObjective(LOGISTIC, d)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        v0, g0 = obj.value_and_gradient(w, batch, 0.1)
+        v1, g1 = fast.value_and_gradient(w, tb, 0.1)
+        np.testing.assert_allclose(float(v1), float(v0), rtol=1e-4)
+        scale = float(np.max(np.abs(np.asarray(g0)))) + 1e-9
+        np.testing.assert_allclose(
+            np.asarray(g1) / scale, np.asarray(g0) / scale, atol=1e-4
+        )
+
+
+class TestEmptyWindows:
+    def test_empty_feature_window_zero_grad(self, rng):
+        """A feature window with NO entries must yield exactly-zero gradient
+        (on TPU the output buffer is uninitialized unless the schedule
+        names every block — regression test for the missing-init bug)."""
+        win = PARAMS.window
+        d = 3 * win  # three feature windows; the middle one stays empty
+        rows_list, labels = [], []
+        for _ in range(40):
+            lo = rng.choice(win - 1, size=2, replace=False)
+            hi = rng.choice(win - 1, size=2, replace=False) + 2 * win
+            ix = lo.tolist() + hi.tolist()
+            vs = rng.normal(size=4).tolist()
+            labels.append(float(rng.uniform() > 0.5))
+            rows_list.append((ix, vs))
+        batch = make_sparse_batch(rows_list, labels)
+        tb = tiled_batch_from_sparse(batch, d, params=PARAMS)
+        tobj = TiledGLMObjective(LOGISTIC, d, interpret=True, mxu="highest")
+        obj = GLMObjective(LOGISTIC, d)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        v1, g1 = tobj.value_and_gradient(w, tb, 0.0)
+        v0, g0 = obj.value_and_gradient(w, batch, 0.0)
+        np.testing.assert_allclose(float(v1), float(v0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=2e-4)
+        # middle window: identically zero
+        assert np.all(np.asarray(g1[win : 2 * win]) == 0.0)
+
+    def test_all_entries_dropped(self, rng):
+        """Weight-0 rows drop every entry; the schedule must still cover
+        all output blocks instead of crashing on an empty entry set."""
+        batch = make_sparse_batch(
+            [([0, 1], [1.0, 2.0]), ([2], [3.0])],
+            [1.0, 0.0],
+            weights=np.zeros(2),
+        )
+        d = 5
+        tb = tiled_batch_from_sparse(batch, d, params=TileParams(4, 4, 8))
+        tobj = TiledGLMObjective(LOGISTIC, d, interpret=True, mxu="highest")
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        v, g = tobj.value_and_gradient(w, tb, 0.0)
+        assert float(v) == 0.0
+        assert np.all(np.asarray(g) == 0.0)

@@ -1,0 +1,79 @@
+"""Real-TPU (non-interpret) test for the tiled Pallas kernels.
+
+The pytest harness pins everything to virtual CPU devices
+(tests/conftest.py), and the axon TPU backend can only be selected before
+JAX initializes — so this test drives the real chip from a SUBPROCESS with
+the default (TPU) environment. Gated behind PHOTON_TPU_TESTS=1: the
+tunnel's first compile is ~20-40s and CI keeps the suite CPU-only.
+
+Run with:  PHOTON_TPU_TESTS=1 python -m pytest tests/test_tiled_tpu.py -v
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_CHECK = r"""
+import numpy as np, jax, jax.numpy as jnp
+assert any(d.platform != "cpu" for d in jax.devices()), jax.devices()
+from photon_ml_tpu.ops.losses import LOGISTIC
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.tiled_sparse import build_tiled_batch, TiledGLMObjective
+from photon_ml_tpu.data.batch import SparseBatch
+
+rng = np.random.default_rng(0)
+n, k, d = 2048, 16, 20000
+indices = rng.integers(0, d, size=(n, k), dtype=np.int64)
+values = rng.normal(size=(n, k)).astype(np.float32)
+labels = (rng.uniform(size=n) > 0.5).astype(np.float32)
+offsets = rng.normal(size=n).astype(np.float32) * 0.1
+weights = rng.uniform(0.5, 1.5, size=n).astype(np.float32)
+rows = np.repeat(np.arange(n, dtype=np.int64), k)
+tb = build_tiled_batch(rows, indices.reshape(-1), values.reshape(-1),
+                       labels, offsets, weights, d)
+sb = SparseBatch(indices=jnp.asarray(indices.astype(np.int32)),
+                 values=jnp.asarray(values), labels=jnp.asarray(labels),
+                 offsets=jnp.asarray(offsets), weights=jnp.asarray(weights))
+oobj = GLMObjective(LOGISTIC, d)
+w = jnp.asarray(rng.normal(size=d).astype(np.float32) * 0.01)
+for mxu, tol in (("highest", 1e-4), ("bf16x2", 1e-3)):
+    tobj = TiledGLMObjective(LOGISTIC, d, mxu=mxu)
+    v1, g1 = jax.jit(tobj.value_and_gradient)(w, tb, 0.1)
+    v2, g2 = jax.jit(oobj.value_and_gradient)(w, sb, 0.1)
+    ge = float(jnp.max(jnp.abs(g1 - g2)) / (jnp.max(jnp.abs(g2)) + 1e-9))
+    hv1 = jax.jit(tobj.hessian_vector)(w, w * 0.5, tb, 0.1)
+    hv2 = jax.jit(oobj.hessian_vector)(w, w * 0.5, sb, 0.1)
+    he = float(jnp.max(jnp.abs(hv1 - hv2)) / (jnp.max(jnp.abs(hv2)) + 1e-9))
+    hd1 = jax.jit(tobj.hessian_diagonal)(w, tb, 0.1)
+    hd2 = jax.jit(oobj.hessian_diagonal)(w, sb, 0.1)
+    de = float(jnp.max(jnp.abs(hd1 - hd2)) / (jnp.max(jnp.abs(hd2)) + 1e-9))
+    assert max(ge, he, de) < tol, (mxu, ge, he, de)
+print("TPU_TILED_OK")
+"""
+
+
+@pytest.mark.skipif(
+    os.environ.get("PHOTON_TPU_TESTS") != "1",
+    reason="real-TPU test; set PHOTON_TPU_TESTS=1 to run",
+)
+def test_tiled_kernels_on_real_tpu():
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = (
+        repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHECK],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "TPU_TILED_OK" in proc.stdout

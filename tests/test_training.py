@@ -186,3 +186,53 @@ class TestSamplers:
         assert float(np.asarray(out.weights).sum()) == pytest.approx(
             float(np.asarray(batch.weights).sum()), rel=0.2
         )
+
+
+class TestKernelSwitch:
+    """The tiled/scatter kernel switch must not change training results
+    (task 'single construction switch' — optim.problem.create_glm_problem)."""
+
+    def test_tiled_training_matches_scatter(self, rng):
+        import numpy as np
+        import jax.numpy as jnp
+        from photon_ml_tpu.data.batch import make_sparse_batch
+        from photon_ml_tpu.task import TaskType
+        from photon_ml_tpu.training import train_generalized_linear_model
+
+        n, d, k = 120, 40, 5
+        rows, labels = [], []
+        w_true = rng.normal(size=d)
+        for _ in range(n):
+            ix = rng.choice(d, size=k, replace=False)
+            vs = rng.normal(size=k)
+            z = float((w_true[ix] * vs).sum())
+            labels.append(float(rng.uniform() < 1 / (1 + np.exp(-z))))
+            rows.append((ix.tolist(), vs.tolist()))
+        batch = make_sparse_batch(rows, labels)
+
+        kwargs = dict(
+            regularization_weights=[1.0, 0.1],
+            max_iter=25,
+        )
+        m_sc, _ = train_generalized_linear_model(
+            batch, TaskType.LOGISTIC_REGRESSION, d, kernel="scatter", **kwargs
+        )
+        m_ti, _ = train_generalized_linear_model(
+            batch, TaskType.LOGISTIC_REGRESSION, d, kernel="tiled", **kwargs
+        )
+        for lam in m_sc:
+            # bf16x2 gradient noise (~1e-5/eval) compounds over the L-BFGS
+            # trajectory; solutions agree to ~0.2% relative, which is well
+            # inside statistical noise for a fitted GLM.
+            np.testing.assert_allclose(
+                np.asarray(m_ti[lam].coefficients.means),
+                np.asarray(m_sc[lam].coefficients.means),
+                rtol=0.02, atol=1e-2,
+            )
+
+    def test_auto_resolves_scatter_on_cpu(self):
+        from photon_ml_tpu.optim.problem import resolve_kernel
+
+        assert resolve_kernel("auto") == "scatter"  # tests run on CPU
+        assert resolve_kernel("tiled") == "tiled"
+        assert resolve_kernel("scatter") == "scatter"
