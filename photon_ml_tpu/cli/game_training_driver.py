@@ -110,12 +110,19 @@ class GameTrainingParams:
     evaluator_types: List[EvaluatorType] = field(default_factory=list)
     compute_variance: bool = False
     delete_output_dir_if_exists: bool = False
+    # "auto": fixed-effect solves run data-parallel under shard_map and
+    # random-effect banks shard their entity axis whenever >1 device is
+    # visible (cli/game/training/Driver.scala is cluster-by-construction);
+    # "off": single-device
+    distributed: str = "auto"
 
     def validate(self) -> None:
         if not self.train_input_dirs:
             raise ValueError("train-input-dirs is required")
         if not self.output_dir:
             raise ValueError("output-dir is required")
+        if self.distributed not in ("auto", "off"):
+            raise ValueError(f"unknown distributed mode {self.distributed!r}")
         coords = set(self.fixed_effect_data_configs) | set(
             self.random_effect_data_configs
         )
@@ -169,6 +176,13 @@ class GameTrainingDriver:
 
     # -- coordinates -------------------------------------------------------
 
+    def _mesh(self):
+        """Data-parallel/entity-parallel mesh over all visible devices;
+        None when single-device or --distributed off."""
+        from photon_ml_tpu.parallel.mesh import maybe_make_mesh
+
+        return maybe_make_mesh(self.params.distributed)
+
     def _build_coordinates(
         self,
         dataset: GameDataset,
@@ -176,6 +190,7 @@ class GameTrainingDriver:
         opt_combo: Dict[str, GLMOptimizationConfiguration],
     ):
         p = self.params
+        mesh = self._mesh()
         coords = {}
         for name, dcfg in p.fixed_effect_data_configs.items():
             ocfg = opt_combo[name]
@@ -194,6 +209,7 @@ class GameTrainingDriver:
                 feature_shard_id=dcfg.feature_shard_id,
                 reg_weight=ocfg.reg_weight,
                 down_sampling_rate=ocfg.down_sampling_rate,
+                mesh=mesh,
             )
         loss = loss_for_task(p.task_type)
         for name, dcfg in p.random_effect_data_configs.items():
@@ -204,6 +220,7 @@ class GameTrainingDriver:
                 ocfg.optimizer_config,
                 ocfg.regularization,
                 reg_weight=ocfg.reg_weight,
+                mesh=mesh,
             )
             if name in p.factored_re_configs:
                 fcfg = p.factored_re_configs[name]
@@ -465,6 +482,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--evaluator-types", default=None)
     ap.add_argument("--compute-variance", default="false")
     ap.add_argument("--delete-output-dir-if-exists", default="false")
+    ap.add_argument(
+        "--distributed", default="auto", choices=["auto", "off"],
+        help="shard FE data axis + RE entity axis over all devices",
+    )
     return ap
 
 
@@ -522,6 +543,7 @@ def params_from_args(argv=None) -> GameTrainingParams:
         ),
         compute_variance=_bool(ns.compute_variance),
         delete_output_dir_if_exists=_bool(ns.delete_output_dir_if_exists),
+        distributed=ns.distributed,
     )
 
 

@@ -104,6 +104,10 @@ class GLMParams:
     # objective kernel: "auto" (tiled Pallas on accelerators, scatter on
     # CPU), "tiled", or "scatter" — see optim.problem.resolve_kernel
     kernel: str = "auto"
+    # "auto": train data-parallel under shard_map whenever >1 device is
+    # visible (the reference is distributed by construction — every Spark
+    # driver runs on a cluster); "off": single-device
+    distributed: str = "auto"
 
     def validate(self) -> None:
         """Cross-field checks (Params.validate, Params.scala:200-222)."""
@@ -111,6 +115,10 @@ class GLMParams:
             raise ValueError("training-data-directory is required")
         if not self.output_dir:
             raise ValueError("output-directory is required")
+        if self.kernel not in ("auto", "tiled", "scatter"):
+            raise ValueError(f"unknown kernel {self.kernel!r}")
+        if self.distributed not in ("auto", "off"):
+            raise ValueError(f"unknown distributed mode {self.distributed!r}")
         if self.optimizer_type == OptimizerType.TRON and self.regularization_type in (
             RegularizationType.L1,
             RegularizationType.ELASTIC_NET,
@@ -213,11 +221,23 @@ class GLMDriver:
                 self._write_summary(p.summarization_output_dir)
         self._advance(DriverStage.PREPROCESSED)
 
+    def _mesh(self):
+        """Data-parallel mesh over all visible devices (Driver.scala's
+        cluster-by-construction analog); None when single-device or off."""
+        from photon_ml_tpu.parallel.mesh import maybe_make_mesh
+
+        return maybe_make_mesh(self.params.distributed)
+
     def train(self) -> None:
         p = self.params
         self.emitter.send(TrainingStartEvent(p.job_name))
         with self.timer.time("train"):
             data = self._data
+            mesh = self._mesh()
+            if mesh is not None:
+                self.logger.info(
+                    "training data-parallel over %d devices", mesh.devices.size
+                )
             self.models, self.results = train_generalized_linear_model(
                 data.batch,
                 p.task,
@@ -233,6 +253,7 @@ class GLMDriver:
                 box=data.constraints,
                 intercept_index=data.intercept_index,
                 kernel=p.kernel,
+                mesh=mesh,
             )
             for lam, res in self.results.items():
                 self.emitter.send(
@@ -425,6 +446,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--kernel", default="auto", choices=["auto", "tiled", "scatter"],
         help="objective kernel (auto: tiled Pallas on accelerators)",
     )
+    ap.add_argument(
+        "--distributed", default="auto", choices=["auto", "off"],
+        help="data-parallel training over all devices (auto: when >1)",
+    )
     return ap
 
 
@@ -459,6 +484,7 @@ def params_from_args(argv=None) -> GLMParams:
         delete_output_dirs_if_exist=_bool(ns.delete_output_dirs_if_exist),
         job_name=ns.job_name,
         kernel=ns.kernel,
+        distributed=ns.distributed,
         event_listeners=(
             ns.event_listeners.split(",") if ns.event_listeners else []
         ),

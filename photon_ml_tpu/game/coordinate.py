@@ -76,6 +76,9 @@ class FixedEffectCoordinate(Coordinate):
     reg_weight: float = 0.0
     down_sampling_rate: float = 1.0
     sampler_seed: int = 0
+    # data-parallel mesh for the global solve (FixedEffectCoordinate runs
+    # distributed by construction in the reference; None = single device)
+    mesh: Optional[object] = None
 
     def initialize_model(self) -> FixedEffectModel:
         dim = self.dataset.shards[self.feature_shard_id].dim
@@ -100,10 +103,12 @@ class FixedEffectCoordinate(Coordinate):
                 self.down_sampling_rate,
                 initial=initial,
                 reg_weight=self.reg_weight,
+                mesh=self.mesh,
             )
         else:
             coefficients, result = self.problem.run(
-                batch, initial=initial, reg_weight=self.reg_weight
+                batch, initial=initial, reg_weight=self.reg_weight,
+                mesh=self.mesh,
             )
         return (
             FixedEffectModel(

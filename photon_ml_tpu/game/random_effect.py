@@ -127,15 +127,43 @@ def _bucket_solver(
 class RandomEffectOptimizationProblem:
     """One solver config shared by all entities (the reference materializes
     an RDD of identical per-entity problems; here the per-entity state is
-    just the bank row)."""
+    just the bank row).
+
+    ``mesh``: when set, every bucket's entity axis is sharded over the
+    mesh's first axis — the expert-parallel analog of the reference's
+    entity co-partitioning (RandomEffectDataSetPartitioner.scala:62-95).
+    Load balance is by construction: a bucket's entities share one padded
+    capacity, so equal-count splits are equal-cost (the reference needs a
+    greedy partitioner because its per-entity costs vary).
+    """
 
     loss: PointwiseLoss
     config: OptimizerConfig
     regularization: RegularizationContext
     reg_weight: float = 0.0
+    mesh: Optional[object] = None
 
     def __post_init__(self):
         self._solver = _bucket_solver(self.loss, self.config, self.regularization)
+
+    def _shard_entity_axis(self, arrays):
+        """Pad arrays' leading (entity) dim to the mesh axis size and place
+        them entity-sharded; returns (padded arrays, real length)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+        axis = mesh.axis_names[0]
+        n_dev = int(mesh.shape[axis])
+        sharding = NamedSharding(mesh, P(axis))
+        e = arrays[0].shape[0]
+        e_pad = ((e + n_dev - 1) // n_dev) * n_dev
+        out = []
+        for a in arrays:
+            if e_pad != e:
+                pad = jnp.zeros((e_pad - e,) + a.shape[1:], a.dtype)
+                a = jnp.concatenate([a, pad])
+            out.append(jax.device_put(a, sharding))
+        return out, e
 
     def update_bank(
         self,
@@ -155,16 +183,27 @@ class RandomEffectOptimizationProblem:
                 off = residual_offsets[safe_rows].astype(np.float32)
                 off = np.where(bucket.row_index >= 0, off, 0.0)
             sl = bank[jnp.asarray(bucket.entity_codes)]
-            new_sl, iters, reasons = self._solver(
+            args = [
                 sl,
                 jnp.asarray(bucket.indices),
                 jnp.asarray(bucket.values),
                 jnp.asarray(bucket.labels),
                 jnp.asarray(off),
                 jnp.asarray(bucket.weights),
+            ]
+            n_real = sl.shape[0]
+            if self.mesh is not None:
+                # padded entities carry zero data: their solve converges at
+                # iteration 0 on a zero gradient — inert and cheap
+                args, n_real = self._shard_entity_axis(args)
+            new_sl, iters, reasons = self._solver(
+                *args,
                 jnp.float32(l1),
                 jnp.float32(l2),
             )
+            new_sl = new_sl[:n_real]
+            iters = iters[:n_real]
+            reasons = reasons[:n_real]
             bank = bank.at[jnp.asarray(bucket.entity_codes)].set(new_sl)
             iters_all.append(np.asarray(iters))
             reasons_all.append(np.asarray(reasons))

@@ -68,10 +68,19 @@ class GLMOptimizationProblem:
         batch: Batch,
         initial: Optional[Array] = None,
         reg_weight: float = 0.0,
+        mesh=None,
     ) -> Tuple[Coefficients, OptResult]:
         """Optimize and build coefficients (+ variances if requested).
 
         Mirrors GeneralizedLinearOptimizationProblem.run:112-121.
+
+        With ``mesh`` set, the ENTIRE optimize loop runs inside one
+        shard_map program: the batch is row-padded and sharded over the
+        mesh's "data" axis, coefficients are replicated, and the
+        objective psums its partials — the treeAggregate analog
+        (ValueAndGradientAggregator.scala:235-250), but with per-iteration
+        reductions riding ICI instead of one cluster round-trip per Breeze
+        evaluation.
         """
         w0 = (
             jnp.zeros((self.objective.dim,), jnp.float32)
@@ -86,19 +95,76 @@ class GLMOptimizationProblem:
             box=self.box,
             l1_mask=self._l1_mask(),
         )
-
-        def vg(w):
-            return self.objective.value_and_gradient(w, batch, l2)
-
-        def hvp(w, d):
-            return self.objective.hessian_vector(w, d, batch, l2)
-
         needs_hvp = self.config.optimizer_type == OptimizerType.TRON
-        result = optimize(vg, w0, l1_weight=l1, hvp_fn=hvp if needs_hvp else None)
+
+        if mesh is None:
+            objective = self.objective
+
+            def vg(w):
+                return objective.value_and_gradient(w, batch, l2)
+
+            def hvp(w, d):
+                return objective.hessian_vector(w, d, batch, l2)
+
+            result = optimize(
+                vg, w0, l1_weight=l1, hvp_fn=hvp if needs_hvp else None
+            )
+            variances = None
+            if self.compute_variances:
+                hdiag = objective.hessian_diagonal(
+                    result.coefficients, batch, l2
+                )
+                variances = 1.0 / (hdiag + _VARIANCE_EPSILON)
+            return Coefficients(result.coefficients, variances), result
+
+        from functools import partial as _partial
+
+        import jax
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS, ensure_data_sharded
+
+        axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
+        objective = self.objective.with_axis(axis)
+        sharded = ensure_data_sharded(batch, mesh, axis)
+        l1_arr = jnp.float32(l1)
+        l2_arr = jnp.float32(l2)
+
+        @_partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(), P(axis), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        def _fit(w0_, b, l1_, l2_):
+            def vg(w):
+                return objective.value_and_gradient(w, b, l2_)
+
+            def hvp(w, d):
+                return objective.hessian_vector(w, d, b, l2_)
+
+            return optimize(
+                vg, w0_, l1_weight=l1_, hvp_fn=hvp if needs_hvp else None
+            )
+
+        result = _fit(w0, sharded, l1_arr, l2_arr)
 
         variances = None
         if self.compute_variances:
-            hdiag = self.objective.hessian_diagonal(result.coefficients, batch, l2)
+
+            @_partial(
+                shard_map,
+                mesh=mesh,
+                in_specs=(P(), P(axis), P()),
+                out_specs=P(),
+                check_vma=False,
+            )
+            def _hdiag(w, b, l2_):
+                return objective.hessian_diagonal(w, b, l2_)
+
+            hdiag = _hdiag(result.coefficients, sharded, l2_arr)
             variances = 1.0 / (hdiag + _VARIANCE_EPSILON)
         return Coefficients(result.coefficients, variances), result
 
@@ -109,11 +175,12 @@ class GLMOptimizationProblem:
         down_sampling_rate: float,
         initial: Optional[Array] = None,
         reg_weight: float = 0.0,
+        mesh=None,
     ) -> Tuple[Coefficients, OptResult]:
         """Apply the task's down-sampler first (runWithSampling:112-124)."""
         if down_sampling_rate < 1.0:
             batch = down_sample(key, batch, down_sampling_rate, self.task)
-        return self.run(batch, initial, reg_weight)
+        return self.run(batch, initial, reg_weight, mesh=mesh)
 
     def create_model(
         self,

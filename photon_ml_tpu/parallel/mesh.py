@@ -61,5 +61,72 @@ def shard_batch(batch, mesh: Mesh, axis: str = DATA_AXIS):
     return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
 
 
+def pad_batch_rows(batch, multiple: int):
+    """Pad a Sparse/Dense batch's row axis up to a multiple (weight-0
+    padding rows are inert in every objective — the Spark-partition-
+    remainder analog). Returns the batch unchanged if already aligned."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.batch import DenseBatch, SparseBatch
+
+    n = batch.labels.shape[0]
+    n_pad = ((n + multiple - 1) // multiple) * multiple
+    if n_pad == n:
+        return batch
+    extra = n_pad - n
+
+    def pad1(a):
+        return jnp.concatenate([a, jnp.zeros((extra,), a.dtype)])
+
+    if isinstance(batch, SparseBatch):
+        return SparseBatch(
+            indices=jnp.concatenate(
+                [batch.indices,
+                 jnp.zeros((extra, batch.indices.shape[1]), batch.indices.dtype)]
+            ),
+            values=jnp.concatenate(
+                [batch.values,
+                 jnp.zeros((extra, batch.values.shape[1]), batch.values.dtype)]
+            ),
+            labels=pad1(batch.labels),
+            offsets=pad1(batch.offsets),
+            weights=pad1(batch.weights),
+        )
+    if isinstance(batch, DenseBatch):
+        return DenseBatch(
+            features=jnp.concatenate(
+                [batch.features,
+                 jnp.zeros((extra, batch.features.shape[1]), batch.features.dtype)]
+            ),
+            labels=pad1(batch.labels),
+            offsets=pad1(batch.offsets),
+            weights=pad1(batch.weights),
+        )
+    raise TypeError(f"cannot row-pad {type(batch).__name__}")
+
+
 def replicate(tree, mesh: Mesh):
     return jax.tree.map(lambda a: jax.device_put(a, replicated(mesh)), tree)
+
+
+def ensure_data_sharded(batch, mesh: Mesh, axis: str = DATA_AXIS):
+    """Idempotent pad+shard: returns the batch unchanged when its rows are
+    already sharded over ``axis`` on this mesh (so a lambda-grid loop pays
+    the host->device transfer once, not once per regularization weight)."""
+    sharding = data_sharding(mesh, axis)
+    if getattr(batch.labels, "sharding", None) == sharding:
+        return batch
+    n_shards = int(mesh.shape[axis])
+    return shard_batch(pad_batch_rows(batch, n_shards), mesh, axis)
+
+
+def maybe_make_mesh(distributed: str) -> Optional[Mesh]:
+    """Shared driver policy: "auto" -> 1-D data mesh over all devices when
+    more than one is visible, else None; "off" -> None."""
+    if distributed not in ("auto", "off"):
+        raise ValueError(
+            f"unknown distributed mode {distributed!r}; expected auto | off"
+        )
+    if distributed == "off" or len(jax.devices()) < 2:
+        return None
+    return make_mesh()

@@ -11,6 +11,7 @@ runtime scalar).
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -50,6 +51,7 @@ def train_generalized_linear_model(
     axis_name: Optional[str] = None,
     initial: Optional[Array] = None,
     kernel: str = "scatter",
+    mesh=None,
 ) -> Tuple[Dict[float, GeneralizedLinearModel], Dict[float, OptResult]]:
     """Train one model per regularization weight with warm starts.
 
@@ -60,6 +62,11 @@ def train_generalized_linear_model(
     ``kernel``: "scatter" | "tiled" | "auto" — objective implementation
     (see optim.problem.resolve_kernel). The tiled schedule is built once
     here and amortized across the whole lambda grid.
+
+    ``mesh``: a jax.sharding.Mesh for data-parallel training — the whole
+    L-BFGS/OWLQN/TRON loop runs under shard_map with the batch sharded
+    over the "data" axis (the treeAggregate analog). The tiled kernel's
+    schedules are whole-batch, so mesh currently implies scatter.
     """
     base = OptimizerConfig.default_for(optimizer_type)
     config = OptimizerConfig(
@@ -71,6 +78,19 @@ def train_generalized_linear_model(
     )
     regularization = RegularizationContext(regularization_type, elastic_net_alpha)
     kernel = resolve_kernel(kernel, batch)
+    if mesh is not None and kernel == "tiled":
+        # Tiled schedules are built for the whole batch; per-shard schedule
+        # stacking is future work — distributed runs use the scatter path.
+        logging.getLogger(__name__).warning(
+            "kernel='tiled' is not yet supported with a mesh; falling back "
+            "to the scatter objective for this distributed run"
+        )
+        kernel = "scatter"
+    if mesh is not None:
+        # shard (and row-pad) once; every lambda reuses the device copies
+        from photon_ml_tpu.parallel.mesh import ensure_data_sharded
+
+        batch = ensure_data_sharded(batch, mesh)
     if kernel == "tiled":
         from photon_ml_tpu.data.batch import SparseBatch
         from photon_ml_tpu.ops.tiled_sparse import (
@@ -107,7 +127,9 @@ def train_generalized_linear_model(
     results: Dict[float, OptResult] = {}
     current = initial
     for lam in weights_desc:
-        coefficients, result = problem.run(batch, initial=current, reg_weight=lam)
+        coefficients, result = problem.run(
+            batch, initial=current, reg_weight=lam, mesh=mesh
+        )
         models[lam] = problem.create_model(coefficients, normalization)
         results[lam] = result
         if warm_start:

@@ -66,9 +66,10 @@ def _np_oracle(x, y, off, w, coef, loss, l2, factor=None, shift=None):
     return val, grad, d2, xe, hdiag
 
 
+@pytest.mark.parametrize("kernel", ["scatter", "tiled"])
 @pytest.mark.parametrize("loss", [losses.LOGISTIC, losses.LINEAR, losses.POISSON], ids=lambda l: l.name)
 @pytest.mark.parametrize("norm", ["none", "scale", "standardize"])
-def test_value_grad_hv_hdiag_vs_oracle(rng, loss, norm):
+def test_value_grad_hv_hdiag_vs_oracle(rng, loss, norm, kernel):
     x, y, off, w = _data(rng)
     coef = rng.normal(size=DIM).astype(np.float32) * 0.3
     d = rng.normal(size=DIM).astype(np.float32)
@@ -89,8 +90,22 @@ def test_value_grad_hv_hdiag_vs_oracle(rng, loss, norm):
     val_o, grad_o, d2_o, xe, hdiag_o = _np_oracle(x, y, off, w, coef, loss, l2, factor, shift)
     hv_o = xe.T @ ((w * d2_o) * (xe @ d)) + l2 * d
 
-    obj = GLMObjective(loss=loss, dim=DIM, norm=ctx)
     batch = make_sparse_batch(_to_sparse_rows(x), y, off, w)
+    if kernel == "tiled":
+        from photon_ml_tpu.ops.tiled_sparse import (
+            TileParams,
+            TiledGLMObjective,
+            tiled_batch_from_sparse,
+        )
+
+        obj = TiledGLMObjective(
+            loss, DIM, norm=ctx, interpret=True, mxu="highest"
+        )
+        batch = tiled_batch_from_sparse(
+            batch, DIM, params=TileParams(8, 8, 32)
+        )
+    else:
+        obj = GLMObjective(loss=loss, dim=DIM, norm=ctx)
 
     val = obj.value(jnp.asarray(coef), batch, l2)
     v2, grad = obj.value_and_gradient(jnp.asarray(coef), batch, l2)
@@ -172,3 +187,42 @@ def test_build_normalization_types(rng):
     assert build_normalization(
         NormalizationType.NONE, mean=mean, std=std, max_magnitude=mx
     ).is_identity
+
+
+def test_tron_and_box_through_problem_layer_with_tiled_kernel(rng):
+    """TRON (hessian_vector-driven) and box constraints must work through
+    GLMOptimizationProblem with the tiled objective — same contract as the
+    scatter kernel (task: tiled/scatter construction switch parity)."""
+    from photon_ml_tpu.optim.common import BoxConstraints
+    from photon_ml_tpu.optim.config import OptimizerConfig, OptimizerType
+    from photon_ml_tpu.optim.problem import create_glm_problem
+    from photon_ml_tpu.ops.tiled_sparse import tiled_batch_from_sparse
+    from photon_ml_tpu.task import TaskType
+
+    x, y, off, w = _data(rng)
+    batch = make_sparse_batch(_to_sparse_rows(x), y, off, w)
+    lower = np.full(DIM, -0.5, np.float32)
+    upper = np.full(DIM, 0.5, np.float32)
+    box = BoxConstraints(jnp.asarray(lower), jnp.asarray(upper))
+    config = OptimizerConfig(optimizer_type=OptimizerType.TRON, max_iter=10)
+
+    results = {}
+    for kernel in ("scatter", "tiled"):
+        problem = create_glm_problem(
+            TaskType.LOGISTIC_REGRESSION, DIM,
+            config=config, box=box, kernel=kernel,
+        )
+        b = (
+            tiled_batch_from_sparse(batch, DIM)
+            if kernel == "tiled" else batch
+        )
+        coefficients, result = problem.run(b, reg_weight=0.5)
+        means = np.asarray(coefficients.means)
+        assert np.all(means >= lower - 1e-6) and np.all(means <= upper + 1e-6)
+        results[kernel] = (means, float(result.value))
+    np.testing.assert_allclose(
+        results["tiled"][0], results["scatter"][0], rtol=0.02, atol=1e-2
+    )
+    np.testing.assert_allclose(
+        results["tiled"][1], results["scatter"][1], rtol=1e-3
+    )
