@@ -151,8 +151,12 @@ def backtracking_line_search(
     shrink: float = 0.5,
     max_steps: int = 24,
     project: Optional[Callable[[Array], Array]] = None,
+    vdot: Callable[[Array, Array], Array] = jnp.vdot,
 ) -> LineSearchResult:
     """Armijo backtracking, optionally projecting each trial point.
+
+    ``vdot`` may be a mesh-global dot (psum over a model axis) so the same
+    search runs over sharded coefficient blocks.
 
     The reference delegates to Breeze's StrongWolfeLineSearch; here a
     projected-backtracking search plus a cautious-update rule in the L-BFGS
@@ -169,7 +173,7 @@ def backtracking_line_search(
     def armijo_ok(w_t, f_t):
         # Armijo on the projected point: f_t <= f + c1 * g.(w_t - w)
         # (for unconstrained this reduces to the usual f + c1 t g.d).
-        return (f_t <= f + c1 * jnp.vdot(g, w_t - w)) & jnp.isfinite(f_t)
+        return (f_t <= f + c1 * vdot(g, w_t - w)) & jnp.isfinite(f_t)
 
     # The Armijo test lives in `cond` (pure arithmetic) so each loop trip
     # costs exactly ONE objective evaluation — the accepted unit step pays

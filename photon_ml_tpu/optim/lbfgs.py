@@ -63,7 +63,29 @@ def _empty_memory(m: int, d: int, dtype) -> _Memory:
     )
 
 
-def _two_loop_direction(g: Array, mem: _Memory) -> Array:
+def make_global_prims(axis_name: Optional[str]):
+    """(vdot, norm, vsum) primitives — mesh-global when ``axis_name`` is
+    set (psum over that axis), plain otherwise. Passing these through the
+    optimizer makes the SAME L-BFGS program run over feature-sharded
+    coefficient blocks: vectors stay device-local, only scalars cross the
+    mesh (the reduce-scatter recipe of SURVEY §2.3's coefficient
+    parallelism)."""
+    if axis_name is None:
+        return jnp.vdot, jnp.linalg.norm, jnp.sum
+
+    def vdot(a, b):
+        return lax.psum(jnp.vdot(a, b), axis_name)
+
+    def norm(a):
+        return jnp.sqrt(jnp.maximum(vdot(a, a), 0.0))
+
+    def vsum(a):
+        return lax.psum(jnp.sum(a), axis_name)
+
+    return vdot, norm, vsum
+
+
+def _two_loop_direction(g: Array, mem: _Memory, vdot=jnp.vdot) -> Array:
     """Classic two-loop recursion over the circular buffer; returns -H~ g."""
     m = mem.s.shape[0]
     alphas = jnp.zeros((m,), g.dtype)
@@ -72,31 +94,31 @@ def _two_loop_direction(g: Array, mem: _Memory) -> Array:
         q, alphas = carry
         idx = jnp.mod(mem.ptr - 1 - i, m)
         valid = i < mem.length
-        a = jnp.where(valid, mem.rho[idx] * jnp.vdot(mem.s[idx], q), 0.0)
+        a = jnp.where(valid, mem.rho[idx] * vdot(mem.s[idx], q), 0.0)
         q = q - a * mem.y[idx]
         return q, alphas.at[idx].set(a)
 
     q, alphas = lax.fori_loop(0, m, backward, (g, alphas))
 
     last = jnp.mod(mem.ptr - 1, m)
-    ys = jnp.vdot(mem.s[last], mem.y[last])
-    yy = jnp.vdot(mem.y[last], mem.y[last])
+    ys = vdot(mem.s[last], mem.y[last])
+    yy = vdot(mem.y[last], mem.y[last])
     gamma = jnp.where(mem.length > 0, ys / jnp.maximum(yy, 1e-30), 1.0)
     r = gamma * q
 
     def forward(i, r):
         idx = jnp.mod(mem.ptr - mem.length + i, m)
         valid = i < mem.length
-        b = jnp.where(valid, mem.rho[idx] * jnp.vdot(mem.y[idx], r), 0.0)
+        b = jnp.where(valid, mem.rho[idx] * vdot(mem.y[idx], r), 0.0)
         return r + jnp.where(valid, alphas[idx] - b, 0.0) * mem.s[idx]
 
     r = lax.fori_loop(0, m, forward, r)
     return -r
 
 
-def _update_memory(mem: _Memory, s: Array, y: Array) -> _Memory:
+def _update_memory(mem: _Memory, s: Array, y: Array, vdot=jnp.vdot) -> _Memory:
     """Cautious update: store the pair only when y.s > eps (keeps H~ PD)."""
-    ys = jnp.vdot(y, s)
+    ys = vdot(y, s)
     ok = ys > 1e-10
     ptr = mem.ptr
     new = _Memory(
@@ -128,36 +150,43 @@ def minimize_lbfgs(
     history: int = 10,
     box: Optional[BoxConstraints] = None,
     ls_max_steps: int = 24,
+    axis_name: Optional[str] = None,
 ) -> OptResult:
     """Minimize a smooth objective. jit/vmap/shard_map-safe.
 
     Defaults mirror LBFGS.scala:152-156 (maxIter=100, m=10, tol=1e-7).
+
+    ``axis_name``: run over a FEATURE-SHARDED coefficient block inside
+    shard_map — w0 (and every state vector) is this device's block, and
+    all inner products / norms psum over the axis, so the optimizer is
+    numerically identical to its replicated self with fully sharded state.
     """
+    vdot, norm, _ = make_global_prims(axis_name)
     project = (lambda w: box.project(w)) if box is not None else None
     w0 = w0 if project is None else project(w0)
     f0, g0 = value_and_grad_fn(w0)
-    g0_norm = jnp.linalg.norm(g0)
+    g0_norm = norm(g0)
 
     def cond(st: _LoopState):
         return st.reason == NOT_CONVERGED
 
     def body(st: _LoopState):
-        d = _two_loop_direction(st.g, st.mem)
+        d = _two_loop_direction(st.g, st.mem, vdot)
         # Fall back to steepest descent if d is not a descent direction.
-        descent = jnp.vdot(d, st.g) < 0
+        descent = vdot(d, st.g) < 0
         d = jnp.where(descent, d, -st.g)
         t0 = jnp.where(
             st.mem.length > 0,
             jnp.ones((), st.f.dtype),
-            1.0 / jnp.maximum(jnp.linalg.norm(d), 1.0),
+            1.0 / jnp.maximum(norm(d), 1.0),
         )
         ls = backtracking_line_search(
             value_and_grad_fn, st.w, st.f, st.g, d, t0,
-            max_steps=ls_max_steps, project=project,
+            max_steps=ls_max_steps, project=project, vdot=vdot,
         )
-        mem = _update_memory(st.mem, ls.w - st.w, ls.g - st.g)
+        mem = _update_memory(st.mem, ls.w - st.w, ls.g - st.g, vdot)
         it = st.iteration + 1
-        g_norm = jnp.linalg.norm(ls.g)
+        g_norm = norm(ls.g)
         # A failed line search means no further progress is possible; check
         # BEFORE the function-change test (a stalled search has Δf == 0 and
         # would otherwise masquerade as convergence).
@@ -188,7 +217,7 @@ def minimize_lbfgs(
     return OptResult(
         coefficients=final.w,
         value=final.f,
-        grad_norm=jnp.linalg.norm(final.g),
+        grad_norm=norm(final.g),
         iterations=final.iteration,
         reason=final.reason,
         tracker=final.tracker,
