@@ -15,9 +15,12 @@ from photon_ml_tpu.parallel.mesh import (
     shard_batch,
 )
 from photon_ml_tpu.parallel.distributed import (
+    FeatureShardedSparseBatch,
     data_parallel_fit_lbfgs,
     data_parallel_value_and_grad,
+    feature_shard_sparse_batch,
     feature_sharded_fit,
+    feature_sharded_sparse_fit,
     feature_sharded_value_and_grad,
 )
 
@@ -29,8 +32,11 @@ __all__ = [
     "replicate",
     "replicated",
     "shard_batch",
+    "FeatureShardedSparseBatch",
     "data_parallel_fit_lbfgs",
     "data_parallel_value_and_grad",
+    "feature_shard_sparse_batch",
     "feature_sharded_fit",
+    "feature_sharded_sparse_fit",
     "feature_sharded_value_and_grad",
 ]

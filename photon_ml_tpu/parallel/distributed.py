@@ -21,7 +21,7 @@ SingleNode objectives (SURVEY L2/L3).
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable, Optional, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -137,6 +137,22 @@ def feature_sharded_value_and_grad(
     return vg
 
 
+def _opt_result_specs(model_axis: str) -> OptResult:
+    """out_specs pytree for an OptResult whose coefficient vector is sharded
+    over ``model_axis`` while every scalar/trace is replicated (scalars are
+    psum'ed mesh-global inside the optimizer, so they agree on all ranks)."""
+    from photon_ml_tpu.optim.common import Tracker
+
+    return OptResult(
+        coefficients=P(model_axis),
+        value=P(),
+        grad_norm=P(),
+        iterations=P(),
+        reason=P(),
+        tracker=Tracker(values=P(), grad_norms=P(), count=P()),
+    )
+
+
 def feature_sharded_fit(
     objective: GLMObjective,
     mesh: Mesh,
@@ -151,6 +167,11 @@ def feature_sharded_fit(
     ([m, d_block] memories, w block) lives SHARDED on every device; the only
     cross-block traffic per iteration is the margin psum and the scalar
     reductions inside the two-loop recursion (vdots psum over model axis).
+
+    Runs the UNMODIFIED ``minimize_lbfgs`` with ``axis_name=model_axis`` —
+    the same program as the replicated/single-chip path, so convergence
+    rules, trackers, and cautious updates cannot diverge. Returns a full
+    OptResult (coefficients sharded over ``model_axis``).
     """
     loss = objective.loss
 
@@ -158,7 +179,7 @@ def feature_sharded_fit(
         shard_map,
         mesh=mesh,
         in_specs=(P(model_axis), P(data_axis, model_axis), P(data_axis), P(data_axis), P(data_axis), P()),
-        out_specs=P(model_axis),
+        out_specs=_opt_result_specs(model_axis),
         check_vma=False,
     )
     def fit(w0_block, x_block, labels, offsets, weights, l2):
@@ -170,119 +191,224 @@ def feature_sharded_fit(
             w_sq = jax.lax.psum(jnp.vdot(w_block, w_block), model_axis)
             return value + 0.5 * l2 * w_sq, grad_block + l2 * w_block
 
-        return _block_lbfgs(vg, w0_block, model_axis, max_iter, tol, history)
+        return minimize_lbfgs(
+            vg, w0_block, max_iter=max_iter, tol=tol, history=history,
+            axis_name=model_axis,
+        )
 
     return fit
 
 
-def _block_lbfgs(vg, w0, model_axis, max_iter, tol, history):
-    """L-BFGS whose inner products psum over the model axis — numerically
-    identical to replicated L-BFGS, state fully sharded."""
-    from jax import lax
+# ---------------------------------------------------------------------------
+# Sparse feature sharding (the 10B-coefficient layout)
+# ---------------------------------------------------------------------------
 
-    def gdot(a, b):
-        return lax.psum(jnp.vdot(a, b), model_axis)
 
-    def gnorm(a):
-        return jnp.sqrt(gdot(a, a))
+class FeatureShardedSparseBatch(NamedTuple):
+    """A SparseBatch re-laid-out for 2-D (data x model) sharding.
 
-    m = history
-    d = w0.shape[0]
-    f0, g0 = vg(w0)
-    g0_norm = gnorm(g0)
+    At the 10B-coefficient north star the data is sparse by definition
+    (SURVEY §2.3 "coefficient parallelism"); the dense [n, d] layout above
+    cannot even be materialized. Here each feature block owns the entries
+    whose feature id falls in its slice of the (padded) vocabulary:
 
-    def two_loop(g, s_h, y_h, rho, length, ptr):
-        alphas = jnp.zeros((m,), g.dtype)
+    - ``indices[M, n, kb]`` int32 — BLOCK-LOCAL feature ids (global id
+      minus block offset); slot (m, i, :) holds row i's entries landing in
+      block m, zero-padded.
+    - ``values[M, n, kb]`` — matching values, zero-padded (a padded slot
+      contributes 0 * w_block[0]).
+    - ``labels/offsets/weights[n]`` — row metadata, sharded over "data".
 
-        def backward(i, carry):
-            q, alphas = carry
-            idx = jnp.mod(ptr - 1 - i, m)
-            valid = i < length
-            a = jnp.where(valid, rho[idx] * gdot(s_h[idx], q), 0.0)
-            return q - a * y_h[idx], alphas.at[idx].set(a)
+    Leading axis M shards over "model", rows shard over "data", so the
+    shard_map block is [1, n/Dd, kb]. kb is the max per-(row, block) entry
+    count — for hashed/uniform feature ids kb ~ k/M; worst case k.
+    """
 
-        q, alphas = lax.fori_loop(0, m, backward, (g, alphas))
-        last = jnp.mod(ptr - 1, m)
-        ys = gdot(s_h[last], y_h[last])
-        yy = gdot(y_h[last], y_h[last])
-        gamma = jnp.where(length > 0, ys / jnp.maximum(yy, 1e-30), 1.0)
-        r = gamma * q
+    indices: Array  # int32 [M, n, kb] block-local
+    values: Array  # float [M, n, kb]
+    labels: Array  # [n]
+    offsets: Array  # [n]
+    weights: Array  # [n]
 
-        def forward(i, r):
-            idx = jnp.mod(ptr - length + i, m)
-            valid = i < length
-            b = jnp.where(valid, rho[idx] * gdot(y_h[idx], r), 0.0)
-            return r + jnp.where(valid, alphas[idx] - b, 0.0) * s_h[idx]
+    @property
+    def num_blocks(self) -> int:
+        return self.indices.shape[0]
 
-        return -lax.fori_loop(0, m, forward, r)
+    @property
+    def num_rows(self) -> int:
+        return self.indices.shape[1]
 
-    def line_search(w, f, g, direction, t0):
-        def trial(t):
-            w_t = w + t * direction
-            f_t, g_t = vg(w_t)
-            return w_t, f_t, g_t
 
-        def ok_fn(w_t, f_t):
-            return (f_t <= f + 1e-4 * gdot(g, w_t - w)) & jnp.isfinite(f_t)
+def feature_shard_sparse_batch(
+    batch,
+    dim: int,
+    num_blocks: int,
+    *,
+    rows_multiple: int = 1,
+    pad_nnz_to: int = 8,
+) -> Tuple[FeatureShardedSparseBatch, int]:
+    """Host-side re-layout of a SparseBatch into per-feature-block slabs.
 
-        def cond(state):
-            _, w_t, f_t, _, k = state
-            return (~ok_fn(w_t, f_t)) & (k < 24)
+    Returns (sharded_batch, block_dim) with block_dim = ceil(dim /
+    num_blocks) rounded so every block covers an equal slice; the sharded
+    coefficient vector has length num_blocks * block_dim (callers pad /
+    slice against ``dim``). The partition is the static analog of the
+    reference's hash-partitioned feature vocabulary
+    (FeatureIndexingJob.scala:90-136) — but by contiguous range, so a
+    block's ids gather from a dense local window.
+    """
+    import numpy as np
 
-        def body(state):
-            t, _, _, _, k = state
-            t2 = t * 0.5
-            w_n, f_n, g_n = trial(t2)
-            return (t2, w_n, f_n, g_n, k + 1)
+    idx = np.asarray(batch.indices)
+    val = np.asarray(batch.values)
+    n, k = idx.shape
+    n_pad = ((n + rows_multiple - 1) // rows_multiple) * rows_multiple
+    block_dim = -(-dim // num_blocks)
 
-        w1, f1, g1 = trial(t0)
-        t, w_t, f_t, g_t, _ = lax.while_loop(
-            cond, body, (t0, w1, f1, g1, jnp.zeros((), jnp.int32))
-        )
-        ok = ok_fn(w_t, f_t)
-        return (
-            jnp.where(ok, 1.0, 0.0),
-            jnp.where(ok, w_t, w),
-            jnp.where(ok, f_t, f),
-            jnp.where(ok, g_t, g),
-        )
+    block_of = idx // block_dim  # [n, k]
+    local = idx - block_of * block_dim
+    # Entries with value exactly 0 (padding) are inert wherever they land;
+    # route them to block 0 so kb reflects real entries only.
+    real = val != 0.0
+    block_of = np.where(real, block_of, 0)
 
-    def cond(st):
-        (w, f, g, s_h, y_h, rho, length, ptr, it, done) = st
-        return ~done
+    # Vectorized routing: rank each real entry within its (block, row)
+    # group via a stable sort; one scatter builds all slabs at once.
+    rows_b = np.broadcast_to(np.arange(n)[:, None], (n, k))
+    flat_key = (block_of * n + rows_b).ravel()  # group id per entry
+    order = np.argsort(flat_key + (~real).ravel() * (num_blocks * n), kind="stable")
+    sorted_key = flat_key[order]
+    n_real = int(real.sum())
+    group_start = np.searchsorted(sorted_key[:n_real], sorted_key[:n_real], side="left")
+    slot = np.arange(n_real) - group_start  # rank within group
 
-    def body(st):
-        (w, f, g, s_h, y_h, rho, length, ptr, it, done) = st
-        direction = two_loop(g, s_h, y_h, rho, length, ptr)
-        descent = gdot(direction, g) < 0
-        direction = jnp.where(descent, direction, -g)
-        t0 = jnp.where(length > 0, 1.0, 1.0 / jnp.maximum(gnorm(direction), 1.0))
-        ok, w2, f2, g2 = line_search(w, f, g, direction, t0)
-        s = w2 - w
-        y = g2 - g
-        ys = gdot(y, s)
-        store = ys > 1e-10
-        s_h2 = jnp.where(store, s_h.at[ptr].set(s), s_h)
-        y_h2 = jnp.where(store, y_h.at[ptr].set(y), y_h)
-        rho2 = jnp.where(store, rho.at[ptr].set(1.0 / jnp.maximum(ys, 1e-30)), rho)
-        length2 = jnp.where(store, jnp.minimum(length + 1, m), length)
-        ptr2 = jnp.where(store, jnp.mod(ptr + 1, m), ptr)
-        it2 = it + 1
-        converged = (
-            (jnp.abs(f2 - f) <= tol * jnp.abs(f0))
-            | (gnorm(g2) <= tol * g0_norm)
-            | (it2 >= max_iter)
-            | (ok == 0.0)
-        )
-        return (w2, f2, g2, s_h2, y_h2, rho2, length2, ptr2, it2, converged)
+    counts = np.bincount(flat_key[real.ravel()], minlength=num_blocks * n)
+    kb = int(max(counts.max(initial=0), 1))
+    kb = ((kb + pad_nnz_to - 1) // pad_nnz_to) * pad_nnz_to
 
-    init = (
-        w0, f0, g0,
-        jnp.zeros((m, d), w0.dtype), jnp.zeros((m, d), w0.dtype),
-        jnp.zeros((m,), w0.dtype),
-        jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
-        jnp.zeros((), jnp.int32),
-        g0_norm == 0.0,
+    out_idx = np.zeros((num_blocks, n_pad, kb), np.int32)
+    out_val = np.zeros((num_blocks, n_pad, kb), val.dtype)
+    sel = order[:n_real]
+    b_sel = block_of.ravel()[sel]
+    r_sel = rows_b.ravel()[sel]
+    out_idx[b_sel, r_sel, slot] = local.ravel()[sel]
+    out_val[b_sel, r_sel, slot] = val.ravel()[sel]
+
+    def pad_rows(a):
+        if n_pad == n:
+            return a
+        return np.concatenate([a, np.zeros((n_pad - n,), a.dtype)])
+
+    sharded = FeatureShardedSparseBatch(
+        indices=jnp.asarray(out_idx),
+        values=jnp.asarray(out_val),
+        labels=jnp.asarray(pad_rows(np.asarray(batch.labels))),
+        offsets=jnp.asarray(pad_rows(np.asarray(batch.offsets))),
+        weights=jnp.asarray(pad_rows(np.asarray(batch.weights))),
     )
-    final = jax.lax.while_loop(cond, body, init)
-    return final[0]
+    return sharded, block_dim
+
+
+def _sparse_shard_specs(model_axis: str, data_axis: str):
+    return (
+        P(model_axis),
+        FeatureShardedSparseBatch(
+            indices=P(model_axis, data_axis),
+            values=P(model_axis, data_axis),
+            labels=P(data_axis),
+            offsets=P(data_axis),
+            weights=P(data_axis),
+        ),
+        P(),
+    )
+
+
+def _sparse_block_vg(loss, b, l2, model_axis: str, data_axis: str):
+    """Block-local (value, grad) closure shared by the sparse-sharded
+    value_and_grad and fit entry points. ``b`` is this device's shard:
+    one feature block x its rows."""
+    assert b.indices.shape[0] == 1, (
+        f"got {b.indices.shape[0]} feature blocks per device; "
+        "num_blocks passed to feature_shard_sparse_batch must equal the "
+        "mesh's model-axis size"
+    )
+    idx = b.indices[0]  # [n_loc, kb] block-local
+    val = b.values[0]
+
+    def vg(w_block):
+        z = jax.lax.psum(
+            jnp.sum(val * w_block[idx], axis=-1), model_axis
+        ) + b.offsets
+        c = b.weights * loss.d1(z, b.labels)
+        value = jax.lax.psum(
+            jnp.sum(b.weights * loss.value(z, b.labels)), data_axis
+        )
+        grad_block = jax.lax.psum(
+            jnp.zeros_like(w_block).at[idx].add(c[:, None] * val), data_axis
+        )
+        w_sq = jax.lax.psum(jnp.vdot(w_block, w_block), model_axis)
+        return value + 0.5 * l2 * w_sq, grad_block + l2 * w_block
+
+    return vg
+
+
+def feature_sharded_sparse_value_and_grad(
+    objective: GLMObjective,
+    mesh: Mesh,
+    *,
+    data_axis: str = DATA_AXIS,
+    model_axis: str = MODEL_AXIS,
+) -> Callable:
+    """(w, sharded_batch, l2) -> (value, grad) over the sparse 2-D layout;
+    value replicated, grad sharded over ``model_axis``."""
+    loss = objective.loss
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=_sparse_shard_specs(model_axis, data_axis),
+        out_specs=(P(), P(model_axis)),
+        check_vma=False,
+    )
+    def vg(w_block, b, l2):
+        return _sparse_block_vg(loss, b, l2, model_axis, data_axis)(w_block)
+
+    return vg
+
+
+def feature_sharded_sparse_fit(
+    objective: GLMObjective,
+    mesh: Mesh,
+    *,
+    data_axis: str = DATA_AXIS,
+    model_axis: str = MODEL_AXIS,
+    max_iter: int = 50,
+    tol: float = 1e-7,
+    history: int = 10,
+) -> Callable:
+    """L-BFGS over a feature-sharded coefficient vector with SPARSE data.
+
+    ``fit(w0, sharded_batch, l2) -> OptResult``; ``w0`` is the full
+    [num_blocks * block_dim] vector (sharded over ``model_axis`` by
+    shard_map), the batch comes from :func:`feature_shard_sparse_batch`.
+    Per evaluation: one psum of partial margins over the model axis + one
+    psum of the block gradient over the data axis; gradient and optimizer
+    state never leave their block's devices.
+    """
+    loss = objective.loss
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=_sparse_shard_specs(model_axis, data_axis),
+        out_specs=_opt_result_specs(model_axis),
+        check_vma=False,
+    )
+    def fit(w0_block, b, l2):
+        return minimize_lbfgs(
+            _sparse_block_vg(loss, b, l2, model_axis, data_axis),
+            w0_block, max_iter=max_iter, tol=tol, history=history,
+            axis_name=model_axis,
+        )
+
+    return fit

@@ -102,12 +102,72 @@ class TestFeatureSharded:
         batch = make_dense_batch(x, y)
         obj = GLMObjective(LOGISTIC, d)
         fit = feature_sharded_fit(obj, mesh4x2, max_iter=50)
-        w = fit(jnp.zeros(d), batch.features, batch.labels, batch.offsets,
-                batch.weights, jnp.float32(0.1))
+        res = fit(jnp.zeros(d), batch.features, batch.labels, batch.offsets,
+                  batch.weights, jnp.float32(0.1))
         local = minimize_lbfgs(
             lambda w_: obj.value_and_gradient(w_, batch, 0.1),
             jnp.zeros(d), max_iter=50,
         )
         np.testing.assert_allclose(
-            np.asarray(w), np.asarray(local.coefficients), atol=5e-3
+            np.asarray(res.coefficients), np.asarray(local.coefficients),
+            atol=5e-3,
+        )
+        # Shared optimizer => identical convergence bookkeeping shape.
+        np.testing.assert_allclose(
+            float(res.value), float(local.value), rtol=1e-5
+        )
+        assert int(res.iterations) > 0
+
+    def test_sparse_sharded_fit_matches_replicated(self, mesh4x2, rng):
+        from photon_ml_tpu.parallel import (
+            feature_shard_sparse_batch,
+            feature_sharded_sparse_fit,
+        )
+
+        # d chosen NOT to divide into equal blocks so d_pad > d and the
+        # padded-slot assertion below is non-vacuous.
+        batch, _ = sparse_problem(rng, n=128, d=45, k=8)
+        d = 45
+        obj = GLMObjective(LOGISTIC, d)
+        sharded, block_dim = feature_shard_sparse_batch(
+            batch, d, num_blocks=2, rows_multiple=4
+        )
+        d_pad = 2 * block_dim
+        assert d_pad > d
+        fit = feature_sharded_sparse_fit(obj, mesh4x2, max_iter=50)
+        res = fit(jnp.zeros(d_pad), sharded, jnp.float32(0.1))
+        local = minimize_lbfgs(
+            lambda w_: obj.value_and_gradient(w_, batch, 0.1),
+            jnp.zeros(d), max_iter=50,
+        )
+        np.testing.assert_allclose(
+            np.asarray(res.coefficients)[:d],
+            np.asarray(local.coefficients), atol=5e-3,
+        )
+        # Padded vocabulary slots never see data => exactly zero.
+        np.testing.assert_array_equal(np.asarray(res.coefficients)[d:], 0.0)
+
+    def test_sparse_sharded_value_and_grad_exact(self, mesh4x2, rng):
+        from photon_ml_tpu.parallel import (
+            feature_shard_sparse_batch,
+            feature_sharded_sparse_fit,  # noqa: F401 (import check)
+        )
+        from photon_ml_tpu.parallel.distributed import (
+            feature_sharded_sparse_value_and_grad,
+        )
+
+        batch, _ = sparse_problem(rng, n=64, d=40, k=8)
+        d = 40
+        obj = GLMObjective(LOGISTIC, d)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        v_local, g_local = obj.value_and_gradient(w, batch, 0.2)
+        sharded, block_dim = feature_shard_sparse_batch(
+            batch, d, num_blocks=2, rows_multiple=4
+        )
+        w_pad = jnp.zeros(2 * block_dim).at[:d].set(w)
+        vg = feature_sharded_sparse_value_and_grad(obj, mesh4x2)
+        v, g = vg(w_pad, sharded, jnp.float32(0.2))
+        np.testing.assert_allclose(float(v), float(v_local), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(g)[:d], np.asarray(g_local), atol=1e-4
         )
