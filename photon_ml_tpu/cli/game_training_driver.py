@@ -92,6 +92,12 @@ class GameTrainingParams:
     train_input_dirs: List[str] = field(default_factory=list)
     validate_input_dirs: Optional[List[str]] = None
     output_dir: str = ""
+    # Dated-input coordinates (Params.scala:44-82): with a range set, each
+    # input dir is expected in daily format <dir>/daily/yyyy/MM/dd.
+    train_date_range: Optional[str] = None
+    train_date_range_days_ago: Optional[str] = None
+    validate_date_range: Optional[str] = None
+    validate_date_range_days_ago: Optional[str] = None
     task_type: TaskType = TaskType.LOGISTIC_REGRESSION
     feature_shards: List[FeatureShardConfiguration] = field(default_factory=list)
     fixed_effect_data_configs: Dict[str, FixedEffectDataConfiguration] = field(
@@ -123,6 +129,13 @@ class GameTrainingParams:
             raise ValueError("output-dir is required")
         if self.distributed not in ("auto", "off"):
             raise ValueError(f"unknown distributed mode {self.distributed!r}")
+        # Exclusivity AND range-string format validated up front.
+        from photon_ml_tpu.utils.date_range import resolve_date_range
+
+        resolve_date_range(self.train_date_range, self.train_date_range_days_ago)
+        resolve_date_range(
+            self.validate_date_range, self.validate_date_range_days_ago
+        )
         coords = set(self.fixed_effect_data_configs) | set(
             self.random_effect_data_configs
         )
@@ -155,6 +168,24 @@ class GameTrainingDriver:
         self.best_config = None
 
     # -- data --------------------------------------------------------------
+
+    def _expand_dated(self, dirs, date_range, days_ago):
+        """IOUtils.getInputPathsWithinDateRange analog over the input-dir
+        list; identity when no range is configured."""
+        from photon_ml_tpu.utils.date_range import (
+            input_paths_within_date_range,
+            resolve_date_range,
+        )
+
+        rng = resolve_date_range(date_range, days_ago)
+        if rng is None:
+            return list(dirs)
+        paths = input_paths_within_date_range(list(dirs), rng)
+        self.logger.info(
+            "date range %s expanded %d dir(s) to %d daily paths",
+            rng, len(list(dirs)), len(paths),
+        )
+        return paths
 
     def _load_dataset(self, dirs: Sequence[str], index_maps=None) -> GameDataset:
         records = read_avro_records(list(dirs))
@@ -381,7 +412,12 @@ class GameTrainingDriver:
     def run(self) -> None:
         p = self.params
         with self.timer.time("load-train"):
-            dataset = self._load_dataset(p.train_input_dirs)
+            dataset = self._load_dataset(
+                self._expand_dated(
+                    p.train_input_dirs, p.train_date_range,
+                    p.train_date_range_days_ago,
+                )
+            )
         self._train_dataset = dataset
         self.logger.info(
             "GAME train data: %d rows, shards %s",
@@ -400,7 +436,13 @@ class GameTrainingDriver:
                 index_maps = {
                     s: d.index_map for s, d in dataset.shards.items()
                 }
-                vdata = self._load_dataset(p.validate_input_dirs, index_maps)
+                vdata = self._load_dataset(
+                    self._expand_dated(
+                        p.validate_input_dirs, p.validate_date_range,
+                        p.validate_date_range_days_ago,
+                    ),
+                    index_maps,
+                )
             validation_fn = self._validation_fn(vdata)
 
         combos = expand_config_grid(
@@ -470,6 +512,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--train-input-dirs", required=True)
     ap.add_argument("--output-dir", required=True)
     ap.add_argument("--validate-input-dirs", default=None)
+    ap.add_argument("--train-date-range", default=None,
+                    help="yyyyMMdd-yyyyMMdd; expects <dir>/daily/yyyy/MM/dd")
+    ap.add_argument("--train-date-range-days-ago", default=None,
+                    help="start-end days ago, e.g. 90-1")
+    ap.add_argument("--validate-date-range", default=None)
+    ap.add_argument("--validate-date-range-days-ago", default=None)
     ap.add_argument("--task-type", default="LOGISTIC_REGRESSION")
     ap.add_argument("--feature-shard-id-to-feature-section-keys-map", required=True)
     ap.add_argument("--fixed-effect-data-configurations", default="")
@@ -518,6 +566,10 @@ def params_from_args(argv=None) -> GameTrainingParams:
         validate_input_dirs=(
             ns.validate_input_dirs.split(",") if ns.validate_input_dirs else None
         ),
+        train_date_range=ns.train_date_range,
+        train_date_range_days_ago=ns.train_date_range_days_ago,
+        validate_date_range=ns.validate_date_range,
+        validate_date_range_days_ago=ns.validate_date_range_days_ago,
         output_dir=ns.output_dir,
         task_type=TaskType.parse(ns.task_type),
         feature_shards=parse_shard_map(

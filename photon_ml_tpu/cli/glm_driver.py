@@ -82,6 +82,16 @@ class GLMParams:
     train_dir: str = ""
     output_dir: str = ""
     validate_dir: Optional[str] = None
+    # Dated-input coordinates (DateRange.scala / IOUtils.scala:84+): when a
+    # range is given the directory is expected in daily format
+    # <dir>/daily/yyyy/MM/dd and expands to the days in range.
+    train_date_range: Optional[str] = None
+    train_date_range_days_ago: Optional[str] = None
+    validate_date_range: Optional[str] = None
+    validate_date_range_days_ago: Optional[str] = None
+    # Per-iteration validation metrics (validatePerIteration,
+    # Driver.scala:329-372); requires a validation directory.
+    validate_per_iteration: bool = False
     task: TaskType = TaskType.LOGISTIC_REGRESSION
     input_format: str = "AVRO"  # AVRO | LIBSVM
     add_intercept: bool = True
@@ -138,6 +148,18 @@ class GLMParams:
             )
         if any(w < 0 for w in self.regularization_weights):
             raise ValueError("regularization weights must be non-negative")
+        # Exclusivity AND range-string format validated up front (a
+        # malformed range should fail here, not mid-preprocess).
+        from photon_ml_tpu.utils.date_range import resolve_date_range
+
+        resolve_date_range(self.train_date_range, self.train_date_range_days_ago)
+        resolve_date_range(
+            self.validate_date_range, self.validate_date_range_days_ago
+        )
+        if self.validate_per_iteration and not self.validate_dir:
+            raise ValueError(
+                "validate-per-iteration requires a validating data directory"
+            )
 
 
 class GLMDriver:
@@ -178,6 +200,7 @@ class GLMDriver:
         self.best_model = None
         self.best_lambda: Optional[float] = None
         self.validation_metrics: Dict[float, Dict[str, float]] = {}
+        self.per_iteration_metrics: Dict[float, List[Dict[str, float]]] = {}
         self._data = None
         self._norm: Optional[NormalizationContext] = None
         self._summary = None
@@ -201,7 +224,10 @@ class GLMDriver:
                 selected_features=selected,
             )
             self._fmt = fmt
-            data = fmt.load(p.train_dir, constraint_string=p.constraint_string)
+            train_paths = self._dated_paths(
+                p.train_dir, p.train_date_range, p.train_date_range_days_ago
+            )
+            data = fmt.load(train_paths, constraint_string=p.constraint_string)
             self._data = data
             self.logger.info(
                 "loaded %d examples, %d features",
@@ -220,6 +246,25 @@ class GLMDriver:
             if p.summarization_output_dir:
                 self._write_summary(p.summarization_output_dir)
         self._advance(DriverStage.PREPROCESSED)
+
+    def _dated_paths(self, base_dir, date_range, days_ago):
+        """Expand a base dir to its daily paths when a date range is given
+        (IOUtils.getInputPathsWithinDateRange analog); otherwise the dir
+        itself."""
+        from photon_ml_tpu.utils.date_range import (
+            input_paths_within_date_range,
+            resolve_date_range,
+        )
+
+        rng = resolve_date_range(date_range, days_ago)
+        if rng is None:
+            return base_dir
+        paths = input_paths_within_date_range(base_dir, rng)
+        self.logger.info(
+            "date range %s expanded %s to %d daily paths", rng, base_dir,
+            len(paths),
+        )
+        return paths
 
     def _mesh(self):
         """Data-parallel mesh over all visible devices (Driver.scala's
@@ -254,6 +299,7 @@ class GLMDriver:
                 intercept_index=data.intercept_index,
                 kernel=p.kernel,
                 mesh=mesh,
+                track_models=p.validate_per_iteration,
             )
             for lam, res in self.results.items():
                 self.emitter.send(
@@ -301,9 +347,17 @@ class GLMDriver:
     def validate(self) -> None:
         p = self.params
         with self.timer.time("validate"):
-            vdata = self._fmt.load(p.validate_dir, index_map=self._data.index_map)
+            validate_paths = self._dated_paths(
+                p.validate_dir, p.validate_date_range,
+                p.validate_date_range_days_ago,
+            )
+            vdata = self._fmt.load(
+                validate_paths, index_map=self._data.index_map
+            )
             sanity_check_data(vdata.batch, p.task, p.data_validation_type)
             self._validation_data = vdata
+            if p.validate_per_iteration:
+                self._validate_per_iteration(vdata)
             # Select by AUC for classification, RMSE/loss otherwise
             # (ModelSelection.scala:36-63).
             maximize = p.task == TaskType.LOGISTIC_REGRESSION
@@ -326,6 +380,27 @@ class GLMDriver:
                     best = (lam, model, score)
             self.best_lambda, self.best_model, _ = best
         self._advance(DriverStage.VALIDATED)
+
+    def _validate_per_iteration(self, vdata) -> None:
+        """Metrics for every (lambda, iteration) model
+        (computeAndLogModelMetrics, Driver.scala:330-349)."""
+        from photon_ml_tpu.training import iteration_models
+
+        p = self.params
+        for lam, result in self.results.items():
+            models = iteration_models(
+                result, p.task, self._norm, self._data.intercept_index
+            )
+            per_iter = [self._metrics_for(m, vdata.batch) for m in models]
+            self.per_iteration_metrics[lam] = per_iter
+            msg = "\n".join(
+                f"Iteration: [{i:6d}] " + " ".join(
+                    f"Metric: [{k}] value: {v}"
+                    for k, v in sorted(metrics.items())
+                )
+                for i, metrics in enumerate(per_iter)
+            )
+            self.logger.info("Model with lambda = %g:\n%s", lam, msg)
 
     def diagnose(self) -> None:
         """Model diagnostics + HTML report (Driver.scala:525-552, 618-638)."""
@@ -389,6 +464,10 @@ class GLMDriver:
                     "validation": {
                         str(k): v for k, v in self.validation_metrics.items()
                     },
+                    "per_iteration_validation": {
+                        str(k): v
+                        for k, v in self.per_iteration_metrics.items()
+                    },
                     "best_lambda": self.best_lambda,
                     "timers": self.timer.durations,
                 },
@@ -423,6 +502,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--training-data-directory", required=True)
     ap.add_argument("--output-directory", required=True)
     ap.add_argument("--validating-data-directory", default=None)
+    ap.add_argument("--train-date-range", default=None,
+                    help="yyyyMMdd-yyyyMMdd; expects <dir>/daily/yyyy/MM/dd")
+    ap.add_argument("--train-date-range-days-ago", default=None,
+                    help="start-end days ago, e.g. 90-1")
+    ap.add_argument("--validate-date-range", default=None)
+    ap.add_argument("--validate-date-range-days-ago", default=None)
+    ap.add_argument("--validate-per-iteration", default="false")
     ap.add_argument("--task", default="LOGISTIC_REGRESSION")
     ap.add_argument("--format", default="AVRO", help="AVRO | LIBSVM")
     ap.add_argument("--intercept", default="true")
@@ -463,6 +549,11 @@ def params_from_args(argv=None) -> GLMParams:
         train_dir=ns.training_data_directory,
         output_dir=ns.output_directory,
         validate_dir=ns.validating_data_directory,
+        train_date_range=ns.train_date_range,
+        train_date_range_days_ago=ns.train_date_range_days_ago,
+        validate_date_range=ns.validate_date_range,
+        validate_date_range_days_ago=ns.validate_date_range_days_ago,
+        validate_per_iteration=_bool(ns.validate_per_iteration),
         task=TaskType.parse(ns.task),
         input_format=ns.format,
         add_intercept=_bool(ns.intercept),

@@ -434,21 +434,10 @@ def read_container(path: str) -> Tuple[SchemaType, Iterator[dict]]:
 
 def read_avro_records(paths: Union[str, List[str]]) -> Iterator[dict]:
     """Iterate records across one or many container files / directories
-    (AvroUtils.readAvroFiles analog; directories expand to their *.avro)."""
-    if isinstance(paths, str):
-        paths = [paths]
-    expanded: List[str] = []
-    for p in paths:
-        if os.path.isdir(p):
-            expanded.extend(
-                sorted(
-                    os.path.join(p, fn)
-                    for fn in os.listdir(p)
-                    if fn.endswith(".avro")
-                )
-            )
-        else:
-            expanded.append(p)
-    for p in expanded:
+    (AvroUtils.readAvroFiles analog; directories expand to their *.avro,
+    skipping hidden/marker files)."""
+    from photon_ml_tpu.io.paths import expand_input_paths
+
+    for p in expand_input_paths(paths, lambda fn: fn.endswith(".avro")):
         _, it = read_container(p)
         yield from it

@@ -40,10 +40,12 @@ def parse_libsvm_line(
 def read_libsvm(
     paths, *, zero_based: bool = False
 ) -> Iterator[Tuple[float, List[Tuple[int, float]]]]:
-    """Iterate (label, [(index, value)]) over one or many files."""
-    if isinstance(paths, str):
-        paths = [paths]
-    for path in paths:
+    """Iterate (label, [(index, value)]) over one or many files;
+    directories expand to their visible regular files (hidden and
+    underscore-marker files like _SUCCESS are skipped)."""
+    from photon_ml_tpu.io.paths import expand_input_paths
+
+    for path in expand_input_paths(paths):
         with open(path, "r", encoding="utf-8") as f:
             for line in f:
                 parsed = parse_libsvm_line(line, zero_based=zero_based)

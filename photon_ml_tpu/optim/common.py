@@ -60,28 +60,45 @@ class Tracker(NamedTuple):
 
     The TPU-native OptimizationStatesTracker: slot i holds (value, ||g||,
     elapsed-iteration marker) for iteration i; ``count`` marks the filled
-    prefix. Coefficient-per-iteration tracking (ModelTracker) is handled by
-    the problem layer re-running with `return_history`.
+    prefix. ``coefs`` (the ModelTracker analog) optionally stacks the
+    coefficient vector per iteration — enabled by the optimizers'
+    ``track_coefficients`` flag; None keeps the while_loop state small for
+    the common case (and for vmapped entity banks).
     """
 
     values: Array  # [cap]
     grad_norms: Array  # [cap]
     count: Array  # int32
+    coefs: Optional[Array] = None  # [cap, d] when tracking models
 
     @staticmethod
-    def create(capacity: int, dtype=jnp.float32) -> "Tracker":
+    def create(
+        capacity: int, dtype=jnp.float32, coef_dim: Optional[int] = None
+    ) -> "Tracker":
         return Tracker(
             values=jnp.zeros((capacity,), dtype),
             grad_norms=jnp.zeros((capacity,), dtype),
             count=jnp.zeros((), jnp.int32),
+            coefs=(
+                None
+                if coef_dim is None
+                else jnp.zeros((capacity, coef_dim), dtype)
+            ),
         )
 
-    def record(self, value: Array, grad_norm: Array) -> "Tracker":
+    def record(
+        self, value: Array, grad_norm: Array, coef: Optional[Array] = None
+    ) -> "Tracker":
         i = jnp.minimum(self.count, self.values.shape[0] - 1)
         return Tracker(
             values=self.values.at[i].set(value),
             grad_norms=self.grad_norms.at[i].set(grad_norm),
             count=self.count + 1,
+            coefs=(
+                self.coefs
+                if self.coefs is None or coef is None
+                else self.coefs.at[i].set(coef)
+            ),
         )
 
 

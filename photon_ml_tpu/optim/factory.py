@@ -51,6 +51,7 @@ def make_optimizer(
     loss_has_hessian: bool = True,
     box: Optional[BoxConstraints] = None,
     l1_mask: Optional[Array] = None,
+    track_coefficients: bool = False,
 ) -> Callable[..., OptResult]:
     """Build ``optimize(value_and_grad_fn, w0, l1_weight=0.0, hvp_fn=None)``.
 
@@ -85,6 +86,7 @@ def make_optimizer(
                 tol=config.tolerance,
                 max_cg=config.tron_max_cg,
                 box=box,
+                track_coefficients=track_coefficients,
             )
         if use_owlqn:
             return minimize_owlqn(
@@ -95,6 +97,7 @@ def make_optimizer(
                 tol=config.tolerance,
                 history=config.lbfgs_history,
                 l1_mask=l1_mask,
+                track_coefficients=track_coefficients,
             )
         return minimize_lbfgs(
             value_and_grad_fn,
@@ -103,6 +106,7 @@ def make_optimizer(
             tol=config.tolerance,
             history=config.lbfgs_history,
             box=box,
+            track_coefficients=track_coefficients,
         )
 
     return optimize

@@ -151,6 +151,7 @@ def minimize_lbfgs(
     box: Optional[BoxConstraints] = None,
     ls_max_steps: int = 24,
     axis_name: Optional[str] = None,
+    track_coefficients: bool = False,
 ) -> OptResult:
     """Minimize a smooth objective. jit/vmap/shard_map-safe.
 
@@ -199,7 +200,9 @@ def minimize_lbfgs(
         ).astype(jnp.int32)
         return _LoopState(
             w=ls.w, f=ls.f, g=ls.g, mem=mem, iteration=it, reason=reason,
-            tracker=st.tracker.record(ls.f, g_norm),
+            tracker=st.tracker.record(
+                ls.f, g_norm, ls.w if track_coefficients else None
+            ),
         )
 
     init = _LoopState(
@@ -211,7 +214,10 @@ def minimize_lbfgs(
         reason=jnp.where(
             g0_norm == 0.0, GRADIENT_WITHIN_TOLERANCE, NOT_CONVERGED
         ).astype(jnp.int32),
-        tracker=Tracker.create(max_iter + 1, w0.dtype).record(f0, g0_norm),
+        tracker=Tracker.create(
+            max_iter + 1, w0.dtype,
+            coef_dim=w0.shape[0] if track_coefficients else None,
+        ).record(f0, g0_norm, w0 if track_coefficients else None),
     )
     final = lax.while_loop(cond, body, init)
     return OptResult(
@@ -247,6 +253,7 @@ def minimize_owlqn(
     history: int = 10,
     l1_mask: Optional[Array] = None,
     ls_max_steps: int = 24,
+    track_coefficients: bool = False,
 ) -> OptResult:
     """Minimize smooth(w) + l1_weight * ||w||_1 (OWL-QN).
 
@@ -311,7 +318,9 @@ def minimize_owlqn(
         ).astype(jnp.int32)
         return _LoopState(
             w=ls.w, f=f_smooth_new, g=ls.g, mem=mem, iteration=it,
-            reason=reason, tracker=st.tracker.record(ls.f, pg_norm),
+            reason=reason, tracker=st.tracker.record(
+                ls.f, pg_norm, ls.w if track_coefficients else None
+            ),
         )
 
     init = _LoopState(
@@ -323,7 +332,10 @@ def minimize_owlqn(
         reason=jnp.where(
             g0_norm == 0.0, GRADIENT_WITHIN_TOLERANCE, NOT_CONVERGED
         ).astype(jnp.int32),
-        tracker=Tracker.create(max_iter + 1, w0.dtype).record(f0, g0_norm),
+        tracker=Tracker.create(
+            max_iter + 1, w0.dtype,
+            coef_dim=w0.shape[0] if track_coefficients else None,
+        ).record(f0, g0_norm, w0 if track_coefficients else None),
     )
     final = lax.while_loop(cond, body, init)
     pg_final = _pseudo_gradient(final.w, final.g, l1_vec)

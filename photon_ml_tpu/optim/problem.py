@@ -69,8 +69,13 @@ class GLMOptimizationProblem:
         initial: Optional[Array] = None,
         reg_weight: float = 0.0,
         mesh=None,
+        track_models: bool = False,
     ) -> Tuple[Coefficients, OptResult]:
         """Optimize and build coefficients (+ variances if requested).
+
+        ``track_models`` stacks the coefficient vector per iteration into
+        ``result.tracker.coefs`` (the ModelTracker analog backing
+        validate-per-iteration, Driver.scala:329-372).
 
         Mirrors GeneralizedLinearOptimizationProblem.run:112-121.
 
@@ -94,6 +99,7 @@ class GLMOptimizationProblem:
             loss_has_hessian=self.objective.loss.has_hessian,
             box=self.box,
             l1_mask=self._l1_mask(),
+            track_coefficients=track_models,
         )
         needs_hvp = self.config.optimizer_type == OptimizerType.TRON
 
@@ -176,11 +182,14 @@ class GLMOptimizationProblem:
         initial: Optional[Array] = None,
         reg_weight: float = 0.0,
         mesh=None,
+        track_models: bool = False,
     ) -> Tuple[Coefficients, OptResult]:
         """Apply the task's down-sampler first (runWithSampling:112-124)."""
         if down_sampling_rate < 1.0:
             batch = down_sample(key, batch, down_sampling_rate, self.task)
-        return self.run(batch, initial, reg_weight, mesh=mesh)
+        return self.run(
+            batch, initial, reg_weight, mesh=mesh, track_models=track_models
+        )
 
     def create_model(
         self,

@@ -130,6 +130,7 @@ def minimize_tron(
     max_cg: int = 20,
     max_improvement_failures: int = 16,
     box: Optional[BoxConstraints] = None,
+    track_coefficients: bool = False,
 ) -> OptResult:
     """Trust-region Newton. ``hvp_fn(w, d) -> H(w) @ d``.
 
@@ -201,7 +202,9 @@ def minimize_tron(
         ).astype(jnp.int32)
         return _TronState(
             w=w2, f=f2, g=g2, delta=delta, iteration=it, reason=reason,
-            failures=failures, tracker=st.tracker.record(f2, g_norm),
+            failures=failures, tracker=st.tracker.record(
+                f2, g_norm, w2 if track_coefficients else None
+            ),
         )
 
     init = _TronState(
@@ -214,7 +217,10 @@ def minimize_tron(
             g0_norm == 0.0, GRADIENT_WITHIN_TOLERANCE, NOT_CONVERGED
         ).astype(jnp.int32),
         failures=jnp.zeros((), jnp.int32),
-        tracker=Tracker.create(max_iter + 1, w0.dtype).record(f0, g0_norm),
+        tracker=Tracker.create(
+            max_iter + 1, w0.dtype,
+            coef_dim=w0.shape[0] if track_coefficients else None,
+        ).record(f0, g0_norm, w0 if track_coefficients else None),
     )
     final = lax.while_loop(cond, body, init)
     return OptResult(

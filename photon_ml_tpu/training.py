@@ -52,6 +52,7 @@ def train_generalized_linear_model(
     initial: Optional[Array] = None,
     kernel: str = "scatter",
     mesh=None,
+    track_models: bool = False,
 ) -> Tuple[Dict[float, GeneralizedLinearModel], Dict[float, OptResult]]:
     """Train one model per regularization weight with warm starts.
 
@@ -67,6 +68,11 @@ def train_generalized_linear_model(
     L-BFGS/OWLQN/TRON loop runs under shard_map with the batch sharded
     over the "data" axis (the treeAggregate analog). The tiled kernel's
     schedules are whole-batch, so mesh currently implies scatter.
+
+    ``track_models``: stack per-iteration coefficients into each
+    OptResult's ``tracker.coefs`` (ModelTracker analog). Use
+    :func:`iteration_models` to turn a result into per-iteration models
+    in the original feature space.
     """
     base = OptimizerConfig.default_for(optimizer_type)
     config = OptimizerConfig(
@@ -128,10 +134,43 @@ def train_generalized_linear_model(
     current = initial
     for lam in weights_desc:
         coefficients, result = problem.run(
-            batch, initial=current, reg_weight=lam, mesh=mesh
+            batch, initial=current, reg_weight=lam, mesh=mesh,
+            track_models=track_models,
         )
         models[lam] = problem.create_model(coefficients, normalization)
         results[lam] = result
         if warm_start:
             current = coefficients.means
     return models, results
+
+
+def iteration_models(
+    result: OptResult,
+    task: TaskType,
+    normalization: Optional[NormalizationContext] = None,
+    intercept_index: Optional[int] = None,
+) -> List[GeneralizedLinearModel]:
+    """Per-iteration models from a tracked OptResult (ModelTracker.models
+    analog): slot 0 is the initial point, slot i the accepted iterate i.
+    Coefficients are de-normalized to the original feature space exactly
+    like the final model (GeneralizedLinearOptimizationProblem.scala:89-95).
+    """
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.optim.problem import create_glm_problem
+
+    if result.tracker.coefs is None:
+        raise ValueError(
+            "OptResult has no coefficient history; train with "
+            "track_models=True"
+        )
+    problem = create_glm_problem(
+        task, int(result.tracker.coefs.shape[1]),
+        intercept_index=intercept_index,
+    )
+    count = int(result.tracker.count)
+    return [
+        problem.create_model(
+            Coefficients(result.tracker.coefs[i]), normalization
+        )
+        for i in range(count)
+    ]

@@ -1,0 +1,116 @@
+"""DateRange parsing + dated input-path expansion (DateRange.scala /
+IOUtils.getInputPathsWithinDateRange analogs) and the per-iteration model
+tracker that backs validate-per-iteration."""
+
+import datetime
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.utils.date_range import (
+    DateRange,
+    daily_path,
+    input_paths_within_date_range,
+    resolve_date_range,
+)
+
+
+class TestDateRange:
+    def test_from_dates(self):
+        r = DateRange.from_dates("20160101-20160103")
+        assert r.start == datetime.date(2016, 1, 1)
+        assert r.end == datetime.date(2016, 1, 3)
+        assert [d.day for d in r.days()] == [1, 2, 3]
+
+    def test_start_after_end_rejected(self):
+        with pytest.raises(ValueError, match="comes after"):
+            DateRange.from_dates("20160105-20160101")
+
+    def test_bad_format_rejected(self):
+        with pytest.raises(ValueError, match="Couldn't parse"):
+            DateRange.from_dates("2016/01/01-20160103")
+        with pytest.raises(ValueError, match="separate two values"):
+            DateRange.from_dates("20160101")
+
+    def test_from_days_ago(self):
+        now = datetime.date(2016, 3, 10)
+        r = DateRange.from_days_ago("9-1", now=now)
+        assert r.start == datetime.date(2016, 3, 1)
+        assert r.end == datetime.date(2016, 3, 9)
+
+    def test_days_ago_validation(self):
+        with pytest.raises(ValueError, match="valid integers"):
+            DateRange.from_days_ago("a-1")
+
+    def test_resolve_both_given_rejected(self):
+        with pytest.raises(ValueError, match="at most one"):
+            resolve_date_range("20160101-20160102", "9-1")
+        assert resolve_date_range(None, None) is None
+
+
+class TestInputPathExpansion:
+    @pytest.fixture
+    def daily_tree(self, tmp_path):
+        base = tmp_path / "input"
+        days = [datetime.date(2016, 1, d) for d in (1, 2, 4)]  # 3rd missing
+        for day in days:
+            p = daily_path(str(base), day)
+            os.makedirs(p)
+            open(os.path.join(p, "part-0.avro"), "w").close()
+        return str(base)
+
+    def test_expansion_skips_missing(self, daily_tree):
+        r = DateRange.from_dates("20160101-20160104")
+        paths = input_paths_within_date_range(daily_tree, r)
+        assert len(paths) == 3
+        assert paths[0].endswith(os.path.join("daily", "2016", "01", "01"))
+        assert paths[-1].endswith(os.path.join("daily", "2016", "01", "04"))
+
+    def test_error_on_missing(self, daily_tree):
+        r = DateRange.from_dates("20160101-20160104")
+        with pytest.raises(FileNotFoundError, match="does not exist"):
+            input_paths_within_date_range(daily_tree, r, error_on_missing=True)
+
+    def test_no_data_in_range_errors(self, daily_tree):
+        r = DateRange.from_dates("20170101-20170102")
+        with pytest.raises(FileNotFoundError, match="No data folder found"):
+            input_paths_within_date_range(daily_tree, r)
+
+    def test_multiple_base_dirs(self, daily_tree, tmp_path):
+        base2 = tmp_path / "input2"
+        p = daily_path(str(base2), datetime.date(2016, 1, 2))
+        os.makedirs(p)
+        r = DateRange.from_dates("20160101-20160104")
+        paths = input_paths_within_date_range([daily_tree, str(base2)], r)
+        assert len(paths) == 4
+
+
+class TestCoefficientTracking:
+    def test_lbfgs_tracks_models(self, rng):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.optim import minimize_lbfgs
+
+        d = 6
+        A = rng.normal(size=(16, d)).astype(np.float32)
+        b = rng.normal(size=16).astype(np.float32)
+
+        def vg(w):
+            r = A @ w - b
+            return 0.5 * jnp.vdot(r, r), A.T @ r
+
+        res = minimize_lbfgs(
+            vg, jnp.zeros(d), max_iter=30, track_coefficients=True
+        )
+        coefs = np.asarray(res.tracker.coefs)
+        count = int(res.tracker.count)
+        assert coefs.shape[1] == d
+        # slot 0 is the initial point, last filled slot the final iterate
+        np.testing.assert_array_equal(coefs[0], 0.0)
+        np.testing.assert_allclose(
+            coefs[count - 1], np.asarray(res.coefficients), atol=1e-6
+        )
+        # default keeps the trace coefficient-free
+        res2 = minimize_lbfgs(vg, jnp.zeros(d), max_iter=5)
+        assert res2.tracker.coefs is None

@@ -174,6 +174,29 @@ class TestGameTrainingEndToEnd:
         assert len(driver.results) == 2
         assert driver.best_config["global"].reg_weight == 0.1
 
+    def test_dated_train_inputs(self, tmp_path, rng):
+        import datetime
+
+        from photon_ml_tpu.utils.date_range import daily_path
+
+        dated = tmp_path / "dated"
+        for d in (1, 2, 3):
+            p = daily_path(str(dated), datetime.date(2016, 1, d))
+            os.makedirs(p)
+            write_game_avro(os.path.join(p, "p0.avro"), rng, n=80,
+                            seed_shift=d)
+        params = self._params(
+            tmp_path, rng,
+            train_input_dirs=[str(dated)],
+            train_date_range="20160101-20160102",  # excludes day 3
+        )
+        from photon_ml_tpu.cli.game_training_driver import GameTrainingDriver
+
+        driver = GameTrainingDriver(params)
+        driver.run()
+        assert driver._train_dataset.num_real_rows == 160
+        assert driver.best_result is not None
+
     def test_missing_opt_config_rejected(self, tmp_path, rng):
         with pytest.raises(ValueError, match="missing optimization"):
             self._params(tmp_path, rng, fixed_effect_opt_configs={}).validate()

@@ -176,6 +176,76 @@ class TestGLMDriverEndToEnd:
         with pytest.raises(ValueError, match="not allowed"):
             p.validate()
 
+    def test_date_range_params_validation(self):
+        p = GLMParams(train_dir="t", output_dir="o",
+                      train_date_range="20160101-20160102",
+                      train_date_range_days_ago="9-1")
+        with pytest.raises(ValueError, match="at most one"):
+            p.validate()
+        p = GLMParams(train_dir="t", output_dir="o",
+                      validate_per_iteration=True)
+        with pytest.raises(ValueError, match="requires a validating"):
+            p.validate()
+
+
+class TestDatedInputAndPerIterationValidation:
+    def _make_daily(self, base, rng, days, n=120):
+        import datetime
+
+        from photon_ml_tpu.utils.date_range import daily_path
+
+        for d in days:
+            p = daily_path(str(base), datetime.date(2016, 1, d))
+            os.makedirs(p)
+            synth_avro(os.path.join(p, "part-0.avro"), rng, n=n)
+
+    def test_dated_train_and_validate(self, tmp_path, rng):
+        train = tmp_path / "train"
+        val = tmp_path / "val"
+        self._make_daily(train, rng, (1, 2, 3))
+        self._make_daily(val, rng, (4,), n=80)
+        out = str(tmp_path / "out")
+        params = GLMParams(
+            train_dir=str(train),
+            validate_dir=str(val),
+            output_dir=out,
+            train_date_range="20160101-20160102",  # excludes day 3
+            validate_date_range="20160104-20160104",
+            task=TaskType.LOGISTIC_REGRESSION,
+            regularization_weights=[1.0],
+        )
+        driver = GLMDriver(params)
+        driver.run()
+        # two of the three daily train files -> 240 examples
+        assert int(np.asarray(driver._data.batch.weights > 0).sum()) == 240
+        assert driver.best_model is not None
+
+    def test_validate_per_iteration(self, tmp_path, avro_dirs):
+        train, val = avro_dirs
+        out = str(tmp_path / "out")
+        params = GLMParams(
+            train_dir=train,
+            validate_dir=val,
+            output_dir=out,
+            task=TaskType.LOGISTIC_REGRESSION,
+            regularization_weights=[0.1, 1.0],
+            validate_per_iteration=True,
+        )
+        driver = GLMDriver(params)
+        driver.run()
+        assert set(driver.per_iteration_metrics) == {0.1, 1.0}
+        for lam, per_iter in driver.per_iteration_metrics.items():
+            iters = int(driver.results[lam].iterations)
+            # slot 0 = initial model, then one per iteration
+            assert len(per_iter) == iters + 1
+            assert all("AUC" in m for m in per_iter)
+            # final per-iteration metrics == the final-model metrics
+            assert per_iter[-1] == driver.validation_metrics[lam]
+        # surfaced in metrics.json
+        with open(os.path.join(out, "metrics.json")) as f:
+            metrics = json.load(f)
+        assert "0.1" in metrics["per_iteration_validation"]
+
 
 @pytest.mark.skipif(
     not os.path.isdir(REF_INPUT), reason="reference fixtures unavailable"
