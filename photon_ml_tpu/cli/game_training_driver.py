@@ -14,7 +14,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import shutil
 from dataclasses import dataclass, field
 from itertools import product
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -121,6 +120,10 @@ class GameTrainingParams:
     # visible (cli/game/training/Driver.scala is cluster-by-construction);
     # "off": single-device
     distributed: str = "auto"
+    # Multi-host orchestration (SparkContextConfiguration analog).
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
 
     def validate(self) -> None:
         if not self.train_input_dirs:
@@ -153,15 +156,22 @@ class GameTrainingDriver:
     def __init__(self, params: GameTrainingParams, logger=None):
         params.validate()
         self.params = params
-        if os.path.isdir(params.output_dir):
-            if params.delete_output_dir_if_exists:
-                shutil.rmtree(params.output_dir)
-            elif os.listdir(params.output_dir):
-                raise ValueError(
-                    f"output dir {params.output_dir} exists and is non-empty"
-                )
-        os.makedirs(params.output_dir, exist_ok=True)
-        self.logger = logger or PhotonLogger(params.output_dir)
+        from photon_ml_tpu.parallel.multihost import (
+            initialize_multihost,
+            is_coordinator,
+            prepare_output_dir,
+        )
+
+        initialize_multihost(
+            params.coordinator_address, params.num_processes, params.process_id
+        )
+        prepare_output_dir(
+            params.output_dir,
+            delete_if_exists=params.delete_output_dir_if_exists,
+        )
+        self.logger = logger or PhotonLogger(
+            params.output_dir if is_coordinator() else None
+        )
         self.timer = Timer()
         self.results = []
         self.best_result = None
@@ -479,7 +489,15 @@ class GameTrainingDriver:
                 self.best_result = (result, metric if metric is not None else 0.0)
                 self.best_config = combo
 
+        from photon_ml_tpu.parallel.multihost import (
+            is_coordinator,
+            sync_processes,
+        )
+
         best = self.best_result[0]
+        if not is_coordinator():
+            sync_processes("outputs-written")
+            return
         with self.timer.time("save-model"):
             spec = "\n".join(
                 f"{name} -> {cfg.render()}" for name, cfg in self.best_config.items()
@@ -499,6 +517,7 @@ class GameTrainingDriver:
                 f,
                 indent=2,
             )
+        sync_processes("outputs-written")
         self.logger.info("timers:\n%s", self.timer.summary())
 
 
@@ -530,6 +549,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--evaluator-types", default=None)
     ap.add_argument("--compute-variance", default="false")
     ap.add_argument("--delete-output-dir-if-exists", default="false")
+    ap.add_argument(
+        "--coordinator-address", default=None,
+        help="host:port of process 0 for multi-host runs (jax.distributed)",
+    )
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
     ap.add_argument(
         "--distributed", default="auto", choices=["auto", "off"],
         help="shard FE data axis + RE entity axis over all devices",
@@ -596,6 +621,9 @@ def params_from_args(argv=None) -> GameTrainingParams:
         compute_variance=_bool(ns.compute_variance),
         delete_output_dir_if_exists=_bool(ns.delete_output_dir_if_exists),
         distributed=ns.distributed,
+        coordinator_address=ns.coordinator_address,
+        num_processes=ns.num_processes,
+        process_id=ns.process_id,
     )
 
 

@@ -17,7 +17,6 @@ import argparse
 import enum
 import json
 import os
-import shutil
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -118,6 +117,11 @@ class GLMParams:
     # visible (the reference is distributed by construction — every Spark
     # driver runs on a cluster); "off": single-device
     distributed: str = "auto"
+    # Multi-host orchestration (the SparkContextConfiguration analog):
+    # address of process 0's coordination service. None = single-process.
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
 
     def validate(self) -> None:
         """Cross-field checks (Params.validate, Params.scala:200-222)."""
@@ -175,20 +179,30 @@ class GLMDriver:
     ):
         params.validate()
         self.params = params
-        # Output-dir guard must precede logger creation (the logger opens
-        # photon.log inside the output dir) — IOUtils.processOutputDir
-        # analog (Driver.scala:148-151).
-        if os.path.isdir(params.output_dir):
-            if params.delete_output_dirs_if_exist:
-                shutil.rmtree(params.output_dir)
-            elif os.listdir(params.output_dir):
-                raise ValueError(
-                    f"output directory {params.output_dir} exists and is "
-                    "non-empty (pass --delete-output-dirs-if-exist to "
-                    "overwrite)"
-                )
-        os.makedirs(params.output_dir, exist_ok=True)
-        self.logger = logger or PhotonLogger(params.output_dir)
+        # Join the coordination service BEFORE any other JAX use so
+        # jax.devices() spans all hosts (multihost.initialize_multihost is
+        # a no-op single-process). Output-dir guard must precede logger
+        # creation (the logger opens photon.log inside the output dir) —
+        # IOUtils.processOutputDir analog (Driver.scala:148-151).
+        from photon_ml_tpu.parallel.multihost import (
+            initialize_multihost,
+            is_coordinator,
+            prepare_output_dir,
+        )
+
+        initialize_multihost(
+            params.coordinator_address, params.num_processes, params.process_id
+        )
+        prepare_output_dir(
+            params.output_dir,
+            delete_if_exists=params.delete_output_dirs_if_exist,
+            hint="pass --delete-output-dirs-if-exist to overwrite",
+        )
+        # Every process logs; only the coordinator's photon.log is the log
+        # of record (the reference copies exactly one driver log to HDFS).
+        self.logger = logger or PhotonLogger(
+            params.output_dir if is_coordinator() else None
+        )
         self.emitter = emitter or EventEmitter()
         for name in params.event_listeners:
             self.emitter.register_by_name(name)
@@ -227,6 +241,13 @@ class GLMDriver:
             train_paths = self._dated_paths(
                 p.train_dir, p.train_date_range, p.train_date_range_days_ago
             )
+            # Multi-host note: every process loads the SAME input (the
+            # cross-process device_put contract: identical global value on
+            # all hosts, each placing only its addressable shards). True
+            # per-process streaming needs a pre-built shared index map
+            # (the FeatureIndexingJob store) + global-array assembly via
+            # jax.make_array_from_process_local_data — see
+            # parallel/multihost.process_shard for the path split.
             data = fmt.load(train_paths, constraint_string=p.constraint_string)
             self._data = data
             self.logger.info(
@@ -244,7 +265,10 @@ class GLMDriver:
                 intercept_index=data.intercept_index,
             )
             if p.summarization_output_dir:
-                self._write_summary(p.summarization_output_dir)
+                from photon_ml_tpu.parallel.multihost import is_coordinator
+
+                if is_coordinator():
+                    self._write_summary(p.summarization_output_dir)
         self._advance(DriverStage.PREPROCESSED)
 
     def _dated_paths(self, base_dir, date_range, days_ago):
@@ -476,14 +500,21 @@ class GLMDriver:
             )
 
     def run(self) -> None:
+        from photon_ml_tpu.parallel.multihost import (
+            is_coordinator,
+            sync_processes,
+        )
+
         p = self.params
         self.preprocess()
         self.train()
         if p.validate_dir:
             self.validate()
-        if p.diagnostic_mode != DiagnosticMode.NONE:
+        if p.diagnostic_mode != DiagnosticMode.NONE and is_coordinator():
             self.diagnose()
-        self._write_outputs()
+        if is_coordinator():
+            self._write_outputs()
+        sync_processes("outputs-written")
         self.logger.info("stages: %s", [s.name for s in self.stage_history])
         self.logger.info("timers:\n%s", self.timer.summary())
         self.emitter.close()
@@ -536,6 +567,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--distributed", default="auto", choices=["auto", "off"],
         help="data-parallel training over all devices (auto: when >1)",
     )
+    ap.add_argument(
+        "--coordinator-address", default=None,
+        help="host:port of process 0 for multi-host runs (jax.distributed)",
+    )
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
     return ap
 
 
@@ -576,6 +613,9 @@ def params_from_args(argv=None) -> GLMParams:
         job_name=ns.job_name,
         kernel=ns.kernel,
         distributed=ns.distributed,
+        coordinator_address=ns.coordinator_address,
+        num_processes=ns.num_processes,
+        process_id=ns.process_id,
         event_listeners=(
             ns.event_listeners.split(",") if ns.event_listeners else []
         ),

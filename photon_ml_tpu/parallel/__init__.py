@@ -14,6 +14,14 @@ from photon_ml_tpu.parallel.mesh import (
     replicated,
     shard_batch,
 )
+from photon_ml_tpu.parallel.multihost import (
+    initialize_multihost,
+    is_coordinator,
+    process_count,
+    process_index,
+    process_shard,
+    sync_processes,
+)
 from photon_ml_tpu.parallel.distributed import (
     FeatureShardedSparseBatch,
     data_parallel_fit_lbfgs,
@@ -32,6 +40,12 @@ __all__ = [
     "replicate",
     "replicated",
     "shard_batch",
+    "initialize_multihost",
+    "is_coordinator",
+    "process_count",
+    "process_index",
+    "process_shard",
+    "sync_processes",
     "FeatureShardedSparseBatch",
     "data_parallel_fit_lbfgs",
     "data_parallel_value_and_grad",

@@ -1,0 +1,146 @@
+"""Multi-host orchestration: process coordination, IO guards, input shards.
+
+The reference boots one Spark driver that owns all IO while executors
+compute (SparkContextConfiguration.scala:44-108 builds the YARN client;
+every write happens driver-side). The JAX SPMD analog inverts control —
+EVERY process runs the same program over its local devices — so the
+concerns become:
+
+- joining the coordination service (``jax.distributed.initialize``), after
+  which ``jax.devices()`` spans all hosts and a Mesh over it makes psum
+  ride ICI within a host and DCN across hosts;
+- electing process 0 for host-side effects (output files, checkpoints,
+  logs-of-record) — the "driver" role;
+- splitting the HOST-side input stream across processes (each process
+  feeds only its local devices; device-side sharding then sees a globally
+  sharded batch).
+
+Single-process runs (including the one-chip dev loop) pass through
+untouched: ``initialize_multihost(None)`` is a no-op and every guard
+degenerates to "yes, you are process 0 of 1".
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, TypeVar
+
+T = TypeVar("T")
+
+_initialized = False
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str],
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the JAX coordination service (the SparkContext-boot analog).
+
+    No-op (returns False) when ``coordinator_address`` is None — the
+    single-process path. Safe to call once per process, before any other
+    JAX usage; ``num_processes``/``process_id`` fall back to the standard
+    cluster-environment auto-detection when None.
+    """
+    global _initialized
+    if coordinator_address is None:
+        return False
+    if _initialized:
+        return True
+    import jax
+
+    kwargs = {"coordinator_address": coordinator_address}
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    return True
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """True on the process elected for host-side effects (process 0) —
+    the Spark-driver role for writes."""
+    return process_index() == 0
+
+
+def coordinator_only(fn):
+    """Decorator: run ``fn`` only on process 0; other processes get None.
+    For output/model/checkpoint writes that must happen exactly once."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if is_coordinator():
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapper
+
+
+def prepare_output_dir(
+    path: str,
+    *,
+    delete_if_exists: bool,
+    hint: str = "",
+) -> None:
+    """Deterministic multi-host output-dir guard.
+
+    EVERY process runs the read-only non-empty check, so a refusal raises
+    the same error everywhere (no process left hanging at a barrier while
+    the coordinator dies — the failure-detection property Spark gets from
+    driver-centric writes). Only the coordinator mutates the directory;
+    the barrier orders that mutation before anyone proceeds.
+    """
+    import os
+    import shutil
+
+    if os.path.isdir(path) and os.listdir(path) and not delete_if_exists:
+        suffix = f" ({hint})" if hint else ""
+        raise ValueError(
+            f"output directory {path} exists and is non-empty{suffix}"
+        )
+    if is_coordinator():
+        if os.path.isdir(path) and delete_if_exists:
+            shutil.rmtree(path)
+        os.makedirs(path, exist_ok=True)
+    sync_processes("output-dir-ready")
+
+
+def process_shard(items: Sequence[T]) -> List[T]:
+    """This process's slice of a host-side work list (input files, daily
+    paths): round-robin by process index, so any ordering skew in the list
+    spreads evenly. Single-process returns the list unchanged.
+
+    NOTE: feeding device_put with per-process DIFFERENT batch contents is
+    wrong — cross-process device_put requires the same global value on all
+    hosts. Use this only with a pre-built shared index map and global-array
+    assembly (jax.make_array_from_process_local_data); the drivers load
+    replicated until that streaming input path lands."""
+    n = process_count()
+    if n <= 1:
+        return list(items)
+    i = process_index()
+    return [x for j, x in enumerate(items) if j % n == i]
+
+
+def sync_processes(name: str = "photon-ml-barrier") -> None:
+    """Barrier across processes (no-op single-process). Use between a
+    coordinator-only write and a global read of its output."""
+    if process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

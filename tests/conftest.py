@@ -27,6 +27,12 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running integration tests (subprocess boots)"
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -1,0 +1,98 @@
+"""Multi-host scaffold tests: single-process semantics inline, plus a
+subprocess smoke test that actually joins a 1-process jax.distributed
+coordination service and runs the GLM driver under it (the CPU analog of
+SparkContextConfiguration.asYarnClient boot, SURVEY §7.11)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.parallel.multihost import (
+    coordinator_only,
+    initialize_multihost,
+    is_coordinator,
+    process_count,
+    process_shard,
+    sync_processes,
+)
+
+
+class TestSingleProcessSemantics:
+    def test_no_coordinator_is_noop(self):
+        assert initialize_multihost(None) is False
+
+    def test_single_process_identity(self):
+        assert process_count() == 1
+        assert is_coordinator()
+        assert process_shard([1, 2, 3]) == [1, 2, 3]
+        sync_processes("noop")  # must not hang or require a service
+
+    def test_coordinator_only_runs(self):
+        calls = []
+
+        @coordinator_only
+        def write(x):
+            calls.append(x)
+            return x
+
+        assert write(7) == 7
+        assert calls == [7]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+class TestOneProcessDistributedSmoke:
+    def test_glm_driver_under_coordination_service(self, tmp_path, rng):
+        """Boot jax.distributed with num_processes=1 in a subprocess and run
+        the full GLM driver with --coordinator-address; output must appear
+        exactly as in the plain single-process run."""
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_glm_driver import synth_avro
+
+        train = tmp_path / "train"
+        train.mkdir()
+        synth_avro(str(train / "p0.avro"), rng, n=150)
+        out = tmp_path / "out"
+        port = _free_port()
+        script = textwrap.dedent(f"""
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            from photon_ml_tpu.cli.glm_driver import main
+            main([
+                "--training-data-directory", {str(train)!r},
+                "--output-directory", {str(out)!r},
+                "--regularization-weights", "1.0",
+                "--coordinator-address", "127.0.0.1:{port}",
+                "--num-processes", "1",
+                "--process-id", "0",
+            ])
+            import photon_ml_tpu.parallel.multihost as mh
+            assert mh.process_count() == 1 and mh.is_coordinator()
+            assert mh._initialized
+        """)
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=420,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        with open(out / "metrics.json") as f:
+            metrics = json.load(f)
+        assert "timers" in metrics
+        assert (out / "models-text").is_dir()
