@@ -1,15 +1,27 @@
 """Benchmark: fused GLM objective throughput (examples/sec/chip).
 
-Runs the L-BFGS hot kernel — fused margins -> loss derivatives -> gradient
-— at an ads-scale shape and prints ONE JSON line. Since round 2 the
-benched path is the tiled Pallas kernel pair (photon_ml_tpu.ops.
-tiled_sparse, gather/scatter-free); the scatter/gather GLMObjective is
-kept as the correctness oracle and its value is cross-checked inline.
+Default (the driver contract): runs the L-BFGS hot kernel — fused margins
+-> loss derivatives -> gradient — at an ads-scale shape and prints ONE
+JSON line. Since round 2 the benched path is the tiled Pallas kernel pair
+(photon_ml_tpu.ops.tiled_sparse, gather/scatter-free); the scatter/gather
+GLMObjective is kept as the correctness oracle and its value is
+cross-checked inline.
+
+``--suite``: the BASELINE.md matrix — end-to-end time-to-converge +
+quality metrics per config (a1a-shaped logistic grid, Criteo-shaped
+TRON/elastic-net, hinge+box, GLMix ~100M coef, GAME ~1B coef), one JSON
+line per config plus a trailing summary line; results also written to
+BASELINE_RESULTS.json. The public datasets themselves are not in the
+image (zero egress), so each config runs on a fixed-seed synthetic
+dataset with the SAME shape/sparsity — stated in the output — which
+measures the machine, not the corpus.
 
 Measurement protocol (see PERF_NOTES.md): the axon tunnel makes
 block_until_ready unreliable and host round-trips cost ~300ms, so the
-kernel is timed with an in-jit fori_loop with a loop-carried dependency,
-differencing two loop lengths to cancel the dispatch constant.
+microbench kernel is timed with an in-jit fori_loop with a loop-carried
+dependency, differencing two loop lengths to cancel the dispatch
+constant. Suite configs time whole host-visible fits (compile excluded by
+a warm run where stated).
 
 The reference publishes no numbers (SURVEY §6, BASELINE.md); vs_baseline
 is computed against our own round-1 scatter/gather measurement
@@ -17,6 +29,7 @@ is computed against our own round-1 scatter/gather measurement
 """
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -117,7 +130,503 @@ def main():
         },
     }
     print(json.dumps(result))
+    return result
+
+
+# ---------------------------------------------------------------------------
+# BASELINE.md suite
+# ---------------------------------------------------------------------------
+
+
+def _synth_sparse(rng, n, d, k, *, task="logistic", noise=0.5):
+    """Fixed-seed synthetic sparse problem with a planted model."""
+    w_true = (rng.normal(size=d) * (rng.uniform(size=d) < 0.2)).astype(
+        np.float32
+    )
+    return _regen_with_model(rng, n, d, k, w_true, task, noise=noise)
+
+
+def _glm_fit_config(
+    name,
+    *,
+    task,
+    optimizer,
+    reg_type,
+    lambdas,
+    n,
+    d,
+    k,
+    n_val=0,
+    max_iter=None,
+    box_bound=None,
+    elastic_net_alpha=None,
+    kernel="auto",
+    seed=0,
+    shape_note="",
+):
+    """Train a lambda grid end-to-end; report warm time-to-converge +
+    validation quality (the BASELINE.json metrics contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.evaluation import (
+        area_under_roc_curve,
+        root_mean_squared_error,
+    )
+    from photon_ml_tpu.models.glm import compute_margins, compute_means
+    from photon_ml_tpu.optim.common import BoxConstraints
+    from photon_ml_tpu.task import TaskType
+    from photon_ml_tpu.training import train_generalized_linear_model
+    from photon_ml_tpu.optim import OptimizerType, RegularizationType
+
+    rng = np.random.default_rng(seed)
+    task_t = TaskType.parse(task)
+    gen_task = {
+        "LOGISTIC_REGRESSION": "logistic",
+        "LINEAR_REGRESSION": "linear",
+        "POISSON_REGRESSION": "poisson",
+        "SMOOTHED_HINGE_LOSS_LINEAR_SVM": "hinge",
+    }[task_t.name]
+    batch, w_true = _synth_sparse(rng, n, d, k, task=gen_task)
+    vbatch = None
+    if n_val:
+        # held-out set drawn from the SAME planted model
+        vbatch, _ = _regen_with_model(
+            np.random.default_rng(seed + 1), n_val, d, k, w_true, gen_task
+        )
+    box = None
+    if box_bound is not None:
+        box = BoxConstraints(
+            lower=jnp.full((d,), -box_bound, jnp.float32),
+            upper=jnp.full((d,), box_bound, jnp.float32),
+        )
+
+    # Resolve + prebuild the tiled schedule OUTSIDE the timed fit: the
+    # schedule is static per dataset (the index-build analog), so
+    # time-to-converge should not re-pay it per lambda grid.
+    from photon_ml_tpu.optim.problem import resolve_kernel
+
+    kernel = resolve_kernel(kernel, batch)
+    schedule_build_s = 0.0
+    if kernel == "tiled":
+        from photon_ml_tpu.ops.tiled_sparse import tiled_batch_from_sparse
+
+        t0 = time.perf_counter()
+        batch = tiled_batch_from_sparse(batch, d)
+        schedule_build_s = time.perf_counter() - t0
+
+    kwargs = dict(
+        optimizer_type=OptimizerType.parse(optimizer),
+        regularization_type=RegularizationType.parse(reg_type),
+        regularization_weights=lambdas,
+        elastic_net_alpha=elastic_net_alpha,
+        max_iter=max_iter,
+        box=box,
+        kernel=kernel,
+    )
+
+    def fit():
+        t0 = time.perf_counter()
+        models, results = train_generalized_linear_model(
+            batch, task_t, d, **kwargs
+        )
+        # force completion host-side
+        for r in results.values():
+            _ = int(r.iterations)
+        return models, results, time.perf_counter() - t0
+
+    _, _, cold_s = fit()  # compile
+    models, results, warm_s = fit()  # time-to-converge, compile excluded
+
+    total_iters = sum(int(r.iterations) for r in results.values())
+    quality = {}
+    if vbatch is not None:
+        lam_best, best = None, None
+        for lam, model in models.items():
+            margins = compute_margins(model.means, vbatch)
+            if task_t == TaskType.LOGISTIC_REGRESSION or (
+                task_t == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM
+            ):
+                score = float(
+                    area_under_roc_curve(
+                        margins, vbatch.labels, vbatch.weights
+                    )
+                )
+                better = best is None or score > best
+            else:
+                means = compute_means(task_t, model.means, vbatch)
+                score = float(
+                    root_mean_squared_error(
+                        means, vbatch.labels, vbatch.weights
+                    )
+                )
+                better = best is None or score < best
+            if better:
+                best, lam_best = score, lam
+        quality = {
+            "metric": (
+                "AUC"
+                if task_t
+                in (
+                    TaskType.LOGISTIC_REGRESSION,
+                    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+                )
+                else "RMSE"
+            ),
+            "best_value": best,
+            "best_lambda": lam_best,
+        }
+    return {
+        "config": name,
+        "metric": "time_to_converge_s",
+        "value": round(warm_s, 3),
+        "unit": "s (lambda grid, warm)",
+        "detail": {
+            "task": task_t.name,
+            "optimizer": optimizer,
+            "regularization": reg_type,
+            "lambdas": lambdas,
+            "n": n,
+            "dim": d,
+            "nnz_per_row": k,
+            "examples_per_sec": round(n * total_iters / warm_s)
+            if warm_s > 0
+            else None,
+            "total_iterations": total_iters,
+            "cold_s": round(cold_s, 3),
+            "kernel": kernel,
+            "schedule_build_s": round(schedule_build_s, 2),
+            "validation": quality,
+            "data": shape_note or "fixed-seed synthetic, planted model",
+        },
+    }
+
+
+def _regen_with_model(rng, n, d, k, w_true, gen_task, noise=0.5):
+    """Draw a dataset from a GIVEN planted model (shared generator for the
+    train set and its held-out split)."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.batch import SparseBatch
+
+    indices = rng.integers(0, d, size=(n, k), dtype=np.int64)
+    values = rng.normal(size=(n, k)).astype(np.float32)
+    z = (w_true[indices] * values).sum(axis=1)
+    if gen_task in ("logistic", "hinge"):
+        p = 1.0 / (1.0 + np.exp(-z / max(noise, 1e-6)))
+        labels = (rng.uniform(size=n) < p).astype(np.float32)
+    elif gen_task == "linear":
+        labels = (z + noise * rng.normal(size=n)).astype(np.float32)
+    elif gen_task == "poisson":
+        lam = np.exp(np.clip(z * 0.1, None, 3.0))
+        labels = rng.poisson(lam).astype(np.float32)
+    else:
+        raise ValueError(gen_task)
+    batch = SparseBatch(
+        indices=jnp.asarray(indices.astype(np.int32)),
+        values=jnp.asarray(values),
+        labels=jnp.asarray(labels),
+        offsets=jnp.zeros((n,), jnp.float32),
+        weights=jnp.ones((n,), jnp.float32),
+    )
+    return batch, w_true
+
+
+def _synth_re_buckets(
+    rng, n_entities, d_local, samples_per_entity, k, chunk
+):
+    """Synthetic bucketed random-effect data (RandomEffectBucket layout)
+    with a planted per-entity model, split into `chunk`-entity buckets so
+    transient optimizer state stays bounded."""
+    from types import SimpleNamespace
+
+    from photon_ml_tpu.game.random_effect_data import RandomEffectBucket
+
+    buckets = []
+    for start in range(0, n_entities, chunk):
+        e = min(chunk, n_entities - start)
+        s = samples_per_entity
+        idx = rng.integers(0, d_local, size=(e, s, k), dtype=np.int32)
+        val = rng.normal(size=(e, s, k)).astype(np.float32)
+        w_ent = rng.normal(size=(e, 1, d_local)).astype(np.float32) * 0.5
+        z = np.take_along_axis(
+            np.broadcast_to(w_ent, (e, s, d_local)), idx, axis=2
+        )
+        z = (z * val).sum(axis=2)
+        p = 1.0 / (1.0 + np.exp(-z))
+        labels = (rng.uniform(size=(e, s)) < p).astype(np.float32)
+        buckets.append(
+            RandomEffectBucket(
+                entity_codes=np.arange(start, start + e, dtype=np.int32),
+                row_index=np.full((e, s), -1, np.int32),
+                indices=idx,
+                values=val,
+                labels=labels,
+                offsets=np.zeros((e, s), np.float32),
+                weights=np.ones((e, s), np.float32),
+            )
+        )
+    return SimpleNamespace(buckets=buckets)
+
+
+def _re_bank_update(problem, bank, dataset):
+    t0 = time.perf_counter()
+    bank, tracker = problem.update_bank(bank, dataset)
+    _ = np.asarray(bank[0, 0])  # force
+    return bank, tracker, time.perf_counter() - t0
+
+
+def _glmix_config(
+    name,
+    *,
+    n_fixed,
+    d_fixed,
+    k_fixed,
+    n_users,
+    d_user,
+    samples_per_user,
+    k_user,
+    n_items=0,
+    d_item=0,
+    samples_per_item=0,
+    k_item=0,
+    re_max_iter=30,
+    re_history=5,
+    chunk=25_000,
+    kernel="auto",
+    seed=0,
+):
+    """Fixed effect + entity banks: one full coordinate-descent-style pass
+    (FE solve, then each RE bank update), coefficients counted honestly."""
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.random_effect import (
+        RandomEffectOptimizationProblem,
+    )
+    from photon_ml_tpu.ops.losses import LOGISTIC
+    from photon_ml_tpu.optim.config import (
+        OptimizerConfig,
+        OptimizerType,
+        RegularizationContext,
+        RegularizationType,
+    )
+    from photon_ml_tpu.task import TaskType
+    from photon_ml_tpu.training import train_generalized_linear_model
+
+    rng = np.random.default_rng(seed)
+    batch, _ = _synth_sparse(rng, n_fixed, d_fixed, k_fixed)
+
+    from photon_ml_tpu.optim.problem import resolve_kernel
+
+    kernel = resolve_kernel(kernel, batch)
+    if kernel == "tiled":
+        from photon_ml_tpu.ops.tiled_sparse import tiled_batch_from_sparse
+
+        batch = tiled_batch_from_sparse(batch, d_fixed)
+
+    def fixed_fit():
+        t0 = time.perf_counter()
+        _, results = train_generalized_linear_model(
+            batch,
+            TaskType.LOGISTIC_REGRESSION,
+            d_fixed,
+            regularization_type=RegularizationType.L2,
+            regularization_weights=[1.0],
+            max_iter=50,
+            kernel=kernel,
+        )
+        iters = int(next(iter(results.values())).iterations)
+        return iters, time.perf_counter() - t0
+
+    fe_iters, _ = fixed_fit()  # compile
+    fe_iters, fe_s = fixed_fit()
+
+    re_specs = [("user", n_users, d_user, samples_per_user, k_user)]
+    if n_items:
+        re_specs.append(("item", n_items, d_item, samples_per_item, k_item))
+
+    re_results = {}
+    total_re_coefs = 0
+    config = OptimizerConfig(
+        OptimizerType.LBFGS,
+        max_iter=re_max_iter,
+        tolerance=1e-5,
+        lbfgs_history=re_history,
+    )
+    for re_name, n_e, d_l, s_e, k_e in re_specs:
+        data = _synth_re_buckets(rng, n_e, d_l, s_e, k_e, chunk)
+        problem = RandomEffectOptimizationProblem(
+            loss=LOGISTIC,
+            config=config,
+            regularization=RegularizationContext(RegularizationType.L2),
+            reg_weight=1.0,
+        )
+        bank = jnp.zeros((n_e, d_l), jnp.float32)
+        bank, _, _ = _re_bank_update(problem, bank, data)  # compile
+        bank = jnp.zeros((n_e, d_l), jnp.float32)
+        bank, tracker, re_s = _re_bank_update(problem, bank, data)
+        total_re_coefs += n_e * d_l
+        re_results[re_name] = {
+            "entities": n_e,
+            "local_dim": d_l,
+            "entities_per_sec": round(n_e / re_s),
+            "seconds": round(re_s, 3),
+            "iterations_mean": round(tracker.iterations_mean, 2),
+        }
+
+    total_coefs = d_fixed + total_re_coefs
+    step_s = fe_s + sum(r["seconds"] for r in re_results.values())
+    return {
+        "config": name,
+        "metric": "coordinate_step_s",
+        "value": round(step_s, 3),
+        "unit": "s (FE solve + all RE bank updates, warm)",
+        "detail": {
+            "total_coefficients": total_coefs,
+            "fixed_effect": {
+                "n": n_fixed,
+                "dim": d_fixed,
+                "iterations": fe_iters,
+                "seconds": round(fe_s, 3),
+                "examples_per_sec": round(n_fixed * fe_iters / fe_s)
+                if fe_s > 0
+                else None,
+            },
+            "random_effects": re_results,
+            "data": "fixed-seed synthetic, planted per-entity models",
+        },
+    }
+
+
+def suite():
+    """BASELINE.md matrix. One JSON line per config + summary."""
+    import jax
+
+    device = str(jax.devices()[0])
+    results = []
+
+    # 1: a1a logistic grid (README.md:217-256 tutorial shape: n=1605
+    # train / 30956 test, d=123; lambdas from run_photon_ml_driver.sh).
+    results.append(
+        _glm_fit_config(
+            "1_a1a_logistic",
+            task="LOGISTIC_REGRESSION",
+            optimizer="LBFGS",
+            reg_type="L2",
+            lambdas=[0.1, 1.0, 10.0, 100.0],
+            n=1605,
+            d=123,
+            k=14,
+            n_val=30_956,
+            max_iter=50,
+            kernel="scatter",  # tiny dim: schedule build not worth it
+            shape_note="synthetic with a1a's exact shape (1605x123, ~14 nnz)",
+        )
+    )
+    print(json.dumps(results[-1]), flush=True)
+
+    # 2: Criteo-shaped linear TRON + poisson elastic-net (39 raw features
+    # hashed to 1M dims, k=39 nnz).
+    results.append(
+        _glm_fit_config(
+            "2a_criteo_linear_tron",
+            task="LINEAR_REGRESSION",
+            optimizer="TRON",
+            reg_type="L2",
+            lambdas=[1.0],
+            n=1 << 18,
+            d=1 << 20,
+            k=40,
+            n_val=1 << 15,
+            shape_note="synthetic at Criteo-sample shape (262k x 1M, 40 nnz)",
+        )
+    )
+    print(json.dumps(results[-1]), flush=True)
+    results.append(
+        _glm_fit_config(
+            "2b_criteo_poisson_elastic_net",
+            task="POISSON_REGRESSION",
+            optimizer="LBFGS",
+            reg_type="ELASTIC_NET",
+            elastic_net_alpha=0.5,
+            lambdas=[0.1, 1.0],
+            n=1 << 18,
+            d=1 << 20,
+            k=40,
+            n_val=1 << 15,
+            max_iter=50,
+            shape_note="synthetic at Criteo-sample shape (262k x 1M, 40 nnz)",
+        )
+    )
+    print(json.dumps(results[-1]), flush=True)
+
+    # 3: smoothed-hinge SVM with per-coefficient box constraints.
+    results.append(
+        _glm_fit_config(
+            "3_hinge_box",
+            task="SMOOTHED_HINGE_LOSS_LINEAR_SVM",
+            optimizer="LBFGS",
+            reg_type="L2",
+            lambdas=[1.0],
+            n=1 << 18,
+            d=1 << 17,
+            k=32,
+            n_val=1 << 15,
+            max_iter=50,
+            box_bound=0.5,
+            shape_note="synthetic (262k x 131k, 32 nnz), box [-0.5, 0.5]",
+        )
+    )
+    print(json.dumps(results[-1]), flush=True)
+
+    # 4: GLMix fixed + per-user RE, ~101M coefficients.
+    results.append(
+        _glmix_config(
+            "4_glmix_100m",
+            n_fixed=1 << 18,
+            d_fixed=1 << 20,
+            k_fixed=64,
+            n_users=100_000,
+            d_user=1000,
+            samples_per_user=16,
+            k_user=32,
+        )
+    )
+    print(json.dumps(results[-1]), flush=True)
+
+    # 5: full GAME fixed + user RE + item RE, ~1B coefficients.
+    results.append(
+        _glmix_config(
+            "5_game_1b",
+            n_fixed=1 << 18,
+            d_fixed=1 << 20,
+            k_fixed=64,
+            n_users=600_000,
+            d_user=1000,
+            samples_per_user=16,
+            k_user=32,
+            n_items=400_000,
+            d_item=1000,
+            samples_per_item=16,
+            k_item=32,
+        )
+    )
+    print(json.dumps(results[-1]), flush=True)
+
+    summary = {
+        "metric": "baseline_suite",
+        "value": len(results),
+        "unit": "configs",
+        "vs_baseline": 1.0,
+        "detail": {"device": device, "configs": [r["config"] for r in results]},
+    }
+    with open("BASELINE_RESULTS.json", "w") as f:
+        json.dump({"device": device, "results": results}, f, indent=2)
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":
-    main()
+    if "--suite" in sys.argv:
+        suite()
+    else:
+        main()

@@ -253,6 +253,7 @@ def minimize_owlqn(
     history: int = 10,
     l1_mask: Optional[Array] = None,
     ls_max_steps: int = 24,
+    axis_name: Optional[str] = None,
     track_coefficients: bool = False,
 ) -> OptResult:
     """Minimize smooth(w) + l1_weight * ||w||_1 (OWL-QN).
@@ -260,26 +261,29 @@ def minimize_owlqn(
     ``l1_weight`` is a runtime scalar — a whole elastic-net path reuses one
     compilation (the reference mutates OWLQN.l1RegWeight the same way,
     OWLQN.scala:43-91). ``l1_mask`` optionally exempts slots (the intercept)
-    from the penalty.
+    from the penalty. ``axis_name``: run over a feature-sharded coefficient
+    block (see minimize_lbfgs) — the L1 term and pseudo-gradient are
+    elementwise, so only the scalar reductions psum.
     """
+    vdot, norm, vsum = make_global_prims(axis_name)
     l1w = jnp.asarray(l1_weight, dtype=w0.dtype)
     mask = jnp.ones_like(w0) if l1_mask is None else l1_mask.astype(w0.dtype)
     l1_vec = l1w * mask
 
     def total(w, fsmooth):
-        return fsmooth + jnp.sum(l1_vec * jnp.abs(w))
+        return fsmooth + vsum(l1_vec * jnp.abs(w))
 
     f0s, g0 = value_and_grad_fn(w0)
     pg0 = _pseudo_gradient(w0, g0, l1_vec)
     f0 = total(w0, f0s)
-    g0_norm = jnp.linalg.norm(pg0)
+    g0_norm = norm(pg0)
 
     def cond(st: _LoopState):
         return st.reason == NOT_CONVERGED
 
     def body(st: _LoopState):
         pg = _pseudo_gradient(st.w, st.g, l1_vec)
-        d = _two_loop_direction(pg, st.mem)
+        d = _two_loop_direction(pg, st.mem, vdot)
         # Constrain direction to the descent orthant of the pseudo-gradient.
         d = jnp.where(d * pg < 0, d, 0.0)
         orthant = jnp.where(st.w != 0, jnp.sign(st.w), jnp.sign(-pg))
@@ -295,18 +299,18 @@ def minimize_owlqn(
         t0 = jnp.where(
             st.mem.length > 0,
             jnp.ones((), st.f.dtype),
-            1.0 / jnp.maximum(jnp.linalg.norm(d), 1.0),
+            1.0 / jnp.maximum(norm(d), 1.0),
         )
         ls = backtracking_line_search(
             vg_total, st.w, f_cur_total, pg, d, t0,
-            max_steps=ls_max_steps, project=project_orthant,
+            max_steps=ls_max_steps, project=project_orthant, vdot=vdot,
         )
         # ls.f is the total value; recover smooth value for state/memory.
-        f_smooth_new = ls.f - jnp.sum(l1_vec * jnp.abs(ls.w))
-        mem = _update_memory(st.mem, ls.w - st.w, ls.g - st.g)
+        f_smooth_new = ls.f - vsum(l1_vec * jnp.abs(ls.w))
+        mem = _update_memory(st.mem, ls.w - st.w, ls.g - st.g, vdot)
         it = st.iteration + 1
         pg_new = _pseudo_gradient(ls.w, ls.g, l1_vec)
-        pg_norm = jnp.linalg.norm(pg_new)
+        pg_norm = norm(pg_new)
         # Stalled line search reports MAX_ITERATIONS, not convergence.
         reason = jnp.where(
             ls.ok,
@@ -342,7 +346,7 @@ def minimize_owlqn(
     return OptResult(
         coefficients=final.w,
         value=total(final.w, final.f),
-        grad_norm=jnp.linalg.norm(pg_final),
+        grad_norm=norm(pg_final),
         iterations=final.iteration,
         reason=final.reason,
         tracker=final.tracker,

@@ -22,6 +22,11 @@ from photon_ml_tpu.parallel.multihost import (
     process_shard,
     sync_processes,
 )
+from photon_ml_tpu.parallel.shuffle import (
+    ShuffledRows,
+    entity_all_to_all,
+    reshard_capacity,
+)
 from photon_ml_tpu.parallel.distributed import (
     FeatureShardedSparseBatch,
     data_parallel_fit_lbfgs,
@@ -29,6 +34,7 @@ from photon_ml_tpu.parallel.distributed import (
     feature_shard_sparse_batch,
     feature_sharded_fit,
     feature_sharded_sparse_fit,
+    feature_sharded_sparse_fit_owlqn,
     feature_sharded_value_and_grad,
 )
 
@@ -46,11 +52,15 @@ __all__ = [
     "process_index",
     "process_shard",
     "sync_processes",
+    "ShuffledRows",
+    "entity_all_to_all",
+    "reshard_capacity",
     "FeatureShardedSparseBatch",
     "data_parallel_fit_lbfgs",
     "data_parallel_value_and_grad",
     "feature_shard_sparse_batch",
     "feature_sharded_fit",
     "feature_sharded_sparse_fit",
+    "feature_sharded_sparse_fit_owlqn",
     "feature_sharded_value_and_grad",
 ]

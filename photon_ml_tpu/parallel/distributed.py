@@ -412,3 +412,39 @@ def feature_sharded_sparse_fit(
         )
 
     return fit
+
+
+def feature_sharded_sparse_fit_owlqn(
+    objective: GLMObjective,
+    mesh: Mesh,
+    *,
+    data_axis: str = DATA_AXIS,
+    model_axis: str = MODEL_AXIS,
+    max_iter: int = 50,
+    tol: float = 1e-7,
+    history: int = 10,
+) -> Callable:
+    """OWL-QN over the sparse feature-sharded layout: the L1/elastic-net
+    path for >HBM coefficient vectors. ``fit(w0, sharded_batch, l2, l1)``
+    (L2 first, matching the smooth objective; L1 last); the L1 term lives
+    in the optimizer (pseudo-gradient/orthant rules are elementwise over
+    the local block, scalars psum — same recipe as L-BFGS)."""
+    from photon_ml_tpu.optim.lbfgs import minimize_owlqn
+
+    loss = objective.loss
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=_sparse_shard_specs(model_axis, data_axis) + (P(),),
+        out_specs=_opt_result_specs(model_axis),
+        check_vma=False,
+    )
+    def fit(w0_block, b, l2, l1):
+        return minimize_owlqn(
+            _sparse_block_vg(loss, b, l2, model_axis, data_axis),
+            w0_block, l1, max_iter=max_iter, tol=tol, history=history,
+            axis_name=model_axis,
+        )
+
+    return fit

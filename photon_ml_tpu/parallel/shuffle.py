@@ -1,0 +1,156 @@
+"""Entity re-sharding: the all_to_all analog of the reference's shuffles.
+
+Reference: RandomEffectDataSet groups rows by entity with a groupByKey/
+partitionBy shuffle over netty (RandomEffectDataSet.scala:169-243;
+SURVEY §2.4 "shuffle ops"). On TPU the same re-keying is an in-jit
+``lax.all_to_all`` over ICI: each device routes its resident rows to the
+device that owns the row's entity, with static send/receive capacities.
+
+Ownership is ``entity_code % num_devices`` — the LongHashPartitioner
+analog (util/LongHashPartitioner.scala): stable, stateless, and balanced
+for hashed entity ids. Rows with code < 0 (padding) are dropped.
+
+Static-shape contract: every device sends exactly ``cap`` rows to every
+other device (weight-0 padding fills the gaps). If more than ``cap`` real
+rows on one device map to one target, the overflow rows are DROPPED and
+reported in the returned counts — callers size ``cap`` from host-side
+entity statistics (the RandomEffectDataSetPartitioner's load counts) and
+assert no overflow.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from photon_ml_tpu.parallel.mesh import DATA_AXIS
+
+Array = jnp.ndarray
+
+
+class ShuffledRows(NamedTuple):
+    """Result of an entity re-shard, rows grouped by owning device.
+
+    Per device (leading axis sharded over the mesh axis):
+    - ``entity_codes [n_out]``: re-sharded codes, -1 on padding slots
+    - ``payload``: pytree of [n_out, ...] arrays aligned with the codes
+    - ``received [1]``: number of real rows that landed on this device
+    - ``dropped [1]``: rows lost to capacity overflow ON THE SEND side
+      (sum over devices = global drops; 0 means the re-shard is lossless)
+    """
+
+    entity_codes: Array
+    payload: object
+    received: Array
+    dropped: Array
+
+
+def entity_all_to_all(
+    mesh: Mesh,
+    entity_codes: Array,
+    payload,
+    *,
+    cap: int,
+    axis: str = DATA_AXIS,
+) -> ShuffledRows:
+    """Re-shard rows to their owning device (code % n_devices).
+
+    ``entity_codes [n]`` and every payload leaf ``[n, ...]`` are sharded
+    over ``axis``; n must divide the axis size. Each device receives
+    ``n_devices * cap`` row slots (its share from every peer).
+    """
+    n_dev = int(mesh.shape[axis])
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), jax.tree.map(lambda _: P(axis), payload)),
+        out_specs=ShuffledRows(
+            entity_codes=P(axis),
+            payload=jax.tree.map(lambda _: P(axis), payload),
+            received=P(axis),
+            dropped=P(axis),
+        ),
+        check_vma=False,
+    )
+    def reshard(codes, data):
+        n_loc = codes.shape[0]
+        owner = jnp.where(codes >= 0, codes % n_dev, n_dev)  # pad -> n_dev
+        # Slot of each row within its (this-device -> owner) send buffer:
+        # rank among same-owner rows, computed via a stable sort.
+        order = jnp.argsort(owner)  # pads sort last
+        sorted_owner = owner[order]
+        # rank within group = position - first position of the group
+        first_of_group = jnp.searchsorted(sorted_owner, sorted_owner)
+        rank_sorted = jnp.arange(n_loc) - first_of_group
+        rank = jnp.zeros((n_loc,), jnp.int32).at[order].set(
+            rank_sorted.astype(jnp.int32)
+        )
+        keep = (codes >= 0) & (rank < cap)
+        # send buffers: [n_dev, cap] slots; dropped rows scatter to a trash
+        # row appended at index n_dev*cap.
+        slot = jnp.where(keep, owner * cap + rank, n_dev * cap)
+        send_codes = jnp.full((n_dev * cap + 1,), -1, codes.dtype)
+        send_codes = send_codes.at[slot].set(
+            jnp.where(keep, codes, -1), mode="drop"
+        )[:-1]
+
+        def route(leaf):
+            buf = jnp.zeros((n_dev * cap + 1,) + leaf.shape[1:], leaf.dtype)
+            masked = jnp.where(
+                keep.reshape((-1,) + (1,) * (leaf.ndim - 1)), leaf, 0
+            )
+            return buf.at[slot].set(masked, mode="drop")[:-1]
+
+        send_payload = jax.tree.map(route, data)
+        dropped = jnp.sum((codes >= 0) & ~keep).reshape(1)
+
+        # all_to_all: split axis 0 (per-target blocks) across devices,
+        # concat received blocks along axis 0.
+        def exchange(buf):
+            blocks = buf.reshape((n_dev, cap) + buf.shape[1:])
+            out = lax.all_to_all(
+                blocks, axis, split_axis=0, concat_axis=0, tiled=False
+            )
+            return out.reshape((n_dev * cap,) + buf.shape[1:])
+
+        recv_codes = exchange(send_codes)
+        recv_payload = jax.tree.map(exchange, send_payload)
+        received = jnp.sum(recv_codes >= 0).reshape(1)
+        return ShuffledRows(
+            entity_codes=recv_codes,
+            payload=recv_payload,
+            received=received,
+            dropped=dropped,
+        )
+
+    return reshard(entity_codes, payload)
+
+
+def reshard_capacity(
+    entity_codes, n_devices: int, *, slack: float = 1.25
+) -> int:
+    """Host-side capacity sizing from actual entity statistics (the
+    RandomEffectDataSetPartitioner's count pass): max rows any (source
+    shard, target device) pair must carry, times ``slack``, rounded to 8.
+    """
+    import numpy as np
+
+    codes = np.asarray(entity_codes)
+    n = codes.shape[0]
+    per_src = n // n_devices
+    worst = 0
+    for s in range(n_devices):
+        local = codes[s * per_src : (s + 1) * per_src]
+        local = local[local >= 0]
+        if local.size:
+            counts = np.bincount(local % n_devices, minlength=n_devices)
+            worst = max(worst, int(counts.max()))
+    cap = int(np.ceil(worst * slack))
+    return max(((cap + 7) // 8) * 8, 8)

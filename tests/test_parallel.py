@@ -80,6 +80,79 @@ class TestDataParallel:
         )
 
 
+class TestEntityAllToAll:
+    """The shuffle analog: re-key rows to entity-owning devices in-jit."""
+
+    def test_round_trip_lossless(self, mesh8, rng):
+        from photon_ml_tpu.parallel.shuffle import (
+            entity_all_to_all,
+            reshard_capacity,
+        )
+
+        n, n_dev, k = 256, 8, 4
+        codes = rng.integers(0, 40, size=n).astype(np.int32)
+        codes[::17] = -1  # padding rows sprinkled in
+        values = rng.normal(size=n).astype(np.float32)
+        feats = rng.normal(size=(n, k)).astype(np.float32)
+        cap = reshard_capacity(codes, n_dev)
+        out = entity_all_to_all(
+            mesh8,
+            jnp.asarray(codes),
+            {"v": jnp.asarray(values), "x": jnp.asarray(feats)},
+            cap=cap,
+        )
+        assert int(np.asarray(out.dropped).sum()) == 0
+        real = codes >= 0
+        assert int(np.asarray(out.received).sum()) == int(real.sum())
+        out_codes = np.asarray(out.entity_codes)
+        out_v = np.asarray(out.payload["v"])
+        got = out_codes >= 0
+        # multiset of (code, value) pairs survives the re-shard
+        sent = sorted(zip(codes[real].tolist(), values[real].tolist()))
+        recv = sorted(zip(out_codes[got].tolist(), out_v[got].tolist()))
+        assert sent == recv
+        # each device block holds only entities it owns (code % n_dev)
+        per_dev = out_codes.reshape(n_dev, -1)
+        for d in range(n_dev):
+            owned = per_dev[d][per_dev[d] >= 0]
+            assert np.all(owned % n_dev == d)
+        # payload rows stay aligned with their codes
+        out_x = np.asarray(out.payload["x"])
+        code_to_row = {}
+        for i in range(n):
+            if real[i]:
+                code_to_row.setdefault(
+                    (codes[i], round(float(values[i]), 5)), feats[i]
+                )
+        for j in np.nonzero(got)[0][:20]:
+            key = (out_codes[j], round(float(out_v[j]), 5))
+            np.testing.assert_allclose(out_x[j], code_to_row[key], rtol=1e-6)
+
+    def test_overflow_is_reported(self, mesh8, rng):
+        from photon_ml_tpu.parallel.shuffle import entity_all_to_all
+
+        n = 64
+        codes = np.zeros(n, np.int32)  # every row -> device 0
+        out = entity_all_to_all(
+            mesh8,
+            jnp.asarray(codes),
+            {"v": jnp.ones(n, jnp.float32)},
+            cap=8,  # each source may send only 8 rows to device 0
+        )
+        # 8 sources x 8 rows each = 64 slots but only 8 rows per source fit
+        assert int(np.asarray(out.received).sum()) == n - int(
+            np.asarray(out.dropped).sum()
+        )
+        assert int(np.asarray(out.dropped).sum()) == 0  # 8 rows/src fit cap
+        out2 = entity_all_to_all(
+            mesh8,
+            jnp.asarray(codes),
+            {"v": jnp.ones(n, jnp.float32)},
+            cap=4,
+        )
+        assert int(np.asarray(out2.dropped).sum()) == n - 8 * 4
+
+
 class TestFeatureSharded:
     def test_value_and_grad_exact(self, mesh4x2, rng):
         n, d = 64, 16
@@ -146,6 +219,37 @@ class TestFeatureSharded:
         )
         # Padded vocabulary slots never see data => exactly zero.
         np.testing.assert_array_equal(np.asarray(res.coefficients)[d:], 0.0)
+
+    def test_sparse_sharded_owlqn_matches_replicated(self, mesh4x2, rng):
+        from photon_ml_tpu.optim.lbfgs import minimize_owlqn
+        from photon_ml_tpu.parallel import feature_shard_sparse_batch
+        from photon_ml_tpu.parallel.distributed import (
+            feature_sharded_sparse_fit_owlqn,
+        )
+
+        batch, _ = sparse_problem(rng, n=128, d=45, k=8)
+        d = 45
+        obj = GLMObjective(LOGISTIC, d)
+        sharded, block_dim = feature_shard_sparse_batch(
+            batch, d, num_blocks=2, rows_multiple=4
+        )
+        fit = feature_sharded_sparse_fit_owlqn(obj, mesh4x2, max_iter=50)
+        res = fit(
+            jnp.zeros(2 * block_dim), sharded,
+            jnp.float32(0.05), jnp.float32(0.2),
+        )
+        local = minimize_owlqn(
+            lambda w_: obj.value_and_gradient(w_, batch, 0.05),
+            jnp.zeros(d), 0.2, max_iter=50,
+        )
+        np.testing.assert_allclose(
+            np.asarray(res.coefficients)[:d],
+            np.asarray(local.coefficients), atol=5e-3,
+        )
+        # L1 must produce sparsity, identically in both runs
+        assert (np.asarray(res.coefficients)[:d] == 0).sum() == (
+            np.asarray(local.coefficients) == 0
+        ).sum()
 
     def test_sparse_sharded_value_and_grad_exact(self, mesh4x2, rng):
         from photon_ml_tpu.parallel import (
