@@ -115,8 +115,11 @@ class GLMParams:
     kernel: str = "auto"
     # "auto": train data-parallel under shard_map whenever >1 device is
     # visible (the reference is distributed by construction — every Spark
-    # driver runs on a cluster); "off": single-device
+    # driver runs on a cluster); "off": single-device; "feature":
+    # feature-sharded coefficients over a 2-D (data, model) mesh — the
+    # >HBM-coefficient path (SURVEY §2.3 coefficient parallelism)
     distributed: str = "auto"
+    model_shards: Optional[int] = None  # model-axis size for "feature"
     # Multi-host orchestration (the SparkContextConfiguration analog):
     # address of process 0's coordination service. None = single-process.
     coordinator_address: Optional[str] = None
@@ -131,8 +134,34 @@ class GLMParams:
             raise ValueError("output-directory is required")
         if self.kernel not in ("auto", "tiled", "scatter"):
             raise ValueError(f"unknown kernel {self.kernel!r}")
-        if self.distributed not in ("auto", "off"):
+        if self.distributed not in ("auto", "off", "feature"):
             raise ValueError(f"unknown distributed mode {self.distributed!r}")
+        if self.distributed == "feature":
+            if self.optimizer_type == OptimizerType.TRON:
+                raise ValueError(
+                    "feature-sharded training supports LBFGS/OWLQN only "
+                    "(TRON needs hessian-vector products across blocks)"
+                )
+            if self.constraint_string is not None:
+                raise ValueError(
+                    "box constraints are not supported with feature-sharded "
+                    "training"
+                )
+            if self.normalization_type != NormalizationType.NONE:
+                raise ValueError(
+                    "normalization is not supported with feature-sharded "
+                    "training"
+                )
+            if self.compute_variances:
+                raise ValueError(
+                    "variance computation is not supported with "
+                    "feature-sharded training"
+                )
+            if self.validate_per_iteration:
+                raise ValueError(
+                    "validate-per-iteration is not supported with "
+                    "feature-sharded training"
+                )
         if self.optimizer_type == OptimizerType.TRON and self.regularization_type in (
             RegularizationType.L1,
             RegularizationType.ELASTIC_NET,
@@ -295,7 +324,9 @@ class GLMDriver:
         cluster-by-construction analog); None when single-device or off."""
         from photon_ml_tpu.parallel.mesh import maybe_make_mesh
 
-        return maybe_make_mesh(self.params.distributed)
+        return maybe_make_mesh(
+            self.params.distributed, self.params.model_shards
+        )
 
     def train(self) -> None:
         p = self.params
@@ -303,48 +334,72 @@ class GLMDriver:
         with self.timer.time("train"):
             data = self._data
             mesh = self._mesh()
-            if mesh is not None:
+            if p.distributed == "feature" and mesh is not None:
+                from photon_ml_tpu.training import train_feature_sharded
+
                 self.logger.info(
-                    "training data-parallel over %d devices", mesh.devices.size
+                    "training feature-sharded over mesh %s",
+                    dict(mesh.shape),
                 )
-            self.models, self.results = train_generalized_linear_model(
-                data.batch,
-                p.task,
-                data.num_features,
-                optimizer_type=p.optimizer_type,
-                regularization_type=p.regularization_type,
-                regularization_weights=p.regularization_weights,
-                elastic_net_alpha=p.elastic_net_alpha,
-                max_iter=p.max_num_iterations,
-                tolerance=p.tolerance,
-                normalization=self._norm,
-                compute_variances=p.compute_variances,
-                box=data.constraints,
-                intercept_index=data.intercept_index,
-                kernel=p.kernel,
-                mesh=mesh,
-                track_models=p.validate_per_iteration,
-            )
-            for lam, res in self.results.items():
-                self.emitter.send(
-                    PhotonOptimizationLogEvent(
-                        reg_weight=lam,
-                        iterations=int(res.iterations),
-                        convergence_reason=CONVERGENCE_REASON_NAMES.get(
-                            int(res.reason), "?"
-                        ),
-                        final_value=float(res.value),
+                self.models, self.results = train_feature_sharded(
+                    data.batch,
+                    p.task,
+                    data.num_features,
+                    mesh=mesh,
+                    regularization_type=p.regularization_type,
+                    regularization_weights=p.regularization_weights,
+                    elastic_net_alpha=p.elastic_net_alpha,
+                    max_iter=p.max_num_iterations or 100,
+                    tolerance=p.tolerance or 1e-7,
+                    intercept_index=data.intercept_index,
+                )
+            else:
+                if mesh is not None:
+                    self.logger.info(
+                        "training data-parallel over %d devices",
+                        mesh.devices.size,
                     )
+                self.models, self.results = train_generalized_linear_model(
+                    data.batch,
+                    p.task,
+                    data.num_features,
+                    optimizer_type=p.optimizer_type,
+                    regularization_type=p.regularization_type,
+                    regularization_weights=p.regularization_weights,
+                    elastic_net_alpha=p.elastic_net_alpha,
+                    max_iter=p.max_num_iterations,
+                    tolerance=p.tolerance,
+                    normalization=self._norm,
+                    compute_variances=p.compute_variances,
+                    box=data.constraints,
+                    intercept_index=data.intercept_index,
+                    kernel=p.kernel,
+                    mesh=mesh,
+                    track_models=p.validate_per_iteration,
                 )
-                self.logger.info(
-                    "lambda=%g: %d iters, f=%g, reason=%s",
-                    lam,
-                    int(res.iterations),
-                    float(res.value),
-                    CONVERGENCE_REASON_NAMES.get(int(res.reason), "?"),
-                )
+            self._log_results()
         self.emitter.send(TrainingFinishEvent(p.job_name))
         self._advance(DriverStage.TRAINED)
+
+    def _log_results(self) -> None:
+        for lam, res in self.results.items():
+            self.emitter.send(
+                PhotonOptimizationLogEvent(
+                    reg_weight=lam,
+                    iterations=int(res.iterations),
+                    convergence_reason=CONVERGENCE_REASON_NAMES.get(
+                        int(res.reason), "?"
+                    ),
+                    final_value=float(res.value),
+                )
+            )
+            self.logger.info(
+                "lambda=%g: %d iters, f=%g, reason=%s",
+                lam,
+                int(res.iterations),
+                float(res.value),
+                CONVERGENCE_REASON_NAMES.get(int(res.reason), "?"),
+            )
 
     def _metrics_for(self, model, batch) -> Dict[str, float]:
         task = self.params.task
@@ -564,8 +619,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="objective kernel (auto: tiled Pallas on accelerators)",
     )
     ap.add_argument(
-        "--distributed", default="auto", choices=["auto", "off"],
-        help="data-parallel training over all devices (auto: when >1)",
+        "--distributed", default="auto",
+        choices=["auto", "off", "feature"],
+        help="auto: data-parallel when >1 device; feature: feature-sharded "
+        "coefficients over a (data, model) mesh (>HBM models)",
+    )
+    ap.add_argument(
+        "--model-shards", type=int, default=None,
+        help="model-axis size for --distributed feature (default 2)",
     )
     ap.add_argument(
         "--coordinator-address", default=None,
@@ -613,6 +674,7 @@ def params_from_args(argv=None) -> GLMParams:
         job_name=ns.job_name,
         kernel=ns.kernel,
         distributed=ns.distributed,
+        model_shards=ns.model_shards,
         coordinator_address=ns.coordinator_address,
         num_processes=ns.num_processes,
         process_id=ns.process_id,

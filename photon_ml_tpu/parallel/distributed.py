@@ -425,10 +425,12 @@ def feature_sharded_sparse_fit_owlqn(
     history: int = 10,
 ) -> Callable:
     """OWL-QN over the sparse feature-sharded layout: the L1/elastic-net
-    path for >HBM coefficient vectors. ``fit(w0, sharded_batch, l2, l1)``
-    (L2 first, matching the smooth objective; L1 last); the L1 term lives
-    in the optimizer (pseudo-gradient/orthant rules are elementwise over
-    the local block, scalars psum — same recipe as L-BFGS)."""
+    path for >HBM coefficient vectors. ``fit(w0, sharded_batch, l2, l1,
+    l1_mask)`` (L2 first, matching the smooth objective; ``l1_mask`` a
+    full [d_pad] 0/1 vector — 0 exempts a slot, e.g. the intercept — split
+    over the model axis like w); the L1 term lives in the optimizer
+    (pseudo-gradient/orthant rules are elementwise over the local block,
+    scalars psum — same recipe as L-BFGS)."""
     from photon_ml_tpu.optim.lbfgs import minimize_owlqn
 
     loss = objective.loss
@@ -436,15 +438,16 @@ def feature_sharded_sparse_fit_owlqn(
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=_sparse_shard_specs(model_axis, data_axis) + (P(),),
+        in_specs=_sparse_shard_specs(model_axis, data_axis)
+        + (P(), P(model_axis)),
         out_specs=_opt_result_specs(model_axis),
         check_vma=False,
     )
-    def fit(w0_block, b, l2, l1):
+    def fit(w0_block, b, l2, l1, l1_mask_block):
         return minimize_owlqn(
             _sparse_block_vg(loss, b, l2, model_axis, data_axis),
             w0_block, l1, max_iter=max_iter, tol=tol, history=history,
-            axis_name=model_axis,
+            l1_mask=l1_mask_block, axis_name=model_axis,
         )
 
     return fit

@@ -120,13 +120,29 @@ def ensure_data_sharded(batch, mesh: Mesh, axis: str = DATA_AXIS):
     return shard_batch(pad_batch_rows(batch, n_shards), mesh, axis)
 
 
-def maybe_make_mesh(distributed: str) -> Optional[Mesh]:
-    """Shared driver policy: "auto" -> 1-D data mesh over all devices when
-    more than one is visible, else None; "off" -> None."""
-    if distributed not in ("auto", "off"):
+def maybe_make_mesh(
+    distributed: str, model_shards: Optional[int] = None
+) -> Optional[Mesh]:
+    """Shared driver policy.
+
+    "auto" -> 1-D data mesh over all devices when more than one is
+    visible, else None; "off" -> None; "feature" -> 2-D (data, model)
+    mesh for feature-sharded coefficients (model axis = ``model_shards``,
+    default 2; data axis = remaining devices).
+    """
+    if distributed not in ("auto", "off", "feature"):
         raise ValueError(
-            f"unknown distributed mode {distributed!r}; expected auto | off"
+            f"unknown distributed mode {distributed!r}; "
+            "expected auto | off | feature"
         )
-    if distributed == "off" or len(jax.devices()) < 2:
+    n = len(jax.devices())
+    if distributed == "off" or n < 2:
         return None
+    if distributed == "feature":
+        m = model_shards if model_shards is not None else 2
+        if n % m != 0:
+            raise ValueError(
+                f"model_shards={m} does not divide the {n} visible devices"
+            )
+        return make_mesh((n // m, m), (DATA_AXIS, MODEL_AXIS))
     return make_mesh()

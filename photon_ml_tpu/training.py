@@ -144,6 +144,99 @@ def train_generalized_linear_model(
     return models, results
 
 
+def train_feature_sharded(
+    batch: Batch,
+    task: TaskType,
+    dim: int,
+    *,
+    mesh,
+    regularization_type: RegularizationType = RegularizationType.NONE,
+    regularization_weights: Sequence[float] = (0.0,),
+    elastic_net_alpha: Optional[float] = None,
+    max_iter: int = 100,
+    tolerance: float = 1e-7,
+    history: int = 10,
+    warm_start: bool = True,
+    intercept_index: Optional[int] = None,
+) -> Tuple[Dict[float, GeneralizedLinearModel], Dict[float, OptResult]]:
+    """Lambda grid over a FEATURE-SHARDED coefficient vector (the >HBM /
+    10B-coefficient path, SURVEY §2.3 "coefficient parallelism").
+
+    The mesh must be 2-D (data, model); the sparse batch is re-laid out
+    once into per-feature-block slabs and every lambda reuses it. L1 and
+    elastic-net run sharded OWL-QN; L2/none run sharded L-BFGS. TRON, box
+    constraints, and normalization are not supported on this path —
+    callers validate (the GLM driver rejects those combinations).
+    """
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.batch import SparseBatch
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.models.glm import create_model
+    from photon_ml_tpu.ops.losses import loss_for_task
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.parallel.distributed import (
+        feature_shard_sparse_batch,
+        feature_sharded_sparse_fit,
+        feature_sharded_sparse_fit_owlqn,
+    )
+    from photon_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    if not isinstance(batch, SparseBatch):
+        raise TypeError(
+            "feature-sharded training requires a SparseBatch, got "
+            f"{type(batch).__name__}"
+        )
+    if MODEL_AXIS not in mesh.axis_names or DATA_AXIS not in mesh.axis_names:
+        raise ValueError(
+            f"feature-sharded training needs a (data, model) mesh, got "
+            f"axes {mesh.axis_names}"
+        )
+    num_blocks = int(mesh.shape[MODEL_AXIS])
+    data_shards = int(mesh.shape[DATA_AXIS])
+    regularization = RegularizationContext(regularization_type, elastic_net_alpha)
+    objective = GLMObjective(loss_for_task(task), dim)
+
+    sharded, block_dim = feature_shard_sparse_batch(
+        batch, dim, num_blocks, rows_multiple=data_shards
+    )
+    d_pad = num_blocks * block_dim
+    use_owlqn = regularization.has_l1
+    if use_owlqn:
+        fit = feature_sharded_sparse_fit_owlqn(
+            objective, mesh, max_iter=max_iter, tol=tolerance, history=history
+        )
+        # Exempt the intercept from the L1 penalty, exactly like the
+        # replicated path's GLMOptimizationProblem._l1_mask.
+        l1_mask = jnp.ones((d_pad,), jnp.float32)
+        if intercept_index is not None:
+            l1_mask = l1_mask.at[intercept_index].set(0.0)
+    else:
+        fit = feature_sharded_sparse_fit(
+            objective, mesh, max_iter=max_iter, tol=tolerance, history=history
+        )
+
+    weights_desc = sorted(set(float(w) for w in regularization_weights), reverse=True)
+    models: Dict[float, GeneralizedLinearModel] = {}
+    results: Dict[float, OptResult] = {}
+    current = jnp.zeros((d_pad,), jnp.float32)
+    for lam in weights_desc:
+        l1, l2 = regularization.split(lam)
+        if use_owlqn:
+            result = fit(
+                current, sharded, jnp.float32(l2), jnp.float32(l1), l1_mask
+            )
+        else:
+            result = fit(current, sharded, jnp.float32(l2))
+        models[lam] = create_model(
+            task, Coefficients(result.coefficients[:dim])
+        )
+        results[lam] = result
+        if warm_start:
+            current = result.coefficients
+    return models, results
+
+
 def iteration_models(
     result: OptResult,
     task: TaskType,

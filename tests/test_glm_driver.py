@@ -188,6 +188,48 @@ class TestGLMDriverEndToEnd:
             p.validate()
 
 
+class TestFeatureShardedDriver:
+    def test_feature_sharded_mode_end_to_end(self, tmp_path, avro_dirs):
+        """--distributed feature trains over a (data, model) mesh and
+        matches the single-device model (the >HBM coefficient path made
+        driver-reachable)."""
+        train, val = avro_dirs
+        results = {}
+        for mode, out in (("feature", "out_fs"), ("off", "out_single")):
+            params = GLMParams(
+                train_dir=train,
+                validate_dir=val,
+                output_dir=str(tmp_path / out),
+                task=TaskType.LOGISTIC_REGRESSION,
+                regularization_weights=[0.1, 1.0],
+                distributed=mode,
+                model_shards=2,
+            )
+            driver = GLMDriver(params)
+            driver.run()
+            results[mode] = driver
+        for lam in (0.1, 1.0):
+            np.testing.assert_allclose(
+                np.asarray(results["feature"].models[lam].means),
+                np.asarray(results["off"].models[lam].means),
+                atol=5e-3,
+            )
+        assert results["feature"].best_model is not None
+
+    def test_feature_mode_param_rejections(self):
+        for kw in (
+            dict(optimizer_type=OptimizerType.TRON),
+            dict(normalization_type=NormalizationType.STANDARDIZATION),
+            dict(compute_variances=True),
+            dict(constraint_string="[]"),
+        ):
+            p = GLMParams(
+                train_dir="t", output_dir="o", distributed="feature", **kw
+            )
+            with pytest.raises(ValueError):
+                p.validate()
+
+
 class TestDatedInputAndPerIterationValidation:
     def _make_daily(self, base, rng, days, n=120):
         import datetime

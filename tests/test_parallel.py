@@ -237,6 +237,7 @@ class TestFeatureSharded:
         res = fit(
             jnp.zeros(2 * block_dim), sharded,
             jnp.float32(0.05), jnp.float32(0.2),
+            jnp.ones(2 * block_dim, jnp.float32),
         )
         local = minimize_owlqn(
             lambda w_: obj.value_and_gradient(w_, batch, 0.05),
