@@ -218,6 +218,66 @@ class TestRandomEffectSolver:
                 atol=5e-3,
             )
 
+    def test_dense_layout_matches_sparse(self, rng):
+        """The densified (batched-matmul) solver must agree with the
+        gather/scatter solver entity for entity — same optimizer, two
+        data layouts."""
+        recs, _, _ = make_records(rng, n=160, n_users=6)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        red = build_random_effect_dataset(
+            ds, RandomEffectDataConfiguration("userId", "userShard")
+        )
+        banks = {}
+        trackers = {}
+        # ELASTIC_NET keeps both layouts on the SAME optimizer (OWL-QN) —
+        # isolating the layout change (dense + pure L2 would auto-select
+        # the Newton solver, covered by test_newton_solver_matches_lbfgs).
+        for layout in ("sparse", "dense"):
+            problem = RandomEffectOptimizationProblem(
+                LOGISTIC, OptimizerConfig(max_iter=100),
+                RegularizationContext(RegularizationType.ELASTIC_NET, 0.5),
+                reg_weight=1.0, layout=layout,
+            )
+            bank = jnp.zeros((red.num_entities, red.local_dim), jnp.float32)
+            banks[layout], trackers[layout] = problem.update_bank(bank, red)
+        np.testing.assert_allclose(
+            np.asarray(banks["dense"]), np.asarray(banks["sparse"]),
+            atol=2e-4,
+        )
+        # Both layouts must actually converge (exact reason-for-reason
+        # equality would be flaky: the two float32 reduction orders can
+        # trip different tolerance tests at the boundary).
+        for tracker in trackers.values():
+            assert tracker.reason_counts.get("MaxIterations", 0) == 0
+
+    def test_newton_solver_matches_lbfgs(self, rng):
+        """The dual-space Newton path (auto-selected for dense + L2 + twice
+        -differentiable loss) must reach the same optimum as L-BFGS — same
+        convex objective, different algorithm."""
+        recs, _, _ = make_records(rng, n=160, n_users=6)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        red = build_random_effect_dataset(
+            ds, RandomEffectDataConfiguration("userId", "userShard")
+        )
+        banks = {}
+        # layout="dense" + L2 auto-selects Newton; layout="sparse" is LBFGS
+        for layout in ("sparse", "dense"):
+            problem = RandomEffectOptimizationProblem(
+                LOGISTIC, OptimizerConfig(max_iter=100),
+                RegularizationContext(RegularizationType.L2),
+                reg_weight=1.0, layout=layout,
+            )
+            if layout == "dense":
+                assert problem._use_dense(red.buckets[0], red.local_dim)
+            bank = jnp.zeros((red.num_entities, red.local_dim), jnp.float32)
+            banks[layout], tracker = problem.update_bank(bank, red)
+        np.testing.assert_allclose(
+            np.asarray(banks["dense"]), np.asarray(banks["sparse"]),
+            atol=2e-3,
+        )
+        # Newton converges in far fewer iterations than L-BFGS
+        assert tracker.iterations_max <= 20
+
     def test_scores_cover_all_rows(self, rng):
         recs, _, _ = make_records(rng)
         ds = build_game_dataset(recs, SHARDS, ["userId"])
