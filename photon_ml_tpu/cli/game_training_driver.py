@@ -12,6 +12,7 @@ Params.scala:199-426 (option names kept verbatim: ``train-input-dirs``,
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 from dataclasses import dataclass, field
@@ -65,6 +66,32 @@ def parse_shard_map(s: str) -> List[FeatureShardConfiguration]:
         FeatureShardConfiguration(k, [b.strip() for b in v.split(",") if b.strip()])
         for k, v in parse_keyed_map(s).items()
     ]
+
+
+def _ensure_manifest(directory: str, manifest: Dict[str, object]) -> None:
+    """Refuse to reuse a checkpoint directory produced by a different run
+    configuration — resuming foreign weights would silently corrupt the
+    result; a changed config must get a fresh --checkpoint-dir."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, "manifest.json")
+    if os.path.isfile(path):
+        with open(path) as f:
+            existing = json.load(f)
+        if existing != manifest:
+            raise ValueError(
+                f"checkpoint directory {directory} was created by a "
+                "different run configuration (inputs, shards, or update "
+                "sequence changed); point --checkpoint-dir somewhere fresh "
+                f"or delete it. Recorded config: {path}"
+            )
+        return
+    # atomic write: concurrent processes sharing the directory either see
+    # no file (and write identical content) or a complete one — never a
+    # partial JSON
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2)
+    os.replace(tmp, path)
 
 
 def expand_config_grid(
@@ -124,6 +151,13 @@ class GameTrainingParams:
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
+    # Step checkpoints + preemption-safe resume (upgrade over the
+    # reference, which only recovers via saved models / Spark lineage):
+    # when set, every coordinate-descent iteration checkpoints here, a
+    # SIGTERM (spot/preemptible TPU eviction warning) stops training at
+    # the next iteration boundary, and a rerun resumes from the latest
+    # step.
+    checkpoint_dir: Optional[str] = None
 
     def validate(self) -> None:
         if not self.train_input_dirs:
@@ -460,42 +494,150 @@ class GameTrainingDriver:
         )
         self.logger.info("training %d configuration combo(s)", len(combos))
         maximize = p.task_type == TaskType.LOGISTIC_REGRESSION
-        for ci, combo in enumerate(combos):
-            with self.timer.time(f"train-combo-{ci}"):
-                coords = self._build_coordinates(dataset, re_datasets, combo)
-                metric_name = None
-                if validation_fn is not None:
-                    metric_name = (self._evaluators[0].render())
-                cd = CoordinateDescent(
-                    coords,
-                    dataset,
-                    p.task_type,
-                    update_sequence=p.updating_sequence,
-                    validation_fn=validation_fn,
-                    validation_metric=metric_name,
-                    validation_maximize=maximize,
-                    logger=self.logger,
-                )
-                result = cd.run(p.num_iterations)
-            self.results.append((combo, result))
-            metric = result.best_metric
-            if self.best_result is None or (
-                metric is not None
-                and (
-                    (maximize and metric > self.best_result[1])
+        # Cross-combo warm start: train the most-regularized combo first
+        # and seed each subsequent combo's coordinate models from the
+        # previous fit — the GLM lambda-grid warm start
+        # (ModelTraining.scala:183-208) lifted to the GAME grid, which the
+        # reference retrains from scratch per combo. Original grid indices
+        # ride along so timer labels and metric-less best selection keep
+        # the user's configured order.
+        order = sorted(
+            range(len(combos)),
+            key=lambda i: -sum(
+                cfg.reg_weight for cfg in combos[i].values()
+            ),
+        )
+        guard = None
+        run_manifest = None
+        if p.checkpoint_dir is not None:
+            from photon_ml_tpu.utils.preemption import PreemptionGuard
+
+            guard = PreemptionGuard().install()
+            run_manifest = {
+                "train_input_dirs": list(p.train_input_dirs),
+                "train_date_range": p.train_date_range,
+                "train_date_range_days_ago": p.train_date_range_days_ago,
+                "task_type": p.task_type.name,
+                "updating_sequence": list(p.updating_sequence or []),
+                "feature_shards": [repr(s) for s in p.feature_shards],
+                "fixed_effect_data_configs": {
+                    k: repr(v)
+                    for k, v in sorted(p.fixed_effect_data_configs.items())
+                },
+                "random_effect_data_configs": {
+                    k: repr(v)
+                    for k, v in sorted(p.random_effect_data_configs.items())
+                },
+            }
+        prev_model = None
+        best_orig_idx = None
+        try:
+            for ti, ci in enumerate(order):
+                combo = combos[ci]
+                if guard is not None and guard.requested:
+                    self.logger.warning(
+                        "preemption requested: not starting combo %d/%d",
+                        ti + 1,
+                        len(combos),
+                    )
+                    break
+                with self.timer.time(f"train-combo-{ci}"):
+                    coords = self._build_coordinates(
+                        dataset, re_datasets, combo
+                    )
+                    metric_name = None
+                    if validation_fn is not None:
+                        metric_name = (self._evaluators[0].render())
+                    checkpointer = None
+                    if p.checkpoint_dir is not None:
+                        from photon_ml_tpu.utils.checkpoint import (
+                            TrainingCheckpointer,
+                        )
+
+                        # key the directory by the combo's CONTENT so a
+                        # changed grid cannot silently resume from another
+                        # combo's weights (a different config gets a fresh
+                        # directory, not a wrong restore)
+                        fp = hashlib.sha1(
+                            "|".join(
+                                f"{name}:{cfg.render()}"
+                                for name, cfg in sorted(combo.items())
+                            ).encode()
+                        ).hexdigest()[:12]
+                        combo_dir = os.path.join(
+                            p.checkpoint_dir, f"combo-{fp}"
+                        )
+                        # data/shard/sequence changes fail loudly instead
+                        # of silently resuming foreign weights
+                        _ensure_manifest(combo_dir, run_manifest)
+                        checkpointer = TrainingCheckpointer(combo_dir)
+                    cd = CoordinateDescent(
+                        coords,
+                        dataset,
+                        p.task_type,
+                        update_sequence=p.updating_sequence,
+                        validation_fn=validation_fn,
+                        validation_metric=metric_name,
+                        validation_maximize=maximize,
+                        logger=self.logger,
+                        checkpointer=checkpointer,
+                        preemption_guard=guard,
+                    )
+                    try:
+                        result = cd.run(
+                            p.num_iterations, initial_model=prev_model
+                        )
+                    finally:
+                        if checkpointer is not None:
+                            checkpointer.close()
+                    prev_model = result.model
+                self.results.append((combo, result))
+                metric = result.best_metric
+                if metric is None:
+                    # no validation metric: selection falls back to the
+                    # user's configured grid order (parity with the
+                    # pre-warm-start sweep), not training order
+                    if self.best_result is None or (
+                        self.best_result[1] is None and ci < best_orig_idx
+                    ):
+                        self.best_result = (result, None)
+                        self.best_config = combo
+                        best_orig_idx = ci
+                elif (
+                    self.best_result is None
+                    or self.best_result[1] is None
+                    or (maximize and metric > self.best_result[1])
                     or (not maximize and metric < self.best_result[1])
-                )
-            ):
-                self.best_result = (result, metric if metric is not None else 0.0)
-                self.best_config = combo
+                ):
+                    self.best_result = (result, metric)
+                    self.best_config = combo
+                    best_orig_idx = ci
+                if result.preempted:
+                    self.logger.warning(
+                        "stopping combo sweep after preemption (combo %d/%d)",
+                        ti + 1,
+                        len(combos),
+                    )
+                    break
+        finally:
+            if guard is not None:
+                guard.uninstall()
 
         from photon_ml_tpu.parallel.multihost import (
             is_coordinator,
             sync_processes,
         )
 
-        best = self.best_result[0]
+        best = self.best_result[0] if self.best_result is not None else None
         if not is_coordinator():
+            sync_processes("outputs-written")
+            return
+        if best is None:
+            # preempted before any combo finished: checkpoints (if enabled)
+            # carry the partial state; nothing coherent to save as best
+            self.logger.warning(
+                "no configuration combo completed; skipping model save"
+            )
             sync_processes("outputs-written")
             return
         with self.timer.time("save-model"):
@@ -558,6 +700,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument(
         "--distributed", default="auto", choices=["auto", "off"],
         help="shard FE data axis + RE entity axis over all devices",
+    )
+    ap.add_argument(
+        "--checkpoint-dir", default=None,
+        help="per-iteration coordinate-descent checkpoints; enables "
+        "SIGTERM-safe stop and resume-from-latest on rerun",
     )
     return ap
 
@@ -624,6 +771,7 @@ def params_from_args(argv=None) -> GameTrainingParams:
         coordinator_address=ns.coordinator_address,
         num_processes=ns.num_processes,
         process_id=ns.process_id,
+        checkpoint_dir=ns.checkpoint_dir,
     )
 
 

@@ -38,6 +38,9 @@ class CoordinateDescentResult:
     validation_history: List[Dict[str, float]] = field(default_factory=list)
     best_model: Optional[GameModel] = None
     best_metric: Optional[float] = None
+    # True when the run stopped early on a preemption signal; the last
+    # completed iteration is checkpointed, so a restarted job resumes.
+    preempted: bool = False
 
 
 class CoordinateDescent:
@@ -55,6 +58,7 @@ class CoordinateDescent:
         validation_maximize: bool = True,
         logger: Optional[PhotonLogger] = None,
         checkpointer=None,  # photon_ml_tpu.utils.checkpoint.TrainingCheckpointer
+        preemption_guard=None,  # photon_ml_tpu.utils.preemption.PreemptionGuard
     ):
         self.coordinates = coordinates
         self.dataset = dataset
@@ -68,6 +72,31 @@ class CoordinateDescent:
         self.validation_maximize = validation_maximize
         self.logger = logger or PhotonLogger()
         self.checkpointer = checkpointer
+        self.preemption_guard = preemption_guard
+
+    def _preemption_agreed(self) -> bool:
+        """Whether to stop for preemption — agreed ACROSS processes.
+
+        Eviction may deliver SIGTERM to only some hosts; a per-process
+        decision would desync the next iteration's collectives (stopped
+        hosts leave the others blocking in psum forever). Every process
+        polls at the same iteration boundary and an any-process OR via
+        allgather makes the stop unanimous. Single-process runs skip the
+        collective.
+        """
+        if self.preemption_guard is None:
+            return False
+        requested = self.preemption_guard.requested
+        import jax
+
+        if jax.process_count() == 1:
+            return requested
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([requested], dtype=np.int32)
+        )
+        return bool(np.max(flags))
 
     def _objective(self, total_score: Array, models: Dict[str, object]) -> float:
         """loss(sum of scores + offsets) + sum of reg terms
@@ -97,11 +126,13 @@ class CoordinateDescent:
                 models[name] = coord.initialize_model()
 
         start_iteration = 0
+        restored_meta = None
         if self.checkpointer is not None:
             latest = self.checkpointer.latest_step()
             if latest is not None:
                 models = self.checkpointer.restore(latest, models)
                 start_iteration = latest
+                restored_meta = self.checkpointer.load_meta()
                 self.logger.info(
                     "resumed coordinate descent from checkpoint step %d", latest
                 )
@@ -113,6 +144,39 @@ class CoordinateDescent:
         validation_history: List[Dict[str, float]] = []
         best_model = None
         best_metric = None
+        best_step = None
+        preempted = False
+
+        if (
+            restored_meta is not None
+            and restored_meta.get("best_step")
+            and restored_meta.get("metric_name") == self.validation_metric
+        ):
+            # Resume keeps the ORIGINAL run's best-iteration selection
+            # instead of silently re-judging the final model: metric from
+            # the sidecar; weights from that step's checkpoint when orbax
+            # still retains it (max_to_keep window). The sidecar is only
+            # trusted when it tracked the SAME validation metric. If the
+            # best step was pruned, the metric is dropped too — a stale
+            # metric paired with different weights would corrupt both grid
+            # selection and later best-iteration comparisons.
+            step = int(restored_meta["best_step"])
+            if step == start_iteration:
+                best_model = GameModel(dict(models), self.task)
+                best_metric = restored_meta.get("best_metric")
+                best_step = step
+            elif step in self.checkpointer.available_steps():
+                best_model = GameModel(
+                    self.checkpointer.restore(step, models), self.task
+                )
+                best_metric = restored_meta.get("best_metric")
+                best_step = step
+            else:
+                self.logger.warning(
+                    "best iteration %d checkpoint was pruned; re-judging "
+                    "from the restored final model",
+                    step,
+                )
 
         for it in range(start_iteration, num_iterations):
             # Fresh O(C) score sum once per iteration; inside the sweep the
@@ -161,6 +225,49 @@ class CoordinateDescent:
                     if better:
                         best_metric = m
                         best_model = game_model
+                        best_step = it + 1
+
+            if self.checkpointer is not None:
+                self.checkpointer.save_meta(
+                    {
+                        "best_step": best_step,
+                        "best_metric": best_metric,
+                        "metric_name": self.validation_metric,
+                    }
+                )
+
+            if self._preemption_agreed():
+                # Iteration it+1 is complete (and checkpointed above when a
+                # checkpointer is set) — stop at the safe boundary; a
+                # restarted run resumes from this step. Flag even on the
+                # final iteration so a multi-run caller (the grid sweep)
+                # stops instead of starting more work in the grace window.
+                preempted = True
+                self.logger.warning(
+                    "preemption requested: stopping after iteration %d/%d",
+                    it + 1,
+                    num_iterations,
+                )
+                break
+
+        if (
+            self.validation_fn is not None
+            and not validation_history
+            and best_metric is None
+            and start_iteration >= num_iterations
+        ):
+            # Fast-forwarded resume with no best-iteration sidecar (legacy
+            # checkpoint): re-establish the restored model's validation
+            # metrics so grid selection doesn't treat the combo as
+            # metric-less.
+            game_model = GameModel(
+                {name: models[name] for name in seq}, self.task
+            )
+            metrics = self.validation_fn(game_model)
+            validation_history.append(metrics)
+            if self.validation_metric is not None:
+                best_metric = metrics[self.validation_metric]
+                best_model = game_model
 
         final = GameModel({name: models[name] for name in seq}, self.task)
         return CoordinateDescentResult(
@@ -170,4 +277,5 @@ class CoordinateDescent:
             validation_history=validation_history,
             best_model=best_model if best_model is not None else final,
             best_metric=best_metric,
+            preempted=preempted,
         )

@@ -9,6 +9,7 @@ model state, resumable across process restarts (preemptible TPU jobs).
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any, Dict, Optional
 
@@ -94,6 +95,26 @@ class TrainingCheckpointer:
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
+
+    def available_steps(self) -> list:
+        return list(self._mgr.all_steps())
+
+    # -- host-side metadata sidecar (best-iteration tracking etc.) --------
+    def save_meta(self, meta: Dict[str, Any]) -> None:
+        """Small JSON sidecar next to the step checkpoints — resume needs
+        more than weights (e.g. which iteration was validation-best)."""
+        path = os.path.join(self.directory, "cd_meta.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, path)
+
+    def load_meta(self) -> Optional[Dict[str, Any]]:
+        path = os.path.join(self.directory, "cd_meta.json")
+        if not os.path.isfile(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
 
     def restore(self, step: int, models: Dict[str, Any]) -> Dict[str, Any]:
         """-> {name: restored model}, using ``models`` as type templates."""

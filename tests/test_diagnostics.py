@@ -240,6 +240,88 @@ class TestCheckpointing:
         assert r2.objective_history[-1] <= r1.objective_history[-1] + 1e-5
 
 
+class TestPreemption:
+    def test_sigterm_sets_flag_and_chains(self):
+        import os
+        import signal
+
+        from photon_ml_tpu.utils.preemption import PreemptionGuard
+
+        outer = []
+        prev = signal.signal(signal.SIGTERM, lambda s, f: outer.append(s))
+        try:
+            with PreemptionGuard() as guard:
+                assert not guard.requested
+                os.kill(os.getpid(), signal.SIGTERM)
+                assert guard.requested
+                assert outer == [signal.SIGTERM]  # chained to prior handler
+            # uninstalled: prior handler restored
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert outer == [signal.SIGTERM] * 2
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_coordinate_descent_stops_at_boundary_and_resumes(
+        self, tmp_path, rng
+    ):
+        from tests.test_game import SHARDS, make_records
+        from photon_ml_tpu.game import (
+            CoordinateDescent,
+            FixedEffectCoordinate,
+            RandomEffectDataConfiguration,
+            build_game_dataset,
+        )
+        from photon_ml_tpu.optim import (
+            OptimizerConfig,
+            RegularizationContext,
+            RegularizationType,
+        )
+        from photon_ml_tpu.utils.checkpoint import TrainingCheckpointer
+        from photon_ml_tpu.utils.preemption import PreemptionGuard
+
+        recs, _, _ = make_records(rng, n=120, n_users=4)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+
+        def coords():
+            return {
+                "global": FixedEffectCoordinate(
+                    name="global", dataset=ds,
+                    problem=create_glm_problem(
+                        TaskType.LOGISTIC_REGRESSION,
+                        ds.shards["globalShard"].dim,
+                        config=OptimizerConfig(max_iter=10),
+                        regularization=RegularizationContext(
+                            RegularizationType.L2
+                        ),
+                    ),
+                    feature_shard_id="globalShard", reg_weight=0.1,
+                ),
+            }
+
+        guard = PreemptionGuard()
+        guard.request()  # preempt before the run: stop after iteration 1
+        ckpt = str(tmp_path / "ckpt")
+        cp = TrainingCheckpointer(ckpt)
+        r = CoordinateDescent(
+            coords(), ds, TaskType.LOGISTIC_REGRESSION,
+            checkpointer=cp, preemption_guard=guard,
+        ).run(3)
+        cp.close()
+        assert r.preempted
+        assert len(r.objective_history) == 1
+        assert TrainingCheckpointer(ckpt).latest_step() == 1
+
+        # restarted "job": resumes at iteration 2 and finishes the plan
+        cp2 = TrainingCheckpointer(ckpt)
+        r2 = CoordinateDescent(
+            coords(), ds, TaskType.LOGISTIC_REGRESSION,
+            checkpointer=cp2, preemption_guard=PreemptionGuard(),
+        ).run(3)
+        cp2.close()
+        assert not r2.preempted
+        assert len(r2.objective_history) == 2  # iterations 2 and 3
+
+
 class TestEvents:
     def test_emitter_and_listener(self):
         seen = []

@@ -161,6 +161,38 @@ class TestGameTrainingEndToEnd:
             metrics["validation_history"][-1]["AUC"], abs=0.05
         )
 
+    def test_checkpoint_dir_resume(self, tmp_path, rng):
+        """--checkpoint-dir: iterations checkpoint; a rerun fast-forwards
+        past completed steps instead of retraining."""
+        from photon_ml_tpu.utils.checkpoint import TrainingCheckpointer
+
+        params = self._params(
+            tmp_path, rng, checkpoint_dir=str(tmp_path / "ckpt"),
+        )
+        GameTrainingDriver(params).run()
+        combo_dir = next((tmp_path / "ckpt").glob("combo-*"))
+        assert TrainingCheckpointer(str(combo_dir)).latest_step() == 2
+        # restarted identical job: resumes at the final step, so no new CD
+        # iterations run, and best selection comes from the meta sidecar
+        import dataclasses
+
+        params2 = dataclasses.replace(
+            params, output_dir=str(tmp_path / "out2")
+        )
+        d2 = GameTrainingDriver(params2)
+        d2.run()
+        assert d2.results[0][1].objective_history == []
+        assert d2.best_result[1] is not None  # metric restored, not re-judged
+
+        # a changed input configuration must fail loudly, not silently
+        # resume foreign weights
+        (tmp_path / "rerun").mkdir()
+        params3 = self._params(
+            tmp_path / "rerun", rng, checkpoint_dir=str(tmp_path / "ckpt"),
+        )
+        with pytest.raises(ValueError, match="different run configuration"):
+            GameTrainingDriver(params3).run()
+
     def test_grid_picks_best(self, tmp_path, rng):
         params = self._params(
             tmp_path, rng,
@@ -172,6 +204,9 @@ class TestGameTrainingEndToEnd:
         driver = GameTrainingDriver(params)
         driver.run()
         assert len(driver.results) == 2
+        # strongest regularization trains first so later combos warm-start
+        # from the previous fit
+        assert driver.results[0][0]["global"].reg_weight == 1000.0
         assert driver.best_config["global"].reg_weight == 0.1
 
     def test_dated_train_inputs(self, tmp_path, rng):
