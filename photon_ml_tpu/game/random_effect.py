@@ -18,6 +18,7 @@ while_loop over [E_b, ...] blocks. Shard the entity axis over the mesh
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -128,25 +129,26 @@ def _bucket_solver(
         return res.coefficients, res.iterations, res.reason
 
     def _densify(ix, v, d_local):
-        """One batched scatter of each entity's [S, k] sparse rows into a
-        dense X [E, S, D] block."""
-        e_b, s_b, _ = ix.shape
-        X = jnp.zeros((e_b, s_b, d_local), v.dtype)
-        return X.at[
-            jnp.arange(e_b)[:, None, None],
-            jnp.arange(s_b)[None, :, None],
-            ix,
-        ].add(v)
+        """Batched densification of each entity's [S, k] sparse rows into a
+        dense X [E, S, D] block — as a fused compare-and-reduce over the
+        nnz axis rather than a scatter: TPU scatters serialize per element
+        (measured 132 ms at E=20k, S=16, k=32, D=1000) while the VPU eats
+        the k-reduction whole (33 ms, exact same result). XLA fuses the
+        [E, S, k, D] broadcast; it is never materialized."""
+        d = jnp.arange(d_local, dtype=ix.dtype)
+        return jnp.sum(
+            v[..., :, None] * (ix[..., :, None] == d[None, None, None, :]),
+            axis=2,
+        )
 
     @jax.jit
     def solve_dense(bank, ix, v, lab, off, w, l1, l2):
-        """DENSE per-entity layout: one batched scatter densifies each
-        entity's rows into X [E, S, D] up front, then every objective
-        evaluation is a pair of batched matmuls riding the MXU. TPU
-        scatters serialize (~8 ns/element, PERF_NOTES.md), so paying ONE
-        scatter per bank update instead of one per line-search trial is a
-        ~40x gradient-path win whenever S*D is small enough to afford the
-        dense block."""
+        """DENSE per-entity layout: one compare-and-reduce densification
+        of each entity's rows into X [E, S, D] up front (see _densify),
+        then every objective evaluation is a pair of batched matmuls
+        riding the MXU instead of the serialized per-element gathers/
+        scatters of the sparse path — a ~40x gradient-path win whenever
+        S*D is small enough to afford the dense block."""
         X = _densify(ix, v, bank.shape[1])
 
         def one(coef0, X_e, lab_e, off_e, w_e):
@@ -193,7 +195,6 @@ def _bucket_solver(
         del l1  # smooth path only (OWL-QN handles l1)
         _, s_b, _ = ix.shape
         X = _densify(ix, v, bank.shape[1])
-        eye = jnp.eye(s_b, dtype=v.dtype)
         max_iter = config.max_iter
         tol = config.tolerance
 
@@ -203,66 +204,129 @@ def _bucket_solver(
             def value(c, z):
                 return jnp.sum(w_e * loss.value(z, lab_e)) + 0.5 * l2 * jnp.vdot(c, c)
 
-            def grad_norm(z, c):
-                # Exact ||X^T cd + l2 c||: the all-dual expansion
-                # (cd G cd + 2 l2 cd.Xc + l2^2 ||c||^2) cancels
-                # catastrophically in float32 once ||g|| is small relative
-                # to the individual terms, mis-reporting convergence — so
-                # spend one [D, S] matvec per call on the true norm.
+            def grad_vec(z, c):
+                # Exact g = X^T cd + l2 c, materialized in coefficient
+                # space: the all-dual norm expansion (cd G cd + 2 l2 cd.Xc
+                # + l2^2 ||c||^2) cancels catastrophically in float32 once
+                # ||g|| is small relative to the individual terms,
+                # mis-reporting convergence — so spend one [D, S] matvec
+                # per iteration on the true gradient. The vector rides the
+                # loop carry: the NEXT iteration's Cauchy fallback needs
+                # exactly this gradient, so it costs no extra X pass.
                 cd = w_e * loss.d1(z, lab_e)
-                return jnp.linalg.norm(X_e.T @ cd + l2 * c)
+                return X_e.T @ cd + l2 * c
 
             z0 = X_e @ coef0 + off_e
             f0 = value(coef0, z0)
-            g0_norm = grad_norm(z0, coef0)
+            g0_vec = grad_vec(z0, coef0)
+            g0_norm = jnp.linalg.norm(g0_vec)
 
-            # state: (c, z, f, iter, reason). z is carried incrementally
-            # (z_t = z + alpha * z_step, z_step computed in dual space) —
-            # the only X touches per iteration are the X^T applies that
-            # materialize the step and the exact gradient norm.
+            # state: (c, z, f, g_vec, iter, reason). z is carried
+            # incrementally (z_t = z + alpha * z_step, z_step computed in
+            # dual space) — the only X touches per iteration are the X^T
+            # applies that materialize the step and the exact gradient.
             def cond(st):
-                return st[4] == NOT_CONVERGED
+                return st[5] == NOT_CONVERGED
 
             def body(st):
-                c, z, f, it, _ = st
+                c, z, f, g_vec, it, _ = st
                 cd = w_e * loss.d1(z, lab_e)  # dual gradient weights [S]
                 d2 = w_e * loss.d2(z, lab_e)  # [S] >= 0 (convex)
                 zp = z - off_e  # = X c
                 u = G @ cd + l2 * zp  # = X g, no X pass
-                A = l2 * eye + d2[:, None] * G
-                t = jnp.linalg.solve(A, d2 * u)
+                # t = (l2 I + D G)^-1 D u via the symmetrized SPD system
+                # B = l2 I + Dh G Dh (Dh = sqrt(D)): t = Dh B^-1 Dh u.
+                # CG with S iterations is exact up to roundoff and runs
+                # ~6x faster than batched LU on TPU (no pivoting loops,
+                # matvecs ride the MXU); the safeguarded line search
+                # absorbs any residual inexactness.
+                dh = jnp.sqrt(d2)
+
+                def b_mv(x):
+                    return l2 * x + dh * (G @ (dh * x))
+
+                rhs = dh * u
+
+                def cg_body(i, st):
+                    x_c, r_c, p_c, rs = st
+                    ap = b_mv(p_c)
+                    alpha = rs / (jnp.vdot(p_c, ap) + 1e-30)
+                    x_c = x_c + alpha * p_c
+                    r_c = r_c - alpha * ap
+                    rs2 = jnp.vdot(r_c, r_c)
+                    p_c = r_c + (rs2 / (rs + 1e-30)) * p_c
+                    return x_c, r_c, p_c, rs2
+
+                y0 = jnp.zeros_like(rhs)
+                y, _, _, _ = jax.lax.fori_loop(
+                    0, s_b, cg_body,
+                    (y0, rhs, rhs, jnp.vdot(rhs, rhs)),
+                )
+                t = dh * y
                 r = cd - t
                 step = -(X_e.T @ r) / l2 - c  # = -H^-1 g, ONE X pass
                 z_step = -(G @ r) / l2 - zp  # = X step, dual space
 
-                # Halving safeguard as a while_loop: the unit step is
-                # accepted almost always on a convex GLM, and trials cost
-                # NO X passes (z moves along the precomputed z_step).
+                # Line search over 16 halving trials: 0-7 along the Newton
+                # step, 8-15 along the exact Cauchy (steepest-descent)
+                # step — the fallback for the rare entity whose float32 CG
+                # left the Newton step non-descent (ill-conditioned B at
+                # tiny l2). Every trial is pure z-space: the loss term
+                # moves along the precomputed dual step and the l2 term is
+                # a scalar quadratic in alpha, so no [D]-sized work or X
+                # pass happens per trial.
+                cc = jnp.vdot(c, c)
+                cs_n = jnp.vdot(c, step)
+                ss_n = jnp.vdot(step, step)
+                cg_dot = jnp.vdot(c, g_vec)
+                g_sq = jnp.vdot(g_vec, g_vec)  # exact, from the carry
+                g_hg = jnp.vdot(u, d2 * u) + l2 * g_sq
+                cauchy = g_sq / (g_hg + 1e-30)
+                cs_c = -cauchy * cg_dot
+                ss_c = cauchy * cauchy * g_sq
+                z_step_c = -cauchy * u
+
+                def trial(k):
+                    newton = k < 8
+                    a = jnp.exp2(-jnp.where(newton, k, k - 8).astype(z.dtype))
+                    z_t = z + a * jnp.where(newton, z_step, z_step_c)
+                    cs = jnp.where(newton, cs_n, cs_c)
+                    ss = jnp.where(newton, ss_n, ss_c)
+                    loss_t = jnp.sum(w_e * loss.value(z_t, lab_e))
+                    return a, loss_t + 0.5 * l2 * (
+                        cc + 2.0 * a * cs + a * a * ss
+                    )
+
                 def ls_cond(carry):
-                    alpha, f_t, k = carry
+                    k, _, f_t = carry
                     bad = (f_t > f) | ~jnp.isfinite(f_t)
-                    return bad & (k < 8)
+                    return bad & (k < 16)
 
                 def ls_body(carry):
-                    alpha, _, k = carry
-                    alpha = alpha * 0.5
-                    c_t = c + alpha * step
-                    z_t = z + alpha * z_step
-                    return alpha, value(c_t, z_t), k + 1
+                    k, _, _ = carry
+                    k = k + 1
+                    a, f_t = trial(k)
+                    return k, a, jnp.where(k < 16, f_t, jnp.inf)
 
-                f1 = value(c + step, z + z_step)
-                alpha, f_t, _ = jax.lax.while_loop(
-                    ls_cond, ls_body, (jnp.float32(1.0), f1, jnp.int32(0))
+                a0, f0_t = trial(jnp.int32(0))
+                k, alpha, f_t = jax.lax.while_loop(
+                    ls_cond, ls_body, (jnp.int32(0), a0, f0_t)
                 )
                 # <= : at the optimum the step is ~0 and f_t == f;
                 # accepting it lets the function-change test converge
                 # instead of mis-reporting MaxIterations.
                 moved = (f_t <= f) & jnp.isfinite(f_t)
-                c2 = jnp.where(moved, c + alpha * step, c)
-                z2 = jnp.where(moved, z + alpha * z_step, z)
+                newton_used = k < 8
+                # the carried g_vec IS the gradient at (c, z) — the
+                # fallback direction costs no extra X pass
+                used_step = jnp.where(newton_used, step, -cauchy * g_vec)
+                used_zstep = jnp.where(newton_used, z_step, z_step_c)
+                c2 = jnp.where(moved, c + alpha * used_step, c)
+                z2 = jnp.where(moved, z + alpha * used_zstep, z)
                 f2 = jnp.where(moved, f_t, f)
                 it2 = it + 1
-                g_norm = grad_norm(z2, c2)
+                g2_vec = grad_vec(z2, c2)
+                g_norm = jnp.linalg.norm(g2_vec)
                 reason = jnp.where(
                     moved,
                     check_convergence(
@@ -271,21 +335,61 @@ def _bucket_solver(
                     ),
                     MAX_ITERATIONS,  # no decreasing step exists
                 ).astype(jnp.int32)
-                return (c2, z2, f2, it2, reason)
+                return (c2, z2, f2, g2_vec, it2, reason)
 
             init = (
-                coef0, z0, f0, jnp.zeros((), jnp.int32),
+                coef0, z0, f0, g0_vec, jnp.zeros((), jnp.int32),
                 jnp.where(
                     g0_norm == 0.0, GRADIENT_WITHIN_TOLERANCE, NOT_CONVERGED
                 ).astype(jnp.int32),
             )
-            c, _, _, it, reason = jax.lax.while_loop(cond, body, init)
+            c, _, _, _, it, reason = jax.lax.while_loop(cond, body, init)
             return c, it, reason
 
         coefs, iters, reasons = jax.vmap(one)(bank, X, lab, off, w)
         return coefs, iters, reasons
 
-    return solve, solve_dense, solve_dense_newton
+    n_reasons = max(CONVERGENCE_REASON_NAMES) + 1
+
+    def _fused(core):
+        """Single-dispatch bucket update: bank-row gather, solve, bank
+        scatter, and the tracker reductions all inside ONE jit program —
+        per-bucket host overhead (separate gather/scatter dispatches plus
+        two [E]-sized device->host tracker transfers) otherwise dwarfs the
+        ~ms solve itself on a tunneled chip.
+
+        The bank operand is DONATED (where the backend supports donation):
+        the scatter updates it in place instead of copying the full
+        [E_total, D] bank per bucket — at the 1B-coefficient scale that
+        copy would double peak bank memory and add a ~4 GB HBM pass per
+        bucket. update_bank defensively copies the caller's bank ONCE
+        before the bucket chain so outside references stay valid."""
+        donate = (0,) if jax.default_backend() != "cpu" else ()
+
+        @partial(jax.jit, donate_argnums=donate)
+        def fused(bank_full, codes, ix, v, lab, off, w, l1, l2):
+            sl = jnp.take(bank_full, codes, axis=0)
+            new_sl, iters, reasons = core(sl, ix, v, lab, off, w, l1, l2)
+            bank_full = bank_full.at[codes].set(new_sl)
+            return (
+                bank_full,
+                jnp.sum(iters),
+                jnp.max(iters),
+                jnp.bincount(reasons, length=n_reasons),
+            )
+
+        return fused
+
+    from types import SimpleNamespace
+
+    return SimpleNamespace(
+        sparse=solve,
+        dense=solve_dense,
+        newton=solve_dense_newton,
+        fused_sparse=_fused(solve),
+        fused_dense=_fused(solve_dense),
+        fused_newton=_fused(solve_dense_newton),
+    )
 
 
 @dataclass
@@ -317,7 +421,7 @@ class RandomEffectOptimizationProblem:
     def __post_init__(self):
         if self.layout not in ("auto", "sparse", "dense"):
             raise ValueError(f"unknown layout {self.layout!r}")
-        self._solver, self._solver_dense, self._solver_newton = _bucket_solver(
+        self._solvers = _bucket_solver(
             self.loss, self.config, self.regularization
         )
         # Device-resident copies of each bucket's static arrays (indices/
@@ -350,14 +454,14 @@ class RandomEffectOptimizationProblem:
             return self.layout == "dense"
         e_b, s_b, _ = bucket.indices.shape
         itemsize = np.dtype(bucket.values.dtype).itemsize
-        # X [E, S, D], plus the Newton path's G and A [E, S, S] blocks when
-        # that solver would actually run — when S > D those Grams, not X,
-        # dominate the footprint, but charging them to a bucket that can
-        # only take the plain dense solver would wrongly force the
-        # serialized-scatter sparse path.
+        # X [E, S, D], plus the Newton path's Gram G [E, S, S] when that
+        # solver would actually run (the CG solve is matrix-free — no
+        # second S x S block) — when S > D the Grams, not X, dominate the
+        # footprint, but charging them to a bucket that can only take the
+        # plain dense solver would wrongly force the slow sparse path.
         floats = e_b * s_b * d_local
         if self._newton_eligible():
-            floats += e_b * 2 * s_b * s_b
+            floats += e_b * s_b * s_b
         return floats * itemsize <= self.dense_bytes_budget
 
     def _bucket_device_args(self, bucket) -> List[Array]:
@@ -375,9 +479,12 @@ class RandomEffectOptimizationProblem:
             jnp.asarray(bucket.values),
             jnp.asarray(bucket.labels),
             jnp.asarray(bucket.weights),
+            jnp.asarray(bucket.offsets),
         ]
         if self.mesh is not None:
             arrs, _ = self._shard_entity_axis(arrs)
+        # entity codes stay unsharded: they index the full bank host-side
+        arrs = arrs + [jnp.asarray(bucket.entity_codes)]
         cache = self._device_cache
         ref = weakref.ref(bucket, lambda _, k=key, c=cache: c.pop(k, None))
         self._device_cache[key] = (ref, arrs)
@@ -411,53 +518,81 @@ class RandomEffectOptimizationProblem:
         """Solve every entity against its active data; returns the new bank
         and an aggregated tracker."""
         l1, l2 = self.regularization.split(self.reg_weight)
-        iters_all: List[np.ndarray] = []
-        reasons_all: List[np.ndarray] = []
+        l1_d, l2_d = jnp.float32(l1), jnp.float32(l2)
+        # Per-bucket stat vectors [iter_sum, iter_max, *reason_counts] stay
+        # ON DEVICE until one stacked fetch at the end: every device->host
+        # readback is a full host<->device round trip (~100ms over a
+        # tunneled chip), so the loop stays fully async and the tracker
+        # costs one sync total, not three per bucket.
+        n_codes = max(CONVERGENCE_REASON_NAMES) + 1
+        n_reals: List[int] = []
+        stat_vecs: List[Array] = []
+        if self.mesh is None and dataset.buckets:
+            # one defensive copy so the fused updates can DONATE the bank
+            # (in-place scatter per bucket) while the caller's reference
+            # stays valid
+            bank = jnp.array(bank, copy=True)
         for bucket in dataset.buckets:
-            ix_d, v_d, lab_d, w_d = self._bucket_device_args(bucket)
-            off = bucket.offsets
+            ix_d, v_d, lab_d, w_d, off_d, codes_d = self._bucket_device_args(
+                bucket
+            )
             if residual_offsets is not None:
                 safe_rows = np.maximum(bucket.row_index, 0)
                 off = residual_offsets[safe_rows].astype(np.float32)
                 off = np.where(bucket.row_index >= 0, off, 0.0)
-            sl = bank[jnp.asarray(bucket.entity_codes)]
-            dynamic = [sl, jnp.asarray(off)]
-            n_real = sl.shape[0]
-            if self.mesh is not None:
-                # padded entities carry zero data: their solve converges at
-                # iteration 0 on a zero gradient — inert and cheap
-                dynamic, n_real = self._shard_entity_axis(dynamic)
-            args = [dynamic[0], ix_d, v_d, lab_d, dynamic[1], w_d]
-            if self._use_dense(bucket, bank.shape[1]):
-                solver = (
-                    self._solver_newton
-                    if self._newton_eligible()
-                    else self._solver_dense
+                off_d = jnp.asarray(off)
+                if self.mesh is not None:
+                    (off_d,), _ = self._shard_entity_axis([off_d])
+            n_real = bucket.num_entities
+            use_dense = self._use_dense(bucket, bank.shape[1])
+            kind = (
+                ("newton" if self._newton_eligible() else "dense")
+                if use_dense
+                else "sparse"
+            )
+            if self.mesh is None:
+                # fused path: gather + solve + scatter + tracker reductions
+                # in one dispatch
+                fused = getattr(self._solvers, f"fused_{kind}")
+                bank, it_sum, it_max, counts = fused(
+                    bank, codes_d, ix_d, v_d, lab_d, off_d, w_d, l1_d, l2_d
                 )
             else:
-                solver = self._solver
-            new_sl, iters, reasons = solver(
-                *args,
-                jnp.float32(l1),
-                jnp.float32(l2),
+                # padded entities carry zero data: their solve converges at
+                # iteration 0 on a zero gradient — inert and cheap
+                sl = bank[codes_d]
+                (sl,), _ = self._shard_entity_axis([sl])
+                solver = getattr(self._solvers, kind)
+                new_sl, iters, reasons = solver(
+                    sl, ix_d, v_d, lab_d, off_d, w_d, l1_d, l2_d
+                )
+                new_sl = new_sl[:n_real]
+                iters = iters[:n_real]
+                reasons = reasons[:n_real]
+                bank = bank.at[codes_d].set(new_sl)
+                it_sum = jnp.sum(iters)
+                it_max = jnp.max(iters)
+                counts = jnp.bincount(reasons, length=n_codes)
+            n_reals.append(n_real)
+            stat_vecs.append(
+                jnp.concatenate([jnp.stack([it_sum, it_max]), counts])
             )
-            new_sl = new_sl[:n_real]
-            iters = iters[:n_real]
-            reasons = reasons[:n_real]
-            bank = bank.at[jnp.asarray(bucket.entity_codes)].set(new_sl)
-            iters_all.append(np.asarray(iters))
-            reasons_all.append(np.asarray(reasons))
-        if iters_all:
-            iters = np.concatenate(iters_all)
-            reasons = np.concatenate(reasons_all)
-            counts: Dict[str, int] = {}
-            for code, cnt in zip(*np.unique(reasons, return_counts=True)):
-                counts[CONVERGENCE_REASON_NAMES.get(int(code), "?")] = int(cnt)
+        if stat_vecs:
+            all_stats = np.asarray(jnp.stack(stat_vecs))  # ONE readback
+            total = sum(n_reals)
+            iter_sum = int(all_stats[:, 0].sum())
+            iter_max = int(all_stats[:, 1].max())
+            count_vec = all_stats[:, 2:].sum(axis=0)
+            counts_dict: Dict[str, int] = {
+                CONVERGENCE_REASON_NAMES.get(code, "?"): int(cnt)
+                for code, cnt in enumerate(count_vec)
+                if cnt
+            }
             tracker = RandomEffectTracker(
-                num_entities=len(iters),
-                iterations_mean=float(iters.mean()),
-                iterations_max=int(iters.max()),
-                reason_counts=counts,
+                num_entities=total,
+                iterations_mean=iter_sum / total,
+                iterations_max=iter_max,
+                reason_counts=counts_dict,
             )
         else:
             tracker = RandomEffectTracker(0, 0.0, 0, {})
@@ -503,9 +638,9 @@ def dryrun_entity_bank(mesh) -> None:
     n_dev = mesh.devices.size
     E, S, K, D = 2 * n_dev, 4, 4, 8
     rng = np.random.default_rng(0)
-    solver, _, _ = _bucket_solver(
+    solver = _bucket_solver(
         LOGISTIC, OptimizerConfig(max_iter=3), RegularizationContext()
-    )
+    ).sparse
     sharding = NamedSharding(mesh, P(axis))
     bank = jax.device_put(jnp.zeros((E, D), jnp.float32), sharding)
     args = (
