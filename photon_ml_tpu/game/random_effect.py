@@ -32,6 +32,7 @@ from photon_ml_tpu.game.random_effect_data import (
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.optim.common import (
     CONVERGENCE_REASON_NAMES,
+    FUNCTION_VALUES_WITHIN_TOLERANCE,
     GRADIENT_WITHIN_TOLERANCE,
     MAX_ITERATIONS,
     NOT_CONVERGED,
@@ -298,24 +299,29 @@ def _bucket_solver(
                     )
 
                 def ls_cond(carry):
-                    k, _, f_t = carry
+                    k, _, f_t, _ = carry
                     bad = (f_t > f) | ~jnp.isfinite(f_t)
                     return bad & (k < 16)
 
                 def ls_body(carry):
-                    k, _, _ = carry
+                    k, _, _, f_min = carry
                     k = k + 1
                     a, f_t = trial(k)
-                    return k, a, jnp.where(k < 16, f_t, jnp.inf)
+                    f_t = jnp.where(k < 16, f_t, jnp.inf)
+                    return k, a, f_t, jnp.minimum(f_min, f_t)
 
                 a0, f0_t = trial(jnp.int32(0))
-                k, alpha, f_t = jax.lax.while_loop(
-                    ls_cond, ls_body, (jnp.int32(0), a0, f0_t)
+                k, alpha, f_t, f_min = jax.lax.while_loop(
+                    ls_cond, ls_body, (jnp.int32(0), a0, f0_t, f0_t)
                 )
-                # <= : at the optimum the step is ~0 and f_t == f;
-                # accepting it lets the function-change test converge
-                # instead of mis-reporting MaxIterations.
+                # Strict decrease moves the iterate (monotone invariant);
+                # when NO trial decreases but the best trial was a float32
+                # near-tie, the entity is sitting on its optimum's noise
+                # plateau — report convergence WITHOUT moving instead of a
+                # bogus MaxIterations (and instead of accepting an uphill
+                # step, which could random-walk past the convergence test).
                 moved = (f_t <= f) & jnp.isfinite(f_t)
+                plateau = ~moved & (f_min <= f + 1e-6 * (1.0 + jnp.abs(f)))
                 newton_used = k < 8
                 # the carried g_vec IS the gradient at (c, z) — the
                 # fallback direction costs no extra X pass
@@ -333,7 +339,11 @@ def _bucket_solver(
                         it2, f, f2, g_norm, f0, g0_norm,
                         max_iter=max_iter, tol=tol,
                     ),
-                    MAX_ITERATIONS,  # no decreasing step exists
+                    jnp.where(
+                        plateau,
+                        FUNCTION_VALUES_WITHIN_TOLERANCE,
+                        MAX_ITERATIONS,  # no decreasing step exists
+                    ),
                 ).astype(jnp.int32)
                 return (c2, z2, f2, g2_vec, it2, reason)
 
