@@ -41,6 +41,12 @@ Array = jnp.ndarray
 # (DistributedOptimizationProblem.scala:79-93).
 _VARIANCE_EPSILON = 1e-12
 
+# Jitted fit programs shared by equal problems (see _get_fit);
+# FIFO-bounded so long-lived processes constructing many distinct
+# problems don't pin executables forever.
+_FIT_CACHE: dict = {}
+_FIT_CACHE_MAX = 32
+
 
 @dataclass(frozen=True)
 class GLMOptimizationProblem:
@@ -62,6 +68,89 @@ class GLMOptimizationProblem:
         if self.intercept_index is None:
             return None
         return jnp.ones((self.objective.dim,)).at[self.intercept_index].set(0.0)
+
+    def _get_fit(self, track_models: bool, mesh=None, axis: str = ""):
+        """Jitted fit program (optionally shard_mapped over ``mesh``),
+        cached so repeat `run` calls skip re-tracing the optimizer
+        while_loop.
+
+        Tracing the L-BFGS while_loop over the tiled objective costs
+        seconds of host time (the schedules are ~16.7M-entry pytrees);
+        without caching EVERY `run` call pays it — once per lambda-grid
+        entry per driver stage, and once per coordinate-descent iteration
+        in GAME. Cache key: the problem's config tuple (module-level, so
+        equal problems share; FIFO-bounded) with an instance-local
+        fallback when a field (e.g. box-constraint arrays) is unhashable.
+        reg weights stay TRACED arguments, so a whole lambda grid is one
+        compile. The cache entry pins the mesh so an id-recycled mesh
+        cannot alias a stale program.
+        """
+        import jax
+
+        key = (
+            self.objective,
+            self.config,
+            self.regularization,
+            self.box,
+            self.intercept_index,
+            track_models,
+            id(mesh) if mesh is not None else None,
+            axis,
+        )
+        try:
+            hash(key)
+            cache = _FIT_CACHE
+        except TypeError:
+            if "_local_fit_cache" not in self.__dict__:
+                object.__setattr__(self, "_local_fit_cache", {})
+            cache = self._local_fit_cache
+            key = (track_models, id(mesh) if mesh is not None else None, axis)
+        hit = cache.get(key)
+        if hit is not None:
+            return hit[0]
+        optimize = make_optimizer(
+            self.config,
+            self.regularization,
+            loss_has_hessian=self.objective.loss.has_hessian,
+            box=self.box,
+            l1_mask=self._l1_mask(),
+            track_coefficients=track_models,
+        )
+        needs_hvp = self.config.optimizer_type == OptimizerType.TRON
+        objective = (
+            self.objective if mesh is None else self.objective.with_axis(axis)
+        )
+
+        def fit(w0, batch, l1, l2):
+            def vg(w):
+                return objective.value_and_gradient(w, batch, l2)
+
+            def hvp(w, d):
+                return objective.hessian_vector(w, d, batch, l2)
+
+            return optimize(
+                vg, w0, l1_weight=l1, hvp_fn=hvp if needs_hvp else None
+            )
+
+        if mesh is not None:
+            from functools import partial as _partial
+
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            fit = _partial(
+                shard_map,
+                mesh=mesh,
+                in_specs=(P(), P(axis), P(), P()),
+                out_specs=P(),
+                check_vma=False,
+            )(fit)
+        fit = jax.jit(fit)
+
+        while len(cache) >= _FIT_CACHE_MAX:
+            cache.pop(next(iter(cache)))
+        cache[key] = (fit, mesh)
+        return fit
 
     def run(
         self,
@@ -93,31 +182,13 @@ class GLMOptimizationProblem:
             else jnp.asarray(initial)
         )
         l1, l2 = self.regularization.split(reg_weight)
-        optimize = make_optimizer(
-            self.config,
-            self.regularization,
-            loss_has_hessian=self.objective.loss.has_hessian,
-            box=self.box,
-            l1_mask=self._l1_mask(),
-            track_coefficients=track_models,
-        )
-        needs_hvp = self.config.optimizer_type == OptimizerType.TRON
 
         if mesh is None:
-            objective = self.objective
-
-            def vg(w):
-                return objective.value_and_gradient(w, batch, l2)
-
-            def hvp(w, d):
-                return objective.hessian_vector(w, d, batch, l2)
-
-            result = optimize(
-                vg, w0, l1_weight=l1, hvp_fn=hvp if needs_hvp else None
-            )
+            fit = self._get_fit(track_models)
+            result = fit(w0, batch, jnp.float32(l1), jnp.float32(l2))
             variances = None
             if self.compute_variances:
-                hdiag = objective.hessian_diagonal(
+                hdiag = self.objective.hessian_diagonal(
                     result.coefficients, batch, l2
                 )
                 variances = 1.0 / (hdiag + _VARIANCE_EPSILON)
@@ -125,40 +196,19 @@ class GLMOptimizationProblem:
 
         from functools import partial as _partial
 
-        import jax
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
         from photon_ml_tpu.parallel.mesh import DATA_AXIS, ensure_data_sharded
 
         axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
-        objective = self.objective.with_axis(axis)
         sharded = ensure_data_sharded(batch, mesh, axis)
-        l1_arr = jnp.float32(l1)
-        l2_arr = jnp.float32(l2)
-
-        @_partial(
-            shard_map,
-            mesh=mesh,
-            in_specs=(P(), P(axis), P(), P()),
-            out_specs=P(),
-            check_vma=False,
-        )
-        def _fit(w0_, b, l1_, l2_):
-            def vg(w):
-                return objective.value_and_gradient(w, b, l2_)
-
-            def hvp(w, d):
-                return objective.hessian_vector(w, d, b, l2_)
-
-            return optimize(
-                vg, w0_, l1_weight=l1_, hvp_fn=hvp if needs_hvp else None
-            )
-
-        result = _fit(w0, sharded, l1_arr, l2_arr)
+        _fit = self._get_fit(track_models, mesh=mesh, axis=axis)
+        result = _fit(w0, sharded, jnp.float32(l1), jnp.float32(l2))
 
         variances = None
         if self.compute_variances:
+            objective = self.objective.with_axis(axis)
 
             @_partial(
                 shard_map,
@@ -170,7 +220,7 @@ class GLMOptimizationProblem:
             def _hdiag(w, b, l2_):
                 return objective.hessian_diagonal(w, b, l2_)
 
-            hdiag = _hdiag(result.coefficients, sharded, l2_arr)
+            hdiag = _hdiag(result.coefficients, sharded, jnp.float32(l2))
             variances = 1.0 / (hdiag + _VARIANCE_EPSILON)
         return Coefficients(result.coefficients, variances), result
 
