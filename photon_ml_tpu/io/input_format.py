@@ -144,7 +144,51 @@ class AvroInputDataFormat:
             if self.selected is None or key in self.selected:
                 yield key, float(f["value"])
 
+    def _decode_native(self, paths):
+        """Try the native column decoder; None -> caller falls back to the
+        Python codec. Returns one DecodedColumns per file."""
+        from photon_ml_tpu.io import native_avro
+        from photon_ml_tpu.io.avro_codec import read_container
+        from photon_ml_tpu.io.paths import expand_input_paths
+
+        if not native_avro.available():
+            return None
+        files = list(
+            expand_input_paths(paths, lambda fn: fn.endswith(".avro"))
+        )
+        if not files:
+            return None
+        out = []
+        try:
+            for p in files:
+                schema, _ = read_container(p)
+                names = {f["name"] for f in schema.get("fields", [])}
+                if "features" not in names or "label" not in names:
+                    return None
+                numeric = [
+                    f for f in ("label", "offset", "weight") if f in names
+                ]
+                plan = native_avro.Plan(schema).compile(
+                    numeric_fields=numeric, bag_fields=["features"]
+                )
+                out.append(native_avro.decode_columns(p, plan))
+        except (native_avro.PlanError, ValueError, OSError):
+            return None
+        return out
+
+    def _index_map_from_decoded(self, decoded) -> IndexMap:
+        keys = (
+            key
+            for cols in decoded
+            for key in cols.strings
+            if self.selected is None or key in self.selected
+        )
+        return IndexMap.build(keys, add_intercept=self.add_intercept)
+
     def build_index_map(self, paths) -> IndexMap:
+        decoded = self._decode_native(paths)
+        if decoded is not None:
+            return self._index_map_from_decoded(decoded)
         keys = (
             key
             for record in read_avro_records(paths)
@@ -158,28 +202,89 @@ class AvroInputDataFormat:
         index_map: Optional[IndexMap] = None,
         constraint_string: Optional[str] = None,
     ) -> LoadedData:
+        decoded = self._decode_native(paths)
         if index_map is None:
-            index_map = self.build_index_map(paths)
+            index_map = (
+                self._index_map_from_decoded(decoded)
+                if decoded is not None
+                else self.build_index_map(paths)
+            )
         dim = index_map.size
         icept = index_map.get_index(intercept_key()) if self.add_intercept else -1
         intercept_index = icept if icept >= 0 else None
 
         rows, labels, offsets, weights = [], [], [], []
-        for record in read_avro_records(paths):
-            ix: List[int] = []
-            vs: List[float] = []
-            for key, value in self._record_pairs(record):
-                i = index_map.get_index(key)
-                if i >= 0:
-                    ix.append(i)
-                    vs.append(value)
-            if intercept_index is not None:
-                ix.append(intercept_index)
-                vs.append(1.0)
-            rows.append((ix, vs))
-            labels.append(float(record["label"]))
-            offsets.append(float(record.get("offset") or 0.0))
-            weights.append(float(record.get("weight") or 1.0))
+        if decoded is not None:
+            for cols in decoded:
+                # vectorized id remap: per-file intern table -> global
+                # index (selected-features filter folded into the table)
+                table = np.asarray(
+                    [
+                        index_map.get_index(s)
+                        if self.selected is None or s in self.selected
+                        else -1
+                        for s in cols.strings
+                    ],
+                    dtype=np.int64,
+                )
+                row_ptr, key_ids, values = cols.bag("features")
+                gix = (
+                    table[key_ids]
+                    if len(key_ids)
+                    else np.zeros(0, np.int64)
+                )
+                lab = cols.f64("label")
+                if np.isnan(lab).any():
+                    # the Python fallback would crash on float(None); a
+                    # NaN label must not silently poison the fit
+                    raise ValueError(
+                        "null/NaN label in Avro input (native decode)"
+                    )
+                off = (
+                    cols.f64("offset")
+                    if "offset" in cols.plan.num_slots
+                    else np.zeros(len(lab))
+                )
+                wgt = (
+                    cols.f64("weight")
+                    if "weight" in cols.plan.num_slots
+                    else np.ones(len(lab))
+                )
+                # only the null sentinel is replaced — inf passes through,
+                # matching the Python fallback
+                off = np.where(np.isnan(off), 0.0, off)
+                wgt = np.where(np.isnan(wgt), 1.0, wgt)
+                for i in range(cols.num_records):
+                    lo, hi = int(row_ptr[i]), int(row_ptr[i + 1])
+                    g = gix[lo:hi]
+                    keep = g >= 0
+                    ix = g[keep].tolist()
+                    vs = values[lo:hi][keep].tolist()
+                    if intercept_index is not None:
+                        ix.append(intercept_index)
+                        vs.append(1.0)
+                    rows.append((ix, vs))
+                labels.extend(lab.tolist())
+                offsets.extend(off.tolist())
+                weights.extend(wgt.tolist())
+        else:
+            for record in read_avro_records(paths):
+                ix: List[int] = []
+                vs: List[float] = []
+                for key, value in self._record_pairs(record):
+                    i = index_map.get_index(key)
+                    if i >= 0:
+                        ix.append(i)
+                        vs.append(value)
+                if intercept_index is not None:
+                    ix.append(intercept_index)
+                    vs.append(1.0)
+                rows.append((ix, vs))
+                labels.append(float(record["label"]))
+                off_v = record.get("offset")
+                wgt_v = record.get("weight")
+                offsets.append(0.0 if off_v is None else float(off_v))
+                weights.append(1.0 if wgt_v is None else float(wgt_v))
 
         batch = _rows_to_batch(rows, labels, offsets, weights)
         constraints = parse_constraint_string(

@@ -1,0 +1,179 @@
+"""Native Avro column decoder vs the pure-Python codec: byte-identical
+container files must produce identical columns (labels, offsets, weights,
+feature bags, metadataMap ids) through both paths."""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro_codec import read_container, write_container
+from photon_ml_tpu.io import native_avro
+
+
+pytestmark = pytest.mark.skipif(
+    not native_avro.available(), reason="native avro build unavailable"
+)
+
+
+def _training_schema():
+    schema = dict(schemas.TRAINING_EXAMPLE_AVRO)
+    return schema
+
+
+def _write_fixture(path, rng, n=500, codec="deflate"):
+    recs = []
+    for i in range(n):
+        feats = [
+            {
+                "name": f"f{int(j)}",
+                "term": "" if j % 2 == 0 else f"t{int(j)}",
+                "value": float(rng.normal()),
+            }
+            for j in rng.integers(0, 50, size=rng.integers(0, 8))
+        ]
+        rec = {
+            "uid": f"u{i}",
+            "label": float(rng.integers(0, 2)),
+            "features": feats,
+            "weight": float(rng.uniform(0.5, 2.0)),
+            "offset": float(rng.normal()),
+            "metadataMap": {"queryId": f"q{i % 7}", "other": "x"},
+        }
+        if i % 11 == 0:
+            rec["offset"] = None  # optional field exercised
+            rec["metadataMap"] = None
+        recs.append(rec)
+    write_container(path, _training_schema(), recs, codec=codec)
+    return recs
+
+
+@pytest.mark.parametrize("codec", ["null", "deflate"])
+def test_matches_python_codec(tmp_path, rng, codec):
+    path = str(tmp_path / "train.avro")
+    recs = _write_fixture(path, rng, codec=codec)
+
+    plan = native_avro.plan_for_file(
+        path,
+        numeric_fields=["label", "offset", "weight"],
+        string_fields=["uid"],
+        bag_fields=["features"],
+        map_field="metadataMap",
+        map_keys=["queryId", "missingKey"],
+    )
+    cols = native_avro.decode_columns(path, plan)
+    assert cols.num_records == len(recs)
+
+    # scalars
+    np.testing.assert_array_equal(
+        cols.f64("label"), np.asarray([r["label"] for r in recs])
+    )
+    np.testing.assert_array_equal(
+        cols.f64("weight"), np.asarray([r["weight"] for r in recs])
+    )
+    offs = cols.f64("offset")
+    for i, r in enumerate(recs):
+        if r["offset"] is None:
+            assert np.isnan(offs[i])
+        else:
+            assert offs[i] == r["offset"]
+
+    # strings
+    uid_ids = cols.str_ids("uid")
+    assert [cols.strings[j] for j in uid_ids] == [r["uid"] for r in recs]
+
+    # metadataMap
+    qids = cols.map_ids("queryId")
+    missing = cols.map_ids("missingKey")
+    assert np.all(missing == -1)
+    for i, r in enumerate(recs):
+        if r["metadataMap"] is None:
+            assert qids[i] == -1
+        else:
+            assert cols.strings[qids[i]] == r["metadataMap"]["queryId"]
+
+    # feature bag: row_ptr + (name TAB term) keys + values
+    row_ptr, key_ids, values = cols.bag("features")
+    assert row_ptr[0] == 0 and row_ptr[-1] == len(key_ids)
+    for i, r in enumerate(recs):
+        lo, hi = int(row_ptr[i]), int(row_ptr[i + 1])
+        got = [
+            (cols.strings[key_ids[j]], values[j]) for j in range(lo, hi)
+        ]
+        want = [
+            (f["name"] + "\t" + f["term"], f["value"]) for f in r["features"]
+        ]
+        assert got == want
+
+    # cross-check the file itself still reads through the Python codec
+    _, it = read_container(path)
+    assert sum(1 for _ in it) == len(recs)
+
+
+def test_unsupported_shape_raises_plan_error(tmp_path, rng):
+    schema = {
+        "name": "Odd", "type": "record",
+        "fields": [{"name": "blob", "type": {"type": "fixed", "name": "F", "size": 4}}],
+    }
+    path = str(tmp_path / "odd.avro")
+    write_container(path, schema, [{"blob": b"abcd"}], codec="null")
+    with pytest.raises(native_avro.PlanError):
+        native_avro.plan_for_file(path, numeric_fields=[])
+
+
+def test_throughput_exceeds_python_codec(tmp_path, rng):
+    """Not a benchmark — just a sanity floor: the native path should beat
+    the record-at-a-time Python codec comfortably on a mid-size file."""
+    import time
+
+    path = str(tmp_path / "big.avro")
+    _write_fixture(path, rng, n=20_000)
+
+    t0 = time.perf_counter()
+    plan = native_avro.plan_for_file(
+        path, numeric_fields=["label"], bag_fields=["features"]
+    )
+    cols = native_avro.decode_columns(path, plan)
+    native_s = time.perf_counter() - t0
+    assert cols.num_records == 20_000
+
+    t0 = time.perf_counter()
+    _, it = read_container(path)
+    n = sum(1 for _ in it)
+    python_s = time.perf_counter() - t0
+    assert n == 20_000
+    assert native_s < python_s, (native_s, python_s)
+
+
+def test_input_format_parity_with_python_path(tmp_path, rng, monkeypatch):
+    """AvroInputDataFormat must produce the IDENTICAL batch through the
+    native fast path and the record-at-a-time Python fallback."""
+    from photon_ml_tpu.io.input_format import AvroInputDataFormat
+
+    path = str(tmp_path / "t.avro")
+    _write_fixture(path, rng, n=300)
+
+    fmt = AvroInputDataFormat(add_intercept=True)
+    fast = fmt.load([path])
+
+    monkeypatch.setattr(native_avro, "available", lambda: False)
+    slow = AvroInputDataFormat(add_intercept=True).load([path])
+
+    assert fast.index_map._fwd == slow.index_map._fwd
+    for field in ("indices", "values", "labels", "offsets", "weights"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fast.batch, field)),
+            np.asarray(getattr(slow.batch, field)),
+        )
+
+    # selected-features filter parity
+    some = sorted(fast.index_map._fwd)[:10]
+    f2 = AvroInputDataFormat(add_intercept=True, selected_features=some)
+    fast2 = f2.load([path])
+    monkeypatch.undo()
+    assert native_avro.available()
+    fast2b = AvroInputDataFormat(
+        add_intercept=True, selected_features=some
+    ).load([path])
+    np.testing.assert_array_equal(
+        np.asarray(fast2.batch.values), np.asarray(fast2b.batch.values)
+    )
