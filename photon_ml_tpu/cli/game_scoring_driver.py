@@ -19,11 +19,11 @@ import numpy as np
 import jax.numpy as jnp
 
 from photon_ml_tpu.evaluation import Evaluator, EvaluatorType
-from photon_ml_tpu.game.data import build_game_dataset
+from photon_ml_tpu.game.data import build_game_dataset_from_files
 from photon_ml_tpu.game.config import FeatureShardConfiguration
 from photon_ml_tpu.game.model_io import load_game_model
 from photon_ml_tpu.io import schemas
-from photon_ml_tpu.io.avro_codec import read_avro_records, write_container
+from photon_ml_tpu.io.avro_codec import write_container
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.task import TaskType
 from photon_ml_tpu.utils.logging_util import PhotonLogger, Timer
@@ -75,8 +75,8 @@ class GameScoringDriver:
                 id_types.add(et.id_type)
 
         with self.timer.time("load-data"):
-            dataset = build_game_dataset(
-                read_avro_records(p.input_dirs),
+            dataset = build_game_dataset_from_files(
+                p.input_dirs,
                 p.feature_shards,
                 sorted(id_types),
                 is_response_required=p.has_response,

@@ -35,12 +35,15 @@ from photon_ml_tpu.game.coordinate import (
     RandomEffectCoordinate,
 )
 from photon_ml_tpu.game.coordinate_descent import CoordinateDescent
-from photon_ml_tpu.game.data import GameDataset, build_game_dataset
+from photon_ml_tpu.game.data import (
+    GameDataset,
+    build_game_dataset,
+    build_game_dataset_from_files,
+)
 from photon_ml_tpu.game.model import GameModel
 from photon_ml_tpu.game.model_io import save_game_model
 from photon_ml_tpu.game.random_effect import RandomEffectOptimizationProblem
 from photon_ml_tpu.game.random_effect_data import build_random_effect_dataset
-from photon_ml_tpu.io.avro_codec import read_avro_records
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.optim.config import GLMOptimizationConfiguration
 from photon_ml_tpu.optim.problem import create_glm_problem
@@ -232,7 +235,6 @@ class GameTrainingDriver:
         return paths
 
     def _load_dataset(self, dirs: Sequence[str], index_maps=None) -> GameDataset:
-        records = read_avro_records(list(dirs))
         re_types = [
             c.random_effect_type
             for c in self.params.random_effect_data_configs.values()
@@ -241,8 +243,9 @@ class GameTrainingDriver:
         for et in self.params.evaluator_types:
             if et.id_type and et.id_type not in re_types:
                 re_types.append(et.id_type)
-        return build_game_dataset(
-            records,
+        # native column decode when available; Python codec fallback inside
+        return build_game_dataset_from_files(
+            list(dirs),
             self.params.feature_shards,
             re_types,
             index_maps=index_maps,

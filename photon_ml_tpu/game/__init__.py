@@ -25,6 +25,7 @@ from photon_ml_tpu.game.data import (
     EntityIndex,
     GameDataset,
     build_game_dataset,
+    build_game_dataset_from_files,
 )
 from photon_ml_tpu.game.model import (
     DatumScoringModel,
@@ -61,6 +62,7 @@ __all__ = [
     "EntityIndex",
     "GameDataset",
     "build_game_dataset",
+    "build_game_dataset_from_files",
     "DatumScoringModel",
     "FixedEffectModel",
     "GameModel",

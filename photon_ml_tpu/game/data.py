@@ -161,8 +161,12 @@ def build_game_dataset(
     uids: List[str] = []
     for i, r in enumerate(records):
         labels[i] = response_of(r)
-        offsets[i] = float(r.get("offset") or 0.0)
-        weights[i] = float(r.get("weight") or 1.0)
+        off_v = r.get("offset")
+        wgt_v = r.get("weight")
+        offsets[i] = 0.0 if off_v is None else float(off_v)
+        # None -> 1.0 but an EXPLICIT 0.0 weight stays 0 (the old `or`
+        # coerced falsy zero, diverging from the native column path)
+        weights[i] = 1.0 if wgt_v is None else float(wgt_v)
         uids.append(str(r.get("uid") or i))
 
     shards: Dict[str, ShardData] = {}
@@ -202,6 +206,233 @@ def build_game_dataset(
     entity_codes: Dict[str, np.ndarray] = {}
     for id_type in random_effect_types:
         raw = [id_of(r, id_type) for r in records]
+        eidx = EntityIndex.build(id_type, raw)
+        codes = np.full((n_pad,), -1, np.int32)
+        for i, v in enumerate(raw):
+            codes[i] = eidx.code_of[v]
+        entity_indexes[id_type] = eidx
+        entity_codes[id_type] = codes
+
+    return GameDataset(
+        uids=uids,
+        labels=labels,
+        offsets=offsets,
+        weights=weights,
+        shards=shards,
+        entity_codes=entity_codes,
+        entity_indexes=entity_indexes,
+        num_real_rows=n,
+    )
+
+
+def build_game_dataset_from_files(
+    paths,
+    shard_configs: Sequence[FeatureShardConfiguration],
+    random_effect_types: Sequence[str] = (),
+    *,
+    index_maps: Optional[Mapping[str, IndexMap]] = None,
+    is_response_required: bool = True,
+    pad_rows_to: int = 8,
+    pad_nnz_to: int = 8,
+) -> GameDataset:
+    """Avro files -> GameDataset through the native column decoder, with a
+    transparent fallback to the record-at-a-time Python path
+    (:func:`build_game_dataset` over ``read_avro_records``).
+
+    The native path materializes every shard's feature bags, the
+    response/offset/weight scalars, the uid, and entity ids (top-level
+    string fields or metadataMap entries) as columns in one C++ pass per
+    file — the JVM-executor decode of DataProcessingUtils.scala:57-143
+    without Spark.
+    """
+    from photon_ml_tpu.io.avro_codec import (
+        read_avro_records,
+        read_container_schema,
+    )
+    from photon_ml_tpu.io.paths import expand_input_paths
+
+    def fallback():
+        return build_game_dataset(
+            read_avro_records(paths),
+            shard_configs,
+            random_effect_types,
+            index_maps=index_maps,
+            is_response_required=is_response_required,
+            pad_rows_to=pad_rows_to,
+            pad_nnz_to=pad_nnz_to,
+        )
+
+    try:
+        from photon_ml_tpu.io import native_avro
+    except Exception:
+        return fallback()
+    if not native_avro.available():
+        return fallback()
+    files = list(expand_input_paths(paths, lambda fn: fn.endswith(".avro")))
+    if not files:
+        return fallback()
+
+    all_bags = sorted({b for cfg in shard_configs for b in cfg.feature_bags})
+    decoded = []
+    try:
+        for p in files:
+            schema = read_container_schema(p)
+            fields = {f["name"]: f["type"] for f in schema.get("fields", [])}
+            if not all(b in fields for b in all_bags):
+                return fallback()
+            # BOTH response and label are captured when present: the
+            # Python builder falls back per RECORD (response-then-label),
+            # not per file
+            response_fields = [
+                f for f in ("response", "label") if f in fields
+            ]
+            if not response_fields and is_response_required:
+                return fallback()
+            numeric = [
+                f
+                for f in response_fields + ["offset", "weight"]
+                if f in fields
+            ]
+            top_ids = [t for t in random_effect_types if t in fields]
+            map_ids = [t for t in random_effect_types if t not in fields]
+            strings = (["uid"] if "uid" in fields else []) + top_ids
+            if map_ids and "metadataMap" not in fields:
+                return fallback()  # the Python path raises the same way
+            plan = native_avro.Plan(schema).compile(
+                numeric_fields=numeric,
+                string_fields=strings,
+                bag_fields=all_bags,
+                map_field="metadataMap" if map_ids else None,
+                map_keys=map_ids,
+            )
+            cols = native_avro.decode_columns(p, plan)
+            decoded.append((cols, response_fields, set(strings)))
+    except (native_avro.PlanError, ValueError, OSError):
+        # ValueError covers decode-time native rejections; semantic errors
+        # (missing ids, null labels) are re-detected identically by the
+        # fallback, which raises the canonical message
+        return fallback()
+
+    n = sum(cols.num_records for cols, _, _ in decoded)
+    if n == 0:
+        raise ValueError("empty GAME dataset")
+    n_pad = max(_round_up(n, pad_rows_to), pad_rows_to)
+    labels = np.zeros((n_pad,), np.float32)
+    offsets = np.zeros((n_pad,), np.float32)
+    weights = np.zeros((n_pad,), np.float32)
+    uids: List[str] = []
+    raw_entity: Dict[str, List[str]] = {t: [] for t in random_effect_types}
+
+    # scalars + ids, file by file
+    row0 = 0
+    for cols, response_fields, strings in decoded:
+        m = cols.num_records
+        lab = np.full(m, np.nan)
+        for f in response_fields:  # response first, then label, per record
+            cand = cols.f64(f)
+            lab = np.where(np.isnan(lab), cand, lab)
+        bad = np.isnan(lab)
+        if bad.any():
+            if is_response_required:
+                raise ValueError("record missing response/label field")
+            lab = np.where(bad, 0.0, lab)
+        off = (
+            cols.f64("offset")
+            if "offset" in cols.plan.num_slots
+            else np.zeros(m)
+        )
+        wgt = (
+            cols.f64("weight")
+            if "weight" in cols.plan.num_slots
+            else np.ones(m)
+        )
+        labels[row0:row0 + m] = lab
+        offsets[row0:row0 + m] = np.where(np.isnan(off), 0.0, off)
+        weights[row0:row0 + m] = np.where(np.isnan(wgt), 1.0, wgt)
+
+        if "uid" in strings:
+            for i, sid in enumerate(cols.str_ids("uid")):
+                # empty string counts as missing, matching the Python
+                # builder's `r.get("uid") or i`
+                s = cols.strings[sid] if sid >= 0 else ""
+                uids.append(s if s else str(row0 + i))
+        else:
+            uids.extend(str(row0 + i) for i in range(m))
+
+        for t in random_effect_types:
+            ids = (
+                cols.str_ids(t) if t in strings else cols.map_ids(t)
+            )
+            missing = ids < 0
+            if missing.any():
+                raise ValueError(f"record missing id {t!r}")
+            raw_entity[t].extend(cols.strings[j] for j in ids)
+        row0 += m
+
+    # shards: merge each config's bags row-wise; vectorized key remap
+    imaps: Dict[str, IndexMap] = {}
+    for cfg in shard_configs:
+        if index_maps is not None and cfg.shard_id in index_maps:
+            imaps[cfg.shard_id] = index_maps[cfg.shard_id]
+        else:
+            keys = (
+                cols.strings[j]
+                for cols, _, _ in decoded
+                for bag in cfg.feature_bags
+                for j in cols.bag(bag)[1]
+            )
+            imaps[cfg.shard_id] = IndexMap.build(
+                keys, add_intercept=cfg.add_intercept
+            )
+
+    shards: Dict[str, ShardData] = {}
+    for cfg in shard_configs:
+        imap = imaps[cfg.shard_id]
+        icept = imap.get_index(intercept_key()) if cfg.add_intercept else -1
+        rows: List[Tuple[List[int], List[float]]] = []
+        k_max = 1
+        for cols, _, _ in decoded:
+            table = np.asarray(
+                [imap.get_index(s) for s in cols.strings], dtype=np.int64
+            )
+            per_bag = []
+            for bag in cfg.feature_bags:
+                row_ptr, key_ids, values = cols.bag(bag)
+                gix = (
+                    table[key_ids] if len(key_ids) else np.zeros(0, np.int64)
+                )
+                per_bag.append((row_ptr, gix, values))
+            for i in range(cols.num_records):
+                ix: List[int] = []
+                vs: List[float] = []
+                for row_ptr, gix, values in per_bag:
+                    lo, hi = int(row_ptr[i]), int(row_ptr[i + 1])
+                    g = gix[lo:hi]
+                    keep = g >= 0
+                    ix.extend(g[keep].tolist())
+                    vs.extend(values[lo:hi][keep].tolist())
+                if icept >= 0:
+                    ix.append(icept)
+                    vs.append(1.0)
+                rows.append((ix, vs))
+                k_max = max(k_max, len(ix))
+        k = max(_round_up(k_max, pad_nnz_to), pad_nnz_to)
+        indices = np.zeros((n_pad, k), np.int32)
+        values_arr = np.zeros((n_pad, k), np.float32)
+        for i, (ix, vs) in enumerate(rows):
+            indices[i, : len(ix)] = ix
+            values_arr[i, : len(vs)] = vs
+        shards[cfg.shard_id] = ShardData(
+            indices=indices,
+            values=values_arr,
+            index_map=imap,
+            intercept_index=icept if icept >= 0 else None,
+        )
+
+    entity_indexes: Dict[str, EntityIndex] = {}
+    entity_codes: Dict[str, np.ndarray] = {}
+    for id_type in random_effect_types:
+        raw = raw_entity[id_type]
         eidx = EntityIndex.build(id_type, raw)
         codes = np.full((n_pad,), -1, np.int32)
         for i, v in enumerate(raw):

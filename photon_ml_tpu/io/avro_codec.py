@@ -432,6 +432,27 @@ def read_container(path: str) -> Tuple[SchemaType, Iterator[dict]]:
     return schema, it()
 
 
+def read_container_schema(path: str) -> SchemaType:
+    """Parse ONLY the header schema without slurping the whole file —
+    reads a growing prefix until the metadata map decodes cleanly."""
+    size = 1 << 16
+    while True:
+        with open(path, "rb") as f:
+            data = f.read(size)
+        if data[:4] != MAGIC:
+            raise ValueError(f"{path}: not an Avro container file")
+        try:
+            dec = BinaryDecoder(data, 4)
+            meta = read_datum(dec, {"type": "map", "values": "bytes"})
+            if dec.pos > len(data):
+                raise IndexError("truncated header")
+            return parse_schema(meta["avro.schema"].decode("utf-8"))
+        except (IndexError, KeyError, UnicodeDecodeError, ValueError) as e:
+            if len(data) < size:  # whole file read and still bad
+                raise ValueError(f"{path}: bad container header") from e
+            size *= 4
+
+
 def read_avro_records(paths: Union[str, List[str]]) -> Iterator[dict]:
     """Iterate records across one or many container files / directories
     (AvroUtils.readAvroFiles analog; directories expand to their *.avro,

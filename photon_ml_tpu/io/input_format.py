@@ -148,7 +148,7 @@ class AvroInputDataFormat:
         """Try the native column decoder; None -> caller falls back to the
         Python codec. Returns one DecodedColumns per file."""
         from photon_ml_tpu.io import native_avro
-        from photon_ml_tpu.io.avro_codec import read_container
+        from photon_ml_tpu.io.avro_codec import read_container_schema
         from photon_ml_tpu.io.paths import expand_input_paths
 
         if not native_avro.available():
@@ -161,7 +161,7 @@ class AvroInputDataFormat:
         out = []
         try:
             for p in files:
-                schema, _ = read_container(p)
+                schema = read_container_schema(p)
                 names = {f["name"] for f in schema.get("fields", [])}
                 if "features" not in names or "label" not in names:
                     return None

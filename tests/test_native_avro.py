@@ -177,3 +177,51 @@ def test_input_format_parity_with_python_path(tmp_path, rng, monkeypatch):
     np.testing.assert_array_equal(
         np.asarray(fast2.batch.values), np.asarray(fast2b.batch.values)
     )
+
+
+def test_game_dataset_parity(tmp_path, rng):
+    """build_game_dataset_from_files (native columns) must equal the
+    record-at-a-time Python builder on the same files."""
+    import os, sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_game_drivers import write_game_avro
+    from photon_ml_tpu.game.config import FeatureShardConfiguration
+    from photon_ml_tpu.game.data import (
+        build_game_dataset,
+        build_game_dataset_from_files,
+    )
+    from photon_ml_tpu.io.avro_codec import read_avro_records
+
+    d = tmp_path / "game"
+    d.mkdir()
+    write_game_avro(str(d / "p0.avro"), rng, n=150)
+    write_game_avro(str(d / "p1.avro"), rng, n=90, seed_shift=1)
+
+    shards = [
+        FeatureShardConfiguration("g", ["features"], add_intercept=True),
+        FeatureShardConfiguration("u", ["userFeatures"], add_intercept=True),
+    ]
+    fast = build_game_dataset_from_files([str(d)], shards, ["userId"])
+    slow = build_game_dataset(
+        read_avro_records([str(d)]), shards, ["userId"]
+    )
+    assert fast.num_real_rows == slow.num_real_rows == 240
+    assert fast.uids == slow.uids
+    np.testing.assert_array_equal(fast.labels, slow.labels)
+    np.testing.assert_array_equal(fast.offsets, slow.offsets)
+    np.testing.assert_array_equal(fast.weights, slow.weights)
+    for sid in ("g", "u"):
+        np.testing.assert_array_equal(
+            fast.shards[sid].indices, slow.shards[sid].indices
+        )
+        np.testing.assert_array_equal(
+            fast.shards[sid].values, slow.shards[sid].values
+        )
+        assert (
+            fast.shards[sid].index_map._fwd == slow.shards[sid].index_map._fwd
+        )
+    np.testing.assert_array_equal(
+        fast.entity_codes["userId"], slow.entity_codes["userId"]
+    )
+    assert fast.entity_indexes["userId"].ids == slow.entity_indexes["userId"].ids
