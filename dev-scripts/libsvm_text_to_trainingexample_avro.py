@@ -1,0 +1,82 @@
+#!/usr/bin/env python3
+"""Convert a LibSVM text file into TrainingExampleAvro container files.
+
+Parity analog of the reference's dataset-conversion helper
+(photon-ml dev-scripts/libsvm_text_to_trainingexample_avro.py, used by the
+README a1a tutorial at README.md:226-229): each feature's LibSVM index
+token becomes the feature ``name`` verbatim (no re-indexing), the ``term``
+is empty, and classification labels are mapped to {0, 1} (any label <= 0
+becomes 0). With ``--regression`` the label is kept as-is.
+
+Unlike the reference there is no output-schema-path argument: the
+TrainingExampleAvro schema ships with the framework
+(photon_ml_tpu.io.schemas) and is embedded in the container header, so the
+output is readable by the reference's Avro input path and by
+``photon_ml_tpu.cli.glm_driver --format TRAINING_EXAMPLE``.
+
+Usage:
+    python libsvm_text_to_trainingexample_avro.py INPUT OUTPUT [--regression]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from photon_ml_tpu.io.avro_codec import write_container  # noqa: E402
+from photon_ml_tpu.io.schemas import TRAINING_EXAMPLE_AVRO  # noqa: E402
+
+
+def libsvm_to_training_example_records(lines, *, regression: bool = False):
+    """Iterate TrainingExampleAvro dicts over LibSVM text lines."""
+    for line in lines:
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        tokens = line.split()
+        if regression:
+            label = float(tokens[0])
+        else:
+            label = 0.0 if float(tokens[0]) <= 0 else 1.0
+        features = []
+        for token in tokens[1:]:
+            name, _, value = token.partition(":")
+            features.append({"name": name, "term": "", "value": float(value)})
+        yield {
+            "uid": None,
+            "label": label,
+            "features": features,
+            "metadataMap": None,
+            "weight": None,
+            "offset": None,
+        }
+
+
+def convert(input_path: str, output_path: str, *, regression: bool = False) -> int:
+    """-> number of converted examples."""
+    with open(input_path, "r", encoding="utf-8") as f:
+        return write_container(
+            output_path,
+            TRAINING_EXAMPLE_AVRO,
+            libsvm_to_training_example_records(f, regression=regression),
+        )
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("input_path", help="LibSVM text input file")
+    parser.add_argument("output_path", help="Avro container output file")
+    parser.add_argument(
+        "-r", "--regression", action="store_true",
+        help="keep labels as-is instead of mapping to {0,1}",
+    )
+    args = parser.parse_args(argv)
+    count = convert(args.input_path, args.output_path, regression=args.regression)
+    print(f"converted {count} examples")
+
+
+if __name__ == "__main__":
+    main()

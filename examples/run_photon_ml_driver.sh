@@ -1,0 +1,77 @@
+#!/bin/bash
+# Demonstrates a full GLM driver invocation from the command line, the
+# analog of the reference's examples/run_photon_ml_driver.sh (which wraps
+# spark-submit; here the "cluster" is the attached TPU and the working
+# root is a local/posix path instead of HDFS).
+#
+# Assumed working-root layout (same as the reference script):
+#   train dataset input:  <working_root>/input/train     (Avro or LibSVM)
+#   test dataset input:   <working_root>/input/test
+# Outputs:
+#   models + metrics:     <working_root>/results
+#   feature summary:      <working_root>/summary
+#
+# Example end-to-end with the a1a tutorial dataset:
+#   python dev-scripts/libsvm_text_to_trainingexample_avro.py a1a.txt \
+#       work/input/train/a1a.avro
+#   python dev-scripts/libsvm_text_to_trainingexample_avro.py a1a.t.txt \
+#       work/input/test/a1a.avro
+#   examples/run_photon_ml_driver.sh work
+
+set -euo pipefail
+
+JOB_NAME="run-photon-ml-driver"
+TASK="LOGISTIC_REGRESSION"
+REG_WEIGHTS="0.1,1,10,100"
+MAX_ITER=50
+
+usage() {
+  echo "Usage: $0 [options...] working_root" >&2
+  echo >&2
+  echo "Options:" >&2
+  echo "  -h, --help          Show this message" >&2
+  echo "  -n, --job-name S    Job name (default: $JOB_NAME)" >&2
+  echo "  -t, --task S        Task type (default: $TASK)" >&2
+  echo "  -l, --lambdas S     Comma-separated reg weights (default: $REG_WEIGHTS)" >&2
+  echo "  -i, --max-iter N    Max optimizer iterations (default: $MAX_ITER)" >&2
+  exit "${1:-2}"
+}
+
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    -h|--help) usage 0 ;;
+    -n|--job-name) JOB_NAME="$2"; shift 2 ;;
+    -t|--task) TASK="$2"; shift 2 ;;
+    -l|--lambdas) REG_WEIGHTS="$2"; shift 2 ;;
+    -i|--max-iter) MAX_ITER="$2"; shift 2 ;;
+    -*) echo "unknown option: $1" >&2; usage ;;
+    *) break ;;
+  esac
+done
+[[ $# -eq 1 ]] || usage
+# absolute path: the script cds to the repo root below, which would
+# silently re-anchor a relative working root
+ROOT="$(cd "$1" 2>/dev/null && pwd)" || {
+  echo "missing working root: $1" >&2; exit 1; }
+
+[[ -d "$ROOT/input/train" ]] || {
+  echo "missing train input dir: $ROOT/input/train" >&2; exit 1; }
+
+VALIDATE_ARGS=()
+if [[ -d "$ROOT/input/test" ]]; then
+  VALIDATE_ARGS=(--validating-data-directory "$ROOT/input/test")
+fi
+
+cd "$(dirname "$0")/.."
+
+exec python -m photon_ml_tpu.cli.glm_driver \
+  --job-name "$JOB_NAME" \
+  --training-data-directory "$ROOT/input/train" \
+  "${VALIDATE_ARGS[@]}" \
+  --output-directory "$ROOT/results" \
+  --task "$TASK" \
+  --regularization-type L2 \
+  --regularization-weights "$REG_WEIGHTS" \
+  --num-iterations "$MAX_ITER" \
+  --summarization-output-dir "$ROOT/summary" \
+  --delete-output-dirs-if-exist true

@@ -97,6 +97,49 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _pad_shard_rows(
+    rows: Sequence[Tuple[List[int], List[float]]],
+    n_pad: int,
+    pad_nnz_to: int,
+    imap: IndexMap,
+    icept: int,
+) -> ShardData:
+    """Ragged (indices, values) rows -> padded ShardData (shared by the
+    record-at-a-time and native-columns builders)."""
+    k_max = max([1] + [len(ix) for ix, _ in rows])
+    k = max(_round_up(k_max, pad_nnz_to), pad_nnz_to)
+    indices = np.zeros((n_pad, k), np.int32)
+    values = np.zeros((n_pad, k), np.float32)
+    for i, (ix, vs) in enumerate(rows):
+        indices[i, : len(ix)] = ix
+        values[i, : len(vs)] = vs
+    return ShardData(
+        indices=indices,
+        values=values,
+        index_map=imap,
+        intercept_index=icept if icept >= 0 else None,
+    )
+
+
+def _build_entity_tables(
+    random_effect_types: Sequence[str],
+    raw_entity: Mapping[str, List[str]],
+    n_pad: int,
+) -> Tuple[Dict[str, EntityIndex], Dict[str, np.ndarray]]:
+    """Raw per-row entity ids -> (EntityIndex, dense code array) per type."""
+    entity_indexes: Dict[str, EntityIndex] = {}
+    entity_codes: Dict[str, np.ndarray] = {}
+    for id_type in random_effect_types:
+        raw = raw_entity[id_type]
+        eidx = EntityIndex.build(id_type, raw)
+        codes = np.full((n_pad,), -1, np.int32)
+        for i, v in enumerate(raw):
+            codes[i] = eidx.code_of[v]
+        entity_indexes[id_type] = eidx
+        entity_codes[id_type] = codes
+    return entity_indexes, entity_codes
+
+
 def build_game_dataset(
     records: Iterable[dict],
     shard_configs: Sequence[FeatureShardConfiguration],
@@ -174,7 +217,6 @@ def build_game_dataset(
         imap = imaps[cfg.shard_id]
         icept = imap.get_index(intercept_key()) if cfg.add_intercept else -1
         rows: List[Tuple[List[int], List[float]]] = []
-        k_max = 1
         for r in records:
             ix: List[int] = []
             vs: List[float] = []
@@ -188,30 +230,15 @@ def build_game_dataset(
                 ix.append(icept)
                 vs.append(1.0)
             rows.append((ix, vs))
-            k_max = max(k_max, len(ix))
-        k = max(_round_up(k_max, pad_nnz_to), pad_nnz_to)
-        indices = np.zeros((n_pad, k), np.int32)
-        values = np.zeros((n_pad, k), np.float32)
-        for i, (ix, vs) in enumerate(rows):
-            indices[i, : len(ix)] = ix
-            values[i, : len(vs)] = vs
-        shards[cfg.shard_id] = ShardData(
-            indices=indices,
-            values=values,
-            index_map=imap,
-            intercept_index=icept if icept >= 0 else None,
+        shards[cfg.shard_id] = _pad_shard_rows(
+            rows, n_pad, pad_nnz_to, imap, icept
         )
 
-    entity_indexes: Dict[str, EntityIndex] = {}
-    entity_codes: Dict[str, np.ndarray] = {}
-    for id_type in random_effect_types:
-        raw = [id_of(r, id_type) for r in records]
-        eidx = EntityIndex.build(id_type, raw)
-        codes = np.full((n_pad,), -1, np.int32)
-        for i, v in enumerate(raw):
-            codes[i] = eidx.code_of[v]
-        entity_indexes[id_type] = eidx
-        entity_codes[id_type] = codes
+    entity_indexes, entity_codes = _build_entity_tables(
+        random_effect_types,
+        {t: [id_of(r, t) for r in records] for t in random_effect_types},
+        n_pad,
+    )
 
     return GameDataset(
         uids=uids,
@@ -294,26 +321,45 @@ def build_game_dataset_from_files(
                 if f in fields
             ]
             top_ids = [t for t in random_effect_types if t in fields]
-            map_ids = [t for t in random_effect_types if t not in fields]
+            map_only_ids = [t for t in random_effect_types if t not in fields]
             strings = (["uid"] if "uid" in fields else []) + top_ids
-            if map_ids and "metadataMap" not in fields:
+            if map_only_ids and "metadataMap" not in fields:
                 return fallback()  # the Python path raises the same way
+            # A NULLABLE top-level id field may be null per record with the
+            # value in metadataMap — capture both and merge per record,
+            # matching the Python builder's id_of fallback. Non-nullable id
+            # fields skip the map capture so datasets whose metadataMap the
+            # plan can't decode (non-string values) stay on the fast path.
+            has_map = "metadataMap" in fields
+
+            def _nullable(ftype):
+                return isinstance(ftype, list) and any(
+                    t == "null"
+                    or (isinstance(t, dict) and t.get("type") == "null")
+                    for t in ftype
+                )
+
+            map_keys = map_only_ids + (
+                [t for t in top_ids if _nullable(fields[t])]
+                if has_map
+                else []
+            )
             plan = native_avro.Plan(schema).compile(
                 numeric_fields=numeric,
                 string_fields=strings,
                 bag_fields=all_bags,
-                map_field="metadataMap" if map_ids else None,
-                map_keys=map_ids,
+                map_field="metadataMap" if map_keys else None,
+                map_keys=map_keys,
             )
             cols = native_avro.decode_columns(p, plan)
-            decoded.append((cols, response_fields, set(strings)))
+            decoded.append((cols, response_fields, set(strings), set(map_keys)))
     except (native_avro.PlanError, ValueError, OSError):
         # ValueError covers decode-time native rejections; semantic errors
         # (missing ids, null labels) are re-detected identically by the
         # fallback, which raises the canonical message
         return fallback()
 
-    n = sum(cols.num_records for cols, _, _ in decoded)
+    n = sum(cols.num_records for cols, _, _, _ in decoded)
     if n == 0:
         raise ValueError("empty GAME dataset")
     n_pad = max(_round_up(n, pad_rows_to), pad_rows_to)
@@ -325,7 +371,7 @@ def build_game_dataset_from_files(
 
     # scalars + ids, file by file
     row0 = 0
-    for cols, response_fields, strings in decoded:
+    for cols, response_fields, strings, map_keys in decoded:
         m = cols.num_records
         lab = np.full(m, np.nan)
         for f in response_fields:  # response first, then label, per record
@@ -360,14 +406,27 @@ def build_game_dataset_from_files(
             uids.extend(str(row0 + i) for i in range(m))
 
         for t in random_effect_types:
-            ids = (
-                cols.str_ids(t) if t in strings else cols.map_ids(t)
-            )
+            if t in strings:
+                ids = cols.str_ids(t)
+                if t in map_keys:
+                    # null top-level value -> per-record metadataMap
+                    # fallback (build_game_dataset's id_of)
+                    ids = np.where(ids < 0, cols.map_ids(t), ids)
+            else:
+                ids = cols.map_ids(t)
             missing = ids < 0
             if missing.any():
                 raise ValueError(f"record missing id {t!r}")
             raw_entity[t].extend(cols.strings[j] for j in ids)
         row0 += m
+
+    # Decode every bag ONCE per file (cols.bag copies the nnz-sized
+    # arrays out of the native buffers on each call) and reuse the tuples
+    # for both the index-map key scan and the row assembly below.
+    bag_cache: List[Dict[str, tuple]] = [
+        {bag: cols.bag(bag) for bag in all_bags}
+        for cols, _, _, _ in decoded
+    ]
 
     # shards: merge each config's bags row-wise; vectorized key remap
     imaps: Dict[str, IndexMap] = {}
@@ -377,9 +436,9 @@ def build_game_dataset_from_files(
         else:
             keys = (
                 cols.strings[j]
-                for cols, _, _ in decoded
+                for (cols, _, _, _), bags in zip(decoded, bag_cache)
                 for bag in cfg.feature_bags
-                for j in cols.bag(bag)[1]
+                for j in bags[bag][1]
             )
             imaps[cfg.shard_id] = IndexMap.build(
                 keys, add_intercept=cfg.add_intercept
@@ -390,14 +449,22 @@ def build_game_dataset_from_files(
         imap = imaps[cfg.shard_id]
         icept = imap.get_index(intercept_key()) if cfg.add_intercept else -1
         rows: List[Tuple[List[int], List[float]]] = []
-        k_max = 1
-        for cols, _, _ in decoded:
-            table = np.asarray(
-                [imap.get_index(s) for s in cols.strings], dtype=np.int64
+        for (cols, _, _, _), bags in zip(decoded, bag_cache):
+            # remap table restricted to intern ids this config's bags
+            # actually reference (the full string table also holds uids
+            # and entity ids — potentially one per row)
+            cfg_keys = [bags[bag][1] for bag in cfg.feature_bags]
+            used = (
+                np.unique(np.concatenate(cfg_keys))
+                if any(len(k) for k in cfg_keys)
+                else np.zeros(0, np.int64)
             )
+            table = np.full(len(cols.strings), -1, dtype=np.int64)
+            for j in used:
+                table[j] = imap.get_index(cols.strings[j])
             per_bag = []
             for bag in cfg.feature_bags:
-                row_ptr, key_ids, values = cols.bag(bag)
+                row_ptr, key_ids, values = bags[bag]
                 gix = (
                     table[key_ids] if len(key_ids) else np.zeros(0, np.int64)
                 )
@@ -415,30 +482,13 @@ def build_game_dataset_from_files(
                     ix.append(icept)
                     vs.append(1.0)
                 rows.append((ix, vs))
-                k_max = max(k_max, len(ix))
-        k = max(_round_up(k_max, pad_nnz_to), pad_nnz_to)
-        indices = np.zeros((n_pad, k), np.int32)
-        values_arr = np.zeros((n_pad, k), np.float32)
-        for i, (ix, vs) in enumerate(rows):
-            indices[i, : len(ix)] = ix
-            values_arr[i, : len(vs)] = vs
-        shards[cfg.shard_id] = ShardData(
-            indices=indices,
-            values=values_arr,
-            index_map=imap,
-            intercept_index=icept if icept >= 0 else None,
+        shards[cfg.shard_id] = _pad_shard_rows(
+            rows, n_pad, pad_nnz_to, imap, icept
         )
 
-    entity_indexes: Dict[str, EntityIndex] = {}
-    entity_codes: Dict[str, np.ndarray] = {}
-    for id_type in random_effect_types:
-        raw = raw_entity[id_type]
-        eidx = EntityIndex.build(id_type, raw)
-        codes = np.full((n_pad,), -1, np.int32)
-        for i, v in enumerate(raw):
-            codes[i] = eidx.code_of[v]
-        entity_indexes[id_type] = eidx
-        entity_codes[id_type] = codes
+    entity_indexes, entity_codes = _build_entity_tables(
+        random_effect_types, raw_entity, n_pad
+    )
 
     return GameDataset(
         uids=uids,

@@ -1,0 +1,93 @@
+"""dev-scripts/libsvm_text_to_trainingexample_avro.py: LibSVM -> Avro
+conversion parity (reference dev-scripts converter used by the a1a
+tutorial, README.md:226-229)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_SCRIPT = os.path.join(
+    os.path.dirname(__file__), "..", "dev-scripts",
+    "libsvm_text_to_trainingexample_avro.py",
+)
+
+
+def _load_converter():
+    spec = importlib.util.spec_from_file_location("libsvm_to_avro", _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+LIBSVM_TEXT = """\
++1 3:1 11:1 14:0.5
+-1 1:2 5:1
+# a comment line
+
+0 7:1.5
+"""
+
+
+def test_convert_roundtrip(tmp_path):
+    mod = _load_converter()
+    src = tmp_path / "data.txt"
+    src.write_text(LIBSVM_TEXT)
+    out = tmp_path / "data.avro"
+    count = mod.convert(str(src), str(out))
+    assert count == 3
+
+    from photon_ml_tpu.io.avro_codec import read_avro_records
+
+    recs = list(read_avro_records(str(out)))
+    assert [r["label"] for r in recs] == [1.0, 0.0, 0.0]
+    # feature names are the literal LibSVM index tokens, terms empty
+    assert recs[0]["features"] == [
+        {"name": "3", "term": "", "value": 1.0},
+        {"name": "11", "term": "", "value": 1.0},
+        {"name": "14", "term": "", "value": 0.5},
+    ]
+    assert recs[1]["features"][0]["name"] == "1"
+
+
+def test_convert_regression_keeps_labels(tmp_path):
+    mod = _load_converter()
+    src = tmp_path / "data.txt"
+    src.write_text("2.5 1:1\n-3.25 2:1\n")
+    out = tmp_path / "data.avro"
+    assert mod.convert(str(src), str(out), regression=True) == 2
+
+    from photon_ml_tpu.io.avro_codec import read_avro_records
+
+    labels = [r["label"] for r in read_avro_records(str(out))]
+    assert labels == [2.5, -3.25]
+
+
+def test_converted_file_feeds_avro_input_format(tmp_path):
+    """The converter's output trains through the AVRO input path."""
+    mod = _load_converter()
+    src = tmp_path / "data.txt"
+    lines = []
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    for i in range(40):
+        label = 1 if rng.uniform() > 0.5 else -1
+        feats = " ".join(
+            f"{j + 1}:{rng.normal():.4f}" for j in range(5)
+        )
+        lines.append(f"{label} {feats}")
+    src.write_text("\n".join(lines) + "\n")
+    train_dir = tmp_path / "train"
+    train_dir.mkdir()
+    mod.convert(str(src), str(train_dir / "part.avro"))
+
+    from photon_ml_tpu.io.input_format import AvroInputDataFormat
+
+    fmt = AvroInputDataFormat()
+    loaded = fmt.load([str(train_dir)])
+    assert loaded.batch.labels.shape[0] == 40
+    assert set(np.asarray(loaded.batch.labels).tolist()) <= {0.0, 1.0}
+    # 5 features + intercept
+    assert loaded.num_features == 6

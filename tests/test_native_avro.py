@@ -225,3 +225,59 @@ def test_game_dataset_parity(tmp_path, rng):
         fast.entity_codes["userId"], slow.entity_codes["userId"]
     )
     assert fast.entity_indexes["userId"].ids == slow.entity_indexes["userId"].ids
+
+
+def test_game_dataset_null_top_level_id_falls_back_to_metadata_map(
+    tmp_path, rng
+):
+    """A nullable top-level entity-id field whose value is (sometimes)
+    null must resolve per record from metadataMap, exactly like the
+    Python builder's id_of fallback."""
+    from photon_ml_tpu.game.config import FeatureShardConfiguration
+    from photon_ml_tpu.game.data import (
+        build_game_dataset,
+        build_game_dataset_from_files,
+    )
+    from photon_ml_tpu.io.avro_codec import read_avro_records, write_container
+    from photon_ml_tpu.io import schemas
+
+    schema = {
+        "name": "GameExample2", "type": "record",
+        "fields": [
+            {"name": "response", "type": "double"},
+            {"name": "userId", "type": ["null", "string"], "default": None},
+            {
+                "name": "metadataMap",
+                "type": ["null", {"type": "map", "values": "string"}],
+                "default": None,
+            },
+            {
+                "name": "features",
+                "type": {"type": "array", "items": schemas.FEATURE_AVRO},
+            },
+        ],
+    }
+    recs = []
+    for i in range(60):
+        u = f"user{i % 5}"
+        # odd rows: id in the top-level field; even rows: null there,
+        # value only in metadataMap
+        recs.append({
+            "response": float(i % 2),
+            "userId": u if i % 2 else None,
+            "metadataMap": None if i % 2 else {"userId": u},
+            "features": [
+                {"name": "f0", "term": "", "value": float(rng.normal())}
+            ],
+        })
+    d = tmp_path / "game"
+    d.mkdir()
+    write_container(str(d / "p.avro"), schema, recs)
+
+    shards = [FeatureShardConfiguration("g", ["features"], add_intercept=True)]
+    fast = build_game_dataset_from_files([str(d)], shards, ["userId"])
+    slow = build_game_dataset(read_avro_records([str(d)]), shards, ["userId"])
+    np.testing.assert_array_equal(
+        fast.entity_codes["userId"], slow.entity_codes["userId"]
+    )
+    assert fast.entity_indexes["userId"].ids == slow.entity_indexes["userId"].ids
