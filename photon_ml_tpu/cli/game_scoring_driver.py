@@ -39,6 +39,10 @@ class GameScoringParams:
     evaluator_types: List[EvaluatorType] = field(default_factory=list)
     model_id: str = ""
     has_response: bool = True
+    # Prebuilt per-shard feature-index stores (prepareFeatureMaps analog,
+    # shared with the training driver; cli/game/GAMEDriver.scala:89-97).
+    offheap_indexmap_dir: Optional[str] = None
+    offheap_indexmap_num_partitions: Optional[int] = None
 
     def validate(self):
         if not self.input_dirs:
@@ -74,11 +78,21 @@ class GameScoringDriver:
             if et.id_type:
                 id_types.add(et.id_type)
 
+        index_maps = None
+        if p.offheap_indexmap_dir:
+            from photon_ml_tpu.utils.native_index import load_offheap_index_maps
+
+            index_maps = load_offheap_index_maps(
+                p.offheap_indexmap_dir,
+                [cfg.shard_id for cfg in p.feature_shards],
+                num_partitions=p.offheap_indexmap_num_partitions,
+            )
         with self.timer.time("load-data"):
             dataset = build_game_dataset_from_files(
                 p.input_dirs,
                 p.feature_shards,
                 sorted(id_types),
+                index_maps=index_maps,
                 is_response_required=p.has_response,
             )
         with self.timer.time("score"):
@@ -141,6 +155,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--evaluator-types", default=None)
     ap.add_argument("--model-id", default="")
     ap.add_argument("--has-response", default="true")
+    ap.add_argument("--offheap-indexmap-dir", default=None)
+    ap.add_argument("--offheap-indexmap-num-partitions", type=int, default=None)
     return ap
 
 
@@ -163,6 +179,8 @@ def params_from_args(argv=None) -> GameScoringParams:
         ),
         model_id=ns.model_id,
         has_response=str(ns.has_response).lower() in ("true", "1", "yes"),
+        offheap_indexmap_dir=ns.offheap_indexmap_dir,
+        offheap_indexmap_num_partitions=ns.offheap_indexmap_num_partitions,
     )
 
 

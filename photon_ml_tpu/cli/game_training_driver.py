@@ -144,6 +144,13 @@ class GameTrainingParams:
     num_iterations: int = 1
     evaluator_types: List[EvaluatorType] = field(default_factory=list)
     compute_variance: bool = False
+    # Prebuilt per-shard partitioned feature-index stores (the reference's
+    # offheap-indexmap-dir, prepareFeatureMaps at
+    # cli/game/GAMEDriver.scala:89-97): a directory with one store
+    # subdirectory per feature shard id, as written by the
+    # feature-indexing job with --shard-name.
+    offheap_indexmap_dir: Optional[str] = None
+    offheap_indexmap_num_partitions: Optional[int] = None
     delete_output_dir_if_exists: bool = False
     # "auto": fixed-effect solves run data-parallel under shard_map and
     # random-effect banks shard their entity axis whenever >1 device is
@@ -456,6 +463,24 @@ class GameTrainingDriver:
 
     # -- run ---------------------------------------------------------------
 
+    def _offheap_index_maps(self):
+        """{shard_id: PartitionedIndexMap} from --offheap-indexmap-dir
+        (prepareFeatureMaps analog); None when the option is unset. Every
+        configured feature shard must have its store subdirectory."""
+        p = self.params
+        if not p.offheap_indexmap_dir:
+            return None
+        from photon_ml_tpu.utils.native_index import load_offheap_index_maps
+
+        maps = load_offheap_index_maps(
+            p.offheap_indexmap_dir,
+            [cfg.shard_id for cfg in p.feature_shards],
+            num_partitions=p.offheap_indexmap_num_partitions,
+        )
+        for sid, m in maps.items():
+            self.logger.info("offheap index map %s: %d features", sid, m.size)
+        return maps
+
     def run(self) -> None:
         p = self.params
         with self.timer.time("load-train"):
@@ -463,7 +488,8 @@ class GameTrainingDriver:
                 self._expand_dated(
                     p.train_input_dirs, p.train_date_range,
                     p.train_date_range_days_ago,
-                )
+                ),
+                index_maps=self._offheap_index_maps(),
             )
         self._train_dataset = dataset
         self.logger.info(
@@ -692,6 +718,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--updating-sequence", default=None)
     ap.add_argument("--num-iterations", type=int, default=1)
     ap.add_argument("--evaluator-types", default=None)
+    ap.add_argument("--offheap-indexmap-dir", default=None)
+    ap.add_argument("--offheap-indexmap-num-partitions", type=int, default=None)
     ap.add_argument("--compute-variance", default="false")
     ap.add_argument("--delete-output-dir-if-exists", default="false")
     ap.add_argument(
@@ -769,6 +797,8 @@ def params_from_args(argv=None) -> GameTrainingParams:
             else []
         ),
         compute_variance=_bool(ns.compute_variance),
+        offheap_indexmap_dir=ns.offheap_indexmap_dir,
+        offheap_indexmap_num_partitions=ns.offheap_indexmap_num_partitions,
         delete_output_dir_if_exists=_bool(ns.delete_output_dir_if_exists),
         distributed=ns.distributed,
         coordinator_address=ns.coordinator_address,

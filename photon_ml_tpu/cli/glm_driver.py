@@ -105,6 +105,11 @@ class GLMParams:
     constraint_string: Optional[str] = None
     selected_features_file: Optional[str] = None
     summarization_output_dir: Optional[str] = None
+    # Prebuilt partitioned feature-index store (OptionNames.scala:47-48,
+    # PalDBIndexMapLoader analog): skip the vocabulary build and use the
+    # store for name<->index lookup. Built by the feature-indexing job.
+    offheap_indexmap_dir: Optional[str] = None
+    offheap_indexmap_num_partitions: Optional[int] = None
     diagnostic_mode: DiagnosticMode = DiagnosticMode.NONE
     compute_variances: bool = False
     delete_output_dirs_if_exist: bool = False
@@ -277,7 +282,25 @@ class GLMDriver:
             # (the FeatureIndexingJob store) + global-array assembly via
             # jax.make_array_from_process_local_data — see
             # parallel/multihost.process_shard for the path split.
-            data = fmt.load(train_paths, constraint_string=p.constraint_string)
+            prebuilt = None
+            if p.offheap_indexmap_dir:
+                from photon_ml_tpu.utils.native_index import (
+                    load_offheap_index_map,
+                )
+
+                prebuilt = load_offheap_index_map(
+                    p.offheap_indexmap_dir,
+                    num_partitions=p.offheap_indexmap_num_partitions,
+                )
+                self.logger.info(
+                    "offheap index map: %d features from %s",
+                    prebuilt.size, p.offheap_indexmap_dir,
+                )
+            data = fmt.load(
+                train_paths,
+                index_map=prebuilt,
+                constraint_string=p.constraint_string,
+            )
             self._data = data
             self.logger.info(
                 "loaded %d examples, %d features",
@@ -609,6 +632,8 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--coefficient-box-constraints", default=None)
     ap.add_argument("--selected-features-file", default=None)
     ap.add_argument("--summarization-output-dir", default=None)
+    ap.add_argument("--offheap-indexmap-dir", default=None)
+    ap.add_argument("--offheap-indexmap-num-partitions", type=int, default=None)
     ap.add_argument("--diagnostic-mode", default="NONE")
     ap.add_argument("--compute-variances", default="false")
     ap.add_argument("--delete-output-dirs-if-exist", default="false")
@@ -668,6 +693,8 @@ def params_from_args(argv=None) -> GLMParams:
         constraint_string=ns.coefficient_box_constraints,
         selected_features_file=ns.selected_features_file,
         summarization_output_dir=ns.summarization_output_dir,
+        offheap_indexmap_dir=ns.offheap_indexmap_dir,
+        offheap_indexmap_num_partitions=ns.offheap_indexmap_num_partitions,
         diagnostic_mode=DiagnosticMode.parse(ns.diagnostic_mode),
         compute_variances=_bool(ns.compute_variances),
         delete_output_dirs_if_exist=_bool(ns.delete_output_dirs_if_exist),

@@ -94,9 +94,17 @@ class IndexMap:
             json.dump(self._fwd, f)
 
     @staticmethod
-    def load(path: str) -> "IndexMap":
+    def load(path: str):
+        """-> IndexMap, or a reopened PartitionedIndexMap when the file is
+        an offheap-store pointer written by PartitionedIndexMap.save (the
+        driver's feature-index output under --offheap-indexmap-dir)."""
         with open(path, "r", encoding="utf-8") as f:
-            return IndexMap(json.load(f))
+            data = json.load(f)
+        if isinstance(data, dict) and "offheap_index_store" in data:
+            from photon_ml_tpu.utils.native_index import PartitionedIndexMap
+
+            return PartitionedIndexMap.from_pointer(data, path)
+        return IndexMap(data)
 
 
 class IdentityIndexMap:

@@ -14,6 +14,7 @@ installs in the image); ctypes keeps the binding dependency-free.
 from __future__ import annotations
 
 import ctypes
+import json
 import os
 import subprocess
 import threading
@@ -154,11 +155,28 @@ class PartitionedIndexMap:
 
     def __init__(self, directory: str):
         self.directory = directory
+        # sort by NUMERIC partition id: lexicographic order would place
+        # partition 10 before 2 and misalign hash(key) % P routing
         parts = sorted(
-            f for f in os.listdir(directory) if f.startswith("index-partition-")
+            (
+                f
+                for f in os.listdir(directory)
+                if f.startswith("index-partition-")
+            ),
+            key=lambda f: int(
+                f[len("index-partition-"):].split(".", 1)[0]
+            ),
         )
         if not parts:
             raise OSError(f"no index partitions in {directory}")
+        expected = [
+            self.STORE_PATTERN.format(part=p) for p in range(len(parts))
+        ]
+        if parts != expected:
+            raise OSError(
+                f"{directory}: partition files {parts} are not the "
+                f"contiguous set {expected}"
+            )
         self._stores = [
             NativeIndexStore(os.path.join(directory, f)) for f in parts
         ]
@@ -201,6 +219,40 @@ class PartitionedIndexMap:
         for s in self._stores:
             s.close()
 
+    def save(self, path: str) -> None:
+        """Write a POINTER to the store instead of duplicating a
+        potentially >200k-key vocabulary as JSON (IndexMap.save parity
+        for the driver's feature-index output). ``IndexMap.load``
+        recognizes the pointer and reopens the store; the relative path
+        keeps an output directory relocatable together with its index."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "offheap_index_store": os.path.abspath(self.directory),
+                    "offheap_index_store_relative": os.path.relpath(
+                        os.path.abspath(self.directory),
+                        os.path.dirname(os.path.abspath(path)),
+                    ),
+                    "num_partitions": len(self._stores),
+                    "size": self.size,
+                },
+                f,
+            )
+
+    @staticmethod
+    def from_pointer(meta: dict, pointer_path: str) -> "PartitionedIndexMap":
+        """Reopen a store from a ``save`` pointer; tries the relative
+        path (relocated output tree) before the recorded absolute one."""
+        rel = meta.get("offheap_index_store_relative")
+        if rel is not None:
+            cand = os.path.join(
+                os.path.dirname(os.path.abspath(pointer_path)), rel
+            )
+            if _has_store(cand):
+                return PartitionedIndexMap(cand)
+        return PartitionedIndexMap(meta["offheap_index_store"])
+
 
 def build_partitioned_index(
     keys: Iterable[str],
@@ -224,3 +276,75 @@ def build_partitioned_index(
             sorted(part_keys),
         )
     return PartitionedIndexMap(directory)
+
+
+def load_offheap_index_map(
+    directory: str,
+    shard_name: Optional[str] = None,
+    num_partitions: Optional[int] = None,
+) -> PartitionedIndexMap:
+    """Open a prebuilt partitioned store (the drivers'
+    ``--offheap-indexmap-dir`` path; PalDBIndexMapLoader analog,
+    cli/game/GAMEDriver.scala:89-97 prepareFeatureMaps).
+
+    With ``shard_name`` (the GAME per-shard path) the store MUST be at
+    ``<directory>/<shard_name>`` — pointing different shards at one store
+    would silently merge their feature spaces. Without it, accepts either
+    a store directory itself (contains ``index-partition-*``) or a parent
+    with exactly one shard subdirectory. ``num_partitions`` — the
+    reference's ``offheap-indexmap-num-partitions`` — is validated
+    against the store when given (here partition count is discovered
+    from the files, so the option is a consistency check only).
+    """
+    if shard_name is not None:
+        d = os.path.join(directory, shard_name)
+        if not _has_store(d):
+            raise OSError(
+                f"no index store for feature shard {shard_name!r} at {d} "
+                "— run the feature-indexing job with "
+                f"--shard-name {shard_name}"
+            )
+    else:
+        d = directory
+        if not _has_store(d):
+            subs = [
+                s
+                for s in sorted(os.listdir(d))
+                if _has_store(os.path.join(d, s))
+            ] if os.path.isdir(d) else []
+            if len(subs) != 1:
+                raise OSError(
+                    f"{directory}: expected an index store or exactly one "
+                    f"shard subdirectory, found {subs or 'none'}"
+                )
+            d = os.path.join(d, subs[0])
+    pm = PartitionedIndexMap(d)
+    if num_partitions is not None and len(pm._stores) != num_partitions:
+        pm.close()
+        raise ValueError(
+            f"offheap index map at {d} has {len(pm._stores)} partitions, "
+            f"expected {num_partitions}"
+        )
+    return pm
+
+
+def load_offheap_index_maps(
+    directory: str,
+    shard_ids: Sequence[str],
+    num_partitions: Optional[int] = None,
+) -> dict:
+    """{shard_id: PartitionedIndexMap} for the GAME drivers'
+    --offheap-indexmap-dir (prepareFeatureMaps analog); every shard must
+    have its ``<directory>/<shard_id>`` store."""
+    return {
+        sid: load_offheap_index_map(
+            directory, shard_name=sid, num_partitions=num_partitions
+        )
+        for sid in shard_ids
+    }
+
+
+def _has_store(d: str) -> bool:
+    return os.path.isdir(d) and any(
+        f.startswith("index-partition-") for f in os.listdir(d)
+    )

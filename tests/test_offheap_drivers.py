@@ -1,0 +1,219 @@
+"""--offheap-indexmap-dir: drivers consuming prebuilt native index stores
+(reference: OptionNames.scala:47-48, PalDBIndexMapLoader,
+cli/game/GAMEDriver.scala:89-97 prepareFeatureMaps)."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def _native_or_skip():
+    from photon_ml_tpu.utils import native_index
+
+    try:
+        native_index._lib()
+    except Exception as e:  # pragma: no cover
+        pytest.skip(f"native index store unavailable: {e}")
+
+
+def test_load_offheap_index_map_shapes(tmp_path):
+    _native_or_skip()
+    from photon_ml_tpu.utils.native_index import (
+        build_partitioned_index,
+        load_offheap_index_map,
+    )
+
+    store = tmp_path / "index" / "global"
+    pm = build_partitioned_index(
+        (f"k{i}\t" for i in range(100)), str(store), num_partitions=3
+    )
+    pm.close()
+
+    # direct store dir
+    m1 = load_offheap_index_map(str(store))
+    assert m1.size == 100
+    m1.close()
+    # parent dir with a single shard subdir
+    m2 = load_offheap_index_map(str(tmp_path / "index"))
+    assert m2.size == 100
+    m2.close()
+    # shard_name selection + partition-count validation
+    m3 = load_offheap_index_map(
+        str(tmp_path / "index"), shard_name="global", num_partitions=3
+    )
+    assert m3.get_index("k7\t") >= 0
+    m3.close()
+    with pytest.raises(ValueError):
+        load_offheap_index_map(str(store), num_partitions=5)
+    # per-shard mode must not silently fall back to a direct store
+    with pytest.raises(OSError):
+        load_offheap_index_map(str(store), shard_name="other")
+    with pytest.raises(OSError):
+        load_offheap_index_map(str(tmp_path / "index"), shard_name="missing")
+
+
+def test_partition_routing_above_ten_partitions(tmp_path):
+    """Lexicographic file ordering would misroute hash(key) % P for
+    P >= 11 (partition '10' sorts before '2')."""
+    _native_or_skip()
+    from photon_ml_tpu.utils.native_index import (
+        build_partitioned_index,
+        load_offheap_index_map,
+    )
+
+    keys = [f"feat{i}\t" for i in range(500)]
+    store = tmp_path / "global"
+    pm = build_partitioned_index(iter(keys), str(store), num_partitions=12)
+    pm.close()
+    m = load_offheap_index_map(str(store), num_partitions=12)
+    seen = {}
+    for k in keys:
+        i = m.get_index(k)
+        assert i >= 0, f"{k} lost in partition routing"
+        assert m.get_feature_name(i) == k
+        seen[i] = k
+    assert len(seen) == len(keys)
+    m.close()
+
+
+def test_pointer_roundtrip_through_index_map_load(tmp_path):
+    """PartitionedIndexMap.save writes a pointer that IndexMap.load
+    reopens — including after the output tree is relocated."""
+    _native_or_skip()
+    import shutil
+
+    from photon_ml_tpu.utils.index_map import IndexMap
+    from photon_ml_tpu.utils.native_index import build_partitioned_index
+
+    out = tmp_path / "out"
+    store = out / "index" / "global"
+    pm = build_partitioned_index(
+        (f"k{i}\t" for i in range(50)), str(store), num_partitions=2
+    )
+    pm.save(str(out / "feature-index" / "index.json"))
+
+    reopened = IndexMap.load(str(out / "feature-index" / "index.json"))
+    assert reopened.size == 50
+    assert reopened.get_index("k3\t") == pm.get_index("k3\t")
+    pm.close()
+    reopened.close()
+
+    # relocate the whole output tree: the relative pointer still resolves
+    moved = tmp_path / "moved"
+    shutil.move(str(out), str(moved))
+    again = IndexMap.load(str(moved / "feature-index" / "index.json"))
+    assert again.size == 50
+    again.close()
+
+
+def test_glm_driver_with_offheap_index(tmp_path, rng):
+    _native_or_skip()
+    from photon_ml_tpu.cli.feature_indexing_driver import run_feature_indexing
+    from photon_ml_tpu.cli.glm_driver import GLMDriver, GLMParams
+    from photon_ml_tpu.io.avro_codec import write_container
+    from photon_ml_tpu.io import schemas
+
+    train = tmp_path / "train"
+    train.mkdir()
+    w = rng.normal(size=6)
+    recs = []
+    for i in range(120):
+        x = rng.normal(size=6)
+        z = float(x @ w)
+        recs.append({
+            "uid": str(i),
+            "label": float(1 / (1 + np.exp(-z)) > rng.uniform()),
+            "features": [
+                {"name": f"f{j}", "term": "", "value": float(x[j])}
+                for j in range(6)
+            ],
+            "metadataMap": None,
+            "weight": None,
+            "offset": None,
+        })
+    write_container(
+        str(train / "part.avro"), schemas.TRAINING_EXAMPLE_AVRO, recs
+    )
+
+    index_dir = tmp_path / "index"
+    run_feature_indexing(
+        [str(train)], str(index_dir), num_partitions=2, shard_name="global"
+    )
+
+    out = tmp_path / "out"
+    params = GLMParams(
+        train_dir=str(train),
+        output_dir=str(out),
+        regularization_weights=[1.0],
+        offheap_indexmap_dir=str(index_dir),
+        offheap_indexmap_num_partitions=2,
+        distributed="off",
+    )
+    driver = GLMDriver(params)
+    driver.run()
+    assert driver.models
+    # feature-index output is a pointer to the offheap store, not a dump
+    meta = json.load(open(out / "feature-index" / "index.json"))
+    assert meta["num_partitions"] == 2
+    assert meta["size"] == 7  # 6 features + intercept
+    # text models resolve feature names through the store
+    text = (out / "models-text").glob("*")
+    assert any(True for _ in text)
+
+
+def test_game_driver_with_offheap_index(tmp_path, rng):
+    _native_or_skip()
+    from test_game_drivers import write_game_avro
+    from photon_ml_tpu.cli.feature_indexing_driver import run_feature_indexing
+    from photon_ml_tpu.cli.game_training_driver import (
+        GameTrainingDriver,
+        params_from_args,
+    )
+
+    train = tmp_path / "train"
+    train.mkdir()
+    write_game_avro(str(train / "p.avro"), rng, n=160)
+
+    index_dir = tmp_path / "index"
+    run_feature_indexing(
+        [str(train)], str(index_dir), feature_bags=["features"],
+        num_partitions=2, shard_name="g",
+    )
+    run_feature_indexing(
+        [str(train)], str(index_dir), feature_bags=["userFeatures"],
+        num_partitions=2, shard_name="u",
+    )
+
+    params = params_from_args([
+        "--train-input-dirs", str(train),
+        "--output-dir", str(tmp_path / "out"),
+        "--feature-shard-id-to-feature-section-keys-map",
+        "g:features|u:userFeatures",
+        "--fixed-effect-data-configurations", "global:g",
+        "--fixed-effect-optimization-configurations",
+        "global:10,1e-6,0.1,1,LBFGS,L2",
+        "--random-effect-data-configurations",
+        "per-user:userId,u,1,none,none,none,index_map",
+        "--random-effect-optimization-configurations",
+        "per-user:10,1e-6,1.0,1,LBFGS,L2",
+        "--updating-sequence", "global,per-user",
+        "--num-iterations", "2",
+        "--offheap-indexmap-dir", str(index_dir),
+        "--offheap-indexmap-num-partitions", "2",
+        "--distributed", "off",
+    ])
+    driver = GameTrainingDriver(params)
+    driver.run()
+    assert driver.results
+    objective = driver.results[0][1].objective_history
+    assert objective[-1] <= objective[0]
