@@ -498,121 +498,147 @@ def _glmix_config(
     }
 
 
-def suite():
-    """BASELINE.md matrix. One JSON line per config + summary."""
+def suite(only=None):
+    """BASELINE.md matrix. One JSON line per config + summary.
+
+    ``only``: config-name prefix filter (``--only 3`` re-measures just
+    config 3); filtered runs MERGE into BASELINE_RESULTS.json instead of
+    rewriting it.
+    """
+    import os
+
     import jax
 
     device = str(jax.devices()[0])
     results = []
 
+    def want(name):
+        return only is None or name.startswith(only)
+
     # 1: a1a logistic grid (README.md:217-256 tutorial shape: n=1605
     # train / 30956 test, d=123; lambdas from run_photon_ml_driver.sh).
-    results.append(
-        _glm_fit_config(
-            "1_a1a_logistic",
-            task="LOGISTIC_REGRESSION",
-            optimizer="LBFGS",
-            reg_type="L2",
-            lambdas=[0.1, 1.0, 10.0, 100.0],
-            n=1605,
-            d=123,
-            k=14,
-            n_val=30_956,
-            max_iter=50,
-            kernel="scatter",  # tiny dim: schedule build not worth it
-            shape_note="synthetic with a1a's exact shape (1605x123, ~14 nnz)",
+    if want("1_a1a_logistic"):
+        results.append(
+            _glm_fit_config(
+                "1_a1a_logistic",
+                task="LOGISTIC_REGRESSION",
+                optimizer="LBFGS",
+                reg_type="L2",
+                lambdas=[0.1, 1.0, 10.0, 100.0],
+                n=1605,
+                d=123,
+                k=14,
+                n_val=30_956,
+                max_iter=50,
+                kernel="scatter",  # tiny dim: schedule build not worth it
+                shape_note="synthetic with a1a's exact shape (1605x123, ~14 nnz)",
+            )
         )
-    )
-    print(json.dumps(results[-1]), flush=True)
+        print(json.dumps(results[-1]), flush=True)
 
     # 2: Criteo-shaped linear TRON + poisson elastic-net (39 raw features
     # hashed to 1M dims, k=39 nnz).
-    results.append(
-        _glm_fit_config(
-            "2a_criteo_linear_tron",
-            task="LINEAR_REGRESSION",
-            optimizer="TRON",
-            reg_type="L2",
-            lambdas=[1.0],
-            n=1 << 18,
-            d=1 << 20,
-            k=40,
-            n_val=1 << 15,
-            shape_note="synthetic at Criteo-sample shape (262k x 1M, 40 nnz)",
+    if want("2a_criteo_linear_tron"):
+        results.append(
+            _glm_fit_config(
+                "2a_criteo_linear_tron",
+                task="LINEAR_REGRESSION",
+                optimizer="TRON",
+                reg_type="L2",
+                lambdas=[1.0],
+                n=1 << 18,
+                d=1 << 20,
+                k=40,
+                n_val=1 << 15,
+                shape_note="synthetic at Criteo-sample shape (262k x 1M, 40 nnz)",
+            )
         )
-    )
-    print(json.dumps(results[-1]), flush=True)
-    results.append(
-        _glm_fit_config(
-            "2b_criteo_poisson_elastic_net",
-            task="POISSON_REGRESSION",
-            optimizer="LBFGS",
-            reg_type="ELASTIC_NET",
-            elastic_net_alpha=0.5,
-            lambdas=[0.1, 1.0],
-            n=1 << 18,
-            d=1 << 20,
-            k=40,
-            n_val=1 << 15,
-            max_iter=50,
-            shape_note="synthetic at Criteo-sample shape (262k x 1M, 40 nnz)",
+        print(json.dumps(results[-1]), flush=True)
+    if want("2b_criteo_poisson_elastic_net"):
+        results.append(
+            _glm_fit_config(
+                "2b_criteo_poisson_elastic_net",
+                task="POISSON_REGRESSION",
+                optimizer="LBFGS",
+                reg_type="ELASTIC_NET",
+                elastic_net_alpha=0.5,
+                lambdas=[0.1, 1.0],
+                n=1 << 18,
+                d=1 << 20,
+                k=40,
+                n_val=1 << 15,
+                max_iter=50,
+                shape_note="synthetic at Criteo-sample shape (262k x 1M, 40 nnz)",
+            )
         )
-    )
-    print(json.dumps(results[-1]), flush=True)
+        print(json.dumps(results[-1]), flush=True)
 
     # 3: smoothed-hinge SVM with per-coefficient box constraints.
-    results.append(
-        _glm_fit_config(
-            "3_hinge_box",
-            task="SMOOTHED_HINGE_LOSS_LINEAR_SVM",
-            optimizer="LBFGS",
-            reg_type="L2",
-            lambdas=[1.0],
-            n=1 << 18,
-            d=1 << 17,
-            k=32,
-            n_val=1 << 15,
-            max_iter=50,
-            box_bound=0.5,
-            shape_note="synthetic (262k x 131k, 32 nnz), box [-0.5, 0.5]",
+    if want("3_hinge_box"):
+        results.append(
+            _glm_fit_config(
+                "3_hinge_box",
+                task="SMOOTHED_HINGE_LOSS_LINEAR_SVM",
+                optimizer="LBFGS",
+                reg_type="L2",
+                lambdas=[1.0],
+                n=1 << 18,
+                d=1 << 17,
+                k=32,
+                n_val=1 << 15,
+                max_iter=50,
+                box_bound=0.5,
+                shape_note="synthetic (262k x 131k, 32 nnz), box [-0.5, 0.5]",
+            )
         )
-    )
-    print(json.dumps(results[-1]), flush=True)
+        print(json.dumps(results[-1]), flush=True)
 
     # 4: GLMix fixed + per-user RE, ~101M coefficients.
-    results.append(
-        _glmix_config(
-            "4_glmix_100m",
-            n_fixed=1 << 18,
-            d_fixed=1 << 20,
-            k_fixed=64,
-            n_users=100_000,
-            d_user=1000,
-            samples_per_user=16,
-            k_user=32,
+    if want("4_glmix_100m"):
+        results.append(
+            _glmix_config(
+                "4_glmix_100m",
+                n_fixed=1 << 18,
+                d_fixed=1 << 20,
+                k_fixed=64,
+                n_users=100_000,
+                d_user=1000,
+                samples_per_user=16,
+                k_user=32,
+            )
         )
-    )
-    print(json.dumps(results[-1]), flush=True)
+        print(json.dumps(results[-1]), flush=True)
 
     # 5: full GAME fixed + user RE + item RE, ~1B coefficients.
-    results.append(
-        _glmix_config(
-            "5_game_1b",
-            n_fixed=1 << 18,
-            d_fixed=1 << 20,
-            k_fixed=64,
-            n_users=600_000,
-            d_user=1000,
-            samples_per_user=16,
-            k_user=32,
-            n_items=400_000,
-            d_item=1000,
-            samples_per_item=16,
-            k_item=32,
+    if want("5_game_1b"):
+        results.append(
+            _glmix_config(
+                "5_game_1b",
+                n_fixed=1 << 18,
+                d_fixed=1 << 20,
+                k_fixed=64,
+                n_users=600_000,
+                d_user=1000,
+                samples_per_user=16,
+                k_user=32,
+                n_items=400_000,
+                d_item=1000,
+                samples_per_item=16,
+                k_item=32,
+            )
         )
-    )
-    print(json.dumps(results[-1]), flush=True)
+        print(json.dumps(results[-1]), flush=True)
 
+    path = "BASELINE_RESULTS.json"
+    merged = {}
+    if only is not None and os.path.exists(path):
+        with open(path) as f:
+            for r in json.load(f).get("results", []):
+                merged[r["config"]] = r
+    for r in results:
+        merged[r["config"]] = r
+    with open(path, "w") as f:
+        json.dump({"device": device, "results": list(merged.values())}, f, indent=2)
     summary = {
         "metric": "baseline_suite",
         "value": len(results),
@@ -620,13 +646,17 @@ def suite():
         "vs_baseline": 1.0,
         "detail": {"device": device, "configs": [r["config"] for r in results]},
     }
-    with open("BASELINE_RESULTS.json", "w") as f:
-        json.dump({"device": device, "results": results}, f, indent=2)
     print(json.dumps(summary))
 
 
 if __name__ == "__main__":
     if "--suite" in sys.argv:
-        suite()
+        only = None
+        if "--only" in sys.argv:
+            i = sys.argv.index("--only") + 1
+            if i >= len(sys.argv):
+                sys.exit("--only requires a config-name prefix")
+            only = sys.argv[i]
+        suite(only=only)
     else:
         main()

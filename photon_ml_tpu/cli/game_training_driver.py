@@ -144,6 +144,10 @@ class GameTrainingParams:
     num_iterations: int = 1
     evaluator_types: List[EvaluatorType] = field(default_factory=list)
     compute_variance: bool = False
+    # ALL: best-model plus every combo's final model under all/<index>
+    # (ModelOutputMode.scala, cli/game/training/Driver.scala:620-635);
+    # BEST: best-model only; NONE: no model output.
+    model_output_mode: str = "ALL"
     # Prebuilt per-shard partitioned feature-index stores (the reference's
     # offheap-indexmap-dir, prepareFeatureMaps at
     # cli/game/GAMEDriver.scala:89-97): a directory with one store
@@ -176,6 +180,10 @@ class GameTrainingParams:
             raise ValueError("output-dir is required")
         if self.distributed not in ("auto", "off"):
             raise ValueError(f"unknown distributed mode {self.distributed!r}")
+        if self.model_output_mode not in ("ALL", "BEST", "NONE"):
+            raise ValueError(
+                f"unknown model output mode {self.model_output_mode!r}"
+            )
         # Exclusivity AND range-string format validated up front.
         from photon_ml_tpu.utils.date_range import resolve_date_range
 
@@ -620,7 +628,7 @@ class GameTrainingDriver:
                         if checkpointer is not None:
                             checkpointer.close()
                     prev_model = result.model
-                self.results.append((combo, result))
+                self.results.append((combo, result, ci))
                 metric = result.best_metric
                 if metric is None:
                     # no validation metric: selection falls back to the
@@ -669,14 +677,31 @@ class GameTrainingDriver:
             )
             sync_processes("outputs-written")
             return
-        with self.timer.time("save-model"):
-            spec = "\n".join(
-                f"{name} -> {cfg.render()}" for name, cfg in self.best_config.items()
-            )
-            save_game_model(
-                best.best_model, dataset,
-                os.path.join(p.output_dir, "best-model"), model_spec=spec,
-            )
+        if p.model_output_mode != "NONE":
+            with self.timer.time("save-model"):
+                spec = "\n".join(
+                    f"{name} -> {cfg.render()}"
+                    for name, cfg in self.best_config.items()
+                )
+                save_game_model(
+                    best.best_model, dataset,
+                    os.path.join(p.output_dir, "best-model"),
+                    model_spec=spec,
+                )
+                if p.model_output_mode == "ALL":
+                    # every combo's final model under all/<original grid
+                    # index> (cli/game/training/Driver.scala:620-635) —
+                    # NOT warm-start training order, so config position i
+                    # always maps to all/<i>
+                    for combo, result, ci in self.results:
+                        save_game_model(
+                            result.model, dataset,
+                            os.path.join(p.output_dir, "all", str(ci)),
+                            model_spec="\n".join(
+                                f"{name} -> {cfg.render()}"
+                                for name, cfg in combo.items()
+                            ),
+                        )
         with open(os.path.join(p.output_dir, "metrics.json"), "w") as f:
             json.dump(
                 {
@@ -721,6 +746,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--offheap-indexmap-dir", default=None)
     ap.add_argument("--offheap-indexmap-num-partitions", type=int, default=None)
     ap.add_argument("--compute-variance", default="false")
+    ap.add_argument(
+        "--model-output-mode", default="ALL", choices=["ALL", "BEST", "NONE"],
+    )
     ap.add_argument("--delete-output-dir-if-exists", default="false")
     ap.add_argument(
         "--coordinator-address", default=None,
@@ -797,6 +825,7 @@ def params_from_args(argv=None) -> GameTrainingParams:
             else []
         ),
         compute_variance=_bool(ns.compute_variance),
+        model_output_mode=ns.model_output_mode,
         offheap_indexmap_dir=ns.offheap_indexmap_dir,
         offheap_indexmap_num_partitions=ns.offheap_indexmap_num_partitions,
         delete_output_dir_if_exists=_bool(ns.delete_output_dir_if_exists),

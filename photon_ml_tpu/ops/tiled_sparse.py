@@ -45,9 +45,14 @@ Array = jnp.ndarray
 
 @dataclass(frozen=True)
 class TileParams:
+    # Defaults from an on-chip sweep at the ads shape (262k x 64nnz x 1M,
+    # PERF_NOTES.md "tile sweep"): chunk 2048 cut the full fused eval
+    # 36.8 -> 28.9 ms vs chunk 1024 (fewer grid steps amortize per-step
+    # scalar/DMA overhead; tile-boundary padding grew only ~25%), while
+    # window-shape changes (s_hi=s_lo=128, or 64/128) were net losses.
     s_hi: int = 128
     s_lo: int = 64
-    chunk: int = 1024  # entries per grid step
+    chunk: int = 2048  # entries per grid step
 
     @property
     def window(self) -> int:

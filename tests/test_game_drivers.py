@@ -237,6 +237,47 @@ class TestGameTrainingEndToEnd:
             self._params(tmp_path, rng, fixed_effect_opt_configs={}).validate()
 
 
+    def test_model_output_modes(self, tmp_path, rng):
+        """ALL writes best-model plus all/<i> per combo; BEST only the
+        best; NONE nothing (ModelOutputMode.scala,
+        cli/game/training/Driver.scala:620-635, :706)."""
+        params = self._params(
+            tmp_path, rng,
+            fixed_effect_opt_configs={
+                "global": "10,1e-6,0.1,1,LBFGS,L2;10,1e-6,100.0,1,LBFGS,L2"
+            },
+            num_iterations=1,
+        )
+        GameTrainingDriver(params).run()
+        out = params.output_dir
+        assert os.path.isdir(os.path.join(out, "best-model"))
+        assert os.path.isdir(os.path.join(out, "all", "0"))
+        assert os.path.isdir(os.path.join(out, "all", "1"))
+        # all/<i> is the USER's grid index (combo 0 = reg 0.1), not the
+        # warm-start training order (which runs reg 100 first)
+        spec0 = open(os.path.join(out, "all", "0", "model-spec")).read()
+        spec1 = open(os.path.join(out, "all", "1", "model-spec")).read()
+        assert "0.1" in spec0 and "100" not in spec0
+        assert "100" in spec1
+
+        for mode, best_exists, all_exists in (
+            ("BEST", True, False), ("NONE", False, False),
+        ):
+            (tmp_path / mode).mkdir()
+            params2 = self._params(
+                (tmp_path / mode), rng, model_output_mode=mode,
+            )
+            GameTrainingDriver(params2).run()
+            out2 = params2.output_dir
+            assert os.path.isdir(os.path.join(out2, "best-model")) == best_exists
+            assert os.path.isdir(os.path.join(out2, "all")) == all_exists
+
+    def test_bad_model_output_mode_rejected(self, tmp_path, rng):
+        params = self._params(tmp_path, rng, model_output_mode="SOME")
+        with pytest.raises(ValueError):
+            GameTrainingDriver(params)
+
+
 @pytest.mark.skipif(
     not os.path.isdir(GAME_REF), reason="reference fixtures unavailable"
 )
