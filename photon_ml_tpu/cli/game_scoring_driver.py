@@ -39,10 +39,13 @@ class GameScoringParams:
     evaluator_types: List[EvaluatorType] = field(default_factory=list)
     model_id: str = ""
     has_response: bool = True
-    # Prebuilt per-shard feature-index stores (prepareFeatureMaps analog,
-    # shared with the training driver; cli/game/GAMEDriver.scala:89-97).
+    # Feature-map sources (prepareFeatureMaps analog, shared with the
+    # training driver; cli/game/GAMEDriver.scala:89-97): offheap stores
+    # take precedence, then name-and-term list files, then maps built
+    # from the scoring data.
     offheap_indexmap_dir: Optional[str] = None
     offheap_indexmap_num_partitions: Optional[int] = None
+    feature_name_and_term_set_path: Optional[str] = None
 
     def validate(self):
         if not self.input_dirs:
@@ -86,6 +89,14 @@ class GameScoringDriver:
                 p.offheap_indexmap_dir,
                 [cfg.shard_id for cfg in p.feature_shards],
                 num_partitions=p.offheap_indexmap_num_partitions,
+            )
+        elif p.feature_name_and_term_set_path:
+            from photon_ml_tpu.io.name_term_list import (
+                index_maps_from_name_term_lists,
+            )
+
+            index_maps = index_maps_from_name_term_lists(
+                p.feature_name_and_term_set_path, p.feature_shards
             )
         with self.timer.time("load-data"):
             dataset = build_game_dataset_from_files(
@@ -157,11 +168,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--has-response", default="true")
     ap.add_argument("--offheap-indexmap-dir", default=None)
     ap.add_argument("--offheap-indexmap-num-partitions", type=int, default=None)
+    ap.add_argument("--feature-name-and-term-set-path", default=None)
+    ap.add_argument("--feature-shard-id-to-intercept-map", default=None)
     return ap
 
 
 def params_from_args(argv=None) -> GameScoringParams:
-    from photon_ml_tpu.cli.game_training_driver import parse_shard_map
+    from photon_ml_tpu.cli.game_training_driver import (
+        apply_intercept_map,
+        parse_shard_map,
+    )
 
     ns = build_arg_parser().parse_args(argv)
     return GameScoringParams(
@@ -169,8 +185,9 @@ def params_from_args(argv=None) -> GameScoringParams:
         game_model_input_dir=ns.game_model_input_dir,
         output_dir=ns.output_dir,
         task_type=TaskType.parse(ns.task_type),
-        feature_shards=parse_shard_map(
-            ns.feature_shard_id_to_feature_section_keys_map
+        feature_shards=apply_intercept_map(
+            parse_shard_map(ns.feature_shard_id_to_feature_section_keys_map),
+            ns.feature_shard_id_to_intercept_map,
         ),
         evaluator_types=(
             [EvaluatorType.parse(s) for s in ns.evaluator_types.split(",")]
@@ -181,6 +198,7 @@ def params_from_args(argv=None) -> GameScoringParams:
         has_response=str(ns.has_response).lower() in ("true", "1", "yes"),
         offheap_indexmap_dir=ns.offheap_indexmap_dir,
         offheap_indexmap_num_partitions=ns.offheap_indexmap_num_partitions,
+        feature_name_and_term_set_path=ns.feature_name_and_term_set_path,
     )
 
 

@@ -71,6 +71,42 @@ def parse_shard_map(s: str) -> List[FeatureShardConfiguration]:
     ]
 
 
+def apply_intercept_map(
+    shards: List[FeatureShardConfiguration], intercept_map: Optional[str]
+) -> List[FeatureShardConfiguration]:
+    """``shardId1:true|shardId2:false`` -> per-shard add_intercept
+    (featureShardIdToInterceptMap, Params.scala:289-300; default true,
+    a bare ``shardId`` also means true)."""
+    if not intercept_map:
+        return shards
+    import dataclasses
+
+    flags = {}
+    for k, v in parse_keyed_map(intercept_map).items():
+        s = v.strip().lower()
+        if s in ("", "true", "1", "yes"):
+            flags[k] = True
+        elif s in ("false", "0", "no"):
+            flags[k] = False
+        else:
+            # a typo like "ture" must not silently drop the intercept
+            # (the reference's .toBoolean throws the same way)
+            raise ValueError(
+                f"intercept map value for {k!r} must be true/false, got {v!r}"
+            )
+    unknown = set(flags) - {s.shard_id for s in shards}
+    if unknown:
+        raise ValueError(
+            f"intercept map references unknown feature shards {sorted(unknown)}"
+        )
+    return [
+        dataclasses.replace(
+            s, add_intercept=flags.get(s.shard_id, s.add_intercept)
+        )
+        for s in shards
+    ]
+
+
 def _ensure_manifest(directory: str, manifest: Dict[str, object]) -> None:
     """Refuse to reuse a checkpoint directory produced by a different run
     configuration — resuming foreign weights would silently corrupt the
@@ -155,6 +191,13 @@ class GameTrainingParams:
     # feature-indexing job with --shard-name.
     offheap_indexmap_dir: Optional[str] = None
     offheap_indexmap_num_partitions: Optional[int] = None
+    # Feature name-and-term list files (the reference's default feature-map
+    # source, GAMEDriver.prepareFeatureMapsDefault +
+    # NameAndTermFeatureSetContainer.scala): <path>/<sectionKey>/ text
+    # files of name TAB term lines; a shard's vocabulary is the union of
+    # its section keys' lists. Ignored when offheap_indexmap_dir is set
+    # (same precedence as the reference's prepareFeatureMaps dispatch).
+    feature_name_and_term_set_path: Optional[str] = None
     delete_output_dir_if_exists: bool = False
     # "auto": fixed-effect solves run data-parallel under shard_map and
     # random-effect banks shard their entity axis whenever >1 device is
@@ -472,22 +515,41 @@ class GameTrainingDriver:
     # -- run ---------------------------------------------------------------
 
     def _offheap_index_maps(self):
-        """{shard_id: PartitionedIndexMap} from --offheap-indexmap-dir
-        (prepareFeatureMaps analog); None when the option is unset. Every
-        configured feature shard must have its store subdirectory."""
+        """{shard_id: index map} resolved like the reference's
+        prepareFeatureMaps dispatch (cli/game/GAMEDriver.scala:89-97):
+        offheap stores when --offheap-indexmap-dir is set, else
+        name-and-term list files when --feature-name-and-term-set-path is
+        set, else None (maps built from the training data)."""
         p = self.params
-        if not p.offheap_indexmap_dir:
-            return None
-        from photon_ml_tpu.utils.native_index import load_offheap_index_maps
+        if p.offheap_indexmap_dir:
+            from photon_ml_tpu.utils.native_index import (
+                load_offheap_index_maps,
+            )
 
-        maps = load_offheap_index_maps(
-            p.offheap_indexmap_dir,
-            [cfg.shard_id for cfg in p.feature_shards],
-            num_partitions=p.offheap_indexmap_num_partitions,
-        )
-        for sid, m in maps.items():
-            self.logger.info("offheap index map %s: %d features", sid, m.size)
-        return maps
+            maps = load_offheap_index_maps(
+                p.offheap_indexmap_dir,
+                [cfg.shard_id for cfg in p.feature_shards],
+                num_partitions=p.offheap_indexmap_num_partitions,
+            )
+            for sid, m in maps.items():
+                self.logger.info(
+                    "offheap index map %s: %d features", sid, m.size
+                )
+            return maps
+        if p.feature_name_and_term_set_path:
+            from photon_ml_tpu.io.name_term_list import (
+                index_maps_from_name_term_lists,
+            )
+
+            maps = index_maps_from_name_term_lists(
+                p.feature_name_and_term_set_path, p.feature_shards
+            )
+            for sid, m in maps.items():
+                self.logger.info(
+                    "name-term list index map %s: %d features", sid, m.size
+                )
+            return maps
+        return None
 
     def run(self) -> None:
         p = self.params
@@ -565,6 +627,12 @@ class GameTrainingDriver:
                     k: repr(v)
                     for k, v in sorted(p.random_effect_data_configs.items())
                 },
+                # the feature-map source defines the coefficient index
+                # space — a changed source must not resume old weights
+                "offheap_indexmap_dir": p.offheap_indexmap_dir,
+                "feature_name_and_term_set_path": (
+                    p.feature_name_and_term_set_path
+                ),
             }
         prev_model = None
         best_orig_idx = None
@@ -735,6 +803,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--validate-date-range-days-ago", default=None)
     ap.add_argument("--task-type", default="LOGISTIC_REGRESSION")
     ap.add_argument("--feature-shard-id-to-feature-section-keys-map", required=True)
+    ap.add_argument(
+        "--feature-shard-id-to-intercept-map", default=None,
+        help="shardId1:true|shardId2:false — whether each shard learns an "
+        "intercept (default true; Params.scala:289-300)",
+    )
+    ap.add_argument(
+        "--feature-name-and-term-set-path", default=None,
+        help="directory of per-section name<TAB>term feature list files "
+        "(the default prepareFeatureMaps source)",
+    )
     ap.add_argument("--fixed-effect-data-configurations", default="")
     ap.add_argument("--fixed-effect-optimization-configurations", default="")
     ap.add_argument("--random-effect-data-configurations", default="")
@@ -803,9 +881,11 @@ def params_from_args(argv=None) -> GameTrainingParams:
         validate_date_range_days_ago=ns.validate_date_range_days_ago,
         output_dir=ns.output_dir,
         task_type=TaskType.parse(ns.task_type),
-        feature_shards=parse_shard_map(
-            ns.feature_shard_id_to_feature_section_keys_map
+        feature_shards=apply_intercept_map(
+            parse_shard_map(ns.feature_shard_id_to_feature_section_keys_map),
+            ns.feature_shard_id_to_intercept_map,
         ),
+        feature_name_and_term_set_path=ns.feature_name_and_term_set_path,
         fixed_effect_data_configs=fe_data,
         fixed_effect_opt_configs=parse_keyed_map(
             ns.fixed_effect_optimization_configurations

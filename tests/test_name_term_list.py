@@ -1,0 +1,168 @@
+"""Feature name-and-term list files (NameAndTermFeatureSetContainer
+analog) and the per-shard intercept map."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(5)
+
+
+class TestListFiles:
+    def test_roundtrip(self, tmp_path):
+        from photon_ml_tpu.io.name_term_list import (
+            read_name_and_term_feature_sets,
+            save_name_and_term_feature_sets,
+        )
+        from photon_ml_tpu.utils.index_map import feature_key
+
+        sets = {
+            "features": {feature_key("a", "t1"), feature_key("b")},
+            "userFeatures": {feature_key("u0"), feature_key("u1", "x")},
+        }
+        save_name_and_term_feature_sets(sets, str(tmp_path))
+        back = read_name_and_term_feature_sets(
+            str(tmp_path), ["features", "userFeatures"]
+        )
+        assert back == sets
+
+    def test_bare_name_line_means_empty_term(self, tmp_path):
+        from photon_ml_tpu.io.name_term_list import read_name_and_term_set
+        from photon_ml_tpu.utils.index_map import feature_key
+
+        d = tmp_path / "features"
+        d.mkdir()
+        (d / "part-00000").write_text("plain\nwith\ttermed\n")
+        assert read_name_and_term_set(str(d)) == {
+            feature_key("plain"), feature_key("with", "termed")
+        }
+
+    def test_missing_section_raises(self, tmp_path):
+        from photon_ml_tpu.io.name_term_list import (
+            read_name_and_term_feature_sets,
+        )
+
+        with pytest.raises(OSError, match="no feature list"):
+            read_name_and_term_feature_sets(str(tmp_path), ["nope"])
+
+    def test_index_map_union_and_intercept(self, tmp_path):
+        from photon_ml_tpu.io.name_term_list import index_map_from_sections
+        from photon_ml_tpu.utils.index_map import feature_key, intercept_key
+
+        sets = {
+            "a": {feature_key("x"), feature_key("y")},
+            "b": {feature_key("y"), feature_key("z")},
+        }
+        m = index_map_from_sections(sets, ["a", "b"], add_intercept=True)
+        assert m.size == 4  # x, y, z + intercept
+        assert m.get_index(intercept_key()) == 3
+        m2 = index_map_from_sections(sets, ["a"], add_intercept=False)
+        assert m2.size == 2
+
+    def test_generate_from_avro(self, tmp_path, rng):
+        from test_game_drivers import write_game_avro
+        from photon_ml_tpu.io.name_term_list import (
+            generate_name_and_term_lists,
+            read_name_and_term_feature_sets,
+        )
+
+        data = tmp_path / "data"
+        data.mkdir()
+        write_game_avro(str(data / "p.avro"), rng, n=50)
+        out = tmp_path / "lists"
+        sets = generate_name_and_term_lists(
+            [str(data)], ["features", "userFeatures"], str(out)
+        )
+        assert len(sets["features"]) == 5
+        assert len(sets["userFeatures"]) == 3
+        back = read_name_and_term_feature_sets(
+            str(out), ["features", "userFeatures"]
+        )
+        assert back == sets
+
+
+class TestInterceptMap:
+    def test_apply(self):
+        from photon_ml_tpu.cli.game_training_driver import (
+            apply_intercept_map,
+            parse_shard_map,
+        )
+
+        shards = parse_shard_map("g:features|u:userFeatures")
+        out = apply_intercept_map(shards, "g:true|u:false")
+        assert out[0].add_intercept is True
+        assert out[1].add_intercept is False
+        # bare shard id means true; unspecified keeps default
+        out2 = apply_intercept_map(shards, "u")
+        assert out2[1].add_intercept is True
+        with pytest.raises(ValueError, match="unknown feature shards"):
+            apply_intercept_map(shards, "ghost:false")
+
+
+class TestDriverIntegration:
+    def test_game_training_with_list_files_and_intercept_map(
+        self, tmp_path, rng
+    ):
+        from test_game_drivers import write_game_avro
+        from photon_ml_tpu.cli.game_training_driver import (
+            GameTrainingDriver,
+            params_from_args,
+        )
+        from photon_ml_tpu.io.name_term_list import (
+            generate_name_and_term_lists,
+        )
+
+        train = tmp_path / "train"
+        train.mkdir()
+        write_game_avro(str(train / "p.avro"), rng, n=160)
+        lists = tmp_path / "lists"
+        generate_name_and_term_lists(
+            [str(train)], ["features", "userFeatures"], str(lists)
+        )
+
+        params = params_from_args([
+            "--train-input-dirs", str(train),
+            "--output-dir", str(tmp_path / "out"),
+            "--feature-shard-id-to-feature-section-keys-map",
+            "g:features|u:userFeatures",
+            "--feature-shard-id-to-intercept-map", "g:true|u:false",
+            "--feature-name-and-term-set-path", str(lists),
+            "--fixed-effect-data-configurations", "global:g",
+            "--fixed-effect-optimization-configurations",
+            "global:10,1e-6,0.1,1,LBFGS,L2",
+            "--random-effect-data-configurations",
+            "per-user:userId,u,1,none,none,none,index_map",
+            "--random-effect-optimization-configurations",
+            "per-user:10,1e-6,1.0,1,LBFGS,L2",
+            "--updating-sequence", "global,per-user",
+            "--num-iterations", "2",
+            "--distributed", "off",
+        ])
+        driver = GameTrainingDriver(params)
+        driver.run()
+        ds = driver._train_dataset
+        # g: 5 features + intercept; u: 3 features, NO intercept
+        assert ds.shards["g"].dim == 6
+        assert ds.shards["u"].dim == 3
+        assert ds.shards["u"].intercept_index is None
+        hist = driver.results[0][1].objective_history
+        assert hist[-1] <= hist[0]
+
+
+class TestStrictness:
+    def test_bad_intercept_value_rejected(self):
+        from photon_ml_tpu.cli.game_training_driver import (
+            apply_intercept_map,
+            parse_shard_map,
+        )
+
+        shards = parse_shard_map("g:features")
+        with pytest.raises(ValueError, match="must be true/false"):
+            apply_intercept_map(shards, "g:ture")
