@@ -92,7 +92,17 @@ class GLMParams:
     # Driver.scala:329-372); requires a validation directory.
     validate_per_iteration: bool = False
     task: TaskType = TaskType.LOGISTIC_REGRESSION
-    input_format: str = "AVRO"  # AVRO | LIBSVM
+    input_format: str = "AVRO"  # AVRO | LIBSVM (INPUT_FILE_FORMAT)
+    # Avro field-name convention (io/FieldNamesType.scala): the response
+    # field is "label" for TRAINING_EXAMPLE, "response" for
+    # RESPONSE_PREDICTION.
+    field_names: str = "TRAINING_EXAMPLE"
+    # Pre-declared LibSVM dimension (--feature-dimension,
+    # LibSVMInputDataFormat.scala:32-39): indices are ids, no vocab scan.
+    feature_dimension: Optional[int] = None
+    # Per-iteration optimizer state logging (OPTIMIZATION_STATE_TRACKER
+    # option): writes optimization-log.txt under the output directory.
+    enable_optimization_tracker: bool = True
     add_intercept: bool = True
     regularization_weights: List[float] = field(default_factory=lambda: [0.0])
     regularization_type: RegularizationType = RegularizationType.L2
@@ -139,6 +149,13 @@ class GLMParams:
             raise ValueError("output-directory is required")
         if self.kernel not in ("auto", "tiled", "scatter"):
             raise ValueError(f"unknown kernel {self.kernel!r}")
+        if (
+            self.feature_dimension is not None
+            and self.input_format.strip().upper() != "LIBSVM"
+        ):
+            raise ValueError(
+                "feature-dimension only applies to the LIBSVM input format"
+            )
         if self.distributed not in ("auto", "off", "feature"):
             raise ValueError(f"unknown distributed mode {self.distributed!r}")
         if self.distributed == "feature":
@@ -266,11 +283,14 @@ class GLMDriver:
             if p.selected_features_file:
                 with open(p.selected_features_file) as f:
                     selected = [line.strip() for line in f if line.strip()]
-            fmt = create_input_format(
-                p.input_format,
-                add_intercept=p.add_intercept,
-                selected_features=selected,
+            kwargs = dict(
+                add_intercept=p.add_intercept, selected_features=selected
             )
+            if p.input_format.strip().upper() == "AVRO":
+                kwargs["field_names"] = p.field_names
+            elif p.feature_dimension is not None:
+                kwargs["feature_dimension"] = p.feature_dimension
+            fmt = create_input_format(p.input_format, **kwargs)
             self._fmt = fmt
             train_paths = self._dated_paths(
                 p.train_dir, p.train_date_range, p.train_date_range_days_ago
@@ -560,6 +580,21 @@ class GLMDriver:
                 os.path.join(out, "best-model", "model.avro"),
                 self._data.index_map,
             )
+        if p.enable_optimization_tracker:
+            with open(os.path.join(out, "optimization-log.txt"), "w") as f:
+                for lam, res in sorted(self.results.items()):
+                    t = res.tracker
+                    n = int(t.count)
+                    f.write(
+                        f"lambda={lam} iterations={int(res.iterations)} "
+                        f"converged={res.reason_name}\n"
+                    )
+                    # slot 0 is the pre-optimization initial point
+                    for i in range(n):
+                        f.write(
+                            f"  iter={i} value={float(t.values[i]):.8g} "
+                            f"|grad|={float(t.grad_norms[i]):.8g}\n"
+                        )
         with open(os.path.join(out, "metrics.json"), "w") as f:
             json.dump(
                 {
@@ -619,7 +654,33 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--validate-date-range-days-ago", default=None)
     ap.add_argument("--validate-per-iteration", default="false")
     ap.add_argument("--task", default="LOGISTIC_REGRESSION")
-    ap.add_argument("--format", default="AVRO", help="AVRO | LIBSVM")
+    ap.add_argument(
+        "--format", default="TRAINING_EXAMPLE",
+        help="Avro field-name convention: TRAINING_EXAMPLE | "
+        "RESPONSE_PREDICTION (FieldNamesType). Legacy values AVRO|LIBSVM "
+        "are accepted as --input-file-format.",
+    )
+    ap.add_argument(
+        "--input-file-format", default=None, help="AVRO | LIBSVM"
+    )
+    ap.add_argument("--feature-dimension", type=int, default=None)
+    ap.add_argument("--optimization-tracker", default="true")
+    ap.add_argument(
+        "--training-diagnostics", default=None,
+        help="DEPRECATED -- use --diagnostic-mode (true -> ALL)",
+    )
+    # Spark-runtime tuning knobs, accepted for invocation compatibility
+    # and ignored: serialization, input splits and treeAggregate depth
+    # have no analog under XLA (psum replaces treeAggregate).
+    ap.add_argument("--kryo", default=None, help="ignored (Spark-only)")
+    ap.add_argument(
+        "--min-partitions", type=int, default=None,
+        help="ignored (Spark-only)",
+    )
+    ap.add_argument(
+        "--tree-aggregate-depth", type=int, default=None,
+        help="ignored (psum replaces treeAggregate)",
+    )
     ap.add_argument("--intercept", default="true")
     ap.add_argument("--regularization-weights", default="0")
     ap.add_argument("--regularization-type", default="L2")
@@ -634,7 +695,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--summarization-output-dir", default=None)
     ap.add_argument("--offheap-indexmap-dir", default=None)
     ap.add_argument("--offheap-indexmap-num-partitions", type=int, default=None)
-    ap.add_argument("--diagnostic-mode", default="NONE")
+    ap.add_argument("--diagnostic-mode", default=None)
     ap.add_argument("--compute-variances", default="false")
     ap.add_argument("--delete-output-dirs-if-exist", default="false")
     ap.add_argument("--job-name", default="photon-ml-tpu")
@@ -668,6 +729,29 @@ def _bool(s) -> bool:
 
 def params_from_args(argv=None) -> GLMParams:
     ns = build_arg_parser().parse_args(argv)
+    # --format carries the FieldNamesType (reference semantics); legacy
+    # invocations that passed AVRO|LIBSVM there are routed to
+    # --input-file-format instead.
+    fmt = (ns.format or "TRAINING_EXAMPLE").strip().upper()
+    file_format = ns.input_file_format
+    field_names = "TRAINING_EXAMPLE"
+    if fmt in ("AVRO", "LIBSVM"):
+        file_format = file_format or fmt
+    elif fmt in ("TRAINING_EXAMPLE", "RESPONSE_PREDICTION", "NONE"):
+        field_names = fmt
+    else:
+        raise ValueError(f"unknown --format {ns.format!r}")
+    if ns.training_diagnostics is not None:
+        # deprecated boolean (PhotonMLCmdLineParser.scala:68-69,184-186):
+        # exclusive with --diagnostic-mode, maps to ALL/NONE
+        if ns.diagnostic_mode is not None:
+            raise ValueError(
+                "specifying both training-diagnostics and diagnostic-mode "
+                "is not supported"
+            )
+        ns.diagnostic_mode = (
+            "ALL" if _bool(ns.training_diagnostics) else "NONE"
+        )
     return GLMParams(
         train_dir=ns.training_data_directory,
         output_dir=ns.output_directory,
@@ -678,7 +762,10 @@ def params_from_args(argv=None) -> GLMParams:
         validate_date_range_days_ago=ns.validate_date_range_days_ago,
         validate_per_iteration=_bool(ns.validate_per_iteration),
         task=TaskType.parse(ns.task),
-        input_format=ns.format,
+        input_format=file_format or "AVRO",
+        field_names=field_names,
+        feature_dimension=ns.feature_dimension,
+        enable_optimization_tracker=_bool(ns.optimization_tracker),
         add_intercept=_bool(ns.intercept),
         regularization_weights=[
             float(x) for x in ns.regularization_weights.split(",") if x
@@ -695,7 +782,7 @@ def params_from_args(argv=None) -> GLMParams:
         summarization_output_dir=ns.summarization_output_dir,
         offheap_indexmap_dir=ns.offheap_indexmap_dir,
         offheap_indexmap_num_partitions=ns.offheap_indexmap_num_partitions,
-        diagnostic_mode=DiagnosticMode.parse(ns.diagnostic_mode),
+        diagnostic_mode=DiagnosticMode.parse(ns.diagnostic_mode or "NONE"),
         compute_variances=_bool(ns.compute_variances),
         delete_output_dirs_if_exist=_bool(ns.delete_output_dirs_if_exist),
         job_name=ns.job_name,

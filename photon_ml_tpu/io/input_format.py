@@ -127,6 +127,11 @@ class AvroInputDataFormat:
     ``selected_features``: optional set of feature keys to keep
     (GLMSuite.featureKeySet filtering); ``add_intercept`` appends the
     constant-1 intercept feature to every row (GLMSuite.addIntercept).
+    ``field_names``: the Avro field-name convention
+    (io/FieldNamesType.scala + avro/{TrainingExample,
+    ResponsePrediction}FieldNames.scala) — the two differ only in the
+    response field: TRAINING_EXAMPLE reads ``label``,
+    RESPONSE_PREDICTION reads ``response``.
     """
 
     def __init__(
@@ -134,9 +139,17 @@ class AvroInputDataFormat:
         *,
         add_intercept: bool = True,
         selected_features: Optional[Sequence[str]] = None,
+        field_names: str = "TRAINING_EXAMPLE",
     ):
         self.add_intercept = add_intercept
         self.selected = set(selected_features) if selected_features else None
+        fn = field_names.strip().upper()
+        if fn in ("TRAINING_EXAMPLE", "NONE"):
+            self.response_field = "label"
+        elif fn == "RESPONSE_PREDICTION":
+            self.response_field = "response"
+        else:
+            raise ValueError(f"unknown field names type {field_names!r}")
 
     def _record_pairs(self, record: dict) -> Iterable[Tuple[str, float]]:
         for f in record["features"]:
@@ -163,10 +176,15 @@ class AvroInputDataFormat:
             for p in files:
                 schema = read_container_schema(p)
                 names = {f["name"] for f in schema.get("fields", [])}
-                if "features" not in names or "label" not in names:
+                if (
+                    "features" not in names
+                    or self.response_field not in names
+                ):
                     return None
                 numeric = [
-                    f for f in ("label", "offset", "weight") if f in names
+                    f
+                    for f in (self.response_field, "offset", "weight")
+                    if f in names
                 ]
                 plan = native_avro.Plan(schema).compile(
                     numeric_fields=numeric, bag_fields=["features"]
@@ -233,7 +251,7 @@ class AvroInputDataFormat:
                     if len(key_ids)
                     else np.zeros(0, np.int64)
                 )
-                lab = cols.f64("label")
+                lab = cols.f64(self.response_field)
                 if np.isnan(lab).any():
                     # the Python fallback would crash on float(None); a
                     # NaN label must not silently poison the fit
@@ -280,7 +298,7 @@ class AvroInputDataFormat:
                     ix.append(intercept_index)
                     vs.append(1.0)
                 rows.append((ix, vs))
-                labels.append(float(record["label"]))
+                labels.append(float(record[self.response_field]))
                 off_v = record.get("offset")
                 wgt_v = record.get("weight")
                 offsets.append(0.0 if off_v is None else float(off_v))
@@ -306,12 +324,23 @@ class LibSVMInputDataFormat:
         add_intercept: bool = True,
         zero_based: bool = False,
         selected_features: Optional[Sequence[str]] = None,
+        feature_dimension: Optional[int] = None,
     ):
         self.add_intercept = add_intercept
         self.zero_based = zero_based
         self.selected = set(selected_features) if selected_features else None
+        self.feature_dimension = feature_dimension
 
     def build_index_map(self, paths) -> IndexMap:
+        if self.feature_dimension is not None:
+            # pre-declared dimension (the reference's --feature-dimension,
+            # LibSVMInputDataFormat.scala:32-39): indices ARE the ids, no
+            # vocabulary scan; intercept appended when enabled
+            from photon_ml_tpu.utils.index_map import IdentityIndexMap
+
+            return IdentityIndexMap(
+                self.feature_dimension, add_intercept=self.add_intercept
+            )
         keys = (
             key
             for _, pairs in read_libsvm(paths, zero_based=self.zero_based)
@@ -336,7 +365,13 @@ class LibSVMInputDataFormat:
         for label, pairs in read_libsvm(paths, zero_based=self.zero_based):
             ix, vs = [], []
             for idx, value in pairs:
-                i = index_map.get_index(feature_key(str(idx)))
+                key = feature_key(str(idx))
+                # with a pre-declared feature_dimension the identity map
+                # accepts every in-range id, so the selected-features
+                # filter must be applied here
+                if self.selected is not None and key not in self.selected:
+                    continue
+                i = index_map.get_index(key)
                 if i >= 0:
                     ix.append(i)
                     vs.append(value)

@@ -104,15 +104,24 @@ class IndexMap:
             from photon_ml_tpu.utils.native_index import PartitionedIndexMap
 
             return PartitionedIndexMap.from_pointer(data, path)
+        if isinstance(data, dict) and "identity_index_map" in data:
+            return IdentityIndexMap(
+                int(data["identity_index_map"]),
+                add_intercept=bool(data.get("add_intercept")),
+            )
         return IndexMap(data)
 
 
 class IdentityIndexMap:
     """Index map for pre-indexed data (IdentityIndexMapLoader analog):
-    keys ARE stringified indices."""
+    keys ARE stringified indices. ``add_intercept`` appends the intercept
+    at the LAST index (the reference's trueFeatureDimension =
+    featureDimension + 1, LibSVMInputDataFormat.scala:39)."""
 
-    def __init__(self, size: int):
-        self._size = size
+    def __init__(self, size: int, *, add_intercept: bool = False):
+        self._features = size
+        self._size = size + (1 if add_intercept else 0)
+        self._intercept = size if add_intercept else None
 
     def __len__(self) -> int:
         return self._size
@@ -122,14 +131,37 @@ class IdentityIndexMap:
         return self._size
 
     def get_index(self, key: str, default: int = -1) -> int:
+        if self._intercept is not None and key == intercept_key():
+            return self._intercept
         name, _term = split_feature_key(key) if DELIMITER in key else (key, "")
         try:
             i = int(name)
         except ValueError:
             return default
-        return i if 0 <= i < self._size else default
+        return i if 0 <= i < self._features else default
 
     def get_feature_name(self, index: int) -> Optional[str]:
-        if 0 <= index < self._size:
+        if self._intercept is not None and index == self._intercept:
+            return intercept_key()
+        if 0 <= index < self._features:
             return feature_key(str(index))
         return None
+
+    def items(self):
+        for i in range(self._features):
+            yield feature_key(str(i)), i
+        if self._intercept is not None:
+            yield intercept_key(), self._intercept
+
+    def save(self, path: str) -> None:
+        """A small descriptor instead of materializing stringified
+        indices; IndexMap.load reconstructs the identity map from it."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "identity_index_map": self._features,
+                    "add_intercept": self._intercept is not None,
+                },
+                f,
+            )

@@ -1,0 +1,214 @@
+"""Reference CLI option parity: field-name conventions, feature-dimension,
+optimization tracker output, deprecated/obviated flags
+(OptionNames.scala surface)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.cli.glm_driver import GLMDriver, GLMParams, params_from_args
+from photon_ml_tpu.io import schemas
+from photon_ml_tpu.io.avro_codec import write_container
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(17)
+
+
+def _write_response_prediction_avro(path, rng, n=100, d=5):
+    """RESPONSE_PREDICTION convention: the response field is named
+    ``response`` (avro/ResponsePredictionFieldNames.scala)."""
+    schema = {
+        "name": "ResponsePrediction", "type": "record",
+        "fields": [
+            {"name": "response", "type": "double"},
+            {
+                "name": "features",
+                "type": {"type": "array", "items": schemas.FEATURE_AVRO},
+            },
+            {"name": "offset", "type": ["null", "double"], "default": None},
+            {"name": "weight", "type": ["null", "double"], "default": None},
+        ],
+    }
+    w = np.linspace(-1, 1, d)
+    recs = []
+    for _ in range(n):
+        x = rng.normal(size=d)
+        y = float(1 / (1 + np.exp(-x @ w)) > rng.uniform())
+        recs.append({
+            "response": y,
+            "features": [
+                {"name": f"f{j}", "term": "", "value": float(x[j])}
+                for j in range(d)
+            ],
+            "offset": None,
+            "weight": None,
+        })
+    write_container(path, schema, recs)
+
+
+class TestFieldNames:
+    def test_response_prediction_trains(self, tmp_path, rng):
+        train = tmp_path / "train"
+        train.mkdir()
+        _write_response_prediction_avro(str(train / "p.avro"), rng)
+        params = GLMParams(
+            train_dir=str(train),
+            output_dir=str(tmp_path / "out"),
+            field_names="RESPONSE_PREDICTION",
+            regularization_weights=[1.0],
+            distributed="off",
+        )
+        driver = GLMDriver(params)
+        driver.run()
+        assert driver.models
+        labels = np.asarray(driver._data.batch.labels)
+        assert set(np.unique(labels)) <= {0.0, 1.0}
+
+    def test_training_example_files_skip_native_for_response(self, tmp_path, rng):
+        """A RESPONSE_PREDICTION file read with TRAINING_EXAMPLE field
+        names has no 'label' field -> loud failure, not silent zeros."""
+        from photon_ml_tpu.io.input_format import AvroInputDataFormat
+
+        train = tmp_path / "train"
+        train.mkdir()
+        _write_response_prediction_avro(str(train / "p.avro"), rng, n=10)
+        fmt = AvroInputDataFormat(field_names="TRAINING_EXAMPLE")
+        with pytest.raises(KeyError):
+            fmt.load([str(train)])
+
+    def test_unknown_field_names_rejected(self):
+        from photon_ml_tpu.io.input_format import AvroInputDataFormat
+
+        with pytest.raises(ValueError, match="field names"):
+            AvroInputDataFormat(field_names="WAT")
+
+
+class TestFormatRouting:
+    def test_legacy_format_values_route_to_file_format(self, tmp_path):
+        p = params_from_args([
+            "--training-data-directory", "x", "--output-directory", "y",
+            "--format", "LIBSVM",
+        ])
+        assert p.input_format == "LIBSVM"
+        assert p.field_names == "TRAINING_EXAMPLE"
+
+    def test_field_names_format(self):
+        p = params_from_args([
+            "--training-data-directory", "x", "--output-directory", "y",
+            "--format", "RESPONSE_PREDICTION",
+            "--input-file-format", "AVRO",
+        ])
+        assert p.input_format == "AVRO"
+        assert p.field_names == "RESPONSE_PREDICTION"
+
+    def test_training_diagnostics_exclusive(self):
+        with pytest.raises(ValueError, match="not supported"):
+            params_from_args([
+                "--training-data-directory", "x", "--output-directory", "y",
+                "--training-diagnostics", "true",
+                "--diagnostic-mode", "ALL",
+            ])
+        p = params_from_args([
+            "--training-data-directory", "x", "--output-directory", "y",
+            "--training-diagnostics", "true",
+        ])
+        assert p.diagnostic_mode.name == "ALL"
+
+    def test_spark_only_flags_accepted(self):
+        p = params_from_args([
+            "--training-data-directory", "x", "--output-directory", "y",
+            "--kryo", "true", "--min-partitions", "64",
+            "--tree-aggregate-depth", "2",
+        ])
+        assert p.train_dir == "x"
+
+
+class TestFeatureDimension:
+    def test_libsvm_identity_map(self, tmp_path, rng):
+        train = tmp_path / "train"
+        train.mkdir()
+        lines = []
+        for _ in range(60):
+            x = rng.normal(size=4)
+            y = 1 if x.sum() > 0 else -1
+            lines.append(
+                f"{y} " + " ".join(f"{j + 1}:{x[j]:.4f}" for j in range(4))
+            )
+        (train / "data.txt").write_text("\n".join(lines) + "\n")
+        params = params_from_args([
+            "--training-data-directory", str(train),
+            "--output-directory", str(tmp_path / "out"),
+            "--format", "LIBSVM",
+            "--feature-dimension", "10",  # upper bound, not scanned
+            "--regularization-weights", "1.0",
+        ])
+        driver = GLMDriver(params)
+        driver.run()
+        # 10 declared features + intercept
+        assert driver._data.num_features == 11
+        assert driver._data.intercept_index == 10
+
+
+class TestOptimizationTracker:
+    def test_log_written_and_disable(self, tmp_path, rng):
+        train = tmp_path / "train"
+        train.mkdir()
+        _write_response_prediction_avro(str(train / "p.avro"), rng)
+        params = GLMParams(
+            train_dir=str(train),
+            output_dir=str(tmp_path / "out"),
+            field_names="RESPONSE_PREDICTION",
+            regularization_weights=[1.0, 10.0],
+            distributed="off",
+        )
+        GLMDriver(params).run()
+        log = tmp_path / "out" / "optimization-log.txt"
+        text = log.read_text()
+        assert "lambda=1.0" in text and "lambda=10.0" in text
+        assert "|grad|=" in text
+
+        params2 = GLMParams(
+            train_dir=str(train),
+            output_dir=str(tmp_path / "out2"),
+            field_names="RESPONSE_PREDICTION",
+            enable_optimization_tracker=False,
+            distributed="off",
+        )
+        GLMDriver(params2).run()
+        assert not (tmp_path / "out2" / "optimization-log.txt").exists()
+
+
+class TestReviewRegressions:
+    def test_diagnostic_mode_equals_form_conflict(self):
+        with pytest.raises(ValueError, match="not supported"):
+            params_from_args([
+                "--training-data-directory", "x", "--output-directory", "y",
+                "--training-diagnostics", "false",
+                "--diagnostic-mode=ALL",
+            ])
+
+    def test_feature_dimension_with_avro_rejected(self):
+        p = params_from_args([
+            "--training-data-directory", "x", "--output-directory", "y",
+            "--feature-dimension", "10",
+        ])
+        with pytest.raises(ValueError, match="LIBSVM"):
+            p.validate()
+
+    def test_identity_map_respects_selected_features(self, tmp_path):
+        from photon_ml_tpu.io.input_format import LibSVMInputDataFormat
+        from photon_ml_tpu.utils.index_map import feature_key
+
+        (tmp_path / "d.txt").write_text("1 1:2.0 2:3.0 3:4.0\n")
+        fmt = LibSVMInputDataFormat(
+            add_intercept=False,
+            feature_dimension=5,
+            selected_features=[feature_key("0"), feature_key("2")],
+        )
+        loaded = fmt.load([str(tmp_path)])
+        vals = np.asarray(loaded.batch.values)[0]
+        # only 1-based ids 1 and 3 (0-based 0 and 2) survive the filter
+        assert sorted(v for v in vals.tolist() if v) == [2.0, 4.0]
