@@ -34,6 +34,18 @@ class GameScoringParams:
     input_dirs: List[str] = field(default_factory=list)
     game_model_input_dir: str = ""
     output_dir: str = ""
+    # Dated-input expansion over the input dirs (scoring Params
+    # date-range / date-range-days-ago).
+    date_range: Optional[str] = None
+    date_range_days_ago: Optional[str] = None
+    # Extra entity-id columns to extract and write with each score
+    # (randomEffectTypeSet: ScoredItem carries idTypeToValueMap,
+    # cli/game/scoring/Driver.scala:42,152).
+    random_effect_id_set: List[str] = field(default_factory=list)
+    # Split the scores output across N part files (numOutputFilesForScores).
+    num_files: int = 1
+    delete_output_dir_if_exists: bool = False
+    application_name: str = "photon-ml-tpu-game-scoring"
     task_type: TaskType = TaskType.LOGISTIC_REGRESSION
     feature_shards: List[FeatureShardConfiguration] = field(default_factory=list)
     evaluator_types: List[EvaluatorType] = field(default_factory=list)
@@ -60,19 +72,26 @@ class GameScoringDriver:
     def __init__(self, params: GameScoringParams, logger=None):
         params.validate()
         self.params = params
-        os.makedirs(params.output_dir, exist_ok=True)
+        from photon_ml_tpu.parallel.multihost import prepare_output_dir
+
+        prepare_output_dir(
+            params.output_dir,
+            delete_if_exists=params.delete_output_dir_if_exists,
+        )
         self.logger = logger or PhotonLogger(params.output_dir)
         self.timer = Timer()
         self.metrics: Dict[str, float] = {}
 
     def run(self) -> None:
         p = self.params
+        self.logger.info("application: %s", p.application_name)
         with self.timer.time("load-model"):
             model = load_game_model(p.game_model_input_dir)
         self.logger.info("loaded coordinates: %s", model.coordinate_names())
 
         # id columns needed: RE types + MF types + sharded evaluator ids
-        id_types = set()
+        # + explicitly requested pass-through ids
+        id_types = set(p.random_effect_id_set)
         for _, (re_type, _, _) in model.random_effects.items():
             id_types.add(re_type)
         for _, (rt, ct, _, _) in model.matrix_factorizations.items():
@@ -98,9 +117,14 @@ class GameScoringDriver:
             index_maps = index_maps_from_name_term_lists(
                 p.feature_name_and_term_set_path, p.feature_shards
             )
+        from photon_ml_tpu.utils.date_range import expand_dated_paths
+
+        input_paths = expand_dated_paths(
+            p.input_dirs, p.date_range, p.date_range_days_ago, self.logger
+        )
         with self.timer.time("load-data"):
             dataset = build_game_dataset_from_files(
-                p.input_dirs,
+                input_paths,
                 p.feature_shards,
                 sorted(id_types),
                 index_maps=index_maps,
@@ -109,30 +133,51 @@ class GameScoringDriver:
         with self.timer.time("score"):
             raw_scores = model.score(dataset, p.task_type)
             scores = raw_scores + jnp.asarray(dataset.offsets)
-        with self.timer.time("write-scores"):
-            self._write_scores(dataset, np.asarray(scores))
+        from photon_ml_tpu.parallel.multihost import (
+            is_coordinator,
+            sync_processes,
+        )
+
+        if is_coordinator():
+            with self.timer.time("write-scores"):
+                self._write_scores(dataset, np.asarray(scores))
         if p.evaluator_types and p.has_response:
             with self.timer.time("evaluate"):
                 self._evaluate(dataset, scores)
-            with open(os.path.join(p.output_dir, "metrics.json"), "w") as f:
-                json.dump(self.metrics, f, indent=2)
+            if is_coordinator():
+                with open(
+                    os.path.join(p.output_dir, "metrics.json"), "w"
+                ) as f:
+                    json.dump(self.metrics, f, indent=2)
+        sync_processes("scores-written")
         self.logger.info("timers:\n%s", self.timer.summary())
 
     def _write_scores(self, dataset, scores: np.ndarray) -> None:
+        id_types = sorted(dataset.entity_indexes)
         records = []
         for i in range(dataset.num_real_rows):
+            meta = {
+                t: dataset.entity_indexes[t].ids[
+                    int(dataset.entity_codes[t][i])
+                ]
+                for t in id_types
+                if int(dataset.entity_codes[t][i]) >= 0
+            }
             records.append({
                 "uid": dataset.uids[i],
                 "label": float(dataset.labels[i]) if self.params.has_response else None,
                 "modelId": self.params.model_id or "game-model",
                 "predictionScore": float(scores[i]),
                 "weight": float(dataset.weights[i]),
-                "metadataMap": None,
+                "metadataMap": meta or None,
             })
-        write_container(
-            os.path.join(self.params.output_dir, "scores", "part-00000.avro"),
+        from photon_ml_tpu.game.model_io import _write_parts
+
+        _write_parts(
+            os.path.join(self.params.output_dir, "scores"),
             schemas.SCORING_RESULT_AVRO,
             records,
+            self.params.num_files,
         )
 
     def _evaluate(self, dataset, scores) -> None:
@@ -164,12 +209,19 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--task-type", default="LOGISTIC_REGRESSION")
     ap.add_argument("--feature-shard-id-to-feature-section-keys-map", required=True)
     ap.add_argument("--evaluator-types", default=None)
-    ap.add_argument("--model-id", default="")
+    ap.add_argument("--game-model-id", default=None)
+    ap.add_argument("--model-id", default=None, help="alias of --game-model-id")
     ap.add_argument("--has-response", default="true")
     ap.add_argument("--offheap-indexmap-dir", default=None)
     ap.add_argument("--offheap-indexmap-num-partitions", type=int, default=None)
     ap.add_argument("--feature-name-and-term-set-path", default=None)
     ap.add_argument("--feature-shard-id-to-intercept-map", default=None)
+    ap.add_argument("--date-range", default=None)
+    ap.add_argument("--date-range-days-ago", default=None)
+    ap.add_argument("--random-effect-id-set", default=None)
+    ap.add_argument("--num-files", type=int, default=1)
+    ap.add_argument("--delete-output-dir-if-exists", default="false")
+    ap.add_argument("--application-name", default=None)
     return ap
 
 
@@ -194,8 +246,21 @@ def params_from_args(argv=None) -> GameScoringParams:
             if ns.evaluator_types
             else []
         ),
-        model_id=ns.model_id,
+        model_id=ns.game_model_id or ns.model_id or "",
         has_response=str(ns.has_response).lower() in ("true", "1", "yes"),
+        date_range=ns.date_range,
+        date_range_days_ago=ns.date_range_days_ago,
+        random_effect_id_set=(
+            [s for s in ns.random_effect_id_set.split(",") if s]
+            if ns.random_effect_id_set
+            else []
+        ),
+        num_files=ns.num_files,
+        delete_output_dir_if_exists=(
+            str(ns.delete_output_dir_if_exists).lower()
+            in ("true", "1", "yes")
+        ),
+        application_name=ns.application_name or "photon-ml-tpu-game-scoring",
         offheap_indexmap_dir=ns.offheap_indexmap_dir,
         offheap_indexmap_num_partitions=ns.offheap_indexmap_num_partitions,
         feature_name_and_term_set_path=ns.feature_name_and_term_set_path,

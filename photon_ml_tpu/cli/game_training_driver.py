@@ -184,6 +184,11 @@ class GameTrainingParams:
     # (ModelOutputMode.scala, cli/game/training/Driver.scala:620-635);
     # BEST: best-model only; NONE: no model output.
     model_output_mode: str = "ALL"
+    # Split each random-effect coordinate's per-entity model records
+    # across N Avro part files (numberOfOutputFilesForRandomEffectModel,
+    # Params.scala:387-391); <=0 writes one file.
+    num_output_files_for_random_effect_model: int = 1
+    application_name: str = "photon-ml-tpu-game-training"
     # Prebuilt per-shard partitioned feature-index stores (the reference's
     # offheap-indexmap-dir, prepareFeatureMaps at
     # cli/game/GAMEDriver.scala:89-97): a directory with one store
@@ -275,22 +280,9 @@ class GameTrainingDriver:
     # -- data --------------------------------------------------------------
 
     def _expand_dated(self, dirs, date_range, days_ago):
-        """IOUtils.getInputPathsWithinDateRange analog over the input-dir
-        list; identity when no range is configured."""
-        from photon_ml_tpu.utils.date_range import (
-            input_paths_within_date_range,
-            resolve_date_range,
-        )
+        from photon_ml_tpu.utils.date_range import expand_dated_paths
 
-        rng = resolve_date_range(date_range, days_ago)
-        if rng is None:
-            return list(dirs)
-        paths = input_paths_within_date_range(list(dirs), rng)
-        self.logger.info(
-            "date range %s expanded %d dir(s) to %d daily paths",
-            rng, len(list(dirs)), len(paths),
-        )
-        return paths
+        return expand_dated_paths(dirs, date_range, days_ago, self.logger)
 
     def _load_dataset(self, dirs: Sequence[str], index_maps=None) -> GameDataset:
         re_types = [
@@ -553,6 +545,7 @@ class GameTrainingDriver:
 
     def run(self) -> None:
         p = self.params
+        self.logger.info("application: %s", p.application_name)
         with self.timer.time("load-train"):
             dataset = self._load_dataset(
                 self._expand_dated(
@@ -755,6 +748,9 @@ class GameTrainingDriver:
                     best.best_model, dataset,
                     os.path.join(p.output_dir, "best-model"),
                     model_spec=spec,
+                    num_re_output_files=(
+                        p.num_output_files_for_random_effect_model
+                    ),
                 )
                 if p.model_output_mode == "ALL":
                     # every combo's final model under all/<original grid
@@ -768,6 +764,9 @@ class GameTrainingDriver:
                             model_spec="\n".join(
                                 f"{name} -> {cfg.render()}"
                                 for name, cfg in combo.items()
+                            ),
+                            num_re_output_files=(
+                                p.num_output_files_for_random_effect_model
                             ),
                         )
         with open(os.path.join(p.output_dir, "metrics.json"), "w") as f:
@@ -825,7 +824,19 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--offheap-indexmap-num-partitions", type=int, default=None)
     ap.add_argument("--compute-variance", default="false")
     ap.add_argument(
-        "--model-output-mode", default="ALL", choices=["ALL", "BEST", "NONE"],
+        "--model-output-mode", default=None, choices=["ALL", "BEST", "NONE"],
+    )
+    ap.add_argument(
+        "--save-models-to-hdfs", default=None,
+        help="DEPRECATED -- use --model-output-mode (true -> ALL)",
+    )
+    ap.add_argument(
+        "--num-output-files-for-random-effect-model", type=int, default=1,
+    )
+    ap.add_argument("--application-name", default=None)
+    ap.add_argument(
+        "--min-partitions-for-validation", type=int, default=None,
+        help="ignored (Spark-only)",
     )
     ap.add_argument("--delete-output-dir-if-exists", default="false")
     ap.add_argument(
@@ -844,6 +855,21 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "SIGTERM-safe stop and resume-from-latest on rerun",
     )
     return ap
+
+
+def _model_output_mode(ns) -> str:
+    """--model-output-mode, with the DEPRECATED --save-models-to-hdfs
+    boolean mapping to ALL/NONE (Params.scala:379-386); both together
+    conflict."""
+    if ns.save_models_to_hdfs is not None:
+        if ns.model_output_mode is not None:
+            raise ValueError(
+                "specifying both save-models-to-hdfs and model-output-mode "
+                "is not supported"
+            )
+        save = str(ns.save_models_to_hdfs).lower() in ("true", "1", "yes")
+        return "ALL" if save else "NONE"
+    return ns.model_output_mode or "ALL"
 
 
 def params_from_args(argv=None) -> GameTrainingParams:
@@ -905,7 +931,13 @@ def params_from_args(argv=None) -> GameTrainingParams:
             else []
         ),
         compute_variance=_bool(ns.compute_variance),
-        model_output_mode=ns.model_output_mode,
+        model_output_mode=_model_output_mode(ns),
+        num_output_files_for_random_effect_model=(
+            ns.num_output_files_for_random_effect_model
+        ),
+        application_name=(
+            ns.application_name or "photon-ml-tpu-game-training"
+        ),
         offheap_indexmap_dir=ns.offheap_indexmap_dir,
         offheap_indexmap_num_partitions=ns.offheap_indexmap_num_partitions,
         delete_output_dir_if_exists=_bool(ns.delete_output_dir_if_exists),

@@ -56,12 +56,27 @@ def _write_lines(path: str, lines: List[str]) -> None:
         f.write("\n".join(lines) + "\n")
 
 
+def _write_parts(base: str, schema, records, num_files: int) -> None:
+    """Split records across ``num_files`` container part files
+    (numberOfOutputFilesForRandomEffectModel,
+    avro/model/ModelProcessingUtils.scala save path; <=0 means one
+    file). The loader reads the whole directory, so the split is
+    transparent on read."""
+    n = max(1, num_files)
+    chunks = [records[i::n] for i in range(n)] if n > 1 else [records]
+    for i, chunk in enumerate(chunks):
+        write_container(
+            os.path.join(base, f"part-{i:05d}.avro"), schema, chunk
+        )
+
+
 def save_game_model(
     model: GameModel,
     dataset: GameDataset,
     out_dir: str,
     *,
     model_spec: Optional[str] = None,
+    num_re_output_files: int = 1,
 ) -> None:
     os.makedirs(out_dir, exist_ok=True)
     if model_spec:
@@ -109,10 +124,11 @@ def save_game_model(
                     "variances": None,
                     "lossFunction": None,
                 })
-            write_container(
-                os.path.join(base, COEFFICIENTS, "part-00000.avro"),
+            _write_parts(
+                os.path.join(base, COEFFICIENTS),
                 schemas.BAYESIAN_LINEAR_MODEL_AVRO,
                 records,
+                num_re_output_files,
             )
         elif isinstance(sub, MatrixFactorizationModel):
             base = os.path.join(out_dir, MATRIX_FACTORIZATION, name)

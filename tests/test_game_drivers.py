@@ -323,3 +323,67 @@ class TestYahooMusicInterop:
         assert hist[-1] <= hist[0]
         rmse = metrics["validation_history"][-1]["RMSE"]
         assert rmse < 1.4, metrics["validation_history"]
+
+
+class TestScoringOptionParity:
+    def test_score_output_ids_num_files_and_model_id(self, tmp_path, rng):
+        """random-effect-id-set ids ride along in metadataMap, --num-files
+        splits the output, --game-model-id stamps every record
+        (cli/game/scoring/Driver.scala:42,152; Params numOutputFilesForScores)."""
+        train = tmp_path / "train"; train.mkdir()
+        write_game_avro(str(train / "p0.avro"), rng, n=200)
+        tparams = GameTrainingParams(
+            train_input_dirs=[str(train)],
+            output_dir=str(tmp_path / "out"),
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            feature_shards=[
+                FeatureShardConfiguration("g", ["features"]),
+                FeatureShardConfiguration("u", ["userFeatures"]),
+            ],
+            fixed_effect_data_configs={
+                "global": FixedEffectDataConfiguration("g")
+            },
+            fixed_effect_opt_configs={"global": "10,1e-6,0.1,1,LBFGS,L2"},
+            random_effect_data_configs={
+                "per-user": RandomEffectDataConfiguration("userId", "u")
+            },
+            random_effect_opt_configs={"per-user": "10,1e-6,1.0,1,LBFGS,L2"},
+            num_iterations=1,
+            num_output_files_for_random_effect_model=3,
+        )
+        GameTrainingDriver(tparams).run()
+        model_dir = os.path.join(tparams.output_dir, "best-model")
+        # RE coefficients split across 3 part files, loadable as one model
+        parts = os.listdir(
+            os.path.join(model_dir, "random-effect", "per-user", "coefficients")
+        )
+        assert sorted(parts) == [
+            "part-00000.avro", "part-00001.avro", "part-00002.avro"
+        ]
+        model = load_game_model(model_dir)
+        _, _, per_entity = model.random_effects["per-user"]
+        assert len(per_entity) == 8  # all users survive the split
+
+        from photon_ml_tpu.cli.game_scoring_driver import params_from_args
+
+        sp = params_from_args([
+            "--input-data-dirs", str(train),
+            "--game-model-input-dir", model_dir,
+            "--output-dir", str(tmp_path / "scores"),
+            "--feature-shard-id-to-feature-section-keys-map",
+            "g:features|u:userFeatures",
+            "--game-model-id", "my-model-7",
+            "--random-effect-id-set", "userId",
+            "--num-files", "2",
+        ])
+        GameScoringDriver(sp).run()
+        score_dir = tmp_path / "scores" / "scores"
+        assert sorted(os.listdir(score_dir)) == [
+            "part-00000.avro", "part-00001.avro"
+        ]
+        recs = list(read_avro_records(str(score_dir)))
+        assert len(recs) == 200
+        assert all(r["modelId"] == "my-model-7" for r in recs)
+        assert all(
+            r["metadataMap"]["userId"].startswith("user") for r in recs
+        )
