@@ -118,7 +118,7 @@ def main():
         "unit": "examples/sec/chip",
         "vs_baseline": round(examples_per_sec / ROUND1_EXAMPLES_PER_SEC, 2),
         "detail": {
-            "kernel": "tiled_pallas_bf16x2",
+            "kernel": "tiled_pallas_" + obj.mxu,
             "n": n,
             "nnz_per_row": k,
             "dim": d,

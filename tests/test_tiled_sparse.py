@@ -244,3 +244,38 @@ class TestEmptyWindows:
         v, g = tobj.value_and_gradient(w, tb, 0.0)
         assert float(v) == 0.0
         assert np.all(np.asarray(g) == 0.0)
+
+
+class TestWideMxuVariant:
+    """mxu="bf16x2w": fused full-width matmuls must match the scatter
+    oracle and the two-matmul bf16x2 variant."""
+
+    def test_matches_oracle_and_bf16x2(self, rng):
+        from photon_ml_tpu.data.batch import SparseBatch
+
+        n, k, d = 96, 6, 130
+        indices = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        values = rng.normal(size=(n, k)).astype(np.float32)
+        labels = (rng.uniform(size=n) > 0.5).astype(np.float32)
+        batch = SparseBatch(
+            indices=jnp.asarray(indices), values=jnp.asarray(values),
+            labels=jnp.asarray(labels), offsets=jnp.zeros(n),
+            weights=jnp.ones(n),
+        )
+        tb = tiled_batch_from_sparse(batch, d, params=PARAMS)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32) * 0.5)
+        oobj = GLMObjective(LOGISTIC, d)
+        v0, g0 = oobj.value_and_gradient(w, batch, 0.1)
+        for mxu in ("bf16x2", "bf16x2w"):
+            tobj = TiledGLMObjective(LOGISTIC, d, interpret=True, mxu=mxu)
+            v1, g1 = tobj.value_and_gradient(w, tb, 0.1)
+            assert abs(float(v1 - v0)) / abs(float(v0)) < 1e-4
+            assert (
+                float(jnp.linalg.norm(g1 - g0) / jnp.linalg.norm(g0)) < 1e-4
+            )
+            hv0 = oobj.hessian_vector(w, w * 0.3, batch, 0.1)
+            hv1 = tobj.hessian_vector(w, w * 0.3, tb, 0.1)
+            assert (
+                float(jnp.linalg.norm(hv1 - hv0) / jnp.linalg.norm(hv0))
+                < 1e-4
+            )

@@ -38,7 +38,7 @@ sb = SparseBatch(indices=jnp.asarray(indices.astype(np.int32)),
                  offsets=jnp.asarray(offsets), weights=jnp.asarray(weights))
 oobj = GLMObjective(LOGISTIC, d)
 w = jnp.asarray(rng.normal(size=d).astype(np.float32) * 0.01)
-for mxu, tol in (("highest", 1e-4), ("bf16x2", 1e-3)):
+for mxu, tol in (("highest", 1e-4), ("bf16x2", 1e-3), ("bf16x2w", 1e-3)):
     tobj = TiledGLMObjective(LOGISTIC, d, mxu=mxu)
     v1, g1 = jax.jit(tobj.value_and_gradient)(w, tb, 0.1)
     v2, g2 = jax.jit(oobj.value_and_gradient)(w, sb, 0.1)
