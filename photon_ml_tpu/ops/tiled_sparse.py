@@ -539,6 +539,10 @@ class TiledGLMObjective:
     def __post_init__(self):
         if self.norm is None:
             object.__setattr__(self, "norm", identity_context())
+        if self.mxu not in ("bf16x2w", "bf16x2", "highest"):
+            # a typo must not silently fall through to the "highest"
+            # branch (2.5x slower, different numerics)
+            raise ValueError(f"unknown mxu variant {self.mxu!r}")
 
     def _psum(self, x):
         if self.axis_name is None:
