@@ -27,6 +27,7 @@ from photon_ml_tpu.diagnostics.reporting import (
     Table,
     Text,
     write_html_report,
+    write_text_report,
 )
 from photon_ml_tpu.task import TaskType
 
@@ -196,4 +197,8 @@ def run_glm_diagnostics(driver) -> None:
 
     out = os.path.join(p.output_dir, "model-diagnostics", "report.html")
     write_html_report(doc, out)
+    # text render strategy alongside (reference reporting/text/**)
+    write_text_report(
+        doc, os.path.join(p.output_dir, "model-diagnostics", "report.txt")
+    )
     driver.logger.info("diagnostics report written to %s", out)

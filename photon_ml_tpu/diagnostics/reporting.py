@@ -173,3 +173,66 @@ def write_html_report(doc: Document, path: str) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "w", encoding="utf-8") as f:
         f.write(render_html(doc))
+
+
+def render_text(doc: Document) -> str:
+    """Plain-text render strategy (the reference's
+    diagnostics/reporting/text/** StringRenderStrategy analog): chapters
+    and sections as underlined headings, tables column-aligned, plots
+    summarized as their series' (min, max, last) since text cannot carry
+    an image."""
+    lines: List[str] = [doc.title, "=" * len(doc.title), ""]
+    for chapter in doc.chapters:
+        lines += [chapter.title, "-" * len(chapter.title), ""]
+        for section in chapter.sections:
+            lines += [f"## {section.title}", ""]
+            for item in section.items:
+                if isinstance(item, Text):
+                    lines += [item.body, ""]
+                elif isinstance(item, Table):
+                    # tolerate ragged rows like render_html does
+                    def cell(row, c):
+                        return str(row[c]) if c < len(row) else ""
+
+                    widths = [
+                        max(
+                            len(str(item.header[c])),
+                            *(len(cell(r, c)) for r in item.rows),
+                        )
+                        if item.rows
+                        else len(str(item.header[c]))
+                        for c in range(len(item.header))
+                    ]
+
+                    def fmt(row):
+                        return "  ".join(
+                            cell(row, c).ljust(w)
+                            for c, w in enumerate(widths)
+                        ).rstrip()
+
+                    if item.caption:
+                        lines.append(item.caption)
+                    lines.append(fmt(item.header))
+                    lines.append("  ".join("-" * w for w in widths))
+                    lines += [fmt(r) for r in item.rows]
+                    lines.append("")
+                elif isinstance(item, LinePlot):
+                    lines.append(f"[plot] {item.title or 'line plot'}")
+                    for name, ys in item.series:
+                        finite = [y for y in ys if y == y]  # NaN filter,
+                        # matching _svg_line_plot's guard
+                        if finite:
+                            lines.append(
+                                f"  {name}: min={min(finite):.6g} "
+                                f"max={max(finite):.6g} "
+                                f"last={finite[-1]:.6g} "
+                                f"({len(ys)} points)"
+                            )
+                    lines.append("")
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def write_text_report(doc: Document, path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(render_text(doc))

@@ -337,3 +337,54 @@ class TestEvents:
         assert len(seen) == 2
         assert isinstance(seen[0], TrainingStartEvent)
         em.close()
+
+
+class TestTextRenderStrategy:
+    def test_render_text(self):
+        from photon_ml_tpu.diagnostics.reporting import (
+            Chapter, Document, LinePlot, Section, Table, Text, render_text,
+        )
+
+        doc = Document("Report", [
+            Chapter("Model", [
+                Section("Summary", [
+                    Text("hello world"),
+                    Table(["name", "value"], [["auc", "0.91"], ["n", "120"]],
+                          caption="metrics"),
+                    LinePlot([1, 2, 3], [("loss", [3.0, 2.0, 1.5])],
+                             title="learning curve"),
+                ]),
+            ]),
+        ])
+        text = render_text(doc)
+        assert "Report" in text and "=====" in text
+        assert "## Summary" in text
+        assert "hello world" in text
+        assert "auc   0.91" in text
+        assert "[plot] learning curve" in text
+        assert "last=1.5" in text
+
+    def test_driver_writes_text_report(self, tmp_path, rng):
+        import os
+
+        from photon_ml_tpu.cli.glm_driver import DiagnosticMode, GLMDriver, GLMParams
+
+        train = tmp_path / "train"
+        train.mkdir()
+        import sys
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_glm_driver import synth_avro
+
+        synth_avro(str(train / "p.avro"), rng, n=120)
+        params = GLMParams(
+            train_dir=str(train),
+            output_dir=str(tmp_path / "out"),
+            regularization_weights=[1.0],
+            diagnostic_mode=DiagnosticMode.TRAIN,
+            distributed="off",
+        )
+        GLMDriver(params).run()
+        base = tmp_path / "out" / "model-diagnostics"
+        assert (base / "report.html").is_file()
+        txt = (base / "report.txt").read_text()
+        assert "=" in txt and "##" in txt
