@@ -190,18 +190,22 @@ def render_text(doc: Document) -> str:
                 if isinstance(item, Text):
                     lines += [item.body, ""]
                 elif isinstance(item, Table):
-                    # tolerate ragged rows like render_html does
+                    # tolerate ragged rows like render_html does — both
+                    # shorter AND longer than the header
                     def cell(row, c):
                         return str(row[c]) if c < len(row) else ""
 
+                    ncols = max(
+                        [len(item.header)] + [len(r) for r in item.rows]
+                    )
                     widths = [
                         max(
-                            len(str(item.header[c])),
+                            len(cell(item.header, c)),
                             *(len(cell(r, c)) for r in item.rows),
                         )
                         if item.rows
-                        else len(str(item.header[c]))
-                        for c in range(len(item.header))
+                        else len(cell(item.header, c))
+                        for c in range(ncols)
                     ]
 
                     def fmt(row):
