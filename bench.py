@@ -499,56 +499,25 @@ def _glmix_config(
 
 
 
-def _synth_mf_latent_buckets(rng, n_solve, n_other, K, s, other_latent, chunk):
-    """Latent-view buckets for one MF ALS half-step: each solved entity
-    has s ratings whose K dense features are the OTHER side's latent
-    vector (MatrixFactorizationCoordinate._latent_view layout)."""
-    from types import SimpleNamespace
-
-    from photon_ml_tpu.game.random_effect_data import RandomEffectBucket
-
-    buckets = []
-    w_true = rng.normal(0, 0.5, size=(n_solve, K)).astype(np.float32)
-    for start in range(0, n_solve, chunk):
-        e = min(chunk, n_solve - start)
-        partners = rng.integers(0, n_other, size=(e, s))
-        val = other_latent[partners]  # [e, s, K]
-        idx = np.tile(np.arange(K, dtype=np.int32)[None, None, :], (e, s, 1))
-        z = (val * w_true[start:start + e, None, :]).sum(axis=2)
-        labels = (z + 0.3 * rng.normal(size=(e, s))).astype(np.float32)
-        buckets.append(
-            RandomEffectBucket(
-                entity_codes=np.arange(start, start + e, dtype=np.int32),
-                row_index=np.full((e, s), -1, np.int32),
-                indices=idx,
-                values=val,
-                labels=labels,
-                offsets=np.zeros((e, s), np.float32),
-                weights=np.ones((e, s), np.float32),
-            )
-        )
-    return SimpleNamespace(buckets=buckets)
-
-
 def _mf_config(
     name,
     *,
     n_rows=138_493,
     n_cols=26_744,
     K=32,
-    s_row=64,
-    s_col=128,
-    chunk=25_000,
-    col_chunk=8_192,
+    n_ratings=2_000_000,
+    num_inner_iterations=1,
     seed=0,
 ):
-    """Matrix-factorization ALS at MovieLens-20M entity counts: one full
-    alternating step = row-factor half-step (all users) + col-factor
-    half-step (all items), each a bank of K-dim ridge solves over the
-    other side's latent features (BASELINE.json config 5's "+ MF" term;
-    ratings reservoir-capped per entity like RandomEffectDataSet)."""
+    """Matrix factorization through the REAL MatrixFactorizationCoordinate
+    at MovieLens-20M entity counts (ratings subsampled 10x to bound the
+    one-time host-side structure build): one update_model call = row +
+    col ALS half-steps including the on-device latent-view gathers. The
+    BASELINE.json config-5 "+ MF" term."""
     import jax.numpy as jnp
 
+    from photon_ml_tpu.game.coordinate import MatrixFactorizationCoordinate
+    from photon_ml_tpu.game.data import EntityIndex, GameDataset
     from photon_ml_tpu.game.random_effect import (
         RandomEffectOptimizationProblem,
     )
@@ -561,55 +530,71 @@ def _mf_config(
     )
 
     rng = np.random.default_rng(seed)
-    row_latent = rng.normal(0, 0.3, size=(n_rows, K)).astype(np.float32)
-    col_latent = rng.normal(0, 0.3, size=(n_cols, K)).astype(np.float32)
-    config = OptimizerConfig(
-        OptimizerType.LBFGS, max_iter=20, tolerance=1e-5, lbfgs_history=5
+    n = n_ratings
+    rows = rng.integers(0, n_rows, size=n).astype(np.int32)
+    cols = rng.integers(0, n_cols, size=n).astype(np.int32)
+    row_true = rng.normal(0, 0.4, size=(n_rows, K)).astype(np.float32)
+    col_true = rng.normal(0, 0.4, size=(n_cols, K)).astype(np.float32)
+    ratings = (
+        (row_true[rows] * col_true[cols]).sum(axis=1)
+        + 0.3 * rng.normal(size=n)
+    ).astype(np.float32)
+
+    def eindex(prefix, count):
+        ids = [f"{prefix}{i}" for i in range(count)]
+        return EntityIndex(prefix, ids, {v: i for i, v in enumerate(ids)})
+
+    dataset = GameDataset(
+        uids=[""] * n,
+        labels=ratings,
+        offsets=np.zeros(n, np.float32),
+        weights=np.ones(n, np.float32),
+        shards={},
+        entity_codes={"userId": rows, "itemId": cols},
+        entity_indexes={
+            "userId": eindex("u", n_rows), "itemId": eindex("i", n_cols)
+        },
+        num_real_rows=n,
     )
-    problem = RandomEffectOptimizationProblem(
-        loss=LINEAR,
-        config=config,
-        regularization=RegularizationContext(RegularizationType.L2),
-        reg_weight=1.0,
+    coord = MatrixFactorizationCoordinate(
+        name="mf",
+        dataset=dataset,
+        row_effect_type="userId",
+        col_effect_type="itemId",
+        num_latent_factors=K,
+        problem=RandomEffectOptimizationProblem(
+            loss=LINEAR,
+            config=OptimizerConfig(
+                OptimizerType.LBFGS, max_iter=20, tolerance=1e-5,
+                lbfgs_history=5,
+            ),
+            regularization=RegularizationContext(RegularizationType.L2),
+            reg_weight=1.0,
+        ),
+        num_inner_iterations=num_inner_iterations,
     )
-    halves = {}
-    for half, n_solve, n_other, s, other, chk in (
-        ("row", n_rows, n_cols, s_row, col_latent, chunk),
-        # the dual-space Newton materializes per-bucket Gram matrices
-        # [E, S, S]; the item side's larger S needs smaller buckets
-        ("col", n_cols, n_rows, s_col, row_latent, col_chunk),
-    ):
-        data = _synth_mf_latent_buckets(
-            rng, n_solve, n_other, K, s, other, chk
-        )
-        bank = jnp.zeros((n_solve, K), jnp.float32)
-        bank, _, _ = _re_bank_update(problem, bank, data)  # compile
-        bank = jnp.zeros((n_solve, K), jnp.float32)
-        bank, tracker, sec = _re_bank_update(problem, bank, data)
-        halves[half] = {
-            "entities": n_solve,
-            "ratings_capped_at": s,
-            "entities_per_sec": round(n_solve / sec),
-            "seconds": round(sec, 3),
-            "iterations_mean": round(tracker.iterations_mean, 2),
-        }
-    step_s = sum(h["seconds"] for h in halves.values())
+    model = coord.initialize_model()
+    t0 = time.perf_counter()
+    model, _ = coord.update_model(model)  # structure build + compile
+    _ = np.asarray(model.row_latent[0, 0])
+    first_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    model, _ = coord.update_model(model)  # warm: the per-CD-iteration cost
+    _ = np.asarray(model.row_latent[0, 0])
+    warm_s = time.perf_counter() - t0
     return {
         "config": name,
-        "metric": "als_solve_s",
-        "value": round(step_s, 3),
-        "unit": "s (row + col ALS half-step SOLVES, warm)",
+        "metric": "mf_als_step_s",
+        "value": round(warm_s, 3),
+        "unit": "s (one full ALS step through the MF coordinate, warm)",
         "detail": {
             "latent_factors": K,
             "total_latent_parameters": (n_rows + n_cols) * K,
-            "halves": halves,
-            "excludes": (
-                "latent-view rebuild + host->device upload: the "
-                "production MF coordinate re-materializes each side's "
-                "latent feature view from the other side's updated "
-                "factors every half-step, so those transfers are NOT "
-                "amortized there the way this warm solve measurement "
-                "amortizes them"
+            "ratings": n,
+            "first_step_s": round(first_s, 3),
+            "includes": (
+                "on-device latent-view gathers from the partner side's "
+                "current factors (structure cached, values_override path)"
             ),
             "data": (
                 "fixed-seed synthetic at MovieLens-20M entity counts "

@@ -355,15 +355,23 @@ class MatrixFactorizationCoordinate(Coordinate):
             jnp.asarray(rng.normal(0, 0.1, size=(C, K)).astype(np.float32)),
         )
 
-    def _als_side(
-        self,
-        solve_codes: np.ndarray,  # [n] entity codes of the side being solved
-        fixed_codes: np.ndarray,
-        fixed_latent: Array,  # [F, K]
-        bank: Array,  # [S, K] current factors of the solved side
-        offsets_np: np.ndarray,
-        num_solved: int,
-    ) -> Array:
+    def _side_structure(self, side: str, solve_codes, fixed_codes, num_solved):
+        """Static ALS half-step structure: entity grouping, bucket
+        membership and per-bucket latent GATHER plans. Depends only on
+        the dataset's entity codes, so it is built once per side and
+        cached — per half-step only the latent VALUES change, and those
+        are gathered on device (see _als_side).
+        """
+        cache = getattr(self, "_als_structure_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_als_structure_cache", cache)
+        hit = cache.get(side)
+        if hit is not None:
+            return hit
+
+        import jax.numpy as jnp
+
         from photon_ml_tpu.game.config import (
             ProjectorType,
             RandomEffectDataConfiguration,
@@ -374,20 +382,22 @@ class MatrixFactorizationCoordinate(Coordinate):
         )
 
         K = self.num_latent_factors
-        n = self.dataset.num_rows
-        real = (self.dataset.weights > 0) & (solve_codes >= 0) & (fixed_codes >= 0)
-        x = np.asarray(jnp.take(fixed_latent, jnp.maximum(jnp.asarray(fixed_codes), 0), axis=0))
-        x = np.where(real[:, None], x, 0.0).astype(np.float32)
-        row_ix = np.tile(np.arange(K, dtype=np.int32)[None, :], (n, 1))
+        real = (
+            (self.dataset.weights > 0)
+            & (solve_codes >= 0)
+            & (fixed_codes >= 0)
+        )
 
         rows_of = [[] for _ in range(num_solved)]
         for i in np.nonzero(real)[0]:
             rows_of[int(solve_codes[i])].append(int(i))
         counts = np.asarray([len(r) for r in rows_of])
         caps = np.asarray([
-            0 if c == 0 else 1 << int(np.ceil(np.log2(max(c, 1)))) for c in counts
+            0 if c == 0 else 1 << int(np.ceil(np.log2(max(c, 1))))
+            for c in counts
         ])
         buckets = []
+        gather_plans = []  # (partner_codes [E_b, S] device, ok [E_b, S] device)
         for S in sorted(set(c for c in caps if c > 0)):
             members = np.nonzero(caps == S)[0]
             E_b = len(members)
@@ -400,11 +410,23 @@ class MatrixFactorizationCoordinate(Coordinate):
             buckets.append(RandomEffectBucket(
                 entity_codes=members.astype(np.int32),
                 row_index=b_rows,
-                indices=np.tile(np.arange(K, dtype=np.int32)[None, None, :], (E_b, S, 1)),
-                values=np.where(ok[:, :, None], x[safe], 0.0),
+                indices=np.tile(
+                    np.arange(K, dtype=np.int32)[None, None, :], (E_b, S, 1)
+                ),
+                # zero-size placeholder: every update passes
+                # values_override (on-device gathers of the partner
+                # side's factors) and _bucket_device_args skips the
+                # stored values on that path, so nothing is pinned
+                values=np.zeros((E_b, S, 0), np.float32),
                 labels=np.where(ok, self.dataset.labels[safe], 0.0),
                 offsets=np.where(ok, self.dataset.offsets[safe], 0.0),
                 weights=np.where(ok, self.dataset.weights[safe], 0.0),
+            ))
+            gather_plans.append((
+                jnp.asarray(
+                    np.where(ok, fixed_codes[safe], 0).astype(np.int32)
+                ),
+                jnp.asarray(ok),
             ))
         view = RandomEffectDataset(
             config=RandomEffectDataConfiguration(
@@ -414,15 +436,52 @@ class MatrixFactorizationCoordinate(Coordinate):
             ),
             num_entities=num_solved,
             local_dim=K,
-            projection=np.tile(np.arange(K, dtype=np.int32)[None, :], (num_solved, 1)),
-            row_local_indices=row_ix,
-            row_local_values=x,
+            projection=np.tile(
+                np.arange(K, dtype=np.int32)[None, :], (num_solved, 1)
+            ),
+            # zero-length row-level placeholders: update_bank never
+            # reads them (scoring goes through
+            # MatrixFactorizationModel.score on the real dataset), and
+            # [n, K] zeros would pin ~0.5 GB host RAM per side for the
+            # coordinate's lifetime
+            row_local_indices=np.zeros((0, K), np.int32),
+            row_local_values=np.zeros((0, K), np.float32),
             row_entity_codes=np.where(real, solve_codes, -1).astype(np.int32),
             buckets=buckets,
             num_active_rows=int(counts.sum()),
             num_passive_rows=0,
         )
-        new_bank, _ = self.problem.update_bank(bank, view, residual_offsets=offsets_np)
+        cache[side] = (view, gather_plans)
+        return cache[side]
+
+    def _als_side(
+        self,
+        side: str,
+        solve_codes: np.ndarray,  # [n] entity codes of the side being solved
+        fixed_codes: np.ndarray,
+        fixed_latent: Array,  # [F, K]
+        bank: Array,  # [S, K] current factors of the solved side
+        offsets_np: np.ndarray,
+        num_solved: int,
+    ) -> Array:
+        import jax.numpy as jnp
+
+        view, gather_plans = self._side_structure(
+            side, solve_codes, fixed_codes, num_solved
+        )
+        # latent feature views gathered ON DEVICE from the partner side's
+        # current factors — no host round trip, no [E, S, K] re-upload.
+        # Deferred per bucket (callables): only the bucket being solved
+        # holds its gathered values in HBM.
+        values = [
+            (lambda codes=codes, ok=ok: jnp.where(
+                ok[..., None], jnp.take(fixed_latent, codes, axis=0), 0.0
+            ))
+            for codes, ok in gather_plans
+        ]
+        new_bank, _ = self.problem.update_bank(
+            bank, view, residual_offsets=offsets_np, values_override=values
+        )
         return new_bank
 
     def update_model(self, model, residual=None):
@@ -434,9 +493,17 @@ class MatrixFactorizationCoordinate(Coordinate):
         R = self.dataset.entity_indexes[self.row_effect_type].num_entities
         C = self.dataset.entity_indexes[self.col_effect_type].num_entities
         row_latent, col_latent = model.row_latent, model.col_latent
+        # With no residual the cached bucket offsets already hold the
+        # dataset offsets — passing residual_offsets would re-gather and
+        # re-upload [E, S] offsets per bucket every half-step for nothing
+        offsets_arg = None if residual is None else offsets_np
         for _ in range(self.num_inner_iterations):
-            row_latent = self._als_side(rows, cols, col_latent, row_latent, offsets_np, R)
-            col_latent = self._als_side(cols, rows, row_latent, col_latent, offsets_np, C)
+            row_latent = self._als_side(
+                "row", rows, cols, col_latent, row_latent, offsets_arg, R
+            )
+            col_latent = self._als_side(
+                "col", cols, rows, row_latent, col_latent, offsets_arg, C
+            )
         return replace(model, row_latent=row_latent, col_latent=col_latent), None
 
     def score(self, model: MatrixFactorizationModel) -> Array:

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -474,25 +474,31 @@ class RandomEffectOptimizationProblem:
             floats += e_b * s_b * s_b
         return floats * itemsize <= self.dense_bytes_budget
 
-    def _bucket_device_args(self, bucket) -> List[Array]:
+    def _bucket_device_args(self, bucket, with_values=True) -> List[Array]:
         """Device-resident (mesh-sharded if configured) static arrays for a
         bucket, transferred once and reused across update_bank calls. The
-        cache holds a weakref: device copies die with the bucket."""
+        cache holds a weakref: device copies die with the bucket.
+        ``with_values=False`` (the values_override path) skips uploading
+        the bucket's stored values — a caller that always overrides them
+        must not pin a dead [E, S, k] copy in HBM."""
         import weakref
 
-        key = id(bucket)
+        key = (id(bucket), with_values)
         hit = self._device_cache.get(key)
         if hit is not None and hit[0]() is bucket:
             return hit[1]
         arrs = [
             jnp.asarray(bucket.indices),
-            jnp.asarray(bucket.values),
+            jnp.asarray(bucket.values) if with_values else None,
             jnp.asarray(bucket.labels),
             jnp.asarray(bucket.weights),
             jnp.asarray(bucket.offsets),
         ]
         if self.mesh is not None:
-            arrs, _ = self._shard_entity_axis(arrs)
+            present = [a for a in arrs if a is not None]
+            present, _ = self._shard_entity_axis(present)
+            it = iter(present)
+            arrs = [next(it) if a is not None else None for a in arrs]
         # entity codes stay unsharded: they index the full bank host-side
         arrs = arrs + [jnp.asarray(bucket.entity_codes)]
         cache = self._device_cache
@@ -524,9 +530,16 @@ class RandomEffectOptimizationProblem:
         bank: Array,  # [E, D]
         dataset: RandomEffectDataset,
         residual_offsets: Optional[np.ndarray] = None,  # [n] replaces offsets
+        values_override: Optional[Sequence[Array]] = None,
     ) -> Tuple[Array, RandomEffectTracker]:
         """Solve every entity against its active data; returns the new bank
-        and an aggregated tracker."""
+        and an aggregated tracker.
+
+        ``values_override``: device-resident per-bucket feature values
+        (aligned with ``dataset.buckets``) replacing each bucket's stored
+        values — the MF ALS path recomputes latent feature views on
+        device every half-step while the bucket STRUCTURE stays cached.
+        """
         l1, l2 = self.regularization.split(self.reg_weight)
         l1_d, l2_d = jnp.float32(l1), jnp.float32(l2)
         # Per-bucket stat vectors [iter_sum, iter_max, *reason_counts] stay
@@ -542,10 +555,19 @@ class RandomEffectOptimizationProblem:
             # (in-place scatter per bucket) while the caller's reference
             # stays valid
             bank = jnp.array(bank, copy=True)
-        for bucket in dataset.buckets:
+        for bi, bucket in enumerate(dataset.buckets):
             ix_d, v_d, lab_d, w_d, off_d, codes_d = self._bucket_device_args(
-                bucket
+                bucket, with_values=values_override is None
             )
+            if values_override is not None:
+                # entries may be callables: the gather for bucket i is
+                # then dispatched only when its solve runs, capping the
+                # override's extra HBM at one bucket's values
+                v_d = values_override[bi]
+                if callable(v_d):
+                    v_d = v_d()
+                if self.mesh is not None:
+                    (v_d,), _ = self._shard_entity_axis([v_d])
             if residual_offsets is not None:
                 safe_rows = np.maximum(bucket.row_index, 0)
                 off = residual_offsets[safe_rows].astype(np.float32)
