@@ -97,6 +97,22 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _padded_width(k_max: int, pad_nnz_to: int) -> int:
+    """Shared nnz-padding policy for both shard builders — changing it in
+    one place keeps the record-at-a-time and native-columns paths
+    bit-identical (test_game_dataset_parity)."""
+    return max(_round_up(max(k_max, 1), pad_nnz_to), pad_nnz_to)
+
+
+def _shard_data(indices, values, imap: IndexMap, icept: int) -> ShardData:
+    return ShardData(
+        indices=indices,
+        values=values,
+        index_map=imap,
+        intercept_index=icept if icept >= 0 else None,
+    )
+
+
 def _pad_shard_rows(
     rows: Sequence[Tuple[List[int], List[float]]],
     n_pad: int,
@@ -104,21 +120,17 @@ def _pad_shard_rows(
     imap: IndexMap,
     icept: int,
 ) -> ShardData:
-    """Ragged (indices, values) rows -> padded ShardData (shared by the
-    record-at-a-time and native-columns builders)."""
+    """Ragged (indices, values) rows -> padded ShardData (the
+    record-at-a-time builder; the native-columns builder scatters into
+    its padded arrays directly but shares _padded_width/_shard_data)."""
     k_max = max([1] + [len(ix) for ix, _ in rows])
-    k = max(_round_up(k_max, pad_nnz_to), pad_nnz_to)
+    k = _padded_width(k_max, pad_nnz_to)
     indices = np.zeros((n_pad, k), np.int32)
     values = np.zeros((n_pad, k), np.float32)
     for i, (ix, vs) in enumerate(rows):
         indices[i, : len(ix)] = ix
         values[i, : len(vs)] = vs
-    return ShardData(
-        indices=indices,
-        values=values,
-        index_map=imap,
-        intercept_index=icept if icept >= 0 else None,
-    )
+    return _shard_data(indices, values, imap, icept)
 
 
 def _build_entity_tables(
@@ -448,8 +460,16 @@ def build_game_dataset_from_files(
     for cfg in shard_configs:
         imap = imaps[cfg.shard_id]
         icept = imap.get_index(intercept_key()) if cfg.add_intercept else -1
-        rows: List[Tuple[List[int], List[float]]] = []
+        # Fully vectorized assembly (a per-record python loop here cost
+        # ~30us/row): per file, remap each bag's interned keys, filter
+        # dropped (-1) features, stable-sort entries by global row (bag
+        # order preserved within a row), then scatter every entry into
+        # the padded [n_pad, k] arrays with one flat assignment.
+        per_file = []  # (row_of_entry_global, gix, values) kept entries
+        counts = np.zeros(n_pad, np.int64)
+        row0 = 0
         for (cols, _, _, _), bags in zip(decoded, bag_cache):
+            m = cols.num_records
             # remap table restricted to intern ids this config's bags
             # actually reference (the full string table also holds uids
             # and entity ids — potentially one per row)
@@ -462,29 +482,56 @@ def build_game_dataset_from_files(
             table = np.full(len(cols.strings), -1, dtype=np.int64)
             for j in used:
                 table[j] = imap.get_index(cols.strings[j])
-            per_bag = []
+            rows_parts, gix_parts, val_parts = [], [], []
             for bag in cfg.feature_bags:
                 row_ptr, key_ids, values = bags[bag]
-                gix = (
-                    table[key_ids] if len(key_ids) else np.zeros(0, np.int64)
+                if not len(key_ids):
+                    continue
+                gix = table[key_ids]
+                keep = gix >= 0
+                ent_rows = np.repeat(
+                    np.arange(m, dtype=np.int64), np.diff(row_ptr)
                 )
-                per_bag.append((row_ptr, gix, values))
-            for i in range(cols.num_records):
-                ix: List[int] = []
-                vs: List[float] = []
-                for row_ptr, gix, values in per_bag:
-                    lo, hi = int(row_ptr[i]), int(row_ptr[i + 1])
-                    g = gix[lo:hi]
-                    keep = g >= 0
-                    ix.extend(g[keep].tolist())
-                    vs.extend(values[lo:hi][keep].tolist())
-                if icept >= 0:
-                    ix.append(icept)
-                    vs.append(1.0)
-                rows.append((ix, vs))
-        shards[cfg.shard_id] = _pad_shard_rows(
-            rows, n_pad, pad_nnz_to, imap, icept
-        )
+                rows_parts.append(ent_rows[keep])
+                gix_parts.append(gix[keep])
+                val_parts.append(values[keep])
+            if rows_parts:
+                r = np.concatenate(rows_parts)
+                g = np.concatenate(gix_parts)
+                v = np.concatenate(val_parts)
+                # stable: equal rows keep bag-concat order, matching the
+                # record-at-a-time builder's per-row bag traversal
+                order = np.argsort(r, kind="stable")
+                r, g, v = r[order], g[order], v[order]
+            else:
+                r = np.zeros(0, np.int64)
+                g = np.zeros(0, np.int64)
+                v = np.zeros(0, np.float32)
+            counts[row0:row0 + m] = np.bincount(r, minlength=m)
+            per_file.append((r + row0, g, v))
+            row0 += m
+        if icept >= 0:
+            counts[:n] += 1  # intercept slot per real row
+        k_max = int(counts.max()) if counts.size else 1
+        k = _padded_width(k_max, pad_nnz_to)
+        indices = np.zeros((n_pad, k), np.int32)
+        values_arr = np.zeros((n_pad, k), np.float32)
+        for r, g, v in per_file:
+            if not len(r):
+                continue
+            # within-row positions: entries are row-sorted, so positions
+            # are arange minus each row's start offset
+            starts = np.searchsorted(r, r)  # first occurrence index per entry
+            intra = np.arange(len(r)) - starts
+            flat = r * k + intra
+            indices.flat[flat] = g
+            values_arr.flat[flat] = v
+        if icept >= 0:
+            rows_real = np.arange(n, dtype=np.int64)
+            flat_i = rows_real * k + (counts[:n] - 1)
+            indices.flat[flat_i] = icept
+            values_arr.flat[flat_i] = 1.0
+        shards[cfg.shard_id] = _shard_data(indices, values_arr, imap, icept)
 
     entity_indexes, entity_codes = _build_entity_tables(
         random_effect_types, raw_entity, n_pad
