@@ -90,7 +90,9 @@ class FixedEffectCoordinate(Coordinate):
     def _batch(self, residual: Optional[Array]) -> SparseBatch:
         offsets = self.dataset.offsets
         if residual is not None:
-            offsets = offsets + np.asarray(residual)
+            # residual algebra stays on device (SURVEY §7.9: KeyValueScore
+            # is a device-resident [n] array; no host round trip)
+            offsets = jnp.asarray(offsets) + residual
         return self.dataset.batch_for_shard(self.feature_shard_id, offsets)
 
     def update_model(self, model, residual=None):
@@ -123,9 +125,9 @@ class FixedEffectCoordinate(Coordinate):
     def regularization_term(self, model: FixedEffectModel) -> float:
         l1, l2 = self.problem.regularization.split(self.reg_weight)
         w = model.model.means
-        term = 0.5 * l2 * float(jnp.vdot(w, w))
+        term = 0.5 * l2 * float(jax.device_get(jnp.vdot(w, w)))
         if l1:
-            term += l1 * float(jnp.sum(jnp.abs(w)))
+            term += l1 * float(jax.device_get(jnp.sum(jnp.abs(w))))
         return term
 
 
@@ -153,7 +155,7 @@ class RandomEffectCoordinate(Coordinate):
     def update_model(self, model, residual=None):
         offsets = self.dataset.offsets
         if residual is not None:
-            offsets = offsets + np.asarray(residual)
+            offsets = jnp.asarray(offsets) + residual  # device-resident
         bank, tracker = self.problem.update_bank(
             model.bank, self.re_dataset, residual_offsets=offsets
         )
@@ -205,15 +207,16 @@ class FactoredRandomEffectCoordinate(Coordinate):
     def _latent_rows(self, projection: Array) -> Tuple[Array, Array]:
         """Project every row into latent space: dense [n, L] values with
         identity local indices."""
-        ix = jnp.asarray(self.re_dataset.row_local_indices)
-        v = jnp.asarray(self.re_dataset.row_local_values)
+        from photon_ml_tpu.game.random_effect import device_row_view
+
+        _, _, ix, v = device_row_view(self.re_dataset)
         # x_lat = sum_s v_s * B[ix_s]  -> [n, L]
         return jnp.einsum("nk,nkl->nl", v, jnp.take(projection, ix, axis=0))
 
     def update_model(self, model, residual=None):
         offsets_np = self.dataset.offsets
         if residual is not None:
-            offsets_np = offsets_np + np.asarray(residual)
+            offsets_np = jnp.asarray(offsets_np) + residual
         bank = model.bank
         projection = model.projection
         L = self.config.latent_space_dimension
@@ -235,17 +238,16 @@ class FactoredRandomEffectCoordinate(Coordinate):
     def _update_projection(
         self, bank: Array, projection: Array, offsets_np: np.ndarray
     ) -> Array:
+        from photon_ml_tpu.game.random_effect import device_row_view
+
         d = self.re_dataset.local_dim
         L = self.config.latent_space_dimension
-        ix = jnp.asarray(self.re_dataset.row_local_indices)  # [n, k]
-        v = jnp.asarray(self.re_dataset.row_local_values)
-        codes = jnp.maximum(jnp.asarray(self.re_dataset.row_entity_codes), 0)
+        codes, valid, ix, v = device_row_view(self.re_dataset)
         w_rows = jnp.take(bank, codes, axis=0)  # [n, L]
         n, k = ix.shape
         # flattened sparse features: index (j*L + l), value v_s * w_l
         flat_ix = (ix[:, :, None] * L + jnp.arange(L)[None, None, :]).reshape(n, k * L)
         flat_v = (v[:, :, None] * w_rows[:, None, :]).reshape(n, k * L)
-        valid = jnp.asarray(self.re_dataset.row_entity_codes >= 0)
         batch = SparseBatch(
             indices=flat_ix.astype(jnp.int32),
             values=jnp.where(valid[:, None], flat_v, 0.0),
@@ -261,9 +263,10 @@ class FactoredRandomEffectCoordinate(Coordinate):
         return coefficients.means.reshape(d, L)
 
     def score(self, model) -> Array:
+        from photon_ml_tpu.game.random_effect import device_row_view
+
         x_lat = self._latent_rows(model.projection)  # [n, L]
-        codes = jnp.maximum(jnp.asarray(self.re_dataset.row_entity_codes), 0)
-        valid = jnp.asarray(self.re_dataset.row_entity_codes >= 0)
+        codes, valid, _, _ = device_row_view(self.re_dataset)
         w_rows = jnp.take(model.bank, codes, axis=0)
         return jnp.where(valid, jnp.sum(x_lat * w_rows, axis=-1), 0.0)
 
@@ -283,11 +286,10 @@ class FactoredRandomEffectModel(DatumScoringModel):
     feature_shard_id: str
 
     def score(self, dataset: GameDataset) -> Array:
-        ix = jnp.asarray(self.re_dataset.row_local_indices)
-        v = jnp.asarray(self.re_dataset.row_local_values)
+        from photon_ml_tpu.game.random_effect import device_row_view
+
+        codes, valid, ix, v = device_row_view(self.re_dataset)
         x_lat = jnp.einsum("nk,nkl->nl", v, jnp.take(self.projection, ix, axis=0))
-        codes = jnp.maximum(jnp.asarray(self.re_dataset.row_entity_codes), 0)
-        valid = jnp.asarray(self.re_dataset.row_entity_codes >= 0)
         w_rows = jnp.take(self.bank, codes, axis=0)
         return jnp.where(valid, jnp.sum(x_lat * w_rows, axis=-1), 0.0)
 
@@ -496,7 +498,7 @@ class MatrixFactorizationCoordinate(Coordinate):
     def update_model(self, model, residual=None):
         offsets_np = self.dataset.offsets
         if residual is not None:
-            offsets_np = offsets_np + np.asarray(residual)
+            offsets_np = jnp.asarray(offsets_np) + residual
         rows = self.dataset.entity_codes[self.row_effect_type]
         cols = self.dataset.entity_codes[self.col_effect_type]
         R = self.dataset.entity_indexes[self.row_effect_type].num_entities

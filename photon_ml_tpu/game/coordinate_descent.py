@@ -101,11 +101,21 @@ class CoordinateDescent:
     def _objective(self, total_score: Array, models: Dict[str, object]) -> float:
         """loss(sum of scores + offsets) + sum of reg terms
         (CoordinateDescent.scala:196-243)."""
+        import jax
+
         loss = loss_for_task(self.task)
-        z = total_score + jnp.asarray(self.dataset.offsets)
-        lab = jnp.asarray(self.dataset.labels)
-        w = jnp.asarray(self.dataset.weights)
-        value = float(jnp.sum(w * loss.value(z, lab)))
+        cached = self.__dict__.get("_device_cols")
+        if cached is None:
+            cached = (
+                jnp.asarray(self.dataset.offsets),
+                jnp.asarray(self.dataset.labels),
+                jnp.asarray(self.dataset.weights),
+            )
+            self._device_cols = cached
+        off, lab, w = cached
+        z = total_score + off
+        # explicit single readback per iteration (transfer-guard safe)
+        value = float(jax.device_get(jnp.sum(w * loss.value(z, lab))))
         for name, coord in self.coordinates.items():
             value += coord.regularization_term(models[name])
         return value

@@ -82,14 +82,33 @@ class GameDataset:
     ) -> SparseBatch:
         """SparseBatch view of one shard (GameDatum.
         generateLabeledPointWithFeatureShardId analog); ``offsets``
-        overrides stored offsets (the residual-score path)."""
-        sd = self.shards[shard_id]
+        overrides stored offsets (the residual-score path).
+
+        Device copies of the static columns are cached per shard: the
+        coordinate-descent loop calls this every iteration and must not
+        re-upload the feature table each time (device-resident
+        KeyValueScore design, SURVEY §7.9) — only the offsets vector
+        varies, and the residual path passes it as an already-on-device
+        array."""
+        cache = self.__dict__.setdefault("_device_cache", {})
+        hit = cache.get(shard_id)
+        if hit is None:
+            sd = self.shards[shard_id]
+            hit = (
+                jnp.asarray(sd.indices),
+                jnp.asarray(sd.values),
+                jnp.asarray(self.labels),
+                jnp.asarray(self.offsets),
+                jnp.asarray(self.weights),
+            )
+            cache[shard_id] = hit
+        ix, v, lab, base_off, w = hit
         return SparseBatch(
-            indices=jnp.asarray(sd.indices),
-            values=jnp.asarray(sd.values),
-            labels=jnp.asarray(self.labels),
-            offsets=jnp.asarray(self.offsets if offsets is None else offsets),
-            weights=jnp.asarray(self.weights),
+            indices=ix,
+            values=v,
+            labels=lab,
+            offsets=base_off if offsets is None else jnp.asarray(offsets),
+            weights=w,
         )
 
 

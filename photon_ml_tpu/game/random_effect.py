@@ -374,7 +374,9 @@ def _bucket_solver(
         copy would double peak bank memory and add a ~4 GB HBM pass per
         bucket. update_bank defensively copies the caller's bank ONCE
         before the bucket chain so outside references stay valid."""
-        donate = (0,) if jax.default_backend() != "cpu" else ()
+        from photon_ml_tpu.utils.backend import effective_platform
+
+        donate = (0,) if effective_platform() != "cpu" else ()
 
         @partial(jax.jit, donate_argnums=donate)
         def fused(bank_full, codes, ix, v, lab, off, w, l1, l2):
@@ -493,6 +495,7 @@ class RandomEffectOptimizationProblem:
             jnp.asarray(bucket.labels),
             jnp.asarray(bucket.weights),
             jnp.asarray(bucket.offsets),
+            jnp.asarray(bucket.row_index),
         ]
         if self.mesh is not None:
             present = [a for a in arrs if a is not None]
@@ -529,7 +532,7 @@ class RandomEffectOptimizationProblem:
         self,
         bank: Array,  # [E, D]
         dataset: RandomEffectDataset,
-        residual_offsets: Optional[np.ndarray] = None,  # [n] replaces offsets
+        residual_offsets: Optional[Array] = None,  # [n] replaces offsets
         values_override: Optional[Sequence[Array]] = None,
     ) -> Tuple[Array, RandomEffectTracker]:
         """Solve every entity against its active data; returns the new bank
@@ -555,8 +558,18 @@ class RandomEffectOptimizationProblem:
             # (in-place scatter per bucket) while the caller's reference
             # stays valid
             bank = jnp.array(bank, copy=True)
+        if residual_offsets is not None:
+            residual_offsets = jnp.asarray(residual_offsets, jnp.float32)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                residual_offsets = jax.device_put(
+                    residual_offsets, NamedSharding(self.mesh, P())
+                )
         for bi, bucket in enumerate(dataset.buckets):
-            ix_d, v_d, lab_d, w_d, off_d, codes_d = self._bucket_device_args(
+            (
+                ix_d, v_d, lab_d, w_d, off_d, rows_d, codes_d,
+            ) = self._bucket_device_args(
                 bucket, with_values=values_override is None
             )
             if values_override is not None:
@@ -569,12 +582,14 @@ class RandomEffectOptimizationProblem:
                 if self.mesh is not None:
                     (v_d,), _ = self._shard_entity_axis([v_d])
             if residual_offsets is not None:
-                safe_rows = np.maximum(bucket.row_index, 0)
-                off = residual_offsets[safe_rows].astype(np.float32)
-                off = np.where(bucket.row_index >= 0, off, 0.0)
-                off_d = jnp.asarray(off)
-                if self.mesh is not None:
-                    (off_d,), _ = self._shard_entity_axis([off_d])
+                # device-side gather of per-row residual offsets — the
+                # KeyValueScore residual currency never leaves the device
+                # (SURVEY §7.9; round 2 gathered on host per bucket)
+                off_d = jnp.where(
+                    rows_d >= 0,
+                    residual_offsets[jnp.maximum(rows_d, 0)],
+                    0.0,
+                )
             n_real = bucket.num_entities
             use_dense = self._use_dense(bucket, bank.shape[1])
             kind = (
@@ -610,7 +625,8 @@ class RandomEffectOptimizationProblem:
                 jnp.concatenate([jnp.stack([it_sum, it_max]), counts])
             )
         if stat_vecs:
-            all_stats = np.asarray(jnp.stack(stat_vecs))  # ONE readback
+            # ONE explicit readback (transfer-guard safe)
+            all_stats = jax.device_get(jnp.stack(stat_vecs))
             total = sum(n_reals)
             iter_sum = int(all_stats[:, 0].sum())
             iter_max = int(all_stats[:, 1].max())
@@ -633,10 +649,27 @@ class RandomEffectOptimizationProblem:
     def regularization_term(self, bank: Array) -> float:
         """Sum of per-entity reg terms (Coordinate.regTerm analog)."""
         l1, l2 = self.regularization.split(self.reg_weight)
-        term = 0.5 * l2 * float(jnp.sum(bank * bank))
+        term = 0.5 * l2 * float(jax.device_get(jnp.sum(bank * bank)))
         if l1:
-            term += l1 * float(jnp.sum(jnp.abs(bank)))
+            term += l1 * float(jax.device_get(jnp.sum(jnp.abs(bank))))
         return term
+
+
+def device_row_view(dataset: RandomEffectDataset):
+    """Cached device copies of the row-aligned arrays (codes clamped,
+    valid mask, local indices, local values). Scoring runs once per
+    coordinate per CD iteration; without the cache every call re-uploads
+    the whole [n, k] table (the round-2 per-iteration PCIe leak)."""
+    hit = dataset.__dict__.get("_device_rows")
+    if hit is None:
+        hit = (
+            jnp.maximum(jnp.asarray(dataset.row_entity_codes), 0),
+            jnp.asarray(dataset.row_entity_codes >= 0),
+            jnp.asarray(dataset.row_local_indices),
+            jnp.asarray(dataset.row_local_values),
+        )
+        dataset.__dict__["_device_rows"] = hit
+    return hit
 
 
 def score_random_effect(
@@ -649,11 +682,8 @@ def score_random_effect(
     features is equivalent to the reference's back-projected model scoring:
     features unseen in the entity's active data have zero coefficients,
     RandomEffectCoordinate.scala:178-199)."""
-    codes = jnp.maximum(jnp.asarray(dataset.row_entity_codes), 0)
-    valid = jnp.asarray(dataset.row_entity_codes >= 0)
+    codes, valid, ix, v = device_row_view(dataset)
     w_rows = jnp.take(bank, codes, axis=0)  # [n, D]
-    ix = jnp.asarray(dataset.row_local_indices)
-    v = jnp.asarray(dataset.row_local_values)
     score = jnp.sum(v * jnp.take_along_axis(w_rows, ix, axis=1), axis=-1)
     return jnp.where(valid, score, 0.0)
 

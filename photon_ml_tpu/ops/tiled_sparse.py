@@ -82,6 +82,263 @@ class _Schedule(NamedTuple):
         return self.step_out.shape[0]
 
 
+import threading as _threading
+
+_TILE_LIB_LOCK = _threading.Lock()
+_tile_lib_handle = None  # None = untried, False = unavailable
+
+
+def _tile_lib():
+    """ctypes handle to native/tile_schedule.cpp (compiled on demand like
+    io/native_avro.py); False when the toolchain/library is unavailable —
+    callers fall back to the numpy builder."""
+    global _tile_lib_handle
+    if _tile_lib_handle is not None:
+        return _tile_lib_handle
+    import ctypes
+    import os
+    import subprocess
+
+    with _TILE_LIB_LOCK:
+        if _tile_lib_handle is not None:
+            return _tile_lib_handle
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        src = os.path.join(root, "native", "tile_schedule.cpp")
+        lib_dir = os.path.join(root, "native", "build")
+        lib_path = os.path.join(lib_dir, "libtile_schedule.so")
+        try:
+            if not (
+                os.path.isfile(lib_path)
+                and os.path.getmtime(lib_path) >= os.path.getmtime(src)
+            ):
+                os.makedirs(lib_dir, exist_ok=True)
+                # compile to a temp path + atomic rename so another
+                # process never dlopens a half-written .so
+                tmp_path = f"{lib_path}.{os.getpid()}.tmp"
+                subprocess.run(
+                    [
+                        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                        src, "-o", tmp_path,
+                    ],
+                    check=True,
+                    capture_output=True,
+                )
+                os.replace(tmp_path, lib_path)
+            lib = ctypes.CDLL(lib_path)
+            i64 = ctypes.c_int64
+            p_i64 = ctypes.POINTER(i64)
+            p_i32 = ctypes.POINTER(ctypes.c_int32)
+            p_f32 = ctypes.POINTER(ctypes.c_float)
+            lib.ts_step_count.restype = i64
+            lib.ts_step_count.argtypes = [p_i64, p_i64, i64, i64, i64, i64]
+            lib.ts_fill.restype = i64
+            lib.ts_fill.argtypes = [
+                p_i64, p_i64, p_f32, i64, i64, i64, i64, i64,
+                p_i32, p_i32, p_i32, p_i32, p_i32, p_f32,
+            ]
+            _tile_lib_handle = lib
+        except Exception:
+            _tile_lib_handle = False
+    return _tile_lib_handle
+
+
+def _build_schedule_native(
+    rows: np.ndarray,
+    feats: np.ndarray,
+    vals: np.ndarray,
+    *,
+    params: TileParams,
+    sort_by_feature_block: bool,
+    num_out_blocks: int,
+) -> Optional[Tuple[np.ndarray, ...]]:
+    """Counting-sort schedule build in C++ (~0.3 s vs ~4 s numpy at the ads
+    shape; ctypes releases the GIL, so the z/grad passes overlap for real).
+    Returns None when the native library is unavailable or the tile space
+    is too large for counting sort."""
+    lib = _tile_lib()
+    if not lib:
+        return None
+    import ctypes
+
+    if sort_by_feature_block:
+        oc, ic = feats, rows
+    else:
+        oc, ic = rows, feats
+    oc = np.ascontiguousarray(oc, dtype=np.int64)
+    ic = np.ascontiguousarray(ic, dtype=np.int64)
+    v = np.ascontiguousarray(vals, dtype=np.float32)
+    n = oc.shape[0]
+    L = params.chunk
+    win = params.window
+
+    def p(a, t):
+        return a.ctypes.data_as(ctypes.POINTER(t))
+
+    i64, i32, f32 = ctypes.c_int64, ctypes.c_int32, ctypes.c_float
+    G = lib.ts_step_count(
+        p(oc, i64), p(ic, i64), n, win, L, num_out_blocks
+    )
+    if G < 0:
+        return None
+    G8 = ((G + 7) // 8) * 8
+    step_out = np.zeros(G, np.int32)
+    step_in = np.zeros(G, np.int32)
+    step_init = np.zeros(G, np.int32)
+    o_pos = np.zeros((G8, L), np.int32)
+    i_pos = np.zeros((G8, L), np.int32)
+    sv = np.zeros((G8, L), np.float32)
+    rc = lib.ts_fill(
+        p(oc, i64), p(ic, i64), p(v, f32), n, win, L, num_out_blocks, G,
+        p(step_out, i32), p(step_in, i32), p(step_init, i32),
+        p(o_pos, i32), p(i_pos, i32), p(sv, f32),
+    )
+    if rc != 0:
+        return None
+    return step_out, step_in, step_init, o_pos, i_pos, sv
+
+
+def _build_schedule_np(
+    rows: np.ndarray,
+    feats: np.ndarray,
+    vals: np.ndarray,
+    *,
+    params: TileParams,
+    sort_by_feature_block: bool,
+    num_out_blocks: int,
+) -> Tuple[np.ndarray, ...]:
+    """Schedule build -> (step_out, step_in, step_init, o_pos, i_pos, sv)
+    numpy arrays. Tries the native counting-sort builder first; the numpy
+    path below is the fallback oracle (vectorized repeat/cumsum/scatter —
+    no per-entry Python loops; the round-2 loop version cost 17-77 s at the
+    ads shape, this is ~8 s, the native builder ~0.3 s)."""
+    native = _build_schedule_native(
+        rows, feats, vals, params=params,
+        sort_by_feature_block=sort_by_feature_block,
+        num_out_blocks=num_out_blocks,
+    )
+    if native is not None:
+        return native
+    win = params.window
+    L = params.chunk
+    # int32 entry coordinates when they fit (half the sort/gather traffic);
+    # feature ids can exceed int32 at the 10B-coefficient scale
+    if len(rows) and int(rows.max()) < 2**31 and int(feats.max()) < 2**31:
+        rows = rows.astype(np.int32, copy=False)
+        feats = feats.astype(np.int32, copy=False)
+    rb = rows // win
+    fb = feats // win
+    # Single combined-key stable argsort (numpy uses radix sort for ints —
+    # ~2x faster than the equivalent two-key lexsort at 16.7M entries).
+    if sort_by_feature_block:
+        key = fb.astype(np.int64) * (int(rb.max(initial=0)) + 1) + rb
+        order = np.argsort(key, kind="stable")
+        out_blocks, in_blocks = fb[order], rb[order]
+        out_pos, in_pos = feats[order] % win, rows[order] % win
+    else:
+        key = rb.astype(np.int64) * (int(fb.max(initial=0)) + 1) + fb
+        order = np.argsort(key, kind="stable")
+        out_blocks, in_blocks = rb[order], fb[order]
+        out_pos, in_pos = rows[order] % win, feats[order] % win
+    v = vals[order]
+    n_ent = len(v)
+
+    if n_ent:
+        # tile boundaries: chunk entries so no chunk crosses a tile
+        # boundary; the sort key IS the tile id, already ordered
+        tile_key = key[order]
+        tile_starts = np.nonzero(
+            np.concatenate([[True], tile_key[1:] != tile_key[:-1]])
+        )[0]
+        tile_ends = np.concatenate([tile_starts[1:], [n_ent]])
+        n_chunks = -(-(tile_ends - tile_starts) // L)  # chunks per tile
+        G_data = int(n_chunks.sum())
+        rep_start = np.repeat(tile_starts, n_chunks)
+        rep_end = np.repeat(tile_ends, n_chunks)
+        first = np.concatenate([[0], np.cumsum(n_chunks)[:-1]])
+        ordinal = np.arange(G_data) - np.repeat(first, n_chunks)
+        chunk_start = rep_start + ordinal * L
+        chunk_end = np.minimum(chunk_start + L, rep_end)
+        so_data = out_blocks[rep_start].astype(np.int32)
+        si_data = in_blocks[chunk_start].astype(np.int32)
+        sizes = chunk_end - chunk_start
+        entry_step = np.repeat(np.arange(G_data), sizes)
+        slot = np.arange(n_ent) - np.repeat(chunk_start, sizes)
+    else:
+        G_data = 0
+        so_data = np.zeros(0, np.int32)
+        si_data = np.zeros(0, np.int32)
+
+    # Every output block needs at least one step: the kernel only writes
+    # blocks named by step_out (out_ref starts as UNINITIALIZED memory on
+    # TPU — interpret mode zero-fills, hiding this), so an output window
+    # with no entries would otherwise return garbage. Append zero-entry
+    # init steps for the missing blocks; the stable sort below slots them
+    # into out-block order so VMEM accumulation stays monotone. Data steps
+    # are already out-block-sorted (entries were lexsorted by out block),
+    # so the stable merge preserves their entry order.
+    present = np.zeros(num_out_blocks, bool)
+    if G_data:
+        present[so_data] = True
+    missing = np.nonzero(~present)[0].astype(np.int32)
+
+    G = G_data + len(missing)
+    so_all = np.concatenate([so_data, missing])
+    si_all = np.concatenate([si_data, np.zeros(len(missing), np.int32)])
+    perm = np.argsort(so_all, kind="stable")
+    step_out = so_all[perm]
+    step_in = si_all[perm]
+    step_init = np.ones(G, np.int32)
+    step_init[1:] = (step_out[1:] != step_out[:-1]).astype(np.int32)
+
+    # pad the entry-row axis to a multiple of 8: the kernel reads entry
+    # rows in (8, L) blocks (sublane tiling); padded rows never execute
+    G8 = ((G + 7) // 8) * 8
+    o_pos = np.zeros((G8, L), np.int32)
+    i_pos = np.zeros((G8, L), np.int32)
+    sv = np.zeros((G8, L), np.float32)
+    if n_ent:
+        inv = np.empty(G_data, np.int64)
+        inv[perm[perm < G_data].astype(np.int64)] = np.nonzero(
+            perm < G_data
+        )[0]
+        dest_row = inv[entry_step]
+        o_pos[dest_row, slot] = out_pos
+        i_pos[dest_row, slot] = in_pos
+        sv[dest_row, slot] = v
+    return step_out, step_in, step_init, o_pos, i_pos, sv
+
+
+def _pad_schedule_np(
+    arrs: Tuple[np.ndarray, ...], pad_steps_to: int, num_out_blocks: int
+) -> Tuple[np.ndarray, ...]:
+    """Pad a schedule's step axis to ``pad_steps_to`` with inert zero-entry
+    steps on the LAST output block (keeps out-block order monotone; the
+    last block always exists — init steps guarantee every block has one).
+    Needed so per-device-shard schedules share one static shape under
+    shard_map."""
+    step_out, step_in, step_init, o_pos, i_pos, sv = arrs
+    G = step_out.shape[0]
+    if pad_steps_to < G:
+        raise ValueError(f"pad_steps_to={pad_steps_to} < num steps {G}")
+    extra = pad_steps_to - G
+    if extra:
+        step_out = np.concatenate(
+            [step_out, np.full(extra, num_out_blocks - 1, np.int32)]
+        )
+        step_in = np.concatenate([step_in, np.zeros(extra, np.int32)])
+        step_init = np.concatenate([step_init, np.zeros(extra, np.int32)])
+    G8 = ((pad_steps_to + 7) // 8) * 8
+    L = o_pos.shape[1]
+    if G8 > o_pos.shape[0]:
+        pad_rows = G8 - o_pos.shape[0]
+        o_pos = np.concatenate([o_pos, np.zeros((pad_rows, L), np.int32)])
+        i_pos = np.concatenate([i_pos, np.zeros((pad_rows, L), np.int32)])
+        sv = np.concatenate([sv, np.zeros((pad_rows, L), np.float32)])
+    return step_out, step_in, step_init, o_pos, i_pos, sv
+
+
 def _build_schedule(
     rows: np.ndarray,
     feats: np.ndarray,
@@ -91,80 +348,11 @@ def _build_schedule(
     sort_by_feature_block: bool,
     num_out_blocks: int,
 ) -> _Schedule:
-    win = params.window
-    L = params.chunk
-    rb = rows // win
-    fb = feats // win
-    if sort_by_feature_block:
-        order = np.lexsort((rb, fb))
-        out_blocks, in_blocks = fb[order], rb[order]
-        out_pos, in_pos = feats[order] % win, rows[order] % win
-    else:
-        order = np.lexsort((fb, rb))
-        out_blocks, in_blocks = rb[order], fb[order]
-        out_pos, in_pos = rows[order] % win, feats[order] % win
-    v = vals[order]
-
-    steps = []  # (entry_start, entry_end, out_block) ; start==end: zero step
-    if len(v):
-        # tile boundaries: chunk entries so no chunk crosses a tile boundary
-        tile_key = (
-            out_blocks.astype(np.int64) * (int(in_blocks.max()) + 1)
-            + in_blocks
-        )
-        boundaries = np.nonzero(
-            np.concatenate([[True], tile_key[1:] != tile_key[:-1]])
-        )[0]
-        tile_starts = boundaries
-        tile_ends = np.concatenate([boundaries[1:], [len(v)]])
-        for s, e in zip(tile_starts, tile_ends):
-            for cs in range(s, e, L):
-                steps.append((cs, min(cs + L, e), int(out_blocks[s])))
-    # Every output block needs at least one step: the kernel only writes
-    # blocks named by step_out (out_ref starts as UNINITIALIZED memory on
-    # TPU — interpret mode zero-fills, hiding this), so an output window
-    # with no entries would otherwise return garbage. Insert zero-entry
-    # init steps for the missing blocks, keeping out-block order sorted so
-    # VMEM accumulation stays monotone.
-    present = {ob for (_, _, ob) in steps}
-    for ob in range(num_out_blocks):
-        if ob not in present:
-            steps.append((0, 0, ob))
-    steps.sort(key=lambda t: t[2])
-
-    G = len(steps)
-    step_out = np.zeros(G, np.int32)
-    step_in = np.zeros(G, np.int32)
-    step_init = np.zeros(G, np.int32)
-    o_pos = np.zeros((G, L), np.int32)
-    i_pos = np.zeros((G, L), np.int32)
-    sv = np.zeros((G, L), np.float32)
-    prev_out = -1
-    for g, (cs, ce, ob) in enumerate(steps):
-        m = ce - cs
-        step_out[g] = ob
-        step_in[g] = in_blocks[cs] if m else 0
-        step_init[g] = 1 if ob != prev_out else 0
-        prev_out = ob
-        if m:
-            o_pos[g, :m] = out_pos[cs:ce]
-            i_pos[g, :m] = in_pos[cs:ce]
-            sv[g, :m] = v[cs:ce]
-    # pad the step axis to a multiple of 8: the kernel reads entry rows in
-    # (8, L) blocks (sublane tiling); padded rows are never executed
-    G8 = ((G + 7) // 8) * 8
-    if G8 != G:
-        o_pos = np.concatenate([o_pos, np.zeros((G8 - G, L), np.int32)])
-        i_pos = np.concatenate([i_pos, np.zeros((G8 - G, L), np.int32)])
-        sv = np.concatenate([sv, np.zeros((G8 - G, L), np.float32)])
-    return _Schedule(
-        jnp.asarray(step_out),
-        jnp.asarray(step_in),
-        jnp.asarray(step_init),
-        jnp.asarray(o_pos),
-        jnp.asarray(i_pos),
-        jnp.asarray(sv),
-    )
+    return _Schedule(*map(jnp.asarray, _build_schedule_np(
+        rows, feats, vals, params=params,
+        sort_by_feature_block=sort_by_feature_block,
+        num_out_blocks=num_out_blocks,
+    )))
 
 
 class TiledSparseBatch(NamedTuple):
@@ -219,13 +407,22 @@ class TiledSparseBatch(NamedTuple):
 @jax.tree_util.register_static
 @dataclass(frozen=True)
 class _TiledMeta:
-    """Static (hashable) shape metadata for TiledSparseBatch."""
+    """Static (hashable) shape metadata for TiledSparseBatch.
+
+    ``data_shards > 1`` marks a mesh layout: every array leaf carries
+    ``data_shards`` per-shard segments concatenated along axis 0 (all
+    per-shard shapes equal), and the shape fields describe ONE shard —
+    the view each device sees inside shard_map with the batch's leaves
+    split over the data axis. Such a batch is only meaningful under that
+    shard_map; single-device code must use ``data_shards == 1`` batches.
+    """
 
     params: TileParams
-    num_rows: int  # padded
+    num_rows: int  # padded (per data shard)
     dim: int  # padded
-    num_real_rows: int
+    num_real_rows: int  # global real row count
     real_dim: int
+    data_shards: int = 1
 
 
 def build_tiled_batch(
@@ -248,14 +445,21 @@ def build_tiled_batch(
     n_pad = max(((n + win - 1) // win) * win, win)
     d_pad = max(((dim + win - 1) // win) * win, win)
 
-    z_sched = _build_schedule(
-        rows, feats, vals, params=params, sort_by_feature_block=False,
-        num_out_blocks=n_pad // win,
-    )
-    g_sched = _build_schedule(
-        rows, feats, vals, params=params, sort_by_feature_block=True,
-        num_out_blocks=d_pad // win,
-    )
+    # the two passes are independent and numpy's sorts/gathers release the
+    # GIL — overlap them (halves the dominant host cost of cold training)
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(2) as pool:
+        fz = pool.submit(
+            _build_schedule, rows, feats, vals, params=params,
+            sort_by_feature_block=False, num_out_blocks=n_pad // win,
+        )
+        fg = pool.submit(
+            _build_schedule, rows, feats, vals, params=params,
+            sort_by_feature_block=True, num_out_blocks=d_pad // win,
+        )
+        z_sched = fz.result()
+        g_sched = fg.result()
     lab = np.zeros(n_pad, np.float32)
     lab[:n] = labels
     off = np.zeros(n_pad, np.float32)
@@ -294,6 +498,319 @@ def tiled_batch_from_sparse(batch, dim: int, *, params: TileParams = TileParams(
         rows, feats, vals,
         np.asarray(batch.labels), np.asarray(batch.offsets), weights,
         dim, params=params,
+    )
+
+
+def _sparse_coo(batch) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """SparseBatch -> filtered COO triples (+ real row count): zero values
+    and weight-0 (padding) rows dropped."""
+    indices = np.asarray(batch.indices)
+    values = np.asarray(batch.values)
+    weights = np.asarray(batch.weights)
+    n, k = indices.shape
+    rows = np.repeat(np.arange(n, dtype=np.int64), k)
+    feats = indices.reshape(-1).astype(np.int64)
+    vals = values.reshape(-1).astype(np.float32)
+    vals = np.where(np.repeat(weights > 0, k), vals, 0.0)
+    nz = vals != 0
+    return rows[nz], feats[nz], vals[nz], n
+
+
+def _padded_row_meta(batch, total: int, n: int):
+    lab = np.zeros(total, np.float32)
+    lab[:n] = np.asarray(batch.labels)
+    off = np.zeros(total, np.float32)
+    off[:n] = np.asarray(batch.offsets)
+    wgt = np.zeros(total, np.float32)
+    wgt[:n] = np.asarray(batch.weights)
+    return jnp.asarray(lab), jnp.asarray(off), jnp.asarray(wgt)
+
+
+def _concat_cell_schedules(
+    local_rows: np.ndarray,
+    local_feats: np.ndarray,
+    vals: np.ndarray,
+    cell_of: np.ndarray,
+    n_cells: int,
+    *,
+    params: TileParams,
+    z_out_blocks: int,
+    g_out_blocks: int,
+) -> Tuple[_Schedule, _Schedule, np.ndarray]:
+    """Per-cell z/grad schedules padded to ONE static shape and
+    concatenated along the step axis (cells in ``cell_of`` order) so a
+    shard_map split hands each device its own schedule. Returns
+    (z_sched, g_sched, g_vals numpy) — callers square g_vals for the
+    hessian-diagonal pass."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def _cell_pair(c):
+        m = cell_of == c
+        lr, lf, vl = local_rows[m], local_feats[m], vals[m]
+        return (
+            _build_schedule_np(
+                lr, lf, vl, params=params, sort_by_feature_block=False,
+                num_out_blocks=z_out_blocks,
+            ),
+            _build_schedule_np(
+                lr, lf, vl, params=params, sort_by_feature_block=True,
+                num_out_blocks=g_out_blocks,
+            ),
+        )
+
+    with ThreadPoolExecutor(min(8, n_cells)) as pool:
+        pairs = list(pool.map(_cell_pair, range(n_cells)))
+    z_parts = [p[0] for p in pairs]
+    g_parts = [p[1] for p in pairs]
+    gz = max(p[0].shape[0] for p in z_parts)
+    gg = max(p[0].shape[0] for p in g_parts)
+    z_parts = [_pad_schedule_np(p, gz, z_out_blocks) for p in z_parts]
+    g_parts = [_pad_schedule_np(p, gg, g_out_blocks) for p in g_parts]
+    z_sched = _Schedule(*(
+        jnp.asarray(np.concatenate([p[i] for p in z_parts]))
+        for i in range(6)
+    ))
+    g_sched = _Schedule(*(
+        jnp.asarray(np.concatenate([p[i] for p in g_parts]))
+        for i in range(6)
+    ))
+    return z_sched, g_sched, np.concatenate([p[5] for p in g_parts])
+
+
+def build_sharded_tiled_batch(
+    batch,
+    dim: int,
+    n_shards: int,
+    *,
+    params: TileParams = TileParams(),
+    mesh=None,
+    axis: Optional[str] = None,
+) -> TiledSparseBatch:
+    """SparseBatch -> mesh-layout TiledSparseBatch: the fast kernel AND
+    data parallelism simultaneously (the reference's hot loop property,
+    ValueAndGradientAggregator.scala:235-250).
+
+    Rows split into ``n_shards`` contiguous ranges (each padded to the tile
+    window); each range gets its OWN z/grad schedule built in its local row
+    space; all schedules are padded to one static shape and concatenated
+    along axis 0. Under shard_map with the batch's leaves split over the
+    data axis, every device then sees exactly a single-shard
+    TiledSparseBatch (the meta describes the per-shard view) and runs the
+    unmodified Pallas kernels; the objective's ``axis_name`` psums do the
+    cross-device reduction. With ``mesh`` given, leaves are placed with
+    rows/steps sharded over ``axis`` (default "data").
+    """
+    win = params.window
+    rows, feats, vals, n = _sparse_coo(batch)
+    rows_per = -(-n // n_shards)
+    R = max(((rows_per + win - 1) // win) * win, win)
+    d_pad = max(((dim + win - 1) // win) * win, win)
+    shard_of = rows // R
+    local_rows = rows - shard_of * R
+
+    z_sched, g_sched, g_vals = _concat_cell_schedules(
+        local_rows, feats, vals, shard_of, n_shards,
+        params=params, z_out_blocks=R // win, g_out_blocks=d_pad // win,
+    )
+    g_vals_sq = jnp.asarray(g_vals**2)
+    lab, off, wgt = _padded_row_meta(batch, n_shards * R, n)
+    out = TiledSparseBatch(
+        meta=_TiledMeta(
+            params=params, num_rows=R, dim=d_pad, num_real_rows=n,
+            real_dim=dim, data_shards=n_shards,
+        ),
+        z_sched=z_sched,
+        g_sched=g_sched,
+        g_vals_sq=g_vals_sq,
+        labels=lab,
+        offsets=off,
+        weights=wgt,
+    )
+    if mesh is not None:
+        out = _place_data_sharded(out, mesh, axis or "data")
+    return out
+
+
+@jax.tree_util.register_static
+@dataclass(frozen=True)
+class _FeatureShardedTiledMeta:
+    """Static metadata for FeatureShardedTiledBatch: shapes describe ONE
+    (data shard x feature block) cell — the per-device view."""
+
+    params: TileParams
+    rows_per_shard: int  # padded rows per data shard
+    block_dim: int  # padded features per model block (multiple of window)
+    num_real_rows: int
+    real_dim: int
+    data_shards: int
+    model_shards: int
+
+
+class FeatureShardedTiledBatch(NamedTuple):
+    """The 10B-coefficient layout on the FAST kernel: a SparseBatch
+    re-laid-out for a 2-D (data x model) mesh with one tiled schedule per
+    (data shard, feature block) cell.
+
+    Each cell's z-schedule produces that feature block's PARTIAL margins
+    for its row shard (psum over "model" completes them); its g-schedule
+    produces the block-local gradient (psum over "data" completes it) —
+    same collective pattern as parallel.distributed's scatter-based sparse
+    layout, but running the Pallas bilinear kernels instead of
+    ~7ns/element gather/scatter loops.
+
+    Schedule leaves concatenate cells along axis 0 in data-major,
+    model-minor order, all cells padded to one static shape, so shard_map
+    splits them with ``P((data, model))``. Row metadata is sharded over
+    "data" only (replicated across feature blocks). Global feature id
+    f lives at w[(f // block_dim) * block_dim + f % block_dim] — blocks
+    are contiguous ranges, so w[:real_dim] are the real coefficients.
+    """
+
+    meta: _FeatureShardedTiledMeta
+    z_sched: _Schedule
+    g_sched: _Schedule
+    labels: Array
+    offsets: Array
+    weights: Array
+
+
+def feature_shard_tiled_batch(
+    batch,
+    dim: int,
+    data_shards: int,
+    model_shards: int,
+    *,
+    params: TileParams = TileParams(),
+    mesh=None,
+    data_axis: str = "data",
+    model_axis: str = "model",
+) -> Tuple[FeatureShardedTiledBatch, int]:
+    """SparseBatch -> (FeatureShardedTiledBatch, block_dim).
+
+    ``block_dim`` (features per model block) is rounded up to a multiple of
+    the tile window so every block's local feature space is tile-aligned;
+    the sharded coefficient vector has length model_shards * block_dim.
+    With ``mesh`` given, leaves are placed with schedules sharded over
+    (data, model) and row metadata over data.
+    """
+    win = params.window
+    rows, feats, vals, n = _sparse_coo(batch)
+    rows_per = -(-n // data_shards)
+    R = max(((rows_per + win - 1) // win) * win, win)
+    block_dim = -(-dim // model_shards)
+    block_dim = max(((block_dim + win - 1) // win) * win, win)
+
+    ds_of = rows // R
+    local_rows = rows - ds_of * R
+    mb_of = feats // block_dim
+    local_feats = feats - mb_of * block_dim
+    cell_of = ds_of * model_shards + mb_of
+
+    z_sched, g_sched, _ = _concat_cell_schedules(
+        local_rows, local_feats, vals, cell_of,
+        data_shards * model_shards, params=params,
+        z_out_blocks=R // win, g_out_blocks=block_dim // win,
+    )
+    lab, off, wgt = _padded_row_meta(batch, data_shards * R, n)
+    out = FeatureShardedTiledBatch(
+        meta=_FeatureShardedTiledMeta(
+            params=params, rows_per_shard=R, block_dim=block_dim,
+            num_real_rows=n, real_dim=dim, data_shards=data_shards,
+            model_shards=model_shards,
+        ),
+        z_sched=z_sched,
+        g_sched=g_sched,
+        labels=lab,
+        offsets=off,
+        weights=wgt,
+    )
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        cell_sh = NamedSharding(mesh, P((data_axis, model_axis)))
+        row_sh = NamedSharding(mesh, P(data_axis))
+        out = FeatureShardedTiledBatch(
+            meta=out.meta,
+            z_sched=_Schedule(*(
+                jax.device_put(a, cell_sh) for a in out.z_sched
+            )),
+            g_sched=_Schedule(*(
+                jax.device_put(a, cell_sh) for a in out.g_sched
+            )),
+            labels=jax.device_put(out.labels, row_sh),
+            offsets=jax.device_put(out.offsets, row_sh),
+            weights=jax.device_put(out.weights, row_sh),
+        )
+    return out, block_dim
+
+
+def tiled_block_local_vg(loss, batch: FeatureShardedTiledBatch,
+                         data_axis: str, model_axis: str, l2,
+                         *, interpret: bool = False, mxu: str = "bf16x2w"):
+    """Block-local (value, grad) closure over ONE device's cell of a
+    FeatureShardedTiledBatch (call inside shard_map). The distributed.py
+    fit entry points wrap this with the unmodified L-BFGS/OWL-QN."""
+    meta = batch.meta
+    p = meta.params
+    win = p.window
+
+    def vg(w_block):
+        w2d = w_block.reshape((meta.block_dim // win, p.s_hi, p.s_lo))
+        z_partial = _run_bilinear_pass(
+            batch.z_sched, w2d, meta.rows_per_shard // win, p,
+            interpret=interpret, mxu=mxu,
+        ).reshape(-1)
+        z = jax.lax.psum(z_partial, model_axis) + batch.offsets
+        c = batch.weights * loss.d1(z, batch.labels)
+        value = jax.lax.psum(
+            jnp.sum(batch.weights * loss.value(z, batch.labels)), data_axis
+        )
+        c2d = c.reshape((meta.rows_per_shard // win, p.s_hi, p.s_lo))
+        g_local = _run_bilinear_pass(
+            batch.g_sched, c2d, meta.block_dim // win, p,
+            interpret=interpret, mxu=mxu,
+        ).reshape(-1)
+        grad_block = jax.lax.psum(g_local, data_axis)
+        w_sq = jax.lax.psum(jnp.vdot(w_block, w_block), model_axis)
+        return value + 0.5 * l2 * w_sq, grad_block + l2 * w_block
+
+    return vg
+
+
+def _place_data_sharded(batch: TiledSparseBatch, mesh, axis: str):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
+
+
+def ensure_tiled_sharded(
+    batch,
+    dim: int,
+    mesh,
+    axis: str = "data",
+    *,
+    params: Optional[TileParams] = None,
+) -> TiledSparseBatch:
+    """Idempotent mesh-layout conversion (the tiled analog of
+    parallel.mesh.ensure_data_sharded): SparseBatch -> sharded tiled build;
+    an already-matching TiledSparseBatch passes through (so a lambda grid
+    or coordinate-descent loop pays the schedule build + transfer once)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = int(mesh.shape[axis])
+    if isinstance(batch, TiledSparseBatch):
+        if batch.meta.data_shards != n:
+            raise ValueError(
+                f"TiledSparseBatch was laid out for {batch.meta.data_shards} "
+                f"data shard(s) but the mesh's {axis!r} axis has {n}; "
+                "rebuild from the SparseBatch with build_sharded_tiled_batch"
+            )
+        if getattr(batch.labels, "sharding", None) == NamedSharding(mesh, P(axis)):
+            return batch
+        return _place_data_sharded(batch, mesh, axis)
+    return build_sharded_tiled_batch(
+        batch, dim, n, params=params or TileParams(), mesh=mesh, axis=axis
     )
 
 

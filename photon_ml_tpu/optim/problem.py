@@ -202,7 +202,15 @@ class GLMOptimizationProblem:
         from photon_ml_tpu.parallel.mesh import DATA_AXIS, ensure_data_sharded
 
         axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
-        sharded = ensure_data_sharded(batch, mesh, axis)
+        from photon_ml_tpu.ops.tiled_sparse import TiledGLMObjective, ensure_tiled_sharded
+
+        if isinstance(self.objective, TiledGLMObjective):
+            # fast kernel AND mesh together: per-shard tiled schedules
+            # (ValueAndGradientAggregator.scala:235-250 runs distributed at
+            # full speed; so do we — no scatter fallback)
+            sharded = ensure_tiled_sharded(batch, self.objective.dim, mesh, axis)
+        else:
+            sharded = ensure_data_sharded(batch, mesh, axis)
         _fit = self._get_fit(track_models, mesh=mesh, axis=axis)
         result = _fit(w0, sharded, jnp.float32(l1), jnp.float32(l2))
 
@@ -274,11 +282,10 @@ def resolve_kernel(kernel: str, batch=None) -> str:
         )
     if kernel != "auto":
         return kernel
-    import jax
-
     from photon_ml_tpu.data.batch import SparseBatch
+    from photon_ml_tpu.utils.backend import effective_platform
 
-    on_tpu = jax.default_backend() == "tpu"
+    on_tpu = effective_platform() == "tpu"
     sparse_ok = batch is None or isinstance(batch, SparseBatch)
     return "tiled" if (on_tpu and sparse_ok) else "scatter"
 
@@ -307,15 +314,14 @@ def create_glm_problem(
     """
     norm_ctx = norm if norm is not None else identity_context()
     if kernel == "tiled":
-        import jax
-
         from photon_ml_tpu.ops.tiled_sparse import TiledGLMObjective
+        from photon_ml_tpu.utils.backend import effective_platform
 
         # Mosaic kernels cannot lower to CPU: an explicit tiled request
         # there runs in interpret mode (slow, for tests/debugging).
         objective = TiledGLMObjective(
             loss_for_task(task), dim, norm_ctx, axis_name,
-            interpret=jax.default_backend() == "cpu",
+            interpret=effective_platform() == "cpu",
         )
     else:
         objective = GLMObjective(

@@ -1,0 +1,23 @@
+"""Backend/platform introspection that respects ``jax.default_device``.
+
+``jax.default_backend()`` initializes and reports the process-default
+platform (TPU when a plugin is pinned) even inside a
+``jax.default_device(cpu)`` scope. Hermetic CPU-mesh paths (the driver's
+``dryrun_multichip``) must never touch the TPU runtime, so library code
+that branches on "what device will my arrays land on" uses
+:func:`effective_platform` instead.
+"""
+
+from __future__ import annotations
+
+
+def effective_platform() -> str:
+    """Platform new unannotated arrays land on under the CURRENT context.
+
+    Honors ``jax.default_device`` scopes (returns "cpu" inside one even
+    when a TPU plugin is installed) and only initializes the backend the
+    caller is about to use anyway.
+    """
+    import jax.numpy as jnp
+
+    return next(iter(jnp.zeros(()).devices())).platform

@@ -8,6 +8,7 @@ Mirrors GameIntegTest/GameTestUtils validator-style checks.
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from photon_ml_tpu.game import (
@@ -498,3 +499,162 @@ class TestMediumScaleGame:
         )
         # design sanity: the whole thing stays minutes-free on 1 CPU device
         assert build_s < 120 and cd_s < 300, (build_s, cd_s)
+
+
+@pytest.mark.slow
+class TestLargeScaleREBuild:
+    """VERDICT r2 item 3: the RE build must saturate one host — 1M rows /
+    100k entities through the REAL vectorized path (argsort + bincount +
+    flat scatter, no per-row or per-entity Python loops)."""
+
+    def test_million_row_build(self, rng):
+        import time
+
+        from photon_ml_tpu.game.data import EntityIndex, GameDataset, ShardData
+        from photon_ml_tpu.utils.index_map import IndexMap
+
+        n, E, d, k = 1_000_000, 100_000, 50_000, 8
+        imap = IndexMap({f"f{i}": i for i in range(d)})
+        ds = GameDataset(
+            uids=[str(i) for i in range(n)],
+            labels=(rng.uniform(size=n) > 0.5).astype(np.float32),
+            offsets=np.zeros(n, np.float32),
+            weights=np.ones(n, np.float32),
+            shards={
+                "userShard": ShardData(
+                    indices=rng.integers(0, d, size=(n, k)).astype(np.int32),
+                    values=rng.normal(size=(n, k)).astype(np.float32),
+                    index_map=imap,
+                    intercept_index=None,
+                )
+            },
+            entity_codes={
+                "userId": rng.integers(0, E, size=n).astype(np.int32)
+            },
+            entity_indexes={
+                "userId": EntityIndex(
+                    "userId", [f"u{i}" for i in range(E)], {}
+                )
+            },
+            num_real_rows=n,
+        )
+        t0 = time.perf_counter()
+        red = build_random_effect_dataset(
+            ds, RandomEffectDataConfiguration("userId", "userShard")
+        )
+        build_s = time.perf_counter() - t0
+        assert red.num_entities == E
+        assert red.num_active_rows == n
+        # each bucket's capacity covers the max active count of its members
+        for b in red.buckets:
+            per_entity = (b.row_index >= 0).sum(axis=1)
+            assert per_entity.max() <= b.capacity
+            assert per_entity.min() >= 1  # members have at least one row
+        # every active row landed in exactly one bucket slot
+        placed = sum(
+            int((b.row_index >= 0).sum()) for b in red.buckets
+        )
+        assert placed == n
+        # host-saturating vectorized build: ~2-3 s typical; generous CI
+        # bound still catches any reintroduced per-row Python loop (~13 s+)
+        assert build_s < 8.0, build_s
+
+    def test_million_row_build_with_cap(self, rng):
+        import time
+
+        from photon_ml_tpu.game.data import EntityIndex, GameDataset, ShardData
+        from photon_ml_tpu.utils.index_map import IndexMap
+
+        n, E, d, k = 1_000_000, 100_000, 30_000, 8
+        imap = IndexMap({f"f{i}": i for i in range(d)})
+        ds = GameDataset(
+            uids=[str(i) for i in range(n)],
+            labels=(rng.uniform(size=n) > 0.5).astype(np.float32),
+            offsets=np.zeros(n, np.float32),
+            weights=np.ones(n, np.float32),
+            shards={
+                "userShard": ShardData(
+                    indices=rng.integers(0, d, size=(n, k)).astype(np.int32),
+                    values=rng.normal(size=(n, k)).astype(np.float32),
+                    index_map=imap,
+                    intercept_index=None,
+                )
+            },
+            entity_codes={
+                "userId": rng.integers(0, E, size=n).astype(np.int32)
+            },
+            entity_indexes={
+                "userId": EntityIndex(
+                    "userId", [f"u{i}" for i in range(E)], {}
+                )
+            },
+            num_real_rows=n,
+        )
+        t0 = time.perf_counter()
+        red = build_random_effect_dataset(
+            ds,
+            RandomEffectDataConfiguration(
+                "userId", "userShard", active_data_upper_bound=8
+            ),
+        )
+        build_s = time.perf_counter() - t0
+        assert red.num_active_rows + red.num_passive_rows == n
+        # reservoir weight mass preserved per entity: sum over buckets
+        total_mass = sum(float(b.weights.sum()) for b in red.buckets)
+        assert total_mass == pytest.approx(n, rel=1e-3)
+        assert build_s < 8.0, build_s
+
+
+class TestDeviceResidentResiduals:
+    """VERDICT r2 item 6: at steady state the coordinate-descent loop does
+    no implicit device->host transfer — residuals, offsets, and scores
+    stay jnp end-to-end (SURVEY §7.9 device-resident KeyValueScore); the
+    tracker/objective readbacks are single EXPLICIT device_get calls."""
+
+    def test_steady_state_no_implicit_d2h(self, rng):
+        recs, _, _ = make_records(rng, n=200, n_users=6)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        red = build_random_effect_dataset(
+            ds, RandomEffectDataConfiguration("userId", "userShard")
+        )
+        coords = {
+            "global": FixedEffectCoordinate(
+                name="global",
+                dataset=ds,
+                problem=create_glm_problem(
+                    TaskType.LOGISTIC_REGRESSION,
+                    ds.shards["globalShard"].dim,
+                    config=OptimizerConfig(max_iter=5),
+                    regularization=RegularizationContext(
+                        RegularizationType.L2
+                    ),
+                ),
+                feature_shard_id="globalShard",
+                reg_weight=0.1,
+            ),
+            "per-user": RandomEffectCoordinate(
+                name="per-user",
+                dataset=ds,
+                re_dataset=red,
+                problem=RandomEffectOptimizationProblem(
+                    LOGISTIC,
+                    OptimizerConfig(max_iter=5),
+                    RegularizationContext(RegularizationType.L2),
+                    reg_weight=1.0,
+                ),
+            ),
+        }
+
+        def make_cd():
+            return CoordinateDescent(
+                coords, ds, TaskType.LOGISTIC_REGRESSION,
+                update_sequence=["global", "per-user"],
+            )
+
+        # iteration 1 warms every device cache (feature tables, row views)
+        warm = make_cd().run(1)
+        # steady state: the same coordinates must run with implicit
+        # device->host transfers disallowed (explicit device_get is fine)
+        with jax.transfer_guard_device_to_host("disallow"):
+            res = make_cd().run(1)
+        assert np.isfinite(res.objective_history[-1])

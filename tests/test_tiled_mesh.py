@@ -1,0 +1,257 @@
+"""Tiled kernel x mesh composition: the fast kernel and data parallelism
+run TOGETHER (the reference's hot loop is simultaneously fast and
+distributed — ValueAndGradientAggregator.scala:235-250; round 2 fell back
+to the scatter objective under a mesh).
+
+All tests run the Pallas kernels in interpret mode on the virtual 8-device
+CPU mesh from tests/conftest.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from photon_ml_tpu.data.batch import make_sparse_batch
+from photon_ml_tpu.ops.losses import LOGISTIC
+from photon_ml_tpu.ops.objective import GLMObjective
+from photon_ml_tpu.ops.tiled_sparse import (
+    TileParams,
+    TiledGLMObjective,
+    build_sharded_tiled_batch,
+    ensure_tiled_sharded,
+    tiled_batch_from_sparse,
+)
+from photon_ml_tpu.parallel.mesh import DATA_AXIS, make_mesh
+from photon_ml_tpu.task import TaskType
+from photon_ml_tpu.training import train_generalized_linear_model
+
+PARAMS = TileParams(s_hi=8, s_lo=8, chunk=32)  # window 64, tiny for tests
+
+
+def random_problem(rng, n=203, d=150, k=6):
+    rows, labels = [], []
+    for _ in range(n):
+        nnz = int(rng.integers(1, k + 1))
+        ix = rng.choice(d, size=nnz, replace=False).tolist()
+        vs = rng.normal(size=nnz).tolist()
+        labels.append(float(rng.uniform() > 0.5))
+        rows.append((ix, vs))
+    return make_sparse_batch(rows, labels, weights=rng.uniform(0.5, 2.0, n)), d
+
+
+class TestShardedTiledBatch:
+    def test_leaf_shapes_stack_per_shard(self, rng):
+        batch, d = random_problem(rng)
+        n_shards = 4
+        tb = build_sharded_tiled_batch(
+            batch, d, n_shards, params=PARAMS
+        )
+        assert tb.meta.data_shards == n_shards
+        # per-shard static views divide every leaf's leading axis
+        assert tb.labels.shape[0] == n_shards * tb.meta.num_rows
+        assert tb.z_sched.step_out.shape[0] % n_shards == 0
+        assert tb.g_sched.step_out.shape[0] % n_shards == 0
+        assert tb.z_sched.out_pos.shape[0] % n_shards == 0
+        # every nonzero entry appears once per schedule, across all shards
+        nnz = int(np.count_nonzero(np.asarray(batch.values)))
+        assert np.count_nonzero(np.asarray(tb.z_sched.vals)) == nnz
+        assert np.count_nonzero(np.asarray(tb.g_sched.vals)) == nnz
+
+    def test_per_shard_blocks_monotone(self, rng):
+        batch, d = random_problem(rng)
+        n_shards = 4
+        tb = build_sharded_tiled_batch(batch, d, n_shards, params=PARAMS)
+        gz = tb.z_sched.step_out.shape[0] // n_shards
+        gg = tb.g_sched.step_out.shape[0] // n_shards
+        for s in range(n_shards):
+            z_out = np.asarray(tb.z_sched.step_out[s * gz:(s + 1) * gz])
+            g_out = np.asarray(tb.g_sched.step_out[s * gg:(s + 1) * gg])
+            assert np.all(np.diff(z_out) >= 0)
+            assert np.all(np.diff(g_out) >= 0)
+
+    def test_value_and_gradient_matches_scatter(self, rng):
+        batch, d = random_problem(rng)
+        mesh = make_mesh()
+        n_shards = int(mesh.shape[DATA_AXIS])
+        tb = build_sharded_tiled_batch(
+            batch, d, n_shards, params=PARAMS, mesh=mesh
+        )
+        obj = TiledGLMObjective(
+            LOGISTIC, d, axis_name=DATA_AXIS, interpret=True
+        )
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+
+        @jax.jit
+        @lambda f: shard_map(
+            f, mesh=mesh, in_specs=(P(), P(DATA_AXIS), P()),
+            out_specs=(P(), P()), check_vma=False,
+        )
+        def vg(w, b, l2):
+            return obj.value_and_gradient(w, b, l2)
+
+        value, grad = vg(w, tb, jnp.float32(0.3))
+        oracle = GLMObjective(LOGISTIC, d)
+        ov, og = oracle.value_and_gradient(w, batch, jnp.float32(0.3))
+        np.testing.assert_allclose(float(value), float(ov), rtol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(grad), np.asarray(og), rtol=3e-3, atol=3e-5
+        )
+
+    def test_hessian_vector_matches_scatter(self, rng):
+        batch, d = random_problem(rng, n=97)
+        mesh = make_mesh()
+        tb = ensure_tiled_sharded(batch, d, mesh, params=PARAMS)
+        obj = TiledGLMObjective(
+            LOGISTIC, d, axis_name=DATA_AXIS, interpret=True
+        )
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=d).astype(np.float32))
+
+        @jax.jit
+        @lambda f: shard_map(
+            f, mesh=mesh, in_specs=(P(), P(), P(DATA_AXIS), P()),
+            out_specs=P(), check_vma=False,
+        )
+        def hv(w, v, b, l2):
+            return obj.hessian_vector(w, v, b, l2)
+
+        got = hv(w, v, tb, jnp.float32(0.1))
+        oracle = GLMObjective(LOGISTIC, d)
+        want = oracle.hessian_vector(w, v, batch, jnp.float32(0.1))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=3e-3, atol=3e-5
+        )
+
+    def test_ensure_idempotent(self, rng):
+        batch, d = random_problem(rng)
+        mesh = make_mesh()
+        tb = ensure_tiled_sharded(batch, d, mesh, params=PARAMS)
+        tb2 = ensure_tiled_sharded(tb, d, mesh, params=PARAMS)
+        assert tb2 is tb
+
+    def test_shard_count_mismatch_raises(self, rng):
+        batch, d = random_problem(rng)
+        mesh = make_mesh()
+        tb = build_sharded_tiled_batch(batch, d, 2, params=PARAMS)
+        with pytest.raises(ValueError, match="laid out for 2"):
+            ensure_tiled_sharded(tb, d, mesh)
+
+
+class TestFeatureShardedTiled:
+    def test_matches_replicated_lbfgs(self, rng):
+        # 10B-coef layout on the fast kernel: 2-D (data=4, model=2) mesh,
+        # tiled block-local schedules vs the plain replicated fit
+        from photon_ml_tpu.ops.tiled_sparse import feature_shard_tiled_batch
+        from photon_ml_tpu.parallel.distributed import (
+            feature_sharded_tiled_fit,
+        )
+        from photon_ml_tpu.parallel.mesh import MODEL_AXIS
+
+        n, d, k = 120, 100, 5
+        w_true = rng.normal(size=d)
+        rows, labels = [], []
+        for _ in range(n):
+            ix = rng.choice(d, size=k, replace=False)
+            vs = rng.normal(size=k)
+            z = float((w_true[ix] * vs).sum())
+            labels.append(float(rng.uniform() < 1 / (1 + np.exp(-z))))
+            rows.append((ix.tolist(), vs.tolist()))
+        batch = make_sparse_batch(rows, labels)
+        mesh = make_mesh((4, 2), (DATA_AXIS, MODEL_AXIS))
+        sharded, block_dim = feature_shard_tiled_batch(
+            batch, d, 4, 2, params=PARAMS, mesh=mesh
+        )
+        obj = GLMObjective(LOGISTIC, d)
+        fit = feature_sharded_tiled_fit(
+            obj, mesh, sharded.meta, max_iter=25, interpret=True
+        )
+        res = fit(
+            jnp.zeros(2 * block_dim, jnp.float32), sharded, jnp.float32(0.5)
+        )
+        # oracle: plain single-device L-BFGS on the scatter objective
+        from photon_ml_tpu.optim.lbfgs import minimize_lbfgs
+
+        oracle = minimize_lbfgs(
+            lambda w: obj.value_and_gradient(w, batch, jnp.float32(0.5)),
+            jnp.zeros(d, jnp.float32), max_iter=25,
+        )
+        np.testing.assert_allclose(
+            np.asarray(res.coefficients)[:d],
+            np.asarray(oracle.coefficients),
+            atol=5e-3,
+        )
+        np.testing.assert_allclose(
+            float(res.value), float(oracle.value), rtol=1e-4
+        )
+
+    def test_train_feature_sharded_tiled_owlqn(self, rng):
+        # elastic-net grid through the public entry point, tiled kernel
+        from photon_ml_tpu.parallel.mesh import MODEL_AXIS
+        from photon_ml_tpu.training import train_feature_sharded
+        from photon_ml_tpu.optim.config import RegularizationType
+
+        n, d, k = 96, 60, 4
+        w_true = rng.normal(size=d)
+        rows, labels = [], []
+        for _ in range(n):
+            ix = rng.choice(d, size=k, replace=False)
+            vs = rng.normal(size=k)
+            z = float((w_true[ix] * vs).sum())
+            labels.append(float(rng.uniform() < 1 / (1 + np.exp(-z))))
+            rows.append((ix.tolist(), vs.tolist()))
+        batch = make_sparse_batch(rows, labels)
+        mesh = make_mesh((4, 2), (DATA_AXIS, MODEL_AXIS))
+        kwargs = dict(
+            mesh=mesh,
+            regularization_type=RegularizationType.ELASTIC_NET,
+            elastic_net_alpha=0.5,
+            regularization_weights=[0.3],
+            max_iter=25,
+        )
+        m_scatter, _ = train_feature_sharded(
+            batch, TaskType.LOGISTIC_REGRESSION, d, kernel="scatter", **kwargs
+        )
+        m_tiled, _ = train_feature_sharded(
+            batch, TaskType.LOGISTIC_REGRESSION, d, kernel="tiled", **kwargs
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_tiled[0.3].coefficients.means),
+            np.asarray(m_scatter[0.3].coefficients.means),
+            atol=5e-3,
+        )
+
+
+class TestTiledMeshTraining:
+    def test_mesh_matches_single_device_tiled(self, rng):
+        # end-to-end lambda grid: tiled+mesh vs scatter single-device agree
+        # (no silent fallback anywhere). Labels come from a planted model so
+        # the optimum is well-conditioned (separable data would amplify fp
+        # reduction-order noise into large coefficient differences).
+        n, d, k = 157, 40, 5
+        w_true = rng.normal(size=d)
+        rows, labels = [], []
+        for _ in range(n):
+            ix = rng.choice(d, size=k, replace=False)
+            vs = rng.normal(size=k)
+            z = float((w_true[ix] * vs).sum())
+            labels.append(float(rng.uniform() < 1 / (1 + np.exp(-z))))
+            rows.append((ix.tolist(), vs.tolist()))
+        batch = make_sparse_batch(rows, labels)
+        kwargs = dict(regularization_weights=[1.0, 0.1], max_iter=25)
+        m_scatter, _ = train_generalized_linear_model(
+            batch, TaskType.LOGISTIC_REGRESSION, d, **kwargs
+        )
+        m_mesh, _ = train_generalized_linear_model(
+            batch, TaskType.LOGISTIC_REGRESSION, d,
+            kernel="tiled", mesh=make_mesh(), **kwargs
+        )
+        for lam in m_scatter:
+            np.testing.assert_allclose(
+                np.asarray(m_mesh[lam].coefficients.means),
+                np.asarray(m_scatter[lam].coefficients.means),
+                atol=5e-3,
+            )
