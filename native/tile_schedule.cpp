@@ -42,15 +42,24 @@ int64_t max_tiles(int64_t n) {
   return rel > floor_tiles ? rel : floor_tiles;
 }
 
-TileDims tile_dims(const int64_t* in_coord, int64_t n, int64_t win,
-                   int64_t num_out_blocks) {
+// Returns n_tiles <= 0 when any coordinate is negative or an out
+// coordinate falls outside the declared output-block space — the caller
+// then falls back to the numpy builder's Python-level error instead of
+// this code indexing the histogram out of bounds.
+TileDims tile_dims(const int64_t* out_coord, const int64_t* in_coord,
+                   int64_t n, int64_t win, int64_t num_out_blocks) {
   int64_t max_in = 0;
+  bool bad = false;
   for (int64_t i = 0; i < n; ++i) {
     if (in_coord[i] > max_in) max_in = in_coord[i];
+    if (in_coord[i] < 0 || out_coord[i] < 0 ||
+        out_coord[i] / win >= num_out_blocks) {
+      bad = true;
+    }
   }
   TileDims d;
   d.n_in_blocks = n ? (max_in / win + 1) : 1;
-  d.n_tiles = num_out_blocks * d.n_in_blocks;
+  d.n_tiles = bad ? -1 : num_out_blocks * d.n_in_blocks;
   return d;
 }
 
@@ -64,7 +73,7 @@ extern "C" {
 int64_t ts_step_count(const int64_t* out_coord, const int64_t* in_coord,
                       int64_t n, int64_t win, int64_t chunk,
                       int64_t num_out_blocks) try {
-  TileDims d = tile_dims(in_coord, n, win, num_out_blocks);
+  TileDims d = tile_dims(out_coord, in_coord, n, win, num_out_blocks);
   if (d.n_tiles <= 0 || d.n_tiles > max_tiles(n)) return -1;
   std::vector<int64_t> counts(static_cast<size_t>(d.n_tiles), 0);
   for (int64_t i = 0; i < n; ++i) {
@@ -98,7 +107,7 @@ int64_t ts_fill(const int64_t* out_coord, const int64_t* in_coord,
                 int64_t num_out_blocks, int64_t expected_steps,
                 int32_t* step_out, int32_t* step_in, int32_t* step_init,
                 int32_t* o_pos, int32_t* i_pos, float* sv) try {
-  TileDims d = tile_dims(in_coord, n, win, num_out_blocks);
+  TileDims d = tile_dims(out_coord, in_coord, n, win, num_out_blocks);
   if (d.n_tiles <= 0 || d.n_tiles > max_tiles(n)) return -1;
   std::vector<int64_t> counts(static_cast<size_t>(d.n_tiles), 0);
   for (int64_t i = 0; i < n; ++i) {
@@ -123,6 +132,7 @@ int64_t ts_fill(const int64_t* out_coord, const int64_t* in_coord,
       entry_base[t] = entries;
       step_base[t] = step;
       int64_t n_chunks = (c + chunk - 1) / chunk;
+      if (step + n_chunks > expected_steps) return -1;  // caller mismatch
       for (int64_t j = 0; j < n_chunks; ++j) {
         step_out[step] = static_cast<int32_t>(ob);
         step_in[step] = static_cast<int32_t>(ib);
@@ -133,6 +143,7 @@ int64_t ts_fill(const int64_t* out_coord, const int64_t* in_coord,
       entries += c;
     }
     if (first_of_block) {  // no entries in this output block
+      if (step >= expected_steps) return -1;  // caller mismatch
       step_out[step] = static_cast<int32_t>(ob);
       step_in[step] = 0;
       step_init[step] = 1;

@@ -91,18 +91,21 @@ class GameDataset:
         varies, and the residual path passes it as an already-on-device
         array."""
         cache = self.__dict__.setdefault("_device_cache", {})
-        hit = cache.get(shard_id)
-        if hit is None:
-            sd = self.shards[shard_id]
-            hit = (
-                jnp.asarray(sd.indices),
-                jnp.asarray(sd.values),
+        rows = cache.get(None)  # dataset-level row columns, shared
+        if rows is None:
+            rows = (
                 jnp.asarray(self.labels),
                 jnp.asarray(self.offsets),
                 jnp.asarray(self.weights),
             )
+            cache[None] = rows
+        lab, base_off, w = rows
+        hit = cache.get(shard_id)
+        if hit is None:
+            sd = self.shards[shard_id]
+            hit = (jnp.asarray(sd.indices), jnp.asarray(sd.values))
             cache[shard_id] = hit
-        ix, v, lab, base_off, w = hit
+        ix, v = hit
         return SparseBatch(
             indices=ix,
             values=v,

@@ -449,6 +449,24 @@ class RandomEffectOptimizationProblem:
         # and a recycled id cannot alias because the dead entry removes
         # itself first.
         self._device_cache: Dict[int, Tuple[object, List[Array]]] = {}
+        # per-dataset residual routers for the mesh path (static routing
+        # tables + jitted all_to_all scatter; weakref like _device_cache)
+        self._router_cache: Dict[int, Tuple[object, object]] = {}
+
+    def _router_for(self, dataset):
+        import weakref
+
+        key = id(dataset)
+        hit = self._router_cache.get(key)
+        if hit is not None and hit[0]() is dataset:
+            return hit[1]
+        from photon_ml_tpu.game.residual_routing import ResidualRouter
+
+        router = ResidualRouter(self.mesh, dataset)
+        cache = self._router_cache
+        ref = weakref.ref(dataset, lambda _, k=key, c=cache: c.pop(k, None))
+        cache[key] = (ref, router)
+        return router
 
     def _newton_eligible(self) -> bool:
         """The dual-space Newton solver needs l2 > 0 (Woodbury ridge), a
@@ -558,14 +576,17 @@ class RandomEffectOptimizationProblem:
             # (in-place scatter per bucket) while the caller's reference
             # stays valid
             bank = jnp.array(bank, copy=True)
+        routed = None
+        router = None
         if residual_offsets is not None:
             residual_offsets = jnp.asarray(residual_offsets, jnp.float32)
-            if self.mesh is not None:
-                from jax.sharding import NamedSharding, PartitionSpec as P
-
-                residual_offsets = jax.device_put(
-                    residual_offsets, NamedSharding(self.mesh, P())
-                )
+            if self.mesh is not None and dataset.buckets:
+                # ICI re-key: ONE all_to_all routes each row's offset to
+                # its entity's owner device (the addScoresToOffsets
+                # shuffle analog) instead of replicating the whole [n]
+                # vector to every device.
+                router = self._router_for(dataset)
+                routed = router.route(residual_offsets)
         for bi, bucket in enumerate(dataset.buckets):
             (
                 ix_d, v_d, lab_d, w_d, off_d, rows_d, codes_d,
@@ -582,14 +603,19 @@ class RandomEffectOptimizationProblem:
                 if self.mesh is not None:
                     (v_d,), _ = self._shard_entity_axis([v_d])
             if residual_offsets is not None:
-                # device-side gather of per-row residual offsets — the
-                # KeyValueScore residual currency never leaves the device
-                # (SURVEY §7.9; round 2 gathered on host per bucket)
-                off_d = jnp.where(
-                    rows_d >= 0,
-                    residual_offsets[jnp.maximum(rows_d, 0)],
-                    0.0,
-                )
+                if routed is not None:
+                    # mesh path: slice this bucket's slab out of the
+                    # routed per-device buffers — already entity-sharded
+                    off_d = router.bucket_slab(routed, bi, bucket.capacity)
+                else:
+                    # single device: per-row gather stays on device — the
+                    # KeyValueScore residual currency never leaves it
+                    # (SURVEY §7.9; round 2 gathered on host per bucket)
+                    off_d = jnp.where(
+                        rows_d >= 0,
+                        residual_offsets[jnp.maximum(rows_d, 0)],
+                        0.0,
+                    )
             n_real = bucket.num_entities
             use_dense = self._use_dense(bucket, bank.shape[1])
             kind = (

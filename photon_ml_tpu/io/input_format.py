@@ -194,6 +194,74 @@ class AvroInputDataFormat:
             return None
         return out
 
+    def iter_rows_from_decoded(self, cols, index_map: IndexMap, intercept_index):
+        """Yield (indices, values, label, offset, weight) per record of one
+        file's DecodedColumns — the single definition of the native-decode
+        remap semantics (intern-table remap, selected-features filter,
+        null/NaN rules, intercept append) shared by the in-memory loader
+        and the streaming (>RAM) path."""
+        table = np.asarray(
+            [
+                index_map.get_index(s)
+                if self.selected is None or s in self.selected
+                else -1
+                for s in cols.strings
+            ],
+            dtype=np.int64,
+        )
+        row_ptr, key_ids, values = cols.bag("features")
+        gix = table[key_ids] if len(key_ids) else np.zeros(0, np.int64)
+        lab = cols.f64(self.response_field)
+        if np.isnan(lab).any():
+            # the Python fallback would crash on float(None); a NaN label
+            # must not silently poison the fit
+            raise ValueError("null/NaN label in Avro input (native decode)")
+        off = (
+            cols.f64("offset")
+            if "offset" in cols.plan.num_slots
+            else np.zeros(len(lab))
+        )
+        wgt = (
+            cols.f64("weight")
+            if "weight" in cols.plan.num_slots
+            else np.ones(len(lab))
+        )
+        # only the null sentinel is replaced — inf passes through,
+        # matching the Python fallback
+        off = np.where(np.isnan(off), 0.0, off)
+        wgt = np.where(np.isnan(wgt), 1.0, wgt)
+        for i in range(cols.num_records):
+            lo, hi = int(row_ptr[i]), int(row_ptr[i + 1])
+            g = gix[lo:hi]
+            keep = g >= 0
+            ix = g[keep].tolist()
+            vs = values[lo:hi][keep].tolist()
+            if intercept_index is not None:
+                ix.append(intercept_index)
+                vs.append(1.0)
+            yield ix, vs, float(lab[i]), float(off[i]), float(wgt[i])
+
+    def iter_rows_from_records(self, records, index_map: IndexMap, intercept_index):
+        """Python-codec twin of iter_rows_from_decoded."""
+        for record in records:
+            ix: List[int] = []
+            vs: List[float] = []
+            for key, value in self._record_pairs(record):
+                i = index_map.get_index(key)
+                if i >= 0:
+                    ix.append(i)
+                    vs.append(value)
+            if intercept_index is not None:
+                ix.append(intercept_index)
+                vs.append(1.0)
+            off_v = record.get("offset")
+            wgt_v = record.get("weight")
+            yield (
+                ix, vs, float(record[self.response_field]),
+                0.0 if off_v is None else float(off_v),
+                1.0 if wgt_v is None else float(wgt_v),
+            )
+
     def _index_map_from_decoded(self, decoded) -> IndexMap:
         keys = (
             key
@@ -233,76 +301,22 @@ class AvroInputDataFormat:
 
         rows, labels, offsets, weights = [], [], [], []
         if decoded is not None:
-            for cols in decoded:
-                # vectorized id remap: per-file intern table -> global
-                # index (selected-features filter folded into the table)
-                table = np.asarray(
-                    [
-                        index_map.get_index(s)
-                        if self.selected is None or s in self.selected
-                        else -1
-                        for s in cols.strings
-                    ],
-                    dtype=np.int64,
+            row_iter = (
+                row
+                for cols in decoded
+                for row in self.iter_rows_from_decoded(
+                    cols, index_map, intercept_index
                 )
-                row_ptr, key_ids, values = cols.bag("features")
-                gix = (
-                    table[key_ids]
-                    if len(key_ids)
-                    else np.zeros(0, np.int64)
-                )
-                lab = cols.f64(self.response_field)
-                if np.isnan(lab).any():
-                    # the Python fallback would crash on float(None); a
-                    # NaN label must not silently poison the fit
-                    raise ValueError(
-                        "null/NaN label in Avro input (native decode)"
-                    )
-                off = (
-                    cols.f64("offset")
-                    if "offset" in cols.plan.num_slots
-                    else np.zeros(len(lab))
-                )
-                wgt = (
-                    cols.f64("weight")
-                    if "weight" in cols.plan.num_slots
-                    else np.ones(len(lab))
-                )
-                # only the null sentinel is replaced — inf passes through,
-                # matching the Python fallback
-                off = np.where(np.isnan(off), 0.0, off)
-                wgt = np.where(np.isnan(wgt), 1.0, wgt)
-                for i in range(cols.num_records):
-                    lo, hi = int(row_ptr[i]), int(row_ptr[i + 1])
-                    g = gix[lo:hi]
-                    keep = g >= 0
-                    ix = g[keep].tolist()
-                    vs = values[lo:hi][keep].tolist()
-                    if intercept_index is not None:
-                        ix.append(intercept_index)
-                        vs.append(1.0)
-                    rows.append((ix, vs))
-                labels.extend(lab.tolist())
-                offsets.extend(off.tolist())
-                weights.extend(wgt.tolist())
+            )
         else:
-            for record in read_avro_records(paths):
-                ix: List[int] = []
-                vs: List[float] = []
-                for key, value in self._record_pairs(record):
-                    i = index_map.get_index(key)
-                    if i >= 0:
-                        ix.append(i)
-                        vs.append(value)
-                if intercept_index is not None:
-                    ix.append(intercept_index)
-                    vs.append(1.0)
-                rows.append((ix, vs))
-                labels.append(float(record[self.response_field]))
-                off_v = record.get("offset")
-                wgt_v = record.get("weight")
-                offsets.append(0.0 if off_v is None else float(off_v))
-                weights.append(1.0 if wgt_v is None else float(wgt_v))
+            row_iter = self.iter_rows_from_records(
+                read_avro_records(paths), index_map, intercept_index
+            )
+        for ix, vs, lab, off, wgt in row_iter:
+            rows.append((ix, vs))
+            labels.append(lab)
+            offsets.append(off)
+            weights.append(wgt)
 
         batch = _rows_to_batch(rows, labels, offsets, weights)
         constraints = parse_constraint_string(

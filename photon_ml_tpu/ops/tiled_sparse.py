@@ -45,18 +45,42 @@ Array = jnp.ndarray
 
 @dataclass(frozen=True)
 class TileParams:
-    # Defaults from an on-chip sweep at the ads shape (262k x 64nnz x 1M,
-    # PERF_NOTES.md "tile sweep"): chunk 2048 cut the full fused eval
-    # 36.8 -> 28.9 ms vs chunk 1024 (fewer grid steps amortize per-step
-    # scalar/DMA overhead; tile-boundary padding grew only ~25%), while
-    # window-shape changes (s_hi=s_lo=128, or 64/128) were net losses.
+    # Defaults from on-chip sweeps at the ads shape (262k x 64nnz x 1M,
+    # PERF_NOTES.md "tile sweep"): window-shape changes (s_hi=s_lo=128, or
+    # 64/128) were net losses. ``chunk=None`` sizes the grid-step width
+    # from the dataset's average tile occupancy at build time (pow2 of the
+    # mean entries per tile, clamped to [1024, 4096]) — at the ads shape
+    # that picks 4096, which with the bf16x2w full-width matmuls measured
+    # 23.9 ms vs 25.8 ms for the old fixed 2048 (fewer grid steps, ~99.5%
+    # slot fill because the mean tile holds ~4078 entries).
     s_hi: int = 128
     s_lo: int = 64
-    chunk: int = 2048  # entries per grid step
+    chunk: Optional[int] = None  # entries per grid step; None = auto
+    # Independent compute chains per grid step (chunk lane-sliced into
+    # `split` sub-chunks with no data dependency). Measured on-chip:
+    # Mosaic does NOT overlap the chains (split=2 cost ~1.3-1.7 ms at
+    # every chunk size), so the default stays 1; the knob remains for
+    # kernel experiments. chunk must be divisible by split * 128.
+    split: int = 1
 
     @property
     def window(self) -> int:
         return self.s_hi * self.s_lo
+
+    def resolved(self, n_entries: int, n_tiles_hint: int) -> "TileParams":
+        """Fix ``chunk=None`` from dataset statistics: pow2 of the mean
+        entries per (row-block x feature-block) tile, clamped to
+        [1024, 4096]. Tiny-window test configs (window < 1024) fall back
+        to the window size so toy schedules stay small."""
+        if self.chunk is not None:
+            return self
+        import dataclasses
+
+        avg = max(1, n_entries // max(n_tiles_hint, 1))
+        c = 1 << int(np.round(np.log2(avg))) if avg > 1 else 1024
+        lo = min(1024, self.window)
+        c = max(lo, min(4096, c))
+        return dataclasses.replace(self, chunk=c)
 
 
 class _Schedule(NamedTuple):
@@ -444,6 +468,7 @@ def build_tiled_batch(
     n = labels.shape[0]
     n_pad = max(((n + win - 1) // win) * win, win)
     d_pad = max(((dim + win - 1) // win) * win, win)
+    params = params.resolved(len(vals), (n_pad // win) * (d_pad // win))
 
     # the two passes are independent and numpy's sorts/gathers release the
     # GIL — overlap them (halves the dominant host cost of cold training)
@@ -485,18 +510,11 @@ def build_tiled_batch(
 
 def tiled_batch_from_sparse(batch, dim: int, *, params: TileParams = TileParams()):
     """Convenience: SparseBatch (padded ELL) -> TiledSparseBatch."""
-    indices = np.asarray(batch.indices)
-    values = np.asarray(batch.values)
-    weights = np.asarray(batch.weights)
-    n, k = indices.shape
-    rows = np.repeat(np.arange(n, dtype=np.int64), k)
-    feats = indices.reshape(-1).astype(np.int64)
-    vals = values.reshape(-1).astype(np.float32)
-    # rows with weight 0 are padding — drop their entries
-    vals = np.where(np.repeat(weights > 0, k), vals, 0.0)
+    rows, feats, vals, _ = _sparse_coo(batch)
     return build_tiled_batch(
         rows, feats, vals,
-        np.asarray(batch.labels), np.asarray(batch.offsets), weights,
+        np.asarray(batch.labels), np.asarray(batch.offsets),
+        np.asarray(batch.weights),
         dim, params=params,
     )
 
@@ -605,6 +623,9 @@ def build_sharded_tiled_batch(
     rows_per = -(-n // n_shards)
     R = max(((rows_per + win - 1) // win) * win, win)
     d_pad = max(((dim + win - 1) // win) * win, win)
+    params = params.resolved(
+        len(vals), n_shards * (R // win) * (d_pad // win)
+    )
     shard_of = rows // R
     local_rows = rows - shard_of * R
 
@@ -699,6 +720,10 @@ def feature_shard_tiled_batch(
     R = max(((rows_per + win - 1) // win) * win, win)
     block_dim = -(-dim // model_shards)
     block_dim = max(((block_dim + win - 1) // win) * win, win)
+    params = params.resolved(
+        len(vals),
+        data_shards * model_shards * (R // win) * (block_dim // win),
+    )
 
     ds_of = rows // R
     local_rows = rows - ds_of * R
@@ -833,6 +858,7 @@ def _bilinear_pass_kernel(
     s_lo: int,
     chunk: int,
     mxu: str,
+    split: int = 1,
 ):
     """One grid step: expand src at in_pos, multiply by vals,
     bilinear-scatter into the out_pos output window.
@@ -850,25 +876,15 @@ def _bilinear_pass_kernel(
     row_sel = (
         jax.lax.broadcasted_iota(jnp.int32, (8, L), 0) == r
     )
-    ip = jnp.sum(
+    ip_full = jnp.sum(
         jnp.where(row_sel, in_pos_ref[...], 0), axis=0, keepdims=True
     )  # [1, L] int32, window-local = hi * s_lo + lo
-    op = jnp.sum(
+    op_full = jnp.sum(
         jnp.where(row_sel, out_pos_ref[...], 0), axis=0, keepdims=True
     )
-    v = jnp.sum(
+    v_full = jnp.sum(
         jnp.where(row_sel, vals_ref[...], 0.0), axis=0, keepdims=True
     )  # [1, L] float32
-
-    ih = ip // s_lo
-    il = ip - ih * s_lo
-    oh = op // s_lo
-    ol = op - oh * s_lo
-
-    hi_iota = jax.lax.broadcasted_iota(jnp.int32, (s_hi, L), 0)
-    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (s_lo, L), 0)
-    dims_in = (((0,), (0,)), ((), ()))
-    dims_out = (((1,), (1,)), ((), ()))
 
     def _split(x):
         # hi + lo bf16 terms of an f32 array (~16 mantissa bits kept);
@@ -877,92 +893,124 @@ def _bilinear_pass_kernel(
         lo_part = (x - hi_part.astype(jnp.float32)).astype(jnp.bfloat16)
         return hi_part, lo_part
 
-    if mxu == "bf16x2w":
-        # Same hi+lo bf16 data split as "bf16x2", but each pass's TWO
-        # half-width matmuls fuse into ONE full-width matmul by packing
-        # the hi and lo terms into the otherwise idle half of the MXU
-        # tile (s_lo = 64 uses 64 of 128 sublanes/lanes): identical MAC
-        # count at ~2x the effective utilization.
-        oh_in_hi = (ih == hi_iota).astype(jnp.bfloat16)  # [S_HI, L]
+    def _chain(ip, op, v, width):
+        """One independent gather->contrib->scatter chain over ``width``
+        entry lanes -> update [S_HI, S_LO]."""
+        ih = ip // s_lo
+        il = ip - ih * s_lo
+        oh = op // s_lo
+        ol = op - oh * s_lo
+        hi_iota = jax.lax.broadcasted_iota(jnp.int32, (s_hi, width), 0)
+        lo_iota = jax.lax.broadcasted_iota(jnp.int32, (s_lo, width), 0)
+        dims_in = (((0,), (0,)), ((), ()))
+        dims_out = (((1,), (1,)), ((), ()))
 
-        # gather: pack [hi | lo] along the lane axis -> [S_HI, 2*S_LO]
-        s1, s2 = _split(src_ref[0])
-        src_cat = jnp.concatenate([s1, s2], axis=1)
-        a_cat = jax.lax.dot_general(
-            src_cat, oh_in_hi, dims_in, preferred_element_type=jnp.float32
-        )  # [2*S_LO, L]: rows [0,S_LO) = hi terms, [S_LO,2*S_LO) = lo
-        # fold the halves first (sublane slice at a multiple of 8) so the
-        # mask-reduce runs at [S_LO, L] instead of [2*S_LO, L]
-        a = a_cat[:s_lo] + a_cat[s_lo:]
-        oh_in_lo = (il == lo_iota).astype(jnp.float32)
-        src_g = jnp.sum(a * oh_in_lo, axis=0, keepdims=True)  # [1, L]
-        contrib = v * src_g
-        lo2_iota = jax.lax.broadcasted_iota(jnp.int32, (2 * s_lo, L), 0)
+        if mxu == "bf16x2w":
+            # Same hi+lo bf16 data split as "bf16x2", but each pass's TWO
+            # half-width matmuls fuse into ONE full-width matmul by packing
+            # the hi and lo terms into the otherwise idle half of the MXU
+            # tile (s_lo = 64 uses 64 of 128 sublanes/lanes): identical MAC
+            # count at ~2x the effective utilization.
+            oh_in_hi = (ih == hi_iota).astype(jnp.bfloat16)  # [S_HI, w]
 
-        # scatter: RHS rows [0,S_LO) carry onehot*c_hi, [S_LO,2*S_LO)
-        # carry onehot*c_lo -> one [S_HI, 2*S_LO] product; the two lane
-        # halves fold with an exact VPU add
-        c1, c2 = _split(contrib)
-        oh_out_hi = (oh == hi_iota).astype(jnp.bfloat16)
-        oh_out_lo2 = (ol == jax.lax.rem(lo2_iota, s_lo)).astype(jnp.bfloat16)
-        # arithmetic blend instead of jnp.where: Mosaic cannot relayout
-        # the lane-replicated i1 mask against the sublane-replicated
-        # c-rows; the float blend is exact (half is 0/1)
-        half = (lo2_iota >= s_lo).astype(jnp.bfloat16)  # [2*S_LO, L]
-        csel = c1 * (jnp.bfloat16(1) - half) + c2 * half
-        update_wide = jax.lax.dot_general(
-            oh_out_hi, oh_out_lo2 * csel, dims_out,
-            preferred_element_type=jnp.float32,
-        )  # [S_HI, 2*S_LO]
-        update = update_wide[:, :s_lo] + update_wide[:, s_lo:]
-    elif mxu == "bf16x2":
-        # One-hot matrices are 0/1 — EXACT in bf16. Only the data operand
-        # carries mantissa, so instead of Precision.HIGHEST (6 bf16 MXU
-        # passes for f32 x f32) we split the data side into two bf16 terms
-        # (hi + lo, ~16 mantissa bits, ~1e-5 rel error) and run 2
-        # single-pass bf16 matmuls — 3x the MXU throughput at
-        # GLM-sufficient precision.
-        oh_in_hi = (ih == hi_iota).astype(jnp.bfloat16)  # [S_HI, L]
-        oh_in_lo = (il == lo_iota).astype(jnp.float32)  # [S_LO, L]
+            # gather: pack [hi | lo] along the lane axis -> [S_HI, 2*S_LO]
+            s1, s2 = _split(src_ref[0])
+            src_cat = jnp.concatenate([s1, s2], axis=1)
+            a_cat = jax.lax.dot_general(
+                src_cat, oh_in_hi, dims_in,
+                preferred_element_type=jnp.float32,
+            )  # [2*S_LO, w]: rows [0,S_LO) = hi terms, [S_LO,2*S_LO) = lo
+            # fold the halves first (sublane slice at a multiple of 8) so
+            # the mask-reduce runs at [S_LO, w] instead of [2*S_LO, w]
+            a = a_cat[:s_lo] + a_cat[s_lo:]
+            oh_in_lo = (il == lo_iota).astype(jnp.float32)
+            src_g = jnp.sum(a * oh_in_lo, axis=0, keepdims=True)  # [1, w]
+            contrib = v * src_g
+            lo2_iota = jax.lax.broadcasted_iota(
+                jnp.int32, (2 * s_lo, width), 0
+            )
 
-        # gather: src_g[p] = src2d[ih[p], il[p]]
-        s1, s2 = _split(src_ref[0])
-        a = jax.lax.dot_general(
-            s1, oh_in_hi, dims_in, preferred_element_type=jnp.float32
-        ) + jax.lax.dot_general(
-            s2, oh_in_hi, dims_in, preferred_element_type=jnp.float32
-        )  # [S_LO, L]
-        src_g = jnp.sum(a * oh_in_lo, axis=0, keepdims=True)  # [1, L]
-        contrib = v * src_g  # [1, L]
+            # scatter: RHS rows [0,S_LO) carry onehot*c_hi, [S_LO,2*S_LO)
+            # carry onehot*c_lo -> one [S_HI, 2*S_LO] product; the two lane
+            # halves fold with an exact VPU add
+            c1, c2 = _split(contrib)
+            oh_out_hi = (oh == hi_iota).astype(jnp.bfloat16)
+            oh_out_lo2 = (
+                ol == jax.lax.rem(lo2_iota, s_lo)
+            ).astype(jnp.bfloat16)
+            # arithmetic blend instead of jnp.where: Mosaic cannot relayout
+            # the lane-replicated i1 mask against the sublane-replicated
+            # c-rows; the float blend is exact (half is 0/1)
+            half = (lo2_iota >= s_lo).astype(jnp.bfloat16)  # [2*S_LO, w]
+            csel = c1 * (jnp.bfloat16(1) - half) + c2 * half
+            update_wide = jax.lax.dot_general(
+                oh_out_hi, oh_out_lo2 * csel, dims_out,
+                preferred_element_type=jnp.float32,
+            )  # [S_HI, 2*S_LO]
+            return update_wide[:, :s_lo] + update_wide[:, s_lo:]
+        elif mxu == "bf16x2":
+            # One-hot matrices are 0/1 — EXACT in bf16. Only the data
+            # operand carries mantissa, so instead of Precision.HIGHEST (6
+            # bf16 MXU passes for f32 x f32) we split the data side into
+            # two bf16 terms (hi + lo, ~16 mantissa bits, ~1e-5 rel error)
+            # and run 2 single-pass bf16 matmuls — 3x the MXU throughput
+            # at GLM-sufficient precision.
+            oh_in_hi = (ih == hi_iota).astype(jnp.bfloat16)  # [S_HI, w]
+            oh_in_lo = (il == lo_iota).astype(jnp.float32)  # [S_LO, w]
 
-        oh_out_hi = (oh == hi_iota).astype(jnp.bfloat16)
-        oh_out_lo = (ol == lo_iota).astype(jnp.bfloat16)
-        # A @ B^T via lane/entry contraction. oh_out_lo is 0/1 and the
-        # contrib terms are already bf16, so each product below is exact.
-        c1, c2 = _split(contrib)
-        update = jax.lax.dot_general(
-            oh_out_hi, oh_out_lo * c1, dims_out,
-            preferred_element_type=jnp.float32,
-        ) + jax.lax.dot_general(
-            oh_out_hi, oh_out_lo * c2, dims_out,
-            preferred_element_type=jnp.float32,
-        )  # [S_HI, S_LO]
-    else:  # "highest": full f32 emulation, ~3x slower, ~1e-7 rel error
-        oh_in_hi = (ih == hi_iota).astype(jnp.float32)
-        oh_in_lo = (il == lo_iota).astype(jnp.float32)
-        a = jax.lax.dot_general(
-            src_ref[0], oh_in_hi, dims_in,
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        src_g = jnp.sum(a * oh_in_lo, axis=0, keepdims=True)
-        contrib = v * src_g
-        oh_out_hi = (oh == hi_iota).astype(jnp.float32)
-        oh_out_lo = (ol == lo_iota).astype(jnp.float32)
-        update = jax.lax.dot_general(
-            oh_out_hi, oh_out_lo * contrib, dims_out,
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            # gather: src_g[p] = src2d[ih[p], il[p]]
+            s1, s2 = _split(src_ref[0])
+            a = jax.lax.dot_general(
+                s1, oh_in_hi, dims_in, preferred_element_type=jnp.float32
+            ) + jax.lax.dot_general(
+                s2, oh_in_hi, dims_in, preferred_element_type=jnp.float32
+            )  # [S_LO, w]
+            src_g = jnp.sum(a * oh_in_lo, axis=0, keepdims=True)  # [1, w]
+            contrib = v * src_g  # [1, w]
+
+            oh_out_hi = (oh == hi_iota).astype(jnp.bfloat16)
+            oh_out_lo = (ol == lo_iota).astype(jnp.bfloat16)
+            # A @ B^T via lane/entry contraction. oh_out_lo is 0/1 and the
+            # contrib terms are already bf16, so each product is exact.
+            c1, c2 = _split(contrib)
+            return jax.lax.dot_general(
+                oh_out_hi, oh_out_lo * c1, dims_out,
+                preferred_element_type=jnp.float32,
+            ) + jax.lax.dot_general(
+                oh_out_hi, oh_out_lo * c2, dims_out,
+                preferred_element_type=jnp.float32,
+            )  # [S_HI, S_LO]
+        else:  # "highest": full f32 emulation, ~3x slower, ~1e-7 rel error
+            oh_in_hi = (ih == hi_iota).astype(jnp.float32)
+            oh_in_lo = (il == lo_iota).astype(jnp.float32)
+            a = jax.lax.dot_general(
+                src_ref[0], oh_in_hi, dims_in,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            src_g = jnp.sum(a * oh_in_lo, axis=0, keepdims=True)
+            contrib = v * src_g
+            oh_out_hi = (oh == hi_iota).astype(jnp.float32)
+            oh_out_lo = (ol == lo_iota).astype(jnp.float32)
+            return jax.lax.dot_general(
+                oh_out_hi, oh_out_lo * contrib, dims_out,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+
+    # `split` independent chains over lane slices of the chunk: no data
+    # dependency between them, so the scheduler can overlap one chain's
+    # VPU one-hot build with another's MXU passes.
+    w = L // split
+    update = _chain(
+        ip_full[:, :w], op_full[:, :w], v_full[:, :w], w
+    )
+    for h in range(1, split):
+        update = update + _chain(
+            ip_full[:, h * w:(h + 1) * w],
+            op_full[:, h * w:(h + 1) * w],
+            v_full[:, h * w:(h + 1) * w],
+            w,
         )
 
     @pl.when(step_init_ref[g] == 1)
@@ -993,6 +1041,7 @@ def _run_bilinear_pass(
         s_lo=params.s_lo,
         chunk=L,
         mxu=mxu,
+        split=params.split,
     )
     entry_spec = pl.BlockSpec((8, L), lambda g, so, si, st: (g // 8, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(

@@ -1,0 +1,127 @@
+"""Host-driven L-BFGS for objectives that cannot be traced into jit.
+
+The in-jit optimizer (optim.lbfgs.minimize_lbfgs) compiles the whole
+while_loop — correct for device-resident data, impossible when each
+objective evaluation performs host IO (the streaming >RAM input path,
+io/streaming.py). This variant drives the SAME math from Python:
+two-loop recursion, cautious memory updates (skip pairs with y.s <= eps),
+steepest-descent fallback, Armijo backtracking with the same constants,
+and the reference's convergence rules (Optimizer.scala:156-170 via
+optim.common.check_convergence). Per-iteration host control costs
+microseconds against evaluations that stream gigabytes from disk.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from photon_ml_tpu.optim.common import (
+    GRADIENT_WITHIN_TOLERANCE,
+    MAX_ITERATIONS,
+    NOT_CONVERGED,
+    OptResult,
+    Tracker,
+    check_convergence,
+)
+
+Array = jnp.ndarray
+ValueAndGrad = Callable[[Array], Tuple[Array, Array]]
+
+_MEM_EPS = 1e-10  # cautious-update threshold, matches optim.lbfgs
+
+
+def _direction(g: Array, s_list: List[Array], y_list: List[Array]) -> Array:
+    """Two-loop recursion over the host-side (s, y) history."""
+    q = -g
+    alphas = []
+    rhos = [1.0 / float(jnp.vdot(y, s)) for s, y in zip(s_list, y_list)]
+    for s, y, rho in zip(reversed(s_list), reversed(y_list), reversed(rhos)):
+        a = rho * float(jnp.vdot(s, q))
+        q = q - a * y
+        alphas.append((a, rho))
+    if s_list:
+        s, y = s_list[-1], y_list[-1]
+        gamma = float(jnp.vdot(s, y)) / max(float(jnp.vdot(y, y)), 1e-30)
+        q = q * gamma
+    for (a, rho), s, y in zip(reversed(alphas), s_list, y_list):
+        b = rho * float(jnp.vdot(y, q))
+        q = q + (a - b) * s
+    return q
+
+
+def minimize_lbfgs_host(
+    value_and_grad_fn: ValueAndGrad,
+    w0: Array,
+    *,
+    max_iter: int = 100,
+    tol: float = 1e-7,
+    history: int = 10,
+    ls_max_steps: int = 24,
+    ls_c1: float = 1e-4,
+    ls_shrink: float = 0.5,
+) -> OptResult:
+    """Minimize a smooth objective whose evaluations run host-side code.
+
+    Same defaults and convergence semantics as minimize_lbfgs
+    (LBFGS.scala:152-156; Optimizer.scala:156-170)."""
+    w = jnp.asarray(w0, jnp.float32)
+    f, g = value_and_grad_fn(w)
+    f0 = float(f)
+    g0_norm = float(jnp.linalg.norm(g))
+    tracker = Tracker.create(max_iter + 1).record(f, jnp.linalg.norm(g))
+
+    s_list: List[Array] = []
+    y_list: List[Array] = []
+    reason = (
+        GRADIENT_WITHIN_TOLERANCE if g0_norm == 0.0 else NOT_CONVERGED
+    )
+    it = 0
+    while reason == NOT_CONVERGED:
+        d = _direction(g, s_list, y_list)
+        if float(jnp.vdot(d, g)) >= 0:  # not a descent direction
+            d = -g
+        t = 1.0 if s_list else 1.0 / max(float(jnp.linalg.norm(d)), 1.0)
+        gd = float(jnp.vdot(g, d))
+        ok = False
+        f_new, g_new, w_new = f, g, w
+        for _ in range(ls_max_steps):
+            w_t = w + t * d
+            f_t, g_t = value_and_grad_fn(w_t)
+            if float(f_t) <= float(f) + ls_c1 * t * gd and bool(
+                jnp.isfinite(f_t)
+            ):
+                ok = True
+                w_new, f_new, g_new = w_t, f_t, g_t
+                break
+            t *= ls_shrink
+        it += 1
+        if ok:
+            s = w_new - w
+            y = g_new - g
+            if float(jnp.vdot(y, s)) > _MEM_EPS:  # cautious update
+                s_list.append(s)
+                y_list.append(y)
+                if len(s_list) > history:
+                    s_list.pop(0)
+                    y_list.pop(0)
+            g_norm = float(jnp.linalg.norm(g_new))
+            reason = int(check_convergence(
+                jnp.int32(it), f, f_new, jnp.float32(g_norm),
+                jnp.float32(f0), jnp.float32(g0_norm),
+                max_iter=max_iter, tol=tol,
+            ))
+            w, f, g = w_new, f_new, g_new
+            tracker = tracker.record(f, jnp.float32(g_norm))
+        else:
+            # stalled line search: no further progress possible
+            reason = MAX_ITERATIONS
+    return OptResult(
+        coefficients=w,
+        value=jnp.float32(float(f)),
+        grad_norm=jnp.linalg.norm(g),
+        iterations=jnp.int32(it),
+        reason=jnp.int32(reason),
+        tracker=tracker,
+    )

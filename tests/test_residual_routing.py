@@ -1,0 +1,152 @@
+"""ResidualRouter: the entity_all_to_all consumer that re-keys per-row
+residual offsets to entity-owning devices each iteration (the
+addScoresToOffsets shuffle analog, RandomEffectDataSet.scala:55-74)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.game import build_game_dataset
+from photon_ml_tpu.game.config import RandomEffectDataConfiguration
+from photon_ml_tpu.game.coordinate import (
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_ml_tpu.game.coordinate_descent import CoordinateDescent
+from photon_ml_tpu.game.random_effect import RandomEffectOptimizationProblem
+from photon_ml_tpu.game.random_effect_data import build_random_effect_dataset
+from photon_ml_tpu.game.residual_routing import ResidualRouter
+from photon_ml_tpu.ops.losses import LOGISTIC
+from photon_ml_tpu.optim.config import (
+    OptimizerConfig,
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_ml_tpu.optim.problem import create_glm_problem
+from photon_ml_tpu.parallel.mesh import make_mesh
+from photon_ml_tpu.task import TaskType
+
+from tests.test_game import SHARDS, make_records
+
+
+def _re_dataset(rng, n=220, n_users=13, cap=None):
+    recs, _, _ = make_records(rng, n=n, n_users=n_users)
+    ds = build_game_dataset(recs, SHARDS, ["userId"])
+    red = build_random_effect_dataset(
+        ds,
+        RandomEffectDataConfiguration(
+            "userId", "userShard", active_data_upper_bound=cap
+        ),
+    )
+    return ds, red
+
+
+class TestRouter:
+    def test_routed_slabs_match_direct_gather(self, rng):
+        ds, red = _re_dataset(rng)
+        mesh = make_mesh()
+        router = ResidualRouter(mesh, red)
+        offsets = rng.normal(size=ds.num_rows).astype(np.float32)
+        flat = router.route(jnp.asarray(offsets))
+        for bi, b in enumerate(red.buckets):
+            slab = np.asarray(router.bucket_slab(flat, bi, b.capacity))
+            # oracle: direct host gather into the same padded layout
+            e_loc = router.e_locs[bi]
+            want = np.zeros((router.n_dev * e_loc, b.capacity), np.float32)
+            safe = np.maximum(b.row_index, 0)
+            got_rows = np.where(b.row_index >= 0, offsets[safe], 0.0)
+            want[: b.num_entities] = got_rows
+            np.testing.assert_allclose(slab, want, rtol=1e-6)
+
+    def test_reservoir_capped_dataset_routes_losslessly(self, rng):
+        ds, red = _re_dataset(rng, n=400, n_users=7, cap=8)
+        mesh = make_mesh()
+        router = ResidualRouter(mesh, red)
+        offsets = rng.normal(size=ds.num_rows).astype(np.float32)
+        flat = router.route(jnp.asarray(offsets))
+        # every active row's offset must land exactly once
+        total_active = sum(
+            int((b.row_index >= 0).sum()) for b in red.buckets
+        )
+        nz = int(np.count_nonzero(np.asarray(flat)))
+        # (offsets are continuous so exact zeros are measure-zero)
+        assert nz == total_active
+
+    def test_update_bank_mesh_uses_routed_offsets(self, rng):
+        # mesh update_bank with residuals == single-device update_bank
+        ds, red = _re_dataset(rng)
+        offsets = jnp.asarray(rng.normal(size=ds.num_rows).astype(np.float32))
+        bank0 = jnp.zeros((red.num_entities, red.local_dim), jnp.float32)
+
+        def problem(mesh):
+            return RandomEffectOptimizationProblem(
+                LOGISTIC,
+                OptimizerConfig(max_iter=15),
+                RegularizationContext(RegularizationType.L2),
+                reg_weight=1.0,
+                mesh=mesh,
+            )
+
+        bank_single, _ = problem(None).update_bank(
+            bank0, red, residual_offsets=offsets
+        )
+        bank_mesh, _ = problem(make_mesh()).update_bank(
+            bank0, red, residual_offsets=offsets
+        )
+        np.testing.assert_allclose(
+            np.asarray(bank_mesh), np.asarray(bank_single), atol=2e-4
+        )
+
+
+class TestMeshSteadyState:
+    def test_mesh_cd_no_implicit_d2h_at_steady_state(self, rng):
+        # VERDICT r2 items 5+6 done-criterion: CPU-mesh CoordinateDescent
+        # under the transfer guard once caches/routers are warm
+        recs, _, _ = make_records(rng, n=200, n_users=6)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        red = build_random_effect_dataset(
+            ds, RandomEffectDataConfiguration("userId", "userShard")
+        )
+        mesh = make_mesh()
+        coords = {
+            "global": FixedEffectCoordinate(
+                name="global",
+                dataset=ds,
+                problem=create_glm_problem(
+                    TaskType.LOGISTIC_REGRESSION,
+                    ds.shards["globalShard"].dim,
+                    config=OptimizerConfig(max_iter=5),
+                    regularization=RegularizationContext(
+                        RegularizationType.L2
+                    ),
+                ),
+                feature_shard_id="globalShard",
+                reg_weight=0.1,
+                mesh=mesh,
+            ),
+            "per-user": RandomEffectCoordinate(
+                name="per-user",
+                dataset=ds,
+                re_dataset=red,
+                problem=RandomEffectOptimizationProblem(
+                    LOGISTIC,
+                    OptimizerConfig(max_iter=5),
+                    RegularizationContext(RegularizationType.L2),
+                    reg_weight=1.0,
+                    mesh=mesh,
+                ),
+            ),
+        }
+
+        def make_cd():
+            return CoordinateDescent(
+                coords, ds, TaskType.LOGISTIC_REGRESSION,
+                update_sequence=["global", "per-user"],
+            )
+
+        make_cd().run(1)  # warm caches, routers, compiled programs
+        with jax.transfer_guard_device_to_host("disallow"):
+            res = make_cd().run(1)
+        assert np.isfinite(res.objective_history[-1])
