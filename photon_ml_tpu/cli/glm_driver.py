@@ -39,7 +39,7 @@ from photon_ml_tpu.events import (
 )
 from photon_ml_tpu.io import schemas
 from photon_ml_tpu.io.avro_codec import write_container
-from photon_ml_tpu.io.input_format import create_input_format
+from photon_ml_tpu.io.input_format import LoadedData, create_input_format
 from photon_ml_tpu.io.model_io import save_glm_models_avro, write_models_in_text
 from photon_ml_tpu.models.glm import compute_margins, compute_means
 from photon_ml_tpu.ops.losses import loss_for_task
@@ -135,6 +135,11 @@ class GLMParams:
     # >HBM-coefficient path (SURVEY §2.3 coefficient parallelism)
     distributed: str = "auto"
     model_shards: Optional[int] = None  # model-axis size for "feature"
+    # Stream the training data from disk per objective evaluation
+    # (io/streaming.py): datasets larger than host RAM train with bounded
+    # memory — the GLMSuite/Spark MEMORY_AND_DISK analog. Avro + smooth
+    # (L2/none) L-BFGS only; validation data still loads in memory.
+    streaming: bool = False
     # Multi-host orchestration (the SparkContextConfiguration analog):
     # address of process 0's coordination service. None = single-process.
     coordinator_address: Optional[str] = None
@@ -215,6 +220,37 @@ class GLMParams:
             raise ValueError(
                 "validate-per-iteration requires a validating data directory"
             )
+        if self.streaming:
+            unsupported = []
+            if self.input_format.strip().upper() != "AVRO":
+                unsupported.append("non-Avro input")
+            if self.regularization_type in (
+                RegularizationType.L1, RegularizationType.ELASTIC_NET,
+            ):
+                unsupported.append("L1/elastic-net")
+            if self.optimizer_type != OptimizerType.LBFGS:
+                unsupported.append(f"optimizer {self.optimizer_type.value}")
+            if self.normalization_type != NormalizationType.NONE:
+                unsupported.append("normalization")
+            if self.constraint_string is not None:
+                unsupported.append("box constraints")
+            if self.compute_variances:
+                unsupported.append("variance computation")
+            if self.summarization_output_dir:
+                unsupported.append("feature summarization")
+            if self.diagnostic_mode != DiagnosticMode.NONE:
+                unsupported.append("diagnostics")
+            if self.validate_per_iteration:
+                unsupported.append("validate-per-iteration")
+            if self.distributed == "feature":
+                unsupported.append("feature-sharded training")
+            if self.offheap_indexmap_dir:
+                unsupported.append("offheap index maps")
+            if unsupported:
+                raise ValueError(
+                    "streaming training does not support: "
+                    + ", ".join(unsupported)
+                )
 
 
 class GLMDriver:
@@ -316,6 +352,43 @@ class GLMDriver:
                     "offheap index map: %d features from %s",
                     prebuilt.size, p.offheap_indexmap_dir,
                 )
+            if p.streaming:
+                # one bounded-memory pass: vocabulary + staging shape
+                # (no full materialization — the train data may exceed RAM)
+                from photon_ml_tpu.io.streaming import scan_stream
+                from photon_ml_tpu.utils.index_map import intercept_key
+
+                index_map, stats = scan_stream(train_paths, fmt)
+                icept = (
+                    index_map.get_index(intercept_key())
+                    if p.add_intercept else -1
+                )
+                self._data = LoadedData(
+                    batch=None,
+                    index_map=index_map,
+                    num_features=index_map.size,
+                    intercept_index=icept if icept >= 0 else None,
+                )
+                self._stream = (train_paths, stats)
+                self.logger.info(
+                    "streaming scan: %d examples, %d features, "
+                    "max %d nnz/row",
+                    stats.num_rows, index_map.size, stats.max_nnz,
+                )
+                if p.data_validation_type != DataValidationType.VALIDATE_DISABLED:
+                    # chunk-wise sanity checks — same DataValidators rules
+                    # as the in-memory path, still bounded memory
+                    from photon_ml_tpu.io.streaming import iter_chunks
+
+                    for chunk in iter_chunks(
+                        train_paths, fmt, index_map,
+                        rows_per_chunk=65536, nnz_width=stats.max_nnz,
+                    ):
+                        sanity_check_data(
+                            chunk, p.task, p.data_validation_type
+                        )
+                self._advance(DriverStage.PREPROCESSED)
+                return
             data = fmt.load(
                 train_paths,
                 index_map=prebuilt,
@@ -377,7 +450,34 @@ class GLMDriver:
         with self.timer.time("train"):
             data = self._data
             mesh = self._mesh()
-            if p.distributed == "feature" and mesh is not None:
+            if p.streaming:
+                from photon_ml_tpu.training import train_streaming_glm
+
+                train_paths, stats = self._stream
+                if mesh is not None:
+                    self.logger.warning(
+                        "streaming training runs single-device; the "
+                        "%d-device mesh is not used (stream the input "
+                        "per process via multihost.process_shard instead)",
+                        mesh.devices.size,
+                    )
+                self.logger.info(
+                    "training in streaming mode (%d rows per full-batch "
+                    "pass)",
+                    stats.num_rows,
+                )
+                self.models, self.results, _ = train_streaming_glm(
+                    train_paths,
+                    p.task,
+                    regularization_type=p.regularization_type,
+                    regularization_weights=p.regularization_weights,
+                    max_iter=p.max_num_iterations or 100,
+                    tolerance=p.tolerance or 1e-7,
+                    fmt=self._fmt,
+                    index_map=data.index_map,
+                    stats=stats,
+                )
+            elif p.distributed == "feature" and mesh is not None:
                 from photon_ml_tpu.training import train_feature_sharded
 
                 self.logger.info(
@@ -395,6 +495,7 @@ class GLMDriver:
                     max_iter=p.max_num_iterations or 100,
                     tolerance=p.tolerance or 1e-7,
                     intercept_index=data.intercept_index,
+                    kernel=p.kernel,
                 )
             else:
                 if mesh is not None:
@@ -715,6 +816,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="model-axis size for --distributed feature (default 2)",
     )
     ap.add_argument(
+        "--streaming", default="false",
+        help="true: stream the training data from disk per evaluation "
+        "(bounded memory for >RAM datasets; Avro + L2/none L-BFGS only)",
+    )
+    ap.add_argument(
         "--coordinator-address", default=None,
         help="host:port of process 0 for multi-host runs (jax.distributed)",
     )
@@ -788,6 +894,7 @@ def params_from_args(argv=None) -> GLMParams:
         job_name=ns.job_name,
         kernel=ns.kernel,
         distributed=ns.distributed,
+        streaming=_bool(ns.streaming),
         model_shards=ns.model_shards,
         coordinator_address=ns.coordinator_address,
         num_processes=ns.num_processes,

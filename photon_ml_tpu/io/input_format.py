@@ -157,41 +157,50 @@ class AvroInputDataFormat:
             if self.selected is None or key in self.selected:
                 yield key, float(f["value"])
 
-    def _decode_native(self, paths):
-        """Try the native column decoder; None -> caller falls back to the
-        Python codec. Returns one DecodedColumns per file."""
+    def decode_file(self, path: str):
+        """Native column decode of ONE file; None -> caller uses the
+        Python codec. The single definition of the native-decode fallback
+        contract (schema shape check, recoverable errors), shared by the
+        in-memory loader and the streaming path."""
         from photon_ml_tpu.io import native_avro
         from photon_ml_tpu.io.avro_codec import read_container_schema
-        from photon_ml_tpu.io.paths import expand_input_paths
 
         if not native_avro.available():
             return None
+        try:
+            schema = read_container_schema(path)
+            names = {f["name"] for f in schema.get("fields", [])}
+            if "features" not in names or self.response_field not in names:
+                return None
+            numeric = [
+                f
+                for f in (self.response_field, "offset", "weight")
+                if f in names
+            ]
+            plan = native_avro.Plan(schema).compile(
+                numeric_fields=numeric, bag_fields=["features"]
+            )
+            return native_avro.decode_columns(path, plan)
+        except (native_avro.PlanError, ValueError, OSError):
+            return None
+
+    def _decode_native(self, paths):
+        """Try the native column decoder for EVERY file; None -> caller
+        falls back to the Python codec (all files or none, so one loader
+        invocation never mixes decode semantics)."""
+        from photon_ml_tpu.io.paths import expand_input_paths
+
         files = list(
             expand_input_paths(paths, lambda fn: fn.endswith(".avro"))
         )
         if not files:
             return None
         out = []
-        try:
-            for p in files:
-                schema = read_container_schema(p)
-                names = {f["name"] for f in schema.get("fields", [])}
-                if (
-                    "features" not in names
-                    or self.response_field not in names
-                ):
-                    return None
-                numeric = [
-                    f
-                    for f in (self.response_field, "offset", "weight")
-                    if f in names
-                ]
-                plan = native_avro.Plan(schema).compile(
-                    numeric_fields=numeric, bag_fields=["features"]
-                )
-                out.append(native_avro.decode_columns(p, plan))
-        except (native_avro.PlanError, ValueError, OSError):
-            return None
+        for p in files:
+            cols = self.decode_file(p)
+            if cols is None:
+                return None
+            out.append(cols)
         return out
 
     def iter_rows_from_decoded(self, cols, index_map: IndexMap, intercept_index):

@@ -43,34 +43,13 @@ def _iter_file_rows(path: str, fmt, index_map: IndexMap):
     time), record-at-a-time Python codec otherwise. The remap semantics
     live in AvroInputDataFormat.iter_rows_from_{decoded,records} — one
     definition shared with the in-memory loader."""
-    from photon_ml_tpu.io import native_avro
-    from photon_ml_tpu.io.avro_codec import (
-        read_avro_records,
-        read_container_schema,
-    )
+    from photon_ml_tpu.io.avro_codec import read_avro_records
 
     icept = (
         index_map.get_index(intercept_key()) if fmt.add_intercept else -1
     )
     icept = icept if icept >= 0 else None
-    decoded = None
-    if native_avro.available():
-        try:
-            schema = read_container_schema(path)
-            names = {f["name"] for f in schema.get("fields", [])}
-            if "features" in names and fmt.response_field in names:
-                numeric = [
-                    f
-                    for f in (fmt.response_field, "offset", "weight")
-                    if f in names
-                ]
-                plan = native_avro.Plan(schema).compile(
-                    numeric_fields=numeric, bag_fields=["features"]
-                )
-                decoded = native_avro.decode_columns(path, plan)
-        except (native_avro.PlanError, ValueError, OSError):
-            decoded = None
-
+    decoded = fmt.decode_file(path)
     if decoded is not None:
         yield from fmt.iter_rows_from_decoded(decoded, index_map, icept)
     else:
@@ -80,21 +59,63 @@ def _iter_file_rows(path: str, fmt, index_map: IndexMap):
 
 
 def scan_stream(paths, fmt) -> Tuple[IndexMap, StreamStats]:
-    """One streaming pass: build the feature IndexMap and the shape stats
-    (row count, max per-row nnz incl. intercept) that fix the staging
-    batch. RSS stays bounded by one file."""
+    """One streaming pass over the files — ONE AT A TIME — collecting the
+    vocabulary, the row count, and the max per-row nnz (incl. intercept)
+    that fix the staging batch. Unlike fmt.build_index_map (which the
+    in-memory loader uses and which holds every file's decoded columns at
+    once), this never keeps more than one decoded file resident — the
+    whole point of the streaming path is datasets larger than RAM."""
+    from photon_ml_tpu.io.avro_codec import read_avro_records
     from photon_ml_tpu.io.paths import expand_input_paths
 
     files = sorted(expand_input_paths(paths, lambda fn: fn.endswith(".avro")))
     if not files:
         raise ValueError(f"no .avro inputs under {paths!r}")
-    index_map = fmt.build_index_map(files)
+    keys = set()
     num_rows = 0
-    max_nnz = 1
+    max_live = 0  # per-row live (nonzero, selected) feature count
     for path in files:
-        for ix, _vs, _l, _o, _w in _iter_file_rows(path, fmt, index_map):
-            num_rows += 1
-            max_nnz = max(max_nnz, len(ix))
+        decoded = fmt.decode_file(path)
+        if decoded is not None:
+            sel = np.asarray(
+                [
+                    fmt.selected is None or s in fmt.selected
+                    for s in decoded.strings
+                ]
+            )
+            keys.update(
+                s
+                for s, ok in zip(decoded.strings, sel)
+                if ok
+            )
+            # per-row width = entries the row iterators will emit: every
+            # entry whose key is selected (zero VALUES are kept — they are
+            # in the map and emitted by iter_rows_from_decoded)
+            row_ptr, key_ids, _values = decoded.bag("features")
+            live = (
+                sel[key_ids] if len(key_ids) else np.zeros(0, bool)
+            )
+            counts = np.add.reduceat(
+                np.concatenate([live.astype(np.int64), [0]]),
+                row_ptr[:-1],
+            ) if decoded.num_records else np.zeros(0, np.int64)
+            # reduceat quirk: empty rows (row_ptr[i] == row_ptr[i+1])
+            # return the element at the index instead of 0
+            widths = np.diff(row_ptr)
+            counts = np.where(widths > 0, counts, 0)
+            if len(counts):
+                max_live = max(max_live, int(counts.max()))
+            num_rows += decoded.num_records
+        else:
+            for record in read_avro_records([path]):
+                live = 0
+                for key, _v in fmt._record_pairs(record):
+                    keys.add(key)
+                    live += 1
+                max_live = max(max_live, live)
+                num_rows += 1
+    index_map = IndexMap.build(iter(keys), add_intercept=fmt.add_intercept)
+    max_nnz = max(max_live + (1 if fmt.add_intercept else 0), 1)
     return index_map, StreamStats(num_rows=num_rows, max_nnz=max_nnz)
 
 
@@ -123,12 +144,16 @@ def iter_chunks(
     fill = 0
 
     def emit():
+        # COPIES are load-bearing: jnp.asarray on the CPU backend can
+        # alias numpy memory zero-copy and dispatch is async, so handing
+        # out a view of the reused staging buffers would let the next
+        # chunk's refill race the consumer's read of this one.
         return SparseBatch(
-            indices=jnp.asarray(ix_buf),
-            values=jnp.asarray(v_buf),
-            labels=jnp.asarray(lab_buf),
-            offsets=jnp.asarray(off_buf),
-            weights=jnp.asarray(wgt_buf),
+            indices=jnp.asarray(ix_buf.copy()),
+            values=jnp.asarray(v_buf.copy()),
+            labels=jnp.asarray(lab_buf.copy()),
+            offsets=jnp.asarray(off_buf.copy()),
+            weights=jnp.asarray(wgt_buf.copy()),
         )
 
     for path in files:

@@ -77,7 +77,7 @@ class TileParams:
         import dataclasses
 
         avg = max(1, n_entries // max(n_tiles_hint, 1))
-        c = 1 << int(np.round(np.log2(avg))) if avg > 1 else 1024
+        c = 1 << int(np.round(np.log2(avg)))
         lo = min(1024, self.window)
         c = max(lo, min(4096, c))
         return dataclasses.replace(self, chunk=c)
@@ -926,25 +926,21 @@ def _bilinear_pass_kernel(
             oh_in_lo = (il == lo_iota).astype(jnp.float32)
             src_g = jnp.sum(a * oh_in_lo, axis=0, keepdims=True)  # [1, w]
             contrib = v * src_g
-            lo2_iota = jax.lax.broadcasted_iota(
-                jnp.int32, (2 * s_lo, width), 0
-            )
 
             # scatter: RHS rows [0,S_LO) carry onehot*c_hi, [S_LO,2*S_LO)
             # carry onehot*c_lo -> one [S_HI, 2*S_LO] product; the two lane
-            # halves fold with an exact VPU add
+            # halves fold with an exact VPU add. The RHS is built from ONE
+            # [S_LO, w] one-hot compare + a sublane concat (round 2 used a
+            # [2*S_LO, w] compare + arithmetic 0/1 blend — twice the VPU
+            # compare work for the same matrix).
             c1, c2 = _split(contrib)
             oh_out_hi = (oh == hi_iota).astype(jnp.bfloat16)
-            oh_out_lo2 = (
-                ol == jax.lax.rem(lo2_iota, s_lo)
-            ).astype(jnp.bfloat16)
-            # arithmetic blend instead of jnp.where: Mosaic cannot relayout
-            # the lane-replicated i1 mask against the sublane-replicated
-            # c-rows; the float blend is exact (half is 0/1)
-            half = (lo2_iota >= s_lo).astype(jnp.bfloat16)  # [2*S_LO, w]
-            csel = c1 * (jnp.bfloat16(1) - half) + c2 * half
+            oh_out_lo = (ol == lo_iota).astype(jnp.bfloat16)
+            rhs = jnp.concatenate(
+                [oh_out_lo * c1, oh_out_lo * c2], axis=0
+            )  # [2*S_LO, w]
             update_wide = jax.lax.dot_general(
-                oh_out_hi, oh_out_lo2 * csel, dims_out,
+                oh_out_hi, rhs, dims_out,
                 preferred_element_type=jnp.float32,
             )  # [S_HI, 2*S_LO]
             return update_wide[:, :s_lo] + update_wide[:, s_lo:]
@@ -1022,6 +1018,12 @@ def _bilinear_pass_kernel(
         out_ref[0] = out_ref[0] + update
 
 
+# Mosaic compiler-params experiment hook (None = defaults). Sweeps set
+# this to probe e.g. dimension_semantics / vmem_limit_bytes; production
+# leaves it None.
+_COMPILER_PARAMS = None
+
+
 def _run_bilinear_pass(
     sched: _Schedule,
     src: Array,  # [num_in_blocks, S_HI, S_LO]
@@ -1035,6 +1037,11 @@ def _run_bilinear_pass(
     """-> [num_out_blocks, S_HI, S_LO] accumulated output."""
     G = sched.num_steps
     L = params.chunk
+    if L % max(params.split, 1) != 0:
+        # a non-dividing split would silently drop the remainder lanes
+        raise ValueError(
+            f"chunk {L} is not divisible by split {params.split}"
+        )
     kernel = partial(
         _bilinear_pass_kernel,
         s_hi=params.s_hi,
@@ -1068,6 +1075,7 @@ def _run_bilinear_pass(
             (num_out_blocks, params.s_hi, params.s_lo), jnp.float32
         ),
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(
         sched.step_out,
         sched.step_in,

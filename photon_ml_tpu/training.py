@@ -277,6 +277,9 @@ def train_streaming_glm(
     add_intercept: bool = True,
     field_names: str = "TRAINING_EXAMPLE",
     warm_start: bool = True,
+    fmt=None,
+    index_map=None,
+    stats=None,
 ):
     """Train a GLM over Avro inputs LARGER than host RAM: every objective
     evaluation streams fixed-shape chunks from disk (io/streaming.py), so
@@ -305,10 +308,12 @@ def train_streaming_glm(
         raise ValueError(
             "streaming training supports L2/none regularization only"
         )
-    fmt = AvroInputDataFormat(
-        add_intercept=add_intercept, field_names=field_names
-    )
-    index_map, stats = scan_stream(paths, fmt)
+    if fmt is None:
+        fmt = AvroInputDataFormat(
+            add_intercept=add_intercept, field_names=field_names
+        )
+    if index_map is None or stats is None:
+        index_map, stats = scan_stream(paths, fmt)
     objective = StreamingGLMObjective(
         paths, fmt, index_map, stats, task, rows_per_chunk=rows_per_chunk
     )

@@ -35,6 +35,9 @@ from photon_ml_tpu.models import Coefficients, logistic_regression_model
 from photon_ml_tpu.optim.problem import create_glm_problem
 from photon_ml_tpu.task import TaskType
 
+# Bootstrap/fitting diagnostics retrain many models: integration tier
+pytestmark = pytest.mark.slow
+
 
 def logistic_batch(rng, n=400, d=5, w=None):
     x = rng.normal(size=(n, d)).astype(np.float32)

@@ -292,6 +292,7 @@ class TestRandomEffectSolver:
         assert np.count_nonzero(s[:200]) > 150
 
 
+@pytest.mark.slow
 class TestCoordinateDescent:
     def _setup(self, rng, task=TaskType.LOGISTIC_REGRESSION):
         recs, _, _ = make_records(rng, n=300, n_users=8)
@@ -605,6 +606,7 @@ class TestLargeScaleREBuild:
         assert build_s < 8.0, build_s
 
 
+@pytest.mark.slow
 class TestDeviceResidentResiduals:
     """VERDICT r2 item 6: at steady state the coordinate-descent loop does
     no implicit device->host transfer — residuals, offsets, and scores

@@ -33,6 +33,10 @@ from photon_ml_tpu.io import schemas
 from photon_ml_tpu.io.avro_codec import read_avro_records, write_container
 from photon_ml_tpu.task import TaskType
 
+# Driver end-to-end runs (full stage pipelines, file IO,
+# multi-lambda fits): integration tier
+pytestmark = pytest.mark.slow
+
 GAME_REF = "/root/reference/photon-ml/src/integTest/resources/GameIntegTest"
 
 

@@ -22,6 +22,10 @@ from photon_ml_tpu.optim import OptimizerType, RegularizationType
 from photon_ml_tpu.ops.normalization import NormalizationType
 from photon_ml_tpu.task import TaskType
 
+# Driver end-to-end runs (full stage pipelines, file IO,
+# multi-lambda fits): integration tier
+pytestmark = pytest.mark.slow
+
 REF_INPUT = "/root/reference/photon-ml/src/integTest/resources/DriverIntegTest/input"
 
 
@@ -309,3 +313,55 @@ class TestReferenceFixtureInterop:
         driver.run()
         best = driver.validation_metrics[driver.best_lambda]
         assert best["AUC"] > 0.75, best
+
+
+class TestStreamingDriver:
+    def test_streaming_mode_matches_in_memory(self, avro_dirs, tmp_path):
+        train, val = avro_dirs
+        common = dict(
+            train_dir=train,
+            validate_dir=val,
+            task=TaskType.LOGISTIC_REGRESSION,
+            regularization_type=RegularizationType.L2,
+            regularization_weights=[1.0, 0.1],
+            max_num_iterations=40,
+        )
+        d1 = GLMDriver(GLMParams(
+            output_dir=str(tmp_path / "mem"), **common
+        ))
+        d1.run()
+        d2 = GLMDriver(GLMParams(
+            output_dir=str(tmp_path / "stream"), streaming=True, **common
+        ))
+        d2.run()
+        assert d2.stage_history[-1].name == d1.stage_history[-1].name
+        assert d2.best_lambda == d1.best_lambda
+        for lam in (1.0, 0.1):
+            np.testing.assert_allclose(
+                np.asarray(d2.models[lam].coefficients.means),
+                np.asarray(d1.models[lam].coefficients.means),
+                atol=5e-3,
+            )
+            # validation metrics agree
+            a = d1.validation_metrics[lam]["AUC"]
+            b = d2.validation_metrics[lam]["AUC"]
+            assert abs(a - b) < 5e-3
+        # model files written in streaming mode too
+        assert os.path.isdir(os.path.join(str(tmp_path / "stream"), "models"))
+
+    def test_streaming_rejects_unsupported(self, avro_dirs, tmp_path):
+        train, _ = avro_dirs
+        with pytest.raises(ValueError, match="streaming training"):
+            GLMParams(
+                train_dir=train,
+                output_dir=str(tmp_path / "x"),
+                streaming=True,
+                regularization_type=RegularizationType.L1,
+            ).validate()
+        with pytest.raises(ValueError, match="streaming training"):
+            GLMParams(
+                train_dir=train,
+                output_dir=str(tmp_path / "y"),
+                streaming=True,
+                normalization_type=NormalizationType.STANDARDIZATION,
+            ).validate()

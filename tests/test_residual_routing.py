@@ -100,6 +100,7 @@ class TestRouter:
         )
 
 
+@pytest.mark.slow
 class TestMeshSteadyState:
     def test_mesh_cd_no_implicit_d2h_at_steady_state(self, rng):
         # VERDICT r2 items 5+6 done-criterion: CPU-mesh CoordinateDescent

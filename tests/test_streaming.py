@@ -67,6 +67,21 @@ class TestStreamingChunks:
         total_real = sum(int((c.weights > 0).sum()) for c in chunks)
         assert total_real == 240
 
+    def test_scan_python_fallback_builds_vocabulary(
+        self, tmp_path, rng, monkeypatch
+    ):
+        # the Python-codec fallback must collect feature keys too — an
+        # empty vocabulary would silently fit an intercept-only model
+        _write_files(tmp_path, rng, n_files=1)
+        import photon_ml_tpu.io.native_avro as na
+
+        monkeypatch.setattr(na, "available", lambda: False)
+        fmt = AvroInputDataFormat()
+        index_map, stats = scan_stream([str(tmp_path)], fmt)
+        assert index_map.size == 26  # 25 features + intercept
+        assert stats.num_rows == 80
+        assert stats.max_nnz == 5  # 4 features + intercept
+
     def test_streaming_objective_matches_in_memory(self, tmp_path, rng):
         _write_files(tmp_path, rng)
         fmt = AvroInputDataFormat()
@@ -174,16 +189,15 @@ for fi in range(n_files):
     del recs
 
 fmt = AvroInputDataFormat()
+# base BEFORE the scan: the vocabulary pass must be file-bounded too
+base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
 index_map, stats = scan_stream([tmp], fmt)
 obj = StreamingGLMObjective(
     [tmp], fmt, index_map, stats, TaskType.LOGISTIC_REGRESSION,
     rows_per_chunk=32768,
 )
 w = jnp.zeros((obj.dim,), jnp.float32)
-# warm up: one full pass (compile + allocator steady state)
-obj.value_and_gradient(w, 0.1)
-base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-for _ in range(2):
+for _ in range(3):
     obj.value_and_gradient(w, 0.1)
 peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
 print("DELTA_KB", peak - base)
