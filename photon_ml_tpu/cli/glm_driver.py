@@ -164,11 +164,6 @@ class GLMParams:
         if self.distributed not in ("auto", "off", "feature"):
             raise ValueError(f"unknown distributed mode {self.distributed!r}")
         if self.distributed == "feature":
-            if self.optimizer_type == OptimizerType.TRON:
-                raise ValueError(
-                    "feature-sharded training supports LBFGS/OWLQN only "
-                    "(TRON needs hessian-vector products across blocks)"
-                )
             if self.constraint_string is not None:
                 raise ValueError(
                     "box constraints are not supported with feature-sharded "
@@ -492,10 +487,11 @@ class GLMDriver:
                     regularization_type=p.regularization_type,
                     regularization_weights=p.regularization_weights,
                     elastic_net_alpha=p.elastic_net_alpha,
-                    max_iter=p.max_num_iterations or 100,
-                    tolerance=p.tolerance or 1e-7,
+                    max_iter=p.max_num_iterations,
+                    tolerance=p.tolerance,
                     intercept_index=data.intercept_index,
                     kernel=p.kernel,
+                    optimizer_type=p.optimizer_type,
                 )
             else:
                 if mesh is not None:

@@ -1042,6 +1042,13 @@ def _run_bilinear_pass(
         raise ValueError(
             f"chunk {L} is not divisible by split {params.split}"
         )
+    entry_spec = pl.BlockSpec((8, L), lambda g, so, si, st: (g // 8, 0))
+    src_spec = pl.BlockSpec(
+        (1, params.s_hi, params.s_lo), lambda g, so, si, st: (si[g], 0, 0)
+    )
+    out_spec = pl.BlockSpec(
+        (1, params.s_hi, params.s_lo), lambda g, so, si, st: (so[g], 0, 0)
+    )
     kernel = partial(
         _bilinear_pass_kernel,
         s_hi=params.s_hi,
@@ -1050,23 +1057,18 @@ def _run_bilinear_pass(
         mxu=mxu,
         split=params.split,
     )
-    entry_spec = pl.BlockSpec((8, L), lambda g, so, si, st: (g // 8, 0))
+    in_specs = [entry_spec, entry_spec, entry_spec, src_spec]
+    operands = (
+        sched.step_out, sched.step_in, sched.step_init,
+        sched.in_pos, sched.out_pos,
+        sched.vals if vals is None else vals,
+        src,
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(G,),
-        in_specs=[
-            entry_spec,  # in_pos
-            entry_spec,  # out_pos
-            entry_spec,  # vals
-            pl.BlockSpec(
-                (1, params.s_hi, params.s_lo),
-                lambda g, so, si, st: (si[g], 0, 0),
-            ),  # src window
-        ],
-        out_specs=pl.BlockSpec(
-            (1, params.s_hi, params.s_lo),
-            lambda g, so, si, st: (so[g], 0, 0),
-        ),
+        in_specs=in_specs,
+        out_specs=out_spec,
     )
     out = pl.pallas_call(
         kernel,
@@ -1076,15 +1078,7 @@ def _run_bilinear_pass(
         ),
         interpret=interpret,
         compiler_params=_COMPILER_PARAMS,
-    )(
-        sched.step_out,
-        sched.step_in,
-        sched.step_init,
-        sched.in_pos,
-        sched.out_pos,
-        sched.vals if vals is None else vals,
-        src,
-    )
+    )(*operands)
     return out
 
 

@@ -53,6 +53,8 @@ def _truncated_cg(
     *,
     max_cg: int,
     cg_tol_factor: float = 0.1,
+    vdot=jnp.vdot,
+    norm=jnp.linalg.norm,
 ):
     """Steihaug truncated CG: approximately solve H s = -g, ||s|| <= delta.
 
@@ -61,13 +63,13 @@ def _truncated_cg(
     so the caller computes prered = -0.5*(g.s - s.r) without an extra
     Hessian-vector product (the tron.cpp trick).
     """
-    cg_tol = cg_tol_factor * jnp.linalg.norm(g)
+    cg_tol = cg_tol_factor * norm(g)
 
     def boundary_tau(s, d, delta):
         # tau >= 0 with ||s + tau d|| = delta
-        dd = jnp.vdot(d, d)
-        sd = jnp.vdot(s, d)
-        ss = jnp.vdot(s, s)
+        dd = vdot(d, d)
+        sd = vdot(s, d)
+        ss = vdot(s, s)
         rad = jnp.sqrt(jnp.maximum(sd * sd + dd * (delta * delta - ss), 0.0))
         return (-sd + rad) / jnp.maximum(dd, 1e-30)
 
@@ -76,15 +78,15 @@ def _truncated_cg(
 
     def body(st: _CGState):
         hd = hvp(st.d)
-        dhd = jnp.vdot(st.d, hd)
+        dhd = vdot(st.d, hd)
         # Negative curvature or radius hit: walk to the boundary and stop.
         alpha = st.rtr / jnp.where(dhd > 0, dhd, 1.0)
         s_new = st.s + alpha * st.d
-        hit = (jnp.linalg.norm(s_new) >= delta) | (dhd <= 0)
+        hit = (norm(s_new) >= delta) | (dhd <= 0)
         step = jnp.where(hit, boundary_tau(st.s, st.d, delta), alpha)
         s_out = st.s + step * st.d
         r_new = st.r - step * hd
-        rtr_new = jnp.vdot(r_new, r_new)
+        rtr_new = vdot(r_new, r_new)
         beta = rtr_new / jnp.maximum(st.rtr, 1e-30)
         d_new = r_new + beta * st.d
         return _CGState(
@@ -101,7 +103,7 @@ def _truncated_cg(
         s=jnp.zeros_like(g),
         r=r0,
         d=r0,
-        rtr=jnp.vdot(r0, r0),
+        rtr=vdot(r0, r0),
         iters=jnp.zeros((), jnp.int32),
         done=jnp.zeros((), bool),
     )
@@ -131,34 +133,57 @@ def minimize_tron(
     max_improvement_failures: int = 16,
     box: Optional[BoxConstraints] = None,
     track_coefficients: bool = False,
+    axis_name: Optional[str] = None,
+    hvp_factory=None,
 ) -> OptResult:
     """Trust-region Newton. ``hvp_fn(w, d) -> H(w) @ d``.
 
+    ``hvp_factory(w) -> (d -> H(w) @ d)``: alternative to ``hvp_fn`` that
+    lets the caller compute the w-only pieces of the Hessian (margins,
+    second-derivative coefficients) ONCE per outer iteration instead of
+    once per CG step — the HessianVectorAggregator caching analog. When
+    given, ``hvp_fn`` is ignored (pass None).
+
     Defaults mirror TRON.scala:260-265 (maxIter=15, tol=1e-5, <=20 CG).
+
+    ``axis_name``: run over a FEATURE-SHARDED coefficient block inside
+    shard_map — every inner product / norm (outer loop AND truncated CG)
+    psums over the axis, so the optimizer is numerically identical to its
+    replicated self with fully sharded state (same contract as
+    minimize_lbfgs).
     """
+    from photon_ml_tpu.optim.lbfgs import make_global_prims
+
+    vdot, norm, _ = make_global_prims(axis_name)
     if box is not None:
         w0 = box.project(w0)
     f0, g0 = value_and_grad_fn(w0)
-    g0_norm = jnp.linalg.norm(g0)
+    g0_norm = norm(g0)
 
     def cond(st: _TronState):
         return st.reason == NOT_CONVERGED
 
     def body(st: _TronState):
+        hvp_local = (
+            hvp_factory(st.w)
+            if hvp_factory is not None
+            else (lambda d: hvp_fn(st.w, d))
+        )
         s, r = _truncated_cg(
-            lambda d: hvp_fn(st.w, d), st.g, st.delta, max_cg=max_cg
+            hvp_local, st.g, st.delta, max_cg=max_cg,
+            vdot=vdot, norm=norm,
         )
         w_trial = st.w + s
         if box is not None:
             w_trial = box.project(w_trial)
             s = w_trial - st.w
         f_new, g_new = value_and_grad_fn(w_trial)
-        gs = jnp.vdot(st.g, s)
+        gs = vdot(st.g, s)
         # r = -g - H s from CG, so s.Hs = -s.(g + r) and
         # prered = -(g.s + 0.5 s.Hs) = -0.5 (g.s - s.r).
-        prered = -0.5 * (gs - jnp.vdot(s, r))
+        prered = -0.5 * (gs - vdot(s, r))
         actred = st.f - f_new
-        snorm = jnp.linalg.norm(s)
+        snorm = norm(s)
 
         # Step-size estimate for the radius update (tron.cpp alpha rule).
         denom = f_new - st.f - gs
@@ -187,7 +212,7 @@ def minimize_tron(
         failures = jnp.where(accept, 0, st.failures + 1).astype(jnp.int32)
 
         it = st.iteration + 1
-        g_norm = jnp.linalg.norm(g2)
+        g_norm = norm(g2)
         reason = check_convergence(
             it, st.f, f2, g_norm, f0, g0_norm, max_iter=max_iter, tol=tol
         )
@@ -226,7 +251,7 @@ def minimize_tron(
     return OptResult(
         coefficients=final.w,
         value=final.f,
-        grad_norm=jnp.linalg.norm(final.g),
+        grad_norm=norm(final.g),
         iterations=final.iteration,
         reason=final.reason,
         tracker=final.tracker,

@@ -66,8 +66,9 @@ def train_generalized_linear_model(
 
     ``mesh``: a jax.sharding.Mesh for data-parallel training — the whole
     L-BFGS/OWLQN/TRON loop runs under shard_map with the batch sharded
-    over the "data" axis (the treeAggregate analog). The tiled kernel's
-    schedules are whole-batch, so mesh currently implies scatter.
+    over the "data" axis (the treeAggregate analog). The tiled kernel
+    composes: per-device-shard schedules are built once and the Pallas
+    kernels run unmodified inside shard_map (no scatter fallback).
 
     ``track_models``: stack per-iteration coefficients into each
     OptResult's ``tracker.coefs`` (ModelTracker analog). Use
@@ -157,21 +158,26 @@ def train_feature_sharded(
     regularization_type: RegularizationType = RegularizationType.NONE,
     regularization_weights: Sequence[float] = (0.0,),
     elastic_net_alpha: Optional[float] = None,
-    max_iter: int = 100,
-    tolerance: float = 1e-7,
+    max_iter: Optional[int] = None,
+    tolerance: Optional[float] = None,
     history: int = 10,
     warm_start: bool = True,
     intercept_index: Optional[int] = None,
     kernel: str = "scatter",
+    optimizer_type: OptimizerType = OptimizerType.LBFGS,
 ) -> Tuple[Dict[float, GeneralizedLinearModel], Dict[float, OptResult]]:
     """Lambda grid over a FEATURE-SHARDED coefficient vector (the >HBM /
     10B-coefficient path, SURVEY §2.3 "coefficient parallelism").
 
     The mesh must be 2-D (data, model); the sparse batch is re-laid out
     once into per-feature-block slabs and every lambda reuses it. L1 and
-    elastic-net run sharded OWL-QN; L2/none run sharded L-BFGS. TRON, box
-    constraints, and normalization are not supported on this path —
-    callers validate (the GLM driver rejects those combinations).
+    elastic-net run sharded OWL-QN; L2/none run sharded L-BFGS or (with
+    ``optimizer_type=TRON``) sharded trust-region Newton whose truncated
+    CG psums every inner product — the reference's
+    one-treeAggregate-per-CG-iteration loop (SURVEY §3.2) on ICI. Box
+    constraints and normalization are not supported on this path —
+    callers validate (the GLM driver rejects those combinations); TRON
+    currently runs the scatter kernel (no tiled Hv schedules).
 
     ``kernel``: "scatter" | "tiled" | "auto" — "tiled" lays each
     (data shard x feature block) cell out as block-local Pallas tile
@@ -204,12 +210,44 @@ def train_feature_sharded(
         )
     num_blocks = int(mesh.shape[MODEL_AXIS])
     data_shards = int(mesh.shape[DATA_AXIS])
+    from photon_ml_tpu.optim.factory import validate_optimizer_choice
+
     regularization = RegularizationContext(regularization_type, elastic_net_alpha)
     objective = GLMObjective(loss_for_task(task), dim)
-    kernel = resolve_kernel(kernel, batch)
+    use_tron = optimizer_type == OptimizerType.TRON
     use_owlqn = regularization.has_l1
+    # shared TRON x regularization / loss-smoothness rules
+    # (OptimizerFactory.scala:49-86)
+    base = OptimizerConfig.default_for(optimizer_type)
+    max_iter = max_iter if max_iter is not None else base.max_iter
+    tolerance = tolerance if tolerance is not None else base.tolerance
+    validate_optimizer_choice(
+        OptimizerConfig(optimizer_type=optimizer_type),
+        regularization,
+        loss_has_hessian=objective.loss.has_hessian,
+    )
+    if use_tron:
+        if kernel == "tiled":
+            raise ValueError(
+                "kernel='tiled' is not available with TRON on the "
+                "feature-sharded path (no tiled Hessian-vector schedules "
+                "yet); use kernel='auto' or 'scatter'"
+            )
+        kernel = "scatter"  # "auto" resolves to the Hv-capable kernel
+    kernel = resolve_kernel(kernel, batch)
 
-    if kernel == "tiled":
+    if use_tron:
+        from photon_ml_tpu.parallel.distributed import (
+            feature_sharded_sparse_fit_tron,
+        )
+
+        sharded, block_dim = feature_shard_sparse_batch(
+            batch, dim, num_blocks, rows_multiple=data_shards
+        )
+        fit = feature_sharded_sparse_fit_tron(
+            objective, mesh, max_iter=max_iter, tol=tolerance
+        )
+    elif kernel == "tiled":
         from photon_ml_tpu.ops.tiled_sparse import feature_shard_tiled_batch
         from photon_ml_tpu.parallel.distributed import feature_sharded_tiled_fit
 

@@ -188,6 +188,75 @@ class TestFeatureShardedTiled:
             float(res.value), float(oracle.value), rtol=1e-4
         )
 
+    def test_feature_sharded_tron_matches_replicated(self, rng):
+        # sharded trust-region Newton: every CG inner product psums over
+        # the model axis (the treeAggregate-per-CG-iteration loop on ICI)
+        from photon_ml_tpu.optim.config import OptimizerType, RegularizationType
+        from photon_ml_tpu.optim.tron import minimize_tron
+        from photon_ml_tpu.ops.objective import GLMObjective as _G
+        from photon_ml_tpu.parallel.mesh import MODEL_AXIS
+        from photon_ml_tpu.training import train_feature_sharded
+
+        n, d, k = 120, 64, 5
+        w_true = rng.normal(size=d)
+        rows, labels = [], []
+        for _ in range(n):
+            ix = rng.choice(d, size=k, replace=False)
+            vs = rng.normal(size=k)
+            z = float((w_true[ix] * vs).sum())
+            labels.append(float(rng.uniform() < 1 / (1 + np.exp(-z))))
+            rows.append((ix.tolist(), vs.tolist()))
+        batch = make_sparse_batch(rows, labels)
+        mesh = make_mesh((4, 2), (DATA_AXIS, MODEL_AXIS))
+        models, results = train_feature_sharded(
+            batch, TaskType.LOGISTIC_REGRESSION, d,
+            mesh=mesh,
+            regularization_type=RegularizationType.L2,
+            regularization_weights=[0.5],
+            max_iter=12,
+            tolerance=1e-5,
+            optimizer_type=OptimizerType.TRON,
+        )
+        obj = _G(LOGISTIC, d)
+        oracle = minimize_tron(
+            lambda w: obj.value_and_gradient(w, batch, jnp.float32(0.5)),
+            lambda w, dd: obj.hessian_vector(w, dd, batch, jnp.float32(0.5)),
+            jnp.zeros(d, jnp.float32), max_iter=12, tol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(models[0.5].coefficients.means),
+            np.asarray(oracle.coefficients),
+            atol=5e-3,
+        )
+        np.testing.assert_allclose(
+            float(results[0.5].value), float(oracle.value), rtol=1e-4
+        )
+
+    def test_feature_sharded_tron_guards(self, rng):
+        from photon_ml_tpu.optim.config import OptimizerType, RegularizationType
+        from photon_ml_tpu.parallel.mesh import MODEL_AXIS
+        from photon_ml_tpu.training import train_feature_sharded
+
+        batch, d = random_problem(rng, n=32, d=16, k=3)
+        mesh = make_mesh((4, 2), (DATA_AXIS, MODEL_AXIS))
+        with pytest.raises(ValueError, match="twice-differentiable"):
+            train_feature_sharded(
+                batch, TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM, d,
+                mesh=mesh, optimizer_type=OptimizerType.TRON,
+            )
+        with pytest.raises(ValueError, match="L1/ELASTIC_NET"):
+            train_feature_sharded(
+                batch, TaskType.LOGISTIC_REGRESSION, d,
+                mesh=mesh, optimizer_type=OptimizerType.TRON,
+                regularization_type=RegularizationType.L1,
+            )
+        with pytest.raises(ValueError, match="tiled"):
+            train_feature_sharded(
+                batch, TaskType.LOGISTIC_REGRESSION, d,
+                mesh=mesh, optimizer_type=OptimizerType.TRON,
+                kernel="tiled",
+            )
+
     def test_train_feature_sharded_tiled_owlqn(self, rng):
         # elastic-net grid through the public entry point, tiled kernel
         from photon_ml_tpu.parallel.mesh import MODEL_AXIS
