@@ -221,8 +221,9 @@ class TestFeatureShardedDriver:
         assert results["feature"].best_model is not None
 
     def test_feature_mode_param_rejections(self):
+        # (TRON is supported on the feature-sharded path since round 3 —
+        # sharded truncated CG — so it is no longer in this list)
         for kw in (
-            dict(optimizer_type=OptimizerType.TRON),
             dict(normalization_type=NormalizationType.STANDARDIZATION),
             dict(compute_variances=True),
             dict(constraint_string="[]"),
@@ -232,6 +233,11 @@ class TestFeatureShardedDriver:
             )
             with pytest.raises(ValueError):
                 p.validate()
+        # TRON + feature sharding validates cleanly
+        GLMParams(
+            train_dir="t", output_dir="o", distributed="feature",
+            optimizer_type=OptimizerType.TRON,
+        ).validate()
 
 
 class TestDatedInputAndPerIterationValidation:
