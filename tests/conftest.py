@@ -36,3 +36,31 @@ def pytest_configure(config):
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def game_example_schema():
+    """Shared GameExample Avro schema for GAME file-path tests (single
+    definition of the test data contract; see photon_ml_tpu.io.schemas
+    for the production schemas)."""
+    from photon_ml_tpu.io import schemas
+
+    return {
+        "name": "GameExample", "type": "record",
+        "fields": [
+            {"name": "uid", "type": ["null", "string"], "default": None},
+            {"name": "response", "type": "double"},
+            {
+                "name": "metadataMap",
+                "type": ["null", {"type": "map", "values": "string"}],
+                "default": None,
+            },
+            {
+                "name": "features",
+                "type": {"type": "array", "items": schemas.FEATURE_AVRO},
+            },
+            {
+                "name": "userFeatures",
+                "type": {"type": "array", "items": "FeatureAvro"},
+            },
+        ],
+    }

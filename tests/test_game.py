@@ -660,3 +660,77 @@ class TestDeviceResidentResiduals:
         with jax.transfer_guard_device_to_host("disallow"):
             res = make_cd().run(1)
         assert np.isfinite(res.objective_history[-1])
+
+
+@pytest.mark.slow
+class TestFilePathScale:
+    """VERDICT r2 item 3, 'through the REAL path': Avro files -> native
+    column decode -> vectorized GAME dataset assembly -> vectorized RE
+    build, at a volume where any per-record Python loop in the chain
+    would visibly blow up."""
+
+    def test_200k_rows_from_avro_files(self, tmp_path, rng):
+        import time
+
+        from photon_ml_tpu.game.data import build_game_dataset_from_files
+        from photon_ml_tpu.io import native_avro
+        from photon_ml_tpu.io.avro_codec import write_container
+
+        from tests.conftest import game_example_schema
+
+        if not native_avro.available():
+            pytest.skip(
+                "native avro decoder unavailable: the point of this test "
+                "is the REAL (native-decode) load path"
+            )
+        n, n_users, d_g, d_u = 200_000, 20_000, 6, 4
+        rows_per_file = 50_000
+        schema = game_example_schema()
+        u_codes = rng.integers(0, n_users, size=n)
+        n_users_seen = len(np.unique(u_codes))
+        for fi in range(n // rows_per_file):
+            recs = []
+            base = fi * rows_per_file
+            for i in range(rows_per_file):
+                u = int(u_codes[base + i])
+                recs.append({
+                    "uid": f"r{base + i}",
+                    "response": float(rng.uniform() > 0.5),
+                    "metadataMap": {"userId": f"user{u}"},
+                    "features": [
+                        {"name": f"g{j}", "term": "",
+                         "value": float(rng.normal())}
+                        for j in range(d_g)
+                    ],
+                    "userFeatures": [
+                        {"name": f"u{j}", "term": "",
+                         "value": float(rng.normal())}
+                        for j in range(d_u)
+                    ],
+                })
+            write_container(
+                str(tmp_path / f"part-{fi}.avro"), schema, recs
+            )
+            del recs
+
+        t0 = time.perf_counter()
+        ds = build_game_dataset_from_files(
+            [str(tmp_path)], SHARDS, ["userId"]
+        )
+        load_s = time.perf_counter() - t0
+        assert ds.num_real_rows == n
+        assert ds.entity_indexes["userId"].num_entities == n_users_seen
+
+        t0 = time.perf_counter()
+        red = build_random_effect_dataset(
+            ds, RandomEffectDataConfiguration("userId", "userShard")
+        )
+        re_s = time.perf_counter() - t0
+        assert red.num_entities == n_users_seen
+        assert red.num_active_rows == n
+        placed = sum(int((b.row_index >= 0).sum()) for b in red.buckets)
+        assert placed == n
+        # the whole chain is vectorized/native: generous 1-core CI bounds
+        # that still catch any reintroduced per-record hot loop
+        assert load_s < 120, load_s
+        assert re_s < 10, re_s

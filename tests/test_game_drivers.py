@@ -62,17 +62,9 @@ def write_game_avro(path, rng, n=240, n_users=8, d_g=5, d_u=3, seed_shift=0):
                 for j in range(d_u)
             ],
         })
-    schema = dict(schemas.TRAINING_EXAMPLE_AVRO)
-    schema = {
-        "name": "GameExample", "type": "record",
-        "fields": [
-            {"name": "uid", "type": ["null", "string"], "default": None},
-            {"name": "response", "type": "double"},
-            {"name": "metadataMap", "type": ["null", {"type": "map", "values": "string"}], "default": None},
-            {"name": "features", "type": {"type": "array", "items": schemas.FEATURE_AVRO}},
-            {"name": "userFeatures", "type": {"type": "array", "items": "FeatureAvro"}},
-        ],
-    }
+    from tests.conftest import game_example_schema
+
+    schema = game_example_schema()
     write_container(path, schema, recs)
 
 
