@@ -248,8 +248,14 @@ class GLMParams:
                 unsupported.append("validate-per-iteration")
             if self.distributed == "feature":
                 unsupported.append("feature-sharded training")
-            if self.offheap_indexmap_dir:
-                unsupported.append("offheap index maps")
+            if (
+                self.coordinator_address is not None
+                and not self.offheap_indexmap_dir
+            ):
+                unsupported.append(
+                    "multi-process streaming without a prebuilt offheap "
+                    "index map (no single process sees the vocabulary)"
+                )
             if unsupported:
                 raise ValueError(
                     "streaming training does not support: "
@@ -358,11 +364,15 @@ class GLMDriver:
                 )
             if p.streaming:
                 # one bounded-memory pass: vocabulary + staging shape
-                # (no full materialization — the train data may exceed RAM)
+                # (no full materialization — the train data may exceed
+                # RAM); a prebuilt offheap store skips the vocabulary scan
+                # (and is required for multi-process streaming)
                 from photon_ml_tpu.io.streaming import scan_stream
                 from photon_ml_tpu.utils.index_map import intercept_key
 
-                index_map, stats = scan_stream(train_paths, fmt)
+                index_map, stats = scan_stream(
+                    train_paths, fmt, index_map=prebuilt
+                )
                 icept = (
                     index_map.get_index(intercept_key())
                     if p.add_intercept else -1
@@ -460,9 +470,10 @@ class GLMDriver:
                 train_paths, stats = self._stream
                 if mesh is not None:
                     self.logger.warning(
-                        "streaming training runs single-device; the "
-                        "%d-device mesh is not used (stream the input "
-                        "per process via multihost.process_shard instead)",
+                        "streaming training computes on one device per "
+                        "process (the %d-device mesh is not used for the "
+                        "chunk passes); across PROCESSES the input files "
+                        "shard and gradients reduce automatically",
                         mesh.devices.size,
                     )
                 self.logger.info(

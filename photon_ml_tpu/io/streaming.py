@@ -58,13 +58,20 @@ def _iter_file_rows(path: str, fmt, index_map: IndexMap):
         )
 
 
-def scan_stream(paths, fmt) -> Tuple[IndexMap, StreamStats]:
+def scan_stream(
+    paths, fmt, *, index_map: Optional[IndexMap] = None
+) -> Tuple[IndexMap, StreamStats]:
     """One streaming pass over the files — ONE AT A TIME — collecting the
     vocabulary, the row count, and the max per-row nnz (incl. intercept)
     that fix the staging batch. Unlike fmt.build_index_map (which the
     in-memory loader uses and which holds every file's decoded columns at
     once), this never keeps more than one decoded file resident — the
-    whole point of the streaming path is datasets larger than RAM."""
+    whole point of the streaming path is datasets larger than RAM.
+
+    With a prebuilt ``index_map`` (the FeatureIndexingJob store — required
+    for multi-host streaming, where no single process sees the whole
+    vocabulary) the key collection is skipped and only the shape stats are
+    scanned."""
     from photon_ml_tpu.io.avro_codec import read_avro_records
     from photon_ml_tpu.io.paths import expand_input_paths
 
@@ -72,6 +79,7 @@ def scan_stream(paths, fmt) -> Tuple[IndexMap, StreamStats]:
     if not files:
         raise ValueError(f"no .avro inputs under {paths!r}")
     keys = set()
+    collect_keys = index_map is None
     num_rows = 0
     max_live = 0  # per-row live (nonzero, selected) feature count
     for path in files:
@@ -83,11 +91,12 @@ def scan_stream(paths, fmt) -> Tuple[IndexMap, StreamStats]:
                     for s in decoded.strings
                 ]
             )
-            keys.update(
-                s
-                for s, ok in zip(decoded.strings, sel)
-                if ok
-            )
+            if collect_keys:
+                keys.update(
+                    s
+                    for s, ok in zip(decoded.strings, sel)
+                    if ok
+                )
             # per-row width = entries the row iterators will emit: every
             # entry whose key is selected (zero VALUES are kept — they are
             # in the map and emitted by iter_rows_from_decoded)
@@ -110,11 +119,15 @@ def scan_stream(paths, fmt) -> Tuple[IndexMap, StreamStats]:
             for record in read_avro_records([path]):
                 live = 0
                 for key, _v in fmt._record_pairs(record):
-                    keys.add(key)
+                    if collect_keys:
+                        keys.add(key)
                     live += 1
                 max_live = max(max_live, live)
                 num_rows += 1
-    index_map = IndexMap.build(iter(keys), add_intercept=fmt.add_intercept)
+    if collect_keys:
+        index_map = IndexMap.build(
+            iter(keys), add_intercept=fmt.add_intercept
+        )
     max_nnz = max(max_live + (1 if fmt.add_intercept else 0), 1)
     return index_map, StreamStats(num_rows=num_rows, max_nnz=max_nnz)
 
@@ -227,6 +240,7 @@ class StreamingGLMObjective:
         )
 
     def value_and_gradient(self, w, l2_weight=0.0):
+        import jax
         import jax.numpy as jnp
 
         value = jnp.float32(0.0)
@@ -235,5 +249,16 @@ class StreamingGLMObjective:
             v, g = self._partial(w, batch)
             value = value + v
             grad = grad + g
+        if jax.process_count() > 1:
+            # cross-host reduction of the loss partials (the treeAggregate
+            # combine step over DCN): each process streamed only ITS file
+            # shard; the regularization term is added once, after
+            from jax.experimental import multihost_utils
+
+            packed = jnp.concatenate([value[None], grad])
+            gathered = multihost_utils.process_allgather(packed)
+            total = gathered.sum(axis=0)
+            value = jnp.float32(total[0])
+            grad = jnp.asarray(total[1:], jnp.float32)
         value = value + 0.5 * l2_weight * jnp.vdot(w, w)
         return value, grad + l2_weight * w

@@ -331,11 +331,21 @@ def train_streaming_glm(
     supported on this path (OWL-QN needs the orthant machinery; use the
     in-memory trainer), matching its L2/none smooth-objective scope.
 
+    Under ``jax.distributed`` (process_count > 1) the input FILES split
+    across processes (multihost.process_shard — the executor-partition
+    analog) and every evaluation's (value, gradient) partials reduce
+    across hosts, so each host only ever reads its shard; this requires a
+    PREBUILT shared index map (the FeatureIndexingJob store) because no
+    single process sees the whole vocabulary.
+
     Returns ({lambda: model}, {lambda: OptResult}, index_map).
     """
+    import numpy as np
+    import jax
     import jax.numpy as jnp
 
     from photon_ml_tpu.io.input_format import AvroInputDataFormat
+    from photon_ml_tpu.io.paths import expand_input_paths
     from photon_ml_tpu.io.streaming import StreamingGLMObjective, scan_stream
     from photon_ml_tpu.models.coefficients import Coefficients
     from photon_ml_tpu.models.glm import create_model
@@ -350,8 +360,50 @@ def train_streaming_glm(
         fmt = AvroInputDataFormat(
             add_intercept=add_intercept, field_names=field_names
         )
-    if index_map is None or stats is None:
-        index_map, stats = scan_stream(paths, fmt)
+    multi = jax.process_count() > 1
+    if multi:
+        if index_map is None:
+            raise ValueError(
+                "multi-host streaming requires a prebuilt shared index "
+                "map (build one with the feature-indexing job); no single "
+                "process sees the whole vocabulary"
+            )
+        from photon_ml_tpu.parallel.multihost import process_shard
+
+        files = sorted(
+            expand_input_paths(paths, lambda fn: fn.endswith(".avro"))
+        )
+        if not files:
+            raise ValueError(f"no .avro inputs under {paths!r}")
+        paths = process_shard(files)
+        if stats is None:
+            # local stats -> global agreement (max nnz must match across
+            # processes: it fixes the compiled staging shape). A process
+            # can own zero files when processes outnumber files — it
+            # still joins every collective with empty partials. Callers
+            # that already hold GLOBAL stats (the driver's preprocess
+            # scan) skip this whole per-shard disk pass.
+            from photon_ml_tpu.io.streaming import StreamStats
+
+            if paths:
+                _, local_stats = scan_stream(
+                    paths, fmt, index_map=index_map
+                )
+            else:
+                local_stats = StreamStats(num_rows=0, max_nnz=1)
+            from jax.experimental import multihost_utils
+
+            gathered = multihost_utils.process_allgather(
+                np.asarray(
+                    [local_stats.num_rows, local_stats.max_nnz], np.int64
+                )
+            )
+            stats = StreamStats(
+                num_rows=int(gathered[:, 0].sum()),
+                max_nnz=int(gathered[:, 1].max()),
+            )
+    elif index_map is None or stats is None:
+        index_map, stats = scan_stream(paths, fmt, index_map=index_map)
     objective = StreamingGLMObjective(
         paths, fmt, index_map, stats, task, rows_per_chunk=rows_per_chunk
     )

@@ -676,7 +676,7 @@ class TestFilePathScale:
         from photon_ml_tpu.io import native_avro
         from photon_ml_tpu.io.avro_codec import write_container
 
-        from tests.conftest import game_example_schema
+        from conftest import game_example_schema
 
         if not native_avro.available():
             pytest.skip(

@@ -29,7 +29,6 @@ from photon_ml_tpu.game.config import (
     RandomEffectDataConfiguration,
 )
 from photon_ml_tpu.game.model_io import load_game_model
-from photon_ml_tpu.io import schemas
 from photon_ml_tpu.io.avro_codec import read_avro_records, write_container
 from photon_ml_tpu.task import TaskType
 
@@ -62,7 +61,7 @@ def write_game_avro(path, rng, n=240, n_users=8, d_g=5, d_u=3, seed_shift=0):
                 for j in range(d_u)
             ],
         })
-    from tests.conftest import game_example_schema
+    from conftest import game_example_schema
 
     schema = game_example_schema()
     write_container(path, schema, recs)

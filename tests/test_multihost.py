@@ -233,3 +233,73 @@ class TestTwoProcessDistributed:
         hist = metrics["objective_history"]
         assert len(hist) == 2 and hist[-1] <= hist[0]
         assert os.path.isdir(out / "best-model" / "random-effect" / "per-user")
+
+
+@pytest.mark.slow
+class TestTwoProcessStreaming:
+    def test_streaming_glm_two_processes(self, tmp_path, rng):
+        """Multi-host >RAM streaming: the input FILES split across the two
+        processes (process_shard) and every evaluation's (value, gradient)
+        partials reduce across hosts, so each rank only reads its shard.
+        Coefficients must match a single-process streaming fit over the
+        full file set."""
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_streaming import _write_files
+
+        train = tmp_path / "train"
+        train.mkdir()
+        _write_files(train, rng, n_files=4, rows_per_file=90)
+        port = _free_port()
+
+        def script(pid):
+            return textwrap.dedent(f"""
+                import jax
+                jax.config.update("jax_platforms", "cpu")
+                import numpy as np
+                from photon_ml_tpu.parallel.multihost import (
+                    initialize_multihost,
+                )
+                initialize_multihost("127.0.0.1:{port}", 2, {pid})
+                assert jax.process_count() == 2
+                from photon_ml_tpu.io.input_format import AvroInputDataFormat
+                from photon_ml_tpu.io.streaming import scan_stream
+                from photon_ml_tpu.optim.config import RegularizationType
+                from photon_ml_tpu.task import TaskType
+                from photon_ml_tpu.training import train_streaming_glm
+
+                fmt = AvroInputDataFormat()
+                # shared vocabulary: both ranks scan the full file set
+                # (stands in for the offheap FeatureIndexingJob store)
+                index_map, _ = scan_stream([{str(train)!r}], fmt)
+                models, results, _ = train_streaming_glm(
+                    [{str(train)!r}], TaskType.LOGISTIC_REGRESSION,
+                    regularization_type=RegularizationType.L2,
+                    regularization_weights=[0.5],
+                    max_iter=25,
+                    fmt=fmt,
+                    index_map=index_map,
+                )
+                if jax.process_index() == 0:
+                    np.save(
+                        {str(tmp_path / "w2proc.npy")!r},
+                        np.asarray(models[0.5].coefficients.means),
+                    )
+            """)
+
+        _run_two_processes(script)
+
+        from photon_ml_tpu.optim.config import RegularizationType
+        from photon_ml_tpu.task import TaskType
+        from photon_ml_tpu.training import train_streaming_glm
+
+        models, _, _ = train_streaming_glm(
+            [str(train)], TaskType.LOGISTIC_REGRESSION,
+            regularization_type=RegularizationType.L2,
+            regularization_weights=[0.5],
+            max_iter=25,
+        )
+        import numpy as np
+
+        w2 = np.load(tmp_path / "w2proc.npy")
+        w1 = np.asarray(models[0.5].coefficients.means)
+        np.testing.assert_allclose(w2, w1, rtol=2e-3, atol=2e-4)
