@@ -79,19 +79,24 @@ def main():
         return lax.fori_loop(0, m, body, (w0, jnp.float32(0.0)))
 
     w0 = jnp.zeros((d,), jnp.float32)
+    iters = 11
 
-    def timed(m):
+    def timed(loop_fn, batch, m):
         best = float("inf")
         for _ in range(2):
             t0 = time.perf_counter()
-            out = loop(m, w0, tb)
+            out = loop_fn(m, w0, batch)
             _ = float(out[1])
             best = min(best, time.perf_counter() - t0)
         return best
 
-    _ = timed(1)  # compile + warm
-    iters = 11
-    dt = (timed(iters) - timed(1)) / (iters - 1)
+    def measure(loop_fn, batch):
+        _ = timed(loop_fn, batch, 1)  # compile + warm
+        return (
+            timed(loop_fn, batch, iters) - timed(loop_fn, batch, 1)
+        ) / (iters - 1)
+
+    dt = measure(loop, tb)
     examples_per_sec = n / dt
 
     # correctness oracle: one scatter/gather evaluation at the same point
@@ -112,6 +117,44 @@ def main():
         float(v_oracle)
     )
 
+    # Same fused eval under a 1-device mesh: the tiled kernels run
+    # UNMODIFIED inside shard_map (per-shard schedules + psum) — the
+    # "fast AND distributed simultaneously" property, recorded so the
+    # artifact shows no mesh penalty (round 2 silently fell back to the
+    # ~10x-slower scatter objective here).
+    from functools import partial as _partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from photon_ml_tpu.ops.tiled_sparse import ensure_tiled_sharded
+    from photon_ml_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+    mesh = make_mesh((1,), (DATA_AXIS,), devices=jax.devices()[:1])
+    # tb already has the 1-shard layout: pass-through + device_put only
+    # (building from sb would re-pull the device batch and rebuild both
+    # schedules)
+    tb_mesh = ensure_tiled_sharded(tb, d, mesh)
+    obj_mesh = obj.with_axis(DATA_AXIS)
+
+    @jax.jit
+    def mesh_loop(m, w0_, tb_):
+        @_partial(
+            shard_map, mesh=mesh, in_specs=(P(), P(DATA_AXIS), P()),
+            out_specs=(P(), P()), check_vma=False,
+        )
+        def vg(w, b, l2):
+            return obj_mesh.value_and_gradient(w, b, l2)
+
+        def body(i, carry):
+            w, acc = carry
+            v, g = vg(w, tb_, jnp.float32(0.1))
+            return (w - 1e-9 * g, acc + v)
+
+        return lax.fori_loop(0, m, body, (w0_, jnp.float32(0.0)))
+
+    mesh_dt = measure(mesh_loop, tb_mesh)
+
     result = {
         "metric": "fused_value_and_gradient_examples_per_sec_per_chip",
         "value": round(examples_per_sec),
@@ -123,6 +166,7 @@ def main():
             "nnz_per_row": k,
             "dim": d,
             "ms_per_eval": round(dt * 1e3, 3),
+            "ms_per_eval_1dev_mesh": round(mesh_dt * 1e3, 3),
             "schedule_build_s": round(schedule_build_s, 1),
             "oracle_value_rel_err": oracle_rel_err,
             "baseline": "round-1 scatter/gather kernel, same shape",
