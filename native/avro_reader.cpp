@@ -34,7 +34,11 @@
 
 #include <zlib.h>
 
+#include <locale.h>
+
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <cmath>
 #include <string>
@@ -156,12 +160,76 @@ enum Op : uint32_t {
 
 enum Want { W_NONE = 0, W_NUM = 1, W_STR = 2 };
 
+// LC_NUMERIC-proof strtod: the embedding process may have set a
+// comma-decimal locale (GUI toolkits do), which must not change how
+// JVM-written Avro decodes.
+static double c_strtod(const char* s, char** end = nullptr) {
+  static locale_t loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+  return strtod_l(s, end, loc);
+}
+
 struct Sink {
   int want = W_NONE;
   bool have = false;
   double num = NAN;
   const uint8_t* str = nullptr;
   int64_t str_len = 0;
+  // scratch for rendering a numeric/boolean union branch under a string
+  // sink (metronome-style ids: uid/userId may arrive as int or long)
+  char buf[40];
+
+  void set_rendered(int n) {
+    str = reinterpret_cast<const uint8_t*>(buf);
+    str_len = n;
+    have = true;
+  }
+
+  // Python-str parity for float branches: shortest decimal that
+  // round-trips, positional vs scientific chosen by Python's repr rule
+  // (exponent only when |v| >= 1e16 or 0 < |v| < 1e-4), trailing ".0"
+  // for integral positional values, "nan"/"inf"/"-inf" specials, and a
+  // decimal point immune to the process locale.
+  void render_double(double v) {
+    if (std::isnan(v)) {
+      set_rendered(snprintf(buf, sizeof(buf), "nan"));
+      return;
+    }
+    if (std::isinf(v)) {
+      set_rendered(snprintf(buf, sizeof(buf), v > 0 ? "inf" : "-inf"));
+      return;
+    }
+    double av = std::fabs(v);
+    bool want_exp = v != 0.0 && (av >= 1e16 || av < 1e-4);
+    char fallback[40];
+    int fallback_n = -1;
+    for (int prec = 1; prec <= 17; ++prec) {
+      int n = snprintf(buf, sizeof(buf), "%.*g", prec, v);
+      for (char* c = buf; *c; ++c)
+        if (*c == ',') *c = '.';
+      if (c_strtod(buf) != v) continue;
+      bool has_e = strpbrk(buf, "eE") != nullptr;
+      if (has_e != want_exp) {
+        // shortest form round-trips but in the wrong notation (e.g. %g
+        // gives "2e+01" for 20.0); remember it, keep looking for a
+        // notation-matching precision
+        if (fallback_n < 0) {
+          memcpy(fallback, buf, n + 1);
+          fallback_n = n;
+        }
+        continue;
+      }
+      if (!strpbrk(buf, ".eE"))
+        n += snprintf(buf + n, sizeof(buf) - n, ".0");
+      set_rendered(n);
+      return;
+    }
+    if (fallback_n >= 0) {
+      memcpy(buf, fallback, fallback_n + 1);
+      if (!strpbrk(buf, ".eE"))
+        fallback_n += snprintf(buf + fallback_n, sizeof(buf) - fallback_n, ".0");
+      set_rendered(fallback_n);
+    }
+  }
 };
 
 struct Plan {
@@ -195,6 +263,9 @@ struct Exec {
         if (sink && sink->want == W_NUM) {
           sink->num = b ? 1.0 : 0.0;
           sink->have = true;
+        } else if (sink && sink->want == W_STR) {
+          sink->set_rendered(
+              snprintf(sink->buf, sizeof(sink->buf), b ? "True" : "False"));
         }
         return;
       }
@@ -204,6 +275,9 @@ struct Exec {
         if (sink && sink->want == W_NUM) {
           sink->num = static_cast<double>(v);
           sink->have = true;
+        } else if (sink && sink->want == W_STR) {
+          sink->set_rendered(snprintf(sink->buf, sizeof(sink->buf), "%lld",
+                                      static_cast<long long>(v)));
         }
         return;
       }
@@ -212,6 +286,8 @@ struct Exec {
         if (sink && sink->want == W_NUM) {
           sink->num = v;
           sink->have = true;
+        } else if (sink && sink->want == W_STR) {
+          sink->render_double(v);
         }
         return;
       }
@@ -220,6 +296,8 @@ struct Exec {
         if (sink && sink->want == W_NUM) {
           sink->num = v;
           sink->have = true;
+        } else if (sink && sink->want == W_STR) {
+          sink->render_double(v);
         }
         return;
       }
@@ -232,6 +310,27 @@ struct Exec {
           sink->str = s;
           sink->str_len = n;
           sink->have = true;
+        } else if (sink && sink->want == W_NUM && n > 0) {
+          // a numeric field whose union carries a string branch (the
+          // metronome label union): parse iff the whole token is a
+          // number, with Python-float() parity (no hex literals)
+          std::string tmp(reinterpret_cast<const char*>(s),
+                          static_cast<size_t>(n));
+          // float() strips surrounding whitespace
+          size_t b = tmp.find_first_not_of(" \t\n\r\f\v");
+          size_t e = tmp.find_last_not_of(" \t\n\r\f\v");
+          if (b != std::string::npos) {
+            tmp = tmp.substr(b, e - b + 1);
+            if (tmp.find('x') == std::string::npos &&
+                tmp.find('X') == std::string::npos) {
+              char* end = nullptr;
+              double v = c_strtod(tmp.c_str(), &end);
+              if (end == tmp.c_str() + tmp.size()) {
+                sink->num = v;
+                sink->have = true;
+              }
+            }
+          }
         }
         return;
       }

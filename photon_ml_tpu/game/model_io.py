@@ -287,7 +287,13 @@ def load_game_model(model_dir: str) -> LoadedGameModel:
                 parts = f.read().split()
             re_type, shard_id = parts[0], parts[1] if len(parts) > 1 else parts[0]
             per_entity: Dict[str, Dict[str, float]] = {}
-            for rec in read_avro_records(os.path.join(base, COEFFICIENTS)):
+            coef_dir = os.path.join(base, COEFFICIENTS)
+            # A random-effect coordinate with no part files loads as an
+            # empty per-entity map (every entity scores 0 through this
+            # coordinate) — the reference's own GameIntegTest/gameModel
+            # fixture ships exactly this shape (id-info only).
+            recs = read_avro_records(coef_dir) if os.path.isdir(coef_dir) else ()
+            for rec in recs:
                 per_entity[rec["modelId"]] = {
                     f"{m['name']}\t{m['term']}": m["value"]
                     for m in rec["means"]

@@ -174,23 +174,40 @@ def _emit_plain(schema, out: List[int]) -> None:
     raise PlanError(f"unsupported schema node: {schema!r}")
 
 
+# Every scalar type is capturable under a string sink: numeric/bool
+# branches render as their Python-str form (C++ Sink::render_double /
+# %lld / True|False) — the metronome TrainingExample schema types uid as
+# [null, string, long, int] and GAME id columns are frequently plain ints.
+_STR_CAPTURABLE = {"string", "bytes"} | _NUMERIC
+
+
 def _is_stringish(schema) -> bool:
     t = _type_name(schema)
-    if t in ("string", "bytes"):
+    if t in _STR_CAPTURABLE:
         return True
     if isinstance(schema, list):
-        return all(_type_name(b) in ("null", "string", "bytes") for b in schema)
+        return all(
+            _type_name(b) == "null" or _type_name(b) in _STR_CAPTURABLE
+            for b in schema
+        )
     return False
 
 
 def _is_numeric(schema) -> bool:
+    """Capturable under a numeric sink. Union branches beyond the numeric
+    ones are tolerated when a numeric branch exists: a string branch
+    parses via strtod when it holds a number and reads as NaN-missing
+    otherwise (the metronome label union is
+    [double,float,int,long,boolean,string])."""
     t = _type_name(schema)
     if t in _NUMERIC:
         return True
     if isinstance(schema, list):
+        names = [_type_name(b) for b in schema]
+        if not any(n in _NUMERIC for n in names):
+            return False
         return all(
-            _type_name(b) == "null" or _type_name(b) in _NUMERIC
-            for b in schema
+            n in _NUMERIC or n in ("null", "string", "bytes") for n in names
         )
     return False
 
@@ -352,7 +369,7 @@ class Plan:
 
     def _emit_map(self, schema, out: List[int]) -> None:
         if not _is_stringish(schema["values"]):
-            raise PlanError("metadata map values must be strings")
+            raise PlanError("metadata map values must be scalar")
         sub: List[int] = []
         _emit_plain(schema["values"], sub)
         # map ids land in i32 slots AFTER the named string slots; the

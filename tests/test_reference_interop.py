@@ -1,0 +1,230 @@
+"""Interop tests pinned on the reference's OWN JVM/Spark-written fixtures.
+
+Every other Avro test in the suite is a self-round-trip; these read the
+16 MB of artifacts the reference ships under integTest/resources — files
+written by org.apache.avro's Java implementation and Spark — so a silent
+wire-format divergence in our codec cannot pass. Mirrors the reference's
+own correctness bar: DriverIntegTest.scala (heart data end-to-end) and
+cli/game/scoring/DriverTest.scala (yahoo-music scoring against a saved
+GAME model, RMSE pinned at 1.32106 from an assumed-correct 2016 capture).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.io.avro_codec import read_avro_records, read_container
+
+REF = "/root/reference/photon-ml/src/integTest/resources"
+DRIVER_IN = os.path.join(REF, "DriverIntegTest", "input")
+GAME_REF = os.path.join(REF, "GameIntegTest")
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        not os.path.isdir(REF), reason="reference fixtures unavailable"
+    ),
+]
+
+
+class TestHeartAvroDecode:
+    """heart.avro: 250 records of the metronome TrainingExample schema —
+    union-typed label/weight/offset/uid, written by the JVM."""
+
+    def test_python_codec_reads_jvm_file(self):
+        schema, it = read_container(os.path.join(DRIVER_IN, "heart.avro"))
+        recs = list(it)
+        assert schema["name"] == "TrainingExample"
+        assert schema["namespace"] == "com.linkedin.metronome.avro.generated"
+        assert len(recs) == 250
+        labels = [r["label"] for r in recs]
+        assert sorted(set(labels)) == [0, 1]
+        assert labels.count(1) == 112
+        r0 = recs[0]
+        # optional union branches decode as None, not as missing keys
+        assert r0["uid"] is None and r0["weight"] is None and r0["offset"] is None
+        assert len(r0["features"]) == 13
+        assert r0["features"][0] == {"name": "1", "value": 70.0, "term": ""}
+
+    def test_validation_and_empty_files(self):
+        val = list(
+            read_avro_records(os.path.join(DRIVER_IN, "heart_validation.avro"))
+        )
+        assert len(val) == 20
+        # "empty.avro" carries records whose feature bags are all empty
+        empty = list(read_avro_records(os.path.join(DRIVER_IN, "empty.avro")))
+        assert len(empty) == 250
+
+    def test_native_decoder_matches_python_codec(self):
+        from photon_ml_tpu.io import native_avro
+
+        if not native_avro.available():
+            pytest.skip("native avro build unavailable")
+        path = os.path.join(DRIVER_IN, "heart.avro")
+        recs = list(read_avro_records(path))
+        plan = native_avro.plan_for_file(
+            path,
+            numeric_fields=["label", "weight", "offset"],
+            string_fields=["uid"],
+            bag_fields=["features"],
+        )
+        cols = native_avro.decode_columns(path, plan)
+        assert cols.num_records == len(recs)
+        np.testing.assert_array_equal(
+            cols.f64("label"), np.asarray([r["label"] for r in recs], np.float64)
+        )
+        row_ptr, _key_ids, values = cols.bag("features")
+        counts = np.diff(row_ptr)
+        np.testing.assert_array_equal(
+            counts, np.asarray([len(r["features"]) for r in recs])
+        )
+        flat = [f["value"] for r in recs for f in r["features"]]
+        np.testing.assert_allclose(values, np.asarray(flat), rtol=0, atol=0)
+
+    def test_glm_driver_end_to_end_on_heart(self, tmp_path):
+        """DriverIntegTest analog: train logistic regression on heart.avro,
+        validate on heart_validation.avro, model selected by held-out AUC."""
+        from photon_ml_tpu.cli.glm_driver import GLMDriver, GLMParams
+        from photon_ml_tpu.task import TaskType
+
+        params = GLMParams(
+            train_dir=os.path.join(DRIVER_IN, "heart.avro"),
+            validate_dir=os.path.join(DRIVER_IN, "heart_validation.avro"),
+            output_dir=str(tmp_path / "out"),
+            task=TaskType.LOGISTIC_REGRESSION,
+            regularization_weights=[1.0],
+        )
+        GLMDriver(params).run()
+        metrics = json.load(open(os.path.join(params.output_dir, "metrics.json")))
+        # capture on this fixture: AUC 0.75, logloss 0.54 (20-row val split)
+        assert metrics["validation"]["1.0"]["AUC"] >= 0.70
+        assert metrics["validation"]["1.0"]["logistic_loss"] <= 0.60
+        assert os.path.isfile(
+            os.path.join(params.output_dir, "best-model", "model.avro")
+        )
+
+
+class TestReferenceGameModelLoad:
+    """Saved-model interop: the reference's Spark-written GAME model
+    directories load through game/model_io.py (ModelProcessingUtils
+    layout parity, avro/Constants.scala:22-25)."""
+
+    def test_fixed_effect_only_model(self):
+        from photon_ml_tpu.game.model_io import load_game_model
+
+        m = load_game_model(os.path.join(GAME_REF, "fixedEffectOnlyGAMEModel"))
+        assert m.coordinate_names() == ["globalShard"]
+        shard_id, means = m.fixed_effects["globalShard"]
+        assert shard_id == "globalShard"
+        assert len(means) == 14982
+        # intercept value written by the JVM, decoded bit-exact
+        assert means["(INTERCEPT)\t"] == pytest.approx(
+            3.5525033712866567, abs=0
+        )
+
+    def test_full_game_model(self):
+        from photon_ml_tpu.game.model_io import load_game_model
+
+        m = load_game_model(os.path.join(GAME_REF, "gameModel"))
+        assert sorted(m.coordinate_names()) == [
+            "globalShard", "songId-songShard", "userId-userShard",
+        ]
+        re_type, shard_id, per_entity = m.random_effects["userId-userShard"]
+        assert (re_type, shard_id) == ("userId", "userShard")
+        # the shipped fixture has id-info but no RE part files (empty dirs
+        # don't survive git): loads as an empty per-entity map
+        assert per_entity == {}
+        _, means = m.fixed_effects["globalShard"]
+        assert len(means) == 14982
+
+
+class TestYahooMusicScoring:
+    """cli/game/scoring DriverTest analog on the shipped fixtures: score
+    yahoo-music-test.avro with the reference's saved model through the
+    scoring driver, evaluate RMSE.
+
+    The reference pins RMSE 1.32106 (its testOffHeapIndexMap capture,
+    LOW_PRECISION tolerance) on the uid-variant of this input; our run on
+    input/test with the fixed-effect model lands 1.3217 — within 6e-4 of
+    the JVM implementation's own anchor.
+    """
+
+    def _score(self, tmp_path, model_subdir):
+        from photon_ml_tpu.cli.game_scoring_driver import (
+            GameScoringDriver,
+            GameScoringParams,
+        )
+        from photon_ml_tpu.evaluation import EvaluatorType
+        from photon_ml_tpu.game.config import FeatureShardConfiguration
+        from photon_ml_tpu.task import TaskType
+
+        params = GameScoringParams(
+            input_dirs=[os.path.join(GAME_REF, "input", "test")],
+            game_model_input_dir=os.path.join(GAME_REF, model_subdir),
+            output_dir=str(tmp_path / "out"),
+            task_type=TaskType.LINEAR_REGRESSION,
+            feature_shards=[
+                FeatureShardConfiguration(
+                    "globalShard", ["features", "songFeatures", "userFeatures"]
+                ),
+            ],
+            feature_name_and_term_set_path=os.path.join(
+                GAME_REF, "input", "feature-lists"
+            ),
+            evaluator_types=[EvaluatorType.parse("RMSE")],
+            model_id="interop-test",
+        )
+        GameScoringDriver(params).run()
+        return params.output_dir
+
+    def test_score_with_reference_model(self, tmp_path):
+        out = self._score(tmp_path, "fixedEffectOnlyGAMEModel")
+        metrics = json.load(open(os.path.join(out, "metrics.json")))
+        assert metrics["RMSE"] == pytest.approx(1.32106, abs=2e-3)
+        recs = list(read_avro_records(os.path.join(out, "scores")))
+        assert len(recs) == 9195
+        assert all(r["modelId"] == "interop-test" for r in recs[:50])
+        assert np.isfinite([r["predictionScore"] for r in recs]).all()
+
+    def test_input_fixture_shape(self):
+        recs = list(
+            read_avro_records(
+                os.path.join(GAME_REF, "input", "test", "yahoo-music-test.avro")
+            )
+        )
+        assert len(recs) == 9195
+        r0 = recs[0]
+        assert {"userId", "songId", "response", "features"} <= set(r0)
+
+    def test_native_game_build_matches_python(self, monkeypatch):
+        """The yahoo-music records (int id columns, union-typed fields) now
+        decode through the native column path; labels and raw entity ids
+        must match the Python-codec build exactly."""
+        from photon_ml_tpu.game.config import FeatureShardConfiguration
+        from photon_ml_tpu.game.data import build_game_dataset_from_files
+        from photon_ml_tpu.io import native_avro
+
+        if not native_avro.available():
+            pytest.skip("native avro build unavailable")
+        files = [
+            os.path.join(GAME_REF, "input", "test", "yahoo-music-test.avro")
+        ]
+        shards = [FeatureShardConfiguration("globalShard", ["features"])]
+        native_ds = build_game_dataset_from_files(
+            files, shards, ["userId", "songId"]
+        )
+        monkeypatch.setattr(native_avro, "available", lambda: False)
+        python_ds = build_game_dataset_from_files(
+            files, shards, ["userId", "songId"]
+        )
+        assert native_ds.num_rows == python_ds.num_rows
+        np.testing.assert_array_equal(native_ds.labels, python_ds.labels)
+        for t in ("userId", "songId"):
+            n_ids = native_ds.entity_indexes[t]
+            p_ids = python_ds.entity_indexes[t]
+            assert sorted(n_ids.ids) == sorted(p_ids.ids)
+            n_raw = [n_ids.ids[c] for c in native_ds.entity_codes[t][: native_ds.num_real_rows]]
+            p_raw = [p_ids.ids[c] for c in python_ds.entity_codes[t][: python_ds.num_real_rows]]
+            assert n_raw == p_raw
