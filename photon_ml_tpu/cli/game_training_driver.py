@@ -349,6 +349,12 @@ class GameTrainingDriver:
                 ocfg.regularization,
                 reg_weight=ocfg.reg_weight,
                 mesh=mesh,
+                # plain RE coordinates attach per-entity variances; the
+                # factored path persists in the ORIGINAL space where the
+                # latent-space Hdiag does not transform diagonally
+                compute_variances=(
+                    p.compute_variance and name not in p.factored_re_configs
+                ),
             )
             if name in p.factored_re_configs:
                 fcfg = p.factored_re_configs[name]

@@ -156,10 +156,17 @@ class RandomEffectCoordinate(Coordinate):
         offsets = self.dataset.offsets
         if residual is not None:
             offsets = jnp.asarray(offsets) + residual  # device-resident
-        bank, tracker = self.problem.update_bank(
-            model.bank, self.re_dataset, residual_offsets=offsets
-        )
-        return replace(model, bank=bank), tracker
+        variances = None
+        if self.problem.compute_variances:
+            bank, tracker, variances = self.problem.update_bank(
+                model.bank, self.re_dataset, residual_offsets=offsets,
+                with_variances=True,
+            )
+        else:
+            bank, tracker = self.problem.update_bank(
+                model.bank, self.re_dataset, residual_offsets=offsets
+            )
+        return replace(model, bank=bank, variances=variances), tracker
 
     def score(self, model: RandomEffectModel) -> Array:
         return score_random_effect(model.bank, self.re_dataset)

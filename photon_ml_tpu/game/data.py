@@ -244,7 +244,10 @@ def build_game_dataset(
         # None -> 1.0 but an EXPLICIT 0.0 weight stays 0 (the old `or`
         # coerced falsy zero, diverging from the native column path)
         weights[i] = 1.0 if wgt_v is None else float(wgt_v)
-        uids.append(str(r.get("uid") or i))
+        # row index only for a MISSING uid: 0 or "" are legitimate ids and
+        # must round-trip (the native column path preserves them)
+        uid_v = r.get("uid")
+        uids.append(str(i) if uid_v is None else str(uid_v))
 
     shards: Dict[str, ShardData] = {}
     for cfg in shard_configs:

@@ -58,6 +58,9 @@ class RandomEffectModel(DatumScoringModel):
     re_dataset: RandomEffectDataset
     random_effect_type: str
     feature_shard_id: str
+    # per-entity coefficient variances [E, D], populated when the problem
+    # runs with compute_variances (isComputingVariance analog)
+    variances: Optional[Array] = None
 
     def score(self, dataset: GameDataset) -> Array:
         # The bank's projection is tied to re_dataset; scoring another

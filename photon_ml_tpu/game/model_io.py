@@ -102,10 +102,14 @@ def save_game_model(
             imap = dataset.shards[sub.feature_shard_id].index_map
             eindex = dataset.entity_indexes[sub.random_effect_type]
             bank = np.asarray(sub.bank)
+            bank_vars = (
+                np.asarray(sub.variances) if sub.variances is not None else None
+            )
             projection = sub.re_dataset.projection
             records = []
             for e in range(sub.re_dataset.num_entities):
                 means = []
+                variances = [] if bank_vars is not None else None
                 for local, g in enumerate(projection[e]):
                     if g < 0:
                         continue
@@ -117,11 +121,17 @@ def save_game_model(
                         continue
                     nm, term = split_feature_key(key)
                     means.append({"name": nm, "term": term, "value": v})
+                    if variances is not None:
+                        variances.append({
+                            "name": nm,
+                            "term": term,
+                            "value": float(bank_vars[e, local]),
+                        })
                 records.append({
                     "modelId": eindex.ids[e],
                     "modelClass": None,
                     "means": means,
-                    "variances": None,
+                    "variances": variances,
                     "lossFunction": None,
                 })
             _write_parts(
@@ -201,6 +211,10 @@ class LoadedGameModel:
         self.fixed_effects: Dict[str, Tuple[str, "np.ndarray"]] = {}
         self.random_effects: Dict[str, Tuple[str, str, Dict[str, Dict[str, float]]]] = {}
         self.matrix_factorizations: Dict[str, Tuple[str, str, Dict[str, np.ndarray], Dict[str, np.ndarray]]] = {}
+        # {coordinate: {entity id: {feature key: variance}}} for models
+        # saved with per-entity variances (scoring ignores them; they load
+        # for inspection/round-trip parity)
+        self.random_effect_variances: Dict[str, Dict[str, Dict[str, float]]] = {}
 
     def coordinate_names(self) -> List[str]:
         return (
@@ -293,12 +307,20 @@ def load_game_model(model_dir: str) -> LoadedGameModel:
             # coordinate) — the reference's own GameIntegTest/gameModel
             # fixture ships exactly this shape (id-info only).
             recs = read_avro_records(coef_dir) if os.path.isdir(coef_dir) else ()
+            per_entity_vars: Dict[str, Dict[str, float]] = {}
             for rec in recs:
                 per_entity[rec["modelId"]] = {
                     f"{m['name']}\t{m['term']}": m["value"]
                     for m in rec["means"]
                 }
+                if rec.get("variances"):
+                    per_entity_vars[rec["modelId"]] = {
+                        f"{m['name']}\t{m['term']}": m["value"]
+                        for m in rec["variances"]
+                    }
             out.random_effects[name] = (re_type, shard_id, per_entity)
+            if per_entity_vars:
+                out.random_effect_variances[name] = per_entity_vars
     mf_dir = os.path.join(model_dir, MATRIX_FACTORIZATION)
     if os.path.isdir(mf_dir):
         for name in sorted(os.listdir(mf_dir)):

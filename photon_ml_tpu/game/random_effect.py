@@ -34,6 +34,7 @@ from photon_ml_tpu.optim.common import (
     CONVERGENCE_REASON_NAMES,
     FUNCTION_VALUES_WITHIN_TOLERANCE,
     GRADIENT_WITHIN_TOLERANCE,
+    LINE_SEARCH_STALLED,
     MAX_ITERATIONS,
     NOT_CONVERGED,
     OptResult,
@@ -342,7 +343,7 @@ def _bucket_solver(
                     jnp.where(
                         plateau,
                         FUNCTION_VALUES_WITHIN_TOLERANCE,
-                        MAX_ITERATIONS,  # no decreasing step exists
+                        LINE_SEARCH_STALLED,  # no decreasing step exists
                     ),
                 ).astype(jnp.int32)
                 return (c2, z2, f2, g2_vec, it2, reason)
@@ -392,6 +393,24 @@ def _bucket_solver(
 
         return fused
 
+    @jax.jit
+    def hdiag(sl, ix, v, lab, off, w, l2):
+        """Per-entity Hessian diagonals at the given bank rows:
+        Hdiag_e[j] = sum_s w_s l''(z_s) x_{s,j}^2 + l2 — the
+        computeVariances input (RandomEffectOptimizationProblem.
+        scala:106-127 -> GeneralizedLinearOptimizationProblem
+        computeVariances). One pass, not a solve: padded samples carry
+        w = 0 and contribute nothing."""
+
+        def one(c_e, ix_e, v_e, lab_e, off_e, w_e):
+            z = jnp.sum(v_e * jnp.take(c_e, ix_e, axis=0), axis=-1) + off_e
+            cd = w_e * loss.d2(z, lab_e)
+            return jnp.zeros_like(c_e).at[ix_e.reshape(-1)].add(
+                ((v_e * v_e) * cd[:, None]).reshape(-1)
+            )
+
+        return jax.vmap(one)(sl, ix, v, lab, off, w) + l2
+
     from types import SimpleNamespace
 
     return SimpleNamespace(
@@ -401,6 +420,7 @@ def _bucket_solver(
         fused_sparse=_fused(solve),
         fused_dense=_fused(solve_dense),
         fused_newton=_fused(solve_dense_newton),
+        hdiag=hdiag,
     )
 
 
@@ -429,6 +449,10 @@ class RandomEffectOptimizationProblem:
     # per line-search trial); "sparse"/"dense" force a layout.
     layout: str = "auto"
     dense_bytes_budget: int = 2 << 30
+    # isComputingVariance (RandomEffectOptimizationProblem.scala:106-127):
+    # the coordinate attaches bank_variances() to the model after each
+    # bank update so saved per-entity models carry them
+    compute_variances: bool = False
 
     def __post_init__(self):
         if self.layout not in ("auto", "sparse", "dense"):
@@ -546,15 +570,50 @@ class RandomEffectOptimizationProblem:
             out.append(jax.device_put(a, sharding))
         return out, e
 
+    def _route_residuals(self, dataset, residual_offsets):
+        """Pre-loop residual-offset routing shared by update_bank and
+        bank_variances: -> (offsets_f32, routed_buffers, router)."""
+        routed = None
+        router = None
+        if residual_offsets is not None:
+            residual_offsets = jnp.asarray(residual_offsets, jnp.float32)
+            if self.mesh is not None and dataset.buckets:
+                # ICI re-key: ONE all_to_all routes each row's offset to
+                # its entity's owner device (the addScoresToOffsets
+                # shuffle analog) instead of replicating the whole [n]
+                # vector to every device.
+                router = self._router_for(dataset)
+                routed = router.route(residual_offsets)
+        return residual_offsets, routed, router
+
+    def _bucket_offsets(
+        self, bi, bucket, rows_d, residual_offsets, routed, router
+    ):
+        """One bucket's per-sample offsets from the routed residuals."""
+        if routed is not None:
+            # mesh path: slice this bucket's slab out of the routed
+            # per-device buffers — already entity-sharded
+            return router.bucket_slab(routed, bi, bucket.capacity)
+        # single device: per-row gather stays on device — the
+        # KeyValueScore residual currency never leaves it
+        # (SURVEY §7.9; round 2 gathered on host per bucket)
+        return jnp.where(
+            rows_d >= 0, residual_offsets[jnp.maximum(rows_d, 0)], 0.0
+        )
+
     def update_bank(
         self,
         bank: Array,  # [E, D]
         dataset: RandomEffectDataset,
         residual_offsets: Optional[Array] = None,  # [n] replaces offsets
         values_override: Optional[Sequence[Array]] = None,
-    ) -> Tuple[Array, RandomEffectTracker]:
+        with_variances: bool = False,
+    ):
         """Solve every entity against its active data; returns the new bank
-        and an aggregated tracker.
+        and an aggregated tracker — plus the per-entity variance bank when
+        ``with_variances`` (the Hdiag pass runs inside the bucket loop with
+        the already-routed offsets in hand, so the mesh path pays no second
+        residual all_to_all).
 
         ``values_override``: device-resident per-bucket feature values
         (aligned with ``dataset.buckets``) replacing each bucket's stored
@@ -576,17 +635,12 @@ class RandomEffectOptimizationProblem:
             # (in-place scatter per bucket) while the caller's reference
             # stays valid
             bank = jnp.array(bank, copy=True)
-        routed = None
-        router = None
-        if residual_offsets is not None:
-            residual_offsets = jnp.asarray(residual_offsets, jnp.float32)
-            if self.mesh is not None and dataset.buckets:
-                # ICI re-key: ONE all_to_all routes each row's offset to
-                # its entity's owner device (the addScoresToOffsets
-                # shuffle analog) instead of replicating the whole [n]
-                # vector to every device.
-                router = self._router_for(dataset)
-                routed = router.route(residual_offsets)
+        residual_offsets, routed, router = self._route_residuals(
+            dataset, residual_offsets
+        )
+        var_bank = jnp.zeros_like(bank) if with_variances else None
+        if with_variances:
+            from photon_ml_tpu.optim.problem import _VARIANCE_EPSILON
         for bi, bucket in enumerate(dataset.buckets):
             (
                 ix_d, v_d, lab_d, w_d, off_d, rows_d, codes_d,
@@ -603,19 +657,9 @@ class RandomEffectOptimizationProblem:
                 if self.mesh is not None:
                     (v_d,), _ = self._shard_entity_axis([v_d])
             if residual_offsets is not None:
-                if routed is not None:
-                    # mesh path: slice this bucket's slab out of the
-                    # routed per-device buffers — already entity-sharded
-                    off_d = router.bucket_slab(routed, bi, bucket.capacity)
-                else:
-                    # single device: per-row gather stays on device — the
-                    # KeyValueScore residual currency never leaves it
-                    # (SURVEY §7.9; round 2 gathered on host per bucket)
-                    off_d = jnp.where(
-                        rows_d >= 0,
-                        residual_offsets[jnp.maximum(rows_d, 0)],
-                        0.0,
-                    )
+                off_d = self._bucket_offsets(
+                    bi, bucket, rows_d, residual_offsets, routed, router
+                )
             n_real = bucket.num_entities
             use_dense = self._use_dense(bucket, bank.shape[1])
             kind = (
@@ -646,6 +690,17 @@ class RandomEffectOptimizationProblem:
                 it_sum = jnp.sum(iters)
                 it_max = jnp.max(iters)
                 counts = jnp.bincount(reasons, length=n_codes)
+            if with_variances:
+                # Hdiag at the just-solved rows, same off_d — no re-route
+                sl_new = jnp.take(bank, codes_d, axis=0)
+                if self.mesh is not None:
+                    (sl_new,), _ = self._shard_entity_axis([sl_new])
+                hd = self._solvers.hdiag(
+                    sl_new, ix_d, v_d, lab_d, off_d, w_d, l2_d
+                )
+                var_bank = var_bank.at[codes_d].set(
+                    1.0 / (hd[:n_real] + _VARIANCE_EPSILON)
+                )
             n_reals.append(n_real)
             stat_vecs.append(
                 jnp.concatenate([jnp.stack([it_sum, it_max]), counts])
@@ -670,7 +725,47 @@ class RandomEffectOptimizationProblem:
             )
         else:
             tracker = RandomEffectTracker(0, 0.0, 0, {})
+        if with_variances:
+            return bank, tracker, var_bank
         return bank, tracker
+
+    def bank_variances(
+        self,
+        bank: Array,  # [E, D]
+        dataset: RandomEffectDataset,
+        residual_offsets: Optional[Array] = None,
+    ) -> Array:
+        """Per-entity coefficient variances 1/(Hdiag + eps) at the bank
+        solution, [E, D] aligned with the bank (isComputingVariance:
+        RandomEffectOptimizationProblem.scala:106-127 plumbs variance
+        computation into every per-entity solve; the per-entity Bayesian
+        models save them via ModelProcessingUtils.scala:44-189). One
+        vmapped Hdiag pass per bucket — no solve."""
+        from photon_ml_tpu.optim.problem import _VARIANCE_EPSILON
+
+        _, l2 = self.regularization.split(self.reg_weight)
+        l2_d = jnp.float32(l2)
+        residual_offsets, routed, router = self._route_residuals(
+            dataset, residual_offsets
+        )
+        variances = jnp.zeros_like(bank)
+        for bi, bucket in enumerate(dataset.buckets):
+            (
+                ix_d, v_d, lab_d, w_d, off_d, rows_d, codes_d,
+            ) = self._bucket_device_args(bucket)
+            if residual_offsets is not None:
+                off_d = self._bucket_offsets(
+                    bi, bucket, rows_d, residual_offsets, routed, router
+                )
+            n_real = bucket.num_entities
+            sl = bank[codes_d]
+            if self.mesh is not None:
+                (sl,), _ = self._shard_entity_axis([sl])
+            hd = self._solvers.hdiag(sl, ix_d, v_d, lab_d, off_d, w_d, l2_d)
+            variances = variances.at[codes_d].set(
+                1.0 / (hd[:n_real] + _VARIANCE_EPSILON)
+            )
+        return variances
 
     def regularization_term(self, bank: Array) -> float:
         """Sum of per-entity reg terms (Coordinate.regTerm analog)."""

@@ -809,6 +809,16 @@ def _place_data_sharded(batch: TiledSparseBatch, mesh, axis: str):
     return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
 
 
+# Sharded-schedule cache for ensure_tiled_sharded: a caller that wraps the
+# SAME indices/values/weights arrays in a fresh SparseBatch per call (the
+# GAME coordinate-descent pattern — only offsets change between sweeps)
+# must not pay the multi-second schedule rebuild + host pull every call.
+# Keyed by array identity; FIFO-bounded because each entry pins a tiled
+# batch in HBM.
+_SHARDED_CACHE: dict = {}
+_SHARDED_CACHE_MAX = 2
+
+
 def ensure_tiled_sharded(
     batch,
     dim: int,
@@ -820,7 +830,10 @@ def ensure_tiled_sharded(
     """Idempotent mesh-layout conversion (the tiled analog of
     parallel.mesh.ensure_data_sharded): SparseBatch -> sharded tiled build;
     an already-matching TiledSparseBatch passes through (so a lambda grid
-    or coordinate-descent loop pays the schedule build + transfer once)."""
+    or coordinate-descent loop pays the schedule build + transfer once).
+    A SparseBatch sharing indices/values/weights with a previous call
+    reuses the cached schedules — only the row metadata (labels/offsets/
+    weights, the parts a CD sweep changes) is re-padded and re-placed."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     n = int(mesh.shape[axis])
@@ -834,9 +847,38 @@ def ensure_tiled_sharded(
         if getattr(batch.labels, "sharding", None) == NamedSharding(mesh, P(axis)):
             return batch
         return _place_data_sharded(batch, mesh, axis)
-    return build_sharded_tiled_batch(
+    key = (
+        id(batch.indices), id(batch.values), id(batch.weights),
+        dim, n, id(mesh), axis, params,
+    )
+    hit = _SHARDED_CACHE.get(key)
+    if hit is not None:
+        (ix_ref, v_ref, w_ref), cached = hit
+        if (
+            ix_ref is batch.indices
+            and v_ref is batch.values
+            and w_ref is batch.weights
+        ):
+            meta = cached.meta
+            lab, off, wgt = _padded_row_meta(
+                batch, meta.data_shards * meta.num_rows, meta.num_real_rows
+            )
+            row_sh = NamedSharding(mesh, P(axis))
+            return cached._replace(
+                labels=jax.device_put(lab, row_sh),
+                offsets=jax.device_put(off, row_sh),
+                weights=jax.device_put(wgt, row_sh),
+            )
+        del _SHARDED_CACHE[key]  # stale id collision
+    out = build_sharded_tiled_batch(
         batch, dim, n, params=params or TileParams(), mesh=mesh, axis=axis
     )
+    while len(_SHARDED_CACHE) >= _SHARDED_CACHE_MAX:
+        _SHARDED_CACHE.pop(next(iter(_SHARDED_CACHE)))
+    _SHARDED_CACHE[key] = (
+        (batch.indices, batch.values, batch.weights), out,
+    )
+    return out
 
 
 # ---------------------------------------------------------------------------

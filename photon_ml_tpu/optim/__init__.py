@@ -6,6 +6,7 @@ from photon_ml_tpu.optim.common import (
     CONVERGENCE_REASON_NAMES,
     FUNCTION_VALUES_WITHIN_TOLERANCE,
     GRADIENT_WITHIN_TOLERANCE,
+    LINE_SEARCH_STALLED,
     MAX_ITERATIONS,
     NOT_CONVERGED,
     OptResult,
@@ -28,6 +29,7 @@ __all__ = [
     "CONVERGENCE_REASON_NAMES",
     "FUNCTION_VALUES_WITHIN_TOLERANCE",
     "GRADIENT_WITHIN_TOLERANCE",
+    "LINE_SEARCH_STALLED",
     "MAX_ITERATIONS",
     "NOT_CONVERGED",
     "OptResult",

@@ -31,12 +31,17 @@ NOT_CONVERGED = 0
 MAX_ITERATIONS = 1
 FUNCTION_VALUES_WITHIN_TOLERANCE = 2
 GRADIENT_WITHIN_TOLERANCE = 3
+# A backtracking line search found no decreasing step (Breeze's
+# LineSearchFailed / ObjectiveNotImproving analog) — distinct from
+# hitting the iteration cap.
+LINE_SEARCH_STALLED = 4
 
 CONVERGENCE_REASON_NAMES = {
     NOT_CONVERGED: "NotConverged",
     MAX_ITERATIONS: "MaxIterations",
     FUNCTION_VALUES_WITHIN_TOLERANCE: "FunctionValuesWithinTolerance",
     GRADIENT_WITHIN_TOLERANCE: "GradientWithinTolerance",
+    LINE_SEARCH_STALLED: "LineSearchStalled",
 }
 
 

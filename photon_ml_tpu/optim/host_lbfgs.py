@@ -19,7 +19,7 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.optim.common import (
     GRADIENT_WITHIN_TOLERANCE,
-    MAX_ITERATIONS,
+    LINE_SEARCH_STALLED,
     NOT_CONVERGED,
     OptResult,
     Tracker,
@@ -115,8 +115,9 @@ def minimize_lbfgs_host(
             w, f, g = w_new, f_new, g_new
             tracker = tracker.record(f, jnp.float32(g_norm))
         else:
-            # stalled line search: no further progress possible
-            reason = MAX_ITERATIONS
+            # stalled line search: no decreasing step exists from here —
+            # report it as such, not as an iteration-cap stop
+            reason = LINE_SEARCH_STALLED
     return OptResult(
         coefficients=w,
         value=jnp.float32(float(f)),

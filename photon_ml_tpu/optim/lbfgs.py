@@ -33,6 +33,7 @@ from jax import lax
 from photon_ml_tpu.optim.common import (
     BoxConstraints,
     GRADIENT_WITHIN_TOLERANCE,
+    LINE_SEARCH_STALLED,
     MAX_ITERATIONS,
     NOT_CONVERGED,
     OptResult,
@@ -196,7 +197,7 @@ def minimize_lbfgs(
             check_convergence(
                 it, st.f, ls.f, g_norm, f0, g0_norm, max_iter=max_iter, tol=tol
             ),
-            MAX_ITERATIONS,
+            LINE_SEARCH_STALLED,
         ).astype(jnp.int32)
         return _LoopState(
             w=ls.w, f=ls.f, g=ls.g, mem=mem, iteration=it, reason=reason,
@@ -311,14 +312,14 @@ def minimize_owlqn(
         it = st.iteration + 1
         pg_new = _pseudo_gradient(ls.w, ls.g, l1_vec)
         pg_norm = norm(pg_new)
-        # Stalled line search reports MAX_ITERATIONS, not convergence.
+        # Stalled line search reports LINE_SEARCH_STALLED, not convergence.
         reason = jnp.where(
             ls.ok,
             check_convergence(
                 it, f_cur_total, ls.f, pg_norm, f0, g0_norm,
                 max_iter=max_iter, tol=tol,
             ),
-            MAX_ITERATIONS,
+            LINE_SEARCH_STALLED,
         ).astype(jnp.int32)
         return _LoopState(
             w=ls.w, f=f_smooth_new, g=ls.g, mem=mem, iteration=it,

@@ -279,6 +279,48 @@ class TestRandomEffectSolver:
         # Newton converges in far fewer iterations than L-BFGS
         assert tracker.iterations_max <= 20
 
+    def test_bank_variances_match_direct(self, rng):
+        """bank_variances = 1/(Hdiag + eps) per entity at the solution,
+        Hdiag[j] = sum_i w_i l''(z_i) x_ij^2 + l2 (isComputingVariance,
+        RandomEffectOptimizationProblem.scala:106-127)."""
+        from photon_ml_tpu.optim.problem import _VARIANCE_EPSILON
+
+        recs, _, _ = make_records(rng, n=120, n_users=5)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        red = build_random_effect_dataset(
+            ds, RandomEffectDataConfiguration("userId", "userShard")
+        )
+        problem = RandomEffectOptimizationProblem(
+            LOGISTIC, OptimizerConfig(max_iter=100),
+            RegularizationContext(RegularizationType.L2), reg_weight=1.0,
+        )
+        bank = jnp.zeros((red.num_entities, red.local_dim), jnp.float32)
+        bank, _ = problem.update_bank(bank, red)
+        variances = np.asarray(problem.bank_variances(bank, red))
+        assert variances.shape == bank.shape
+        assert (variances > 0).all()
+
+        codes = ds.entity_codes["userId"]
+        sd = ds.shards["userShard"]
+        for e in range(3):
+            rows = np.nonzero((codes == e) & (ds.weights > 0))[0]
+            proj = red.projection[e]
+            D_e = int((proj >= 0).sum())
+            gl2loc = {int(g): l for l, g in enumerate(proj[:D_e])}
+            hd = np.full(D_e, 1.0)  # l2 = reg_weight
+            for i in rows:
+                z = ds.offsets[i]
+                for g, v in zip(sd.indices[i], sd.values[i]):
+                    if v != 0:
+                        z += v * float(bank[e, gl2loc[int(g)]])
+                d2 = float(ds.weights[i]) * float(LOGISTIC.d2(z, ds.labels[i]))
+                for g, v in zip(sd.indices[i], sd.values[i]):
+                    if v != 0:
+                        hd[gl2loc[int(g)]] += d2 * float(v) ** 2
+            np.testing.assert_allclose(
+                variances[e, :D_e], 1.0 / (hd + _VARIANCE_EPSILON), rtol=2e-4
+            )
+
     def test_scores_cover_all_rows(self, rng):
         recs, _, _ = make_records(rng)
         ds = build_game_dataset(recs, SHARDS, ["userId"])

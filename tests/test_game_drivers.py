@@ -320,6 +320,60 @@ class TestYahooMusicInterop:
         assert rmse < 1.4, metrics["validation_history"]
 
 
+class TestPerEntityVariances:
+    def test_variances_round_trip(self, tmp_path, rng):
+        """--compute-variance writes per-entity variances into the saved
+        BayesianLinearModelAvro records and they load back
+        (RandomEffectOptimizationProblem.scala:106-127,
+        ModelProcessingUtils.scala:44-189)."""
+        train = tmp_path / "train"; train.mkdir()
+        write_game_avro(str(train / "p0.avro"), rng, n=200)
+        tparams = GameTrainingParams(
+            train_input_dirs=[str(train)],
+            output_dir=str(tmp_path / "out"),
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            feature_shards=[
+                FeatureShardConfiguration("g", ["features"]),
+                FeatureShardConfiguration("u", ["userFeatures"]),
+            ],
+            fixed_effect_data_configs={
+                "global": FixedEffectDataConfiguration("g")
+            },
+            fixed_effect_opt_configs={"global": "10,1e-6,0.1,1,LBFGS,L2"},
+            random_effect_data_configs={
+                "per-user": RandomEffectDataConfiguration("userId", "u")
+            },
+            random_effect_opt_configs={"per-user": "10,1e-6,1.0,1,LBFGS,L2"},
+            num_iterations=1,
+            compute_variance=True,
+        )
+        GameTrainingDriver(tparams).run()
+        model_dir = os.path.join(tparams.output_dir, "best-model")
+        recs = list(read_avro_records(
+            os.path.join(model_dir, "random-effect", "per-user", "coefficients")
+        ))
+        assert len(recs) == 8
+        for rec in recs:
+            if not rec["means"]:
+                continue
+            assert rec["variances"] is not None
+            # variance entries align with means, all positive
+            assert [(m["name"], m["term"]) for m in rec["variances"]] == [
+                (m["name"], m["term"]) for m in rec["means"]
+            ]
+            assert all(m["value"] > 0 for m in rec["variances"])
+        model = load_game_model(model_dir)
+        per_entity_vars = model.random_effect_variances["per-user"]
+        _, _, per_entity = model.random_effects["per-user"]
+        populated = [k for k, m in per_entity.items() if m]
+        assert populated and set(per_entity_vars) >= set(populated)
+        # fixed-effect side carries variances too (GLM compute path)
+        fe = list(read_avro_records(
+            os.path.join(model_dir, "fixed-effect", "global", "coefficients")
+        ))
+        assert fe[0]["variances"] is not None
+
+
 class TestScoringOptionParity:
     def test_score_output_ids_num_files_and_model_id(self, tmp_path, rng):
         """random-effect-id-set ids ride along in metadataMap, --num-files

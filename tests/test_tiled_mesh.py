@@ -133,6 +133,26 @@ class TestShardedTiledBatch:
         tb2 = ensure_tiled_sharded(tb, d, mesh, params=PARAMS)
         assert tb2 is tb
 
+    def test_schedule_cache_across_fresh_wrappers(self, rng):
+        """A fresh SparseBatch sharing indices/values/weights with a prior
+        call (the GAME CD pattern: only offsets change per sweep) reuses
+        the cached schedules — no rebuild — while the new offsets land in
+        the returned batch."""
+        batch, d = random_problem(rng)
+        mesh = make_mesh()
+        tb = ensure_tiled_sharded(batch, d, mesh, params=PARAMS)
+        shifted = batch._replace(offsets=batch.offsets + 1.0)
+        tb2 = ensure_tiled_sharded(shifted, d, mesh, params=PARAMS)
+        assert tb2.z_sched.vals is tb.z_sched.vals  # schedules reused
+        n = batch.labels.shape[0]
+        np.testing.assert_allclose(
+            np.asarray(tb2.offsets)[:n], np.asarray(batch.offsets) + 1.0
+        )
+        # different values array -> genuine rebuild
+        scaled = batch._replace(values=batch.values * 2.0)
+        tb3 = ensure_tiled_sharded(scaled, d, mesh, params=PARAMS)
+        assert tb3.z_sched.vals is not tb.z_sched.vals
+
     def test_shard_count_mismatch_raises(self, rng):
         batch, d = random_problem(rng)
         mesh = make_mesh()
