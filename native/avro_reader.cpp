@@ -165,6 +165,7 @@ enum Want { W_NONE = 0, W_NUM = 1, W_STR = 2 };
 // JVM-written Avro decodes.
 static double c_strtod(const char* s, char** end = nullptr) {
   static locale_t loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+  if (!loc) return strtod(s, end);  // newlocale failed: best effort
   return strtod_l(s, end, loc);
 }
 

@@ -164,15 +164,6 @@ class GLMParams:
         if self.distributed not in ("auto", "off", "feature"):
             raise ValueError(f"unknown distributed mode {self.distributed!r}")
         if self.distributed == "feature":
-            if (
-                self.optimizer_type == OptimizerType.TRON
-                and self.kernel == "tiled"
-            ):
-                raise ValueError(
-                    "kernel='tiled' is not available with TRON on the "
-                    "feature-sharded path (no tiled Hessian-vector "
-                    "schedules); use --kernel auto or scatter"
-                )
             if self.constraint_string is not None:
                 raise ValueError(
                     "box constraints are not supported with feature-sharded "

@@ -62,24 +62,55 @@ class TileParams:
     # every chunk size), so the default stays 1; the knob remains for
     # kernel experiments. chunk must be divisible by split * 128.
     split: int = 1
+    # Spill-to-scatter threshold: a tile whose entry count modulo the
+    # chunk leaves a remainder <= spill_cap routes that remainder to a
+    # small XLA gather/scatter path instead of paying a nearly-empty
+    # grid step (and a tile with <= spill_cap entries total spills
+    # entirely). Break-even (measured, ads shape): one grid step costs
+    # ~3.9 us while a spilled entry costs ~15 ns of serialized
+    # gather+scatter, so the cap defaults to chunk // 16 (~260 at chunk
+    # 4096). None = default; 0 disables spilling.
+    spill_cap: Optional[int] = None
 
     @property
     def window(self) -> int:
         return self.s_hi * self.s_lo
 
+    def resolved_spill_cap(self) -> int:
+        if self.spill_cap is not None:
+            return self.spill_cap
+        return max(0, (self.chunk or 0) // 16)
+
     def resolved(self, n_entries: int, n_tiles_hint: int) -> "TileParams":
-        """Fix ``chunk=None`` from dataset statistics: pow2 of the mean
-        entries per (row-block x feature-block) tile, clamped to
-        [1024, 4096]. Tiny-window test configs (window < 1024) fall back
-        to the window size so toy schedules stay small."""
+        """Fix ``chunk=None`` from dataset statistics. Tiny-window test
+        configs (window < 1024) fall back to the window size so toy
+        schedules stay small.
+
+        With spilling enabled and tiles in the single-chunk regime, the
+        chunk is mean + 2*sqrt(mean) rounded up to a lane multiple: tile
+        occupancy concentrates around the mean (Poisson-ish), so a chunk
+        just past the +2-sigma tail holds ~98% of tiles in ONE ~97%-full
+        step and spills only the far tail. Measured at the ads shape
+        (mean 4078): chunk 4224 -> 16.5 ms/eval vs 18.6 at pow2 4096
+        (104k spills -> 2.3k) vs 23.1 without spilling. Multi-chunk
+        tiles (mean > 4096) keep the pow2 rule — the remainder logic
+        already spills or pads their tails."""
         if self.chunk is not None:
             return self
         import dataclasses
 
         avg = max(1, n_entries // max(n_tiles_hint, 1))
-        c = 1 << int(np.round(np.log2(avg)))
         lo = min(1024, self.window)
-        c = max(lo, min(4096, c))
+        spilling = self.spill_cap is None or self.spill_cap > 0
+        # lane slices in the kernel are chunk // split wide, so the
+        # resolved chunk must divide by split * 128
+        align = 128 * max(1, self.split)
+        if spilling and avg <= 4096:
+            c = int(-(-int(avg + 2.0 * np.sqrt(avg)) // align) * align)
+            c = max(lo, min(-(-4608 // align) * align, c))
+        else:
+            c = 1 << int(np.round(np.log2(avg)))
+            c = max(lo, min(4096, c))
         return dataclasses.replace(self, chunk=c)
 
 
@@ -92,6 +123,11 @@ class _Schedule(NamedTuple):
     for a 528 MB schedule) and [G, 1, L] 8x, while [G, L] is compact. In
     the kernel each [1, L] row broadcasts against sublane-iota; a
     [8, L//8] -> [L] reshape would be an unsupported Mosaic relayout.
+
+    ``spill_*``: the tile remainders routed around the kernel (see
+    TileParams.spill_cap) as SCHEDULE-LOCAL flat coordinates (output /
+    input position in this pass's padded out/in space). Zero-padded to a
+    lane multiple; padding slots carry val 0 at coordinate 0 — inert.
     """
 
     step_out: Array  # int32 [G] output block id per step
@@ -100,10 +136,27 @@ class _Schedule(NamedTuple):
     out_pos: Array  # int32 [G, L] window-local OUTPUT index in [0, WIN)
     in_pos: Array  # int32 [G, L] window-local INPUT index in [0, WIN)
     vals: Array  # float32 [G, L] entry values (0 for padding slots)
+    spill_out: Array  # int32 [S] flat output coordinate
+    spill_in: Array  # int32 [S] flat input coordinate
+    spill_vals: Array  # float32 [S] (0 for padding)
 
     @property
     def num_steps(self) -> int:
         return self.step_out.shape[0]
+
+    def apply_spill(
+        self, out_flat: Array, src_flat: Array,
+        vals: Optional[Array] = None,
+    ) -> Array:
+        """out_flat[spill_out] += spill_vals * src_flat[spill_in] — the
+        scatter cleanup completing the kernel's chunked partial sums.
+        ``vals`` overrides the entry values (the hessian-diagonal pass
+        squares them)."""
+        if self.spill_vals.shape[0] == 0:
+            return out_flat
+        v = self.spill_vals if vals is None else vals
+        contrib = v * jnp.take(src_flat, self.spill_in)
+        return out_flat.at[self.spill_out].add(contrib)
 
 
 import threading as _threading
@@ -155,12 +208,15 @@ def _tile_lib():
             p_i64 = ctypes.POINTER(i64)
             p_i32 = ctypes.POINTER(ctypes.c_int32)
             p_f32 = ctypes.POINTER(ctypes.c_float)
-            lib.ts_step_count.restype = i64
-            lib.ts_step_count.argtypes = [p_i64, p_i64, i64, i64, i64, i64]
+            lib.ts_plan.restype = i64
+            lib.ts_plan.argtypes = [
+                p_i64, p_i64, i64, i64, i64, i64, i64, p_i64, p_i64,
+            ]
             lib.ts_fill.restype = i64
             lib.ts_fill.argtypes = [
-                p_i64, p_i64, p_f32, i64, i64, i64, i64, i64,
+                p_i64, p_i64, p_f32, i64, i64, i64, i64, i64, i64, i64,
                 p_i32, p_i32, p_i32, p_i32, p_i32, p_f32,
+                p_i32, p_i32, p_f32,
             ]
             _tile_lib_handle = lib
         except Exception:
@@ -201,11 +257,25 @@ def _build_schedule_native(
         return a.ctypes.data_as(ctypes.POINTER(t))
 
     i64, i32, f32 = ctypes.c_int64, ctypes.c_int32, ctypes.c_float
-    G = lib.ts_step_count(
-        p(oc, i64), p(ic, i64), n, win, L, num_out_blocks
+    cap = params.resolved_spill_cap()
+    # flat spill coordinates must fit int32 — same (conservative,
+    # block-rounded) bound as the numpy builder so both produce
+    # identically shaped schedules
+    if cap and n and (
+        (int(oc.max()) // win) * win + win >= 2**31
+        or (int(ic.max()) // win) * win + win >= 2**31
+    ):
+        cap = 0
+    steps_out = ctypes.c_int64()
+    spilled_out = ctypes.c_int64()
+    rc = lib.ts_plan(
+        p(oc, i64), p(ic, i64), n, win, L, cap, num_out_blocks,
+        ctypes.byref(steps_out), ctypes.byref(spilled_out),
     )
-    if G < 0:
+    if rc != 0:
         return None
+    G = steps_out.value
+    S = spilled_out.value
     G8 = ((G + 7) // 8) * 8
     step_out = np.zeros(G, np.int32)
     step_in = np.zeros(G, np.int32)
@@ -213,14 +283,23 @@ def _build_schedule_native(
     o_pos = np.zeros((G8, L), np.int32)
     i_pos = np.zeros((G8, L), np.int32)
     sv = np.zeros((G8, L), np.float32)
+    sp_out = np.zeros(S, np.int32)
+    sp_in = np.zeros(S, np.int32)
+    sp_vals = np.zeros(S, np.float32)
     rc = lib.ts_fill(
-        p(oc, i64), p(ic, i64), p(v, f32), n, win, L, num_out_blocks, G,
+        p(oc, i64), p(ic, i64), p(v, f32), n, win, L, cap,
+        num_out_blocks, G, S,
         p(step_out, i32), p(step_in, i32), p(step_init, i32),
         p(o_pos, i32), p(i_pos, i32), p(sv, f32),
+        p(sp_out, i32), p(sp_in, i32), p(sp_vals, f32),
     )
     if rc != 0:
         return None
-    return step_out, step_in, step_init, o_pos, i_pos, sv
+    sp_out, sp_in, sp_vals = _pad_spill_np(sp_out, sp_in, sp_vals)
+    return (
+        step_out, step_in, step_init, o_pos, i_pos, sv,
+        sp_out, sp_in, sp_vals,
+    )
 
 
 def _build_schedule_np(
@@ -267,6 +346,10 @@ def _build_schedule_np(
         out_pos, in_pos = rows[order] % win, feats[order] % win
     v = vals[order]
     n_ent = len(v)
+    cap = params.resolved_spill_cap()
+    sp_out = np.zeros(0, np.int32)
+    sp_in = np.zeros(0, np.int32)
+    sp_vals = np.zeros(0, np.float32)
 
     if n_ent:
         # tile boundaries: chunk entries so no chunk crosses a tile
@@ -276,7 +359,47 @@ def _build_schedule_np(
             np.concatenate([[True], tile_key[1:] != tile_key[:-1]])
         )[0]
         tile_ends = np.concatenate([tile_starts[1:], [n_ent]])
-        n_chunks = -(-(tile_ends - tile_starts) // L)  # chunks per tile
+        sizes_t = tile_ends - tile_starts
+        if cap and (
+            int(out_blocks.max(initial=0)) * win + win >= 2**31
+            or int(in_blocks.max(initial=0)) * win + win >= 2**31
+        ):
+            cap = 0  # flat spill coordinates must fit int32
+        # spill rule (see TileParams.spill_cap): whole tiny tiles spill;
+        # otherwise a small remainder past the last full chunk spills —
+        # the spilled entries are each tile's TAIL in stable order
+        full = sizes_t // L
+        rem = sizes_t % L
+        spill_all = sizes_t <= cap
+        spill_tail = (~spill_all) & (rem > 0) & (rem <= cap) & (full >= 1)
+        n_spill_t = np.where(
+            spill_all, sizes_t, np.where(spill_tail, rem, 0)
+        )
+        kept_t = sizes_t - n_spill_t
+        n_chunks = -(-kept_t // L)  # 0 for fully spilled tiles
+        if int(n_spill_t.sum()):
+            pos_in_tile = np.arange(n_ent) - np.repeat(tile_starts, sizes_t)
+            is_spill = pos_in_tile >= np.repeat(kept_t, sizes_t)
+            sp_out = (
+                out_blocks[is_spill].astype(np.int64) * win
+                + out_pos[is_spill]
+            ).astype(np.int32)
+            sp_in = (
+                in_blocks[is_spill].astype(np.int64) * win
+                + in_pos[is_spill]
+            ).astype(np.int32)
+            sp_vals = v[is_spill].astype(np.float32)
+            keep = ~is_spill
+            out_blocks, in_blocks = out_blocks[keep], in_blocks[keep]
+            out_pos, in_pos, v = out_pos[keep], in_pos[keep], v[keep]
+            n_ent = len(v)
+            tile_starts = np.concatenate(
+                [[0], np.cumsum(kept_t)[:-1]]
+            ).astype(tile_starts.dtype)
+            tile_ends = tile_starts + kept_t
+        live = n_chunks > 0
+        tile_starts, tile_ends = tile_starts[live], tile_ends[live]
+        n_chunks = n_chunks[live]
         G_data = int(n_chunks.sum())
         rep_start = np.repeat(tile_starts, n_chunks)
         rep_end = np.repeat(tile_ends, n_chunks)
@@ -331,18 +454,42 @@ def _build_schedule_np(
         o_pos[dest_row, slot] = out_pos
         i_pos[dest_row, slot] = in_pos
         sv[dest_row, slot] = v
-    return step_out, step_in, step_init, o_pos, i_pos, sv
+    sp_out, sp_in, sp_vals = _pad_spill_np(sp_out, sp_in, sp_vals)
+    return (
+        step_out, step_in, step_init, o_pos, i_pos, sv,
+        sp_out, sp_in, sp_vals,
+    )
+
+
+def _pad_spill_np(sp_out, sp_in, sp_vals, pad_to: Optional[int] = None):
+    """Zero-pad spill arrays to a lane multiple (or exactly ``pad_to``);
+    padding entries carry val 0 at coordinate 0 — inert adds."""
+    s = len(sp_vals)
+    target = ((s + 127) // 128) * 128 if pad_to is None else pad_to
+    if target < s:
+        raise ValueError(f"pad_to={target} < spill size {s}")
+    if target != s:
+        sp_out = np.concatenate([sp_out, np.zeros(target - s, np.int32)])
+        sp_in = np.concatenate([sp_in, np.zeros(target - s, np.int32)])
+        sp_vals = np.concatenate(
+            [sp_vals, np.zeros(target - s, np.float32)]
+        )
+    return sp_out, sp_in, sp_vals
 
 
 def _pad_schedule_np(
-    arrs: Tuple[np.ndarray, ...], pad_steps_to: int, num_out_blocks: int
+    arrs: Tuple[np.ndarray, ...], pad_steps_to: int, num_out_blocks: int,
+    pad_spill_to: Optional[int] = None,
 ) -> Tuple[np.ndarray, ...]:
     """Pad a schedule's step axis to ``pad_steps_to`` with inert zero-entry
     steps on the LAST output block (keeps out-block order monotone; the
-    last block always exists — init steps guarantee every block has one).
-    Needed so per-device-shard schedules share one static shape under
-    shard_map."""
-    step_out, step_in, step_init, o_pos, i_pos, sv = arrs
+    last block always exists — init steps guarantee every block has one)
+    and its spill axis to ``pad_spill_to``. Needed so per-device-shard
+    schedules share one static shape under shard_map."""
+    (
+        step_out, step_in, step_init, o_pos, i_pos, sv,
+        sp_out, sp_in, sp_vals,
+    ) = arrs
     G = step_out.shape[0]
     if pad_steps_to < G:
         raise ValueError(f"pad_steps_to={pad_steps_to} < num steps {G}")
@@ -360,7 +507,14 @@ def _pad_schedule_np(
         o_pos = np.concatenate([o_pos, np.zeros((pad_rows, L), np.int32)])
         i_pos = np.concatenate([i_pos, np.zeros((pad_rows, L), np.int32)])
         sv = np.concatenate([sv, np.zeros((pad_rows, L), np.float32)])
-    return step_out, step_in, step_init, o_pos, i_pos, sv
+    if pad_spill_to is not None:
+        sp_out, sp_in, sp_vals = _pad_spill_np(
+            sp_out, sp_in, sp_vals, pad_to=pad_spill_to
+        )
+    return (
+        step_out, step_in, step_init, o_pos, i_pos, sv,
+        sp_out, sp_in, sp_vals,
+    )
 
 
 def _build_schedule(
@@ -582,15 +736,17 @@ def _concat_cell_schedules(
     g_parts = [p[1] for p in pairs]
     gz = max(p[0].shape[0] for p in z_parts)
     gg = max(p[0].shape[0] for p in g_parts)
-    z_parts = [_pad_schedule_np(p, gz, z_out_blocks) for p in z_parts]
-    g_parts = [_pad_schedule_np(p, gg, g_out_blocks) for p in g_parts]
+    sz = max(p[8].shape[0] for p in z_parts)
+    sg = max(p[8].shape[0] for p in g_parts)
+    z_parts = [_pad_schedule_np(p, gz, z_out_blocks, sz) for p in z_parts]
+    g_parts = [_pad_schedule_np(p, gg, g_out_blocks, sg) for p in g_parts]
     z_sched = _Schedule(*(
         jnp.asarray(np.concatenate([p[i] for p in z_parts]))
-        for i in range(6)
+        for i in range(9)
     ))
     g_sched = _Schedule(*(
         jnp.asarray(np.concatenate([p[i] for p in g_parts]))
-        for i in range(6)
+        for i in range(9)
     ))
     return z_sched, g_sched, np.concatenate([p[5] for p in g_parts])
 
@@ -785,6 +941,7 @@ def tiled_block_local_vg(loss, batch: FeatureShardedTiledBatch,
             batch.z_sched, w2d, meta.rows_per_shard // win, p,
             interpret=interpret, mxu=mxu,
         ).reshape(-1)
+        z_partial = batch.z_sched.apply_spill(z_partial, w_block)
         z = jax.lax.psum(z_partial, model_axis) + batch.offsets
         c = batch.weights * loss.d1(z, batch.labels)
         value = jax.lax.psum(
@@ -795,11 +952,59 @@ def tiled_block_local_vg(loss, batch: FeatureShardedTiledBatch,
             batch.g_sched, c2d, meta.block_dim // win, p,
             interpret=interpret, mxu=mxu,
         ).reshape(-1)
+        g_local = batch.g_sched.apply_spill(g_local, c)
         grad_block = jax.lax.psum(g_local, data_axis)
         w_sq = jax.lax.psum(jnp.vdot(w_block, w_block), model_axis)
         return value + 0.5 * l2 * w_sq, grad_block + l2 * w_block
 
     return vg
+
+
+def tiled_block_local_hvp_factory(
+    loss, batch: FeatureShardedTiledBatch,
+    data_axis: str, model_axis: str, l2,
+    *, interpret: bool = False, mxu: str = "bf16x2w",
+):
+    """Block-local Hessian-vector FACTORY over one device's cell of a
+    FeatureShardedTiledBatch (call inside shard_map) — the tiled twin of
+    parallel.distributed._sparse_block_hvp_factory
+    (HessianVectorAggregator.scala:137-152). The Hv pass reuses the
+    z-schedule for the direction expansion and the g-schedule for the
+    accumulation — same static layout, different contraction — so the
+    reference's hottest distributed loop (one Hv per CG step,
+    TRON.scala:259-341) runs at full kernel speed. The w-only pieces
+    (margins psum, second-derivative coefficients) are computed once per
+    outer TRON iteration."""
+    meta = batch.meta
+    p = meta.params
+    win = p.window
+
+    def _z(x_block):
+        x2d = x_block.reshape((meta.block_dim // win, p.s_hi, p.s_lo))
+        part = _run_bilinear_pass(
+            batch.z_sched, x2d, meta.rows_per_shard // win, p,
+            interpret=interpret, mxu=mxu,
+        ).reshape(-1)
+        return batch.z_sched.apply_spill(part, x_block)
+
+    def factory(w_block):
+        z = jax.lax.psum(_z(w_block), model_axis) + batch.offsets
+        d2c = batch.weights * loss.d2(z, batch.labels)
+
+        def hvp(d_block):
+            zd = jax.lax.psum(_z(d_block), model_axis)
+            c = d2c * zd
+            c2d = c.reshape((meta.rows_per_shard // win, p.s_hi, p.s_lo))
+            h_local = _run_bilinear_pass(
+                batch.g_sched, c2d, meta.block_dim // win, p,
+                interpret=interpret, mxu=mxu,
+            ).reshape(-1)
+            h_local = batch.g_sched.apply_spill(h_local, c)
+            return jax.lax.psum(h_local, data_axis) + l2 * d_block
+
+        return hvp
+
+    return factory
 
 
 def _place_data_sharded(batch: TiledSparseBatch, mesh, axis: str):
@@ -1169,22 +1374,25 @@ class TiledGLMObjective:
         b = batch
         p = b.params
         w2d = w_padded.reshape((b.num_feat_blocks, p.s_hi, p.s_lo))
-        return _run_bilinear_pass(
+        raw = _run_bilinear_pass(
             b.z_sched, w2d, b.num_row_blocks, p,
             interpret=self.interpret, mxu=self.mxu,
         ).reshape(-1)
+        return b.z_sched.apply_spill(raw, w_padded)
 
     def _grad_pass(
         self, c_rows: Array, batch: TiledSparseBatch,
         vals: Optional[Array] = None,
+        spill_vals: Optional[Array] = None,
     ) -> Array:
         b = batch
         p = b.params
         c2d = c_rows.reshape((b.num_row_blocks, p.s_hi, p.s_lo))
-        return _run_bilinear_pass(
+        g = _run_bilinear_pass(
             b.g_sched, c2d, b.num_feat_blocks, p,
             vals=vals, interpret=self.interpret, mxu=self.mxu,
         ).reshape(-1)
+        return b.g_sched.apply_spill(g, c_rows, vals=spill_vals)
 
     # -- margins -----------------------------------------------------------
 
@@ -1253,7 +1461,10 @@ class TiledGLMObjective:
         d_in = coef.shape[0]
         z = self.margins(coef, batch)
         c = batch.weights * self.loss.d2(z, batch.labels)
-        s2 = self._grad_pass(c, batch, vals=batch.g_vals_sq)[:d_in]
+        s2 = self._grad_pass(
+            c, batch, vals=batch.g_vals_sq,
+            spill_vals=batch.g_sched.spill_vals**2,
+        )[:d_in]
         if self.norm.shift is not None:
             # shifted space needs S1 = sum c x and S0 = sum c as well
             s1 = self._grad_pass(c, batch)[:d_in]

@@ -176,8 +176,9 @@ def train_feature_sharded(
     CG psums every inner product — the reference's
     one-treeAggregate-per-CG-iteration loop (SURVEY §3.2) on ICI. Box
     constraints and normalization are not supported on this path —
-    callers validate (the GLM driver rejects those combinations); TRON
-    currently runs the scatter kernel (no tiled Hv schedules).
+    callers validate (the GLM driver rejects those combinations). TRON
+    runs the tiled kernels too: its Hv pass reuses the z/g schedules
+    (tiled_block_local_hvp_factory).
 
     ``kernel``: "scatter" | "tiled" | "auto" — "tiled" lays each
     (data shard x feature block) cell out as block-local Pallas tile
@@ -226,17 +227,22 @@ def train_feature_sharded(
         regularization,
         loss_has_hessian=objective.loss.has_hessian,
     )
-    if use_tron:
-        if kernel == "tiled":
-            raise ValueError(
-                "kernel='tiled' is not available with TRON on the "
-                "feature-sharded path (no tiled Hessian-vector schedules "
-                "yet); use kernel='auto' or 'scatter'"
-            )
-        kernel = "scatter"  # "auto" resolves to the Hv-capable kernel
     kernel = resolve_kernel(kernel, batch)
 
-    if use_tron:
+    if use_tron and kernel == "tiled":
+        from photon_ml_tpu.ops.tiled_sparse import feature_shard_tiled_batch
+        from photon_ml_tpu.parallel.distributed import (
+            feature_sharded_tiled_fit_tron,
+        )
+
+        sharded, block_dim = feature_shard_tiled_batch(
+            batch, dim, data_shards, num_blocks, mesh=mesh,
+            data_axis=DATA_AXIS, model_axis=MODEL_AXIS,
+        )
+        fit = feature_sharded_tiled_fit_tron(
+            objective, mesh, sharded.meta, max_iter=max_iter, tol=tolerance
+        )
+    elif use_tron:
         from photon_ml_tpu.parallel.distributed import (
             feature_sharded_sparse_fit_tron,
         )

@@ -233,11 +233,43 @@ class TestFeatureShardedDriver:
             )
             with pytest.raises(ValueError):
                 p.validate()
-        # TRON + feature sharding validates cleanly
-        GLMParams(
-            train_dir="t", output_dir="o", distributed="feature",
-            optimizer_type=OptimizerType.TRON,
-        ).validate()
+        # TRON + feature sharding validates cleanly, with either kernel
+        # (tiled Hv schedules landed round 4)
+        for kernel in ("auto", "tiled", "scatter"):
+            GLMParams(
+                train_dir="t", output_dir="o", distributed="feature",
+                optimizer_type=OptimizerType.TRON, kernel=kernel,
+            ).validate()
+
+    def test_feature_sharded_tron_tiled_end_to_end(self, tmp_path, avro_dirs):
+        """--distributed feature --optimizer TRON --kernel tiled: the
+        hottest distributed loop (Hv per CG step) on the Pallas kernels,
+        driver-reachable; matches the single-device TRON model."""
+        train, val = avro_dirs
+        results = {}
+        for mode, kernel, out in (
+            ("feature", "tiled", "out_fs_tron"),
+            ("off", "auto", "out_single_tron"),
+        ):
+            params = GLMParams(
+                train_dir=train,
+                validate_dir=val,
+                output_dir=str(tmp_path / out),
+                task=TaskType.LOGISTIC_REGRESSION,
+                regularization_weights=[1.0],
+                optimizer_type=OptimizerType.TRON,
+                distributed=mode,
+                model_shards=2,
+                kernel=kernel,
+            )
+            driver = GLMDriver(params)
+            driver.run()
+            results[mode] = driver
+        np.testing.assert_allclose(
+            np.asarray(results["feature"].models[1.0].means),
+            np.asarray(results["off"].models[1.0].means),
+            atol=5e-3,
+        )
 
 
 class TestDatedInputAndPerIterationValidation:

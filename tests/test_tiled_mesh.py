@@ -56,10 +56,17 @@ class TestShardedTiledBatch:
         assert tb.z_sched.step_out.shape[0] % n_shards == 0
         assert tb.g_sched.step_out.shape[0] % n_shards == 0
         assert tb.z_sched.out_pos.shape[0] % n_shards == 0
-        # every nonzero entry appears once per schedule, across all shards
+        # every nonzero entry appears once per schedule (chunk slots +
+        # spill tail), across all shards
         nnz = int(np.count_nonzero(np.asarray(batch.values)))
-        assert np.count_nonzero(np.asarray(tb.z_sched.vals)) == nnz
-        assert np.count_nonzero(np.asarray(tb.g_sched.vals)) == nnz
+        assert (
+            np.count_nonzero(np.asarray(tb.z_sched.vals))
+            + np.count_nonzero(np.asarray(tb.z_sched.spill_vals))
+        ) == nnz
+        assert (
+            np.count_nonzero(np.asarray(tb.g_sched.vals))
+            + np.count_nonzero(np.asarray(tb.g_sched.spill_vals))
+        ) == nnz
 
     def test_per_shard_blocks_monotone(self, rng):
         batch, d = random_problem(rng)
@@ -208,9 +215,12 @@ class TestFeatureShardedTiled:
             float(res.value), float(oracle.value), rtol=1e-4
         )
 
-    def test_feature_sharded_tron_matches_replicated(self, rng):
+    @pytest.mark.parametrize("kernel", ["scatter", "tiled"])
+    def test_feature_sharded_tron_matches_replicated(self, rng, kernel):
         # sharded trust-region Newton: every CG inner product psums over
-        # the model axis (the treeAggregate-per-CG-iteration loop on ICI)
+        # the model axis (the treeAggregate-per-CG-iteration loop on ICI).
+        # kernel="tiled" runs the Pallas z/g schedules for BOTH the
+        # objective and the Hv factory (tiled_block_local_hvp_factory).
         from photon_ml_tpu.optim.config import OptimizerType, RegularizationType
         from photon_ml_tpu.optim.tron import minimize_tron
         from photon_ml_tpu.ops.objective import GLMObjective as _G
@@ -236,6 +246,7 @@ class TestFeatureShardedTiled:
             max_iter=12,
             tolerance=1e-5,
             optimizer_type=OptimizerType.TRON,
+            kernel=kernel,
         )
         obj = _G(LOGISTIC, d)
         oracle = minimize_tron(
@@ -269,12 +280,6 @@ class TestFeatureShardedTiled:
                 batch, TaskType.LOGISTIC_REGRESSION, d,
                 mesh=mesh, optimizer_type=OptimizerType.TRON,
                 regularization_type=RegularizationType.L1,
-            )
-        with pytest.raises(ValueError, match="tiled"):
-            train_feature_sharded(
-                batch, TaskType.LOGISTIC_REGRESSION, d,
-                mesh=mesh, optimizer_type=OptimizerType.TRON,
-                kernel="tiled",
             )
 
     def test_train_feature_sharded_tiled_owlqn(self, rng):

@@ -41,9 +41,13 @@ class TestSchedule:
         batch, d = random_problem(rng)
         tb = tiled_batch_from_sparse(batch, d, params=PARAMS)
         # every nonzero entry appears exactly once in each schedule
+        # (chunk slots + spill tail together)
         nnz = int(np.count_nonzero(np.asarray(batch.values)))
-        assert np.count_nonzero(tb.z_sched.vals) == nnz
-        assert np.count_nonzero(tb.g_sched.vals) == nnz
+        for sched in (tb.z_sched, tb.g_sched):
+            assert (
+                np.count_nonzero(sched.vals)
+                + np.count_nonzero(sched.spill_vals)
+            ) == nnz
         # monotone output blocks
         z_out = np.asarray(tb.z_sched.step_out)
         g_out = np.asarray(tb.g_sched.step_out)
@@ -244,6 +248,82 @@ class TestEmptyWindows:
         v, g = tobj.value_and_gradient(w, tb, 0.0)
         assert float(v) == 0.0
         assert np.all(np.asarray(g) == 0.0)
+
+
+class TestSpill:
+    """Spill-to-scatter hybrid (TileParams.spill_cap): tile remainders
+    route around the kernel through _Schedule.apply_spill; the combined
+    result must stay exact against the scatter objective."""
+
+    def _spilly(self, rng, cap=8):
+        batch, d = random_problem(rng, n=160, d=90, k=5)
+        params = TileParams(s_hi=8, s_lo=8, chunk=32, spill_cap=cap)
+        tb = tiled_batch_from_sparse(batch, d, params=params)
+        return batch, tb, d
+
+    def test_spills_present_and_exact(self, rng):
+        batch, tb, d = self._spilly(rng)
+        assert int(np.count_nonzero(tb.z_sched.spill_vals)) > 0
+        assert int(np.count_nonzero(tb.g_sched.spill_vals)) > 0
+        obj = GLMObjective(LOGISTIC, d)
+        tobj = TiledGLMObjective(LOGISTIC, d, interpret=True, mxu="highest")
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        v0, g0 = obj.value_and_gradient(w, batch, 0.2)
+        v1, g1 = tobj.value_and_gradient(w, tb, 0.2)
+        np.testing.assert_allclose(float(v1), float(v0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(tobj.hessian_diagonal(w, tb, 0.1)),
+            np.asarray(obj.hessian_diagonal(w, batch, 0.1)),
+            atol=2e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(tobj.hessian_vector(w, w * 0.5, tb, 0.1)),
+            np.asarray(obj.hessian_vector(w, w * 0.5, batch, 0.1)),
+            atol=2e-4,
+        )
+
+    def test_spill_reduces_steps(self, rng):
+        batch, d = random_problem(rng, n=160, d=90, k=5)
+        no_spill = tiled_batch_from_sparse(
+            batch, d, params=TileParams(s_hi=8, s_lo=8, chunk=32, spill_cap=0)
+        )
+        spill = tiled_batch_from_sparse(
+            batch, d, params=TileParams(s_hi=8, s_lo=8, chunk=32, spill_cap=8)
+        )
+        assert spill.z_sched.num_steps < no_spill.z_sched.num_steps
+        assert int(np.count_nonzero(no_spill.z_sched.spill_vals)) == 0
+
+    def test_native_matches_numpy_builder(self, rng):
+        from photon_ml_tpu.ops import tiled_sparse as ts
+
+        if not ts._tile_lib():
+            pytest.skip("native tile builder unavailable")
+        n, d, nnz = 400, 260, 5000
+        rows = rng.integers(0, n, nnz).astype(np.int64)
+        feats = rng.integers(0, d, nnz).astype(np.int64)
+        vals = rng.normal(size=nnz).astype(np.float32)
+        params = TileParams(s_hi=8, s_lo=8, chunk=32, spill_cap=8)
+        win = params.window
+        nob = (n + win - 1) // win
+        for by_feat, blocks in ((False, nob), (True, (d + win - 1) // win)):
+            native = ts._build_schedule_native(
+                rows, feats, vals, params=params,
+                sort_by_feature_block=by_feat, num_out_blocks=blocks,
+            )
+            assert native is not None
+            saved = ts._tile_lib_handle
+            ts._tile_lib_handle = False
+            try:
+                pyb = ts._build_schedule_np(
+                    rows, feats, vals, params=params,
+                    sort_by_feature_block=by_feat, num_out_blocks=blocks,
+                )
+            finally:
+                ts._tile_lib_handle = saved
+            assert int(np.count_nonzero(native[8])) > 0  # spill exercised
+            for a, b in zip(native, pyb):
+                np.testing.assert_array_equal(a, b)
 
 
 class TestWideMxuVariant:
