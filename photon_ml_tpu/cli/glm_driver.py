@@ -219,10 +219,6 @@ class GLMParams:
             unsupported = []
             if self.input_format.strip().upper() != "AVRO":
                 unsupported.append("non-Avro input")
-            if self.regularization_type in (
-                RegularizationType.L1, RegularizationType.ELASTIC_NET,
-            ):
-                unsupported.append("L1/elastic-net")
             if self.optimizer_type != OptimizerType.LBFGS:
                 unsupported.append(f"optimizer {self.optimizer_type.value}")
             if self.normalization_type != NormalizationType.NONE:
@@ -477,6 +473,7 @@ class GLMDriver:
                     p.task,
                     regularization_type=p.regularization_type,
                     regularization_weights=p.regularization_weights,
+                    elastic_net_alpha=p.elastic_net_alpha,
                     max_iter=p.max_num_iterations or 100,
                     tolerance=p.tolerance or 1e-7,
                     fmt=self._fmt,

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import Callable, List, Optional, Tuple
 
+import numpy as np
 import jax.numpy as jnp
 
 from photon_ml_tpu.optim.common import (
@@ -122,6 +123,107 @@ def minimize_lbfgs_host(
         coefficients=w,
         value=jnp.float32(float(f)),
         grad_norm=jnp.linalg.norm(g),
+        iterations=jnp.int32(it),
+        reason=jnp.int32(reason),
+        tracker=tracker,
+    )
+
+
+def minimize_owlqn_host(
+    value_and_grad_fn: ValueAndGrad,
+    w0: Array,
+    l1_weight,
+    *,
+    max_iter: int = 100,
+    tol: float = 1e-7,
+    history: int = 10,
+    l1_mask: Optional[Array] = None,
+    ls_max_steps: int = 24,
+    ls_c1: float = 1e-4,
+    ls_shrink: float = 0.5,
+) -> OptResult:
+    """Host-driven OWL-QN: minimize smooth(w) + l1 * ||w||_1 where each
+    smooth evaluation runs host-side code (the streaming >RAM path's
+    elastic-net). Same Andrew & Gao rules as optim.lbfgs.minimize_owlqn —
+    pseudo-gradient, orthant-constrained direction, orthant projection of
+    trial points, memory pairs on SMOOTH gradients — driven from Python
+    like minimize_lbfgs_host. ``value_and_grad_fn`` returns the SMOOTH
+    (value, gradient)."""
+    from photon_ml_tpu.optim.lbfgs import _pseudo_gradient
+
+    w = jnp.asarray(w0, jnp.float32)
+    l1_vec = jnp.float32(l1_weight) * (
+        jnp.ones_like(w) if l1_mask is None else jnp.asarray(l1_mask)
+    )
+
+    def total(w_t, f_smooth):
+        return float(f_smooth) + float(jnp.sum(l1_vec * jnp.abs(w_t)))
+
+    f_s, g = value_and_grad_fn(w)
+    pg = _pseudo_gradient(w, g, l1_vec)
+    f_tot = total(w, f_s)
+    f0 = f_tot
+    g0_norm = float(jnp.linalg.norm(pg))
+    tracker = Tracker.create(max_iter + 1).record(
+        jnp.float32(f_tot), jnp.float32(g0_norm)
+    )
+
+    s_list: List[Array] = []
+    y_list: List[Array] = []
+    reason = (
+        GRADIENT_WITHIN_TOLERANCE if g0_norm == 0.0 else NOT_CONVERGED
+    )
+    it = 0
+    while reason == NOT_CONVERGED:
+        pg = _pseudo_gradient(w, g, l1_vec)
+        d = _direction(pg, s_list, y_list)
+        # constrain to the descent orthant of the pseudo-gradient
+        d = jnp.where(d * pg < 0, d, 0.0)
+        if float(jnp.vdot(d, pg)) >= 0:
+            d = -pg
+        orthant = jnp.where(w != 0, jnp.sign(w), jnp.sign(-pg))
+        t = 1.0 if s_list else 1.0 / max(float(jnp.linalg.norm(d)), 1.0)
+        ok = False
+        w_new, f_new_tot, g_new = w, f_tot, g
+        for _ in range(ls_max_steps):
+            w_t = jnp.where(jnp.sign(w + t * d) == orthant, w + t * d, 0.0)
+            f_t_s, g_t = value_and_grad_fn(w_t)
+            f_t_tot = total(w_t, f_t_s)
+            # Armijo on the projected point against the pseudo-gradient
+            if f_t_tot <= f_tot + ls_c1 * float(
+                jnp.vdot(pg, w_t - w)
+            ) and np.isfinite(f_t_tot):
+                ok = True
+                w_new, f_new_tot, g_new = w_t, f_t_tot, g_t
+                break
+            t *= ls_shrink
+        it += 1
+        if ok:
+            s = w_new - w
+            y = g_new - g  # smooth gradients, per Andrew & Gao
+            if float(jnp.vdot(y, s)) > _MEM_EPS:
+                s_list.append(s)
+                y_list.append(y)
+                if len(s_list) > history:
+                    s_list.pop(0)
+                    y_list.pop(0)
+            pg_new = _pseudo_gradient(w_new, g_new, l1_vec)
+            pg_norm = float(jnp.linalg.norm(pg_new))
+            reason = int(check_convergence(
+                jnp.int32(it), jnp.float32(f_tot), jnp.float32(f_new_tot),
+                jnp.float32(pg_norm), jnp.float32(f0), jnp.float32(g0_norm),
+                max_iter=max_iter, tol=tol,
+            ))
+            w, f_tot, g = w_new, f_new_tot, g_new
+            tracker = tracker.record(
+                jnp.float32(f_tot), jnp.float32(pg_norm)
+            )
+        else:
+            reason = LINE_SEARCH_STALLED
+    return OptResult(
+        coefficients=w,
+        value=jnp.float32(f_tot),
+        grad_norm=jnp.linalg.norm(_pseudo_gradient(w, g, l1_vec)),
         iterations=jnp.int32(it),
         reason=jnp.int32(reason),
         tracker=tracker,

@@ -57,7 +57,7 @@ def data_parallel_value_and_grad(
     def vg(w, batch, l2):
         return obj.value_and_gradient(w, batch, l2)
 
-    return vg
+    return jax.jit(vg)
 
 
 def data_parallel_fit_lbfgs(
@@ -87,7 +87,7 @@ def data_parallel_fit_lbfgs(
             vg, w0, max_iter=max_iter, tol=tol, history=history
         )
 
-    return fit
+    return jax.jit(fit)
 
 
 # ---------------------------------------------------------------------------
@@ -134,7 +134,7 @@ def feature_sharded_value_and_grad(
         grad_block = grad_block + l2 * w_block
         return value, grad_block
 
-    return vg
+    return jax.jit(vg)
 
 
 def _opt_result_specs(model_axis: str) -> OptResult:
@@ -196,7 +196,7 @@ def feature_sharded_fit(
             axis_name=model_axis,
         )
 
-    return fit
+    return jax.jit(fit)
 
 
 # ---------------------------------------------------------------------------
@@ -421,7 +421,7 @@ def feature_sharded_sparse_fit_tron(
             axis_name=model_axis, hvp_factory=factory,
         )
 
-    return fit
+    return jax.jit(fit)
 
 
 def feature_sharded_sparse_value_and_grad(
@@ -445,7 +445,7 @@ def feature_sharded_sparse_value_and_grad(
     def vg(w_block, b, l2):
         return _sparse_block_vg(loss, b, l2, model_axis, data_axis)(w_block)
 
-    return vg
+    return jax.jit(vg)
 
 
 def feature_sharded_sparse_fit(
@@ -483,7 +483,7 @@ def feature_sharded_sparse_fit(
             axis_name=model_axis,
         )
 
-    return fit
+    return jax.jit(fit)
 
 
 def feature_sharded_tiled_fit(
@@ -589,7 +589,7 @@ def feature_sharded_tiled_fit(
                 batch.offsets, batch.weights, l2,
             )
 
-    return fit
+    return jax.jit(fit)
 
 
 def feature_sharded_tiled_fit_tron(
@@ -656,7 +656,7 @@ def feature_sharded_tiled_fit_tron(
             batch.offsets, batch.weights, l2,
         )
 
-    return fit
+    return jax.jit(fit)
 
 
 def feature_sharded_sparse_fit_owlqn(
@@ -695,4 +695,4 @@ def feature_sharded_sparse_fit_owlqn(
             l1_mask=l1_mask_block, axis_name=model_axis,
         )
 
-    return fit
+    return jax.jit(fit)

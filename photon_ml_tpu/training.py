@@ -314,10 +314,13 @@ def train_streaming_glm(
     *,
     regularization_type: RegularizationType = RegularizationType.NONE,
     regularization_weights: Sequence[float] = (0.0,),
+    elastic_net_alpha: Optional[float] = None,
     max_iter: int = 100,
     tolerance: float = 1e-7,
     history: int = 10,
     rows_per_chunk: int = 65536,
+    cache_bytes: int = 2 << 30,
+    prefetch: bool = True,
     add_intercept: bool = True,
     field_names: str = "TRAINING_EXAMPLE",
     warm_start: bool = True,
@@ -332,10 +335,13 @@ def train_streaming_glm(
     sequence as the in-memory path.
 
     The reference's analog is Spark's MEMORY_AND_DISK persist under
-    GLMSuite.readLabeledPointsFromAvro (io/GLMSuite.scala:98-131): data
-    beyond memory re-reads from disk per pass. L1/elastic-net are not
-    supported on this path (OWL-QN needs the orthant machinery; use the
-    in-memory trainer), matching its L2/none smooth-objective scope.
+    GLMSuite.readLabeledPointsFromAvro (io/GLMSuite.scala:98-131): the
+    first evaluation caches staged chunks — device-resident up to
+    ``cache_bytes``, the remainder spilled as raw fixed-shape arrays to
+    local scratch — so later evaluations never re-decode Avro;
+    ``prefetch`` decode-aheads on a worker thread. L1/elastic-net run
+    host-driven OWL-QN (minimize_owlqn_host) with the intercept exempt
+    from the penalty, exactly like the in-memory path.
 
     Under ``jax.distributed`` (process_count > 1) the input FILES split
     across processes (multihost.process_shard — the executor-partition
@@ -355,13 +361,14 @@ def train_streaming_glm(
     from photon_ml_tpu.io.streaming import StreamingGLMObjective, scan_stream
     from photon_ml_tpu.models.coefficients import Coefficients
     from photon_ml_tpu.models.glm import create_model
-    from photon_ml_tpu.optim.host_lbfgs import minimize_lbfgs_host
+    from photon_ml_tpu.optim.host_lbfgs import (
+        minimize_lbfgs_host,
+        minimize_owlqn_host,
+    )
 
-    regularization = RegularizationContext(regularization_type)
-    if regularization.has_l1:
-        raise ValueError(
-            "streaming training supports L2/none regularization only"
-        )
+    regularization = RegularizationContext(
+        regularization_type, elastic_net_alpha
+    )
     if fmt is None:
         fmt = AvroInputDataFormat(
             add_intercept=add_intercept, field_names=field_names
@@ -411,8 +418,19 @@ def train_streaming_glm(
     elif index_map is None or stats is None:
         index_map, stats = scan_stream(paths, fmt, index_map=index_map)
     objective = StreamingGLMObjective(
-        paths, fmt, index_map, stats, task, rows_per_chunk=rows_per_chunk
+        paths, fmt, index_map, stats, task,
+        rows_per_chunk=rows_per_chunk, cache_bytes=cache_bytes,
+        prefetch=prefetch,
     )
+    l1_mask = None
+    if regularization.has_l1 and fmt.add_intercept:
+        from photon_ml_tpu.utils.index_map import intercept_key
+
+        icept = index_map.get_index(intercept_key())
+        if icept >= 0:
+            l1_mask = (
+                jnp.ones((objective.dim,), jnp.float32).at[icept].set(0.0)
+            )
 
     weights_desc = sorted(
         set(float(w) for w in regularization_weights), reverse=True
@@ -421,11 +439,18 @@ def train_streaming_glm(
     results: Dict[float, OptResult] = {}
     current = jnp.zeros((objective.dim,), jnp.float32)
     for lam in weights_desc:
-        _, l2 = regularization.split(lam)
-        result = minimize_lbfgs_host(
-            lambda w: objective.value_and_gradient(w, l2),
-            current, max_iter=max_iter, tol=tolerance, history=history,
-        )
+        l1, l2 = regularization.split(lam)
+        if l1:
+            result = minimize_owlqn_host(
+                lambda w: objective.value_and_gradient(w, l2),
+                current, l1, max_iter=max_iter, tol=tolerance,
+                history=history, l1_mask=l1_mask,
+            )
+        else:
+            result = minimize_lbfgs_host(
+                lambda w: objective.value_and_gradient(w, l2),
+                current, max_iter=max_iter, tol=tolerance, history=history,
+            )
         models[lam] = create_model(task, Coefficients(result.coefficients))
         results[lam] = result
         if warm_start:
