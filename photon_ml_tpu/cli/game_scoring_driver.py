@@ -58,6 +58,8 @@ class GameScoringParams:
     offheap_indexmap_dir: Optional[str] = None
     offheap_indexmap_num_partitions: Optional[int] = None
     feature_name_and_term_set_path: Optional[str] = None
+    # jax.profiler trace of the scoring pass (SURVEY §7.11)
+    profile_dir: Optional[str] = None
 
     def validate(self):
         if not self.input_dirs:
@@ -130,7 +132,9 @@ class GameScoringDriver:
                 index_maps=index_maps,
                 is_response_required=p.has_response,
             )
-        with self.timer.time("score"):
+        from photon_ml_tpu.utils.profiling import profile_trace
+
+        with self.timer.time("score"), profile_trace(p.profile_dir):
             raw_scores = model.score(dataset, p.task_type)
             scores = raw_scores + jnp.asarray(dataset.offsets)
         from photon_ml_tpu.parallel.multihost import (
@@ -222,6 +226,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--num-files", type=int, default=1)
     ap.add_argument("--delete-output-dir-if-exists", default="false")
     ap.add_argument("--application-name", default=None)
+    ap.add_argument(
+        "--profile-dir", default=None,
+        help="write a jax.profiler trace of the scoring pass here",
+    )
     return ap
 
 
@@ -247,6 +255,7 @@ def params_from_args(argv=None) -> GameScoringParams:
             else []
         ),
         model_id=ns.game_model_id or ns.model_id or "",
+        profile_dir=ns.profile_dir,
         has_response=str(ns.has_response).lower() in ("true", "1", "yes"),
         date_range=ns.date_range,
         date_range_days_ago=ns.date_range_days_ago,

@@ -49,6 +49,7 @@ from photon_ml_tpu.optim.config import GLMOptimizationConfiguration
 from photon_ml_tpu.optim.problem import create_glm_problem
 from photon_ml_tpu.task import TaskType
 from photon_ml_tpu.utils.logging_util import PhotonLogger, Timer
+from photon_ml_tpu.utils.profiling import profile_trace
 
 
 def parse_keyed_map(s: str) -> Dict[str, str]:
@@ -220,6 +221,9 @@ class GameTrainingParams:
     # the next iteration boundary, and a rerun resumes from the latest
     # step.
     checkpoint_dir: Optional[str] = None
+    # jax.profiler trace of the training combos into this directory
+    # (SURVEY §7.11): one trace spanning the coordinate-descent fits.
+    profile_dir: Optional[str] = None
 
     def validate(self) -> None:
         if not self.train_input_dirs:
@@ -645,7 +649,11 @@ class GameTrainingDriver:
                         len(combos),
                     )
                     break
-                with self.timer.time(f"train-combo-{ci}"):
+                with self.timer.time(f"train-combo-{ci}"), profile_trace(
+                    # trace the FIRST combo actually trained (combos run
+                    # in warm-start order, not grid order)
+                    p.profile_dir if ti == 0 else None
+                ):
                     coords = self._build_coordinates(
                         dataset, re_datasets, combo
                     )
@@ -952,6 +960,7 @@ def params_from_args(argv=None) -> GameTrainingParams:
         num_processes=ns.num_processes,
         process_id=ns.process_id,
         checkpoint_dir=ns.checkpoint_dir,
+        profile_dir=ns.profile_dir,
     )
 
 

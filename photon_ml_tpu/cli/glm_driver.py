@@ -137,9 +137,14 @@ class GLMParams:
     model_shards: Optional[int] = None  # model-axis size for "feature"
     # Stream the training data from disk per objective evaluation
     # (io/streaming.py): datasets larger than host RAM train with bounded
-    # memory — the GLMSuite/Spark MEMORY_AND_DISK analog. Avro + smooth
-    # (L2/none) L-BFGS only; validation data still loads in memory.
+    # memory — the GLMSuite/Spark MEMORY_AND_DISK analog. Avro input,
+    # host-driven L-BFGS (L2/none) or OWL-QN (L1/elastic-net);
+    # validation data still loads in memory.
     streaming: bool = False
+    # jax.profiler trace of the training stage into this directory
+    # (SURVEY §7.11 upgrade over Timer-only observability); conventionally
+    # <output-dir>/profile, viewable in TensorBoard/Perfetto.
+    profile_dir: Optional[str] = None
     # Multi-host orchestration (the SparkContextConfiguration analog):
     # address of process 0's coordination service. None = single-process.
     coordinator_address: Optional[str] = None
@@ -448,7 +453,9 @@ class GLMDriver:
     def train(self) -> None:
         p = self.params
         self.emitter.send(TrainingStartEvent(p.job_name))
-        with self.timer.time("train"):
+        from photon_ml_tpu.utils.profiling import profile_trace
+
+        with self.timer.time("train"), profile_trace(p.profile_dir):
             data = self._data
             mesh = self._mesh()
             if p.streaming:
@@ -822,7 +829,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument(
         "--streaming", default="false",
         help="true: stream the training data from disk per evaluation "
-        "(bounded memory for >RAM datasets; Avro + L2/none L-BFGS only)",
+        "(bounded memory for >RAM datasets; Avro + L-BFGS/OWL-QN)",
+    )
+    ap.add_argument(
+        "--profile-dir", default=None,
+        help="write a jax.profiler trace of the training stage here "
+        "(TensorBoard/Perfetto-viewable)",
     )
     ap.add_argument(
         "--coordinator-address", default=None,
@@ -899,6 +911,7 @@ def params_from_args(argv=None) -> GLMParams:
         kernel=ns.kernel,
         distributed=ns.distributed,
         streaming=_bool(ns.streaming),
+        profile_dir=ns.profile_dir,
         model_shards=ns.model_shards,
         coordinator_address=ns.coordinator_address,
         num_processes=ns.num_processes,

@@ -320,7 +320,9 @@ def _latent_view(
         )
         bv = x_lat[safe].astype(np.float32)
         bv = np.where((b.row_index >= 0)[:, :, None], bv, 0.0)
-        buckets.append(dc_replace(b, indices=bix, values=bv))
+        buckets.append(
+            dc_replace(b, indices=bix, values=bv, identity_indices=True)
+        )
     return dc_replace(
         base,
         local_dim=L,
@@ -412,6 +414,31 @@ class MatrixFactorizationCoordinate(Coordinate):
             1 << np.ceil(np.log2(np.maximum(counts, 1))).astype(np.int64),
             0,
         )
+        # Merge sparse cap-classes upward: every distinct (E_b, S) bucket
+        # shape costs a multi-second trace + compile of the fused solver
+        # (measured ~5 s/program over the relay — 9 programs made the MF
+        # first step 63 s warm-cache), while padding a FEW entities to
+        # the next power of two only squares their tiny share of the
+        # Gram work. Keep a class only when it holds >= 25% of the
+        # active entities; everything else pads up to the next kept
+        # class (the largest class is always kept — entities can never
+        # pad DOWN without dropping samples).
+        active = caps > 0
+        if active.any():
+            classes, class_counts = np.unique(caps[active], return_counts=True)
+            total_active = int(class_counts.sum())
+            kept = [
+                int(s)
+                for s, c in zip(classes, class_counts)
+                if c >= 0.25 * total_active
+            ]
+            top = int(classes.max())
+            if top not in kept:
+                kept.append(top)
+            kept = np.asarray(sorted(kept), np.int64)
+            # next kept class >= each entity's cap
+            idx = np.searchsorted(kept, caps[active])
+            caps[active] = kept[idx]
         buckets = []
         gather_plans = []  # (partner_codes [E_b, S] device, ok [E_b, S] device)
         for S in sorted(set(int(c) for c in caps if c > 0)):
@@ -439,6 +466,7 @@ class MatrixFactorizationCoordinate(Coordinate):
                 labels=np.where(ok, self.dataset.labels[safe], 0.0),
                 offsets=np.where(ok, self.dataset.offsets[safe], 0.0),
                 weights=np.where(ok, self.dataset.weights[safe], 0.0),
+                identity_indices=True,
             ))
             gather_plans.append((
                 jnp.asarray(

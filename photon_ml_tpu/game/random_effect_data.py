@@ -40,6 +40,10 @@ class RandomEffectBucket:
     labels: np.ndarray  # float32 [E_b, S_b]
     offsets: np.ndarray  # float32 [E_b, S_b]
     weights: np.ndarray  # float32 [E_b, S_b] (0 pad; reservoir-rescaled)
+    # True when ``indices`` is the tiled arange(k) (k == local_dim, the
+    # MF latent view): the dense solvers then use X = values directly,
+    # skipping the [E, S, k, D] densify broadcast entirely
+    identity_indices: bool = False
 
     @property
     def num_entities(self) -> int:

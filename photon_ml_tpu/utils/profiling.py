@@ -1,0 +1,29 @@
+"""Profiler hooks: ``jax.profiler`` traces behind the drivers'
+``--profile-dir`` flag (SURVEY §7.11 — the deliberate upgrade over the
+reference's Timer-only observability: XLA/TPU timelines instead of wall
+-clock buckets). Traces land in the given directory (conventionally
+``<output-dir>/profile``, next to optimization-log.txt) and open in
+TensorBoard / Perfetto."""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager, nullcontext
+from typing import Optional
+
+
+@contextmanager
+def _trace(profile_dir: str):
+    import jax
+
+    os.makedirs(profile_dir, exist_ok=True)
+    with jax.profiler.trace(profile_dir):
+        yield
+
+
+def profile_trace(profile_dir: Optional[str]):
+    """Context manager: a ``jax.profiler`` trace into ``profile_dir``,
+    or a no-op when the flag is unset."""
+    if not profile_dir:
+        return nullcontext()
+    return _trace(profile_dir)

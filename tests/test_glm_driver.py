@@ -272,6 +272,24 @@ class TestFeatureShardedDriver:
         )
 
 
+class TestProfilerHook:
+    def test_profile_dir_writes_trace(self, tmp_path, avro_dirs):
+        """--profile-dir captures a jax.profiler trace of the train stage
+        (SURVEY §7.11): a TensorBoard-loadable .xplane.pb appears."""
+        train, _ = avro_dirs
+        prof = tmp_path / "profile"
+        params = GLMParams(
+            train_dir=train,
+            output_dir=str(tmp_path / "out"),
+            task=TaskType.LOGISTIC_REGRESSION,
+            regularization_weights=[1.0],
+            profile_dir=str(prof),
+        )
+        GLMDriver(params).run()
+        traces = list(prof.rglob("*.xplane.pb"))
+        assert traces, f"no trace files under {prof}"
+
+
 class TestDatedInputAndPerIterationValidation:
     def _make_daily(self, base, rng, days, n=120):
         import datetime
