@@ -868,6 +868,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="per-iteration coordinate-descent checkpoints; enables "
         "SIGTERM-safe stop and resume-from-latest on rerun",
     )
+    ap.add_argument(
+        "--profile-dir", default=None,
+        help="write a jax.profiler trace of the first training combo here",
+    )
     return ap
 
 

@@ -427,14 +427,20 @@ class MatrixFactorizationCoordinate(Coordinate):
         if active.any():
             classes, class_counts = np.unique(caps[active], return_counts=True)
             total_active = int(class_counts.sum())
-            kept = [
+            kept = {
                 int(s)
                 for s, c in zip(classes, class_counts)
                 if c >= 0.25 * total_active
-            ]
-            top = int(classes.max())
-            if top not in kept:
-                kept.append(top)
+            }
+            kept.add(int(classes.max()))
+            # bound the padding: no entity pads more than 4x its own cap
+            # (heavy-tailed count distributions can otherwise leave every
+            # class under the 25% bar and collapse the merge onto the
+            # largest class — [E, S_max] blocks would blow host memory)
+            for s in sorted((int(c) for c in classes), reverse=True):
+                target = min((k for k in kept if k >= s), default=None)
+                if target is None or target > 4 * s:
+                    kept.add(s)
             kept = np.asarray(sorted(kept), np.int64)
             # next kept class >= each entity's cap
             idx = np.searchsorted(kept, caps[active])
