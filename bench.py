@@ -231,8 +231,15 @@ def _run_tpu_test_tier():
         for fn in os.listdir(tests_dir):
             if fn.endswith(".py"):
                 with open(os.path.join(tests_dir, fn)) as f:
-                    gates.update(
-                        re.findall(r'reason="([^"]+)"', f.read())
+                    src = f.read()
+                gates.update(re.findall(r'reason=f?"([^"]+)"', src))
+                # pytest.skip("..." "...") — f-strings and implicitly
+                # concatenated fragments included
+                for m in re.finditer(
+                    r'pytest\.skip\(\s*((?:f?"[^"]*"\s*)+)', src
+                ):
+                    gates.add(
+                        "".join(re.findall(r'"([^"]*)"', m.group(1)))
                     )
         return {
             "ok": proc.returncode == 0,

@@ -164,6 +164,7 @@ class TestDistributedGame:
             ),
         )
         banks = {}
+        variances = {}
         for label, mesh in (("single", None), ("mesh", make_mesh())):
             problem = RandomEffectOptimizationProblem(
                 LOGISTIC,
@@ -173,7 +174,16 @@ class TestDistributedGame:
                 mesh=mesh,
             )
             bank0 = jnp.zeros((red.num_entities, red.local_dim), jnp.float32)
-            bank, tracker = problem.update_bank(bank0, red)
+            bank, tracker, var = problem.update_bank(
+                bank0, red, with_variances=True
+            )
             assert tracker.num_entities == red.num_entities
             banks[label] = np.asarray(bank)
+            variances[label] = np.asarray(var)
         np.testing.assert_allclose(banks["mesh"], banks["single"], atol=1e-3)
+        # per-entity variances ride the same sharding (isComputingVariance
+        # under the mesh): entity-for-entity agreement, all positive
+        assert (variances["single"] > 0).all()
+        np.testing.assert_allclose(
+            variances["mesh"], variances["single"], rtol=2e-3, atol=1e-5
+        )

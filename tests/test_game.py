@@ -480,6 +480,86 @@ class TestMatrixFactorization:
         after = mse(model)
         assert after < before * 0.5, (before, after)
 
+    def test_identity_solvers_match_densify(self, rng):
+        """The *_id solver variants (X = values, no densify broadcast —
+        the MF latent-view fast path) must produce the same solves as
+        the general densify path on identity-index data."""
+        from photon_ml_tpu.game.random_effect import _bucket_solver
+        from photon_ml_tpu.ops.losses import LOGISTIC as _LOG
+
+        E, S, k = 50, 8, 4
+        solvers = _bucket_solver(
+            _LOG, OptimizerConfig(max_iter=50),
+            RegularizationContext(RegularizationType.L2),
+        )
+        ix = np.tile(np.arange(k, dtype=np.int32)[None, None, :], (E, S, 1))
+        v = rng.normal(size=(E, S, k)).astype(np.float32)
+        lab = (rng.uniform(size=(E, S)) > 0.5).astype(np.float32)
+        w = np.ones((E, S), np.float32)
+        off = np.zeros((E, S), np.float32)
+        bank = jnp.zeros((E, k), jnp.float32)
+        args = (
+            jnp.asarray(ix), jnp.asarray(v), jnp.asarray(lab),
+            jnp.asarray(off), jnp.asarray(w),
+            jnp.float32(0.0), jnp.float32(0.5),
+        )
+        for base, ident in (("dense", "dense_id"), ("newton", "newton_id")):
+            out_b, _, _ = getattr(solvers, base)(bank, *args)
+            out_i, _, _ = getattr(solvers, ident)(bank, *args)
+            np.testing.assert_allclose(
+                np.asarray(out_i), np.asarray(out_b), atol=1e-5,
+                err_msg=base,
+            )
+
+    def test_cap_class_merge_bounds_padding(self, rng):
+        """The MF bucket cap-class merge (fewer distinct solver programs)
+        must never pad an entity's sample capacity more than 4x — a
+        heavy-tailed count distribution where no class holds 25% of
+        entities must not collapse everything onto the largest class."""
+        # entity i gets ~2^(i mod 10) ratings: every cap class ~10%
+        counts = [2 ** (i % 10) for i in range(40)]
+        rows = np.repeat(np.arange(40, dtype=np.int32), counts)
+        n = len(rows)
+        cols = rng.integers(0, 5, size=n).astype(np.int32)
+        recs = [
+            {
+                "uid": f"r{i}",
+                "response": float(rng.normal()),
+                "userId": f"u{rows[i]}",
+                "itemId": f"i{cols[i]}",
+                "features": [],
+            }
+            for i in range(n)
+        ]
+        ds = build_game_dataset(
+            recs, [FeatureShardConfiguration("g", ["features"])],
+            ["userId", "itemId"],
+        )
+        mf = MatrixFactorizationCoordinate(
+            name="mf", dataset=ds, row_effect_type="userId",
+            col_effect_type="itemId", num_latent_factors=2,
+            problem=RandomEffectOptimizationProblem(
+                LINEAR, OptimizerConfig(max_iter=5),
+                RegularizationContext(RegularizationType.L2), reg_weight=1.0,
+            ),
+        )
+        row_codes = ds.entity_codes["userId"]
+        col_codes = ds.entity_codes["itemId"]
+        view, _ = mf._side_structure("row", row_codes, col_codes, 40)
+        per_entity = np.bincount(
+            row_codes[(ds.weights > 0) & (row_codes >= 0)], minlength=40
+        )
+        for b in view.buckets:
+            assert b.identity_indices
+            S = b.row_index.shape[1]
+            for e, code in enumerate(b.entity_codes):
+                c = per_entity[code]
+                cap = 1 << int(np.ceil(np.log2(max(c, 1))))
+                assert S <= 4 * cap, (int(code), c, cap, S)
+        # every entity appears in exactly one bucket
+        all_codes = np.concatenate([b.entity_codes for b in view.buckets])
+        assert sorted(all_codes.tolist()) == list(range(40))
+
 
 @pytest.mark.slow
 class TestMediumScaleGame:
