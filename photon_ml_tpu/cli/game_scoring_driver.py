@@ -60,10 +60,18 @@ class GameScoringParams:
     feature_name_and_term_set_path: Optional[str] = None
     # jax.profiler trace of the scoring pass (SURVEY §7.11)
     profile_dir: Optional[str] = None
+    # Chunked scoring for inputs larger than memory (the reference scores
+    # RDD partitions without collecting — Spark's memory profile by
+    # construction); requires prebuilt feature maps, pointwise/global
+    # evaluators only.
+    streaming: bool = False
+    rows_per_chunk: int = 100_000
 
     def validate(self):
         if not self.input_dirs:
             raise ValueError("input-data-dirs is required")
+        if self.streaming and self.rows_per_chunk < 1:
+            raise ValueError("rows-per-chunk must be >= 1")
         if not self.game_model_input_dir:
             raise ValueError("game-model-input-dir is required")
         if not self.output_dir:
@@ -124,6 +132,17 @@ class GameScoringDriver:
         input_paths = expand_dated_paths(
             p.input_dirs, p.date_range, p.date_range_days_ago, self.logger
         )
+        from photon_ml_tpu.parallel.multihost import (
+            is_coordinator,
+            sync_processes,
+        )
+        from photon_ml_tpu.utils.profiling import profile_trace
+
+        if p.streaming:
+            self._run_streaming(model, sorted(id_types), index_maps, input_paths)
+            sync_processes("scores-written")
+            self.logger.info("timers:\n%s", self.timer.summary())
+            return
         with self.timer.time("load-data"):
             dataset = build_game_dataset_from_files(
                 input_paths,
@@ -132,15 +151,9 @@ class GameScoringDriver:
                 index_maps=index_maps,
                 is_response_required=p.has_response,
             )
-        from photon_ml_tpu.utils.profiling import profile_trace
-
         with self.timer.time("score"), profile_trace(p.profile_dir):
             raw_scores = model.score(dataset, p.task_type)
             scores = raw_scores + jnp.asarray(dataset.offsets)
-        from photon_ml_tpu.parallel.multihost import (
-            is_coordinator,
-            sync_processes,
-        )
 
         if is_coordinator():
             with self.timer.time("write-scores"):
@@ -156,7 +169,102 @@ class GameScoringDriver:
         sync_processes("scores-written")
         self.logger.info("timers:\n%s", self.timer.summary())
 
-    def _write_scores(self, dataset, scores: np.ndarray) -> None:
+    def _run_streaming(self, model, id_types, index_maps, input_paths) -> None:
+        """Chunked scoring: records stream from the input files
+        ``rows_per_chunk`` at a time; each chunk builds its own small
+        GameDataset (model lookup is by RAW entity id, so per-chunk
+        entity indexes are safe), scores, and appends a scores part file.
+        Peak memory is one chunk's features — the partition-streamed
+        memory profile the reference gets from Spark by construction
+        (cli/game/scoring/Driver.scala:171-204 scores RDD partitions
+        without collecting). Pointwise + global-rank metrics accumulate
+        on [n] float arrays; SHARDED evaluators need global group
+        indexes and are rejected up front."""
+        import itertools
+
+        from photon_ml_tpu.game.data import build_game_dataset
+        from photon_ml_tpu.io.avro_codec import read_avro_records
+        from photon_ml_tpu.parallel.multihost import is_coordinator
+        from photon_ml_tpu.utils.profiling import profile_trace
+
+        p = self.params
+        if index_maps is None:
+            raise ValueError(
+                "streaming scoring requires prebuilt feature maps "
+                "(--offheap-indexmap-dir or "
+                "--feature-name-and-term-set-path): no single chunk sees "
+                "the whole vocabulary"
+            )
+        for et in p.evaluator_types:
+            if et.is_sharded:
+                raise ValueError(
+                    f"sharded evaluator {et.render()!r} needs global "
+                    "per-group data; use in-memory scoring"
+                )
+        if p.num_files != 1:
+            self.logger.warning(
+                "--num-files is ignored in streaming mode: one scores "
+                "part file is written per %d-row chunk", p.rows_per_chunk
+            )
+        all_scores: List[np.ndarray] = []
+        all_labels: List[np.ndarray] = []
+        all_weights: List[np.ndarray] = []
+        n_rows = 0
+        part = 0
+        records_iter = iter(read_avro_records(input_paths))
+        with self.timer.time("score-stream"), profile_trace(p.profile_dir):
+            while True:
+                chunk = list(
+                    itertools.islice(records_iter, p.rows_per_chunk)
+                )
+                if not chunk:
+                    break
+                ds = build_game_dataset(
+                    chunk, p.feature_shards, id_types,
+                    index_maps=index_maps,
+                    is_response_required=p.has_response,
+                    row_offset=n_rows,
+                )
+                scores = np.asarray(
+                    model.score(ds, p.task_type) + jnp.asarray(ds.offsets)
+                )[: ds.num_real_rows]
+                if is_coordinator():
+                    from photon_ml_tpu.io.avro_codec import write_container
+
+                    write_container(
+                        os.path.join(
+                            p.output_dir, "scores", f"part-{part:05d}.avro"
+                        ),
+                        schemas.SCORING_RESULT_AVRO,
+                        self._score_records(ds, scores),
+                    )
+                part += 1
+                n_rows += ds.num_real_rows
+                if p.evaluator_types and p.has_response:
+                    all_scores.append(scores)
+                    all_labels.append(
+                        np.asarray(ds.labels[: ds.num_real_rows])
+                    )
+                    all_weights.append(
+                        np.asarray(ds.weights[: ds.num_real_rows])
+                    )
+        self.logger.info(
+            "streamed %d rows in %d chunk(s)", n_rows, part
+        )
+        if p.evaluator_types and p.has_response and n_rows > 0:
+            with self.timer.time("evaluate"):
+                self._evaluate_pointwise(
+                    jnp.asarray(np.concatenate(all_scores)),
+                    jnp.asarray(np.concatenate(all_labels)),
+                    jnp.asarray(np.concatenate(all_weights)),
+                )
+            if is_coordinator():
+                with open(
+                    os.path.join(p.output_dir, "metrics.json"), "w"
+                ) as f:
+                    json.dump(self.metrics, f, indent=2)
+
+    def _score_records(self, dataset, scores: np.ndarray) -> list:
         id_types = sorted(dataset.entity_indexes)
         records = []
         for i in range(dataset.num_real_rows):
@@ -175,12 +283,15 @@ class GameScoringDriver:
                 "weight": float(dataset.weights[i]),
                 "metadataMap": meta or None,
             })
+        return records
+
+    def _write_scores(self, dataset, scores: np.ndarray) -> None:
         from photon_ml_tpu.game.model_io import _write_parts
 
         _write_parts(
             os.path.join(self.params.output_dir, "scores"),
             schemas.SCORING_RESULT_AVRO,
-            records,
+            self._score_records(dataset, scores),
             self.params.num_files,
         )
 
@@ -188,7 +299,6 @@ class GameScoringDriver:
         p = self.params
         lab = jnp.asarray(dataset.labels)
         w = jnp.asarray(dataset.weights)
-        loss = loss_for_task(p.task_type)
         for et in p.evaluator_types:
             if et.is_sharded:
                 gids = dataset.entity_codes[et.id_type]
@@ -198,9 +308,19 @@ class GameScoringDriver:
                 value = float(
                     ev.evaluate(scores, lab, w, jnp.maximum(jnp.asarray(gids), 0))
                 )
+                self.metrics[et.render()] = value
+                self.logger.info("%s = %g", et.render(), value)
             else:
-                metric_in = loss.mean(scores) if et.name == "RMSE" else scores
-                value = float(Evaluator(et).evaluate(metric_in, lab, w))
+                self._evaluate_pointwise(scores, lab, w, evaluators=[et])
+
+    def _evaluate_pointwise(self, scores, lab, w, evaluators=None) -> None:
+        """Non-sharded metrics — ONE definition shared by the in-memory
+        and streaming paths so a metric change cannot diverge them."""
+        p = self.params
+        loss = loss_for_task(p.task_type)
+        for et in evaluators if evaluators is not None else p.evaluator_types:
+            metric_in = loss.mean(scores) if et.name == "RMSE" else scores
+            value = float(Evaluator(et).evaluate(metric_in, lab, w))
             self.metrics[et.render()] = value
             self.logger.info("%s = %g", et.render(), value)
 
@@ -230,6 +350,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--profile-dir", default=None,
         help="write a jax.profiler trace of the scoring pass here",
     )
+    ap.add_argument(
+        "--streaming", default="false",
+        help="true: score in bounded-memory chunks (needs prebuilt "
+        "feature maps; sharded evaluators unsupported)",
+    )
+    ap.add_argument("--rows-per-chunk", type=int, default=100_000)
     return ap
 
 
@@ -256,6 +382,8 @@ def params_from_args(argv=None) -> GameScoringParams:
         ),
         model_id=ns.game_model_id or ns.model_id or "",
         profile_dir=ns.profile_dir,
+        streaming=str(ns.streaming).lower() in ("true", "1", "yes"),
+        rows_per_chunk=ns.rows_per_chunk,
         has_response=str(ns.has_response).lower() in ("true", "1", "yes"),
         date_range=ns.date_range,
         date_range_days_ago=ns.date_range_days_ago,

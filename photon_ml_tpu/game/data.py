@@ -183,6 +183,7 @@ def build_game_dataset(
     is_response_required: bool = True,
     pad_rows_to: int = 8,
     pad_nnz_to: int = 8,
+    row_offset: int = 0,
 ) -> GameDataset:
     """Records -> GameDataset (DataProcessingUtils.getGameDataSetFrom
     GenericRecords analog).
@@ -190,7 +191,9 @@ def build_game_dataset(
     - response from "response" or "label" field (scoring mode tolerates
       absence with is_response_required=False);
     - ids read from top-level fields or metadataMap, stringified;
-    - feature keys are name TAB term per bag, one IndexMap per shard.
+    - feature keys are name TAB term per bag, one IndexMap per shard;
+    - ``row_offset`` shifts the fallback uid for records with no uid
+      field so chunked builds (streaming scoring) stay globally unique.
     """
     records = list(records)
     n = len(records)
@@ -247,7 +250,7 @@ def build_game_dataset(
         # row index only for a MISSING uid: 0 or "" are legitimate ids and
         # must round-trip (the native column path preserves them)
         uid_v = r.get("uid")
-        uids.append(str(i) if uid_v is None else str(uid_v))
+        uids.append(str(row_offset + i) if uid_v is None else str(uid_v))
 
     shards: Dict[str, ShardData] = {}
     for cfg in shard_configs:

@@ -225,23 +225,35 @@ class LoadedGameModel:
 
     def score(self, dataset: GameDataset, task: TaskType) -> jnp.ndarray:
         total = jnp.zeros((dataset.num_rows,), jnp.float32)
+        fe_cache = self.__dict__.setdefault("_fe_weight_cache", {})
         for name, (shard_id, means) in self.fixed_effects.items():
             imap = dataset.shards[shard_id].index_map
-            w = np.zeros((imap.size,), np.float32)
-            for key, v in means.items():
-                i = imap.get_index(key)
-                if i >= 0:
-                    w[i] = v
-            glm = create_model(task, Coefficients(jnp.asarray(w)))
+            # the fixed-effect weight vector depends only on (model,
+            # index map): chunked scoring calls score() once per chunk
+            # with the SAME prebuilt maps — don't rebuild the whole
+            # coefficient dict each time
+            hit = fe_cache.get(name)
+            if hit is None or hit[0] is not imap:
+                w = np.zeros((imap.size,), np.float32)
+                for key, v in means.items():
+                    i = imap.get_index(key)
+                    if i >= 0:
+                        w[i] = v
+                hit = (imap, jnp.asarray(w))
+                fe_cache[name] = hit
+            glm = create_model(task, Coefficients(hit[1]))
             total = total + glm.score(dataset.batch_for_shard(shard_id))
         for name, (re_type, shard_id, per_entity) in self.random_effects.items():
             imap = dataset.shards[shard_id].index_map
             eindex = dataset.entity_indexes[re_type]
             bank = np.zeros((eindex.num_entities, imap.size), np.float32)
-            for raw_id, means in per_entity.items():
-                code = eindex.code_of.get(raw_id)
-                if code is None:
-                    continue  # entity unseen in the scoring data
+            # iterate the DATASET's entities (small per scoring chunk)
+            # and look up the model dict — not the model's full entity
+            # set per call
+            for code, raw_id in enumerate(eindex.ids):
+                means = per_entity.get(raw_id)
+                if not means:
+                    continue  # entity has no model (scores 0)
                 for key, v in means.items():
                     i = imap.get_index(key)
                     if i >= 0:
@@ -264,13 +276,13 @@ class LoadedGameModel:
             K = len(next(iter(rows.values())))
             R = np.zeros((r_index.num_entities, K), np.float32)
             C = np.zeros((c_index.num_entities, K), np.float32)
-            for rid, vec in rows.items():
-                code = r_index.code_of.get(rid)
-                if code is not None:
+            for code, rid in enumerate(r_index.ids):
+                vec = rows.get(rid)
+                if vec is not None:
                     R[code] = vec
-            for cid, vec in cols.items():
-                code = c_index.code_of.get(cid)
-                if code is not None:
+            for code, cid in enumerate(c_index.ids):
+                vec = cols.get(cid)
+                if vec is not None:
                     C[code] = vec
             mf = MatrixFactorizationModel(
                 row_t, col_t, jnp.asarray(R), jnp.asarray(C)

@@ -179,6 +179,107 @@ class TestYahooMusicScoring:
         GameScoringDriver(params).run()
         return params.output_dir
 
+    def test_streaming_scoring_matches_in_memory(self, tmp_path):
+        """--streaming scores in bounded-memory chunks (the reference's
+        partition-streamed profile): same scores, same RMSE, multiple
+        part files."""
+        from photon_ml_tpu.cli.game_scoring_driver import (
+            GameScoringDriver,
+            GameScoringParams,
+        )
+        from photon_ml_tpu.evaluation import EvaluatorType
+        from photon_ml_tpu.game.config import FeatureShardConfiguration
+        from photon_ml_tpu.task import TaskType
+
+        outs = {}
+        for label, streaming in (("mem", False), ("stream", True)):
+            params = GameScoringParams(
+                input_dirs=[os.path.join(GAME_REF, "input", "test")],
+                game_model_input_dir=os.path.join(
+                    GAME_REF, "fixedEffectOnlyGAMEModel"
+                ),
+                output_dir=str(tmp_path / label),
+                task_type=TaskType.LINEAR_REGRESSION,
+                feature_shards=[
+                    FeatureShardConfiguration(
+                        "globalShard",
+                        ["features", "songFeatures", "userFeatures"],
+                    ),
+                ],
+                feature_name_and_term_set_path=os.path.join(
+                    GAME_REF, "input", "feature-lists"
+                ),
+                evaluator_types=[EvaluatorType.parse("RMSE")],
+                streaming=streaming,
+                rows_per_chunk=2500,
+            )
+            GameScoringDriver(params).run()
+            # part files sort lexically = chunk order, so file order IS
+            # the input row order on both paths (the fixture has no uid
+            # field — row-index uids restart per chunk and cannot key a
+            # cross-path sort)
+            recs = list(
+                read_avro_records(os.path.join(params.output_dir, "scores"))
+            )
+            metrics = json.load(
+                open(os.path.join(params.output_dir, "metrics.json"))
+            )
+            outs[label] = (recs, metrics)
+        mem_recs, mem_m = outs["mem"]
+        st_recs, st_m = outs["stream"]
+        assert len(st_recs) == len(mem_recs) == 9195
+        # 9195 rows / 2500 per chunk -> 4 part files
+        parts = os.listdir(os.path.join(tmp_path, "stream", "scores"))
+        assert len(parts) == 4
+        assert st_m["RMSE"] == pytest.approx(mem_m["RMSE"], rel=1e-6)
+        np.testing.assert_allclose(
+            [r["predictionScore"] for r in st_recs],
+            [r["predictionScore"] for r in mem_recs],
+            rtol=1e-5,
+        )
+
+    def test_streaming_scoring_guards(self, tmp_path):
+        from photon_ml_tpu.cli.game_scoring_driver import (
+            GameScoringDriver,
+            GameScoringParams,
+        )
+        from photon_ml_tpu.evaluation import EvaluatorType
+        from photon_ml_tpu.game.config import FeatureShardConfiguration
+        from photon_ml_tpu.task import TaskType
+
+        base = dict(
+            input_dirs=[os.path.join(GAME_REF, "input", "test")],
+            game_model_input_dir=os.path.join(
+                GAME_REF, "fixedEffectOnlyGAMEModel"
+            ),
+            task_type=TaskType.LINEAR_REGRESSION,
+            feature_shards=[
+                FeatureShardConfiguration("globalShard", ["features"]),
+            ],
+            streaming=True,
+        )
+        # no prebuilt feature maps -> rejected
+        with pytest.raises(ValueError, match="prebuilt feature maps"):
+            GameScoringDriver(
+                GameScoringParams(
+                    output_dir=str(tmp_path / "a"), **base
+                )
+            ).run()
+        # sharded evaluators -> rejected
+        with pytest.raises(ValueError, match="sharded evaluator"):
+            GameScoringDriver(
+                GameScoringParams(
+                    output_dir=str(tmp_path / "b"),
+                    feature_name_and_term_set_path=os.path.join(
+                        GAME_REF, "input", "feature-lists"
+                    ),
+                    evaluator_types=[
+                        EvaluatorType.parse("precision@5:userId")
+                    ],
+                    **base,
+                )
+            ).run()
+
     def test_score_with_reference_model(self, tmp_path):
         out = self._score(tmp_path, "fixedEffectOnlyGAMEModel")
         metrics = json.load(open(os.path.join(out, "metrics.json")))
