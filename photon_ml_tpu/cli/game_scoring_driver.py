@@ -70,8 +70,27 @@ class GameScoringParams:
     def validate(self):
         if not self.input_dirs:
             raise ValueError("input-data-dirs is required")
-        if self.streaming and self.rows_per_chunk < 1:
-            raise ValueError("rows-per-chunk must be >= 1")
+        if self.streaming:
+            # all param-detectable streaming misconfigurations fail HERE,
+            # before __init__ touches (or deletes) the output directory
+            if self.rows_per_chunk < 1:
+                raise ValueError("rows-per-chunk must be >= 1")
+            if not (
+                self.offheap_indexmap_dir
+                or self.feature_name_and_term_set_path
+            ):
+                raise ValueError(
+                    "streaming scoring requires prebuilt feature maps "
+                    "(--offheap-indexmap-dir or "
+                    "--feature-name-and-term-set-path): no single chunk "
+                    "sees the whole vocabulary"
+                )
+            for et in self.evaluator_types:
+                if et.is_sharded:
+                    raise ValueError(
+                        f"sharded evaluator {et.render()!r} needs global "
+                        "per-group data; use in-memory scoring"
+                    )
         if not self.game_model_input_dir:
             raise ValueError("game-model-input-dir is required")
         if not self.output_dir:
@@ -170,88 +189,85 @@ class GameScoringDriver:
         self.logger.info("timers:\n%s", self.timer.summary())
 
     def _run_streaming(self, model, id_types, index_maps, input_paths) -> None:
-        """Chunked scoring: records stream from the input files
-        ``rows_per_chunk`` at a time; each chunk builds its own small
-        GameDataset (model lookup is by RAW entity id, so per-chunk
-        entity indexes are safe), scores, and appends a scores part file.
-        Peak memory is one chunk's features — the partition-streamed
-        memory profile the reference gets from Spark by construction
-        (cli/game/scoring/Driver.scala:171-204 scores RDD partitions
-        without collecting). Pointwise + global-rank metrics accumulate
-        on [n] float arrays; SHARDED evaluators need global group
-        indexes and are rejected up front."""
-        import itertools
-
-        from photon_ml_tpu.game.data import build_game_dataset
-        from photon_ml_tpu.io.avro_codec import read_avro_records
+        """Chunked scoring: ONE input file loads at a time (through the
+        native column decoder — the file is the natural partition unit,
+        exactly like io/streaming.py's >RAM training path), then scores
+        and writes in ``rows_per_chunk`` row slices. Peak memory is one
+        file's features — the partition-streamed profile the reference
+        gets from Spark by construction (cli/game/scoring/
+        Driver.scala:171-204 scores RDD partitions without collecting).
+        Pointwise + global-rank metrics accumulate on [n] float arrays;
+        param-level guards (prebuilt maps, no sharded evaluators) live
+        in GameScoringParams.validate."""
+        from photon_ml_tpu.game.data import slice_game_dataset
+        from photon_ml_tpu.io.paths import expand_input_paths
         from photon_ml_tpu.parallel.multihost import is_coordinator
         from photon_ml_tpu.utils.profiling import profile_trace
 
         p = self.params
-        if index_maps is None:
-            raise ValueError(
-                "streaming scoring requires prebuilt feature maps "
-                "(--offheap-indexmap-dir or "
-                "--feature-name-and-term-set-path): no single chunk sees "
-                "the whole vocabulary"
-            )
-        for et in p.evaluator_types:
-            if et.is_sharded:
-                raise ValueError(
-                    f"sharded evaluator {et.render()!r} needs global "
-                    "per-group data; use in-memory scoring"
-                )
         if p.num_files != 1:
             self.logger.warning(
                 "--num-files is ignored in streaming mode: one scores "
                 "part file is written per %d-row chunk", p.rows_per_chunk
             )
+        # expand sorts within each directory and preserves the caller's
+        # dir order — identical global order to the in-memory path (a
+        # global re-sort would reassign fallback uids across dirs)
+        files = expand_input_paths(
+            input_paths, lambda fn: fn.endswith(".avro")
+        )
         all_scores: List[np.ndarray] = []
         all_labels: List[np.ndarray] = []
         all_weights: List[np.ndarray] = []
         n_rows = 0
         part = 0
-        records_iter = iter(read_avro_records(input_paths))
         with self.timer.time("score-stream"), profile_trace(p.profile_dir):
-            while True:
-                chunk = list(
-                    itertools.islice(records_iter, p.rows_per_chunk)
-                )
-                if not chunk:
-                    break
-                ds = build_game_dataset(
-                    chunk, p.feature_shards, id_types,
-                    index_maps=index_maps,
-                    is_response_required=p.has_response,
-                    row_offset=n_rows,
-                )
-                scores = np.asarray(
-                    model.score(ds, p.task_type) + jnp.asarray(ds.offsets)
-                )[: ds.num_real_rows]
-                if is_coordinator():
-                    from photon_ml_tpu.io.avro_codec import write_container
-
-                    write_container(
-                        os.path.join(
-                            p.output_dir, "scores", f"part-{part:05d}.avro"
-                        ),
-                        schemas.SCORING_RESULT_AVRO,
-                        self._score_records(ds, scores),
+            for path in files:
+                try:
+                    ds_file = build_game_dataset_from_files(
+                        [path], p.feature_shards, id_types,
+                        index_maps=index_maps,
+                        is_response_required=p.has_response,
+                        row_offset=n_rows,
                     )
-                part += 1
-                n_rows += ds.num_real_rows
-                if p.evaluator_types and p.has_response:
-                    all_scores.append(scores)
-                    all_labels.append(
-                        np.asarray(ds.labels[: ds.num_real_rows])
+                except ValueError as e:
+                    if "empty GAME dataset" in str(e):
+                        continue  # zero-record part file
+                    raise
+                for a in range(0, ds_file.num_real_rows, p.rows_per_chunk):
+                    ds = slice_game_dataset(
+                        ds_file, a, a + p.rows_per_chunk
                     )
-                    all_weights.append(
-                        np.asarray(ds.weights[: ds.num_real_rows])
-                    )
+                    scores = np.asarray(
+                        model.score(ds, p.task_type)
+                        + jnp.asarray(ds.offsets)
+                    )[: ds.num_real_rows]
+                    if is_coordinator():
+                        write_container(
+                            os.path.join(
+                                p.output_dir, "scores",
+                                f"part-{part:05d}.avro",
+                            ),
+                            schemas.SCORING_RESULT_AVRO,
+                            self._score_records(ds, scores),
+                        )
+                    part += 1
+                    n_rows += ds.num_real_rows
+                    if p.evaluator_types and p.has_response:
+                        all_scores.append(scores)
+                        all_labels.append(
+                            np.asarray(ds.labels[: ds.num_real_rows])
+                        )
+                        all_weights.append(
+                            np.asarray(ds.weights[: ds.num_real_rows])
+                        )
+        if n_rows == 0:
+            raise ValueError("empty GAME dataset")  # in-memory parity
         self.logger.info(
-            "streamed %d rows in %d chunk(s)", n_rows, part
+            "streamed %d rows in %d chunk(s) from %d file(s)",
+            n_rows, part, len(files),
         )
-        if p.evaluator_types and p.has_response and n_rows > 0:
+        if p.evaluator_types and p.has_response:
             with self.timer.time("evaluate"):
                 self._evaluate_pointwise(
                     jnp.asarray(np.concatenate(all_scores)),

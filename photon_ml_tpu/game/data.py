@@ -292,6 +292,29 @@ def build_game_dataset(
     )
 
 
+def slice_game_dataset(ds: GameDataset, start: int, stop: int) -> GameDataset:
+    """Row-range view [start, stop) over a dataset's REAL rows — the
+    scoring drivers' chunk unit. Array slices are views (no copy);
+    entity indexes are shared (codes are already dense)."""
+    stop = min(stop, ds.num_real_rows)
+    return GameDataset(
+        uids=ds.uids[start:stop],
+        labels=ds.labels[start:stop],
+        offsets=ds.offsets[start:stop],
+        weights=ds.weights[start:stop],
+        shards={
+            k: ShardData(
+                sd.indices[start:stop], sd.values[start:stop],
+                sd.index_map, sd.intercept_index,
+            )
+            for k, sd in ds.shards.items()
+        },
+        entity_codes={t: c[start:stop] for t, c in ds.entity_codes.items()},
+        entity_indexes=ds.entity_indexes,
+        num_real_rows=stop - start,
+    )
+
+
 def build_game_dataset_from_files(
     paths,
     shard_configs: Sequence[FeatureShardConfiguration],
@@ -301,6 +324,7 @@ def build_game_dataset_from_files(
     is_response_required: bool = True,
     pad_rows_to: int = 8,
     pad_nnz_to: int = 8,
+    row_offset: int = 0,
 ) -> GameDataset:
     """Avro files -> GameDataset through the native column decoder, with a
     transparent fallback to the record-at-a-time Python path
@@ -327,6 +351,7 @@ def build_game_dataset_from_files(
             is_response_required=is_response_required,
             pad_rows_to=pad_rows_to,
             pad_nnz_to=pad_nnz_to,
+            row_offset=row_offset,
         )
 
     try:
@@ -438,12 +463,16 @@ def build_game_dataset_from_files(
 
         if "uid" in strings:
             for i, sid in enumerate(cols.str_ids("uid")):
-                # empty string counts as missing, matching the Python
-                # builder's `r.get("uid") or i`
-                s = cols.strings[sid] if sid >= 0 else ""
-                uids.append(s if s else str(row0 + i))
+                # only a MISSING uid (null branch) falls back to the row
+                # index — "" is a legitimate id (matches the Python
+                # builder since round 4)
+                uids.append(
+                    cols.strings[sid]
+                    if sid >= 0
+                    else str(row_offset + row0 + i)
+                )
         else:
-            uids.extend(str(row0 + i) for i in range(m))
+            uids.extend(str(row_offset + row0 + i) for i in range(m))
 
         for t in random_effect_types:
             if t in strings:

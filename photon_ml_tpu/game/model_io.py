@@ -243,21 +243,29 @@ class LoadedGameModel:
                 fe_cache[name] = hit
             glm = create_model(task, Coefficients(hit[1]))
             total = total + glm.score(dataset.batch_for_shard(shard_id))
+        re_cache = self.__dict__.setdefault("_re_bank_cache", {})
         for name, (re_type, shard_id, per_entity) in self.random_effects.items():
             imap = dataset.shards[shard_id].index_map
             eindex = dataset.entity_indexes[re_type]
-            bank = np.zeros((eindex.num_entities, imap.size), np.float32)
-            # iterate the DATASET's entities (small per scoring chunk)
-            # and look up the model dict — not the model's full entity
-            # set per call
-            for code, raw_id in enumerate(eindex.ids):
-                means = per_entity.get(raw_id)
-                if not means:
-                    continue  # entity has no model (scores 0)
-                for key, v in means.items():
-                    i = imap.get_index(key)
-                    if i >= 0:
-                        bank[code, i] = v
+            # chunks sliced from one file share eindex/imap: build the
+            # bank once per (entity index, index map), like the FE cache
+            hit = re_cache.get(name)
+            if hit is None or hit[0] is not eindex or hit[1] is not imap:
+                bank = np.zeros((eindex.num_entities, imap.size), np.float32)
+                # iterate the DATASET's entities (small per scoring
+                # chunk) and look up the model dict — not the model's
+                # full entity set per call
+                for code, raw_id in enumerate(eindex.ids):
+                    means = per_entity.get(raw_id)
+                    if not means:
+                        continue  # entity has no model (scores 0)
+                    for key, v in means.items():
+                        i = imap.get_index(key)
+                        if i >= 0:
+                            bank[code, i] = v
+                hit = (eindex, imap, jnp.asarray(bank))
+                re_cache[name] = hit
+            bank = hit[2]
             codes = dataset.entity_codes[re_type]
             valid = jnp.asarray(codes >= 0)
             w_rows = jnp.take(
@@ -270,23 +278,26 @@ class LoadedGameModel:
                 axis=-1,
             )
             total = total + jnp.where(valid, score, 0.0)
+        mf_cache = self.__dict__.setdefault("_mf_latent_cache", {})
         for name, (row_t, col_t, rows, cols) in self.matrix_factorizations.items():
             r_index = dataset.entity_indexes[row_t]
             c_index = dataset.entity_indexes[col_t]
-            K = len(next(iter(rows.values())))
-            R = np.zeros((r_index.num_entities, K), np.float32)
-            C = np.zeros((c_index.num_entities, K), np.float32)
-            for code, rid in enumerate(r_index.ids):
-                vec = rows.get(rid)
-                if vec is not None:
-                    R[code] = vec
-            for code, cid in enumerate(c_index.ids):
-                vec = cols.get(cid)
-                if vec is not None:
-                    C[code] = vec
-            mf = MatrixFactorizationModel(
-                row_t, col_t, jnp.asarray(R), jnp.asarray(C)
-            )
+            hit = mf_cache.get(name)
+            if hit is None or hit[0] is not r_index or hit[1] is not c_index:
+                K = len(next(iter(rows.values())))
+                R = np.zeros((r_index.num_entities, K), np.float32)
+                C = np.zeros((c_index.num_entities, K), np.float32)
+                for code, rid in enumerate(r_index.ids):
+                    vec = rows.get(rid)
+                    if vec is not None:
+                        R[code] = vec
+                for code, cid in enumerate(c_index.ids):
+                    vec = cols.get(cid)
+                    if vec is not None:
+                        C[code] = vec
+                hit = (r_index, c_index, jnp.asarray(R), jnp.asarray(C))
+                mf_cache[name] = hit
+            mf = MatrixFactorizationModel(row_t, col_t, hit[2], hit[3])
             total = total + mf.score(dataset)
         return total
 

@@ -407,13 +407,14 @@ class TestStreamingDriver:
 
     def test_streaming_rejects_unsupported(self, avro_dirs, tmp_path):
         train, _ = avro_dirs
-        with pytest.raises(ValueError, match="streaming training"):
-            GLMParams(
-                train_dir=train,
-                output_dir=str(tmp_path / "x"),
-                streaming=True,
-                regularization_type=RegularizationType.L1,
-            ).validate()
+        # L1/elastic-net stream via host-driven OWL-QN since round 4:
+        # validates cleanly
+        GLMParams(
+            train_dir=train,
+            output_dir=str(tmp_path / "x"),
+            streaming=True,
+            regularization_type=RegularizationType.L1,
+        ).validate()
         with pytest.raises(ValueError, match="streaming training"):
             GLMParams(
                 train_dir=train,

@@ -50,6 +50,20 @@ for mxu, tol in (("highest", 1e-4), ("bf16x2", 1e-3), ("bf16x2w", 1e-3)):
     hd2 = jax.jit(oobj.hessian_diagonal)(w, sb, 0.1)
     de = float(jnp.max(jnp.abs(hd1 - hd2)) / (jnp.max(jnp.abs(hd2)) + 1e-9))
     assert max(ge, he, de) < tol, (mxu, ge, he, de)
+
+# spill-to-scatter hybrid ON CHIP: force tile remainders through the
+# spill path (cap > remainder) and hold the same tolerance
+from photon_ml_tpu.ops.tiled_sparse import TileParams
+tb_spill = build_tiled_batch(rows, indices.reshape(-1), values.reshape(-1),
+                             labels, offsets, weights, d,
+                             params=TileParams(chunk=4096, spill_cap=3000))
+assert int(np.count_nonzero(np.asarray(tb_spill.z_sched.spill_vals))) > 0
+assert int(np.count_nonzero(np.asarray(tb_spill.g_sched.spill_vals))) > 0
+tobj = TiledGLMObjective(LOGISTIC, d, mxu="bf16x2w")
+v1, g1 = jax.jit(tobj.value_and_gradient)(w, tb_spill, 0.1)
+v2, g2 = jax.jit(oobj.value_and_gradient)(w, sb, 0.1)
+ge = float(jnp.max(jnp.abs(g1 - g2)) / (jnp.max(jnp.abs(g2)) + 1e-9))
+assert ge < 1e-3, ("spill", ge)
 print("TPU_TILED_OK")
 """
 
