@@ -61,12 +61,6 @@ def make_optimizer(
     """
     validate_optimizer_choice(config, regularization, loss_has_hessian=loss_has_hessian)
     use_owlqn = regularization.has_l1
-    if use_owlqn and box is not None:
-        raise ValueError(
-            "box constraints are not supported with L1/ELASTIC_NET "
-            "regularization (OWL-QN's orthant projection and the hypercube "
-            "projection conflict); use L2/NONE with LBFGS or TRON"
-        )
 
     def optimize(
         value_and_grad_fn: ValueAndGrad,
@@ -97,6 +91,7 @@ def make_optimizer(
                 tol=config.tolerance,
                 history=config.lbfgs_history,
                 l1_mask=l1_mask,
+                box=box,
                 track_coefficients=track_coefficients,
             )
         return minimize_lbfgs(

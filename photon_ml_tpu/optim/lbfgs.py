@@ -253,6 +253,7 @@ def minimize_owlqn(
     tol: float = 1e-7,
     history: int = 10,
     l1_mask: Optional[Array] = None,
+    box: Optional[BoxConstraints] = None,
     ls_max_steps: int = 24,
     axis_name: Optional[str] = None,
     track_coefficients: bool = False,
@@ -265,8 +266,15 @@ def minimize_owlqn(
     from the penalty. ``axis_name``: run over a feature-sharded coefficient
     block (see minimize_lbfgs) — the L1 term and pseudo-gradient are
     elementwise, so only the scalar reductions psum.
+
+    ``box``: project every trial point into the hypercube AFTER the orthant
+    projection — the reference's OWLQN subclasses LBFGS and inherits its
+    line-search projection (OWLQN.scala:43-91, LBFGS.scala:77), so
+    constrained elastic-net is a supported combination.
     """
     vdot, norm, vsum = make_global_prims(axis_name)
+    if box is not None:
+        w0 = box.project(w0)
     l1w = jnp.asarray(l1_weight, dtype=w0.dtype)
     mask = jnp.ones_like(w0) if l1_mask is None else l1_mask.astype(w0.dtype)
     l1_vec = l1w * mask
@@ -290,7 +298,8 @@ def minimize_owlqn(
         orthant = jnp.where(st.w != 0, jnp.sign(st.w), jnp.sign(-pg))
 
         def project_orthant(w_t):
-            return jnp.where(jnp.sign(w_t) == orthant, w_t, 0.0)
+            w_t = jnp.where(jnp.sign(w_t) == orthant, w_t, 0.0)
+            return w_t if box is None else box.project(w_t)
 
         def vg_total(w_t):
             fs, gs = value_and_grad_fn(w_t)

@@ -206,11 +206,29 @@ class TestRegressions:
         assert int(res.reason) == GRADIENT_WITHIN_TOLERANCE
         assert int(res.iterations) == 0
 
-    def test_owlqn_box_rejected(self):
-        box = BoxConstraints(lower=jnp.zeros(4), upper=jnp.ones(4))
-        with pytest.raises(ValueError):
-            make_optimizer(
-                OptimizerConfig(OptimizerType.LBFGS),
-                RegularizationContext(RegularizationType.L1),
-                box=box,
-            )
+    def test_owlqn_box_constrained_elastic_net(self):
+        # The reference's OWLQN subclasses LBFGS and inherits the
+        # hypercube projection (OWLQN.scala:43-91, LBFGS.scala:77), so
+        # box + L1/elastic-net is a supported combination: the iterate
+        # must converge INSIDE the box with the L1 shrinkage applied.
+        box = BoxConstraints(
+            lower=jnp.asarray([-0.5, -0.5, -0.5, -0.5]),
+            upper=jnp.asarray([0.5, 0.5, 0.5, 0.5]),
+        )
+        optimize = make_optimizer(
+            OptimizerConfig(OptimizerType.LBFGS),
+            RegularizationContext(RegularizationType.ELASTIC_NET, 0.5),
+            box=box,
+        )
+        res = optimize(quad_vg(CENTER, SCALES), jnp.zeros(4), l1_weight=0.05)
+        w = np.asarray(res.coefficients)
+        assert np.all(w >= -0.5 - 1e-6) and np.all(w <= 0.5 + 1e-6)
+        # CENTER dims outside the box clamp to the boundary (minus L1
+        # shrinkage pressure, which cannot push them back inside by more
+        # than l1/scale); dims inside shrink toward zero.
+        unconstrained = minimize_owlqn(
+            quad_vg(CENTER, SCALES), jnp.zeros(4), 0.05
+        )
+        w_un = np.asarray(unconstrained.coefficients)
+        expected = np.clip(w_un, -0.5, 0.5)
+        np.testing.assert_allclose(w, expected, atol=0.05)
