@@ -208,8 +208,14 @@ class GameTrainingParams:
     # "auto": fixed-effect solves run data-parallel under shard_map and
     # random-effect banks shard their entity axis whenever >1 device is
     # visible (cli/game/training/Driver.scala is cluster-by-construction);
-    # "off": single-device
+    # "off": single-device; "feature": the fixed effect runs
+    # FEATURE-SHARDED over a 2-D (data, model) mesh — the reference's
+    # huge-dimension GAME fixed effect (treeAggregate depth valve at
+    # >=200k features, Driver.scala:357-363,717-719; "hundreds of
+    # billions of coefficients", README.md:73) — while random-effect
+    # banks keep sharding entities over a 1-D mesh
     distributed: str = "auto"
+    model_shards: Optional[int] = None  # model-axis size for "feature"
     # Multi-host orchestration (SparkContextConfiguration analog).
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
@@ -230,7 +236,7 @@ class GameTrainingParams:
             raise ValueError("train-input-dirs is required")
         if not self.output_dir:
             raise ValueError("output-dir is required")
-        if self.distributed not in ("auto", "off"):
+        if self.distributed not in ("auto", "off", "feature"):
             raise ValueError(f"unknown distributed mode {self.distributed!r}")
         if self.model_output_mode not in ("ALL", "BEST", "NONE"):
             raise ValueError(
@@ -310,10 +316,24 @@ class GameTrainingDriver:
 
     def _mesh(self):
         """Data-parallel/entity-parallel mesh over all visible devices;
-        None when single-device or --distributed off."""
+        None when single-device or --distributed off. In "feature" mode
+        this is the 1-D mesh the RANDOM-EFFECT banks shard over; the
+        fixed effect gets its own 2-D mesh from _fe_mesh."""
         from photon_ml_tpu.parallel.mesh import maybe_make_mesh
 
-        return maybe_make_mesh(self.params.distributed)
+        mode = self.params.distributed
+        return maybe_make_mesh("auto" if mode == "feature" else mode)
+
+    def _fe_mesh(self):
+        """Mesh for the fixed-effect solves: the 2-D (data, model) mesh in
+        "feature" mode (feature-sharded coefficients inside the GAME CD),
+        the shared 1-D data mesh otherwise."""
+        from photon_ml_tpu.parallel.mesh import maybe_make_mesh
+
+        p = self.params
+        if p.distributed == "feature":
+            return maybe_make_mesh("feature", p.model_shards)
+        return self._mesh()
 
     def _build_coordinates(
         self,
@@ -323,6 +343,7 @@ class GameTrainingDriver:
     ):
         p = self.params
         mesh = self._mesh()
+        fe_mesh = self._fe_mesh()
         coords = {}
         for name, dcfg in p.fixed_effect_data_configs.items():
             ocfg = opt_combo[name]
@@ -341,7 +362,7 @@ class GameTrainingDriver:
                 feature_shard_id=dcfg.feature_shard_id,
                 reg_weight=ocfg.reg_weight,
                 down_sampling_rate=ocfg.down_sampling_rate,
-                mesh=mesh,
+                mesh=fe_mesh,
             )
         loss = loss_for_task(p.task_type)
         for name, dcfg in p.random_effect_data_configs.items():
@@ -860,8 +881,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--num-processes", type=int, default=None)
     ap.add_argument("--process-id", type=int, default=None)
     ap.add_argument(
-        "--distributed", default="auto", choices=["auto", "off"],
-        help="shard FE data axis + RE entity axis over all devices",
+        "--distributed", default="auto", choices=["auto", "off", "feature"],
+        help="shard FE data axis + RE entity axis over all devices; "
+        "feature: run the fixed effect feature-sharded over a "
+        "(data, model) mesh (>HBM coefficient vectors)",
+    )
+    ap.add_argument(
+        "--model-shards", type=int, default=None,
+        help="model-axis size for --distributed feature (default 2)",
     )
     ap.add_argument(
         "--checkpoint-dir", default=None,
@@ -960,6 +987,7 @@ def params_from_args(argv=None) -> GameTrainingParams:
         offheap_indexmap_num_partitions=ns.offheap_indexmap_num_partitions,
         delete_output_dir_if_exists=_bool(ns.delete_output_dir_if_exists),
         distributed=ns.distributed,
+        model_shards=ns.model_shards,
         coordinator_address=ns.coordinator_address,
         num_processes=ns.num_processes,
         process_id=ns.process_id,

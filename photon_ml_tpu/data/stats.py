@@ -42,6 +42,62 @@ class BasicStatisticalSummary(NamedTuple):
         return jnp.sqrt(self.variance)
 
 
+def sparse_moments(batch: SparseBatch, dim: int):
+    """Accumulable raw moments of one sparse batch: (n, s1, s2, l1, nnz,
+    mx, mn) with mx/mn over NONZERO entries only (+-inf when untouched).
+    Chunked/streaming summaries sum the first five and max/min the last
+    two across chunks, then call :func:`finalize_summary` ONCE — the
+    implicit-zero fold needs the global n and nnz."""
+    real = (batch.weights > 0).astype(jnp.float32)
+    n = jnp.sum(real)
+    flat_ix = batch.indices.reshape(-1)
+    row_real = jnp.repeat(real, batch.indices.shape[1])
+    v = batch.values.reshape(-1) * row_real
+    nz = ((batch.values.reshape(-1) != 0) & (row_real > 0)).astype(jnp.float32)
+    s1 = jnp.zeros((dim,), jnp.float32).at[flat_ix].add(v)
+    s2 = jnp.zeros((dim,), jnp.float32).at[flat_ix].add(v * v)
+    l1 = jnp.zeros((dim,), jnp.float32).at[flat_ix].add(jnp.abs(v))
+    nnz = jnp.zeros((dim,), jnp.float32).at[flat_ix].add(nz)
+    # Per-feature max/min over NONZERO entries (padding slots carry
+    # index 0 / value 0 and must not pollute feature 0).
+    big = jnp.float32(jnp.inf)
+    nonzero_slot = (row_real > 0) & (batch.values.reshape(-1) != 0)
+    mx = jnp.full((dim,), -big).at[flat_ix].max(
+        jnp.where(nonzero_slot, batch.values.reshape(-1), -big)
+    )
+    mn = jnp.full((dim,), big).at[flat_ix].min(
+        jnp.where(nonzero_slot, batch.values.reshape(-1), big)
+    )
+    return n, s1, s2, l1, nnz, mx, mn
+
+
+def finalize_summary(n, s1, s2, l1, nnz, mx, mn) -> BasicStatisticalSummary:
+    """Raw (possibly chunk-accumulated) moments -> summary, with the
+    implicit-zero fold (zeros — explicit or implicit — enter max/min via
+    the nnz < n test, contributing the same 0) and the NaN-variance
+    repair of BasicStatisticalSummary.scala:94-120."""
+    has_implicit_zero = nnz < n
+    mx = jnp.where(has_implicit_zero, jnp.maximum(mx, 0.0), mx)
+    mn = jnp.where(has_implicit_zero, jnp.minimum(mn, 0.0), mn)
+    mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+    mn = jnp.where(jnp.isfinite(mn), mn, 0.0)
+    safe_n = jnp.maximum(n, 1.0)
+    mean = s1 / safe_n
+    var = (s2 - safe_n * mean * mean) / jnp.maximum(safe_n - 1.0, 1.0)
+    var = jnp.where(jnp.isfinite(var) & (var >= 0), var, 1.0)
+    return BasicStatisticalSummary(
+        mean=mean,
+        variance=var,
+        count=n,
+        num_nonzeros=nnz,
+        max=mx,
+        min=mn,
+        norm_l1=l1,
+        norm_l2=jnp.sqrt(s2),
+        mean_abs=l1 / safe_n,
+    )
+
+
 def compute_summary(batch: Batch, dim: int) -> BasicStatisticalSummary:
     """colStats analog. Implicit zeros count toward mean/variance/min/max
     exactly as in MLlib's sparse colStats."""
@@ -49,30 +105,7 @@ def compute_summary(batch: Batch, dim: int) -> BasicStatisticalSummary:
     n = jnp.sum(real)
 
     if isinstance(batch, SparseBatch):
-        flat_ix = batch.indices.reshape(-1)
-        row_real = jnp.repeat(real, batch.indices.shape[1])
-        v = batch.values.reshape(-1) * row_real
-        nz = ((batch.values.reshape(-1) != 0) & (row_real > 0)).astype(jnp.float32)
-        s1 = jnp.zeros((dim,), jnp.float32).at[flat_ix].add(v)
-        s2 = jnp.zeros((dim,), jnp.float32).at[flat_ix].add(v * v)
-        l1 = jnp.zeros((dim,), jnp.float32).at[flat_ix].add(jnp.abs(v))
-        nnz = jnp.zeros((dim,), jnp.float32).at[flat_ix].add(nz)
-        # Per-feature max/min over NONZERO entries (padding slots carry
-        # index 0 / value 0 and must not pollute feature 0); zeros — explicit
-        # or implicit — fold in via the nnz < n test, contributing the same 0.
-        big = jnp.float32(jnp.inf)
-        nonzero_slot = (row_real > 0) & (batch.values.reshape(-1) != 0)
-        mx = jnp.full((dim,), -big).at[flat_ix].max(
-            jnp.where(nonzero_slot, batch.values.reshape(-1), -big)
-        )
-        mn = jnp.full((dim,), big).at[flat_ix].min(
-            jnp.where(nonzero_slot, batch.values.reshape(-1), big)
-        )
-        has_implicit_zero = nnz < n
-        mx = jnp.where(has_implicit_zero, jnp.maximum(mx, 0.0), mx)
-        mn = jnp.where(has_implicit_zero, jnp.minimum(mn, 0.0), mn)
-        mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
-        mn = jnp.where(jnp.isfinite(mn), mn, 0.0)
+        return finalize_summary(*sparse_moments(batch, dim))
     else:
         f = batch.features * real[:, None]
         s1 = jnp.sum(f, axis=0)

@@ -41,7 +41,21 @@ def run_glm_diagnostics(driver) -> None:
     p = driver.params
     data = driver._data
     summary = driver._summary
-    batch = data.batch
+    # Streaming runs carry no in-memory train batch; row-level sections
+    # (calibration/Kendall fallback, bootstrap, fitting curves) run on
+    # the bounded uniform reservoir sample collected during the
+    # streamed-summary pass instead — the bounded-memory stand-in for
+    # the reference's RDD-wide diagnose passes (Driver.scala:525-552).
+    batch = (
+        data.batch
+        if data.batch is not None
+        else getattr(driver, "_stream_sample", None)
+    )
+    if batch is None:
+        raise ValueError(
+            "diagnostics need an in-memory batch or a streamed reservoir "
+            "sample; run preprocess() with a diagnostic mode set"
+        )
     vdata = getattr(driver, "_validation_data", None)
     doc = Document(title=f"Photon ML TPU diagnostics — {p.job_name}")
 

@@ -67,7 +67,18 @@ class Coordinate:
 
 @dataclass
 class FixedEffectCoordinate(Coordinate):
-    """Global GLM block (FixedEffectCoordinate.scala:137-164)."""
+    """Global GLM block (FixedEffectCoordinate.scala:137-164).
+
+    With a 2-D (data, model) ``mesh`` the solve runs FEATURE-SHARDED:
+    the coefficient vector splits over the model axis and the existing
+    sparse/tiled feature-sharded fits (incl. TRON) run inside the GAME
+    coordinate descent — the reference's whole scale story is the GAME
+    fixed effect at huge dimension (treeAggregate depth valve at >=200k
+    features, cli/game/training/Driver.scala:357-363,717-719; "hundreds
+    of billions of coefficients", README.md:73). The sharded layout is
+    built once and reused across CD iterations — only the offsets (the
+    residual currency) are re-placed per sweep.
+    """
 
     name: str
     dataset: GameDataset
@@ -77,7 +88,8 @@ class FixedEffectCoordinate(Coordinate):
     down_sampling_rate: float = 1.0
     sampler_seed: int = 0
     # data-parallel mesh for the global solve (FixedEffectCoordinate runs
-    # distributed by construction in the reference; None = single device)
+    # distributed by construction in the reference; None = single device).
+    # A mesh carrying a "model" axis selects the feature-sharded solve.
     mesh: Optional[object] = None
 
     def initialize_model(self) -> FixedEffectModel:
@@ -95,7 +107,17 @@ class FixedEffectCoordinate(Coordinate):
             offsets = jnp.asarray(offsets) + residual
         return self.dataset.batch_for_shard(self.feature_shard_id, offsets)
 
+    def _is_feature_sharded(self) -> bool:
+        from photon_ml_tpu.parallel.mesh import MODEL_AXIS
+
+        return (
+            self.mesh is not None
+            and MODEL_AXIS in getattr(self.mesh, "axis_names", ())
+        )
+
     def update_model(self, model, residual=None):
+        if self._is_feature_sharded():
+            return self._update_model_feature_sharded(model, residual)
         batch = self._batch(residual)
         initial = model.model.means if model is not None else None
         if self.down_sampling_rate < 1.0:
@@ -112,6 +134,143 @@ class FixedEffectCoordinate(Coordinate):
                 batch, initial=initial, reg_weight=self.reg_weight,
                 mesh=self.mesh,
             )
+        return (
+            FixedEffectModel(
+                self.problem.create_model(coefficients), self.feature_shard_id
+            ),
+            result,
+        )
+
+    # -- feature-sharded solve (2-D mesh) ----------------------------------
+
+    def _feature_sharded_state(self):
+        """Build-once layout + jitted fit for the (data, model) mesh.
+
+        The sharded batch STRUCTURE (entry routing, tile schedules) only
+        depends on indices/values/weights — fixed across CD iterations —
+        so it is cached on the coordinate; per update only the offsets
+        (residual currency) are re-padded and re-placed (the same
+        device-resident KeyValueScore contract as batch_for_shard)."""
+        state = self.__dict__.get("_fs_state")
+        if state is not None:
+            return state
+        from photon_ml_tpu.ops.tiled_sparse import (
+            TiledGLMObjective,
+            feature_shard_tiled_batch,
+        )
+        from photon_ml_tpu.optim.config import OptimizerType
+        from photon_ml_tpu.parallel.distributed import (
+            feature_shard_sparse_batch,
+            feature_sharded_glm_fit,
+            feature_sharded_hessian_diagonal,
+        )
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+        problem = self.problem
+        dim = self.dataset.shards[self.feature_shard_id].dim
+        base = self.dataset.batch_for_shard(self.feature_shard_id)
+        host = jax.device_get(base)
+        data_shards = int(self.mesh.shape[DATA_AXIS])
+        model_shards = int(self.mesh.shape[MODEL_AXIS])
+        tiled = isinstance(problem.objective, TiledGLMObjective)
+        if tiled:
+            sharded, block_dim = feature_shard_tiled_batch(
+                host, dim, data_shards, model_shards, mesh=self.mesh
+            )
+            meta, layout = sharded.meta, "tiled"
+            rows_total = meta.data_shards * meta.rows_per_shard
+        else:
+            sharded, block_dim = feature_shard_sparse_batch(
+                host, dim, model_shards, rows_multiple=data_shards
+            )
+            meta, layout = None, "sparse"
+            rows_total = sharded.labels.shape[0]
+        use_tron = problem.config.optimizer_type == OptimizerType.TRON
+        use_owlqn = problem.regularization.has_l1
+        norm = problem.objective.norm
+        d_pad = model_shards * block_dim
+        from photon_ml_tpu.parallel.distributed import (
+            feature_sharded_extras,
+        )
+
+        extras_tail, l1_mask, with_norm = feature_sharded_extras(
+            dim, d_pad, normalization=norm, box=problem.box,
+            use_owlqn=use_owlqn, intercept_index=problem.intercept_index,
+        )
+        fit = feature_sharded_glm_fit(
+            problem.objective, self.mesh, meta, layout=layout,
+            optimizer=(
+                "tron" if use_tron else ("owlqn" if use_owlqn else "lbfgs")
+            ),
+            max_iter=problem.config.max_iter,
+            tol=problem.config.tolerance,
+            history=problem.config.lbfgs_history,
+            max_cg=problem.config.tron_max_cg,
+            with_norm=with_norm, with_box=problem.box is not None,
+        )
+        hdiag = None
+        if problem.compute_variances:
+            hdiag = feature_sharded_hessian_diagonal(
+                problem.objective, self.mesh, meta, layout=layout,
+                with_norm=with_norm,
+            )
+        state = dict(
+            sharded=sharded, fit=fit, hdiag=hdiag, dim=dim, d_pad=d_pad,
+            rows_total=rows_total, use_owlqn=use_owlqn, l1_mask=l1_mask,
+            extras_tail=extras_tail, with_norm=with_norm,
+        )
+        self.__dict__["_fs_state"] = state
+        return state
+
+    def _update_model_feature_sharded(self, model, residual):
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS
+
+        if self.down_sampling_rate < 1.0:
+            raise NotImplementedError(
+                "down-sampling re-weights rows per iteration, which would "
+                "invalidate the cached feature-sharded layout; run the "
+                "fixed effect data-parallel (1-D mesh) when sampling"
+            )
+        st = self._feature_sharded_state()
+        offsets = jnp.asarray(self.dataset.offsets)
+        if residual is not None:
+            offsets = offsets + residual
+        n = offsets.shape[0]
+        if st["rows_total"] != n:
+            offsets = jnp.concatenate(
+                [offsets, jnp.zeros((st["rows_total"] - n,), jnp.float32)]
+            )
+        offsets = jax.device_put(
+            offsets, NamedSharding(self.mesh, P(DATA_AXIS))
+        )
+        sharded = st["sharded"]._replace(offsets=offsets)
+        st["sharded"] = sharded  # keep the freshest placement cached
+
+        initial = model.model.means if model is not None else None
+        w0 = jnp.zeros((st["d_pad"],), jnp.float32)
+        if initial is not None:
+            w0 = w0.at[: initial.shape[0]].set(initial)
+        l1, l2 = self.problem.regularization.split(self.reg_weight)
+        extras = (
+            [jnp.float32(l1), st["l1_mask"]] if st["use_owlqn"] else []
+        ) + st["extras_tail"]
+        result = st["fit"](w0, sharded, jnp.float32(l2), *extras)
+        variances = None
+        if st["hdiag"] is not None:
+            from photon_ml_tpu.optim.problem import _VARIANCE_EPSILON
+
+            norm_extras = st["extras_tail"][:2] if st["with_norm"] else []
+            hd = st["hdiag"](
+                result.coefficients, sharded, jnp.float32(l2), *norm_extras
+            )
+            variances = (1.0 / (hd + _VARIANCE_EPSILON))[: st["dim"]]
+        coefficients = Coefficients(
+            result.coefficients[: st["dim"]], variances
+        )
+        result = result._replace(coefficients=coefficients.means)
         return (
             FixedEffectModel(
                 self.problem.create_model(coefficients), self.feature_shard_id
@@ -549,6 +708,18 @@ class MatrixFactorizationCoordinate(Coordinate):
         # dataset offsets — passing residual_offsets would re-gather and
         # re-upload [E, S] offsets per bucket every half-step for nothing
         offsets_arg = None if residual is None else offsets_np
+        if not self.__dict__.get("_als_prewarmed"):
+            # cold start: AOT-compile BOTH sides' bucket programs in one
+            # threaded pool before the first half-step — per-side warming
+            # serialized the col side's compiles behind the row solves
+            # (and skipped single-bucket sides entirely)
+            row_view, _ = self._side_structure("row", rows, cols, R)
+            col_view, _ = self._side_structure("col", cols, rows, C)
+            self.problem.prewarm([
+                (row_latent, row_view, True, offsets_arg is not None),
+                (col_latent, col_view, True, offsets_arg is not None),
+            ])
+            self.__dict__["_als_prewarmed"] = True
         for _ in range(self.num_inner_iterations):
             row_latent = self._als_side(
                 "row", rows, cols, col_latent, row_latent, offsets_arg, R

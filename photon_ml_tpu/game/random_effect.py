@@ -637,6 +637,79 @@ class RandomEffectOptimizationProblem:
             rows_d >= 0, residual_offsets[jnp.maximum(rows_d, 0)], 0.0
         )
 
+    def _bucket_plans(
+        self,
+        bank: Array,
+        dataset: RandomEffectDataset,
+        *,
+        has_values_override: bool,
+        has_residual_offsets: bool,
+        l1_d,
+        l2_d,
+    ):
+        """(sig, thunk) plans for every DISTINCT bucket program of one
+        dataset; ``thunk()`` lowers the bucket's exact solver call and
+        returns the compiled executable."""
+        plans = []
+        seen_sigs = set()
+        for bi, bucket in enumerate(dataset.buckets):
+            kind = self._bucket_kind(bucket, bank.shape[1])
+            sig = (kind, bank.shape, bucket.indices.shape)
+            if sig in seen_sigs:
+                continue
+            seen_sigs.add(sig)
+
+            def thunk(bi=bi, bucket=bucket, kind=kind, bank=bank):
+                (
+                    ix_d, v_d, lab_d, w_d, off_d, rows_d, codes_d,
+                ) = self._bucket_device_args(
+                    bucket, with_values=not has_values_override
+                )
+                # COMPUTED operands (override gathers, residual
+                # offsets) lower from avals only — materializing them
+                # here would run every bucket's partner gather
+                # concurrently and break the one-bucket HBM cap the
+                # deferred values_override exists for
+                if has_values_override:
+                    k_dim = bucket.indices.shape[-1]
+                    v_d = jax.ShapeDtypeStruct(
+                        bucket.indices.shape[:2] + (k_dim,), jnp.float32
+                    )
+                if has_residual_offsets:
+                    off_d = jax.ShapeDtypeStruct(
+                        bucket.offsets.shape, jnp.float32
+                    )
+                fused = getattr(self._solvers, f"fused_{kind}")
+                # lowering never executes; the loop calls the result
+                return fused.lower(
+                    bank, codes_d, ix_d, v_d, lab_d, off_d, w_d,
+                    l1_d, l2_d,
+                ).compile()
+
+            plans.append((sig, thunk))
+        return plans
+
+    def prewarm(self, specs) -> None:
+        """AOT-compile the bucket programs of SEVERAL (bank, dataset,
+        has_values_override, has_residual_offsets) quadruples in ONE
+        threaded pool. The MF coordinate calls this before its first ALS
+        half-step so BOTH sides' programs — including single-bucket sides
+        that per-side warming used to skip — compile concurrently over
+        the relay instead of serializing across half-steps."""
+        if self.mesh is not None:
+            return
+        l1, l2 = self.regularization.split(self.reg_weight)
+        l1_d, l2_d = jnp.float32(l1), jnp.float32(l2)
+        plans = []
+        for bank, dataset, has_override, has_resid in specs:
+            plans += self._bucket_plans(
+                bank, dataset,
+                has_values_override=has_override,
+                has_residual_offsets=has_resid,
+                l1_d=l1_d, l2_d=l2_d,
+            )
+        self._warm_solvers(plans)
+
     def _warm_solvers(self, plans) -> None:
         """AOT-compile each distinct bucket program from its own thread so
         the relay compiles them CONCURRENTLY. The async jit-call path
@@ -646,16 +719,15 @@ class RandomEffectOptimizationProblem:
         four); the persistent XLA cache never sees relay compiles, so
         this is the only cold-start lever. Compiled executables land in
         ``_aot_cache`` and the bucket loop calls them instead of the jit
-        wrapper.
-
-        ``plans``: list of (sig, thunk) where ``thunk()`` lowers the
-        bucket's exact solver call and returns the compiled object."""
+        wrapper. Single fresh programs AOT-compile too (round-5: the
+        jit-call path's compile is slower over the relay even alone, and
+        single-bucket MF sides used to skip the pool entirely)."""
         from concurrent.futures import ThreadPoolExecutor
 
         fresh = [
             (sig, thunk) for sig, thunk in plans if sig not in self._aot_cache
         ]
-        if len(fresh) <= 1:
+        if not fresh:
             return
         with ThreadPoolExecutor(min(8, len(fresh))) as pool:
             compiled = list(pool.map(lambda item: item[1](), fresh))
@@ -702,45 +774,13 @@ class RandomEffectOptimizationProblem:
         var_bank = jnp.zeros_like(bank) if with_variances else None
         if with_variances:
             from photon_ml_tpu.optim.problem import _VARIANCE_EPSILON
-        if self.mesh is None and len(dataset.buckets) > 1:
-            plans = []
-            seen_sigs = set()
-            for bi, bucket in enumerate(dataset.buckets):
-                kind = self._bucket_kind(bucket, bank.shape[1])
-                sig = (kind, bank.shape, bucket.indices.shape)
-                if sig in seen_sigs:
-                    continue
-                seen_sigs.add(sig)
-
-                def thunk(bi=bi, bucket=bucket, kind=kind):
-                    (
-                        ix_d, v_d, lab_d, w_d, off_d, rows_d, codes_d,
-                    ) = self._bucket_device_args(
-                        bucket, with_values=values_override is None
-                    )
-                    # COMPUTED operands (override gathers, residual
-                    # offsets) lower from avals only — materializing them
-                    # here would run every bucket's partner gather
-                    # concurrently and break the one-bucket HBM cap the
-                    # deferred values_override exists for
-                    if values_override is not None:
-                        k_dim = bucket.indices.shape[-1]
-                        v_d = jax.ShapeDtypeStruct(
-                            bucket.indices.shape[:2] + (k_dim,), jnp.float32
-                        )
-                    if residual_offsets is not None:
-                        off_d = jax.ShapeDtypeStruct(
-                            bucket.offsets.shape, jnp.float32
-                        )
-                    fused = getattr(self._solvers, f"fused_{kind}")
-                    # lowering never executes; the loop calls the result
-                    return fused.lower(
-                        bank, codes_d, ix_d, v_d, lab_d, off_d, w_d,
-                        l1_d, l2_d,
-                    ).compile()
-
-                plans.append((sig, thunk))
-            self._warm_solvers(plans)
+        if self.mesh is None and dataset.buckets:
+            self._warm_solvers(self._bucket_plans(
+                bank, dataset,
+                has_values_override=values_override is not None,
+                has_residual_offsets=residual_offsets is not None,
+                l1_d=l1_d, l2_d=l2_d,
+            ))
         for bi, bucket in enumerate(dataset.buckets):
             (
                 ix_d, v_d, lab_d, w_d, off_d, rows_d, codes_d,
@@ -932,4 +972,14 @@ def dryrun_entity_bank(mesh) -> None:
         jax.device_put(jnp.ones((E, S), jnp.float32), sharding),
     )
     new_bank, iters, reasons = solver(bank, *args, jnp.float32(0.0), jnp.float32(0.1))
-    assert bool(jnp.all(jnp.isfinite(new_bank)))
+    # numeric oracle, not just finiteness: the sharded solve must equal
+    # the same solver on unsharded (single-device) arrays
+    host_args = tuple(jax.device_get(a) for a in args)
+    oracle_bank, _, _ = solver(
+        jnp.zeros((E, D), jnp.float32),
+        *(jnp.asarray(a) for a in host_args),
+        jnp.float32(0.0), jnp.float32(0.1),
+    )
+    np.testing.assert_allclose(
+        np.asarray(new_bank), np.asarray(oracle_bank), atol=5e-3
+    )

@@ -246,6 +246,111 @@ def _prefetched(source: Iterator, depth: int = 2) -> Iterator:
         stop.set()
 
 
+def streaming_summary(
+    paths,
+    fmt,
+    index_map: IndexMap,
+    stats: StreamStats,
+    *,
+    rows_per_chunk: int = 65536,
+    reservoir_rows: int = 0,
+    seed: int = 0,
+):
+    """One bounded-memory pass computing the FEATURE SUMMARY over a >RAM
+    stream (the colStats/summarization stage, BasicStatistics.scala:42 —
+    every reference driver stage is a pass over an RDD; this is that pass
+    over chunks), plus an optional uniform RESERVOIR SAMPLE of rows
+    returned as an in-memory SparseBatch (algorithm R over the stream) —
+    the bounded-memory stand-in for diagnostics stages that genuinely
+    need row-level resampling (bootstrap).
+
+    Returns ``(summary, sample_batch_or_None)``. Multi-host: moments
+    reduce across processes; the reservoir stays process-local (used only
+    by the coordinator's diagnostics).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.stats import finalize_summary, sparse_moments
+
+    dim = index_map.size
+    moments_fn = jax.jit(lambda b: sparse_moments(b, dim))
+    acc = None
+    K = int(reservoir_rows)
+    rng = np.random.default_rng(seed)
+    W = stats.max_nnz
+    res = (
+        {
+            "ix": np.zeros((K, W), np.int32),
+            "v": np.zeros((K, W), np.float32),
+            "lab": np.zeros(K, np.float32),
+            "off": np.zeros(K, np.float32),
+            "wgt": np.zeros(K, np.float32),
+        }
+        if K
+        else None
+    )
+    seen = 0
+    for chunk in iter_chunks(
+        paths, fmt, index_map, rows_per_chunk=rows_per_chunk, nnz_width=W
+    ):
+        m = moments_fn(chunk)
+        if acc is None:
+            acc = list(m)
+        else:
+            for i in range(5):  # n, s1, s2, l1, nnz are sums
+                acc[i] = acc[i] + m[i]
+            acc[5] = jnp.maximum(acc[5], m[5])
+            acc[6] = jnp.minimum(acc[6], m[6])
+        if res is not None:
+            wgt = np.asarray(chunk.weights)
+            real = np.nonzero(wgt > 0)[0]
+            ix_np = np.asarray(chunk.indices)
+            v_np = np.asarray(chunk.values)
+            lab_np = np.asarray(chunk.labels)
+            off_np = np.asarray(chunk.offsets)
+            for r in real:  # algorithm R, exact
+                seen += 1
+                if seen <= K:
+                    slot = seen - 1
+                elif rng.random() < K / seen:
+                    slot = rng.integers(0, K)
+                else:
+                    continue
+                res["ix"][slot] = ix_np[r]
+                res["v"][slot] = v_np[r]
+                res["lab"][slot] = lab_np[r]
+                res["off"][slot] = off_np[r]
+                res["wgt"][slot] = wgt[r]
+    if acc is None:
+        raise ValueError(f"no rows found under {paths!r}")
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        for i in range(5):
+            acc[i] = jnp.asarray(
+                multihost_utils.process_allgather(acc[i]).sum(axis=0)
+            )
+        acc[5] = jnp.asarray(
+            multihost_utils.process_allgather(acc[5]).max(axis=0)
+        )
+        acc[6] = jnp.asarray(
+            multihost_utils.process_allgather(acc[6]).min(axis=0)
+        )
+    summary = finalize_summary(*acc)
+    sample = None
+    if res is not None:
+        k_eff = min(seen, K)
+        sample = SparseBatch(
+            indices=jnp.asarray(res["ix"][:k_eff]),
+            values=jnp.asarray(res["v"][:k_eff]),
+            labels=jnp.asarray(res["lab"][:k_eff]),
+            offsets=jnp.asarray(res["off"][:k_eff]),
+            weights=jnp.asarray(res["wgt"][:k_eff]),
+        )
+    return summary, sample
+
+
 class _DiskChunkStore:
     """Fixed-shape staged chunks spilled to a local scratch directory —
     the disk half of Spark's persist(MEMORY_AND_DISK)
@@ -350,6 +455,19 @@ class StreamingGLMObjective:
     2..N never re-decodes Avro. ``cache_bytes=0`` disables caching (one
     decode pass per evaluation, the round-3 behavior); ``prefetch``
     decode-aheads one chunk on a worker thread.
+
+    FAST-KERNEL CACHED PATH (``kernel="auto"|"tiled"`` on TPU): staged
+    chunks have FIXED structure after the populate pass — exactly what
+    the tiled Pallas kernels' static schedules need — so once the cache
+    exists, per-chunk tile schedules are built ONCE (padded to one common
+    shape so a single compiled program serves every chunk) and evaluation
+    2..N dispatches the gather/scatter-free bilinear kernels
+    asynchronously chunk after chunk, accumulating on device. The
+    reference pays no kernel penalty for persisted-on-disk data
+    (GLMSuite.scala:98-131 + ValueAndGradientAggregator.scala:235-250);
+    after this, neither do we. Tiled chunks are device-resident up to
+    ``tiled_cache_bytes``; chunks past the budget stay on the scatter
+    partial.
     """
 
     def __init__(
@@ -364,6 +482,10 @@ class StreamingGLMObjective:
         cache_bytes: int = 2 << 30,
         prefetch: bool = True,
         spill_dir: Optional[str] = None,
+        kernel: str = "auto",
+        tiled_cache_bytes: int = 4 << 30,
+        tile_params=None,
+        norm=None,
     ):
         import jax
 
@@ -383,9 +505,151 @@ class StreamingGLMObjective:
         self._mem_cache: List[SparseBatch] = []
         self._disk_cache: Optional[_DiskChunkStore] = None
         self._cached = False
-        self._objective = GLMObjective(loss_for_task(task), self.dim)
+        from photon_ml_tpu.ops.normalization import identity_context
+
+        self._loss = loss_for_task(task)
+        self.norm = norm if norm is not None else identity_context()
+        self._objective = GLMObjective(self._loss, self.dim, self.norm)
         self._partial = jax.jit(
             lambda w, b: self._objective.value_and_gradient(w, b, 0.0)
+        )
+        if kernel not in ("auto", "tiled", "scatter"):
+            raise ValueError(f"unknown kernel {kernel!r}")
+        from photon_ml_tpu.utils.backend import effective_platform
+
+        self._use_tiled = kernel == "tiled" or (
+            kernel == "auto" and effective_platform() == "tpu"
+        )
+        self.tiled_cache_bytes = int(tiled_cache_bytes)
+        self.tile_params = tile_params
+        self._tiled_chunks: Optional[List] = None  # [TiledSparseBatch]
+        self._tiled_objective = None
+        self._tiled_partial = None
+
+    # -- tiled cached path --------------------------------------------------
+
+    def _build_tiled_chunks(self) -> None:
+        """Convert cached staged chunks to tiled batches, once.
+
+        Every chunk shares the staging shape [R, W], so all schedules are
+        padded to ONE static (steps, spill) shape — a single compiled
+        tiled program then serves the whole stream with no per-chunk
+        recompilation. Build cost is one pass of the native counting-sort
+        builder per chunk (threaded; structure is fixed for the rest of
+        training, the persisted-RDD analog)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        import jax
+
+        from photon_ml_tpu.ops import tiled_sparse as ts
+
+        params0 = self.tile_params or ts.TileParams()
+        win = params0.window
+        R = self.rows_per_chunk
+        r_pad = max(((R + win - 1) // win) * win, win)
+        d_pad = max(((self.dim + win - 1) // win) * win, win)
+        z_blocks, g_blocks = r_pad // win, d_pad // win
+
+        # ONE chunk at a time — the COO staging of a chunk is dropped
+        # before the next decodes, so host memory holds at most the KEPT
+        # schedules (bounded by tiled_cache_bytes) + one in-flight chunk;
+        # the >RAM streaming contract survives the fast-kernel upgrade.
+        params = None
+        built = []  # (z, g, lab, off, wgt) for kept chunks only
+        budget = self.tiled_cache_bytes
+        with ThreadPoolExecutor(2) as pool:
+            for batch in self.chunks():
+                rows, feats, vals, _n = ts._sparse_coo(batch)
+                if params is None:
+                    # chunks share the staging shape; the first chunk's
+                    # occupancy fixes the grid-step width for all
+                    params = params0.resolved(
+                        max(1, len(vals) // max(z_blocks * g_blocks, 1)),
+                        z_blocks * g_blocks,
+                    )
+                fz = pool.submit(
+                    ts._build_schedule_np, rows, feats, vals,
+                    params=params, sort_by_feature_block=False,
+                    num_out_blocks=z_blocks,
+                )
+                g = ts._build_schedule_np(
+                    rows, feats, vals, params=params,
+                    sort_by_feature_block=True, num_out_blocks=g_blocks,
+                )
+                z = fz.result()
+                del rows, feats, vals
+                nbytes = (
+                    sum(a.nbytes for a in z) + 2 * sum(a.nbytes for a in g)
+                )
+                if nbytes > budget:
+                    # remaining chunks stay on the scatter partial
+                    break
+                budget -= nbytes
+                built.append((
+                    z, g,
+                    np.asarray(batch.labels),
+                    np.asarray(batch.offsets),
+                    np.asarray(batch.weights),
+                ))
+        if not built:
+            self._tiled_chunks = []
+            return
+        # pad every kept schedule to ONE static shape so a single
+        # compiled program serves all chunks
+        gz = max(b[0][0].shape[0] for b in built)
+        gg = max(b[1][0].shape[0] for b in built)
+        sz = max(b[0][8].shape[0] for b in built)
+        sg = max(b[1][8].shape[0] for b in built)
+        meta = ts._TiledMeta(
+            params=params, num_rows=r_pad, dim=d_pad,
+            num_real_rows=R, real_dim=self.dim,
+        )
+        import jax.numpy as jnp
+
+        def pad_rows(a):
+            out = np.zeros(r_pad, np.float32)
+            out[: a.shape[0]] = a
+            return jnp.asarray(out)
+
+        tiled: List = []
+        for z, g, lab, off, wgt in built:
+            z = ts._pad_schedule_np(z, gz, z_blocks, sz)
+            g = ts._pad_schedule_np(g, gg, g_blocks, sg)
+            tiled.append(
+                ts.TiledSparseBatch(
+                    meta=meta,
+                    z_sched=ts._Schedule(*map(jnp.asarray, z)),
+                    g_sched=ts._Schedule(*map(jnp.asarray, g)),
+                    g_vals_sq=jnp.asarray(g[5] ** 2),
+                    labels=pad_rows(lab),
+                    offsets=pad_rows(off),
+                    weights=pad_rows(wgt),
+                )
+            )
+        from photon_ml_tpu.utils.backend import effective_platform
+
+        self._tiled_objective = ts.TiledGLMObjective(
+            self._loss, self.dim, self.norm,
+            interpret=effective_platform() == "cpu",
+        )
+        self._tiled_partial = jax.jit(
+            lambda w, tb: self._tiled_objective.value_and_gradient(w, tb, 0.0)
+        )
+        self._tiled_chunks = tiled
+
+    def _ensure_tiled(self) -> bool:
+        if not (self._use_tiled and self._cached):
+            return False
+        if self._tiled_chunks is None:
+            self._build_tiled_chunks()
+        return bool(self._tiled_chunks)
+
+    def _overflow_chunks(self) -> Iterator[SparseBatch]:
+        """Cached chunks past the tiled-cache budget (scatter fallback)."""
+        import itertools
+
+        yield from itertools.islice(
+            self.chunks(), len(self._tiled_chunks), None
         )
 
     def _chunk_nbytes(self) -> int:
@@ -430,16 +694,101 @@ class StreamingGLMObjective:
         self._disk_cache = disk
         self._cached = True
 
+    def _reduce_hosts(self, vec):
+        """Cross-host sum of a streamed partial (the treeAggregate combine
+        over DCN); no-op single-process."""
+        import jax
+        import jax.numpy as jnp
+
+        if jax.process_count() <= 1:
+            return vec
+        from jax.experimental import multihost_utils
+
+        return jnp.asarray(
+            multihost_utils.process_allgather(vec).sum(axis=0), jnp.float32
+        )
+
+    def hessian_vector(self, w, direction, l2_weight=0.0):
+        """Streamed H(w) @ d: one pass over the cached staged chunks —
+        the reference's exact second-order pattern (one cluster aggregate
+        per CG step, HessianVectorAggregator.scala:137-152). Rides the
+        tiled chunk cache when built."""
+        import jax
+        import jax.numpy as jnp
+
+        hv = jnp.zeros((self.dim,), jnp.float32)
+        if self._ensure_tiled():
+            if getattr(self, "_tiled_hv", None) is None:
+                obj = self._tiled_objective
+                self._tiled_hv = jax.jit(
+                    lambda w_, d_, tb: obj.hessian_vector(w_, d_, tb, 0.0)
+                )
+            for tb in self._tiled_chunks:
+                hv = hv + self._tiled_hv(w, direction, tb)
+            chunks = self._overflow_chunks()
+        else:
+            chunks = self.chunks()
+        if getattr(self, "_scatter_hv", None) is None:
+            self._scatter_hv = jax.jit(
+                lambda w_, d_, b: self._objective.hessian_vector(
+                    w_, d_, b, 0.0
+                )
+            )
+        for batch in chunks:
+            hv = hv + self._scatter_hv(w, direction, batch)
+        hv = self._reduce_hosts(hv)
+        return hv + l2_weight * direction
+
+    def hessian_diagonal(self, w, l2_weight=0.0):
+        """Streamed Hessian diagonal (the variance pass,
+        DistributedOptimizationProblem.scala:79-93): one pass over the
+        cached staged chunks."""
+        import jax
+        import jax.numpy as jnp
+
+        diag = jnp.zeros((self.dim,), jnp.float32)
+        if self._ensure_tiled():
+            if getattr(self, "_tiled_hd", None) is None:
+                obj = self._tiled_objective
+                self._tiled_hd = jax.jit(
+                    lambda w_, tb: obj.hessian_diagonal(w_, tb, 0.0)
+                )
+            for tb in self._tiled_chunks:
+                diag = diag + self._tiled_hd(w, tb)
+            chunks = self._overflow_chunks()
+        else:
+            chunks = self.chunks()
+        if getattr(self, "_scatter_hd", None) is None:
+            self._scatter_hd = jax.jit(
+                lambda w_, b: self._objective.hessian_diagonal(w_, b, 0.0)
+            )
+        for batch in chunks:
+            diag = diag + self._scatter_hd(w, batch)
+        return self._reduce_hosts(diag) + l2_weight
+
     def value_and_gradient(self, w, l2_weight=0.0):
         import jax
         import jax.numpy as jnp
 
         value = jnp.float32(0.0)
         grad = jnp.zeros((self.dim,), jnp.float32)
-        for batch in self.chunks():
-            v, g = self._partial(w, batch)
-            value = value + v
-            grad = grad + g
+        if self._ensure_tiled():
+            # cached fast path: one async tiled dispatch per chunk,
+            # accumulated on device (the caller's value readback is the
+            # only sync point — dispatches pipeline behind each other)
+            for tb in self._tiled_chunks:
+                v, g = self._tiled_partial(w, tb)
+                value = value + v
+                grad = grad + g
+            for batch in self._overflow_chunks():
+                v, g = self._partial(w, batch)
+                value = value + v
+                grad = grad + g
+        else:
+            for batch in self.chunks():
+                v, g = self._partial(w, batch)
+                value = value + v
+                grad = grad + g
         if jax.process_count() > 1:
             # cross-host reduction of the loss partials (the treeAggregate
             # combine step over DCN): each process streamed only ITS file

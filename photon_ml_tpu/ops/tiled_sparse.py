@@ -927,21 +927,31 @@ def feature_shard_tiled_batch(
 
 def tiled_block_local_vg(loss, batch: FeatureShardedTiledBatch,
                          data_axis: str, model_axis: str, l2,
-                         *, interpret: bool = False, mxu: str = "bf16x2w"):
+                         *, shift=None, factor=None,
+                         interpret: bool = False, mxu: str = "bf16x2w"):
     """Block-local (value, grad) closure over ONE device's cell of a
     FeatureShardedTiledBatch (call inside shard_map). The distributed.py
-    fit entry points wrap this with the unmodified L-BFGS/OWL-QN."""
+    fit entry points wrap this with the unmodified L-BFGS/OWL-QN.
+
+    ``shift``/``factor``: this feature block's slice of the lazy
+    normalization vectors (NormalizationContext.scala:119-157 applied
+    inside the aggregator): margins use w_eff = factor * w and subtract
+    the psum'd shift.w_eff scalar; gradients un-shift with the data-psum'd
+    prefactor — normalization shards trivially along the feature axis."""
     meta = batch.meta
     p = meta.params
     win = p.window
 
     def vg(w_block):
-        w2d = w_block.reshape((meta.block_dim // win, p.s_hi, p.s_lo))
+        w_eff = w_block if factor is None else w_block * factor
+        w2d = w_eff.reshape((meta.block_dim // win, p.s_hi, p.s_lo))
         z_partial = _run_bilinear_pass(
             batch.z_sched, w2d, meta.rows_per_shard // win, p,
             interpret=interpret, mxu=mxu,
         ).reshape(-1)
-        z_partial = batch.z_sched.apply_spill(z_partial, w_block)
+        z_partial = batch.z_sched.apply_spill(z_partial, w_eff)
+        if shift is not None:
+            z_partial = z_partial - jnp.vdot(shift, w_eff)
         z = jax.lax.psum(z_partial, model_axis) + batch.offsets
         c = batch.weights * loss.d1(z, batch.labels)
         value = jax.lax.psum(
@@ -954,6 +964,12 @@ def tiled_block_local_vg(loss, batch: FeatureShardedTiledBatch,
         ).reshape(-1)
         g_local = batch.g_sched.apply_spill(g_local, c)
         grad_block = jax.lax.psum(g_local, data_axis)
+        if shift is not None or factor is not None:
+            prefactor = jax.lax.psum(jnp.sum(c), data_axis)
+            if shift is not None:
+                grad_block = grad_block - shift * prefactor
+            if factor is not None:
+                grad_block = grad_block * factor
         w_sq = jax.lax.psum(jnp.vdot(w_block, w_block), model_axis)
         return value + 0.5 * l2 * w_sq, grad_block + l2 * w_block
 
@@ -963,7 +979,8 @@ def tiled_block_local_vg(loss, batch: FeatureShardedTiledBatch,
 def tiled_block_local_hvp_factory(
     loss, batch: FeatureShardedTiledBatch,
     data_axis: str, model_axis: str, l2,
-    *, interpret: bool = False, mxu: str = "bf16x2w",
+    *, shift=None, factor=None,
+    interpret: bool = False, mxu: str = "bf16x2w",
 ):
     """Block-local Hessian-vector FACTORY over one device's cell of a
     FeatureShardedTiledBatch (call inside shard_map) — the tiled twin of
@@ -980,19 +997,28 @@ def tiled_block_local_hvp_factory(
     win = p.window
 
     def _z(x_block):
+        # x_block is already in EFFECTIVE space (callers apply factor);
+        # the shift correction is one block-local scalar folded into the
+        # model-axis psum
         x2d = x_block.reshape((meta.block_dim // win, p.s_hi, p.s_lo))
         part = _run_bilinear_pass(
             batch.z_sched, x2d, meta.rows_per_shard // win, p,
             interpret=interpret, mxu=mxu,
         ).reshape(-1)
-        return batch.z_sched.apply_spill(part, x_block)
+        part = batch.z_sched.apply_spill(part, x_block)
+        if shift is not None:
+            part = part - jnp.vdot(shift, x_block)
+        return part
+
+    def _eff(x_block):
+        return x_block if factor is None else x_block * factor
 
     def factory(w_block):
-        z = jax.lax.psum(_z(w_block), model_axis) + batch.offsets
+        z = jax.lax.psum(_z(_eff(w_block)), model_axis) + batch.offsets
         d2c = batch.weights * loss.d2(z, batch.labels)
 
         def hvp(d_block):
-            zd = jax.lax.psum(_z(d_block), model_axis)
+            zd = jax.lax.psum(_z(_eff(d_block)), model_axis)
             c = d2c * zd
             c2d = c.reshape((meta.rows_per_shard // win, p.s_hi, p.s_lo))
             h_local = _run_bilinear_pass(
@@ -1000,11 +1026,72 @@ def tiled_block_local_hvp_factory(
                 interpret=interpret, mxu=mxu,
             ).reshape(-1)
             h_local = batch.g_sched.apply_spill(h_local, c)
-            return jax.lax.psum(h_local, data_axis) + l2 * d_block
+            h_block = jax.lax.psum(h_local, data_axis)
+            if shift is not None or factor is not None:
+                prefactor = jax.lax.psum(jnp.sum(c), data_axis)
+                if shift is not None:
+                    h_block = h_block - shift * prefactor
+                if factor is not None:
+                    h_block = h_block * factor
+            return h_block + l2 * d_block
 
         return hvp
 
     return factory
+
+
+def tiled_block_local_hdiag(
+    loss, batch: FeatureShardedTiledBatch,
+    data_axis: str, model_axis: str, l2,
+    *, shift=None, factor=None,
+    interpret: bool = False, mxu: str = "bf16x2w",
+):
+    """Block-local Hessian-DIAGONAL closure over one device's cell — the
+    variance computation of DistributedOptimizationProblem.scala:79-93 on
+    the feature-sharded layout. Hdiag is block-local by construction
+    (diag_j only touches feature j's entries), so it shards trivially:
+    one g-pass with squared values psum'd over "data" (plus the S1/S0
+    shifted-space terms when normalization is active)."""
+    meta = batch.meta
+    p = meta.params
+    win = p.window
+
+    def hdiag(w_block):
+        w_eff = w_block if factor is None else w_block * factor
+        w2d = w_eff.reshape((meta.block_dim // win, p.s_hi, p.s_lo))
+        z_partial = _run_bilinear_pass(
+            batch.z_sched, w2d, meta.rows_per_shard // win, p,
+            interpret=interpret, mxu=mxu,
+        ).reshape(-1)
+        z_partial = batch.z_sched.apply_spill(z_partial, w_eff)
+        if shift is not None:
+            z_partial = z_partial - jnp.vdot(shift, w_eff)
+        z = jax.lax.psum(z_partial, model_axis) + batch.offsets
+        c = batch.weights * loss.d2(z, batch.labels)
+        c2d = c.reshape((meta.rows_per_shard // win, p.s_hi, p.s_lo))
+
+        def g_pass(vals, spill_vals):
+            out = _run_bilinear_pass(
+                batch.g_sched, c2d, meta.block_dim // win, p,
+                vals=vals, interpret=interpret, mxu=mxu,
+            ).reshape(-1)
+            return batch.g_sched.apply_spill(out, c, vals=spill_vals)
+
+        s2 = jax.lax.psum(
+            g_pass(batch.g_sched.vals**2, batch.g_sched.spill_vals**2),
+            data_axis,
+        )
+        if shift is not None:
+            s1 = jax.lax.psum(g_pass(None, None), data_axis)
+            s0 = jax.lax.psum(jnp.sum(c), data_axis)
+            diag = s2 - 2.0 * shift * s1 + (shift**2) * s0
+        else:
+            diag = s2
+        if factor is not None:
+            diag = diag * factor**2
+        return diag + l2
+
+    return hdiag
 
 
 def _place_data_sharded(batch: TiledSparseBatch, mesh, axis: str):
@@ -1022,6 +1109,48 @@ def _place_data_sharded(batch: TiledSparseBatch, mesh, axis: str):
 # batch in HBM.
 _SHARDED_CACHE: dict = {}
 _SHARDED_CACHE_MAX = 2
+
+
+def ensure_tiled(
+    batch,
+    dim: int,
+    *,
+    params: Optional[TileParams] = None,
+) -> TiledSparseBatch:
+    """Idempotent single-device tiled conversion with the same
+    identity-keyed cache as ensure_tiled_sharded: a SparseBatch sharing
+    indices/values/weights with a previous call (the GAME coordinate-
+    descent pattern — only offsets change between sweeps) reuses the
+    cached schedules and only re-pads the row metadata."""
+    if isinstance(batch, TiledSparseBatch):
+        return batch
+    key = (
+        id(batch.indices), id(batch.values), id(batch.weights),
+        dim, None, None, None, params,
+    )
+    hit = _SHARDED_CACHE.get(key)
+    if hit is not None:
+        (ix_ref, v_ref, w_ref), cached = hit
+        if (
+            ix_ref is batch.indices
+            and v_ref is batch.values
+            and w_ref is batch.weights
+        ):
+            meta = cached.meta
+            lab, off, wgt = _padded_row_meta(
+                batch, meta.num_rows, meta.num_real_rows
+            )
+            return cached._replace(labels=lab, offsets=off, weights=wgt)
+        del _SHARDED_CACHE[key]
+    out = tiled_batch_from_sparse(
+        batch, dim, params=params or TileParams()
+    )
+    while len(_SHARDED_CACHE) >= _SHARDED_CACHE_MAX:
+        _SHARDED_CACHE.pop(next(iter(_SHARDED_CACHE)))
+    _SHARDED_CACHE[key] = (
+        (batch.indices, batch.values, batch.weights), out,
+    )
+    return out
 
 
 def ensure_tiled_sharded(

@@ -19,6 +19,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from photon_ml_tpu.optim.common import (
+    BoxConstraints,
     GRADIENT_WITHIN_TOLERANCE,
     LINE_SEARCH_STALLED,
     NOT_CONVERGED,
@@ -59,19 +60,29 @@ def minimize_lbfgs_host(
     max_iter: int = 100,
     tol: float = 1e-7,
     history: int = 10,
+    box: Optional[BoxConstraints] = None,
     ls_max_steps: int = 24,
     ls_c1: float = 1e-4,
     ls_shrink: float = 0.5,
+    track_coefficients: bool = False,
 ) -> OptResult:
     """Minimize a smooth objective whose evaluations run host-side code.
 
     Same defaults and convergence semantics as minimize_lbfgs
-    (LBFGS.scala:152-156; Optimizer.scala:156-170)."""
+    (LBFGS.scala:152-156; Optimizer.scala:156-170), including the
+    hypercube projection of trial points (LBFGS.scala:77) when ``box``
+    is given and the per-iteration coefficient stack (ModelTracker
+    analog) when ``track_coefficients``."""
     w = jnp.asarray(w0, jnp.float32)
+    if box is not None:
+        w = box.project(w)
     f, g = value_and_grad_fn(w)
     f0 = float(f)
     g0_norm = float(jnp.linalg.norm(g))
-    tracker = Tracker.create(max_iter + 1).record(f, jnp.linalg.norm(g))
+    tracker = Tracker.create(
+        max_iter + 1,
+        coef_dim=w.shape[0] if track_coefficients else None,
+    ).record(f, jnp.linalg.norm(g), w if track_coefficients else None)
 
     s_list: List[Array] = []
     y_list: List[Array] = []
@@ -89,6 +100,8 @@ def minimize_lbfgs_host(
         f_new, g_new, w_new = f, g, w
         for _ in range(ls_max_steps):
             w_t = w + t * d
+            if box is not None:
+                w_t = box.project(w_t)
             f_t, g_t = value_and_grad_fn(w_t)
             if float(f_t) <= float(f) + ls_c1 * t * gd and bool(
                 jnp.isfinite(f_t)
@@ -114,7 +127,9 @@ def minimize_lbfgs_host(
                 max_iter=max_iter, tol=tol,
             ))
             w, f, g = w_new, f_new, g_new
-            tracker = tracker.record(f, jnp.float32(g_norm))
+            tracker = tracker.record(
+                f, jnp.float32(g_norm), w if track_coefficients else None
+            )
         else:
             # stalled line search: no decreasing step exists from here —
             # report it as such, not as an iteration-cap stop
@@ -138,9 +153,11 @@ def minimize_owlqn_host(
     tol: float = 1e-7,
     history: int = 10,
     l1_mask: Optional[Array] = None,
+    box: Optional[BoxConstraints] = None,
     ls_max_steps: int = 24,
     ls_c1: float = 1e-4,
     ls_shrink: float = 0.5,
+    track_coefficients: bool = False,
 ) -> OptResult:
     """Host-driven OWL-QN: minimize smooth(w) + l1 * ||w||_1 where each
     smooth evaluation runs host-side code (the streaming >RAM path's
@@ -152,6 +169,8 @@ def minimize_owlqn_host(
     from photon_ml_tpu.optim.lbfgs import _pseudo_gradient
 
     w = jnp.asarray(w0, jnp.float32)
+    if box is not None:
+        w = box.project(w)
     l1_vec = jnp.float32(l1_weight) * (
         jnp.ones_like(w) if l1_mask is None else jnp.asarray(l1_mask)
     )
@@ -164,8 +183,12 @@ def minimize_owlqn_host(
     f_tot = total(w, f_s)
     f0 = f_tot
     g0_norm = float(jnp.linalg.norm(pg))
-    tracker = Tracker.create(max_iter + 1).record(
-        jnp.float32(f_tot), jnp.float32(g0_norm)
+    tracker = Tracker.create(
+        max_iter + 1,
+        coef_dim=w.shape[0] if track_coefficients else None,
+    ).record(
+        jnp.float32(f_tot), jnp.float32(g0_norm),
+        w if track_coefficients else None,
     )
 
     s_list: List[Array] = []
@@ -187,6 +210,11 @@ def minimize_owlqn_host(
         w_new, f_new_tot, g_new = w, f_tot, g
         for _ in range(ls_max_steps):
             w_t = jnp.where(jnp.sign(w + t * d) == orthant, w + t * d, 0.0)
+            if box is not None:
+                # hypercube projection AFTER the orthant projection, the
+                # inherited LBFGS.scala:77 semantics (same as the in-jit
+                # minimize_owlqn)
+                w_t = box.project(w_t)
             f_t_s, g_t = value_and_grad_fn(w_t)
             f_t_tot = total(w_t, f_t_s)
             # Armijo on the projected point against the pseudo-gradient
@@ -216,7 +244,8 @@ def minimize_owlqn_host(
             ))
             w, f_tot, g = w_new, f_new_tot, g_new
             tracker = tracker.record(
-                jnp.float32(f_tot), jnp.float32(pg_norm)
+                jnp.float32(f_tot), jnp.float32(pg_norm),
+                w if track_coefficients else None,
             )
         else:
             reason = LINE_SEARCH_STALLED

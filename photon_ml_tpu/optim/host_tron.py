@@ -1,0 +1,164 @@
+"""Host-driven TRON for objectives that cannot be traced into jit.
+
+The in-jit optimizer (optim.tron.minimize_tron) compiles the whole
+trust-region while_loop — impossible when each (value, gradient) or
+Hessian-vector evaluation performs host IO (the streaming >RAM input
+path, io/streaming.py). This variant drives the SAME math from Python:
+LIBLINEAR eta/sigma trust-region rules, Steihaug truncated CG (<=20
+iterations, one streamed Hv pass per step — exactly the reference's
+one-cluster-aggregate-per-CG-step loop,
+HessianVectorAggregator.scala:137-152 + TRON.scala:259-341), and the
+shared convergence rules (Optimizer.scala:156-170).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from photon_ml_tpu.optim.common import (
+    BoxConstraints,
+    GRADIENT_WITHIN_TOLERANCE,
+    MAX_ITERATIONS,
+    NOT_CONVERGED,
+    OptResult,
+    Tracker,
+    check_convergence,
+)
+
+Array = jnp.ndarray
+ValueAndGrad = Callable[[Array], Tuple[Array, Array]]
+
+# LIBLINEAR trust-region constants (TRON.scala / tron.cpp) — identical to
+# optim.tron so the two drivers walk the same iterate sequence.
+_ETA0, _ETA1, _ETA2 = 1e-4, 0.25, 0.75
+_SIGMA1, _SIGMA2, _SIGMA3 = 0.25, 0.5, 4.0
+
+
+def _truncated_cg_host(hvp, g, delta, *, max_cg: int, cg_tol_factor=0.1):
+    """Steihaug truncated CG, host-driven: each iteration costs ONE hvp
+    call (= one streamed pass). Returns (s, r) with r = -g - H s, the
+    tron.cpp prered trick."""
+    cg_tol = cg_tol_factor * float(jnp.linalg.norm(g))
+    s = jnp.zeros_like(g)
+    r = -g
+    d = r
+    rtr = float(jnp.vdot(r, r))
+    for _ in range(max_cg):
+        if np.sqrt(rtr) <= cg_tol:
+            break
+        hd = hvp(d)
+        dhd = float(jnp.vdot(d, hd))
+        alpha = rtr / dhd if dhd > 0 else 0.0
+        s_new = s + alpha * d
+        hit = dhd <= 0 or float(jnp.linalg.norm(s_new)) >= delta
+        if hit:
+            # walk to the trust-region boundary and stop
+            dd = float(jnp.vdot(d, d))
+            sd = float(jnp.vdot(s, d))
+            ss = float(jnp.vdot(s, s))
+            rad = np.sqrt(max(sd * sd + dd * (delta * delta - ss), 0.0))
+            tau = (-sd + rad) / max(dd, 1e-30)
+            s = s + tau * d
+            r = r - tau * hd
+            break
+        s = s_new
+        r = r - alpha * hd
+        rtr_new = float(jnp.vdot(r, r))
+        beta = rtr_new / max(rtr, 1e-30)
+        d = r + beta * d
+        rtr = rtr_new
+    return s, r
+
+
+def minimize_tron_host(
+    value_and_grad_fn: ValueAndGrad,
+    hvp_fn: Callable[[Array, Array], Array],
+    w0: Array,
+    *,
+    max_iter: int = 15,
+    tol: float = 1e-5,
+    max_cg: int = 20,
+    max_improvement_failures: int = 16,
+    box: Optional[BoxConstraints] = None,
+    hvp_factory=None,
+    track_coefficients: bool = False,
+) -> OptResult:
+    """Trust-region Newton whose evaluations run host-side code.
+
+    ``hvp_fn(w, d) -> H(w) @ d``; ``hvp_factory(w) -> (d -> H(w) @ d)``
+    lets the caller cache the w-only pieces (margins, d2 coefficients)
+    once per outer iteration — with streamed data that saves one full
+    disk/cache pass per CG step. Defaults mirror TRON.scala:260-265."""
+    w = jnp.asarray(w0, jnp.float32)
+    if box is not None:
+        w = box.project(w)
+    f, g = value_and_grad_fn(w)
+    f0 = float(f)
+    g0_norm = float(jnp.linalg.norm(g))
+    delta = g0_norm
+    tracker = Tracker.create(
+        max_iter + 1,
+        coef_dim=w.shape[0] if track_coefficients else None,
+    ).record(f, jnp.float32(g0_norm), w if track_coefficients else None)
+    reason = (
+        GRADIENT_WITHIN_TOLERANCE if g0_norm == 0.0 else NOT_CONVERGED
+    )
+    it = 0
+    failures = 0
+    while reason == NOT_CONVERGED:
+        hvp = (
+            hvp_factory(w)
+            if hvp_factory is not None
+            else (lambda d, _w=w: hvp_fn(_w, d))
+        )
+        s, r = _truncated_cg_host(hvp, g, delta, max_cg=max_cg)
+        w_trial = w + s
+        if box is not None:
+            w_trial = box.project(w_trial)
+            s = w_trial - w
+        f_new, g_new = value_and_grad_fn(w_trial)
+        gs = float(jnp.vdot(g, s))
+        prered = -0.5 * (gs - float(jnp.vdot(s, r)))
+        actred = float(f) - float(f_new)
+        snorm = float(jnp.linalg.norm(s))
+
+        denom = float(f_new) - float(f) - gs
+        alpha = _SIGMA3 if denom <= 0 else max(_SIGMA1, -0.5 * (gs / denom))
+        if actred < _ETA0 * prered:
+            delta = min(max(alpha, _SIGMA1) * snorm, _SIGMA2 * delta)
+        elif actred < _ETA1 * prered:
+            delta = max(_SIGMA1 * delta, min(alpha * snorm, _SIGMA2 * delta))
+        elif actred < _ETA2 * prered:
+            delta = max(_SIGMA1 * delta, min(alpha * snorm, _SIGMA3 * delta))
+        else:
+            delta = max(delta, min(alpha * snorm, _SIGMA3 * delta))
+
+        accept = actred > _ETA0 * prered and np.isfinite(float(f_new))
+        it += 1
+        if accept:
+            failures = 0
+            g_norm = float(jnp.linalg.norm(g_new))
+            reason = int(check_convergence(
+                jnp.int32(it), f, f_new, jnp.float32(g_norm),
+                jnp.float32(f0), jnp.float32(g0_norm),
+                max_iter=max_iter, tol=tol,
+            ))
+            w, f, g = w_trial, f_new, g_new
+            tracker = tracker.record(
+                f, jnp.float32(g_norm), w if track_coefficients else None
+            )
+        else:
+            failures += 1
+            if it >= max_iter or failures >= max_improvement_failures:
+                reason = MAX_ITERATIONS
+    return OptResult(
+        coefficients=w,
+        value=jnp.float32(float(f)),
+        grad_norm=jnp.linalg.norm(g),
+        iterations=jnp.int32(it),
+        reason=jnp.int32(reason),
+        tracker=tracker,
+    )

@@ -184,6 +184,18 @@ class GLMOptimizationProblem:
         l1, l2 = self.regularization.split(reg_weight)
 
         if mesh is None:
+            from photon_ml_tpu.data.batch import SparseBatch
+            from photon_ml_tpu.ops.tiled_sparse import (
+                TiledGLMObjective,
+                ensure_tiled,
+            )
+
+            if isinstance(self.objective, TiledGLMObjective) and isinstance(
+                batch, SparseBatch
+            ):
+                # identity-cached conversion: a CD loop re-wrapping the
+                # same columns with fresh offsets reuses the schedules
+                batch = ensure_tiled(batch, self.objective.dim)
             fit = self._get_fit(track_models)
             result = fit(w0, batch, jnp.float32(l1), jnp.float32(l2))
             variances = None

@@ -137,10 +137,12 @@ def feature_sharded_value_and_grad(
     return jax.jit(vg)
 
 
-def _opt_result_specs(model_axis: str) -> OptResult:
+def _opt_result_specs(model_axis: str, track_models: bool = False) -> OptResult:
     """out_specs pytree for an OptResult whose coefficient vector is sharded
     over ``model_axis`` while every scalar/trace is replicated (scalars are
-    psum'ed mesh-global inside the optimizer, so they agree on all ranks)."""
+    psum'ed mesh-global inside the optimizer, so they agree on all ranks).
+    With ``track_models`` the per-iteration coefficient stack is sharded
+    over its feature axis like the coefficients themselves."""
     from photon_ml_tpu.optim.common import Tracker
 
     return OptResult(
@@ -149,7 +151,10 @@ def _opt_result_specs(model_axis: str) -> OptResult:
         grad_norm=P(),
         iterations=P(),
         reason=P(),
-        tracker=Tracker(values=P(), grad_norms=P(), count=P()),
+        tracker=Tracker(
+            values=P(), grad_norms=P(), count=P(),
+            coefs=P(None, model_axis) if track_models else None,
+        ),
     )
 
 
@@ -323,10 +328,16 @@ def _sparse_shard_specs(model_axis: str, data_axis: str):
     )
 
 
-def _sparse_block_vg(loss, b, l2, model_axis: str, data_axis: str):
+def _sparse_block_vg(loss, b, l2, model_axis: str, data_axis: str,
+                     shift=None, factor=None):
     """Block-local (value, grad) closure shared by the sparse-sharded
     value_and_grad and fit entry points. ``b`` is this device's shard:
-    one feature block x its rows."""
+    one feature block x its rows.
+
+    ``shift``/``factor``: this block's slice of the lazy normalization
+    vectors (NormalizationContext.scala:119-157) — margins use
+    w_eff = factor * w and subtract the psum'd shift.w_eff scalar; the
+    gradient un-shifts with the data-psum'd prefactor."""
     assert b.indices.shape[0] == 1, (
         f"got {b.indices.shape[0]} feature blocks per device; "
         "num_blocks passed to feature_shard_sparse_batch must equal the "
@@ -336,9 +347,11 @@ def _sparse_block_vg(loss, b, l2, model_axis: str, data_axis: str):
     val = b.values[0]
 
     def vg(w_block):
-        z = jax.lax.psum(
-            jnp.sum(val * w_block[idx], axis=-1), model_axis
-        ) + b.offsets
+        w_eff = w_block if factor is None else w_block * factor
+        raw = jnp.sum(val * w_eff[idx], axis=-1)
+        if shift is not None:
+            raw = raw - jnp.vdot(shift, w_eff)
+        z = jax.lax.psum(raw, model_axis) + b.offsets
         c = b.weights * loss.d1(z, b.labels)
         value = jax.lax.psum(
             jnp.sum(b.weights * loss.value(z, b.labels)), data_axis
@@ -346,13 +359,20 @@ def _sparse_block_vg(loss, b, l2, model_axis: str, data_axis: str):
         grad_block = jax.lax.psum(
             jnp.zeros_like(w_block).at[idx].add(c[:, None] * val), data_axis
         )
+        if shift is not None or factor is not None:
+            prefactor = jax.lax.psum(jnp.sum(c), data_axis)
+            if shift is not None:
+                grad_block = grad_block - shift * prefactor
+            if factor is not None:
+                grad_block = grad_block * factor
         w_sq = jax.lax.psum(jnp.vdot(w_block, w_block), model_axis)
         return value + 0.5 * l2 * w_sq, grad_block + l2 * w_block
 
     return vg
 
 
-def _sparse_block_hvp_factory(loss, b, l2, model_axis: str, data_axis: str):
+def _sparse_block_hvp_factory(loss, b, l2, model_axis: str, data_axis: str,
+                              shift=None, factor=None):
     """Block-local Hessian-vector FACTORY over one device's shard — the
     distributed HessianVectorAggregator analog
     (HessianVectorAggregator.scala:137-152). The w-only pieces (margins
@@ -363,26 +383,74 @@ def _sparse_block_hvp_factory(loss, b, l2, model_axis: str, data_axis: str):
     idx = b.indices[0]
     val = b.values[0]
 
+    def _z(x_block):
+        raw = jnp.sum(val * x_block[idx], axis=-1)
+        if shift is not None:
+            raw = raw - jnp.vdot(shift, x_block)
+        return raw
+
+    def _eff(x_block):
+        return x_block if factor is None else x_block * factor
+
     def factory(w_block):
-        z = jax.lax.psum(
-            jnp.sum(val * w_block[idx], axis=-1), model_axis
-        ) + b.offsets
+        z = jax.lax.psum(_z(_eff(w_block)), model_axis) + b.offsets
         d2c = b.weights * loss.d2(z, b.labels)
 
         def hvp(d_block):
-            zd = jax.lax.psum(
-                jnp.sum(val * d_block[idx], axis=-1), model_axis
-            )
+            zd = jax.lax.psum(_z(_eff(d_block)), model_axis)
             c = d2c * zd
             h_block = jax.lax.psum(
                 jnp.zeros_like(d_block).at[idx].add(c[:, None] * val),
                 data_axis,
             )
+            if shift is not None or factor is not None:
+                prefactor = jax.lax.psum(jnp.sum(c), data_axis)
+                if shift is not None:
+                    h_block = h_block - shift * prefactor
+                if factor is not None:
+                    h_block = h_block * factor
             return h_block + l2 * d_block
 
         return hvp
 
     return factory
+
+
+def _sparse_block_hdiag(loss, b, l2, model_axis: str, data_axis: str,
+                        shift=None, factor=None):
+    """Block-local Hessian-diagonal closure (the variance computation of
+    DistributedOptimizationProblem.scala:79-93 on the sharded layout):
+    diag_j only touches feature j's entries, so it shards trivially —
+    one scatter of c * val^2 psum'd over "data" (plus S1/S0 terms in the
+    shifted space when normalization is active)."""
+    idx = b.indices[0]
+    val = b.values[0]
+
+    def hdiag(w_block):
+        w_eff = w_block if factor is None else w_block * factor
+        raw = jnp.sum(val * w_eff[idx], axis=-1)
+        if shift is not None:
+            raw = raw - jnp.vdot(shift, w_eff)
+        z = jax.lax.psum(raw, model_axis) + b.offsets
+        c = b.weights * loss.d2(z, b.labels)
+        s2 = jax.lax.psum(
+            jnp.zeros_like(w_block).at[idx].add(c[:, None] * val**2),
+            data_axis,
+        )
+        if shift is not None:
+            s1 = jax.lax.psum(
+                jnp.zeros_like(w_block).at[idx].add(c[:, None] * val),
+                data_axis,
+            )
+            s0 = jax.lax.psum(jnp.sum(c), data_axis)
+            diag = s2 - 2.0 * shift * s1 + (shift**2) * s0
+        else:
+            diag = s2
+        if factor is not None:
+            diag = diag * factor**2
+        return diag + l2
+
+    return hdiag
 
 
 def feature_sharded_sparse_fit_tron(
@@ -657,6 +725,313 @@ def feature_sharded_tiled_fit_tron(
         )
 
     return jax.jit(fit)
+
+
+def feature_sharded_glm_fit(
+    objective: GLMObjective,
+    mesh: Mesh,
+    meta=None,
+    *,
+    layout: str = "sparse",  # "sparse" | "tiled"
+    optimizer: str = "lbfgs",  # "lbfgs" | "owlqn" | "tron"
+    data_axis: str = DATA_AXIS,
+    model_axis: str = MODEL_AXIS,
+    max_iter: int = 50,
+    tol: float = 1e-7,
+    history: int = 10,
+    max_cg: int = 20,
+    with_norm: bool = False,
+    with_box: bool = False,
+    track_models: bool = False,
+    interpret: Optional[bool] = None,
+) -> Callable:
+    """Unified feature-sharded fit builder: every optimizer x layout x
+    feature combination the replicated path supports, on the 2-D
+    (data, model) mesh. The reference composes normalization
+    (NormalizationContext.scala:119-157, applied inside aggregators),
+    variances (DistributedOptimizationProblem.scala:79-93), and box
+    projection (LBFGS.scala:77) freely with distribution; so do we —
+    Hdiag and the box projection are block-local/elementwise, and the
+    lazy shift/factor algebra shards along the feature axis with one
+    extra psum'd scalar.
+
+    Returns ``fit(w0, batch, l2, *extras)`` where extras are, in order:
+    ``l1, l1_mask`` (owlqn), ``shift, factor`` (with_norm; full [d_pad]
+    vectors, sharded over the model axis), ``lower, upper`` (with_box;
+    full [d_pad] vectors). ``meta`` is required for the tiled layout.
+    """
+    from photon_ml_tpu.optim.common import BoxConstraints
+    from photon_ml_tpu.optim.lbfgs import minimize_owlqn
+    from photon_ml_tpu.optim.tron import minimize_tron
+
+    if optimizer not in ("lbfgs", "owlqn", "tron"):
+        raise ValueError(f"unknown optimizer {optimizer!r}")
+    if layout not in ("sparse", "tiled"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if layout == "tiled":
+        if meta is None:
+            raise ValueError("tiled layout requires the batch meta")
+        from photon_ml_tpu.utils.backend import effective_platform
+
+        if interpret is None:
+            interpret = effective_platform() == "cpu"
+    loss = objective.loss
+    owlqn = optimizer == "owlqn"
+    tron = optimizer == "tron"
+
+    extra_specs = []
+    if owlqn:
+        extra_specs += [P(), P(model_axis)]  # l1, l1_mask
+    if with_norm:
+        extra_specs += [P(model_axis), P(model_axis)]  # shift, factor
+    if with_box:
+        extra_specs += [P(model_axis), P(model_axis)]  # lower, upper
+
+    def _unpack(extras):
+        i = 0
+        l1 = l1_mask = shift = factor = box = None
+        if owlqn:
+            l1, l1_mask = extras[0], extras[1]
+            i = 2
+        if with_norm:
+            shift, factor = extras[i], extras[i + 1]
+            i += 2
+        if with_box:
+            box = BoxConstraints(lower=extras[i], upper=extras[i + 1])
+        return l1, l1_mask, shift, factor, box
+
+    def _dispatch(vg, hvp_factory, w0_block, l1, l1_mask, box):
+        if tron:
+            return minimize_tron(
+                vg, None, w0_block, max_iter=max_iter, tol=tol,
+                max_cg=max_cg, box=box, axis_name=model_axis,
+                hvp_factory=hvp_factory, track_coefficients=track_models,
+            )
+        if owlqn:
+            return minimize_owlqn(
+                vg, w0_block, l1, max_iter=max_iter, tol=tol,
+                history=history, l1_mask=l1_mask, box=box,
+                axis_name=model_axis, track_coefficients=track_models,
+            )
+        return minimize_lbfgs(
+            vg, w0_block, max_iter=max_iter, tol=tol, history=history,
+            box=box, axis_name=model_axis, track_coefficients=track_models,
+        )
+
+    out_specs = _opt_result_specs(model_axis, track_models)
+
+    if layout == "tiled":
+        from photon_ml_tpu.ops.tiled_sparse import (
+            FeatureShardedTiledBatch,
+            tiled_block_local_hvp_factory,
+            tiled_block_local_vg,
+        )
+
+        sched_spec = P((data_axis, model_axis))
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(
+                P(model_axis), sched_spec, sched_spec,
+                P(data_axis), P(data_axis), P(data_axis), P(),
+                tuple(extra_specs),
+            ),
+            out_specs=out_specs,
+            check_vma=False,
+        )
+        def _fit(w0_block, z_sched, g_sched, labels, offsets, weights, l2,
+                 extras):
+            l1, l1_mask, shift, factor, box = _unpack(extras)
+            cell = FeatureShardedTiledBatch(
+                meta, z_sched, g_sched, labels, offsets, weights
+            )
+            vg = tiled_block_local_vg(
+                loss, cell, data_axis, model_axis, l2,
+                shift=shift, factor=factor, interpret=interpret,
+            )
+            factory = (
+                tiled_block_local_hvp_factory(
+                    loss, cell, data_axis, model_axis, l2,
+                    shift=shift, factor=factor, interpret=interpret,
+                )
+                if tron else None
+            )
+            return _dispatch(vg, factory, w0_block, l1, l1_mask, box)
+
+        def fit(w0, batch, l2, *extras):
+            return _fit(
+                w0, batch.z_sched, batch.g_sched, batch.labels,
+                batch.offsets, batch.weights, l2, tuple(extras),
+            )
+    else:
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=_sparse_shard_specs(model_axis, data_axis)
+            + (tuple(extra_specs),),
+            out_specs=out_specs,
+            check_vma=False,
+        )
+        def _fit(w0_block, b, l2, extras):
+            l1, l1_mask, shift, factor, box = _unpack(extras)
+            vg = _sparse_block_vg(
+                loss, b, l2, model_axis, data_axis,
+                shift=shift, factor=factor,
+            )
+            factory = (
+                _sparse_block_hvp_factory(
+                    loss, b, l2, model_axis, data_axis,
+                    shift=shift, factor=factor,
+                )
+                if tron else None
+            )
+            return _dispatch(vg, factory, w0_block, l1, l1_mask, box)
+
+        def fit(w0, batch, l2, *extras):
+            return _fit(w0, batch, l2, tuple(extras))
+
+    return jax.jit(fit)
+
+
+def feature_sharded_extras(
+    dim: int,
+    d_pad: int,
+    *,
+    normalization=None,
+    box=None,
+    use_owlqn: bool = False,
+    intercept_index: Optional[int] = None,
+):
+    """Assemble feature_sharded_glm_fit's positional extras protocol in
+    ONE place (fit call order: [l1, l1_mask] from the caller, then this
+    tail = [shift, factor] when normalization is active, then
+    [lower, upper] when a box is given — all padded to [d_pad] with inert
+    fills). Returns ``(extras_tail, l1_mask, with_norm)``; ``l1_mask`` is
+    None unless ``use_owlqn`` (intercept exempt, like the replicated
+    GLMOptimizationProblem._l1_mask). Both train_feature_sharded and the
+    GAME FixedEffectCoordinate build their calls from here so the
+    protocol cannot silently diverge."""
+    with_norm = normalization is not None and not normalization.is_identity
+
+    def _pad(v, fill):
+        v = jnp.asarray(v, jnp.float32)
+        if v.shape[0] == d_pad:
+            return v
+        return jnp.concatenate(
+            [v, jnp.full((d_pad - v.shape[0],), fill, jnp.float32)]
+        )
+
+    extras_tail = []
+    if with_norm:
+        # padded slots are inert: shift 0, factor 1
+        extras_tail += [
+            _pad(
+                normalization.shift
+                if normalization.shift is not None
+                else jnp.zeros((dim,), jnp.float32),
+                0.0,
+            ),
+            _pad(
+                normalization.factor
+                if normalization.factor is not None
+                else jnp.ones((dim,), jnp.float32),
+                1.0,
+            ),
+        ]
+    if box is not None:
+        # padded slots unconstrained so padding coefficients stay at 0
+        extras_tail += [_pad(box.lower, -jnp.inf), _pad(box.upper, jnp.inf)]
+    l1_mask = None
+    if use_owlqn:
+        l1_mask = jnp.ones((d_pad,), jnp.float32)
+        if intercept_index is not None:
+            l1_mask = l1_mask.at[intercept_index].set(0.0)
+    return extras_tail, l1_mask, with_norm
+
+
+def feature_sharded_hessian_diagonal(
+    objective: GLMObjective,
+    mesh: Mesh,
+    meta=None,
+    *,
+    layout: str = "sparse",
+    data_axis: str = DATA_AXIS,
+    model_axis: str = MODEL_AXIS,
+    with_norm: bool = False,
+    interpret: Optional[bool] = None,
+) -> Callable:
+    """Hessian diagonal over the feature-sharded layouts — the variance
+    computation (DistributedOptimizationProblem.scala:79-93) composed with
+    feature sharding. Returns ``hdiag(w, batch, l2[, shift, factor])``
+    producing the full [d_pad] diagonal (gathered across blocks)."""
+    loss = objective.loss
+    if layout == "tiled":
+        if meta is None:
+            raise ValueError("tiled layout requires the batch meta")
+        from photon_ml_tpu.utils.backend import effective_platform
+
+        if interpret is None:
+            interpret = effective_platform() == "cpu"
+    norm_specs = (P(model_axis), P(model_axis)) if with_norm else ()
+
+    if layout == "tiled":
+        from photon_ml_tpu.ops.tiled_sparse import (
+            FeatureShardedTiledBatch,
+            tiled_block_local_hdiag,
+        )
+
+        sched_spec = P((data_axis, model_axis))
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(
+                P(model_axis), sched_spec, sched_spec,
+                P(data_axis), P(data_axis), P(data_axis), P(),
+                tuple(norm_specs),
+            ),
+            out_specs=P(model_axis),
+            check_vma=False,
+        )
+        def _hdiag(w_block, z_sched, g_sched, labels, offsets, weights, l2,
+                   extras):
+            shift, factor = extras if with_norm else (None, None)
+            cell = FeatureShardedTiledBatch(
+                meta, z_sched, g_sched, labels, offsets, weights
+            )
+            return tiled_block_local_hdiag(
+                loss, cell, data_axis, model_axis, l2,
+                shift=shift, factor=factor, interpret=interpret,
+            )(w_block)
+
+        def hdiag(w, batch, l2, *extras):
+            return _hdiag(
+                w, batch.z_sched, batch.g_sched, batch.labels,
+                batch.offsets, batch.weights, l2, tuple(extras),
+            )
+    else:
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=_sparse_shard_specs(model_axis, data_axis)
+            + (tuple(norm_specs),),
+            out_specs=P(model_axis),
+            check_vma=False,
+        )
+        def _hdiag(w_block, b, l2, extras):
+            shift, factor = extras if with_norm else (None, None)
+            return _sparse_block_hdiag(
+                loss, b, l2, model_axis, data_axis,
+                shift=shift, factor=factor,
+            )(w_block)
+
+        def hdiag(w, batch, l2, *extras):
+            return _hdiag(w, batch, l2, tuple(extras))
+
+    return jax.jit(hdiag)
 
 
 def feature_sharded_sparse_fit_owlqn(

@@ -162,9 +162,13 @@ def train_feature_sharded(
     tolerance: Optional[float] = None,
     history: int = 10,
     warm_start: bool = True,
+    normalization: Optional[NormalizationContext] = None,
+    compute_variances: bool = False,
+    box: Optional[BoxConstraints] = None,
     intercept_index: Optional[int] = None,
     kernel: str = "scatter",
     optimizer_type: OptimizerType = OptimizerType.LBFGS,
+    track_models: bool = False,
 ) -> Tuple[Dict[float, GeneralizedLinearModel], Dict[float, OptResult]]:
     """Lambda grid over a FEATURE-SHARDED coefficient vector (the >HBM /
     10B-coefficient path, SURVEY §2.3 "coefficient parallelism").
@@ -174,11 +178,18 @@ def train_feature_sharded(
     elastic-net run sharded OWL-QN; L2/none run sharded L-BFGS or (with
     ``optimizer_type=TRON``) sharded trust-region Newton whose truncated
     CG psums every inner product — the reference's
-    one-treeAggregate-per-CG-iteration loop (SURVEY §3.2) on ICI. Box
-    constraints and normalization are not supported on this path —
-    callers validate (the GLM driver rejects those combinations). TRON
+    one-treeAggregate-per-CG-iteration loop (SURVEY §3.2) on ICI. TRON
     runs the tiled kernels too: its Hv pass reuses the z/g schedules
     (tiled_block_local_hvp_factory).
+
+    The reference composes normalization, variances, box constraints and
+    per-iteration model tracking freely with distribution
+    (NormalizationContext.scala:119-157 inside the aggregators,
+    DistributedOptimizationProblem.scala:79-93, LBFGS.scala:77); here the
+    shift/factor vectors shard along the feature axis (one extra psum'd
+    scalar for the margin shift), the Hessian diagonal and box projection
+    are block-local/elementwise, and ``track_models`` shards the
+    per-iteration coefficient stack like the coefficients themselves.
 
     ``kernel``: "scatter" | "tiled" | "auto" — "tiled" lays each
     (data shard x feature block) cell out as block-local Pallas tile
@@ -194,8 +205,8 @@ def train_feature_sharded(
     from photon_ml_tpu.ops.objective import GLMObjective
     from photon_ml_tpu.parallel.distributed import (
         feature_shard_sparse_batch,
-        feature_sharded_sparse_fit,
-        feature_sharded_sparse_fit_owlqn,
+        feature_sharded_glm_fit,
+        feature_sharded_hessian_diagonal,
     )
     from photon_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
@@ -228,64 +239,56 @@ def train_feature_sharded(
         loss_has_hessian=objective.loss.has_hessian,
     )
     kernel = resolve_kernel(kernel, batch)
+    with_norm = normalization is not None and not normalization.is_identity
 
-    if use_tron and kernel == "tiled":
+    if kernel == "tiled":
         from photon_ml_tpu.ops.tiled_sparse import feature_shard_tiled_batch
-        from photon_ml_tpu.parallel.distributed import (
-            feature_sharded_tiled_fit_tron,
-        )
 
         sharded, block_dim = feature_shard_tiled_batch(
             batch, dim, data_shards, num_blocks, mesh=mesh,
             data_axis=DATA_AXIS, model_axis=MODEL_AXIS,
         )
-        fit = feature_sharded_tiled_fit_tron(
-            objective, mesh, sharded.meta, max_iter=max_iter, tol=tolerance
-        )
-    elif use_tron:
-        from photon_ml_tpu.parallel.distributed import (
-            feature_sharded_sparse_fit_tron,
-        )
-
-        sharded, block_dim = feature_shard_sparse_batch(
-            batch, dim, num_blocks, rows_multiple=data_shards
-        )
-        fit = feature_sharded_sparse_fit_tron(
-            objective, mesh, max_iter=max_iter, tol=tolerance
-        )
-    elif kernel == "tiled":
-        from photon_ml_tpu.ops.tiled_sparse import feature_shard_tiled_batch
-        from photon_ml_tpu.parallel.distributed import feature_sharded_tiled_fit
-
-        sharded, block_dim = feature_shard_tiled_batch(
-            batch, dim, data_shards, num_blocks, mesh=mesh,
-            data_axis=DATA_AXIS, model_axis=MODEL_AXIS,
-        )
-        fit = feature_sharded_tiled_fit(
-            objective, mesh, sharded.meta, max_iter=max_iter,
-            tol=tolerance, history=history, owlqn=use_owlqn,
-        )
+        meta = sharded.meta
     else:
         sharded, block_dim = feature_shard_sparse_batch(
             batch, dim, num_blocks, rows_multiple=data_shards
         )
-        if use_owlqn:
-            fit = feature_sharded_sparse_fit_owlqn(
-                objective, mesh, max_iter=max_iter, tol=tolerance,
-                history=history,
-            )
-        else:
-            fit = feature_sharded_sparse_fit(
-                objective, mesh, max_iter=max_iter, tol=tolerance,
-                history=history,
-            )
+        meta = None
+    optimizer = "tron" if use_tron else ("owlqn" if use_owlqn else "lbfgs")
+    layout = "tiled" if kernel == "tiled" else "sparse"
+    fit = feature_sharded_glm_fit(
+        objective, mesh, meta, layout=layout, optimizer=optimizer,
+        max_iter=max_iter, tol=tolerance, history=history,
+        with_norm=with_norm, with_box=box is not None,
+        track_models=track_models,
+    )
     d_pad = num_blocks * block_dim
-    if use_owlqn:
-        # Exempt the intercept from the L1 penalty, exactly like the
-        # replicated path's GLMOptimizationProblem._l1_mask.
-        l1_mask = jnp.ones((d_pad,), jnp.float32)
+    from photon_ml_tpu.parallel.distributed import feature_sharded_extras
+
+    extras_tail, l1_mask, _ = feature_sharded_extras(
+        dim, d_pad, normalization=normalization, box=box,
+        use_owlqn=use_owlqn, intercept_index=intercept_index,
+    )
+
+    hdiag_fn = None
+    if compute_variances:
+        hdiag_fn = feature_sharded_hessian_diagonal(
+            objective, mesh, meta, layout=layout, with_norm=with_norm,
+        )
+        norm_extras = extras_tail[:2] if with_norm else []
+
+    def _to_original_space(means):
+        """De-normalize back to the raw feature space, exactly like
+        GLMOptimizationProblem.create_model
+        (GeneralizedLinearOptimizationProblem.scala:89-95)."""
+        if not with_norm:
+            return means
+        orig = normalization.model_to_original_space(means)
         if intercept_index is not None:
-            l1_mask = l1_mask.at[intercept_index].set(0.0)
+            orig = orig.at[intercept_index].add(
+                normalization.intercept_adjustment(means)
+            )
+        return orig
 
     weights_desc = sorted(set(float(w) for w in regularization_weights), reverse=True)
     models: Dict[float, GeneralizedLinearModel] = {}
@@ -293,16 +296,33 @@ def train_feature_sharded(
     current = jnp.zeros((d_pad,), jnp.float32)
     for lam in weights_desc:
         l1, l2 = regularization.split(lam)
-        if use_owlqn:
-            result = fit(
-                current, sharded, jnp.float32(l2), jnp.float32(l1), l1_mask
+        extras = (
+            [jnp.float32(l1), l1_mask] if use_owlqn else []
+        ) + extras_tail
+        result = fit(current, sharded, jnp.float32(l2), *extras)
+        variances = None
+        if hdiag_fn is not None:
+            from photon_ml_tpu.optim.problem import _VARIANCE_EPSILON
+
+            hd = hdiag_fn(
+                result.coefficients, sharded, jnp.float32(l2), *norm_extras
             )
-        else:
-            result = fit(current, sharded, jnp.float32(l2))
+            variances = (1.0 / (hd + _VARIANCE_EPSILON))[:dim]
         models[lam] = create_model(
-            task, Coefficients(result.coefficients[:dim])
+            task,
+            Coefficients(
+                _to_original_space(result.coefficients[:dim]), variances
+            ),
         )
-        results[lam] = result
+        # Results carry REAL-dimension coefficients (and tracked models),
+        # consistent with the replicated path; the padded vector is only
+        # the warm-start currency.
+        tracker = result.tracker
+        if tracker.coefs is not None:
+            tracker = tracker._replace(coefs=tracker.coefs[:, :dim])
+        results[lam] = result._replace(
+            coefficients=result.coefficients[:dim], tracker=tracker
+        )
         if warm_start:
             current = result.coefficients
     return models, results
@@ -315,15 +335,22 @@ def train_streaming_glm(
     regularization_type: RegularizationType = RegularizationType.NONE,
     regularization_weights: Sequence[float] = (0.0,),
     elastic_net_alpha: Optional[float] = None,
-    max_iter: int = 100,
-    tolerance: float = 1e-7,
+    max_iter: Optional[int] = None,
+    tolerance: Optional[float] = None,
     history: int = 10,
     rows_per_chunk: int = 65536,
     cache_bytes: int = 2 << 30,
     prefetch: bool = True,
+    kernel: str = "auto",
+    tile_params=None,
     add_intercept: bool = True,
     field_names: str = "TRAINING_EXAMPLE",
     warm_start: bool = True,
+    optimizer_type: OptimizerType = OptimizerType.LBFGS,
+    normalization: Optional[NormalizationContext] = None,
+    compute_variances: bool = False,
+    box: Optional[BoxConstraints] = None,
+    track_models: bool = False,
     fmt=None,
     index_map=None,
     stats=None,
@@ -361,13 +388,27 @@ def train_streaming_glm(
     from photon_ml_tpu.io.streaming import StreamingGLMObjective, scan_stream
     from photon_ml_tpu.models.coefficients import Coefficients
     from photon_ml_tpu.models.glm import create_model
+    from photon_ml_tpu.optim.factory import validate_optimizer_choice
     from photon_ml_tpu.optim.host_lbfgs import (
         minimize_lbfgs_host,
         minimize_owlqn_host,
     )
+    from photon_ml_tpu.optim.host_tron import minimize_tron_host
 
     regularization = RegularizationContext(
         regularization_type, elastic_net_alpha
+    )
+    from photon_ml_tpu.ops.losses import loss_for_task as _loss_for_task
+
+    use_tron = optimizer_type == OptimizerType.TRON
+    base = OptimizerConfig.default_for(optimizer_type)
+    max_iter = max_iter if max_iter is not None else base.max_iter
+    tolerance = tolerance if tolerance is not None else base.tolerance
+    # shared TRON x regularization / loss-smoothness rules
+    validate_optimizer_choice(
+        OptimizerConfig(optimizer_type=optimizer_type),
+        regularization,
+        loss_has_hessian=_loss_for_task(task).has_hessian,
     )
     if fmt is None:
         fmt = AvroInputDataFormat(
@@ -420,17 +461,34 @@ def train_streaming_glm(
     objective = StreamingGLMObjective(
         paths, fmt, index_map, stats, task,
         rows_per_chunk=rows_per_chunk, cache_bytes=cache_bytes,
-        prefetch=prefetch,
+        prefetch=prefetch, kernel=kernel, tile_params=tile_params,
+        norm=normalization,
     )
-    l1_mask = None
-    if regularization.has_l1 and fmt.add_intercept:
-        from photon_ml_tpu.utils.index_map import intercept_key
+    from photon_ml_tpu.utils.index_map import intercept_key
 
+    intercept_index = None
+    if fmt.add_intercept:
         icept = index_map.get_index(intercept_key())
         if icept >= 0:
-            l1_mask = (
-                jnp.ones((objective.dim,), jnp.float32).at[icept].set(0.0)
+            intercept_index = icept
+    l1_mask = None
+    if regularization.has_l1 and intercept_index is not None:
+        l1_mask = (
+            jnp.ones((objective.dim,), jnp.float32)
+            .at[intercept_index].set(0.0)
+        )
+
+    def _to_original_space(means):
+        """De-normalize like GLMOptimizationProblem.create_model
+        (GeneralizedLinearOptimizationProblem.scala:89-95)."""
+        if normalization is None or normalization.is_identity:
+            return means
+        orig = normalization.model_to_original_space(means)
+        if intercept_index is not None:
+            orig = orig.at[intercept_index].add(
+                normalization.intercept_adjustment(means)
             )
+        return orig
 
     weights_desc = sorted(
         set(float(w) for w in regularization_weights), reverse=True
@@ -440,18 +498,38 @@ def train_streaming_glm(
     current = jnp.zeros((objective.dim,), jnp.float32)
     for lam in weights_desc:
         l1, l2 = regularization.split(lam)
-        if l1:
+        if use_tron:
+            # one streamed Hv pass per CG step — the reference's exact
+            # second-order pattern (HessianVectorAggregator.scala:137-152)
+            result = minimize_tron_host(
+                lambda w: objective.value_and_gradient(w, l2),
+                lambda w, d_: objective.hessian_vector(w, d_, l2),
+                current, max_iter=max_iter, tol=tolerance, box=box,
+                track_coefficients=track_models,
+            )
+        elif l1:
             result = minimize_owlqn_host(
                 lambda w: objective.value_and_gradient(w, l2),
                 current, l1, max_iter=max_iter, tol=tolerance,
-                history=history, l1_mask=l1_mask,
+                history=history, l1_mask=l1_mask, box=box,
+                track_coefficients=track_models,
             )
         else:
             result = minimize_lbfgs_host(
                 lambda w: objective.value_and_gradient(w, l2),
                 current, max_iter=max_iter, tol=tolerance, history=history,
+                box=box, track_coefficients=track_models,
             )
-        models[lam] = create_model(task, Coefficients(result.coefficients))
+        variances = None
+        if compute_variances:
+            hd = objective.hessian_diagonal(result.coefficients, l2)
+            variances = 1.0 / (hd + 1e-12)
+        models[lam] = create_model(
+            task,
+            Coefficients(
+                _to_original_space(result.coefficients), variances
+            ),
+        )
         results[lam] = result
         if warm_start:
             current = result.coefficients

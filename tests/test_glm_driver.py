@@ -220,19 +220,21 @@ class TestFeatureShardedDriver:
             )
         assert results["feature"].best_model is not None
 
-    def test_feature_mode_param_rejections(self):
-        # (TRON is supported on the feature-sharded path since round 3 —
-        # sharded truncated CG — so it is no longer in this list)
+    def test_feature_mode_composes_all_params(self):
+        # Round 5 closed the feature-sharded combination guards: the
+        # reference composes normalization, variances and box constraints
+        # freely with distribution (NormalizationContext.scala:119-157,
+        # DistributedOptimizationProblem.scala:79-93, LBFGS.scala:77) —
+        # these now VALIDATE cleanly instead of raising.
         for kw in (
             dict(normalization_type=NormalizationType.STANDARDIZATION),
             dict(compute_variances=True),
             dict(constraint_string="[]"),
+            dict(validate_per_iteration=True, validate_dir="v"),
         ):
-            p = GLMParams(
+            GLMParams(
                 train_dir="t", output_dir="o", distributed="feature", **kw
-            )
-            with pytest.raises(ValueError):
-                p.validate()
+            ).validate()
         # TRON + feature sharding validates cleanly, with either kernel
         # (tiled Hv schedules landed round 4)
         for kernel in ("auto", "tiled", "scatter"):
@@ -240,6 +242,46 @@ class TestFeatureShardedDriver:
                 train_dir="t", output_dir="o", distributed="feature",
                 optimizer_type=OptimizerType.TRON, kernel=kernel,
             ).validate()
+
+    def test_feature_sharded_norm_variances_validate_per_iter(
+        self, tmp_path, avro_dirs
+    ):
+        """The previously-guarded combinations, driver end-to-end in
+        --distributed feature mode: standardization + variances +
+        validate-per-iteration must reproduce the single-device run."""
+        train, val = avro_dirs
+        results = {}
+        for mode, out in (("feature", "out_fsn"), ("off", "out_sn")):
+            params = GLMParams(
+                train_dir=train,
+                validate_dir=val,
+                output_dir=str(tmp_path / out),
+                task=TaskType.LOGISTIC_REGRESSION,
+                regularization_weights=[1.0],
+                normalization_type=NormalizationType.STANDARDIZATION,
+                compute_variances=True,
+                validate_per_iteration=True,
+                distributed=mode,
+                model_shards=2,
+            )
+            driver = GLMDriver(params)
+            driver.run()
+            results[mode] = driver
+        np.testing.assert_allclose(
+            np.asarray(results["feature"].models[1.0].means),
+            np.asarray(results["off"].models[1.0].means),
+            atol=5e-3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(results["feature"].models[1.0].coefficients.variances),
+            np.asarray(results["off"].models[1.0].coefficients.variances),
+            rtol=5e-3,
+        )
+        per_iter = results["feature"].per_iteration_metrics[1.0]
+        assert len(per_iter) > 1
+        # per-iteration metrics track the single-device run
+        ref_iter = results["off"].per_iteration_metrics[1.0]
+        assert abs(per_iter[-1]["AUC"] - ref_iter[-1]["AUC"]) < 1e-3
 
     def test_feature_sharded_tron_tiled_end_to_end(self, tmp_path, avro_dirs):
         """--distributed feature --optimizer TRON --kernel tiled: the
@@ -405,20 +447,38 @@ class TestStreamingDriver:
         # model files written in streaming mode too
         assert os.path.isdir(os.path.join(str(tmp_path / "stream"), "models"))
 
-    def test_streaming_rejects_unsupported(self, avro_dirs, tmp_path):
+    def test_streaming_guards_only_structural(self, avro_dirs, tmp_path):
         train, _ = avro_dirs
-        # L1/elastic-net stream via host-driven OWL-QN since round 4:
-        # validates cleanly
-        GLMParams(
-            train_dir=train,
-            output_dir=str(tmp_path / "x"),
-            streaming=True,
-            regularization_type=RegularizationType.L1,
-        ).validate()
+        # Round 5: every driver stage streams (TRON, normalization, box,
+        # variances, summarization, diagnostics, validate-per-iteration)
+        # — these all validate cleanly now
+        for kw in (
+            dict(regularization_type=RegularizationType.L1),
+            dict(normalization_type=NormalizationType.STANDARDIZATION),
+            dict(optimizer_type=OptimizerType.TRON),
+            dict(compute_variances=True),
+            dict(summarization_output_dir="s"),
+            dict(constraint_string="[]"),
+            dict(validate_per_iteration=True, validate_dir="v"),
+        ):
+            GLMParams(
+                train_dir=train,
+                output_dir=str(tmp_path / "x"),
+                streaming=True,
+                **kw,
+            ).validate()
+        # what remains unsupported is structural: conflicting layouts
         with pytest.raises(ValueError, match="streaming training"):
             GLMParams(
                 train_dir=train,
                 output_dir=str(tmp_path / "y"),
                 streaming=True,
-                normalization_type=NormalizationType.STANDARDIZATION,
+                distributed="feature",
+            ).validate()
+        with pytest.raises(ValueError, match="streaming training"):
+            GLMParams(
+                train_dir=train,
+                output_dir=str(tmp_path / "z"),
+                streaming=True,
+                input_format="LIBSVM",
             ).validate()

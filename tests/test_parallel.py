@@ -276,3 +276,211 @@ class TestFeatureSharded:
         np.testing.assert_allclose(
             np.asarray(g)[:d], np.asarray(g_local), atol=1e-4
         )
+
+
+class TestFeatureShardedCompositions:
+    """The reference composes normalization, variances, box constraints
+    and per-iteration model tracking freely with distribution
+    (NormalizationContext.scala:119-157, DistributedOptimizationProblem
+    .scala:79-93, LBFGS.scala:77, Driver.scala:329-372); each combination
+    must match the replicated path exactly (fp32 noise only)."""
+
+    def _problem(self, rng, n=128, d=45, k=8):
+        batch, _ = sparse_problem(rng, n=n, d=d, k=k)
+        return batch, d
+
+    def _norm(self, batch, d):
+        from photon_ml_tpu.data.stats import compute_summary
+        from photon_ml_tpu.ops.normalization import (
+            NormalizationType,
+            build_normalization,
+        )
+
+        s = compute_summary(batch, d)
+        return build_normalization(
+            NormalizationType.STANDARDIZATION,
+            mean=s.mean, std=s.std, max_magnitude=s.max_magnitude,
+        )
+
+    @pytest.mark.parametrize("kernel", ["scatter", "tiled"])
+    def test_normalization_matches_replicated(self, mesh4x2, rng, kernel):
+        from photon_ml_tpu.task import TaskType
+        from photon_ml_tpu.training import (
+            train_feature_sharded,
+            train_generalized_linear_model,
+        )
+        from photon_ml_tpu.optim import RegularizationType
+
+        batch, d = self._problem(rng)
+        norm = self._norm(batch, d)
+        kwargs = dict(
+            regularization_type=RegularizationType.L2,
+            regularization_weights=[0.5], max_iter=40,
+        )
+        m_rep, _ = train_generalized_linear_model(
+            batch, TaskType.LOGISTIC_REGRESSION, d, normalization=norm,
+            kernel="scatter", **kwargs,
+        )
+        m_sh, _ = train_feature_sharded(
+            batch, TaskType.LOGISTIC_REGRESSION, d, mesh=mesh4x2,
+            normalization=norm, kernel=kernel, **kwargs,
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_sh[0.5].means), np.asarray(m_rep[0.5].means),
+            atol=5e-3,
+        )
+
+    def test_box_matches_replicated(self, mesh4x2, rng):
+        from photon_ml_tpu.optim.common import BoxConstraints
+        from photon_ml_tpu.task import TaskType
+        from photon_ml_tpu.training import (
+            train_feature_sharded,
+            train_generalized_linear_model,
+        )
+        from photon_ml_tpu.optim import RegularizationType
+
+        batch, d = self._problem(rng)
+        box = BoxConstraints(
+            lower=jnp.full((d,), -0.2, jnp.float32),
+            upper=jnp.full((d,), 0.2, jnp.float32),
+        )
+        kwargs = dict(
+            regularization_type=RegularizationType.L2,
+            regularization_weights=[0.1], max_iter=40,
+        )
+        m_rep, _ = train_generalized_linear_model(
+            batch, TaskType.LOGISTIC_REGRESSION, d, box=box,
+            kernel="scatter", **kwargs,
+        )
+        m_sh, _ = train_feature_sharded(
+            batch, TaskType.LOGISTIC_REGRESSION, d, mesh=mesh4x2,
+            box=box, kernel="scatter", **kwargs,
+        )
+        w = np.asarray(m_sh[0.1].means)
+        assert np.all(w >= -0.2 - 1e-6) and np.all(w <= 0.2 + 1e-6)
+        # the box must actually bind somewhere or this test is vacuous
+        assert np.any(np.isclose(np.abs(w), 0.2, atol=1e-4))
+        np.testing.assert_allclose(
+            w, np.asarray(m_rep[0.1].means), atol=5e-3
+        )
+
+    @pytest.mark.parametrize("kernel", ["scatter", "tiled"])
+    def test_variances_match_replicated(self, mesh4x2, rng, kernel):
+        from photon_ml_tpu.task import TaskType
+        from photon_ml_tpu.training import (
+            train_feature_sharded,
+            train_generalized_linear_model,
+        )
+        from photon_ml_tpu.optim import RegularizationType
+
+        batch, d = self._problem(rng)
+        kwargs = dict(
+            regularization_type=RegularizationType.L2,
+            regularization_weights=[1.0], max_iter=40,
+        )
+        m_rep, _ = train_generalized_linear_model(
+            batch, TaskType.LOGISTIC_REGRESSION, d,
+            compute_variances=True, kernel="scatter", **kwargs,
+        )
+        m_sh, _ = train_feature_sharded(
+            batch, TaskType.LOGISTIC_REGRESSION, d, mesh=mesh4x2,
+            compute_variances=True, kernel=kernel, **kwargs,
+        )
+        assert m_sh[1.0].coefficients.variances is not None
+        np.testing.assert_allclose(
+            np.asarray(m_sh[1.0].coefficients.variances),
+            np.asarray(m_rep[1.0].coefficients.variances), rtol=2e-3,
+        )
+
+    def test_track_models_matches_replicated(self, mesh4x2, rng):
+        from photon_ml_tpu.task import TaskType
+        from photon_ml_tpu.training import (
+            train_feature_sharded,
+            train_generalized_linear_model,
+        )
+        from photon_ml_tpu.optim import RegularizationType
+
+        batch, d = self._problem(rng)
+        kwargs = dict(
+            regularization_type=RegularizationType.L2,
+            regularization_weights=[0.5], max_iter=10,
+        )
+        _, r_rep = train_generalized_linear_model(
+            batch, TaskType.LOGISTIC_REGRESSION, d, track_models=True,
+            kernel="scatter", **kwargs,
+        )
+        _, r_sh = train_feature_sharded(
+            batch, TaskType.LOGISTIC_REGRESSION, d, mesh=mesh4x2,
+            track_models=True, kernel="scatter", **kwargs,
+        )
+        rep, sh = r_rep[0.5], r_sh[0.5]
+        assert sh.tracker.coefs is not None
+        n_rep = int(rep.tracker.count)
+        assert int(sh.tracker.count) == n_rep
+        np.testing.assert_allclose(
+            np.asarray(sh.tracker.coefs)[:n_rep],
+            np.asarray(rep.tracker.coefs)[:n_rep], atol=5e-3,
+        )
+
+    def test_tron_normalization_matches_replicated(self, mesh4x2, rng):
+        from photon_ml_tpu.optim import OptimizerType, RegularizationType
+        from photon_ml_tpu.task import TaskType
+        from photon_ml_tpu.training import (
+            train_feature_sharded,
+            train_generalized_linear_model,
+        )
+
+        batch, d = self._problem(rng)
+        norm = self._norm(batch, d)
+        kwargs = dict(
+            optimizer_type=OptimizerType.TRON,
+            regularization_type=RegularizationType.L2,
+            regularization_weights=[1.0], max_iter=15,
+        )
+        m_rep, _ = train_generalized_linear_model(
+            batch, TaskType.LOGISTIC_REGRESSION, d, normalization=norm,
+            kernel="scatter", **kwargs,
+        )
+        m_sh, _ = train_feature_sharded(
+            batch, TaskType.LOGISTIC_REGRESSION, d, mesh=mesh4x2,
+            normalization=norm, kernel="scatter", **kwargs,
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_sh[1.0].means), np.asarray(m_rep[1.0].means),
+            atol=5e-3,
+        )
+
+    def test_owlqn_box_norm_composed(self, mesh4x2, rng):
+        # the full stack at once: elastic-net OWL-QN + box + intercept
+        # exemption on the sharded path, vs the replicated problem layer
+        from photon_ml_tpu.optim.common import BoxConstraints
+        from photon_ml_tpu.task import TaskType
+        from photon_ml_tpu.training import (
+            train_feature_sharded,
+            train_generalized_linear_model,
+        )
+        from photon_ml_tpu.optim import RegularizationType
+
+        batch, d = self._problem(rng)
+        box = BoxConstraints(
+            lower=jnp.full((d,), -0.3, jnp.float32),
+            upper=jnp.full((d,), 0.3, jnp.float32),
+        )
+        kwargs = dict(
+            regularization_type=RegularizationType.ELASTIC_NET,
+            elastic_net_alpha=0.5,
+            regularization_weights=[0.2], max_iter=40,
+        )
+        m_rep, _ = train_generalized_linear_model(
+            batch, TaskType.LOGISTIC_REGRESSION, d, box=box,
+            kernel="scatter", **kwargs,
+        )
+        m_sh, _ = train_feature_sharded(
+            batch, TaskType.LOGISTIC_REGRESSION, d, mesh=mesh4x2,
+            box=box, kernel="scatter", **kwargs,
+        )
+        w = np.asarray(m_sh[0.2].means)
+        assert np.all(w >= -0.3 - 1e-6) and np.all(w <= 0.3 + 1e-6)
+        np.testing.assert_allclose(
+            w, np.asarray(m_rep[0.2].means), atol=5e-3
+        )
