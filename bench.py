@@ -171,7 +171,7 @@ def main():
     #   [128, 128] x [128, L] (gather + scatter sides), ~197 bf16
     #   TFLOP/s on a v5e-class chip.
     # - dispatched_step_bound_ms: the measured-step cost model from
-    #   PERF_NOTES round 4 — ~3.9 us per grid step (MXU + the one-hot
+    #   PERF_NOTES round 4 — ~2.0 us per grid step (MXU + the one-hot
     #   VPU chain Mosaic will not overlap) + ~15 ns per spilled entry.
     #   This is the bound parameter tuning cannot beat; going below it
     #   needs a different expansion algorithm or a Mosaic change.
@@ -181,9 +181,15 @@ def main():
     spills = int(tb.z_sched.spill_vals.shape[0]) + int(
         tb.g_sched.spill_vals.shape[0]
     )
-    macs_per_step = 2 * 2 * 128 * 128 * L  # 2 passes' worth per side
-    mxu_floor_ms = steps_total * macs_per_step * 2 / 197e12 * 1e3
-    dispatched_bound_ms = steps_total * 3.9e-3 + spills * 15e-6
+    # per grid step: one gather matmul [128,128]x[128,L] + one scatter
+    # matmul [128,L]x[L,128] (bf16x2w fuses the hi/lo split into these
+    # full-width tiles), 128*128*L MACs each
+    macs_per_step = 2 * 128 * 128 * L
+    mxu_floor_ms = steps_total * macs_per_step * 2 / 197e12 * 1e3  # FLOPs
+    # measured round-4 dispatched cost: 16.4 ms / 8192 total steps =
+    # ~2.0 us per grid step (MXU + the one-hot VPU chain Mosaic will not
+    # overlap) — the bound parameter tuning cannot beat
+    dispatched_bound_ms = steps_total * 2.0e-3 + spills * 15e-6
     sched_bytes = sum(
         int(np.asarray(a).nbytes)
         for s_ in (tb.z_sched, tb.g_sched)
@@ -218,8 +224,9 @@ def main():
                 "grid_steps_per_eval": int(steps_total),
                 "spilled_entries_per_eval": spills,
                 "model": (
-                    "3.9us/step + 15ns/spill (PERF_NOTES r4); MXU floor "
-                    "at 197 bf16 TFLOP/s; HBM at 819 GB/s"
+                    "2.0us/grid-step (r4 measured: 16.4ms / 8192 steps) "
+                    "+ 15ns/spill; MXU floor at 197 bf16 TFLOP/s; HBM at "
+                    "819 GB/s"
                 ),
             },
             "device": str(jax.devices()[0]),
@@ -693,7 +700,24 @@ def _streaming_config(name, *, n_files=8, rows_per_file=125_000, d=200_000,
             return time.perf_counter() - t0
 
         eval1_s = one_eval()  # decode + cache populate (+ compile)
-        eval2_s = min(one_eval() for _ in range(3))  # cached
+        eval_rt_s = min(one_eval() for _ in range(3))  # cached + readback
+
+        # Cached-eval DEVICE rate with the tunnel readback amortized
+        # (PERF_NOTES protocol: each host<->device readback costs ~100 ms
+        # over the axon relay and would otherwise dominate; a local chip
+        # pays ~us). Chained evals keep a real data dependency.
+        def eval_chain(m):
+            t0 = time.perf_counter()
+            w_ = w
+            for _ in range(m):
+                v, g = obj.value_and_gradient(w_, 0.1)
+                w_ = w_ - 1e-9 * g
+            _ = float(v) + float(jnp.sum(g))
+            return time.perf_counter() - t0
+
+        t1 = min(eval_chain(1) for _ in range(2))
+        t7 = min(eval_chain(7) for _ in range(2))
+        eval2_s = max((t7 - t1) / 6, 1e-9)
         n = stats.num_rows
         return {
             "config": name,
@@ -707,6 +731,10 @@ def _streaming_config(name, *, n_files=8, rows_per_file=125_000, d=200_000,
                 "n_files": n_files,
                 "eval1_s_decode": round(eval1_s, 2),
                 "eval2_s_cached": round(eval2_s, 3),
+                "eval_s_cached_with_readback": round(eval_rt_s, 3),
+                "kernel_path": (
+                    "tiled_scan" if obj._tiled_chunk_count else "scatter"
+                ),
                 "cache_speedup": round(eval1_s / eval2_s, 1),
                 "scan_s": round(scan_s, 2),
                 "examples_per_sec_decode_eval": round(n / eval1_s),

@@ -305,23 +305,28 @@ def streaming_summary(
         if res is not None:
             wgt = np.asarray(chunk.weights)
             real = np.nonzero(wgt > 0)[0]
-            ix_np = np.asarray(chunk.indices)
-            v_np = np.asarray(chunk.values)
-            lab_np = np.asarray(chunk.labels)
-            off_np = np.asarray(chunk.offsets)
-            for r in real:  # algorithm R, exact
-                seen += 1
-                if seen <= K:
-                    slot = seen - 1
-                elif rng.random() < K / seen:
-                    slot = rng.integers(0, K)
-                else:
-                    continue
-                res["ix"][slot] = ix_np[r]
-                res["v"][slot] = v_np[r]
-                res["lab"][slot] = lab_np[r]
-                res["off"][slot] = off_np[r]
-                res["wgt"][slot] = wgt[r]
+            m = len(real)
+            if m:
+                # vectorized algorithm R (exact): per-row independent
+                # acceptance draws + random slots; numpy fancy assignment
+                # applies duplicates in order, so the LAST accepted row
+                # wins a contested slot — identical to the sequential
+                # algorithm. One rng call per chunk, not per row.
+                t = seen + 1 + np.arange(m)  # global 1-based row ranks
+                fill_mask = t <= K
+                slots = np.where(fill_mask, t - 1, 0)
+                u = rng.random(m)
+                accept = fill_mask | (u < K / t)
+                rand_slots = rng.integers(0, K, size=m)
+                slots = np.where(fill_mask, slots, rand_slots)
+                sel = real[accept]
+                dst = slots[accept]
+                res["ix"][dst] = np.asarray(chunk.indices)[sel]
+                res["v"][dst] = np.asarray(chunk.values)[sel]
+                res["lab"][dst] = np.asarray(chunk.labels)[sel]
+                res["off"][dst] = np.asarray(chunk.offsets)[sel]
+                res["wgt"][dst] = wgt[sel]
+                seen += m
     if acc is None:
         raise ValueError(f"no rows found under {paths!r}")
     if jax.process_count() > 1:
@@ -522,9 +527,9 @@ class StreamingGLMObjective:
         )
         self.tiled_cache_bytes = int(tiled_cache_bytes)
         self.tile_params = tile_params
-        self._tiled_chunks: Optional[List] = None  # [TiledSparseBatch]
+        self._tiled_chunk_count: Optional[int] = None
+        self._tiled_stacked = None  # chunk-stacked TiledSparseBatch pytree
         self._tiled_objective = None
-        self._tiled_partial = None
 
     # -- tiled cached path --------------------------------------------------
 
@@ -563,9 +568,9 @@ class StreamingGLMObjective:
                 if params is None:
                     # chunks share the staging shape; the first chunk's
                     # occupancy fixes the grid-step width for all
+                    # (resolved() divides by the tile count itself)
                     params = params0.resolved(
-                        max(1, len(vals) // max(z_blocks * g_blocks, 1)),
-                        z_blocks * g_blocks,
+                        len(vals), z_blocks * g_blocks
                     )
                 fz = pool.submit(
                     ts._build_schedule_np, rows, feats, vals,
@@ -592,7 +597,7 @@ class StreamingGLMObjective:
                     np.asarray(batch.weights),
                 ))
         if not built:
-            self._tiled_chunks = []
+            self._tiled_chunk_count = 0
             return
         # pad every kept schedule to ONE static shape so a single
         # compiled program serves all chunks
@@ -609,47 +614,99 @@ class StreamingGLMObjective:
         def pad_rows(a):
             out = np.zeros(r_pad, np.float32)
             out[: a.shape[0]] = a
-            return jnp.asarray(out)
+            return out
 
-        tiled: List = []
-        for z, g, lab, off, wgt in built:
-            z = ts._pad_schedule_np(z, gz, z_blocks, sz)
-            g = ts._pad_schedule_np(g, gg, g_blocks, sg)
-            tiled.append(
-                ts.TiledSparseBatch(
-                    meta=meta,
-                    z_sched=ts._Schedule(*map(jnp.asarray, z)),
-                    g_sched=ts._Schedule(*map(jnp.asarray, g)),
-                    g_vals_sq=jnp.asarray(g[5] ** 2),
-                    labels=pad_rows(lab),
-                    offsets=pad_rows(off),
-                    weights=pad_rows(wgt),
-                )
+        # ALL cached chunks evaluate in ONE dispatch: leaves stacked along
+        # a leading chunk axis (stacked HOST-side — one device copy, no
+        # per-chunk device duplicates) and folded by lax.scan — per-chunk
+        # python dispatches cost ~10 ms each over a tunneled chip, which
+        # at 16 chunks dwarfed the kernels themselves
+        n_chunks = len(built)
+        padded = [
+            (
+                ts._pad_schedule_np(z, gz, z_blocks, sz),
+                ts._pad_schedule_np(g, gg, g_blocks, sg),
+                lab, off, wgt,
             )
+            for z, g, lab, off, wgt in built
+        ]
+        del built
+
+        def lead(items):
+            arrs = list(items)
+            return jnp.asarray(
+                np.stack(arrs) if n_chunks > 1 else arrs[0]
+            )
+
+        self._tiled_stacked = ts.TiledSparseBatch(
+            meta=meta,
+            z_sched=ts._Schedule(
+                *(lead(p[0][i] for p in padded) for i in range(9))
+            ),
+            g_sched=ts._Schedule(
+                *(lead(p[1][i] for p in padded) for i in range(9))
+            ),
+            g_vals_sq=lead(p[1][5] ** 2 for p in padded),
+            labels=lead(pad_rows(p[2]) for p in padded),
+            offsets=lead(pad_rows(p[3]) for p in padded),
+            weights=lead(pad_rows(p[4]) for p in padded),
+        )
+        del padded
         from photon_ml_tpu.utils.backend import effective_platform
 
         self._tiled_objective = ts.TiledGLMObjective(
             self._loss, self.dim, self.norm,
             interpret=effective_platform() == "cpu",
         )
-        self._tiled_partial = jax.jit(
-            lambda w, tb: self._tiled_objective.value_and_gradient(w, tb, 0.0)
+        self._tiled_chunk_count = n_chunks
+        obj = self._tiled_objective
+
+        def _scan(w, stacked, fold):
+            if n_chunks <= 1:
+                return fold(w, stacked)
+
+            def body(carry, tb):
+                out = fold(w, tb)
+                return jax.tree.map(jnp.add, carry, out), None
+
+            init = jax.tree.map(
+                jnp.zeros_like, jax.eval_shape(fold, w, jax.tree.map(
+                    lambda x: x[0], stacked
+                ))
+            )
+            carry, _ = jax.lax.scan(body, init, stacked)
+            return carry
+
+        self._tiled_vg_all = jax.jit(
+            lambda w, st: _scan(
+                w, st, lambda w_, tb: obj.value_and_gradient(w_, tb, 0.0)
+            )
         )
-        self._tiled_chunks = tiled
+        self._tiled_hv_all = jax.jit(
+            lambda w, d, st: _scan(
+                (w, d), st,
+                lambda wd, tb: obj.hessian_vector(wd[0], wd[1], tb, 0.0),
+            )
+        )
+        self._tiled_hd_all = jax.jit(
+            lambda w, st: _scan(
+                w, st, lambda w_, tb: obj.hessian_diagonal(w_, tb, 0.0)
+            )
+        )
 
     def _ensure_tiled(self) -> bool:
         if not (self._use_tiled and self._cached):
             return False
-        if self._tiled_chunks is None:
+        if self._tiled_chunk_count is None:
             self._build_tiled_chunks()
-        return bool(self._tiled_chunks)
+        return self._tiled_chunk_count > 0
 
     def _overflow_chunks(self) -> Iterator[SparseBatch]:
         """Cached chunks past the tiled-cache budget (scatter fallback)."""
         import itertools
 
         yield from itertools.islice(
-            self.chunks(), len(self._tiled_chunks), None
+            self.chunks(), self._tiled_chunk_count, None
         )
 
     def _chunk_nbytes(self) -> int:
@@ -718,13 +775,7 @@ class StreamingGLMObjective:
 
         hv = jnp.zeros((self.dim,), jnp.float32)
         if self._ensure_tiled():
-            if getattr(self, "_tiled_hv", None) is None:
-                obj = self._tiled_objective
-                self._tiled_hv = jax.jit(
-                    lambda w_, d_, tb: obj.hessian_vector(w_, d_, tb, 0.0)
-                )
-            for tb in self._tiled_chunks:
-                hv = hv + self._tiled_hv(w, direction, tb)
+            hv = hv + self._tiled_hv_all(w, direction, self._tiled_stacked)
             chunks = self._overflow_chunks()
         else:
             chunks = self.chunks()
@@ -748,13 +799,7 @@ class StreamingGLMObjective:
 
         diag = jnp.zeros((self.dim,), jnp.float32)
         if self._ensure_tiled():
-            if getattr(self, "_tiled_hd", None) is None:
-                obj = self._tiled_objective
-                self._tiled_hd = jax.jit(
-                    lambda w_, tb: obj.hessian_diagonal(w_, tb, 0.0)
-                )
-            for tb in self._tiled_chunks:
-                diag = diag + self._tiled_hd(w, tb)
+            diag = diag + self._tiled_hd_all(w, self._tiled_stacked)
             chunks = self._overflow_chunks()
         else:
             chunks = self.chunks()
@@ -773,13 +818,12 @@ class StreamingGLMObjective:
         value = jnp.float32(0.0)
         grad = jnp.zeros((self.dim,), jnp.float32)
         if self._ensure_tiled():
-            # cached fast path: one async tiled dispatch per chunk,
-            # accumulated on device (the caller's value readback is the
-            # only sync point — dispatches pipeline behind each other)
-            for tb in self._tiled_chunks:
-                v, g = self._tiled_partial(w, tb)
-                value = value + v
-                grad = grad + g
+            # cached fast path: EVERY tiled chunk folds inside one
+            # jitted lax.scan dispatch (per-chunk dispatches cost ~10 ms
+            # each over a tunneled chip)
+            v, g = self._tiled_vg_all(w, self._tiled_stacked)
+            value = value + v
+            grad = grad + g
             for batch in self._overflow_chunks():
                 v, g = self._partial(w, batch)
                 value = value + v

@@ -522,8 +522,10 @@ def train_streaming_glm(
             )
         variances = None
         if compute_variances:
+            from photon_ml_tpu.optim.problem import _VARIANCE_EPSILON
+
             hd = objective.hessian_diagonal(result.coefficients, l2)
-            variances = 1.0 / (hd + 1e-12)
+            variances = 1.0 / (hd + _VARIANCE_EPSILON)
         models[lam] = create_model(
             task,
             Coefficients(

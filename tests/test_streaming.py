@@ -364,8 +364,7 @@ class TestStreamingTiledKernel:
         np.testing.assert_allclose(float(v1_t), float(v1_s), rtol=1e-5)
         # eval 2: tiled objective switches to the per-chunk schedules
         v2_t, g2_t = tiled.value_and_gradient(w, 0.3)
-        assert tiled._tiled_chunks, "tiled chunk cache was not built"
-        assert len(tiled._tiled_chunks) == 4  # 240 rows / 64 per chunk
+        assert tiled._tiled_chunk_count == 4  # 240 rows / 64 per chunk
         v2_s, g2_s = scatter.value_and_gradient(w, 0.3)
         np.testing.assert_allclose(float(v2_t), float(v2_s), rtol=2e-4)
         np.testing.assert_allclose(
@@ -392,7 +391,7 @@ class TestStreamingTiledKernel:
         )
         v1, _ = obj.value_and_gradient(w, 0.2)
         v2, g2 = obj.value_and_gradient(w, 0.2)
-        assert 0 < len(obj._tiled_chunks) < 3
+        assert 0 < obj._tiled_chunk_count < 3
         np.testing.assert_allclose(float(v2), float(v1), rtol=2e-4)
 
     def test_streaming_elastic_net_on_tiled_cache(self, tmp_path, rng):

@@ -241,7 +241,7 @@ try:
     ws = jnp.asarray(r.normal(size=sobj.dim).astype(np.float32) * 0.1)
     v1, g1 = sobj.value_and_gradient(ws, 0.3)   # populate (scatter)
     v2, g2 = sobj.value_and_gradient(ws, 0.3)   # cached (tiled Pallas)
-    assert sobj._tiled_chunks, "tiled chunk cache was not built on TPU"
+    assert sobj._tiled_chunk_count, "tiled chunk cache was not built on TPU"
     assert abs(float(v2) - float(v1)) / abs(float(v1)) < 2e-4, (v1, v2)
     gerr = float(jnp.max(jnp.abs(g2 - g1)) / (jnp.max(jnp.abs(g1)) + 1e-9))
     assert gerr < 2e-3, gerr
