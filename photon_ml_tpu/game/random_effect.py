@@ -402,6 +402,44 @@ def _bucket_solver(
 
         return fused
 
+    def _fused_scan(core):
+        """The fused bucket update folded over a STACK of same-shape
+        buckets by lax.scan — one dispatch for the whole group. Profiled
+        at the config-4 user-bank shape (PERF_NOTES round 5): the four
+        sequential per-bucket dispatches left ~125 ms of host gaps
+        between ~76 ms device programs; scanning removes the gaps. The
+        bank threads through the scan carry (donated, in-place
+        scatters)."""
+        from photon_ml_tpu.utils.backend import effective_platform
+
+        donate = (0,) if effective_platform() != "cpu" else ()
+
+        @partial(jax.jit, donate_argnums=donate)
+        def fused_scan(bank_full, codes_s, ix_s, v_s, lab_s, off_s, w_s,
+                       l1, l2):
+            def body(bank, args):
+                codes, ix, v, lab, off, w = args
+                sl = jnp.take(bank, codes, axis=0)
+                new_sl, iters, reasons = core(sl, ix, v, lab, off, w, l1, l2)
+                bank = bank.at[codes].set(new_sl)
+                return bank, (
+                    jnp.sum(iters),
+                    jnp.max(iters),
+                    jnp.bincount(reasons, length=n_reasons),
+                )
+
+            bank_full, (it_sums, it_maxs, counts) = jax.lax.scan(
+                body, bank_full, (codes_s, ix_s, v_s, lab_s, off_s, w_s)
+            )
+            return (
+                bank_full,
+                jnp.sum(it_sums),
+                jnp.max(it_maxs),
+                jnp.sum(counts, axis=0),
+            )
+
+        return fused_scan
+
     @jax.jit
     def hdiag(sl, ix, v, lab, off, w, l2):
         """Per-entity Hessian diagonals at the given bank rows:
@@ -437,6 +475,11 @@ def _bucket_solver(
         fused_dense_id=_fused(solve_dense_id),
         fused_newton=_fused(solve_newton),
         fused_newton_id=_fused(solve_newton_id),
+        fused_scan_sparse=_fused_scan(solve),
+        fused_scan_dense=_fused_scan(solve_dense),
+        fused_scan_dense_id=_fused_scan(solve_dense_id),
+        fused_scan_newton=_fused_scan(solve_newton),
+        fused_scan_newton_id=_fused_scan(solve_newton_id),
         hdiag=hdiag,
     )
 
@@ -622,6 +665,42 @@ class RandomEffectOptimizationProblem:
                 routed = router.route(residual_offsets)
         return residual_offsets, routed, router
 
+    def _stacked_group_args(self, dataset, members, *, with_residuals):
+        """Device-stacked [B, ...] args for a same-shape bucket group,
+        built from the HOST arrays in one transfer per field and cached
+        on the dataset. Only the offset source the configuration needs is
+        stacked: stored offsets when ``with_residuals`` is False, row
+        indices (for the on-device residual gather) when True — never
+        both (a dead [B, E, S] buffer would otherwise pin HBM for the
+        dataset's lifetime).
+
+        Accepted trade-off: a dataset that ALSO runs the per-bucket path
+        (bank_variances / with_variances) holds its buckets in both this
+        cache and the per-bucket device cache; the two paths do not
+        co-occur within one update, and problems are variance-typed for
+        their lifetime, so the overlap is rare in practice."""
+        cache = dataset.__dict__.setdefault("_stacked_device_cache", {})
+        key = (tuple(members), bool(with_residuals))
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+        bs = [dataset.buckets[bi] for bi in members]
+        out = (
+            jnp.asarray(np.stack([b.entity_codes for b in bs])),
+            jnp.asarray(np.stack([b.indices for b in bs])),
+            jnp.asarray(np.stack([b.values for b in bs])),
+            jnp.asarray(np.stack([b.labels for b in bs])),
+            None
+            if with_residuals
+            else jnp.asarray(np.stack([b.offsets for b in bs])),
+            jnp.asarray(np.stack([b.weights for b in bs])),
+            jnp.asarray(np.stack([b.row_index for b in bs]))
+            if with_residuals
+            else None,
+        )
+        cache[key] = out
+        return out
+
     def _bucket_offsets(
         self, bi, bucket, rows_d, residual_offsets, routed, router
     ):
@@ -646,13 +725,56 @@ class RandomEffectOptimizationProblem:
         has_residual_offsets: bool,
         l1_d,
         l2_d,
+        groups=None,
     ):
         """(sig, thunk) plans for every DISTINCT bucket program of one
         dataset; ``thunk()`` lowers the bucket's exact solver call and
-        returns the compiled executable."""
+        returns the compiled executable. With ``groups`` (the update_bank
+        fold grouping) multi-member groups plan the SCAN program from
+        avals instead of per-bucket programs."""
         plans = []
+        if groups is not None:
+            singles = []
+            seen_scan_sigs = set()
+            for sig, members in groups:
+                if len(members) == 1:
+                    singles.append(members[0])
+                    continue
+                kind = sig[0]
+                bucket = dataset.buckets[members[0]]
+                E, S = bucket.labels.shape
+                ixk = bucket.indices.shape
+                B = len(members)
+                scan_sig = (
+                    "scan", kind, bank.shape, (B,) + ixk
+                )
+                if scan_sig in seen_scan_sigs:
+                    continue  # identical program; one compile suffices
+                seen_scan_sigs.add(scan_sig)
+
+                def thunk(kind=kind, B=B, E=E, S=S, ixk=ixk, bank=bank):
+                    sds = jax.ShapeDtypeStruct
+                    f32, i32 = jnp.float32, jnp.int32
+                    fused_scan = getattr(
+                        self._solvers, f"fused_scan_{kind}"
+                    )
+                    return fused_scan.lower(
+                        bank,
+                        sds((B, E), i32),
+                        sds((B,) + ixk, i32),
+                        sds((B,) + ixk, f32),
+                        sds((B, E, S), f32),
+                        sds((B, E, S), f32),
+                        sds((B, E, S), f32),
+                        l1_d, l2_d,
+                    ).compile()
+
+                plans.append((scan_sig, thunk))
+            buckets_iter = [(bi, dataset.buckets[bi]) for bi in singles]
+        else:
+            buckets_iter = list(enumerate(dataset.buckets))
         seen_sigs = set()
-        for bi, bucket in enumerate(dataset.buckets):
+        for bi, bucket in buckets_iter:
             kind = self._bucket_kind(bucket, bank.shape[1])
             sig = (kind, bank.shape, bucket.indices.shape)
             if sig in seen_sigs:
@@ -774,14 +896,65 @@ class RandomEffectOptimizationProblem:
         var_bank = jnp.zeros_like(bank) if with_variances else None
         if with_variances:
             from photon_ml_tpu.optim.problem import _VARIANCE_EPSILON
+        # Same-shape bucket RUNS fold into one lax.scan dispatch (the
+        # profiled ~125 ms of host gaps between per-bucket dispatches at
+        # the config-4 shape, PERF_NOTES round 5); per-bucket paths keep
+        # handling the mesh / values_override / variances cases.
+        fold_eligible = (
+            self.mesh is None
+            and values_override is None
+            and not with_variances
+            and len(dataset.buckets) > 1
+        )
+        groups: List = []
+        if fold_eligible:
+            for bi, bucket in enumerate(dataset.buckets):
+                kind = self._bucket_kind(bucket, bank.shape[1])
+                sig = (kind, bucket.indices.shape)
+                if groups and groups[-1][0] == sig:
+                    groups[-1][1].append(bi)
+                else:
+                    groups.append((sig, [bi]))
+        else:
+            groups = [(None, [bi]) for bi in range(len(dataset.buckets))]
         if self.mesh is None and dataset.buckets:
             self._warm_solvers(self._bucket_plans(
                 bank, dataset,
                 has_values_override=values_override is not None,
                 has_residual_offsets=residual_offsets is not None,
                 l1_d=l1_d, l2_d=l2_d,
+                groups=groups if fold_eligible else None,
             ))
-        for bi, bucket in enumerate(dataset.buckets):
+        for sig, members in groups:
+            if len(members) > 1:
+                kind = sig[0]
+                (
+                    codes_s, ix_s, v_s, lab_s, off_s, w_s, rows_s,
+                ) = self._stacked_group_args(
+                    dataset, members,
+                    with_residuals=residual_offsets is not None,
+                )
+                if residual_offsets is not None:
+                    off_s = jnp.where(
+                        rows_s >= 0,
+                        residual_offsets[jnp.maximum(rows_s, 0)],
+                        0.0,
+                    )
+                fused_scan = self._aot_cache.get(
+                    ("scan", kind, bank.shape, ix_s.shape)
+                ) or getattr(self._solvers, f"fused_scan_{kind}")
+                bank, it_sum, it_max, counts = fused_scan(
+                    bank, codes_s, ix_s, v_s, lab_s, off_s, w_s, l1_d, l2_d
+                )
+                n_reals.append(
+                    sum(dataset.buckets[bi].num_entities for bi in members)
+                )
+                stat_vecs.append(
+                    jnp.concatenate([jnp.stack([it_sum, it_max]), counts])
+                )
+                continue
+            bi = members[0]
+            bucket = dataset.buckets[bi]
             (
                 ix_d, v_d, lab_d, w_d, off_d, rows_d, codes_d,
             ) = self._bucket_device_args(
