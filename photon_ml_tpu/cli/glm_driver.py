@@ -381,16 +381,29 @@ class GLMDriver:
                 if needs_summary:
                     # one more bounded-memory pass: streamed colStats
                     # (+ a reservoir sample of rows when diagnostics will
-                    # need row-level resampling)
+                    # need row-level resampling). streaming_summary
+                    # all-reduces moments across processes, so each
+                    # process must scan only ITS file shard — passing the
+                    # full set would multiply every moment by the process
+                    # count.
+                    import jax
+
                     from photon_ml_tpu.io.streaming import streaming_summary
 
+                    summary_paths = train_paths
+                    if jax.process_count() > 1:
+                        from photon_ml_tpu.io.streaming import (
+                            shard_avro_files,
+                        )
+
+                        summary_paths = shard_avro_files(train_paths)
                     reservoir = (
                         100_000
                         if p.diagnostic_mode != DiagnosticMode.NONE
                         else 0
                     )
                     self._summary, self._stream_sample = streaming_summary(
-                        train_paths, fmt, index_map, stats,
+                        summary_paths, fmt, index_map, stats,
                         reservoir_rows=reservoir,
                     )
                     self._norm = build_normalization(

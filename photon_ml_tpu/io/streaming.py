@@ -246,6 +246,25 @@ def _prefetched(source: Iterator, depth: int = 2) -> Iterator:
         stop.set()
 
 
+def shard_avro_files(paths):
+    """Cross-process-consistent shard of a path set's .avro files: the
+    GLOBAL sort before the round-robin split is load-bearing — every host
+    must agree on the file order or the shards overlap. One definition
+    shared by the streaming trainer, the driver's summary pass, and
+    tests."""
+    import jax
+
+    from photon_ml_tpu.io.paths import expand_input_paths
+    from photon_ml_tpu.parallel.multihost import process_shard
+
+    files = sorted(
+        expand_input_paths(paths, lambda fn: fn.endswith(".avro"))
+    )
+    if not files:
+        raise ValueError(f"no .avro inputs under {paths!r}")
+    return process_shard(files)
+
+
 def streaming_summary(
     paths,
     fmt,
@@ -266,7 +285,11 @@ def streaming_summary(
 
     Returns ``(summary, sample_batch_or_None)``. Multi-host: moments
     reduce across processes; the reservoir stays process-local (used only
-    by the coordinator's diagnostics).
+    by the coordinator's diagnostics) — i.e. it is drawn from the
+    coordinator's 1/P round-robin file shard, not the full set. The
+    round-robin split interleaves date/source-partitioned files, which
+    keeps the sample roughly representative; exact global sampling would
+    need a cross-host exchange that diagnostics do not warrant.
     """
     import jax
     import jax.numpy as jnp
@@ -328,7 +351,21 @@ def streaming_summary(
                 res["wgt"][dst] = wgt[sel]
                 seen += m
     if acc is None:
-        raise ValueError(f"no rows found under {paths!r}")
+        if jax.process_count() <= 1:
+            raise ValueError(f"no rows found under {paths!r}")
+        # a process can own ZERO file shards when processes outnumber
+        # files — it still joins the cross-host reduction with inert
+        # moments
+        big = jnp.float32(jnp.inf)
+        acc = [
+            jnp.float32(0.0),
+            jnp.zeros((dim,), jnp.float32),
+            jnp.zeros((dim,), jnp.float32),
+            jnp.zeros((dim,), jnp.float32),
+            jnp.zeros((dim,), jnp.float32),
+            jnp.full((dim,), -big),
+            jnp.full((dim,), big),
+        ]
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
@@ -342,6 +379,11 @@ def streaming_summary(
         acc[6] = jnp.asarray(
             multihost_utils.process_allgather(acc[6]).min(axis=0)
         )
+        if int(acc[0]) == 0:
+            # same contract as single-process: .avro files that exist but
+            # hold zero rows must not produce a benign-looking summary
+            # (mean 0 / variance 1) and train garbage normalization
+            raise ValueError(f"no rows found under {paths!r} on any host")
     summary = finalize_summary(*acc)
     sample = None
     if res is not None:

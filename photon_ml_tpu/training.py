@@ -384,7 +384,6 @@ def train_streaming_glm(
     import jax.numpy as jnp
 
     from photon_ml_tpu.io.input_format import AvroInputDataFormat
-    from photon_ml_tpu.io.paths import expand_input_paths
     from photon_ml_tpu.io.streaming import StreamingGLMObjective, scan_stream
     from photon_ml_tpu.models.coefficients import Coefficients
     from photon_ml_tpu.models.glm import create_model
@@ -422,14 +421,9 @@ def train_streaming_glm(
                 "map (build one with the feature-indexing job); no single "
                 "process sees the whole vocabulary"
             )
-        from photon_ml_tpu.parallel.multihost import process_shard
+        from photon_ml_tpu.io.streaming import shard_avro_files
 
-        files = sorted(
-            expand_input_paths(paths, lambda fn: fn.endswith(".avro"))
-        )
-        if not files:
-            raise ValueError(f"no .avro inputs under {paths!r}")
-        paths = process_shard(files)
+        paths = shard_avro_files(paths)
         if stats is None:
             # local stats -> global agreement (max nnz must match across
             # processes: it fixes the compiled staging shape). A process

@@ -303,3 +303,72 @@ class TestTwoProcessStreaming:
         w2 = np.load(tmp_path / "w2proc.npy")
         w1 = np.asarray(models[0.5].coefficients.means)
         np.testing.assert_allclose(w2, w1, rtol=2e-3, atol=2e-4)
+
+
+@pytest.mark.slow
+class TestTwoProcessStreamingSummary:
+    def test_streamed_summary_two_processes(self, tmp_path, rng):
+        """Multi-host streamed colStats: each process scans only ITS file
+        shard and moments all-reduce — the result must equal the
+        single-process summary over the full set (double-counting every
+        moment by the process count is the failure this pins)."""
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_streaming import _write_files
+
+        train = tmp_path / "train"
+        train.mkdir()
+        _write_files(train, rng, n_files=4, rows_per_file=60)
+        port = _free_port()
+
+        def script(pid):
+            return textwrap.dedent(f"""
+                import jax
+                jax.config.update("jax_platforms", "cpu")
+                import numpy as np
+                from photon_ml_tpu.parallel.multihost import (
+                    initialize_multihost, process_shard,
+                )
+                initialize_multihost("127.0.0.1:{port}", 2, {pid})
+                from photon_ml_tpu.io.input_format import AvroInputDataFormat
+                from photon_ml_tpu.io.paths import expand_input_paths
+                from photon_ml_tpu.io.streaming import (
+                    scan_stream, streaming_summary,
+                )
+
+                fmt = AvroInputDataFormat()
+                index_map, stats = scan_stream([{str(train)!r}], fmt)
+                files = process_shard(sorted(expand_input_paths(
+                    [{str(train)!r}], lambda fn: fn.endswith(".avro")
+                )))
+                summary, _ = streaming_summary(
+                    files, fmt, index_map, stats
+                )
+                if jax.process_index() == 0:
+                    np.savez(
+                        {str(tmp_path / "summary2.npz")!r},
+                        mean=np.asarray(summary.mean),
+                        variance=np.asarray(summary.variance),
+                        count=np.asarray(summary.count),
+                        nnz=np.asarray(summary.num_nonzeros),
+                        mx=np.asarray(summary.max),
+                        mn=np.asarray(summary.min),
+                    )
+            """)
+
+        _run_two_processes(script)
+
+        from photon_ml_tpu.io.input_format import AvroInputDataFormat
+        from photon_ml_tpu.io.streaming import scan_stream, streaming_summary
+
+        fmt = AvroInputDataFormat()
+        index_map, stats = scan_stream([str(train)], fmt)
+        ref, _ = streaming_summary([str(train)], fmt, index_map, stats)
+        import numpy as np
+
+        got = np.load(tmp_path / "summary2.npz")
+        assert int(got["count"]) == int(ref.count)
+        np.testing.assert_allclose(got["mean"], np.asarray(ref.mean), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(got["variance"], np.asarray(ref.variance), rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(got["nnz"], np.asarray(ref.num_nonzeros))
+        np.testing.assert_allclose(got["mx"], np.asarray(ref.max), atol=1e-6)
+        np.testing.assert_allclose(got["mn"], np.asarray(ref.min), atol=1e-6)
