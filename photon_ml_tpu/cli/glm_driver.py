@@ -422,11 +422,22 @@ class GLMDriver:
                             self._write_summary(p.summarization_output_dir)
                 if p.data_validation_type != DataValidationType.VALIDATE_DISABLED:
                     # chunk-wise sanity checks — same DataValidators rules
-                    # as the in-memory path, still bounded memory
+                    # as the in-memory path, still bounded memory; each
+                    # process checks only ITS file shard (the checks are
+                    # per-chunk, no cross-host reduce needed)
+                    import jax
+
                     from photon_ml_tpu.io.streaming import iter_chunks
 
+                    check_paths = train_paths
+                    if jax.process_count() > 1:
+                        from photon_ml_tpu.io.streaming import (
+                            shard_avro_files,
+                        )
+
+                        check_paths = shard_avro_files(train_paths)
                     for chunk in iter_chunks(
-                        train_paths, fmt, index_map,
+                        check_paths, fmt, index_map,
                         rows_per_chunk=65536, nnz_width=stats.max_nnz,
                     ):
                         sanity_check_data(

@@ -330,16 +330,13 @@ class TestTwoProcessStreamingSummary:
                 )
                 initialize_multihost("127.0.0.1:{port}", 2, {pid})
                 from photon_ml_tpu.io.input_format import AvroInputDataFormat
-                from photon_ml_tpu.io.paths import expand_input_paths
                 from photon_ml_tpu.io.streaming import (
-                    scan_stream, streaming_summary,
+                    scan_stream, shard_avro_files, streaming_summary,
                 )
 
                 fmt = AvroInputDataFormat()
                 index_map, stats = scan_stream([{str(train)!r}], fmt)
-                files = process_shard(sorted(expand_input_paths(
-                    [{str(train)!r}], lambda fn: fn.endswith(".avro")
-                )))
+                files = shard_avro_files([{str(train)!r}])
                 summary, _ = streaming_summary(
                     files, fmt, index_map, stats
                 )
