@@ -661,11 +661,11 @@ class TestLargeScaleREBuild:
             },
             num_real_rows=n,
         )
-        t0 = time.process_time()
+        t0 = time.thread_time()
         red = build_random_effect_dataset(
             ds, RandomEffectDataConfiguration("userId", "userShard")
         )
-        build_s = time.process_time() - t0
+        build_s = time.thread_time() - t0
         assert red.num_entities == E
         assert red.num_active_rows == n
         # each bucket's capacity covers the max active count of its members
@@ -681,8 +681,9 @@ class TestLargeScaleREBuild:
         # host-saturating vectorized build: ~2-3 s typical; generous CI
         # bound still catches any reintroduced per-row Python loop (~13 s+)
         # guards the vectorized build against regressing to the round-2
-        # per-row loop (17-77 s at this scale); PROCESS CPU time, so
-        # concurrent host load cannot flake it on a 1-core box
+        # per-row loop (17-77 s at this scale); CURRENT-THREAD CPU time,
+        # so neither concurrent host load nor leftover worker threads
+        # from earlier test modules can flake it on a 1-core box
         assert build_s < 15.0, build_s
 
     def test_million_row_build_with_cap(self, rng):
@@ -716,21 +717,22 @@ class TestLargeScaleREBuild:
             },
             num_real_rows=n,
         )
-        t0 = time.process_time()
+        t0 = time.thread_time()
         red = build_random_effect_dataset(
             ds,
             RandomEffectDataConfiguration(
                 "userId", "userShard", active_data_upper_bound=8
             ),
         )
-        build_s = time.process_time() - t0
+        build_s = time.thread_time() - t0
         assert red.num_active_rows + red.num_passive_rows == n
         # reservoir weight mass preserved per entity: sum over buckets
         total_mass = sum(float(b.weights.sum()) for b in red.buckets)
         assert total_mass == pytest.approx(n, rel=1e-3)
         # guards the vectorized build against regressing to the round-2
-        # per-row loop (17-77 s at this scale); PROCESS CPU time, so
-        # concurrent host load cannot flake it on a 1-core box
+        # per-row loop (17-77 s at this scale); CURRENT-THREAD CPU time,
+        # so neither concurrent host load nor leftover worker threads
+        # from earlier test modules can flake it on a 1-core box
         assert build_s < 15.0, build_s
 
 
