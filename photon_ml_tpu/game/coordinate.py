@@ -168,23 +168,60 @@ class FixedEffectCoordinate(Coordinate):
 
         problem = self.problem
         dim = self.dataset.shards[self.feature_shard_id].dim
-        base = self.dataset.batch_for_shard(self.feature_shard_id)
-        host = jax.device_get(base)
         data_shards = int(self.mesh.shape[DATA_AXIS])
         model_shards = int(self.mesh.shape[MODEL_AXIS])
         tiled = isinstance(problem.objective, TiledGLMObjective)
-        if tiled:
-            sharded, block_dim = feature_shard_tiled_batch(
-                host, dim, data_shards, model_shards, mesh=self.mesh
+        # The LAYOUT only depends on the shard + mesh CONTENT + kernel,
+        # not on the optimizer config — cache it on the dataset so a grid
+        # of combos (each building fresh coordinates AND a fresh,
+        # content-identical mesh) pays the multi-second re-layout once,
+        # like batch_for_shard's device cache on the replicated path.
+        # Keyed by mesh content (axes + device ids), not object identity:
+        # shardings over content-equal meshes are interchangeable. The
+        # sparse layout never touches the mesh, so its key omits it.
+        # Bounded to ONE entry per feature shard: a sweep that varies the
+        # mesh shape or kernel must not accumulate device-pinned layouts.
+        layout_cache = self.dataset.__dict__.setdefault(
+            "_fs_layout_cache", {}
+        )
+        mesh_key = (
+            (
+                tuple(self.mesh.axis_names),
+                tuple(int(n) for n in self.mesh.devices.shape),
+                tuple(d.id for d in self.mesh.devices.flat),
             )
-            meta, layout = sharded.meta, "tiled"
-            rows_total = meta.data_shards * meta.rows_per_shard
+            if tiled
+            else None
+        )
+        layout_key = (
+            self.feature_shard_id, data_shards, model_shards, tiled,
+            mesh_key,
+        )
+        hit = layout_cache.get(layout_key)
+        if hit is not None:
+            sharded, block_dim, meta, layout, rows_total = hit
         else:
-            sharded, block_dim = feature_shard_sparse_batch(
-                host, dim, model_shards, rows_multiple=data_shards
+            base = self.dataset.batch_for_shard(self.feature_shard_id)
+            host = jax.device_get(base)
+            if tiled:
+                sharded, block_dim = feature_shard_tiled_batch(
+                    host, dim, data_shards, model_shards, mesh=self.mesh
+                )
+                meta, layout = sharded.meta, "tiled"
+                rows_total = meta.data_shards * meta.rows_per_shard
+            else:
+                sharded, block_dim = feature_shard_sparse_batch(
+                    host, dim, model_shards, rows_multiple=data_shards
+                )
+                meta, layout = None, "sparse"
+                rows_total = sharded.labels.shape[0]
+            for k in [
+                k for k in layout_cache if k[0] == self.feature_shard_id
+            ]:
+                del layout_cache[k]
+            layout_cache[layout_key] = (
+                sharded, block_dim, meta, layout, rows_total
             )
-            meta, layout = None, "sparse"
-            rows_total = sharded.labels.shape[0]
         use_tron = problem.config.optimizer_type == OptimizerType.TRON
         use_owlqn = problem.regularization.has_l1
         norm = problem.objective.norm

@@ -298,3 +298,49 @@ class TestFeatureShardedGameFE:
         np.testing.assert_allclose(
             results["sharded"], results["single"], atol=5e-3
         )
+
+    def test_layout_cached_across_coordinates(self, rng):
+        """A combo grid builds fresh coordinates over the same dataset;
+        the feature-sharded LAYOUT (the multi-second host re-layout) must
+        be built once and shared, with results unchanged."""
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+        recs, _, _ = make_records(rng, n=120, n_users=6)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+
+        def coord(reg_weight):
+            # a FRESH (content-identical) mesh per combo, exactly like
+            # the driver's per-combo _fe_mesh() — the cache must hit on
+            # mesh CONTENT, not object identity
+            return FixedEffectCoordinate(
+                name="fixed",
+                dataset=ds,
+                problem=create_glm_problem(
+                    TaskType.LOGISTIC_REGRESSION,
+                    ds.shards["globalShard"].dim,
+                    config=OptimizerConfig(max_iter=15),
+                    regularization=RegularizationContext(
+                        RegularizationType.L2
+                    ),
+                ),
+                feature_shard_id="globalShard",
+                reg_weight=reg_weight,
+                mesh=make_mesh((4, 2), (DATA_AXIS, MODEL_AXIS)),
+            )
+
+        c1, c2 = coord(0.5), coord(2.0)
+        m1, _ = c1.update_model(c1.initialize_model())
+        m2, _ = c2.update_model(c2.initialize_model())
+        cache = ds.__dict__["_fs_layout_cache"]
+        assert len(cache) == 1  # one layout shared by both combos
+        st1 = c1.__dict__["_fs_state"]
+        st2 = c2.__dict__["_fs_state"]
+        # same underlying per-entry arrays (identity, not equality) —
+        # the layout was built once and shared
+        assert (
+            st1["sharded"].indices is st2["sharded"].indices
+        )
+        # stronger reg shrinks the solution
+        w1 = np.asarray(m1.model.means)
+        w2 = np.asarray(m2.model.means)
+        assert np.linalg.norm(w2) < np.linalg.norm(w1)
