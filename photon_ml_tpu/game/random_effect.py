@@ -62,6 +62,32 @@ class RandomEffectTracker:
     reason_counts: Dict[str, int]
 
 
+# Solver namespaces shared across problem instances with equal
+# (loss, config, regularization): a GAME combo grid builds a fresh
+# RandomEffectOptimizationProblem per combo, and without sharing each
+# re-jits (and, over a relay, re-COMPILES) every bucket program — the
+# reg weights are traced arguments, so combos differing only in lambda
+# are the same programs. The namespace also carries the shared AOT
+# executable cache. FIFO-bounded; unhashable configs fall through to a
+# fresh build.
+_SOLVER_CACHE: dict = {}
+_SOLVER_CACHE_MAX = 16
+
+
+def _cached_bucket_solver(
+    loss: PointwiseLoss,
+    config: OptimizerConfig,
+    regularization: RegularizationContext,
+):
+    from photon_ml_tpu.utils.memo import get_or_build
+
+    return get_or_build(
+        _SOLVER_CACHE, _SOLVER_CACHE_MAX,
+        (loss, config, regularization),
+        lambda: _bucket_solver(loss, config, regularization),
+    )
+
+
 def _bucket_solver(
     loss: PointwiseLoss,
     config: OptimizerConfig,
@@ -517,12 +543,16 @@ class RandomEffectOptimizationProblem:
     def __post_init__(self):
         if self.layout not in ("auto", "sparse", "dense"):
             raise ValueError(f"unknown layout {self.layout!r}")
-        # AOT-compiled bucket programs from the threaded warm pass,
-        # keyed by (kind, bank shape, bucket indices shape)
-        self._aot_cache: Dict[tuple, object] = {}
-        self._solvers = _bucket_solver(
+        self._solvers = _cached_bucket_solver(
             self.loss, self.config, self.regularization
         )
+        # AOT-compiled bucket programs from the threaded warm pass,
+        # keyed by (kind, bank shape, bucket indices shape). Lives ON the
+        # (shared) solver namespace so equal-config problems — a combo
+        # grid's fresh problem per combo — reuse compiled executables.
+        if not hasattr(self._solvers, "aot_cache"):
+            self._solvers.aot_cache = {}
+        self._aot_cache: Dict[tuple, object] = self._solvers.aot_cache
         # Device-resident copies of each bucket's static arrays (indices/
         # values/labels/weights), keyed by id(bucket). Coordinate descent
         # calls update_bank once per iteration with identical bucket data —
@@ -854,6 +884,12 @@ class RandomEffectOptimizationProblem:
         with ThreadPoolExecutor(min(8, len(fresh))) as pool:
             compiled = list(pool.map(lambda item: item[1](), fresh))
         for (sig, _), exe in zip(fresh, compiled):
+            # FIFO-bounded: the cache lives on the SHARED solver
+            # namespace (process lifetime via _SOLVER_CACHE), so a
+            # long-lived driver sweeping many bank/bucket shapes must
+            # not accumulate executables forever
+            while len(self._aot_cache) >= 64:
+                self._aot_cache.pop(next(iter(self._aot_cache)))
             self._aot_cache[sig] = exe
 
     def update_bank(

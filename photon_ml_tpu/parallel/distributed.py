@@ -727,6 +727,29 @@ def feature_sharded_tiled_fit_tron(
     return jax.jit(fit)
 
 
+# Jitted feature-sharded fit programs shared across builder calls: a
+# GAME combo grid builds fresh coordinates (and fresh fit closures) per
+# combo, and without sharing each pays a multi-second re-trace of the
+# optimizer while_loop over the schedule pytrees (the round-2 lesson
+# problem.py's _FIT_CACHE already encodes for the replicated path).
+# Keyed by mesh CONTENT — shardings over content-equal meshes are
+# interchangeable. FIFO-bounded; unhashable keys (e.g. array-carrying
+# normalization contexts inside the objective) skip the cache.
+_FS_FIT_CACHE: dict = {}
+_FS_FIT_CACHE_MAX = 16
+
+
+def _mesh_content_key(mesh: Mesh):
+    # platform included: device ids are only unique PER platform, and a
+    # process can hold both a CPU mesh (interpret fallback) and an
+    # accelerator mesh with identical axes/ids
+    return (
+        tuple(mesh.axis_names),
+        tuple(int(n) for n in mesh.devices.shape),
+        tuple((d.platform, d.id) for d in mesh.devices.flat),
+    )
+
+
 def feature_sharded_glm_fit(
     objective: GLMObjective,
     mesh: Mesh,
@@ -775,6 +798,47 @@ def feature_sharded_glm_fit(
 
         if interpret is None:
             interpret = effective_platform() == "cpu"
+    cache_key = (
+        objective, _mesh_content_key(mesh), meta, layout, optimizer,
+        data_axis, model_axis, max_iter, tol, history, max_cg,
+        with_norm, with_box, track_models, interpret,
+    )
+    from photon_ml_tpu.utils.memo import get_or_build
+
+    return get_or_build(
+        _FS_FIT_CACHE, _FS_FIT_CACHE_MAX, cache_key,
+        lambda: _build_feature_sharded_glm_fit(
+            objective, mesh, meta, layout=layout, optimizer=optimizer,
+            data_axis=data_axis, model_axis=model_axis, max_iter=max_iter,
+            tol=tol, history=history, max_cg=max_cg, with_norm=with_norm,
+            with_box=with_box, track_models=track_models,
+            interpret=interpret,
+        ),
+    )
+
+
+def _build_feature_sharded_glm_fit(
+    objective: GLMObjective,
+    mesh: Mesh,
+    meta,
+    *,
+    layout: str,
+    optimizer: str,
+    data_axis: str,
+    model_axis: str,
+    max_iter: int,
+    tol: float,
+    history: int,
+    max_cg: int,
+    with_norm: bool,
+    with_box: bool,
+    track_models: bool,
+    interpret: Optional[bool],
+) -> Callable:
+    from photon_ml_tpu.optim.common import BoxConstraints
+    from photon_ml_tpu.optim.lbfgs import minimize_owlqn
+    from photon_ml_tpu.optim.tron import minimize_tron
+
     loss = objective.loss
     owlqn = optimizer == "owlqn"
     tron = optimizer == "tron"

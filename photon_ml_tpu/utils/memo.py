@@ -1,0 +1,36 @@
+"""Tiny shared helper for the module-level bounded program caches.
+
+Several layers memoize expensive-to-build jitted/compiled program
+bundles keyed by hashable config tuples (optim/problem's fit cache, the
+feature-sharded fit cache, the RE bucket-solver namespace cache). The
+guard-hash + FIFO-evict + insert idiom lives here once so eviction or
+key-policy fixes cannot drift between copies.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+def get_or_build(cache: dict, max_size: int, key, build: Callable):
+    """Return ``cache[key]``, building (and FIFO-inserting) on miss.
+
+    ``key`` may be unhashable (e.g. carries arrays), in which case the
+    cache is bypassed and ``build()`` runs uncached. Pass the already-
+    constructed key; pass ``None`` to force a bypass.
+    """
+    if key is not None:
+        try:
+            hash(key)
+        except TypeError:
+            key = None
+    if key is None:
+        return build()
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
+    value = build()
+    while len(cache) >= max_size:
+        cache.pop(next(iter(cache)))
+    cache[key] = value
+    return value
