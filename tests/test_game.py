@@ -624,116 +624,106 @@ class TestMediumScaleGame:
         assert build_s < 120 and cd_s < 300, (build_s, cd_s)
 
 
+
+
+_BUILD_TIMING_SCRIPT = """
+import json, sys, time
+import numpy as np
+sys.path.insert(0, {repo!r})
+from photon_ml_tpu.game import (
+    RandomEffectDataConfiguration, build_random_effect_dataset,
+)
+from photon_ml_tpu.game.data import EntityIndex, GameDataset, ShardData
+from photon_ml_tpu.utils.index_map import IndexMap
+
+rng = np.random.default_rng(42)
+n, E, d, k = {n}, {E}, {d}, {k}
+imap = IndexMap({{f"f{{i}}": i for i in range(d)}})
+ds = GameDataset(
+    uids=[str(i) for i in range(n)],
+    labels=(rng.uniform(size=n) > 0.5).astype(np.float32),
+    offsets=np.zeros(n, np.float32),
+    weights=np.ones(n, np.float32),
+    shards={{"userShard": ShardData(
+        indices=rng.integers(0, d, size=(n, k)).astype(np.int32),
+        values=rng.normal(size=(n, k)).astype(np.float32),
+        index_map=imap, intercept_index=None)}},
+    entity_codes={{"userId": rng.integers(0, E, size=n).astype(np.int32)}},
+    entity_indexes={{"userId": EntityIndex(
+        "userId", [f"u{{i}}" for i in range(E)], {{}})}},
+    num_real_rows=n,
+)
+t0 = time.thread_time()
+red = build_random_effect_dataset(
+    ds, RandomEffectDataConfiguration(
+        "userId", "userShard", active_data_upper_bound={cap}))
+build_s = time.thread_time() - t0
+caps_cover = all(
+    int((b.row_index >= 0).sum(axis=1).max()) <= b.capacity
+    and int((b.row_index >= 0).sum(axis=1).min()) >= 1
+    for b in red.buckets
+)
+print(json.dumps({{
+    "build_s": build_s,
+    "num_entities": red.num_entities,
+    "num_active_rows": red.num_active_rows,
+    "num_passive_rows": red.num_passive_rows,
+    "placed": sum(int((b.row_index >= 0).sum()) for b in red.buckets),
+    "caps_cover": caps_cover,
+    "total_weight_mass": sum(float(b.weights.sum()) for b in red.buckets),
+}}))
+"""
+
+
+def _hermetic_build(n, E, d, k, cap=None):
+    """Build the 1M-row RE dataset (and time it) in a FRESH interpreter:
+    in the parent, the full suite's accumulated heap makes direct-reclaim
+    page faults bill to the building thread's CPU time, flaking any
+    in-process bound on a small box. The subprocess returns BOTH the
+    hermetic thread-CPU build time and the correctness summaries, so the
+    parent never constructs the 1M-row dataset at all."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = _BUILD_TIMING_SCRIPT.format(
+        repo=repo, n=n, E=E, d=d, k=k, cap=cap if cap is not None else "None"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return _json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 @pytest.mark.slow
 class TestLargeScaleREBuild:
-    """VERDICT r2 item 3: the RE build must saturate one host — 1M rows /
-    100k entities through the REAL vectorized path (argsort + bincount +
-    flat scatter, no per-row or per-entity Python loops)."""
+    """1M rows x 8 nnz with 100k entities through the REAL vectorized
+    path (argsort + bincount + flat scatter, no per-row or per-entity
+    Python loops), built and timed hermetically in a subprocess."""
 
-    def test_million_row_build(self, rng):
-        import time
+    def test_million_row_build(self):
+        r = _hermetic_build(n=1_000_000, E=100_000, d=50_000, k=8)
+        assert r["num_entities"] == 100_000
+        assert r["num_active_rows"] == 1_000_000
+        # each bucket's capacity covers its members; every active row
+        # landed in exactly one bucket slot
+        assert r["caps_cover"]
+        assert r["placed"] == 1_000_000
+        # regression guard: a reintroduced per-row loop costs 17-77 s at
+        # this scale (round 2); the fresh interpreter makes the bound
+        # immune to suite-level memory pressure and host load
+        assert r["build_s"] < 15.0, r["build_s"]
 
-        from photon_ml_tpu.game.data import EntityIndex, GameDataset, ShardData
-        from photon_ml_tpu.utils.index_map import IndexMap
-
-        n, E, d, k = 1_000_000, 100_000, 50_000, 8
-        imap = IndexMap({f"f{i}": i for i in range(d)})
-        ds = GameDataset(
-            uids=[str(i) for i in range(n)],
-            labels=(rng.uniform(size=n) > 0.5).astype(np.float32),
-            offsets=np.zeros(n, np.float32),
-            weights=np.ones(n, np.float32),
-            shards={
-                "userShard": ShardData(
-                    indices=rng.integers(0, d, size=(n, k)).astype(np.int32),
-                    values=rng.normal(size=(n, k)).astype(np.float32),
-                    index_map=imap,
-                    intercept_index=None,
-                )
-            },
-            entity_codes={
-                "userId": rng.integers(0, E, size=n).astype(np.int32)
-            },
-            entity_indexes={
-                "userId": EntityIndex(
-                    "userId", [f"u{i}" for i in range(E)], {}
-                )
-            },
-            num_real_rows=n,
-        )
-        t0 = time.thread_time()
-        red = build_random_effect_dataset(
-            ds, RandomEffectDataConfiguration("userId", "userShard")
-        )
-        build_s = time.thread_time() - t0
-        assert red.num_entities == E
-        assert red.num_active_rows == n
-        # each bucket's capacity covers the max active count of its members
-        for b in red.buckets:
-            per_entity = (b.row_index >= 0).sum(axis=1)
-            assert per_entity.max() <= b.capacity
-            assert per_entity.min() >= 1  # members have at least one row
-        # every active row landed in exactly one bucket slot
-        placed = sum(
-            int((b.row_index >= 0).sum()) for b in red.buckets
-        )
-        assert placed == n
-        # host-saturating vectorized build: ~2-3 s typical; generous CI
-        # bound still catches any reintroduced per-row Python loop (~13 s+)
-        # guards the vectorized build against regressing to the round-2
-        # per-row loop (17-77 s at this scale); CURRENT-THREAD CPU time,
-        # so neither concurrent host load nor leftover worker threads
-        # from earlier test modules can flake it on a 1-core box
-        assert build_s < 15.0, build_s
-
-    def test_million_row_build_with_cap(self, rng):
-        import time
-
-        from photon_ml_tpu.game.data import EntityIndex, GameDataset, ShardData
-        from photon_ml_tpu.utils.index_map import IndexMap
-
-        n, E, d, k = 1_000_000, 100_000, 30_000, 8
-        imap = IndexMap({f"f{i}": i for i in range(d)})
-        ds = GameDataset(
-            uids=[str(i) for i in range(n)],
-            labels=(rng.uniform(size=n) > 0.5).astype(np.float32),
-            offsets=np.zeros(n, np.float32),
-            weights=np.ones(n, np.float32),
-            shards={
-                "userShard": ShardData(
-                    indices=rng.integers(0, d, size=(n, k)).astype(np.int32),
-                    values=rng.normal(size=(n, k)).astype(np.float32),
-                    index_map=imap,
-                    intercept_index=None,
-                )
-            },
-            entity_codes={
-                "userId": rng.integers(0, E, size=n).astype(np.int32)
-            },
-            entity_indexes={
-                "userId": EntityIndex(
-                    "userId", [f"u{i}" for i in range(E)], {}
-                )
-            },
-            num_real_rows=n,
-        )
-        t0 = time.thread_time()
-        red = build_random_effect_dataset(
-            ds,
-            RandomEffectDataConfiguration(
-                "userId", "userShard", active_data_upper_bound=8
-            ),
-        )
-        build_s = time.thread_time() - t0
-        assert red.num_active_rows + red.num_passive_rows == n
-        # reservoir weight mass preserved per entity: sum over buckets
-        total_mass = sum(float(b.weights.sum()) for b in red.buckets)
-        assert total_mass == pytest.approx(n, rel=1e-3)
-        # guards the vectorized build against regressing to the round-2
-        # per-row loop (17-77 s at this scale); CURRENT-THREAD CPU time,
-        # so neither concurrent host load nor leftover worker threads
-        # from earlier test modules can flake it on a 1-core box
-        assert build_s < 15.0, build_s
+    def test_million_row_build_with_cap(self):
+        r = _hermetic_build(n=1_000_000, E=100_000, d=30_000, k=8, cap=8)
+        assert r["num_active_rows"] + r["num_passive_rows"] == 1_000_000
+        # reservoir weight mass preserved per entity (sum over buckets)
+        assert abs(r["total_weight_mass"] - 1_000_000) < 1e-3 * 1_000_000
+        assert r["build_s"] < 15.0, r["build_s"]
 
 
 @pytest.mark.slow
