@@ -137,9 +137,9 @@ class GLMParams:
     model_shards: Optional[int] = None  # model-axis size for "feature"
     # Stream the training data from disk per objective evaluation
     # (io/streaming.py): datasets larger than host RAM train with bounded
-    # memory — the GLMSuite/Spark MEMORY_AND_DISK analog. Avro input,
-    # host-driven L-BFGS (L2/none) or OWL-QN (L1/elastic-net);
-    # validation data still loads in memory.
+    # memory — the GLMSuite/Spark MEMORY_AND_DISK analog. Avro (native
+    # chunked decode) or LibSVM (line-at-a-time) input; host-driven
+    # L-BFGS/OWL-QN/TRON; validation data still loads in memory.
     streaming: bool = False
     # jax.profiler trace of the training stage into this directory
     # (SURVEY §7.11 upgrade over Timer-only observability); conventionally
@@ -211,11 +211,6 @@ class GLMParams:
             # diagnostics resample a bounded reservoir of the stream.
             # What remains unsupported is structural:
             unsupported = []
-            if self.input_format.strip().upper() != "AVRO":
-                # only the Avro codec has a native chunked column decoder
-                # (io/native_avro.py); LibSVM text has no bounded-memory
-                # decode path here
-                unsupported.append("non-Avro input")
             if self.distributed == "feature":
                 # feature sharding lays the WHOLE dataset out per feature
                 # block up front; streaming re-stages rows chunk by chunk
@@ -393,10 +388,12 @@ class GLMDriver:
                     summary_paths = train_paths
                     if jax.process_count() > 1:
                         from photon_ml_tpu.io.streaming import (
-                            shard_avro_files,
+                            shard_stream_files,
                         )
 
-                        summary_paths = shard_avro_files(train_paths)
+                        summary_paths = shard_stream_files(
+                            train_paths, fmt
+                        )
                     reservoir = (
                         100_000
                         if p.diagnostic_mode != DiagnosticMode.NONE
@@ -432,10 +429,10 @@ class GLMDriver:
                     check_paths = train_paths
                     if jax.process_count() > 1:
                         from photon_ml_tpu.io.streaming import (
-                            shard_avro_files,
+                            shard_stream_files,
                         )
 
-                        check_paths = shard_avro_files(train_paths)
+                        check_paths = shard_stream_files(train_paths, fmt)
                     for chunk in iter_chunks(
                         check_paths, fmt, index_map,
                         rows_per_chunk=65536, nnz_width=stats.max_nnz,

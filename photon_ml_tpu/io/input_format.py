@@ -271,6 +271,102 @@ class AvroInputDataFormat:
                 1.0 if wgt_v is None else float(wgt_v),
             )
 
+    # -- streaming protocol (io/streaming.py drives these) -----------------
+
+    def _stream_intercept(self, index_map: IndexMap) -> Optional[int]:
+        icept = (
+            index_map.get_index(intercept_key()) if self.add_intercept else -1
+        )
+        return icept if icept >= 0 else None
+
+    def stream_files(self, paths) -> List[str]:
+        """Sorted input files for the bounded-memory streaming path."""
+        from photon_ml_tpu.io.paths import expand_input_paths
+
+        files = sorted(
+            expand_input_paths(paths, lambda fn: fn.endswith(".avro"))
+        )
+        if not files:
+            raise ValueError(f"no .avro inputs under {paths!r}")
+        return files
+
+    def stream_rows(self, path: str, index_map: IndexMap):
+        """Yield (indices, values, label, offset, weight) per record of
+        ONE file, bounded memory: native column decode when available
+        (one file resident at a time), record-at-a-time Python codec
+        otherwise. The remap semantics live in iter_rows_from_{decoded,
+        records} — one definition shared with the in-memory loader."""
+        icept = self._stream_intercept(index_map)
+        decoded = self.decode_file(path)
+        if decoded is not None:
+            yield from self.iter_rows_from_decoded(decoded, index_map, icept)
+        else:
+            yield from self.iter_rows_from_records(
+                read_avro_records([path]), index_map, icept
+            )
+
+    def stream_scan(self, paths, index_map: Optional[IndexMap] = None):
+        """One streaming pass over the files — ONE AT A TIME — collecting
+        the vocabulary, the row count, and the max per-row nnz (incl.
+        intercept) that fix the staging batch. Never keeps more than one
+        decoded file resident. With a prebuilt ``index_map`` (the
+        FeatureIndexingJob store — required for multi-host streaming) the
+        key collection is skipped and only shape stats are scanned."""
+        from photon_ml_tpu.io.streaming import StreamStats
+
+        files = self.stream_files(paths)
+        keys = set()
+        collect_keys = index_map is None
+        num_rows = 0
+        max_live = 0  # per-row live (nonzero, selected) feature count
+        for path in files:
+            decoded = self.decode_file(path)
+            if decoded is not None:
+                sel = np.asarray(
+                    [
+                        self.selected is None or s in self.selected
+                        for s in decoded.strings
+                    ]
+                )
+                if collect_keys:
+                    keys.update(
+                        s for s, ok in zip(decoded.strings, sel) if ok
+                    )
+                # per-row width = entries the row iterators will emit:
+                # every entry whose key is selected (zero VALUES are kept
+                # — they are in the map and emitted by
+                # iter_rows_from_decoded)
+                row_ptr, key_ids, _values = decoded.bag("features")
+                live = (
+                    sel[key_ids] if len(key_ids) else np.zeros(0, bool)
+                )
+                counts = np.add.reduceat(
+                    np.concatenate([live.astype(np.int64), [0]]),
+                    row_ptr[:-1],
+                ) if decoded.num_records else np.zeros(0, np.int64)
+                # reduceat quirk: empty rows (row_ptr[i] == row_ptr[i+1])
+                # return the element at the index instead of 0
+                widths = np.diff(row_ptr)
+                counts = np.where(widths > 0, counts, 0)
+                if len(counts):
+                    max_live = max(max_live, int(counts.max()))
+                num_rows += decoded.num_records
+            else:
+                for record in read_avro_records([path]):
+                    live = 0
+                    for key, _v in self._record_pairs(record):
+                        if collect_keys:
+                            keys.add(key)
+                        live += 1
+                    max_live = max(max_live, live)
+                    num_rows += 1
+        if collect_keys:
+            index_map = IndexMap.build(
+                iter(keys), add_intercept=self.add_intercept
+            )
+        max_nnz = max(max_live + (1 if self.add_intercept else 0), 1)
+        return index_map, StreamStats(num_rows=num_rows, max_nnz=max_nnz)
+
     def _index_map_from_decoded(self, decoded) -> IndexMap:
         keys = (
             key
@@ -384,33 +480,116 @@ class LibSVMInputDataFormat:
         icept = index_map.get_index(intercept_key()) if self.add_intercept else -1
         intercept_index = icept if icept >= 0 else None
 
+        # ONE remap definition: the in-memory loader iterates the same
+        # stream_rows the streaming path uses (selected-features filter,
+        # identity-map range check, intercept append), so the two paths
+        # cannot diverge — the contract the Avro format keeps via
+        # iter_rows_from_{decoded,records}
         rows, labels, offsets, weights = [], [], [], []
-        for label, pairs in read_libsvm(paths, zero_based=self.zero_based):
-            ix, vs = [], []
-            for idx, value in pairs:
-                key = feature_key(str(idx))
-                # with a pre-declared feature_dimension the identity map
-                # accepts every in-range id, so the selected-features
-                # filter must be applied here
-                if self.selected is not None and key not in self.selected:
-                    continue
-                i = index_map.get_index(key)
-                if i >= 0:
-                    ix.append(i)
-                    vs.append(value)
-            if intercept_index is not None:
-                ix.append(intercept_index)
-                vs.append(1.0)
-            rows.append((ix, vs))
-            labels.append(label)
-            offsets.append(0.0)
-            weights.append(1.0)
+        for path in self.stream_files(paths):
+            for ix, vs, lab, off, wgt in self.stream_rows(path, index_map):
+                rows.append((ix, vs))
+                labels.append(lab)
+                offsets.append(off)
+                weights.append(wgt)
 
         batch = _rows_to_batch(rows, labels, offsets, weights)
         constraints = parse_constraint_string(
             constraint_string, index_map, dim, intercept_index
         )
         return LoadedData(batch, index_map, dim, intercept_index, constraints)
+
+    # -- streaming protocol (io/streaming.py drives these) -----------------
+    # LibSVM is line-oriented text, so the bounded-memory contract is
+    # trivial: one line resident at a time (the reference's GLMSuite
+    # streams both formats identically through RDD rows,
+    # LibSVMInputDataFormat.scala:43-75).
+
+    def stream_files(self, paths) -> List[str]:
+        from photon_ml_tpu.io.paths import expand_input_paths
+
+        files = sorted(expand_input_paths(paths))
+        if not files:
+            raise ValueError(f"no inputs under {paths!r}")
+        return files
+
+    def stream_rows(self, path: str, index_map: IndexMap):
+        """(indices, values, label, offset, weight) per line of ONE file,
+        one line resident at a time; same remap semantics as load()."""
+        from photon_ml_tpu.io.libsvm import parse_libsvm_line
+
+        icept = (
+            index_map.get_index(intercept_key()) if self.add_intercept else -1
+        )
+        icept = icept if icept >= 0 else None
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                parsed = parse_libsvm_line(line, zero_based=self.zero_based)
+                if parsed is None:
+                    continue
+                label, pairs = parsed
+                ix: List[int] = []
+                vs: List[float] = []
+                for idx, value in pairs:
+                    key = feature_key(str(idx))
+                    if self.selected is not None and key not in self.selected:
+                        continue
+                    i = index_map.get_index(key)
+                    if i >= 0:
+                        ix.append(i)
+                        vs.append(value)
+                if icept is not None:
+                    ix.append(icept)
+                    vs.append(1.0)
+                yield ix, vs, label, 0.0, 1.0
+
+    def stream_scan(self, paths, index_map: Optional[IndexMap] = None):
+        """Line-at-a-time vocabulary + staging-shape scan. A pre-declared
+        ``feature_dimension`` skips the vocabulary collection (identity
+        map), exactly like build_index_map."""
+        from photon_ml_tpu.io.libsvm import parse_libsvm_line
+        from photon_ml_tpu.io.streaming import StreamStats
+
+        files = self.stream_files(paths)
+        collect_keys = index_map is None
+        keys = set()
+        num_rows = 0
+        max_live = 0
+        for path in files:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    parsed = parse_libsvm_line(
+                        line, zero_based=self.zero_based
+                    )
+                    if parsed is None:
+                        continue
+                    _label, pairs = parsed
+                    live = 0
+                    for idx, _v in pairs:
+                        key = feature_key(str(idx))
+                        if (
+                            self.selected is not None
+                            and key not in self.selected
+                        ):
+                            continue
+                        if collect_keys and self.feature_dimension is None:
+                            keys.add(key)
+                        live += 1
+                    max_live = max(max_live, live)
+                    num_rows += 1
+        if collect_keys:
+            if self.feature_dimension is not None:
+                from photon_ml_tpu.utils.index_map import IdentityIndexMap
+
+                index_map = IdentityIndexMap(
+                    self.feature_dimension, add_intercept=self.add_intercept
+                )
+            else:
+                index_map = IndexMap.build(
+                    iter(keys), add_intercept=self.add_intercept
+                )
+        max_nnz = max(max_live + (1 if self.add_intercept else 0), 1)
+        return index_map, StreamStats(num_rows=num_rows, max_nnz=max_nnz)
 
 
 def create_input_format(kind: str, **kwargs):

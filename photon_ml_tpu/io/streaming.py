@@ -26,7 +26,7 @@ from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from photon_ml_tpu.data.batch import SparseBatch
-from photon_ml_tpu.utils.index_map import IndexMap, intercept_key
+from photon_ml_tpu.utils.index_map import IndexMap
 
 
 @dataclass(frozen=True)
@@ -37,99 +37,18 @@ class StreamStats:
     max_nnz: int  # per-row nonzeros INCLUDING the intercept slot
 
 
-def _iter_file_rows(path: str, fmt, index_map: IndexMap):
-    """Yield (indices, values, label, offset, weight) per record of ONE
-    file: native column decode when available (one file resident at a
-    time), record-at-a-time Python codec otherwise. The remap semantics
-    live in AvroInputDataFormat.iter_rows_from_{decoded,records} — one
-    definition shared with the in-memory loader."""
-    from photon_ml_tpu.io.avro_codec import read_avro_records
-
-    icept = (
-        index_map.get_index(intercept_key()) if fmt.add_intercept else -1
-    )
-    icept = icept if icept >= 0 else None
-    decoded = fmt.decode_file(path)
-    if decoded is not None:
-        yield from fmt.iter_rows_from_decoded(decoded, index_map, icept)
-    else:
-        yield from fmt.iter_rows_from_records(
-            read_avro_records([path]), index_map, icept
-        )
-
-
 def scan_stream(
     paths, fmt, *, index_map: Optional[IndexMap] = None
 ) -> Tuple[IndexMap, StreamStats]:
-    """One streaming pass over the files — ONE AT A TIME — collecting the
-    vocabulary, the row count, and the max per-row nnz (incl. intercept)
-    that fix the staging batch. Unlike fmt.build_index_map (which the
-    in-memory loader uses and which holds every file's decoded columns at
-    once), this never keeps more than one decoded file resident — the
-    whole point of the streaming path is datasets larger than RAM.
-
-    With a prebuilt ``index_map`` (the FeatureIndexingJob store — required
-    for multi-host streaming, where no single process sees the whole
-    vocabulary) the key collection is skipped and only the shape stats are
-    scanned."""
-    from photon_ml_tpu.io.avro_codec import read_avro_records
-    from photon_ml_tpu.io.paths import expand_input_paths
-
-    files = sorted(expand_input_paths(paths, lambda fn: fn.endswith(".avro")))
-    if not files:
-        raise ValueError(f"no .avro inputs under {paths!r}")
-    keys = set()
-    collect_keys = index_map is None
-    num_rows = 0
-    max_live = 0  # per-row live (nonzero, selected) feature count
-    for path in files:
-        decoded = fmt.decode_file(path)
-        if decoded is not None:
-            sel = np.asarray(
-                [
-                    fmt.selected is None or s in fmt.selected
-                    for s in decoded.strings
-                ]
-            )
-            if collect_keys:
-                keys.update(
-                    s
-                    for s, ok in zip(decoded.strings, sel)
-                    if ok
-                )
-            # per-row width = entries the row iterators will emit: every
-            # entry whose key is selected (zero VALUES are kept — they are
-            # in the map and emitted by iter_rows_from_decoded)
-            row_ptr, key_ids, _values = decoded.bag("features")
-            live = (
-                sel[key_ids] if len(key_ids) else np.zeros(0, bool)
-            )
-            counts = np.add.reduceat(
-                np.concatenate([live.astype(np.int64), [0]]),
-                row_ptr[:-1],
-            ) if decoded.num_records else np.zeros(0, np.int64)
-            # reduceat quirk: empty rows (row_ptr[i] == row_ptr[i+1])
-            # return the element at the index instead of 0
-            widths = np.diff(row_ptr)
-            counts = np.where(widths > 0, counts, 0)
-            if len(counts):
-                max_live = max(max_live, int(counts.max()))
-            num_rows += decoded.num_records
-        else:
-            for record in read_avro_records([path]):
-                live = 0
-                for key, _v in fmt._record_pairs(record):
-                    if collect_keys:
-                        keys.add(key)
-                    live += 1
-                max_live = max(max_live, live)
-                num_rows += 1
-    if collect_keys:
-        index_map = IndexMap.build(
-            iter(keys), add_intercept=fmt.add_intercept
-        )
-    max_nnz = max(max_live + (1 if fmt.add_intercept else 0), 1)
-    return index_map, StreamStats(num_rows=num_rows, max_nnz=max_nnz)
+    """One bounded-memory pass collecting the vocabulary, the row count,
+    and the max per-row nnz (incl. intercept) that fix the staging batch
+    — dispatched to the input format's streaming protocol
+    (``fmt.stream_scan``): Avro scans one decoded file at a time, LibSVM
+    one text line at a time. With a prebuilt ``index_map`` (the
+    FeatureIndexingJob store — required for multi-host streaming, where
+    no single process sees the whole vocabulary) the key collection is
+    skipped and only the shape stats are scanned."""
+    return fmt.stream_scan(paths, index_map=index_map)
 
 
 def iter_chunks(
@@ -145,9 +64,10 @@ def iter_chunks(
     shape, so one jitted partial-objective serves the whole stream."""
     import jax.numpy as jnp
 
-    from photon_ml_tpu.io.paths import expand_input_paths
-
-    files = sorted(expand_input_paths(paths, lambda fn: fn.endswith(".avro")))
+    # a multi-host process can own a ZERO-file shard (process_shard with
+    # more processes than files) — it must yield no chunks and still join
+    # every collective, not raise
+    files = fmt.stream_files(paths) if paths else []
     R, W = rows_per_chunk, nnz_width
     ix_buf = np.zeros((R, W), np.int32)
     v_buf = np.zeros((R, W), np.float32)
@@ -170,7 +90,7 @@ def iter_chunks(
         )
 
     for path in files:
-        for ix, vs, lab, off, wgt in _iter_file_rows(path, fmt, index_map):
+        for ix, vs, lab, off, wgt in fmt.stream_rows(path, index_map):
             if len(ix) > W:
                 raise ValueError(
                     f"row has {len(ix)} nonzeros > staging width {W}; "
@@ -246,23 +166,22 @@ def _prefetched(source: Iterator, depth: int = 2) -> Iterator:
         stop.set()
 
 
-def shard_avro_files(paths):
-    """Cross-process-consistent shard of a path set's .avro files: the
-    GLOBAL sort before the round-robin split is load-bearing — every host
-    must agree on the file order or the shards overlap. One definition
-    shared by the streaming trainer, the driver's summary pass, and
-    tests."""
-    import jax
-
-    from photon_ml_tpu.io.paths import expand_input_paths
+def shard_stream_files(paths, fmt):
+    """Cross-process-consistent shard of the format's input files: the
+    GLOBAL sort (inside ``fmt.stream_files``) before the round-robin
+    split is load-bearing — every host must agree on the file order or
+    the shards overlap. One definition shared by the streaming trainer,
+    the driver's summary/validation passes, and tests."""
     from photon_ml_tpu.parallel.multihost import process_shard
 
-    files = sorted(
-        expand_input_paths(paths, lambda fn: fn.endswith(".avro"))
-    )
-    if not files:
-        raise ValueError(f"no .avro inputs under {paths!r}")
-    return process_shard(files)
+    return process_shard(fmt.stream_files(paths))
+
+
+def shard_avro_files(paths):
+    """Back-compat alias: shard the default Avro format's files."""
+    from photon_ml_tpu.io.input_format import AvroInputDataFormat
+
+    return shard_stream_files(paths, AvroInputDataFormat())
 
 
 def streaming_summary(

@@ -370,6 +370,11 @@ def train_streaming_glm(
     host-driven OWL-QN (minimize_owlqn_host) with the intercept exempt
     from the penalty, exactly like the in-memory path.
 
+    Works over Avro (native chunked column decode) or LibSVM text
+    (line-at-a-time) inputs — pass the matching ``fmt``; both formats
+    implement the streaming protocol (stream_files/stream_rows/
+    stream_scan), like the reference streams both through GLMSuite.
+
     Under ``jax.distributed`` (process_count > 1) the input FILES split
     across processes (multihost.process_shard — the executor-partition
     analog) and every evaluation's (value, gradient) partials reduce
@@ -421,9 +426,9 @@ def train_streaming_glm(
                 "map (build one with the feature-indexing job); no single "
                 "process sees the whole vocabulary"
             )
-        from photon_ml_tpu.io.streaming import shard_avro_files
+        from photon_ml_tpu.io.streaming import shard_stream_files
 
-        paths = shard_avro_files(paths)
+        paths = shard_stream_files(paths, fmt)
         if stats is None:
             # local stats -> global agreement (max nnz must match across
             # processes: it fixes the compiled staging shape). A process

@@ -467,6 +467,13 @@ class TestStreamingDriver:
                 streaming=True,
                 **kw,
             ).validate()
+        # LibSVM streams line-at-a-time since round 5: validates cleanly
+        GLMParams(
+            train_dir=train,
+            output_dir=str(tmp_path / "z"),
+            streaming=True,
+            input_format="LIBSVM",
+        ).validate()
         # what remains unsupported is structural: conflicting layouts
         with pytest.raises(ValueError, match="streaming training"):
             GLMParams(
@@ -474,11 +481,4 @@ class TestStreamingDriver:
                 output_dir=str(tmp_path / "y"),
                 streaming=True,
                 distributed="feature",
-            ).validate()
-        with pytest.raises(ValueError, match="streaming training"):
-            GLMParams(
-                train_dir=train,
-                output_dir=str(tmp_path / "z"),
-                streaming=True,
-                input_format="LIBSVM",
             ).validate()
