@@ -1,0 +1,80 @@
+#!/usr/bin/env bash
+# Tile-schedule cache A/B: run the GLM driver twice against one tmp
+# --tile-cache-dir and assert the second (warm) run's schedule-build time
+# is at least 10x lower than the first (cold) run's.
+#
+# Runs fully on CPU (JAX_PLATFORMS=cpu): the schedule build is host-side,
+# so the cache win is measurable without a TPU. The fit itself runs the
+# tiled kernels in interpret mode, so the dataset is kept small and the
+# grid short — the metric under test is metrics.json's schedule_cache
+# build_s/load_s, not fit throughput.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TMP=$(mktemp -d -t photon-sched-cache-XXXXXX)
+trap 'rm -rf "$TMP"' EXIT
+export JAX_PLATFORMS=cpu
+
+N_ROWS=98304
+NNZ=8
+DIM=12288
+
+python - "$TMP/data" "$N_ROWS" "$NNZ" "$DIM" <<'EOF'
+import os, sys
+import numpy as np
+
+out_dir, n, k, d = sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
+os.makedirs(out_dir, exist_ok=True)
+rng = np.random.default_rng(0)
+w = rng.normal(size=d).astype(np.float32) * 0.3
+with open(os.path.join(out_dir, "part-00000.libsvm"), "w") as f:
+    for _ in range(n):
+        ix = rng.choice(d, size=k, replace=False)
+        vs = rng.normal(size=k).astype(np.float32)
+        z = float((w[ix] * vs).sum())
+        y = int(rng.uniform() < 1.0 / (1.0 + np.exp(-z)))
+        f.write(
+            f"{y} " + " ".join(f"{i + 1}:{v:.4f}" for i, v in zip(ix, vs)) + "\n"
+        )
+print(f"wrote {n} LibSVM rows to {out_dir}")
+EOF
+
+run_driver() {
+  python -m photon_ml_tpu.cli.glm_driver \
+    --training-data-directory "$TMP/data" \
+    --output-directory "$1" \
+    --format LIBSVM \
+    --feature-dimension "$DIM" \
+    --kernel tiled \
+    --distributed off \
+    --optimizer LBFGS \
+    --num-iterations 2 \
+    --regularization-weights 1.0 \
+    --data-validation-type VALIDATE_DISABLED \
+    --tile-cache-dir "$TMP/cache"
+}
+
+echo "== cold run (cache empty) =="
+run_driver "$TMP/out-cold"
+echo "== warm run (cache populated) =="
+run_driver "$TMP/out-warm"
+
+python - "$TMP/out-cold/metrics.json" "$TMP/out-warm/metrics.json" <<'EOF'
+import json, sys
+
+cold = json.load(open(sys.argv[1]))["schedule_cache"]
+warm = json.load(open(sys.argv[2]))["schedule_cache"]
+# schedule time = what the cache replaces: build (+ artifact load on the
+# warm side); keying/hash cost is reported separately in hash_s
+cold_s = cold["build_s"] + cold["load_s"]
+warm_s = warm["build_s"] + warm["load_s"]
+print(f"cold: builds={cold['builds']} build_s={cold['build_s']:.3f} load_s={cold['load_s']:.4f}")
+print(f"warm: hits={warm['hits']} build_s={warm['build_s']:.3f} load_s={warm['load_s']:.4f} hash_s={warm['hash_s']:.4f}")
+assert cold["builds"] >= 2, f"cold run built {cold['builds']} schedules, expected z+g"
+assert warm["builds"] == 0, f"warm run rebuilt {warm['builds']} schedules (cache missed)"
+assert warm["hits"] >= 2, f"warm run hit {warm['hits']} artifacts, expected z+g"
+speedup = cold_s / max(warm_s, 1e-9)
+print(f"schedule time: cold {cold_s:.3f}s -> warm {warm_s:.4f}s ({speedup:.1f}x)")
+assert speedup >= 10.0, f"warm schedule time only {speedup:.1f}x lower (need >= 10x)"
+print("OK: warm schedule load >= 10x faster than cold build")
+EOF

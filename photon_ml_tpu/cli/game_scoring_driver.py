@@ -60,6 +60,11 @@ class GameScoringParams:
     feature_name_and_term_set_path: Optional[str] = None
     # jax.profiler trace of the scoring pass (SURVEY §7.11)
     profile_dir: Optional[str] = None
+    # Persistent content-addressed tile-schedule cache directory
+    # (ops/schedule_cache.py), shared with the training drivers so a
+    # scoring run over an already-trained dataset reuses its tiled
+    # layout. None falls back to PHOTON_TILE_CACHE_DIR; unset = off.
+    tile_cache_dir: Optional[str] = None
     # Chunked scoring for inputs larger than memory (the reference scores
     # RDD partitions without collecting — Spark's memory profile by
     # construction); requires prebuilt feature maps, pointwise/global
@@ -101,6 +106,10 @@ class GameScoringDriver:
     def __init__(self, params: GameScoringParams, logger=None):
         params.validate()
         self.params = params
+        if params.tile_cache_dir is not None:
+            from photon_ml_tpu.ops.schedule_cache import configure
+
+            configure(params.tile_cache_dir)
         from photon_ml_tpu.parallel.multihost import prepare_output_dir
 
         prepare_output_dir(
@@ -367,6 +376,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="write a jax.profiler trace of the scoring pass here",
     )
     ap.add_argument(
+        "--tile-cache-dir", default=None,
+        help="persistent tile-schedule cache directory shared with the "
+        "training drivers. Default: $PHOTON_TILE_CACHE_DIR, unset = off",
+    )
+    ap.add_argument(
         "--streaming", default="false",
         help="true: score in bounded-memory chunks (needs prebuilt "
         "feature maps; sharded evaluators unsupported)",
@@ -398,6 +412,7 @@ def params_from_args(argv=None) -> GameScoringParams:
         ),
         model_id=ns.game_model_id or ns.model_id or "",
         profile_dir=ns.profile_dir,
+        tile_cache_dir=ns.tile_cache_dir,
         streaming=str(ns.streaming).lower() in ("true", "1", "yes"),
         rows_per_chunk=ns.rows_per_chunk,
         has_response=str(ns.has_response).lower() in ("true", "1", "yes"),

@@ -230,6 +230,11 @@ class GameTrainingParams:
     # jax.profiler trace of the training combos into this directory
     # (SURVEY §7.11): one trace spanning the coordinate-descent fits.
     profile_dir: Optional[str] = None
+    # Persistent content-addressed tile-schedule cache directory
+    # (ops/schedule_cache.py): GAME sweeps over the same dataset reuse
+    # the tiled layout across runs. None falls back to the
+    # PHOTON_TILE_CACHE_DIR env var; unset = off.
+    tile_cache_dir: Optional[str] = None
 
     def validate(self) -> None:
         if not self.train_input_dirs:
@@ -257,6 +262,24 @@ class GameTrainingParams:
         for name in self.fixed_effect_data_configs:
             if name not in self.fixed_effect_opt_configs:
                 raise ValueError(f"missing optimization config for {name}")
+            if self.distributed == "feature":
+                # the feature-sharded fixed effect lays the WHOLE dataset
+                # out per feature block; down-sampling would need a
+                # re-layout per draw — unsupported, and it must fail HERE
+                # at argument parsing, not as a mid-training
+                # NotImplementedError in FixedEffectCoordinate
+                # (ADVICE.md round 5)
+                for alt in self.fixed_effect_opt_configs[name].split(";"):
+                    if not alt.strip():
+                        continue
+                    cfg = GLMOptimizationConfiguration.parse(alt)
+                    if cfg.down_sampling_rate < 1.0:
+                        raise ValueError(
+                            "--distributed feature does not support a "
+                            f"down-sampling rate < 1.0 (coordinate {name!r} "
+                            f"has rate {cfg.down_sampling_rate}); drop the "
+                            "down-sampling or use --distributed auto/off"
+                        )
         for name in self.random_effect_data_configs:
             if name not in self.random_effect_opt_configs:
                 raise ValueError(f"missing optimization config for {name}")
@@ -275,6 +298,12 @@ class GameTrainingDriver:
         initialize_multihost(
             params.coordinator_address, params.num_processes, params.process_id
         )
+        if params.tile_cache_dir is not None:
+            # process-wide: every coordinate's tiled conversion (FE solves
+            # across all combos) shares the persistent tier
+            from photon_ml_tpu.ops.schedule_cache import configure
+
+            configure(params.tile_cache_dir)
         prepare_output_dir(
             params.output_dir,
             delete_if_exists=params.delete_output_dir_if_exists,
@@ -899,6 +928,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--profile-dir", default=None,
         help="write a jax.profiler trace of the first training combo here",
     )
+    ap.add_argument(
+        "--tile-cache-dir", default=None,
+        help="persistent content-addressed tile-schedule cache directory "
+        "(warm GAME sweeps over the same dataset skip the tiled layout "
+        "rebuild). Default: $PHOTON_TILE_CACHE_DIR, unset = off",
+    )
     return ap
 
 
@@ -993,6 +1028,7 @@ def params_from_args(argv=None) -> GameTrainingParams:
         process_id=ns.process_id,
         checkpoint_dir=ns.checkpoint_dir,
         profile_dir=ns.profile_dir,
+        tile_cache_dir=ns.tile_cache_dir,
     )
 
 

@@ -145,6 +145,17 @@ class GLMParams:
     # (SURVEY §7.11 upgrade over Timer-only observability); conventionally
     # <output-dir>/profile, viewable in TensorBoard/Perfetto.
     profile_dir: Optional[str] = None
+    # Persistent content-addressed tile-schedule cache directory
+    # (ops/schedule_cache.py): warm reruns over the same dataset load the
+    # tiled layout instead of paying the multi-second rebuild. None falls
+    # back to the PHOTON_TILE_CACHE_DIR env var; unset = off.
+    tile_cache_dir: Optional[str] = None
+    # Diagnostics reservoir bounds for the streaming path: the sample is
+    # rows x max_nnz dense (int32+float32), so wide-row datasets must not
+    # blow the bounded-memory contract — rows are scaled down to fit the
+    # byte budget (ADVICE.md round 5).
+    diagnostic_reservoir_rows: int = 100_000
+    diagnostic_reservoir_bytes: int = 256 << 20
     # Multi-host orchestration (the SparkContextConfiguration analog):
     # address of process 0's coordination service. None = single-process.
     coordinator_address: Optional[str] = None
@@ -187,6 +198,10 @@ class GLMParams:
             )
         if any(w < 0 for w in self.regularization_weights):
             raise ValueError("regularization weights must be non-negative")
+        if self.diagnostic_reservoir_rows < 1:
+            raise ValueError("diagnostic-reservoir-rows must be >= 1")
+        if self.diagnostic_reservoir_bytes < 1:
+            raise ValueError("diagnostic-reservoir-bytes must be >= 1")
         # Exclusivity AND range-string format validated up front (a
         # malformed range should fail here, not mid-preprocess).
         from photon_ml_tpu.utils.date_range import resolve_date_range
@@ -231,6 +246,18 @@ class GLMParams:
                 )
 
 
+def budgeted_reservoir_rows(
+    max_rows: int, budget_bytes: int, max_nnz: int
+) -> int:
+    """Diagnostics-reservoir row count under a byte budget: the sample is
+    rows x max_nnz dense (int32 indices + float32 values = 8 B/slot, plus
+    12 B/row of label/offset/weight), so wide-row datasets scale rows
+    DOWN to fit instead of allocating multiple GB on the host — the
+    streaming path's bounded-memory contract (ADVICE.md round 5)."""
+    bytes_per_row = max(1, max_nnz) * 8 + 12
+    return max(1, min(max_rows, budget_bytes // bytes_per_row))
+
+
 class GLMDriver:
     """Staged GLM pipeline. After run(): ``stage_history`` lists completed
     stages, ``models`` maps lambda->model, ``best_model`` /
@@ -258,6 +285,12 @@ class GLMDriver:
         initialize_multihost(
             params.coordinator_address, params.num_processes, params.process_id
         )
+        if params.tile_cache_dir is not None:
+            # process-wide so every stage's tiled conversion (train,
+            # validation, diagnostics) shares the same persistent tier
+            from photon_ml_tpu.ops.schedule_cache import configure
+
+            configure(params.tile_cache_dir)
         prepare_output_dir(
             params.output_dir,
             delete_if_exists=params.delete_output_dirs_if_exist,
@@ -285,6 +318,8 @@ class GLMDriver:
         self._summary = None
         # bounded reservoir sample of a streamed train set (diagnostics)
         self._stream_sample = None
+        # tile-schedule cache counters captured after the train stage
+        self._schedule_cache_stats: Dict[str, float] = {}
 
     # -- stages ------------------------------------------------------------
 
@@ -394,11 +429,21 @@ class GLMDriver:
                         summary_paths = shard_stream_files(
                             train_paths, fmt
                         )
-                    reservoir = (
-                        100_000
-                        if p.diagnostic_mode != DiagnosticMode.NONE
-                        else 0
-                    )
+                    reservoir = 0
+                    if p.diagnostic_mode != DiagnosticMode.NONE:
+                        reservoir = budgeted_reservoir_rows(
+                            p.diagnostic_reservoir_rows,
+                            p.diagnostic_reservoir_bytes,
+                            stats.max_nnz,
+                        )
+                        if reservoir < p.diagnostic_reservoir_rows:
+                            self.logger.info(
+                                "diagnostics reservoir scaled to %d rows "
+                                "(%d B budget at %d nnz/row)",
+                                reservoir,
+                                p.diagnostic_reservoir_bytes,
+                                stats.max_nnz,
+                            )
                     self._summary, self._stream_sample = streaming_summary(
                         summary_paths, fmt, index_map, stats,
                         reservoir_rows=reservoir,
@@ -539,6 +584,7 @@ class GLMDriver:
                     fmt=self._fmt,
                     index_map=data.index_map,
                     stats=stats,
+                    tile_cache_dir=p.tile_cache_dir,
                 )
             elif p.distributed == "feature" and mesh is not None:
                 from photon_ml_tpu.training import train_feature_sharded
@@ -564,6 +610,7 @@ class GLMDriver:
                     kernel=p.kernel,
                     optimizer_type=p.optimizer_type,
                     track_models=p.validate_per_iteration,
+                    tile_cache_dir=p.tile_cache_dir,
                 )
             else:
                 if mesh is not None:
@@ -588,10 +635,30 @@ class GLMDriver:
                     kernel=p.kernel,
                     mesh=mesh,
                     track_models=p.validate_per_iteration,
+                    tile_cache_dir=p.tile_cache_dir,
                 )
             self._log_results()
+        self._log_schedule_cache()
         self.emitter.send(TrainingFinishEvent(p.job_name))
         self._advance(DriverStage.TRAINED)
+
+    def _log_schedule_cache(self) -> None:
+        """Surface the tile-schedule cache outcome of the training stage
+        (build/load/hit-miss timers) to the log and the event stream."""
+        from photon_ml_tpu.events import ScheduleCacheEvent
+        from photon_ml_tpu.ops.schedule_cache import stats
+
+        s = stats()
+        if not (s.builds or s.hits or s.misses):
+            return  # scatter kernel / no tiled conversion this run
+        self._schedule_cache_stats = s.as_dict()
+        self.emitter.send(ScheduleCacheEvent(stats=self._schedule_cache_stats))
+        self.logger.info(
+            "tile-schedule cache: %d hit(s), %d miss(es), %d build(s) "
+            "(build %.2fs, load %.3fs, store %.2fs, hash %.2fs)",
+            s.hits, s.misses, s.builds,
+            s.build_s, s.load_s, s.store_s, s.hash_s,
+        )
 
     def _log_results(self) -> None:
         for lam, res in self.results.items():
@@ -776,6 +843,7 @@ class GLMDriver:
                     },
                     "best_lambda": self.best_lambda,
                     "timers": self.timer.durations,
+                    "schedule_cache": self._schedule_cache_stats,
                 },
                 f,
                 indent=2,
@@ -894,6 +962,22 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "(TensorBoard/Perfetto-viewable)",
     )
     ap.add_argument(
+        "--tile-cache-dir", default=None,
+        help="persistent content-addressed tile-schedule cache directory: "
+        "warm reruns over the same dataset load the tiled layout instead "
+        "of rebuilding it (multi-host: process 0 writes, others read). "
+        "Default: $PHOTON_TILE_CACHE_DIR, unset = off",
+    )
+    ap.add_argument(
+        "--diagnostic-reservoir-rows", type=int, default=100_000,
+        help="max rows in the streaming diagnostics reservoir sample",
+    )
+    ap.add_argument(
+        "--diagnostic-reservoir-bytes", type=int, default=256 << 20,
+        help="byte budget for the diagnostics reservoir (rows scale down "
+        "when max nnz/row is large, preserving bounded memory)",
+    )
+    ap.add_argument(
         "--coordinator-address", default=None,
         help="host:port of process 0 for multi-host runs (jax.distributed)",
     )
@@ -969,6 +1053,9 @@ def params_from_args(argv=None) -> GLMParams:
         distributed=ns.distributed,
         streaming=_bool(ns.streaming),
         profile_dir=ns.profile_dir,
+        tile_cache_dir=ns.tile_cache_dir,
+        diagnostic_reservoir_rows=ns.diagnostic_reservoir_rows,
+        diagnostic_reservoir_bytes=ns.diagnostic_reservoir_bytes,
         model_shards=ns.model_shards,
         coordinator_address=ns.coordinator_address,
         num_processes=ns.num_processes,

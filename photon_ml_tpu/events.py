@@ -44,6 +44,16 @@ class PhotonOptimizationLogEvent(Event):
     metrics: Dict[str, float] = field(default_factory=dict)
 
 
+@dataclass(frozen=True)
+class ScheduleCacheEvent(Event):
+    """Tile-schedule cache outcome for one training stage: hit/miss/build
+    counters plus the host-side build/load/store timers
+    (ops/schedule_cache.py). Emitted by the drivers after training so
+    listeners can track cold-vs-warm schedule cost per run."""
+
+    stats: Dict[str, float] = field(default_factory=dict)
+
+
 class EventListener:
     def on_event(self, event: Event) -> None:  # pragma: no cover - interface
         raise NotImplementedError

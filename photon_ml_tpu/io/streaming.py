@@ -452,6 +452,7 @@ class StreamingGLMObjective:
         tiled_cache_bytes: int = 4 << 30,
         tile_params=None,
         norm=None,
+        tile_cache_dir: Optional[str] = None,
     ):
         import jax
 
@@ -488,6 +489,12 @@ class StreamingGLMObjective:
         )
         self.tiled_cache_bytes = int(tiled_cache_bytes)
         self.tile_params = tile_params
+        # persistent schedule-cache dir for the per-chunk tiled builds
+        # (ops/schedule_cache.py); None falls back to the process config /
+        # PHOTON_TILE_CACHE_DIR. Staged chunks have fixed content after
+        # the populate pass, so a rerun over the same files hits the
+        # content-addressed artifacts chunk by chunk.
+        self.tile_cache_dir = tile_cache_dir
         self._tiled_chunk_count: Optional[int] = None
         self._tiled_stacked = None  # chunk-stacked TiledSparseBatch pytree
         self._tiled_objective = None
@@ -523,7 +530,9 @@ class StreamingGLMObjective:
         params = None
         built = []  # (z, g, lab, off, wgt) for kept chunks only
         budget = self.tiled_cache_bytes
-        with ThreadPoolExecutor(2) as pool:
+        from photon_ml_tpu.ops.schedule_cache import cache_scope
+
+        with cache_scope(self.tile_cache_dir), ThreadPoolExecutor(2) as pool:
             for batch in self.chunks():
                 rows, feats, vals, _n = ts._sparse_coo(batch)
                 if params is None:

@@ -310,19 +310,45 @@ def _build_schedule_np(
     params: TileParams,
     sort_by_feature_block: bool,
     num_out_blocks: int,
+    digest: Optional[str] = None,
 ) -> Tuple[np.ndarray, ...]:
     """Schedule build -> (step_out, step_in, step_init, o_pos, i_pos, sv)
-    numpy arrays. Tries the native counting-sort builder first; the numpy
+    numpy arrays. Tries the persistent content-addressed disk cache first
+    (ops/schedule_cache.py — a hit returns mmap-backed arrays and skips
+    the build entirely), then the native counting-sort builder; the numpy
     path below is the fallback oracle (vectorized repeat/cumsum/scatter —
     no per-entry Python loops; the round-2 loop version cost 17-77 s at the
-    ads shape, this is ~8 s, the native builder ~0.3 s)."""
+    ads shape, this is ~8 s, the native builder ~0.3 s).
+
+    ``digest``: precomputed content digest of (rows, feats, vals) so
+    callers building BOTH passes from one triple hash it once."""
+    from photon_ml_tpu.ops import schedule_cache as _sc
+
+    cache_dir = _sc.resolve_cache_dir()
+    cache_key = None
+    if cache_dir is not None:
+        if digest is None:
+            digest = _sc.content_digest(rows, feats, vals)
+        cache_key = _sc.schedule_key(
+            digest, params, sort_by_feature_block, num_out_blocks
+        )
+        cached = _sc.load_schedule(cache_dir, cache_key)
+        if cached is None and not _sc.is_cache_writer():
+            # multi-host: the coordinator builds and writes; everyone
+            # else waits for its artifact (local build only on timeout)
+            cached = _sc.wait_and_load(cache_dir, cache_key)
+        if cached is not None:
+            return cached
+    import time as _time
+
+    t_build = _time.perf_counter()
     native = _build_schedule_native(
         rows, feats, vals, params=params,
         sort_by_feature_block=sort_by_feature_block,
         num_out_blocks=num_out_blocks,
     )
     if native is not None:
-        return native
+        return _finish_schedule_build(native, t_build, cache_dir, cache_key)
     win = params.window
     L = params.chunk
     # int32 entry coordinates when they fit (half the sort/gather traffic);
@@ -455,10 +481,26 @@ def _build_schedule_np(
         i_pos[dest_row, slot] = in_pos
         sv[dest_row, slot] = v
     sp_out, sp_in, sp_vals = _pad_spill_np(sp_out, sp_in, sp_vals)
-    return (
-        step_out, step_in, step_init, o_pos, i_pos, sv,
-        sp_out, sp_in, sp_vals,
+    return _finish_schedule_build(
+        (
+            step_out, step_in, step_init, o_pos, i_pos, sv,
+            sp_out, sp_in, sp_vals,
+        ),
+        t_build, cache_dir, cache_key,
     )
+
+
+def _finish_schedule_build(arrays, t0, cache_dir, key):
+    """Record the build in the cache stats/profiling stream and persist
+    the artifact (writer process only) when the disk tier is active."""
+    import time as _time
+
+    from photon_ml_tpu.ops import schedule_cache as _sc
+
+    _sc.record_build_seconds(_time.perf_counter() - t0)
+    if key is not None and _sc.is_cache_writer():
+        _sc.store_schedule(cache_dir, key, arrays)
+    return arrays
 
 
 def _pad_spill_np(sp_out, sp_in, sp_vals, pad_to: Optional[int] = None):
@@ -525,11 +567,12 @@ def _build_schedule(
     params: TileParams,
     sort_by_feature_block: bool,
     num_out_blocks: int,
+    digest: Optional[str] = None,
 ) -> _Schedule:
     return _Schedule(*map(jnp.asarray, _build_schedule_np(
         rows, feats, vals, params=params,
         sort_by_feature_block=sort_by_feature_block,
-        num_out_blocks=num_out_blocks,
+        num_out_blocks=num_out_blocks, digest=digest,
     )))
 
 
@@ -628,14 +671,23 @@ def build_tiled_batch(
     # GIL — overlap them (halves the dominant host cost of cold training)
     from concurrent.futures import ThreadPoolExecutor
 
+    from photon_ml_tpu.ops import schedule_cache as _sc
+
+    # both passes key off the same COO triple: hash it once, up front
+    digest = (
+        _sc.content_digest(rows, feats, vals)
+        if _sc.resolve_cache_dir() is not None else None
+    )
     with ThreadPoolExecutor(2) as pool:
         fz = pool.submit(
             _build_schedule, rows, feats, vals, params=params,
             sort_by_feature_block=False, num_out_blocks=n_pad // win,
+            digest=digest,
         )
         fg = pool.submit(
             _build_schedule, rows, feats, vals, params=params,
             sort_by_feature_block=True, num_out_blocks=d_pad // win,
+            digest=digest,
         )
         z_sched = fz.result()
         g_sched = fg.result()
@@ -732,14 +784,21 @@ def _concat_cell_schedules(
 
     with ThreadPoolExecutor(min(8, n_cells)) as pool:
         pairs = list(pool.map(_cell_pair, range(n_cells)))
-    z_parts = [p[0] for p in pairs]
-    g_parts = [p[1] for p in pairs]
-    gz = max(p[0].shape[0] for p in z_parts)
-    gg = max(p[0].shape[0] for p in g_parts)
-    sz = max(p[8].shape[0] for p in z_parts)
-    sg = max(p[8].shape[0] for p in g_parts)
-    z_parts = [_pad_schedule_np(p, gz, z_out_blocks, sz) for p in z_parts]
-    g_parts = [_pad_schedule_np(p, gg, g_out_blocks, sg) for p in g_parts]
+        z_parts = [p[0] for p in pairs]
+        g_parts = [p[1] for p in pairs]
+        gz = max(p[0].shape[0] for p in z_parts)
+        gg = max(p[0].shape[0] for p in g_parts)
+        sz = max(p[8].shape[0] for p in z_parts)
+        sg = max(p[8].shape[0] for p in g_parts)
+        # the per-cell pad-to-common-shape copies were the last serial
+        # stretch of the sharded build — numpy concatenate releases the
+        # GIL, so they overlap on the same pool
+        z_parts = list(pool.map(
+            lambda p: _pad_schedule_np(p, gz, z_out_blocks, sz), z_parts
+        ))
+        g_parts = list(pool.map(
+            lambda p: _pad_schedule_np(p, gg, g_out_blocks, sg), g_parts
+        ))
     z_sched = _Schedule(*(
         jnp.asarray(np.concatenate([p[i] for p in z_parts]))
         for i in range(9)
@@ -1101,14 +1160,24 @@ def _place_data_sharded(batch: TiledSparseBatch, mesh, axis: str):
     return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
 
 
-# Sharded-schedule cache for ensure_tiled_sharded: a caller that wraps the
-# SAME indices/values/weights arrays in a fresh SparseBatch per call (the
-# GAME coordinate-descent pattern — only offsets change between sweeps)
-# must not pay the multi-second schedule rebuild + host pull every call.
-# Keyed by array identity; FIFO-bounded because each entry pins a tiled
-# batch in HBM.
-_SHARDED_CACHE: dict = {}
+# In-memory conversion caches for ensure_tiled / ensure_tiled_sharded: a
+# caller that wraps the SAME indices/values/weights arrays in a fresh
+# SparseBatch per call (the GAME coordinate-descent pattern — only
+# offsets change between sweeps) must not pay the multi-second schedule
+# rebuild + host pull every call. Keyed by array identity; LRU-bounded
+# because each entry pins a tiled batch in HBM. TWO separate caches (one
+# per conversion flavor, ADVICE.md round 5): a process interleaving
+# single-device and sharded conversions — GAME with several FE shards
+# plus a GLM grid — previously thrashed one shared 2-entry dict and
+# silently rebuilt every sweep. Both sit in front of the persistent disk
+# tier (ops/schedule_cache.py), which absorbs genuine evictions and
+# process restarts.
+from photon_ml_tpu.ops.schedule_cache import ScheduleLRU as _ScheduleLRU
+
+_TILED_CACHE_MAX = 2
 _SHARDED_CACHE_MAX = 2
+_TILED_CACHE = _ScheduleLRU(_TILED_CACHE_MAX)
+_SHARDED_CACHE = _ScheduleLRU(_SHARDED_CACHE_MAX)
 
 
 def ensure_tiled(
@@ -1118,17 +1187,19 @@ def ensure_tiled(
     params: Optional[TileParams] = None,
 ) -> TiledSparseBatch:
     """Idempotent single-device tiled conversion with the same
-    identity-keyed cache as ensure_tiled_sharded: a SparseBatch sharing
-    indices/values/weights with a previous call (the GAME coordinate-
-    descent pattern — only offsets change between sweeps) reuses the
-    cached schedules and only re-pads the row metadata."""
+    identity-keyed LRU pattern as ensure_tiled_sharded (but its OWN
+    bounded cache, so the two conversion flavors cannot evict each
+    other): a SparseBatch sharing indices/values/weights with a previous
+    call (the GAME coordinate-descent pattern — only offsets change
+    between sweeps) reuses the cached schedules and only re-pads the row
+    metadata."""
     if isinstance(batch, TiledSparseBatch):
         return batch
     key = (
         id(batch.indices), id(batch.values), id(batch.weights),
-        dim, None, None, None, params,
+        dim, params,
     )
-    hit = _SHARDED_CACHE.get(key)
+    hit = _TILED_CACHE.get(key)
     if hit is not None:
         (ix_ref, v_ref, w_ref), cached = hit
         if (
@@ -1141,14 +1212,12 @@ def ensure_tiled(
                 batch, meta.num_rows, meta.num_real_rows
             )
             return cached._replace(labels=lab, offsets=off, weights=wgt)
-        del _SHARDED_CACHE[key]
+        _TILED_CACHE.pop(key)  # stale id collision
     out = tiled_batch_from_sparse(
         batch, dim, params=params or TileParams()
     )
-    while len(_SHARDED_CACHE) >= _SHARDED_CACHE_MAX:
-        _SHARDED_CACHE.pop(next(iter(_SHARDED_CACHE)))
-    _SHARDED_CACHE[key] = (
-        (batch.indices, batch.values, batch.weights), out,
+    _TILED_CACHE.put(
+        key, ((batch.indices, batch.values, batch.weights), out),
     )
     return out
 
@@ -1203,14 +1272,12 @@ def ensure_tiled_sharded(
                 offsets=jax.device_put(off, row_sh),
                 weights=jax.device_put(wgt, row_sh),
             )
-        del _SHARDED_CACHE[key]  # stale id collision
+        _SHARDED_CACHE.pop(key)  # stale id collision
     out = build_sharded_tiled_batch(
         batch, dim, n, params=params or TileParams(), mesh=mesh, axis=axis
     )
-    while len(_SHARDED_CACHE) >= _SHARDED_CACHE_MAX:
-        _SHARDED_CACHE.pop(next(iter(_SHARDED_CACHE)))
-    _SHARDED_CACHE[key] = (
-        (batch.indices, batch.values, batch.weights), out,
+    _SHARDED_CACHE.put(
+        key, ((batch.indices, batch.values, batch.weights), out),
     )
     return out
 

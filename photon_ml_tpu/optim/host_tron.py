@@ -116,12 +116,23 @@ def minimize_tron_host(
         )
         s, r = _truncated_cg_host(hvp, g, delta, max_cg=max_cg)
         w_trial = w + s
+        projected = False
         if box is not None:
             w_trial = box.project(w_trial)
-            s = w_trial - w
+            s_proj = w_trial - w
+            projected = bool(jnp.any(s_proj != s))
+            s = s_proj
         f_new, g_new = value_and_grad_fn(w_trial)
         gs = float(jnp.vdot(g, s))
-        prered = -0.5 * (gs - float(jnp.vdot(s, r)))
+        if projected:
+            # the CG residual r belongs to the UNPROJECTED step; with an
+            # active box constraint the quadratic model must be re-
+            # evaluated at the projected s (one extra Hv pass) or the
+            # actred/prered trust-region test compares incompatible
+            # models near the boundary
+            prered = -(gs + 0.5 * float(jnp.vdot(s, hvp(s))))
+        else:
+            prered = -0.5 * (gs - float(jnp.vdot(s, r)))
         actred = float(f) - float(f_new)
         snorm = float(jnp.linalg.norm(s))
 

@@ -53,6 +53,7 @@ def train_generalized_linear_model(
     kernel: str = "scatter",
     mesh=None,
     track_models: bool = False,
+    tile_cache_dir: Optional[str] = None,
 ) -> Tuple[Dict[float, GeneralizedLinearModel], Dict[float, OptResult]]:
     """Train one model per regularization weight with warm starts.
 
@@ -74,6 +75,12 @@ def train_generalized_linear_model(
     OptResult's ``tracker.coefs`` (ModelTracker analog). Use
     :func:`iteration_models` to turn a result into per-iteration models
     in the original feature space.
+
+    ``tile_cache_dir``: persistent content-addressed schedule cache
+    directory (ops/schedule_cache.py) for the tiled conversion — a warm
+    rerun over the same dataset loads the schedules instead of
+    rebuilding. None falls back to the process configuration /
+    PHOTON_TILE_CACHE_DIR env var (unset = off).
     """
     base = OptimizerConfig.default_for(optimizer_type)
     config = OptimizerConfig(
@@ -92,31 +99,33 @@ def train_generalized_linear_model(
         batch = ensure_data_sharded(batch, mesh)
     if kernel == "tiled":
         from photon_ml_tpu.data.batch import SparseBatch
+        from photon_ml_tpu.ops.schedule_cache import cache_scope
         from photon_ml_tpu.ops.tiled_sparse import (
             TiledSparseBatch,
             ensure_tiled_sharded,
             tiled_batch_from_sparse,
         )
 
-        if mesh is not None:
-            # per-device-shard schedules built once here; the whole lambda
-            # grid (and problem.run's idempotent ensure) reuses them —
-            # tiled and distributed compose, no scatter fallback
-            if not isinstance(batch, (SparseBatch, TiledSparseBatch)):
+        with cache_scope(tile_cache_dir):
+            if mesh is not None:
+                # per-device-shard schedules built once here; the whole
+                # lambda grid (and problem.run's idempotent ensure) reuses
+                # them — tiled and distributed compose, no scatter fallback
+                if not isinstance(batch, (SparseBatch, TiledSparseBatch)):
+                    raise TypeError(
+                        "kernel='tiled' requires a SparseBatch or "
+                        f"TiledSparseBatch, got {type(batch).__name__}; use "
+                        "kernel='scatter' for dense batches"
+                    )
+                batch = ensure_tiled_sharded(batch, dim, mesh)
+            elif isinstance(batch, SparseBatch):
+                batch = tiled_batch_from_sparse(batch, dim)
+            elif not isinstance(batch, TiledSparseBatch):
                 raise TypeError(
                     "kernel='tiled' requires a SparseBatch or "
                     f"TiledSparseBatch, got {type(batch).__name__}; use "
                     "kernel='scatter' for dense batches"
                 )
-            batch = ensure_tiled_sharded(batch, dim, mesh)
-        elif isinstance(batch, SparseBatch):
-            batch = tiled_batch_from_sparse(batch, dim)
-        elif not isinstance(batch, TiledSparseBatch):
-            raise TypeError(
-                "kernel='tiled' requires a SparseBatch or TiledSparseBatch, "
-                f"got {type(batch).__name__}; use kernel='scatter' for "
-                "dense batches"
-            )
     problem = create_glm_problem(
         task,
         dim,
@@ -169,6 +178,7 @@ def train_feature_sharded(
     kernel: str = "scatter",
     optimizer_type: OptimizerType = OptimizerType.LBFGS,
     track_models: bool = False,
+    tile_cache_dir: Optional[str] = None,
 ) -> Tuple[Dict[float, GeneralizedLinearModel], Dict[float, OptResult]]:
     """Lambda grid over a FEATURE-SHARDED coefficient vector (the >HBM /
     10B-coefficient path, SURVEY §2.3 "coefficient parallelism").
@@ -242,12 +252,14 @@ def train_feature_sharded(
     with_norm = normalization is not None and not normalization.is_identity
 
     if kernel == "tiled":
+        from photon_ml_tpu.ops.schedule_cache import cache_scope
         from photon_ml_tpu.ops.tiled_sparse import feature_shard_tiled_batch
 
-        sharded, block_dim = feature_shard_tiled_batch(
-            batch, dim, data_shards, num_blocks, mesh=mesh,
-            data_axis=DATA_AXIS, model_axis=MODEL_AXIS,
-        )
+        with cache_scope(tile_cache_dir):
+            sharded, block_dim = feature_shard_tiled_batch(
+                batch, dim, data_shards, num_blocks, mesh=mesh,
+                data_axis=DATA_AXIS, model_axis=MODEL_AXIS,
+            )
         meta = sharded.meta
     else:
         sharded, block_dim = feature_shard_sparse_batch(
@@ -354,6 +366,7 @@ def train_streaming_glm(
     fmt=None,
     index_map=None,
     stats=None,
+    tile_cache_dir: Optional[str] = None,
 ):
     """Train a GLM over Avro inputs LARGER than host RAM: every objective
     evaluation streams fixed-shape chunks from disk (io/streaming.py), so
@@ -461,7 +474,7 @@ def train_streaming_glm(
         paths, fmt, index_map, stats, task,
         rows_per_chunk=rows_per_chunk, cache_bytes=cache_bytes,
         prefetch=prefetch, kernel=kernel, tile_params=tile_params,
-        norm=normalization,
+        norm=normalization, tile_cache_dir=tile_cache_dir,
     )
     from photon_ml_tpu.utils.index_map import intercept_key
 

@@ -8,8 +8,9 @@ TensorBoard / Perfetto."""
 from __future__ import annotations
 
 import os
+import threading
 from contextlib import contextmanager, nullcontext
-from typing import Optional
+from typing import Dict, Optional
 
 
 @contextmanager
@@ -27,3 +28,32 @@ def profile_trace(profile_dir: Optional[str]):
     if not profile_dir:
         return nullcontext()
     return _trace(profile_dir)
+
+
+# -- host-side timing registry ----------------------------------------------
+#
+# jax.profiler covers device timelines; HOST-side one-off costs (schedule
+# builds, cache loads/stores) need their own accumulation so drivers can
+# report them without wrapping every call site in a Timer. Named buckets
+# accumulate across the process; drivers snapshot into metrics.json.
+
+_HOST_TIMINGS: Dict[str, float] = {}
+_HOST_TIMINGS_LOCK = threading.Lock()
+
+
+def record_host_timing(name: str, seconds: float) -> None:
+    """Accumulate ``seconds`` into the named host-timing bucket
+    (thread-safe — schedule builds run on worker threads)."""
+    with _HOST_TIMINGS_LOCK:
+        _HOST_TIMINGS[name] = _HOST_TIMINGS.get(name, 0.0) + seconds
+
+
+def host_timings() -> Dict[str, float]:
+    """Snapshot of all accumulated host-timing buckets."""
+    with _HOST_TIMINGS_LOCK:
+        return dict(_HOST_TIMINGS)
+
+
+def reset_host_timings() -> None:
+    with _HOST_TIMINGS_LOCK:
+        _HOST_TIMINGS.clear()

@@ -11,6 +11,10 @@ Must run before any jax import in the test process.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Hermetic tier-1: a developer's persistent tile-schedule cache must not
+# leak into (or be polluted by) the test run — tests opt in explicitly
+# via schedule_cache.cache_scope(tmp_path).
+os.environ.pop("PHOTON_TILE_CACHE_DIR", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -22,6 +26,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+# Installs the jax compat shim (jax.shard_map on releases where it still
+# lives in jax.experimental) BEFORE test modules do `from jax import
+# shard_map` at import time.
+import photon_ml_tpu  # noqa: E402,F401
 
 import numpy as np
 import pytest

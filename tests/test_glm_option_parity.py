@@ -212,3 +212,44 @@ class TestReviewRegressions:
         vals = np.asarray(loaded.batch.values)[0]
         # only 1-based ids 1 and 3 (0-based 0 and 2) survive the filter
         assert sorted(v for v in vals.tolist() if v) == [2.0, 4.0]
+
+
+class TestTileCacheOption:
+    def test_tile_cache_dir_flag_and_default(self):
+        p = params_from_args([
+            "--training-data-directory", "x", "--output-directory", "y",
+        ])
+        assert p.tile_cache_dir is None  # env-var / off default
+        p = params_from_args([
+            "--training-data-directory", "x", "--output-directory", "y",
+            "--tile-cache-dir", "/scratch/tiles",
+        ])
+        assert p.tile_cache_dir == "/scratch/tiles"
+
+
+class TestDiagnosticReservoirBudget:
+    def test_byte_budget_scales_rows_down(self):
+        from photon_ml_tpu.cli.glm_driver import budgeted_reservoir_rows
+
+        # narrow rows: the row cap binds, not the byte budget
+        assert budgeted_reservoir_rows(100_000, 256 << 20, 16) == 100_000
+        # wide rows (max_nnz 4096 -> ~32 KiB/row): the byte budget binds
+        wide = budgeted_reservoir_rows(100_000, 256 << 20, 4096)
+        assert 1 <= wide < 100_000
+        assert wide * (4096 * 8 + 12) <= 256 << 20
+        # pathologically wide rows still sample at least one row
+        assert budgeted_reservoir_rows(100_000, 1024, 1 << 20) == 1
+
+    def test_reservoir_params_validated(self, tmp_path):
+        p = GLMParams(
+            train_dir="x", output_dir=str(tmp_path / "o"),
+            diagnostic_reservoir_rows=0,
+        )
+        with pytest.raises(ValueError, match="reservoir-rows"):
+            p.validate()
+        p = GLMParams(
+            train_dir="x", output_dir=str(tmp_path / "o"),
+            diagnostic_reservoir_bytes=0,
+        )
+        with pytest.raises(ValueError, match="reservoir-bytes"):
+            p.validate()

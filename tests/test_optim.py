@@ -151,6 +151,47 @@ class TestTRON:
         np.testing.assert_allclose(np.asarray(solve(centers)), np.asarray(centers), atol=1e-3)
 
 
+class TestHostTronBox:
+    def test_box_projected_step_converges_to_constrained_optimum(self):
+        """Active box constraints: the trust-region test must use a
+        quadratic model of the PROJECTED step (host_tron recomputes
+        prered via one extra Hv when projection alters s), so the solver
+        still walks to the constrained optimum instead of collapsing the
+        radius on inconsistent actred/prered ratios."""
+        from photon_ml_tpu.optim.host_tron import minimize_tron_host
+
+        center = jnp.asarray([2.0, -3.0, 0.25, 1.5], jnp.float32)
+        scales = jnp.asarray([1.0, 4.0, 0.5, 2.0], jnp.float32)
+        box = BoxConstraints(
+            lower=jnp.full((4,), -0.5, jnp.float32),
+            upper=jnp.full((4,), 0.5, jnp.float32),
+        )
+        res = minimize_tron_host(
+            quad_vg(center, scales),
+            quad_hvp(scales),
+            jnp.zeros(4),
+            max_iter=100,
+            tol=1e-10,
+            box=box,
+        )
+        # separable quadratic: the constrained optimum is the clipped center
+        expected = np.clip(np.asarray(center), -0.5, 0.5)
+        np.testing.assert_allclose(
+            np.asarray(res.coefficients), expected, atol=1e-3
+        )
+        assert int(res.reason) != NOT_CONVERGED
+
+    def test_unconstrained_matches_in_jit_tron(self):
+        from photon_ml_tpu.optim.host_tron import minimize_tron_host
+
+        res = minimize_tron_host(
+            quad_vg(CENTER, SCALES), quad_hvp(SCALES), jnp.zeros(4)
+        )
+        np.testing.assert_allclose(
+            np.asarray(res.coefficients), CENTER, atol=1e-4
+        )
+
+
 class TestFactory:
     def test_tron_l1_rejected(self):
         with pytest.raises(ValueError):
