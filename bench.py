@@ -29,6 +29,7 @@ is computed against our own round-1 scatter/gather measurement
 """
 
 import json
+import os
 import sys
 import time
 
@@ -50,6 +51,24 @@ def main():
     from photon_ml_tpu.utils.backend import enable_compilation_cache
 
     enable_compilation_cache()
+
+    if all(d.platform == "cpu" for d in jax.devices()):
+        # No accelerator reachable from this host (the axon tunnel can be
+        # down for a whole round): the Mosaic kernels cannot execute, so
+        # the headline microbench is meaningless here. Emit the sections
+        # whose numbers are host-side and transfer (the overlap A/B +
+        # streaming-populate accounting) with the device stated, instead
+        # of crashing and leaving the round with no artifact.
+        result = overlap_ab()
+        result["tpu_tests"] = tpu_tests
+        result["detail"]["device"] = str(jax.devices()[0])
+        result["detail"]["note"] = (
+            "CPU-only host (accelerator unreachable); kernel-path "
+            "microbench and BASELINE suite skipped — see the last "
+            "chip-attached BENCH round for those numbers"
+        )
+        print(json.dumps(result))
+        return result
 
     from photon_ml_tpu.data.batch import SparseBatch
     from photon_ml_tpu.ops.losses import LOGISTIC
@@ -107,15 +126,19 @@ def main():
         shutil.rmtree(cache_tmp, ignore_errors=True)
     obj = TiledGLMObjective(LOGISTIC, d)
 
-    @jax.jit
-    def loop(m, w0, tb):
-        def body(i, carry):
-            w, acc = carry
-            v, g = obj.value_and_gradient(w, tb, 0.1)
-            return (w - 1e-9 * g, acc + v)
+    def make_loop(o):
+        @jax.jit
+        def loop(m, w0, tb):
+            def body(i, carry):
+                w, acc = carry
+                v, g = o.value_and_gradient(w, tb, 0.1)
+                return (w - 1e-9 * g, acc + v)
 
-        return lax.fori_loop(0, m, body, (w0, jnp.float32(0.0)))
+            return lax.fori_loop(0, m, body, (w0, jnp.float32(0.0)))
 
+        return loop
+
+    loop = make_loop(obj)
     w0 = jnp.zeros((d,), jnp.float32)
     iters = 11
 
@@ -140,6 +163,17 @@ def main():
     # capture the driver keeps
     dt = min(measure(loop, tb), measure(loop, tb))
     examples_per_sec = n / dt
+
+    # Kernel-chapter close-out A/B: the MXU-packed one-hot expansion
+    # (onehot="mxu", the round-3 "pack the one-hot build onto the MXU"
+    # lever) against the compare build, same schedules, back-to-back —
+    # the record PERF_NOTES round 7 carries so it is never re-litigated.
+    loop_moh = make_loop(TiledGLMObjective(LOGISTIC, d, onehot="mxu"))
+    try:
+        dt_moh = min(measure(loop_moh, tb), measure(loop_moh, tb))
+    except Exception as e:  # Mosaic lowering may reject the tiny matmul
+        dt_moh = None
+        moh_error = f"{type(e).__name__}: {e}"[:300]
 
     # correctness oracle: one scatter/gather evaluation at the same point
     oracle = GLMObjective(LOGISTIC, d)
@@ -230,18 +264,26 @@ def main():
     )
     hbm_bytes_bound_ms = sched_bytes / 819e9 * 1e3
 
+    # host-device overlap A/B (CPU-scaled shape; the full config-5 A/B
+    # runs via dev-scripts/bench_overlap.sh / `bench.py --overlap-ab --full`)
+    overlap_result = overlap_ab()
+
     result = {
         "metric": "fused_value_and_gradient_examples_per_sec_per_chip",
         "value": round(examples_per_sec),
         "unit": "examples/sec/chip",
         "vs_baseline": round(examples_per_sec / ROUND1_EXAMPLES_PER_SEC, 2),
         "tpu_tests": tpu_tests,
+        "overlap": overlap_result["detail"],
         "detail": {
             "kernel": "tiled_pallas_" + obj.mxu,
             "n": n,
             "nnz_per_row": k,
             "dim": d,
             "ms_per_eval": round(dt * 1e3, 3),
+            "ms_per_eval_mxu_onehot": (
+                round(dt_moh * 1e3, 3) if dt_moh is not None else moh_error
+            ),
             "ms_per_eval_1dev_mesh": round(mesh_dt * 1e3, 3),
             "schedule_build_s": round(schedule_build_s, 1),
             "schedule_build_s_cold": round(schedule_build_s_cold, 2),
@@ -273,6 +315,298 @@ def main():
     }
     print(json.dumps(result))
     return result
+
+
+def overlap_ab(full: bool = False):
+    """Host-device overlap A/B (parallel/overlap.py): the config-5-shaped
+    GAME coordinate-descent step with overlap on vs off, plus the
+    streaming cold-populate pipeline accounting (wall vs host-decode vs
+    device-consume). ``full`` uses the BASELINE config-5 scale (chip-class
+    hosts); the default is the same SHAPE (FE + two multi-bucket RE banks
+    through the real CoordinateDescent) scaled for a CPU host.
+
+    What the A/B exercises: deferred readbacks (one batched device_get
+    per iteration instead of per-bank tracker + per-coordinate reg-term
+    pulls — each ~100 ms over a relay-attached chip), prefetched host
+    prep under device solves, and async artifact IO. On a single-core
+    CPU-only host the expectation is PARITY (the eliminated costs are
+    relay/async-device latencies that do not exist there); the serial
+    path must not be faster.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game import (
+        CoordinateDescent,
+        FeatureShardConfiguration,
+        FixedEffectCoordinate,
+        RandomEffectCoordinate,
+        RandomEffectDataConfiguration,
+        RandomEffectOptimizationProblem,
+        build_game_dataset,
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.ops.losses import LOGISTIC
+    from photon_ml_tpu.optim.config import (
+        OptimizerConfig,
+        RegularizationContext,
+        RegularizationType,
+    )
+    from photon_ml_tpu.optim.problem import create_glm_problem
+    from photon_ml_tpu.parallel import overlap
+    from photon_ml_tpu.task import TaskType
+
+    rng = np.random.default_rng(0)
+    if full:
+        n, dg, n_users, n_items = 1 << 17, 1 << 16, 60_000, 40_000
+    else:
+        n, dg, n_users, n_items = 16_384, 4_096, 2_000, 1_200
+    kg, ku = 16, 6
+    # Skewed entity frequencies (Zipf-ish) land the RE datasets in
+    # MULTIPLE capacity-class buckets — the per-bucket dispatch/readback
+    # structure the overlap layer targets (config 5 runs 24 + 16 buckets).
+    users = np.minimum(
+        (rng.pareto(1.2, size=n) * n_users / 20).astype(np.int64), n_users - 1
+    )
+    items = np.minimum(
+        (rng.pareto(1.2, size=n) * n_items / 20).astype(np.int64), n_items - 1
+    )
+    gix = rng.integers(0, dg, size=(n, kg))
+    gv = rng.normal(size=(n, kg)).astype(np.float32)
+    uv = rng.normal(size=(n, ku)).astype(np.float32)
+    z = gv.sum(axis=1) * 0.1 + uv.sum(axis=1) * 0.2
+    y = (rng.uniform(size=n) < 1.0 / (1.0 + np.exp(-z))).astype(np.float32)
+    recs = [
+        {
+            "uid": f"r{i}",
+            "response": float(y[i]),
+            "userId": f"u{users[i]}",
+            "itemId": f"i{items[i]}",
+            "features": [
+                {"name": str(int(j)), "term": "", "value": float(v)}
+                for j, v in zip(gix[i], gv[i])
+            ],
+            "userFeatures": [
+                {"name": f"f{j}", "term": "", "value": float(uv[i][j])}
+                for j in range(ku)
+            ],
+        }
+        for i in range(n)
+    ]
+    shards = [
+        FeatureShardConfiguration("globalShard", ["features"], add_intercept=True),
+        FeatureShardConfiguration("userShard", ["userFeatures"], add_intercept=True),
+    ]
+    ds = build_game_dataset(recs, shards, ["userId", "itemId"])
+    del recs
+
+    def build_cd():
+        red_u = build_random_effect_dataset(
+            ds, RandomEffectDataConfiguration("userId", "userShard")
+        )
+        red_i = build_random_effect_dataset(
+            ds, RandomEffectDataConfiguration("itemId", "userShard")
+        )
+        coords = {
+            "fixed": FixedEffectCoordinate(
+                name="fixed",
+                dataset=ds,
+                problem=create_glm_problem(
+                    TaskType.LOGISTIC_REGRESSION,
+                    ds.shards["globalShard"].dim,
+                    config=OptimizerConfig(max_iter=25),
+                    regularization=RegularizationContext(
+                        RegularizationType.L2
+                    ),
+                ),
+                feature_shard_id="globalShard",
+                reg_weight=0.5,
+            ),
+            "perUser": RandomEffectCoordinate(
+                name="perUser", dataset=ds, re_dataset=red_u,
+                problem=RandomEffectOptimizationProblem(
+                    LOGISTIC, OptimizerConfig(max_iter=15),
+                    RegularizationContext(RegularizationType.L2),
+                    reg_weight=1.0,
+                ),
+            ),
+            "perItem": RandomEffectCoordinate(
+                name="perItem", dataset=ds, re_dataset=red_i,
+                problem=RandomEffectOptimizationProblem(
+                    LOGISTIC, OptimizerConfig(max_iter=15),
+                    RegularizationContext(RegularizationType.L2),
+                    reg_weight=1.0,
+                ),
+            ),
+        }
+        n_buckets = len(red_u.buckets) + len(red_i.buckets)
+        return CoordinateDescent(
+            coords, ds, TaskType.LOGISTIC_REGRESSION,
+            update_sequence=["fixed", "perUser", "perItem"],
+        ), n_buckets
+
+    cd, n_buckets = build_cd()
+    with overlap.overlap_scope(True):
+        cd.run(1)  # compile + device caches (both modes share programs)
+
+    def step_time(enabled):
+        best = float("inf")
+        for _ in range(2):
+            with overlap.overlap_scope(enabled):
+                t0 = time.perf_counter()
+                cd.run(1)
+                best = min(best, time.perf_counter() - t0)
+        return best
+
+    # alternate to keep host-load drift out of the comparison
+    t_on = step_time(True)
+    t_off = step_time(False)
+    t_on = min(t_on, step_time(True))
+    t_off = min(t_off, step_time(False))
+    with overlap.overlap_scope(True):
+        overlap.reset_readback_stats()
+        cd.run(1)
+        readbacks_on = overlap.readback_stats()
+    with overlap.overlap_scope(False):
+        overlap.reset_readback_stats()
+        cd.run(1)
+        readbacks_off = overlap.readback_stats()
+
+    # -- streaming cold-populate pipeline accounting ------------------------
+    from photon_ml_tpu.io import schemas
+    from photon_ml_tpu.io.avro_codec import write_container
+    from photon_ml_tpu.io.input_format import AvroInputDataFormat
+    from photon_ml_tpu.io.streaming import (
+        StreamingGLMObjective,
+        iter_chunks,
+        scan_stream,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="photon-overlap-bench-")
+    try:
+        r = np.random.default_rng(1)
+        n_files, rows_per_file, ds_d, ks = (
+            (8, 125_000, 200_000, 16) if full else (6, 8_000, 20_000, 12)
+        )
+        for fi in range(n_files):
+            sx = r.integers(0, ds_d, size=(rows_per_file, ks))
+            sv = r.normal(size=(rows_per_file, ks))
+            lab = (r.uniform(size=rows_per_file) > 0.5).astype(float)
+            write_container(
+                f"{tmp}/p{fi}.avro",
+                schemas.TRAINING_EXAMPLE_AVRO,
+                [
+                    {
+                        "uid": f"{fi}-{i}",
+                        "label": float(lab[i]),
+                        "features": [
+                            {"name": str(int(j)), "term": "", "value": float(v)}
+                            for j, v in zip(sx[i], sv[i])
+                        ],
+                        "offset": 0.0,
+                        "weight": 1.0,
+                    }
+                    for i in range(rows_per_file)
+                ],
+            )
+        fmt = AvroInputDataFormat()
+        index_map, stats = scan_stream([tmp], fmt)
+
+        def populate_wall(overlapped):
+            with overlap.overlap_scope(overlapped):
+                sobj = StreamingGLMObjective(
+                    [tmp], fmt, index_map, stats,
+                    TaskType.LOGISTIC_REGRESSION,
+                    rows_per_chunk=16_384, kernel="scatter",
+                    prefetch=overlapped,
+                )
+                w = jnp.zeros((sobj.dim,), jnp.float32)
+                t0 = time.perf_counter()
+                v, _ = sobj.value_and_gradient(w, 0.1)
+                _ = float(v)
+                wall = time.perf_counter() - t0
+                # device-consume per pass: the cached eval (no decode)
+                t0 = time.perf_counter()
+                v, _ = sobj.value_and_gradient(w, 0.1)
+                _ = float(v)
+                consume = time.perf_counter() - t0
+            return wall, consume
+
+        populate_wall(True)  # compile the partial program once
+        wall_piped, consume_s = populate_wall(True)
+        wall_serial, _ = populate_wall(False)
+        wall_piped = min(wall_piped, populate_wall(True)[0])
+        wall_serial = min(wall_serial, populate_wall(False)[0])
+        # host decode+stage alone: drain the chunk iterator, no compute
+        t0 = time.perf_counter()
+        for _chunk in iter_chunks(
+            [tmp], fmt, index_map,
+            rows_per_chunk=16_384, nnz_width=stats.max_nnz, pipeline=False,
+        ):
+            pass
+        decode_s = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    one_core = os.cpu_count() == 1 if hasattr(os, "cpu_count") else False
+    return {
+        "metric": "overlap_ab",
+        "value": round(t_off / t_on, 3),
+        "unit": "x speedup (GAME CD step, overlap on vs off)",
+        "detail": {
+            "scale": "config-5 full" if full else "config-5-shaped, CPU-scaled",
+            "game_step": {
+                "rows": n,
+                "fe_dim": int(ds.shards["globalShard"].dim),
+                "re_entities": [n_users, n_items],
+                "re_buckets_total": n_buckets,
+                "step_s_overlap_on": round(t_on, 3),
+                "step_s_overlap_off": round(t_off, 3),
+                "speedup": round(t_off / t_on, 3),
+                "readbacks_per_step_on": readbacks_on,
+                "readbacks_per_step_off": readbacks_off,
+            },
+            "streaming_populate": {
+                "files": n_files,
+                "rows": n_files * rows_per_file,
+                "cold_populate_wall_s_pipelined": round(wall_piped, 3),
+                "cold_populate_wall_s_serial": round(wall_serial, 3),
+                "host_decode_stage_s": round(decode_s, 3),
+                "device_consume_s": round(consume_s, 3),
+                "bound_max_decode_consume_s": round(
+                    max(decode_s, consume_s), 3
+                ),
+                "bound_sum_s": round(decode_s + consume_s, 3),
+                # the acceptance inequality, with a 15%+50ms epsilon:
+                # multicore/chip hosts must meet the max() bound; a
+                # single-core host can only meet the sum() bound (no
+                # second core to run the decode under the consume)
+                "wall_within_max_bound": bool(
+                    wall_piped
+                    <= max(decode_s, consume_s) * 1.15 + 0.05
+                ),
+                "wall_within_sum_bound": bool(
+                    wall_piped <= (decode_s + consume_s) * 1.15 + 0.05
+                ),
+            },
+            "host": {
+                "cpu_count": os.cpu_count(),
+                "note": (
+                    "single-core host: compute/compute overlap is "
+                    "physically unavailable; the pipelined wall is bounded "
+                    "by decode+consume, and the GAME A/B gate is parity "
+                    "(>=1.15x applies on relay/chip-attached hosts where "
+                    "the eliminated ~100ms readbacks and ~125ms dispatch "
+                    "gaps exist — PERF_NOTES round 5/6)"
+                    if one_core
+                    else "multi-core host"
+                ),
+            },
+        },
+    }
 
 
 def _run_tpu_test_tier():
@@ -1267,7 +1601,9 @@ def suite(only=None):
 
 
 if __name__ == "__main__":
-    if "--suite" in sys.argv:
+    if "--overlap-ab" in sys.argv:
+        print(json.dumps(overlap_ab(full="--full" in sys.argv)))
+    elif "--suite" in sys.argv:
         only = None
         if "--only" in sys.argv:
             i = sys.argv.index("--only") + 1

@@ -65,6 +65,9 @@ class GameScoringParams:
     # scoring run over an already-trained dataset reuses its tiled
     # layout. None falls back to PHOTON_TILE_CACHE_DIR; unset = off.
     tile_cache_dir: Optional[str] = None
+    # Escape hatch for the host-device overlap layer (parallel/overlap.py):
+    # True writes score part files synchronously (serial A/B baseline).
+    no_overlap: bool = False
     # Chunked scoring for inputs larger than memory (the reference scores
     # RDD partitions without collecting — Spark's memory profile by
     # construction); requires prebuilt feature maps, pointwise/global
@@ -110,6 +113,10 @@ class GameScoringDriver:
             from photon_ml_tpu.ops.schedule_cache import configure
 
             configure(params.tile_cache_dir)
+        if params.no_overlap:
+            from photon_ml_tpu.parallel import overlap
+
+            overlap.set_overlap(False)
         from photon_ml_tpu.parallel.multihost import prepare_output_dir
 
         prepare_output_dir(
@@ -252,7 +259,13 @@ class GameScoringDriver:
                         + jnp.asarray(ds.offsets)
                     )[: ds.num_real_rows]
                     if is_coordinator():
-                        write_container(
+                        # async artifact IO (overlap): chunk i's part
+                        # file writes while chunk i+1 loads and scores;
+                        # drained before the completion log/barrier
+                        from photon_ml_tpu.parallel import overlap
+
+                        overlap.submit_io(
+                            write_container,
                             os.path.join(
                                 p.output_dir, "scores",
                                 f"part-{part:05d}.avro",
@@ -270,6 +283,9 @@ class GameScoringDriver:
                         all_weights.append(
                             np.asarray(ds.weights[: ds.num_real_rows])
                         )
+        from photon_ml_tpu.parallel import overlap
+
+        overlap.drain_io()  # every queued part file is on disk
         if n_rows == 0:
             raise ValueError("empty GAME dataset")  # in-memory parity
         self.logger.info(
@@ -386,6 +402,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "feature maps; sharded evaluators unsupported)",
     )
     ap.add_argument("--rows-per-chunk", type=int, default=100_000)
+    ap.add_argument(
+        "--no-overlap", default="false",
+        help="disable the host-device overlap layer (async score-part "
+        "writes) and run fully serial",
+    )
     return ap
 
 
@@ -413,6 +434,7 @@ def params_from_args(argv=None) -> GameScoringParams:
         model_id=ns.game_model_id or ns.model_id or "",
         profile_dir=ns.profile_dir,
         tile_cache_dir=ns.tile_cache_dir,
+        no_overlap=str(ns.no_overlap).lower() in ("true", "1", "yes"),
         streaming=str(ns.streaming).lower() in ("true", "1", "yes"),
         rows_per_chunk=ns.rows_per_chunk,
         has_response=str(ns.has_response).lower() in ("true", "1", "yes"),

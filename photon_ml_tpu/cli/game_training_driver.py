@@ -235,6 +235,11 @@ class GameTrainingParams:
     # the tiled layout across runs. None falls back to the
     # PHOTON_TILE_CACHE_DIR env var; unset = off.
     tile_cache_dir: Optional[str] = None
+    # Escape hatch for the host-device overlap layer (parallel/overlap.py):
+    # True runs fully serial — eager readbacks, inline host prep,
+    # synchronous checkpoint/metrics writes (the pre-overlap behavior and
+    # the dev-scripts/bench_overlap.sh A/B baseline).
+    no_overlap: bool = False
 
     def validate(self) -> None:
         if not self.train_input_dirs:
@@ -262,24 +267,11 @@ class GameTrainingParams:
         for name in self.fixed_effect_data_configs:
             if name not in self.fixed_effect_opt_configs:
                 raise ValueError(f"missing optimization config for {name}")
-            if self.distributed == "feature":
-                # the feature-sharded fixed effect lays the WHOLE dataset
-                # out per feature block; down-sampling would need a
-                # re-layout per draw — unsupported, and it must fail HERE
-                # at argument parsing, not as a mid-training
-                # NotImplementedError in FixedEffectCoordinate
-                # (ADVICE.md round 5)
-                for alt in self.fixed_effect_opt_configs[name].split(";"):
-                    if not alt.strip():
-                        continue
-                    cfg = GLMOptimizationConfiguration.parse(alt)
-                    if cfg.down_sampling_rate < 1.0:
-                        raise ValueError(
-                            "--distributed feature does not support a "
-                            f"down-sampling rate < 1.0 (coordinate {name!r} "
-                            f"has rate {cfg.down_sampling_rate}); drop the "
-                            "down-sampling or use --distributed auto/off"
-                        )
+            # Down-sampling composes with --distributed feature since the
+            # sampler became pure row re-weighting on the cached sharded
+            # layout (the per-draw weights are traced arguments —
+            # FixedEffectCoordinate._update_model_feature_sharded); the
+            # round-5 parse-time rejection is gone with the limitation.
         for name in self.random_effect_data_configs:
             if name not in self.random_effect_opt_configs:
                 raise ValueError(f"missing optimization config for {name}")
@@ -304,6 +296,10 @@ class GameTrainingDriver:
             from photon_ml_tpu.ops.schedule_cache import configure
 
             configure(params.tile_cache_dir)
+        if params.no_overlap:
+            from photon_ml_tpu.parallel import overlap
+
+            overlap.set_overlap(False)
         prepare_output_dir(
             params.output_dir,
             delete_if_exists=params.delete_output_dir_if_exists,
@@ -689,6 +685,7 @@ class GameTrainingDriver:
             }
         prev_model = None
         best_orig_idx = None
+        build_futures: Dict[int, object] = {}
         try:
             for ti, ci in enumerate(order):
                 combo = combos[ci]
@@ -704,9 +701,23 @@ class GameTrainingDriver:
                     # in warm-start order, not grid order)
                     p.profile_dir if ti == 0 else None
                 ):
-                    coords = self._build_coordinates(
-                        dataset, re_datasets, combo
+                    from photon_ml_tpu.parallel import overlap
+
+                    fut = build_futures.pop(ci, None)
+                    coords = (
+                        overlap.wait(fut)
+                        if fut is not None
+                        else self._build_coordinates(dataset, re_datasets, combo)
                     )
+                    if ti + 1 < len(order):
+                        # the NEXT combo's problem setup builds on the
+                        # background worker UNDER this combo's training
+                        # (overlap prefetched dispatch on the grid axis)
+                        nci = order[ti + 1]
+                        build_futures[nci] = overlap.submit(
+                            self._build_coordinates,
+                            dataset, re_datasets, combos[nci],
+                        )
                     metric_name = None
                     if validation_fn is not None:
                         metric_name = (self._evaluators[0].render())
@@ -751,6 +762,10 @@ class GameTrainingDriver:
                         )
                     finally:
                         if checkpointer is not None:
+                            from photon_ml_tpu.parallel import overlap
+
+                            # queued step writes must land before close
+                            overlap.drain_io()
                             checkpointer.close()
                     prev_model = result.model
                 self.results.append((combo, result, ci))
@@ -934,6 +949,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "(warm GAME sweeps over the same dataset skip the tiled layout "
         "rebuild). Default: $PHOTON_TILE_CACHE_DIR, unset = off",
     )
+    ap.add_argument(
+        "--no-overlap", default="false",
+        help="disable the host-device overlap layer (deferred readbacks, "
+        "background host prep, async checkpoint/metrics writes) and run "
+        "fully serial — the A/B escape hatch",
+    )
     return ap
 
 
@@ -1029,6 +1050,7 @@ def params_from_args(argv=None) -> GameTrainingParams:
         checkpoint_dir=ns.checkpoint_dir,
         profile_dir=ns.profile_dir,
         tile_cache_dir=ns.tile_cache_dir,
+        no_overlap=_bool(ns.no_overlap),
     )
 
 

@@ -150,6 +150,11 @@ class GLMParams:
     # tiled layout instead of paying the multi-second rebuild. None falls
     # back to the PHOTON_TILE_CACHE_DIR env var; unset = off.
     tile_cache_dir: Optional[str] = None
+    # Escape hatch for the host-device overlap layer (parallel/overlap.py):
+    # True runs fully serial — eager readbacks, inline host prep,
+    # synchronous artifact writes (the pre-overlap behavior, and the A/B
+    # baseline for dev-scripts/bench_overlap.sh).
+    no_overlap: bool = False
     # Diagnostics reservoir bounds for the streaming path: the sample is
     # rows x max_nnz dense (int32+float32), so wide-row datasets must not
     # blow the bounded-memory contract — rows are scaled down to fit the
@@ -291,6 +296,10 @@ class GLMDriver:
             from photon_ml_tpu.ops.schedule_cache import configure
 
             configure(params.tile_cache_dir)
+        if params.no_overlap:
+            from photon_ml_tpu.parallel import overlap
+
+            overlap.set_overlap(False)
         prepare_output_dir(
             params.output_dir,
             delete_if_exists=params.delete_output_dirs_if_exist,
@@ -461,7 +470,15 @@ class GLMDriver:
                         )
 
                         if is_coordinator():
-                            self._write_summary(p.summarization_output_dir)
+                            # async artifact IO (overlap): the summary
+                            # write runs off the critical path; run()
+                            # drains before the output barrier
+                            from photon_ml_tpu.parallel import overlap
+
+                            overlap.submit_io(
+                                self._write_summary,
+                                p.summarization_output_dir,
+                            )
                 if p.data_validation_type != DataValidationType.VALIDATE_DISABLED:
                     # chunk-wise sanity checks — same DataValidators rules
                     # as the in-memory path, still bounded memory; each
@@ -511,7 +528,11 @@ class GLMDriver:
                 from photon_ml_tpu.parallel.multihost import is_coordinator
 
                 if is_coordinator():
-                    self._write_summary(p.summarization_output_dir)
+                    from photon_ml_tpu.parallel import overlap
+
+                    overlap.submit_io(
+                        self._write_summary, p.summarization_output_dir
+                    )
         self._advance(DriverStage.PREPROCESSED)
 
     def _dated_paths(self, base_dir, date_range, days_ago):
@@ -661,23 +682,31 @@ class GLMDriver:
         )
 
     def _log_results(self) -> None:
-        for lam, res in self.results.items():
+        # The lambda grid's (iterations, value, reason) scalars live on
+        # device; ONE batched fetch materializes the whole grid instead
+        # of three scalar pulls per lambda (deferred-readback discipline,
+        # parallel/overlap.py via training.grid_result_scalars).
+        from photon_ml_tpu.training import grid_result_scalars
+
+        for lam, (iters, value, reason) in grid_result_scalars(
+            self.results
+        ).items():
             self.emitter.send(
                 PhotonOptimizationLogEvent(
                     reg_weight=lam,
-                    iterations=int(res.iterations),
+                    iterations=iters,
                     convergence_reason=CONVERGENCE_REASON_NAMES.get(
-                        int(res.reason), "?"
+                        reason, "?"
                     ),
-                    final_value=float(res.value),
+                    final_value=value,
                 )
             )
             self.logger.info(
                 "lambda=%g: %d iters, f=%g, reason=%s",
                 lam,
-                int(res.iterations),
-                float(res.value),
-                CONVERGENCE_REASON_NAMES.get(int(res.reason), "?"),
+                iters,
+                value,
+                CONVERGENCE_REASON_NAMES.get(reason, "?"),
             )
 
     def _metrics_for(self, model, batch) -> Dict[str, float]:
@@ -864,6 +893,9 @@ class GLMDriver:
             self.diagnose()
         if is_coordinator():
             self._write_outputs()
+        from photon_ml_tpu.parallel import overlap
+
+        overlap.drain_io()  # queued artifact writes land before the barrier
         sync_processes("outputs-written")
         self.logger.info("stages: %s", [s.name for s in self.stage_history])
         self.logger.info("timers:\n%s", self.timer.summary())
@@ -983,6 +1015,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     )
     ap.add_argument("--num-processes", type=int, default=None)
     ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument(
+        "--no-overlap", default="false",
+        help="disable the host-device overlap layer (deferred readbacks, "
+        "background host prep, async artifact writes) and run fully "
+        "serial — the A/B escape hatch",
+    )
     return ap
 
 
@@ -1054,6 +1092,7 @@ def params_from_args(argv=None) -> GLMParams:
         streaming=_bool(ns.streaming),
         profile_dir=ns.profile_dir,
         tile_cache_dir=ns.tile_cache_dir,
+        no_overlap=_bool(ns.no_overlap),
         diagnostic_reservoir_rows=ns.diagnostic_reservoir_rows,
         diagnostic_reservoir_bytes=ns.diagnostic_reservoir_bytes,
         model_shards=ns.model_shards,

@@ -25,26 +25,54 @@ Array = jnp.ndarray
 
 def default_down_sample(key: Array, batch: Batch, rate) -> Batch:
     """Uniform row down-sampling with 1/rate weight rescale."""
-    keep = jax.random.bernoulli(key, rate, batch.weights.shape)
-    new_w = jnp.where(keep, batch.weights / rate, 0.0)
-    return batch._replace(weights=new_w)
+    return batch._replace(
+        weights=_default_weights(key, batch.weights, rate)
+    )
 
 
 def binary_classification_down_sample(key: Array, batch: Batch, rate) -> Batch:
     """Keep all positives; keep negatives with probability ``rate`` and
     rescale their weight by 1/rate (BinaryClassificationDownSampler)."""
-    keep_draw = jax.random.bernoulli(key, rate, batch.weights.shape)
-    is_positive = batch.labels > 0.5
-    new_w = jnp.where(
-        is_positive,
-        batch.weights,
-        jnp.where(keep_draw, batch.weights / rate, 0.0),
+    return batch._replace(
+        weights=_binary_weights(key, batch.labels, batch.weights, rate)
     )
-    return batch._replace(weights=new_w)
+
+
+def _default_weights(key: Array, weights: Array, rate) -> Array:
+    keep = jax.random.bernoulli(key, rate, weights.shape)
+    return jnp.where(keep, weights / rate, 0.0)
+
+
+def _binary_weights(key: Array, labels: Array, weights: Array, rate) -> Array:
+    keep_draw = jax.random.bernoulli(key, rate, weights.shape)
+    is_positive = labels > 0.5
+    return jnp.where(
+        is_positive,
+        weights,
+        jnp.where(keep_draw, weights / rate, 0.0),
+    )
+
+
+def down_sample_weights(
+    key: Array, labels: Array, weights: Array, rate, task: TaskType
+) -> Array:
+    """The samplers' WEIGHT transform alone: [n] -> [n], identical draws
+    to :func:`down_sample` for the same key/shape. The feature-sharded
+    fixed effect re-weights its cached sharded layout with this (the
+    mask is a traced argument — the layout, schedules and compiled fit
+    survive every draw), so sampled-sharded reproduces sampled-replicated
+    bit-for-bit on the sampling side."""
+    if task in (
+        TaskType.LOGISTIC_REGRESSION, TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM
+    ):
+        return _binary_weights(key, labels, weights, rate)
+    return _default_weights(key, weights, rate)
 
 
 def down_sample(key: Array, batch: Batch, rate, task: TaskType) -> Batch:
     """Task-dispatching sampler (DownSampler factory semantics)."""
-    if task == TaskType.LOGISTIC_REGRESSION or task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
-        return binary_classification_down_sample(key, batch, rate)
-    return default_down_sample(key, batch, rate)
+    return batch._replace(
+        weights=down_sample_weights(
+            key, batch.labels, batch.weights, rate, task
+        )
+    )

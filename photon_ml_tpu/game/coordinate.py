@@ -64,6 +64,20 @@ class Coordinate:
     def regularization_term(self, model: DatumScoringModel) -> float:
         raise NotImplementedError
 
+    def regularization_term_device(self, model: DatumScoringModel) -> Array:
+        """The reg term as a DEVICE scalar: the CD loop sums these into
+        its one batched readback per iteration (parallel/overlap) instead
+        of pulling 1-2 host floats per coordinate. Default falls back to
+        the host implementation for coordinate types with no device
+        expression."""
+        return jnp.float32(self.regularization_term(model))
+
+    def prepare(self, model: Optional[DatumScoringModel] = None) -> None:
+        """Host-side staging for this coordinate's NEXT update (device
+        transfers, layout builds, AOT warming) — idempotent, and safe to
+        run on a background thread while another coordinate's solves
+        occupy the device (overlap prefetched dispatch). Default: no-op."""
+
 
 @dataclass
 class FixedEffectCoordinate(Coordinate):
@@ -76,8 +90,9 @@ class FixedEffectCoordinate(Coordinate):
     fixed effect at huge dimension (treeAggregate depth valve at >=200k
     features, cli/game/training/Driver.scala:357-363,717-719; "hundreds
     of billions of coefficients", README.md:73). The sharded layout is
-    built once and reused across CD iterations — only the offsets (the
-    residual currency) are re-placed per sweep.
+    built once and reused across CD iterations — only the row vectors a
+    sweep changes (offsets, the residual currency, and the down-sampling
+    draw's weights) are re-placed per update.
     """
 
     name: str
@@ -147,10 +162,14 @@ class FixedEffectCoordinate(Coordinate):
         """Build-once layout + jitted fit for the (data, model) mesh.
 
         The sharded batch STRUCTURE (entry routing, tile schedules) only
-        depends on indices/values/weights — fixed across CD iterations —
-        so it is cached on the coordinate; per update only the offsets
-        (residual currency) are re-padded and re-placed (the same
-        device-resident KeyValueScore contract as batch_for_shard)."""
+        depends on indices/values and the BUILD-time weight mask — fixed
+        across CD iterations — so it is cached on the coordinate; per
+        update only the row vectors (offsets — the residual currency —
+        and, when down-sampling, the draw's weights) are re-padded and
+        re-placed. A sampled weight only ever ZEROES a row that was live
+        at build time (inert through c = w * l'(z)), never revives a
+        built-out one, so the cached layout stays exact under every
+        draw."""
         state = self.__dict__.get("_fs_state")
         if state is not None:
             return state
@@ -260,30 +279,43 @@ class FixedEffectCoordinate(Coordinate):
         return state
 
     def _update_model_feature_sharded(self, model, residual):
-        import numpy as np
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from photon_ml_tpu.parallel.mesh import DATA_AXIS
 
-        if self.down_sampling_rate < 1.0:
-            raise NotImplementedError(
-                "down-sampling re-weights rows per iteration, which would "
-                "invalidate the cached feature-sharded layout; run the "
-                "fixed effect data-parallel (1-D mesh) when sampling"
-            )
         st = self._feature_sharded_state()
         offsets = jnp.asarray(self.dataset.offsets)
         if residual is not None:
             offsets = offsets + residual
         n = offsets.shape[0]
-        if st["rows_total"] != n:
-            offsets = jnp.concatenate(
-                [offsets, jnp.zeros((st["rows_total"] - n,), jnp.float32)]
+        row_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+
+        def _place_rows(vec):
+            if st["rows_total"] != n:
+                vec = jnp.concatenate(
+                    [vec, jnp.zeros((st["rows_total"] - n,), jnp.float32)]
+                )
+            return jax.device_put(vec, row_sharding)
+
+        sharded = st["sharded"]._replace(offsets=_place_rows(offsets))
+        if self.down_sampling_rate < 1.0:
+            # Down-sampling is pure row re-weighting (data/sampler.py):
+            # the per-draw weights ride the SAME re-pad-and-place path as
+            # the residual offsets — traced arguments against the cached
+            # layout, so the entry routing, tile schedules and compiled
+            # fit all survive sampling (padding rows keep weight 0 and
+            # stay inert). Same PRNG key as the replicated path, so
+            # sampled-sharded == sampled-replicated draw-for-draw.
+            from photon_ml_tpu.data.sampler import down_sample_weights
+
+            w_new = down_sample_weights(
+                jax.random.PRNGKey(self.sampler_seed),
+                jnp.asarray(self.dataset.labels),
+                jnp.asarray(self.dataset.weights),
+                self.down_sampling_rate,
+                self.problem.task,
             )
-        offsets = jax.device_put(
-            offsets, NamedSharding(self.mesh, P(DATA_AXIS))
-        )
-        sharded = st["sharded"]._replace(offsets=offsets)
+            sharded = sharded._replace(weights=_place_rows(w_new))
         st["sharded"] = sharded  # keep the freshest placement cached
 
         initial = model.model.means if model is not None else None
@@ -319,12 +351,28 @@ class FixedEffectCoordinate(Coordinate):
         return model.score(self.dataset)
 
     def regularization_term(self, model: FixedEffectModel) -> float:
+        from photon_ml_tpu.parallel import overlap
+
+        return float(
+            overlap.device_get(self.regularization_term_device(model))
+        )
+
+    def regularization_term_device(self, model: FixedEffectModel) -> Array:
         l1, l2 = self.problem.regularization.split(self.reg_weight)
         w = model.model.means
-        term = 0.5 * l2 * float(jax.device_get(jnp.vdot(w, w)))
+        term = 0.5 * l2 * jnp.vdot(w, w)
         if l1:
-            term += l1 * float(jax.device_get(jnp.sum(jnp.abs(w))))
+            term = term + l1 * jnp.sum(jnp.abs(w))
         return term
+
+    def prepare(self, model=None) -> None:
+        """Stage the solve's static inputs ahead of update_model: the
+        feature-sharded layout (built once, multi-second cold) or the
+        replicated path's device copies of the shard columns."""
+        if self._is_feature_sharded():
+            self._feature_sharded_state()
+        else:
+            self.dataset.batch_for_shard(self.feature_shard_id)
 
 
 @dataclass
@@ -356,11 +404,12 @@ class RandomEffectCoordinate(Coordinate):
         if self.problem.compute_variances:
             bank, tracker, variances = self.problem.update_bank(
                 model.bank, self.re_dataset, residual_offsets=offsets,
-                with_variances=True,
+                with_variances=True, defer_tracker=True,
             )
         else:
             bank, tracker = self.problem.update_bank(
-                model.bank, self.re_dataset, residual_offsets=offsets
+                model.bank, self.re_dataset, residual_offsets=offsets,
+                defer_tracker=True,
             )
         return replace(model, bank=bank, variances=variances), tracker
 
@@ -369,6 +418,25 @@ class RandomEffectCoordinate(Coordinate):
 
     def regularization_term(self, model: RandomEffectModel) -> float:
         return self.problem.regularization_term(model.bank)
+
+    def regularization_term_device(self, model: RandomEffectModel) -> Array:
+        return self.problem.regularization_term_device(model.bank)
+
+    def prepare(self, model=None) -> None:
+        """Stage bucket device transfers / stacked group args / AOT
+        programs + the row view the score pass reads."""
+        from photon_ml_tpu.game.random_effect import device_row_view
+
+        bank = (
+            model.bank
+            if model is not None
+            else jnp.zeros(
+                (self.re_dataset.num_entities, self.re_dataset.local_dim),
+                jnp.float32,
+            )
+        )
+        self.problem.prepare(bank, self.re_dataset)
+        device_row_view(self.re_dataset)
 
 
 @dataclass

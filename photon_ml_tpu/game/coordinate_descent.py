@@ -24,6 +24,7 @@ from photon_ml_tpu.game.coordinate import Coordinate
 from photon_ml_tpu.game.data import GameDataset
 from photon_ml_tpu.game.model import GameModel
 from photon_ml_tpu.ops.losses import loss_for_task
+from photon_ml_tpu.parallel import overlap
 from photon_ml_tpu.task import TaskType
 from photon_ml_tpu.utils.logging_util import PhotonLogger
 
@@ -99,10 +100,17 @@ class CoordinateDescent:
         return bool(np.max(flags))
 
     def _objective(self, total_score: Array, models: Dict[str, object]) -> float:
-        """loss(sum of scores + offsets) + sum of reg terms
-        (CoordinateDescent.scala:196-243)."""
-        import jax
+        return self._objective_deferred(total_score, models).result()
 
+    def _objective_deferred(
+        self, total_score: Array, models: Dict[str, object]
+    ) -> overlap.Deferred:
+        """loss(sum of scores + offsets) + sum of reg terms
+        (CoordinateDescent.scala:196-243) as a DEFERRED device scalar:
+        the loss term and every coordinate's regularization term stay on
+        device and the value joins the iteration's single batched
+        readback (overlap.fetch_all) instead of 1 + 2-per-coordinate
+        scalar pulls."""
         loss = loss_for_task(self.task)
         cached = self.__dict__.get("_device_cols")
         if cached is None:
@@ -114,11 +122,10 @@ class CoordinateDescent:
             self._device_cols = cached
         off, lab, w = cached
         z = total_score + off
-        # explicit single readback per iteration (transfer-guard safe)
-        value = float(jax.device_get(jnp.sum(w * loss.value(z, lab))))
+        value = jnp.sum(w * loss.value(z, lab))
         for name, coord in self.coordinates.items():
-            value += coord.regularization_term(models[name])
-        return value
+            value = value + coord.regularization_term_device(models[name])
+        return overlap.Deferred(value, float)
 
     def run(
         self,
@@ -197,8 +204,23 @@ class CoordinateDescent:
             total = jnp.zeros((self.dataset.num_rows,), jnp.float32)
             for name in seq:
                 total = total + scores[name]
-            for name in seq:
+            # Prefetched dispatch (overlap lever 3): coordinate k+1's
+            # host prep — bucket stacking/device transfer, layout builds,
+            # AOT warming — runs on the background worker UNDER coordinate
+            # k's device solves instead of as a serial gap between their
+            # dispatches. The worker only ever touches the coordinate
+            # being prefetched; the main thread wait()s before updating
+            # it, so cache mutations never race.
+            prefetched: Dict[str, object] = {}
+            for j, name in enumerate(seq):
                 coord = self.coordinates[name]
+                overlap.wait(prefetched.pop(name, None))
+                if overlap.overlap_enabled() and j + 1 < len(seq):
+                    nxt = seq[j + 1]
+                    if nxt != name and nxt not in prefetched:
+                        prefetched[nxt] = overlap.submit(
+                            self.coordinates[nxt].prepare, models[nxt]
+                        )
                 residual = total - scores[name] if len(seq) > 1 else None
                 models[name], tracker = coord.update_model(models[name], residual)
                 trackers[name].append(tracker)
@@ -209,14 +231,31 @@ class CoordinateDescent:
                     else new_score
                 )
                 scores[name] = new_score
+            for fut in prefetched.values():  # surface prep failures
+                overlap.wait(fut)
 
-            objective = self._objective(total, models)
+            # Deferred-readback discipline: the objective (loss + every
+            # reg term) and every coordinate's tracker stats come back in
+            # ONE batched device_get per iteration — not per-bucket, not
+            # per-coordinate (each pull is a ~100 ms round trip over a
+            # relay-attached chip).
+            objective_d = self._objective_deferred(total, models)
+            overlap.fetch_all(
+                [objective_d]
+                + [
+                    getattr(trackers[name][-1], "deferred", None)
+                    for name in seq
+                ]
+            )
+            objective = objective_d.result()
             objective_history.append(objective)
             self.logger.info(
                 "coordinate descent iter %d: objective=%g", it + 1, objective
             )
             if self.checkpointer is not None:
-                self.checkpointer.save(it + 1, models)
+                # async artifact IO: the write leaves the critical path;
+                # drain_io() below is the barrier before any stop
+                overlap.submit_io(self.checkpointer.save, it + 1, dict(models))
 
             if self.validation_fn is not None:
                 game_model = GameModel(
@@ -238,12 +277,13 @@ class CoordinateDescent:
                         best_step = it + 1
 
             if self.checkpointer is not None:
-                self.checkpointer.save_meta(
+                overlap.submit_io(
+                    self.checkpointer.save_meta,
                     {
                         "best_step": best_step,
                         "best_metric": best_metric,
                         "metric_name": self.validation_metric,
-                    }
+                    },
                 )
 
             if self._preemption_agreed():
@@ -259,6 +299,11 @@ class CoordinateDescent:
                     num_iterations,
                 )
                 break
+
+        # IO barrier: every queued checkpoint/meta write is on disk before
+        # the run returns — a preempted (or completed) run's restart
+        # contract must not depend on a still-in-flight write.
+        overlap.drain_io()
 
         if (
             self.validation_fn is not None

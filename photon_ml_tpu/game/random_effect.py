@@ -62,6 +62,45 @@ class RandomEffectTracker:
     reason_counts: Dict[str, int]
 
 
+class LazyRandomEffectTracker:
+    """RandomEffectTracker facade whose aggregated stats stay
+    DEVICE-RESIDENT until first use (the overlap deferred-readback path):
+    ``update_bank(..., defer_tracker=True)`` returns one of these instead
+    of forcing a device->host round trip per bank update. The coordinate
+    descent loop batch-fetches every coordinate's ``.deferred`` with ONE
+    ``device_get`` per iteration (parallel/overlap.fetch_all); any other
+    consumer that touches an attribute forces its own (counted) fetch, so
+    behavior is identical to the eager tracker — only the transfer
+    schedule changes."""
+
+    __slots__ = ("deferred",)
+
+    def __init__(self, deferred):
+        self.deferred = deferred
+
+    def _tracker(self) -> RandomEffectTracker:
+        return self.deferred.result()
+
+    @property
+    def num_entities(self) -> int:
+        return self._tracker().num_entities
+
+    @property
+    def iterations_mean(self) -> float:
+        return self._tracker().iterations_mean
+
+    @property
+    def iterations_max(self) -> int:
+        return self._tracker().iterations_max
+
+    @property
+    def reason_counts(self) -> Dict[str, int]:
+        return self._tracker().reason_counts
+
+    def __repr__(self) -> str:  # force: repr is a host-side consumer
+        return repr(self._tracker())
+
+
 # Solver namespaces shared across problem instances with equal
 # (loss, config, regularization): a GAME combo grid builds a fresh
 # RandomEffectOptimizationProblem per combo, and without sharing each
@@ -841,6 +880,67 @@ class RandomEffectOptimizationProblem:
             plans.append((sig, thunk))
         return plans
 
+    def _bucket_groups(self, d_local, dataset, *, fold_eligible):
+        """Consecutive same-signature bucket runs -> [(sig, members)]
+        (the lax.scan fold grouping); singletons when folding is off."""
+        groups: List = []
+        if fold_eligible:
+            for bi, bucket in enumerate(dataset.buckets):
+                kind = self._bucket_kind(bucket, d_local)
+                sig = (kind, bucket.indices.shape)
+                if groups and groups[-1][0] == sig:
+                    groups[-1][1].append(bi)
+                else:
+                    groups.append((sig, [bi]))
+        else:
+            groups = [(None, [bi]) for bi in range(len(dataset.buckets))]
+        return groups
+
+    def prepare(
+        self, bank: Array, dataset: RandomEffectDataset,
+        *, has_residual_offsets: bool = True,
+    ) -> None:
+        """Host-side staging for a FUTURE update_bank over ``dataset``:
+        device transfer of every bucket's static arrays (stacked group
+        args on the fold path), residual routing tables on the mesh path,
+        and AOT compiles of the bucket programs. Idempotent — everything
+        lands in the same caches update_bank reads — and safe to run on a
+        background thread while ANOTHER coordinate's solves occupy the
+        device (the overlap prefetched-dispatch lever: coordinate k+1's
+        host prep runs under coordinate k's device work instead of as a
+        serial gap between their dispatches)."""
+        if not dataset.buckets:
+            return
+        # mirror update_bank's fold eligibility (variance-typed problems
+        # run the per-bucket path, so stage per-bucket device args — a
+        # stacked copy would pin HBM the update never reads)
+        fold_eligible = (
+            self.mesh is None
+            and not self.compute_variances
+            and len(dataset.buckets) > 1
+        )
+        groups = self._bucket_groups(
+            bank.shape[1], dataset, fold_eligible=fold_eligible
+        )
+        for _sig, members in groups:
+            if len(members) > 1:
+                self._stacked_group_args(
+                    dataset, members, with_residuals=has_residual_offsets
+                )
+            else:
+                self._bucket_device_args(dataset.buckets[members[0]])
+        if self.mesh is None:
+            l1, l2 = self.regularization.split(self.reg_weight)
+            self._warm_solvers(self._bucket_plans(
+                bank, dataset,
+                has_values_override=False,
+                has_residual_offsets=has_residual_offsets,
+                l1_d=jnp.float32(l1), l2_d=jnp.float32(l2),
+                groups=groups if fold_eligible else None,
+            ))
+        elif has_residual_offsets:
+            self._router_for(dataset)  # static routing tables, host-built
+
     def prewarm(self, specs) -> None:
         """AOT-compile the bucket programs of SEVERAL (bank, dataset,
         has_values_override, has_residual_offsets) quadruples in ONE
@@ -899,6 +999,7 @@ class RandomEffectOptimizationProblem:
         residual_offsets: Optional[Array] = None,  # [n] replaces offsets
         values_override: Optional[Sequence[Array]] = None,
         with_variances: bool = False,
+        defer_tracker: bool = False,
     ):
         """Solve every entity against its active data; returns the new bank
         and an aggregated tracker — plus the per-entity variance bank when
@@ -910,6 +1011,11 @@ class RandomEffectOptimizationProblem:
         (aligned with ``dataset.buckets``) replacing each bucket's stored
         values — the MF ALS path recomputes latent feature views on
         device every half-step while the bucket STRUCTURE stays cached.
+
+        ``defer_tracker``: return a LazyRandomEffectTracker whose stats
+        stay on device — the GAME CD loop folds every coordinate's
+        tracker into ONE batched readback per iteration instead of one
+        round trip per bank update (~100 ms each over a relay).
         """
         l1, l2 = self.regularization.split(self.reg_weight)
         l1_d, l2_d = jnp.float32(l1), jnp.float32(l2)
@@ -942,17 +1048,9 @@ class RandomEffectOptimizationProblem:
             and not with_variances
             and len(dataset.buckets) > 1
         )
-        groups: List = []
-        if fold_eligible:
-            for bi, bucket in enumerate(dataset.buckets):
-                kind = self._bucket_kind(bucket, bank.shape[1])
-                sig = (kind, bucket.indices.shape)
-                if groups and groups[-1][0] == sig:
-                    groups[-1][1].append(bi)
-                else:
-                    groups.append((sig, [bi]))
-        else:
-            groups = [(None, [bi]) for bi in range(len(dataset.buckets))]
+        groups = self._bucket_groups(
+            bank.shape[1], dataset, fold_eligible=fold_eligible
+        )
         if self.mesh is None and dataset.buckets:
             self._warm_solvers(self._bucket_plans(
                 bank, dataset,
@@ -1053,23 +1151,33 @@ class RandomEffectOptimizationProblem:
                 jnp.concatenate([jnp.stack([it_sum, it_max]), counts])
             )
         if stat_vecs:
-            # ONE explicit readback (transfer-guard safe)
-            all_stats = jax.device_get(jnp.stack(stat_vecs))
+            from photon_ml_tpu.parallel import overlap
+
             total = sum(n_reals)
-            iter_sum = int(all_stats[:, 0].sum())
-            iter_max = int(all_stats[:, 1].max())
-            count_vec = all_stats[:, 2:].sum(axis=0)
-            counts_dict: Dict[str, int] = {
-                CONVERGENCE_REASON_NAMES.get(code, "?"): int(cnt)
-                for code, cnt in enumerate(count_vec)
-                if cnt
-            }
-            tracker = RandomEffectTracker(
-                num_entities=total,
-                iterations_mean=iter_sum / total,
-                iterations_max=iter_max,
-                reason_counts=counts_dict,
-            )
+
+            def _finalize(all_stats, total=total):
+                iter_sum = int(all_stats[:, 0].sum())
+                iter_max = int(all_stats[:, 1].max())
+                count_vec = all_stats[:, 2:].sum(axis=0)
+                counts_dict: Dict[str, int] = {
+                    CONVERGENCE_REASON_NAMES.get(code, "?"): int(cnt)
+                    for code, cnt in enumerate(count_vec)
+                    if cnt
+                }
+                return RandomEffectTracker(
+                    num_entities=total,
+                    iterations_mean=iter_sum / total,
+                    iterations_max=iter_max,
+                    reason_counts=counts_dict,
+                )
+
+            deferred = overlap.Deferred(jnp.stack(stat_vecs), _finalize)
+            if defer_tracker and not deferred.done:
+                # stats stay device-resident; the CD loop batch-fetches
+                tracker = LazyRandomEffectTracker(deferred)
+            else:
+                # ONE explicit readback (transfer-guard safe)
+                tracker = deferred.result()
         else:
             tracker = RandomEffectTracker(0, 0.0, 0, {})
         if with_variances:
@@ -1116,10 +1224,20 @@ class RandomEffectOptimizationProblem:
 
     def regularization_term(self, bank: Array) -> float:
         """Sum of per-entity reg terms (Coordinate.regTerm analog)."""
+        from photon_ml_tpu.parallel import overlap
+
+        return float(
+            overlap.device_get(self.regularization_term_device(bank))
+        )
+
+    def regularization_term_device(self, bank: Array) -> Array:
+        """The reg term as a DEVICE scalar — no readback: the overlap
+        path folds it into the CD iteration's one batched fetch instead
+        of two scalar pulls per coordinate per iteration."""
         l1, l2 = self.regularization.split(self.reg_weight)
-        term = 0.5 * l2 * float(jax.device_get(jnp.sum(bank * bank)))
+        term = 0.5 * l2 * jnp.sum(bank * bank)
         if l1:
-            term += l1 * float(jax.device_get(jnp.sum(jnp.abs(bank))))
+            term = term + l1 * jnp.sum(jnp.abs(bank))
         return term
 
 

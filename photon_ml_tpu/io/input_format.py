@@ -296,10 +296,27 @@ class AvroInputDataFormat:
         (one file resident at a time), record-at-a-time Python codec
         otherwise. The remap semantics live in iter_rows_from_{decoded,
         records} — one definition shared with the in-memory loader."""
+        yield from self.stream_rows_from_payload(
+            self.decode_payload(path), path, index_map
+        )
+
+    # The two pipeline stages of stream_rows, split so the streaming
+    # layer can run them on DIFFERENT threads (reader/decode ahead of
+    # staging, io/streaming._pipelined_file_rows): decode_payload is the
+    # expensive whole-file native column decode; stream_rows_from_payload
+    # is the cheap row remap/iteration over an already-decoded payload.
+
+    def decode_payload(self, path: str):
+        """Decode stage: ONE file's decoded columns (None -> the
+        record-at-a-time Python-codec fallback in
+        stream_rows_from_payload). Thread-safe; holds one file."""
+        return self.decode_file(path)
+
+    def stream_rows_from_payload(self, payload, path: str, index_map: IndexMap):
+        """Staging stage: rows of one file from its decoded payload."""
         icept = self._stream_intercept(index_map)
-        decoded = self.decode_file(path)
-        if decoded is not None:
-            yield from self.iter_rows_from_decoded(decoded, index_map, icept)
+        if payload is not None:
+            yield from self.iter_rows_from_decoded(payload, index_map, icept)
         else:
             yield from self.iter_rows_from_records(
                 read_avro_records([path]), index_map, icept

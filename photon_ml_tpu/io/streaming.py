@@ -51,6 +51,29 @@ def scan_stream(
     return fmt.stream_scan(paths, index_map=index_map)
 
 
+def _pipelined_file_rows(files, fmt, index_map: IndexMap):
+    """reader->decode stage of the populate pipeline: a worker thread
+    decodes file i+1 (``fmt.decode_payload`` — the expensive whole-file
+    native column decode) while the caller stages file i's rows. Bounded
+    double-buffering: at most one decoded payload queued + one being
+    staged + one in flight on the worker. Formats without the split
+    decode hook (LibSVM is line-at-a-time) fall back to the serial
+    ``stream_rows``."""
+    decode = getattr(fmt, "decode_payload", None)
+    rows_from = getattr(fmt, "stream_rows_from_payload", None)
+    if decode is None or rows_from is None:
+        for path in files:
+            yield from fmt.stream_rows(path, index_map)
+        return
+
+    def decoded():
+        for path in files:
+            yield path, decode(path)
+
+    for path, payload in _prefetched(decoded(), depth=1):
+        yield from rows_from(payload, path, index_map)
+
+
 def iter_chunks(
     paths,
     fmt,
@@ -58,12 +81,28 @@ def iter_chunks(
     *,
     rows_per_chunk: int,
     nnz_width: int,
+    pipeline: Optional[bool] = None,
 ) -> Iterator[SparseBatch]:
     """Stream fixed-shape [rows_per_chunk, nnz_width] SparseBatch chunks
     (weight-0 padding rows in the final chunk). Every chunk has the SAME
-    shape, so one jitted partial-objective serves the whole stream."""
+    shape, so one jitted partial-objective serves the whole stream.
+
+    ``pipeline``: decode-ahead the NEXT file on a worker thread while
+    this thread stages the current one (reader->decode->stage overlap,
+    parallel/overlap.py); None follows the global overlap setting AND
+    requires a multi-core host — on one core the extra thread cannot
+    overlap anything and its switching overhead measurably loses (A/B
+    in PERF_NOTES round 6), while the existing chunk-level prefetch
+    already recovers the recoverable idle. The serial path is
+    row-for-row identical."""
+    import os
+
     import jax.numpy as jnp
 
+    if pipeline is None:
+        from photon_ml_tpu.parallel.overlap import overlap_enabled
+
+        pipeline = overlap_enabled() and (os.cpu_count() or 1) > 1
     # a multi-host process can own a ZERO-file shard (process_shard with
     # more processes than files) — it must yield no chunks and still join
     # every collective, not raise
@@ -89,24 +128,32 @@ def iter_chunks(
             weights=jnp.asarray(wgt_buf.copy()),
         )
 
-    for path in files:
-        for ix, vs, lab, off, wgt in fmt.stream_rows(path, index_map):
-            if len(ix) > W:
-                raise ValueError(
-                    f"row has {len(ix)} nonzeros > staging width {W}; "
-                    "re-scan the stream or raise nnz_width"
-                )
-            ix_buf[fill, : len(ix)] = ix
-            ix_buf[fill, len(ix):] = 0
-            v_buf[fill, : len(vs)] = vs
-            v_buf[fill, len(vs):] = 0.0
-            lab_buf[fill] = lab
-            off_buf[fill] = off
-            wgt_buf[fill] = wgt
-            fill += 1
-            if fill == R:
-                yield emit()
-                fill = 0
+    rows = (
+        _pipelined_file_rows(files, fmt, index_map)
+        if pipeline
+        else (
+            row
+            for path in files
+            for row in fmt.stream_rows(path, index_map)
+        )
+    )
+    for ix, vs, lab, off, wgt in rows:
+        if len(ix) > W:
+            raise ValueError(
+                f"row has {len(ix)} nonzeros > staging width {W}; "
+                "re-scan the stream or raise nnz_width"
+            )
+        ix_buf[fill, : len(ix)] = ix
+        ix_buf[fill, len(ix):] = 0
+        v_buf[fill, : len(vs)] = vs
+        v_buf[fill, len(vs):] = 0.0
+        lab_buf[fill] = lab
+        off_buf[fill] = off
+        wgt_buf[fill] = wgt
+        fill += 1
+        if fill == R:
+            yield emit()
+            fill = 0
     if fill:
         ix_buf[fill:] = 0
         v_buf[fill:] = 0.0

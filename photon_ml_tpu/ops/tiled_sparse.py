@@ -1302,6 +1302,7 @@ def _bilinear_pass_kernel(
     chunk: int,
     mxu: str,
     split: int = 1,
+    onehot: str = "compare",
 ):
     """One grid step: expand src at in_pos, multiply by vals,
     bilinear-scatter into the out_pos output window.
@@ -1336,6 +1337,42 @@ def _bilinear_pass_kernel(
         lo_part = (x - hi_part.astype(jnp.float32)).astype(jnp.bfloat16)
         return hi_part, lo_part
 
+    def _expand(idx, s, width, dt):
+        """Positional expansion: [1, width] window-local indices ->
+        [s, width] one-hot rows.
+
+        ``onehot="compare"`` (default): sublane-iota equality compare —
+        the round-2 build, one [s, width] VPU compare + select chain.
+
+        ``onehot="mxu"``: the round-3 "pack the one-hot build itself
+        onto the MXU" lever. 1 - (i - ix)^2 comes from ONE tiny
+        [s, 3] x [3, width] matmul over packed features [1, ix, ix^2]
+        (lhs rows [1 - i^2, 2i, -1]); a single relu blends it to the
+        exact 0/1 indicator, since integer mismatches give d >= 1.
+        f32 HIGHEST keeps ix^2 exact (< 2^14 << 2^24 mantissa range) —
+        one-hot EXACTNESS, which the bf16 split relies on, survives.
+        Trades the [s, width] compare chain for a matmul + one
+        elementwise pass; whether Mosaic schedules it better than the
+        compare is the A/B bench.py carries (PERF_NOTES round 6)."""
+        if onehot == "mxu":
+            i_col = jax.lax.broadcasted_iota(jnp.float32, (s, 1), 0)
+            lhs = jnp.concatenate(
+                [1.0 - i_col * i_col, 2.0 * i_col, -jnp.ones_like(i_col)],
+                axis=1,
+            )  # [s, 3]
+            idx_f = idx.astype(jnp.float32)
+            rhs = jnp.concatenate(
+                [jnp.ones_like(idx_f), idx_f, idx_f * idx_f], axis=0
+            )  # [3, width]
+            d = jax.lax.dot_general(
+                lhs, rhs, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )  # [s, width] = 1 - (i - ix)^2
+            return jnp.maximum(d, 0.0).astype(dt)
+        iota = jax.lax.broadcasted_iota(jnp.int32, (s, width), 0)
+        return (idx == iota).astype(dt)
+
     def _chain(ip, op, v, width):
         """One independent gather->contrib->scatter chain over ``width``
         entry lanes -> update [S_HI, S_LO]."""
@@ -1343,8 +1380,6 @@ def _bilinear_pass_kernel(
         il = ip - ih * s_lo
         oh = op // s_lo
         ol = op - oh * s_lo
-        hi_iota = jax.lax.broadcasted_iota(jnp.int32, (s_hi, width), 0)
-        lo_iota = jax.lax.broadcasted_iota(jnp.int32, (s_lo, width), 0)
         dims_in = (((0,), (0,)), ((), ()))
         dims_out = (((1,), (1,)), ((), ()))
 
@@ -1354,7 +1389,7 @@ def _bilinear_pass_kernel(
             # the hi and lo terms into the otherwise idle half of the MXU
             # tile (s_lo = 64 uses 64 of 128 sublanes/lanes): identical MAC
             # count at ~2x the effective utilization.
-            oh_in_hi = (ih == hi_iota).astype(jnp.bfloat16)  # [S_HI, w]
+            oh_in_hi = _expand(ih, s_hi, width, jnp.bfloat16)  # [S_HI, w]
 
             # gather: pack [hi | lo] along the lane axis -> [S_HI, 2*S_LO]
             s1, s2 = _split(src_ref[0])
@@ -1366,7 +1401,7 @@ def _bilinear_pass_kernel(
             # fold the halves first (sublane slice at a multiple of 8) so
             # the mask-reduce runs at [S_LO, w] instead of [2*S_LO, w]
             a = a_cat[:s_lo] + a_cat[s_lo:]
-            oh_in_lo = (il == lo_iota).astype(jnp.float32)
+            oh_in_lo = _expand(il, s_lo, width, jnp.float32)
             src_g = jnp.sum(a * oh_in_lo, axis=0, keepdims=True)  # [1, w]
             contrib = v * src_g
 
@@ -1377,8 +1412,8 @@ def _bilinear_pass_kernel(
             # [2*S_LO, w] compare + arithmetic 0/1 blend — twice the VPU
             # compare work for the same matrix).
             c1, c2 = _split(contrib)
-            oh_out_hi = (oh == hi_iota).astype(jnp.bfloat16)
-            oh_out_lo = (ol == lo_iota).astype(jnp.bfloat16)
+            oh_out_hi = _expand(oh, s_hi, width, jnp.bfloat16)
+            oh_out_lo = _expand(ol, s_lo, width, jnp.bfloat16)
             rhs = jnp.concatenate(
                 [oh_out_lo * c1, oh_out_lo * c2], axis=0
             )  # [2*S_LO, w]
@@ -1394,8 +1429,8 @@ def _bilinear_pass_kernel(
             # two bf16 terms (hi + lo, ~16 mantissa bits, ~1e-5 rel error)
             # and run 2 single-pass bf16 matmuls — 3x the MXU throughput
             # at GLM-sufficient precision.
-            oh_in_hi = (ih == hi_iota).astype(jnp.bfloat16)  # [S_HI, w]
-            oh_in_lo = (il == lo_iota).astype(jnp.float32)  # [S_LO, w]
+            oh_in_hi = _expand(ih, s_hi, width, jnp.bfloat16)  # [S_HI, w]
+            oh_in_lo = _expand(il, s_lo, width, jnp.float32)  # [S_LO, w]
 
             # gather: src_g[p] = src2d[ih[p], il[p]]
             s1, s2 = _split(src_ref[0])
@@ -1407,8 +1442,8 @@ def _bilinear_pass_kernel(
             src_g = jnp.sum(a * oh_in_lo, axis=0, keepdims=True)  # [1, w]
             contrib = v * src_g  # [1, w]
 
-            oh_out_hi = (oh == hi_iota).astype(jnp.bfloat16)
-            oh_out_lo = (ol == lo_iota).astype(jnp.bfloat16)
+            oh_out_hi = _expand(oh, s_hi, width, jnp.bfloat16)
+            oh_out_lo = _expand(ol, s_lo, width, jnp.bfloat16)
             # A @ B^T via lane/entry contraction. oh_out_lo is 0/1 and the
             # contrib terms are already bf16, so each product is exact.
             c1, c2 = _split(contrib)
@@ -1420,8 +1455,8 @@ def _bilinear_pass_kernel(
                 preferred_element_type=jnp.float32,
             )  # [S_HI, S_LO]
         else:  # "highest": full f32 emulation, ~3x slower, ~1e-7 rel error
-            oh_in_hi = (ih == hi_iota).astype(jnp.float32)
-            oh_in_lo = (il == lo_iota).astype(jnp.float32)
+            oh_in_hi = _expand(ih, s_hi, width, jnp.float32)
+            oh_in_lo = _expand(il, s_lo, width, jnp.float32)
             a = jax.lax.dot_general(
                 src_ref[0], oh_in_hi, dims_in,
                 preferred_element_type=jnp.float32,
@@ -1429,8 +1464,8 @@ def _bilinear_pass_kernel(
             )
             src_g = jnp.sum(a * oh_in_lo, axis=0, keepdims=True)
             contrib = v * src_g
-            oh_out_hi = (oh == hi_iota).astype(jnp.float32)
-            oh_out_lo = (ol == lo_iota).astype(jnp.float32)
+            oh_out_hi = _expand(oh, s_hi, width, jnp.float32)
+            oh_out_lo = _expand(ol, s_lo, width, jnp.float32)
             return jax.lax.dot_general(
                 oh_out_hi, oh_out_lo * contrib, dims_out,
                 preferred_element_type=jnp.float32,
@@ -1476,6 +1511,7 @@ def _run_bilinear_pass(
     vals: Optional[Array] = None,
     interpret: bool = False,
     mxu: str = "bf16x2w",
+    onehot: str = "compare",
 ) -> Array:
     """-> [num_out_blocks, S_HI, S_LO] accumulated output."""
     G = sched.num_steps
@@ -1499,6 +1535,7 @@ def _run_bilinear_pass(
         chunk=L,
         mxu=mxu,
         split=params.split,
+        onehot=onehot,
     )
     in_specs = [entry_spec, entry_spec, entry_spec, src_spec]
     operands = (
@@ -1546,6 +1583,11 @@ class TiledGLMObjective:
     # matmuls fused into one full-width MXU tile (~1e-5 rel err, fastest);
     # "bf16x2": the two-matmul variant; "highest" (~1e-7, 2.5x slower).
     mxu: str = "bf16x2w"
+    # Positional-expansion algorithm: "compare" (sublane-iota equality,
+    # the round-2 build) or "mxu" (squared-distance matmul + relu — the
+    # round-3 "pack the one-hot build onto the MXU" lever; exact 0/1
+    # output either way, see _bilinear_pass_kernel._expand).
+    onehot: str = "compare"
 
     def __post_init__(self):
         if self.norm is None:
@@ -1554,6 +1596,8 @@ class TiledGLMObjective:
             # a typo must not silently fall through to the "highest"
             # branch (2.5x slower, different numerics)
             raise ValueError(f"unknown mxu variant {self.mxu!r}")
+        if self.onehot not in ("compare", "mxu"):
+            raise ValueError(f"unknown onehot variant {self.onehot!r}")
 
     def _psum(self, x):
         if self.axis_name is None:
@@ -1572,7 +1616,7 @@ class TiledGLMObjective:
         w2d = w_padded.reshape((b.num_feat_blocks, p.s_hi, p.s_lo))
         raw = _run_bilinear_pass(
             b.z_sched, w2d, b.num_row_blocks, p,
-            interpret=self.interpret, mxu=self.mxu,
+            interpret=self.interpret, mxu=self.mxu, onehot=self.onehot,
         ).reshape(-1)
         return b.z_sched.apply_spill(raw, w_padded)
 
@@ -1586,7 +1630,7 @@ class TiledGLMObjective:
         c2d = c_rows.reshape((b.num_row_blocks, p.s_hi, p.s_lo))
         g = _run_bilinear_pass(
             b.g_sched, c2d, b.num_feat_blocks, p,
-            vals=vals, interpret=self.interpret, mxu=self.mxu,
+            vals=vals, interpret=self.interpret, mxu=self.mxu, onehot=self.onehot,
         ).reshape(-1)
         return b.g_sched.apply_spill(g, c_rows, vals=spill_vals)
 

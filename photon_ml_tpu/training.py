@@ -550,6 +550,29 @@ def train_streaming_glm(
     return models, results, index_map
 
 
+def grid_result_scalars(
+    results: Dict[float, OptResult],
+) -> Dict[float, Tuple[int, float, int]]:
+    """{lambda: (iterations, value, reason)} with ONE batched readback
+    for the whole grid (parallel/overlap deferred-readback discipline).
+
+    Every OptResult's scalars are device-resident futures until someone
+    forces them; the pre-overlap consumers pulled three scalars per
+    lambda serially — each a full host<->device round trip (~100 ms over
+    a relay-attached chip), paid once per grid entry. One device_get
+    materializes the lot."""
+    from photon_ml_tpu.parallel import overlap
+
+    items = list(results.items())
+    fetched = overlap.device_get(
+        [(res.iterations, res.value, res.reason) for _, res in items]
+    )
+    return {
+        lam: (int(it), float(value), int(reason))
+        for (lam, _), (it, value, reason) in zip(items, fetched)
+    }
+
+
 def iteration_models(
     result: OptResult,
     task: TaskType,

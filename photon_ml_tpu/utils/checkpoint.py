@@ -117,8 +117,15 @@ class TrainingCheckpointer:
             return json.load(f)
 
     def restore(self, step: int, models: Dict[str, Any]) -> Dict[str, Any]:
-        """-> {name: restored model}, using ``models`` as type templates."""
-        state = self._mgr.restore(step)
+        """-> {name: restored model}, using ``models`` as type templates.
+
+        Explicit StandardRestore args: a FRESH process (the actual resume
+        scenario) has no handler registered for the saved item, and
+        orbax's inference-from-history only works after a save in the
+        same process — without the args the restore raises KeyError
+        ("provide a CheckpointHandlerRegistry"). The host-side topology
+        check happens in restore_model (template-typed)."""
+        state = self._mgr.restore(step, args=ocp.args.StandardRestore())
         return {
             name: restore_model(models[name], state[name]) for name in models
         }

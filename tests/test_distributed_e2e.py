@@ -299,6 +299,46 @@ class TestFeatureShardedGameFE:
             results["sharded"], results["single"], atol=5e-3
         )
 
+    def test_sharded_fe_down_sampling_matches_replicated(self, rng):
+        """Down-sampling on the FEATURE-SHARDED fixed effect: the per-draw
+        sampling weights are traced arguments against the cached sharded
+        layout, so (same RNG key) sampled-sharded reproduces
+        sampled-replicated — the round-5 guard and driver rejection are
+        gone."""
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+        recs, _, _ = make_records(rng, n=160, n_users=8)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        mesh2d = make_mesh((4, 2), (DATA_AXIS, MODEL_AXIS))
+        results = {}
+        for label, mesh in (("single", None), ("sharded", mesh2d)):
+            coord = FixedEffectCoordinate(
+                name="fixed",
+                dataset=ds,
+                problem=create_glm_problem(
+                    TaskType.LOGISTIC_REGRESSION,
+                    ds.shards["globalShard"].dim,
+                    config=OptimizerConfig(max_iter=25),
+                    regularization=RegularizationContext(
+                        RegularizationType.L2
+                    ),
+                ),
+                feature_shard_id="globalShard",
+                reg_weight=0.5,
+                down_sampling_rate=0.6,
+                sampler_seed=7,
+                mesh=mesh,
+            )
+            model, _ = coord.update_model(coord.initialize_model())
+            # second update exercises the cached-layout re-weighting path
+            model, _ = coord.update_model(model)
+            results[label] = np.asarray(model.model.means)
+        # the dropped rows differ from the full-data fit — only an
+        # identical draw sequence can make these match
+        np.testing.assert_allclose(
+            results["sharded"], results["single"], atol=5e-3
+        )
+
     def test_layout_cached_across_coordinates(self, rng):
         """A combo grid builds fresh coordinates over the same dataset;
         the feature-sharded LAYOUT (the multi-second host re-layout) must

@@ -231,31 +231,21 @@ class TestGameTrainingEndToEnd:
         with pytest.raises(ValueError, match="missing optimization"):
             self._params(tmp_path, rng, fixed_effect_opt_configs={}).validate()
 
-    def test_feature_sharded_rejects_down_sampling(self, tmp_path, rng):
-        """--distributed feature + a down-sampling rate < 1.0 must fail at
-        argument validation, not as a mid-training NotImplementedError in
-        the feature-sharded fixed effect (ADVICE.md round 5)."""
+    def test_feature_sharded_accepts_down_sampling(self, tmp_path, rng):
+        """Down-sampling now COMPOSES with --distributed feature: the
+        sampler is pure row re-weighting whose per-draw weights ride the
+        cached sharded layout as traced arguments
+        (FixedEffectCoordinate._update_model_feature_sharded), so the
+        round-5 parse-time rejection is gone — singly and in grids."""
         p = self._params(
             tmp_path, rng,
             distributed="feature",
             fixed_effect_opt_configs={"global": "30,1e-6,0.1,0.5,LBFGS,L2"},
         )
-        with pytest.raises(ValueError, match="down-sampling"):
-            p.validate()
-        # rate 1.0 (and grids mixing only rate-1.0 alternatives) pass
-        p.fixed_effect_opt_configs = {
-            "global": "30,1e-6,0.1,1,LBFGS,L2;30,1e-6,1.0,1,LBFGS,L2"
-        }
         p.validate()
-        # a down-sampled alternative hiding in a grid is caught too
         p.fixed_effect_opt_configs = {
             "global": "30,1e-6,0.1,1,LBFGS,L2;30,1e-6,1.0,0.9,LBFGS,L2"
         }
-        with pytest.raises(ValueError, match="down-sampling"):
-            p.validate()
-        # down-sampling on the non-feature-sharded modes stays allowed
-        p.distributed = "auto"
-        p.fixed_effect_opt_configs = {"global": "30,1e-6,0.1,0.5,LBFGS,L2"}
         p.validate()
 
 

@@ -200,13 +200,16 @@ class TestChunkCache:
             TaskType.LOGISTIC_REGRESSION, rows_per_chunk=64,
         )
         calls = {"n": 0}
-        real = AvroInputDataFormat.stream_rows
+        # count at the DECODE seam: the overlap pipeline stages rows via
+        # decode_payload/stream_rows_from_payload on a worker thread, and
+        # the serial stream_rows routes through the same decode_payload
+        real = AvroInputDataFormat.decode_payload
 
-        def counting(self, path, imap):
+        def counting(self, path):
             calls["n"] += 1
-            return real(self, path, imap)
+            return real(self, path)
 
-        monkeypatch.setattr(AvroInputDataFormat, "stream_rows", counting)
+        monkeypatch.setattr(AvroInputDataFormat, "decode_payload", counting)
         w = jnp.asarray(rng.normal(size=obj.dim).astype(np.float32))
         v1, g1 = obj.value_and_gradient(w, 0.1)
         decodes_after_first = calls["n"]
@@ -251,13 +254,16 @@ class TestChunkCache:
             cache_bytes=0,
         )
         calls = {"n": 0}
-        real = AvroInputDataFormat.stream_rows
+        # count at the DECODE seam: the overlap pipeline stages rows via
+        # decode_payload/stream_rows_from_payload on a worker thread, and
+        # the serial stream_rows routes through the same decode_payload
+        real = AvroInputDataFormat.decode_payload
 
-        def counting(self, path, imap):
+        def counting(self, path):
             calls["n"] += 1
-            return real(self, path, imap)
+            return real(self, path)
 
-        monkeypatch.setattr(AvroInputDataFormat, "stream_rows", counting)
+        monkeypatch.setattr(AvroInputDataFormat, "decode_payload", counting)
         w = jnp.zeros((obj.dim,), jnp.float32)
         obj.value_and_gradient(w)
         obj.value_and_gradient(w)

@@ -206,6 +206,32 @@ class TestBf16x2Precision:
         )
 
 
+class TestMxuPackedOneHot:
+    def test_mxu_onehot_bit_identical_to_compare(self, rng):
+        """The MXU-packed positional expansion (squared-distance matmul +
+        relu, the round-3 'pack the one-hot build onto the MXU' lever)
+        must produce EXACT 0/1 one-hots — every mxu variant's output is
+        bit-identical to the iota-compare build."""
+        batch, d = random_problem(rng)
+        tb = tiled_batch_from_sparse(batch, d, params=PARAMS)
+        w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+        for mxu in ("highest", "bf16x2", "bf16x2w"):
+            a = TiledGLMObjective(
+                LOGISTIC, d, interpret=True, mxu=mxu, onehot="compare"
+            )
+            b = TiledGLMObjective(
+                LOGISTIC, d, interpret=True, mxu=mxu, onehot="mxu"
+            )
+            va, ga = a.value_and_gradient(w, tb, 0.1)
+            vb, gb = b.value_and_gradient(w, tb, 0.1)
+            assert float(va) == float(vb), mxu
+            np.testing.assert_array_equal(np.asarray(ga), np.asarray(gb))
+
+    def test_unknown_onehot_rejected(self):
+        with pytest.raises(ValueError, match="onehot"):
+            TiledGLMObjective(LOGISTIC, 8, onehot="typo")
+
+
 class TestEmptyWindows:
     def test_empty_feature_window_zero_grad(self, rng):
         """A feature window with NO entries must yield exactly-zero gradient

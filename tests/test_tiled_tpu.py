@@ -10,12 +10,17 @@ marker. Gated behind PHOTON_TPU_TESTS=1: the tunnel's first compile is
 ~20-40s and CI keeps the suite CPU-only.
 
 Sections (SURVEY §4: test on the real execution target):
-  1. tiled Pallas kernels (all mxu variants + spill hybrid) vs scatter
+  1. tiled Pallas kernels (all mxu variants + spill hybrid + the
+     MXU-packed one-hot expansion) vs scatter
   2. GLM driver-path fit at the a1a shape, tiled-on-TPU vs scatter-on-CPU
   3. random-effect bank update on TPU vs the same solve on CPU
   4. MF ALS warm step on TPU vs the same coordinate on CPU
   5. streaming cached evaluation (tiled chunk cache) vs in-memory scatter
   6. 1-device-mesh tiled fit (shard_map) vs the replicated fit
+  7. FEATURE-SHARDED fit under a 1x1 (data, model) mesh vs the CPU oracle
+  8. full GAME coordinate-descent step on chip vs the CPU oracle (the
+     whole composition: FE solve + RE bank + residuals + objective,
+     through the overlap layer's deferred readbacks)
 
 Run with:  PHOTON_TPU_TESTS=1 python -m pytest tests/test_tiled_tpu.py -v
 """
@@ -80,6 +85,16 @@ v1, g1 = jax.jit(tobj.value_and_gradient)(w, tb_spill, 0.1)
 v2, g2 = jax.jit(oobj.value_and_gradient)(w, sb, 0.1)
 ge = float(jnp.max(jnp.abs(g1 - g2)) / (jnp.max(jnp.abs(g2)) + 1e-9))
 assert ge < 1e-3, ("spill", ge)
+
+# MXU-packed one-hot expansion ON CHIP: bit-identical to the compare
+# build (both produce exact 0/1 one-hots) — the Mosaic lowering of the
+# distance-matmul route must not change numerics
+tobj_moh = TiledGLMObjective(LOGISTIC, d, mxu="bf16x2w", onehot="mxu")
+vm, gm = jax.jit(tobj_moh.value_and_gradient)(w, tb, 0.1)
+vc, gc = jax.jit(TiledGLMObjective(LOGISTIC, d, mxu="bf16x2w")
+                 .value_and_gradient)(w, tb, 0.1)
+assert float(vm) == float(vc), ("mxu-onehot value", float(vm), float(vc))
+assert bool(jnp.all(gm == gc)), "mxu-onehot grad differs from compare build"
 print("TPU_TILED_OK")
 
 # ---- 2. GLM training-path fit at the a1a shape: TPU tiled vs CPU ------
@@ -261,6 +276,84 @@ for lam in (1.0, 0.1):
                               - np.asarray(m_tpu[lam].means))))
     assert err < 5e-3, ("mesh", lam, err)
 print("TPU_MESH_FIT_OK")
+
+# ---- 7. feature-sharded fit under a 1x1 (data, model) mesh ------------
+from photon_ml_tpu.parallel.mesh import MODEL_AXIS
+from photon_ml_tpu.training import train_feature_sharded
+
+mesh11 = make_mesh((1, 1), (DATA_AXIS, MODEL_AXIS), devices=[tpu_dev])
+m_fs, _ = train_feature_sharded(
+    batch_a1a, TaskType.LOGISTIC_REGRESSION, d_a1a, mesh=mesh11,
+    kernel="tiled", **kwargs)
+for lam in (1.0, 0.1):
+    err = float(np.max(np.abs(np.asarray(m_fs[lam].means)
+                              - np.asarray(m_cpu[lam].means))))
+    assert err < 5e-3, ("feature-sharded", lam, err)
+print("TPU_FEATURE_SHARDED_OK")
+
+# ---- 8. full GAME coordinate-descent step on chip vs CPU oracle -------
+from photon_ml_tpu.game import (
+    CoordinateDescent, FeatureShardConfiguration, FixedEffectCoordinate,
+    RandomEffectCoordinate, RandomEffectDataConfiguration,
+    RandomEffectOptimizationProblem, build_game_dataset,
+    build_random_effect_dataset,
+)
+from photon_ml_tpu.optim.config import RegularizationContext as RC5
+from photon_ml_tpu.optim.config import RegularizationType as RT5
+from photon_ml_tpu.optim.problem import create_glm_problem
+
+r = np.random.default_rng(5)
+recs = []
+for i in range(160):
+    u = int(r.integers(0, 8))
+    xg = r.normal(size=5); xu = r.normal(size=3)
+    recs.append({
+        "uid": f"r{i}", "response": float(r.uniform() > 0.5),
+        "userId": f"u{u}",
+        "features": [{"name": f"g{j}", "term": "", "value": float(xg[j])}
+                     for j in range(5)],
+        "userFeatures": [{"name": f"f{j}", "term": "", "value": float(xu[j])}
+                         for j in range(3)],
+    })
+game_shards = [
+    FeatureShardConfiguration("globalShard", ["features"], add_intercept=True),
+    FeatureShardConfiguration("userShard", ["userFeatures"], add_intercept=True),
+]
+
+def game_cd_step():
+    ds = build_game_dataset(recs, game_shards, ["userId"])
+    red = build_random_effect_dataset(
+        ds, RandomEffectDataConfiguration("userId", "userShard"))
+    coords = {
+        "fixed": FixedEffectCoordinate(
+            name="fixed", dataset=ds,
+            problem=create_glm_problem(
+                TaskType.LOGISTIC_REGRESSION, ds.shards["globalShard"].dim,
+                config=OptimizerConfig(max_iter=10),
+                regularization=RC5(RT5.L2)),
+            feature_shard_id="globalShard", reg_weight=0.5),
+        "perUser": RandomEffectCoordinate(
+            name="perUser", dataset=ds, re_dataset=red,
+            problem=RandomEffectOptimizationProblem(
+                LOGISTIC, OptimizerConfig(max_iter=10), RC5(RT5.L2),
+                reg_weight=1.0)),
+    }
+    res = CoordinateDescent(
+        coords, ds, TaskType.LOGISTIC_REGRESSION,
+        update_sequence=["fixed", "perUser"],
+    ).run(2)
+    return (np.asarray(res.model.get_model("fixed").model.means),
+            np.asarray(res.model.get_model("perUser").bank),
+            np.asarray(res.objective_history))
+
+from photon_ml_tpu.optim.config import OptimizerConfig
+fe_t, bank_t, hist_t = game_cd_step()
+with jax.default_device(cpu):
+    fe_c, bank_c, hist_c = game_cd_step()
+assert float(np.max(np.abs(fe_t - fe_c))) < 5e-3, "GAME CD FE means"
+assert float(np.max(np.abs(bank_t - bank_c))) < 5e-3, "GAME CD RE bank"
+np.testing.assert_allclose(hist_t, hist_c, atol=1e-3)
+print("TPU_GAME_CD_OK")
 """
 
 _MARKERS = {
@@ -270,6 +363,8 @@ _MARKERS = {
     "mf_warm_step": "TPU_MF_OK",
     "streaming_cached_eval": "TPU_STREAMING_OK",
     "one_device_mesh_fit": "TPU_MESH_FIT_OK",
+    "feature_sharded_1x1_mesh_fit": "TPU_FEATURE_SHARDED_OK",
+    "game_cd_step": "TPU_GAME_CD_OK",
 }
 
 pytestmark = pytest.mark.skipif(
