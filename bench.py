@@ -62,6 +62,12 @@ def main():
         result = overlap_ab()
         result["tpu_tests"] = tpu_tests
         result["detail"]["device"] = str(jax.devices()[0])
+        # the out-of-core GAME CD A/B is host-side by construction —
+        # its numbers (examples_per_s, peak_rss_bytes, objective parity)
+        # belong in the round artifact even with the tunnel down
+        result["detail"]["streaming_game"] = _streaming_game_config(
+            "streaming_game"
+        )["detail"]
         result["detail"]["note"] = (
             "CPU-only host (accelerator unreachable); kernel-path "
             "microbench and BASELINE suite skipped — see the last "
@@ -267,6 +273,7 @@ def main():
     # host-device overlap A/B (CPU-scaled shape; the full config-5 A/B
     # runs via dev-scripts/bench_overlap.sh / `bench.py --overlap-ab --full`)
     overlap_result = overlap_ab()
+    streaming_game = _streaming_game_config("streaming_game")["detail"]
 
     result = {
         "metric": "fused_value_and_gradient_examples_per_sec_per_chip",
@@ -275,6 +282,7 @@ def main():
         "vs_baseline": round(examples_per_sec / ROUND1_EXAMPLES_PER_SEC, 2),
         "tpu_tests": tpu_tests,
         "overlap": overlap_result["detail"],
+        "streaming_game": streaming_game,
         "detail": {
             "kernel": "tiled_pallas_" + obj.mxu,
             "n": n,
@@ -1119,6 +1127,194 @@ def _streaming_config(name, *, n_files=8, rows_per_file=125_000, d=200_000,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _streaming_game_config(name, *, n_files=3, rows_per_file=6000,
+                           n_users=400, d_g=24, d_u=8, num_iterations=2,
+                           budget_bytes=2 << 20, seed=0):
+    """Out-of-core GAME fit A/B (game/streaming.py): streamed coordinate
+    descent over spilled chunks vs the in-memory CD on the same files.
+    Emits examples_per_s + peak_rss_bytes (the budget contract made
+    observable) + the objective parity — the round artifact's
+    ``streaming_game`` section. Gates live in
+    dev-scripts/bench_streaming_game.sh (host-class-aware: throughput
+    >= 0.8x in-memory on multi-core hosts, objective parity everywhere,
+    RSS delta bounded)."""
+    import shutil
+    import tempfile
+
+    from photon_ml_tpu.game.config import (
+        FeatureShardConfiguration,
+        FixedEffectDataConfiguration,
+        ProjectorType,
+        RandomEffectDataConfiguration,
+    )
+    from photon_ml_tpu.io import schemas
+    from photon_ml_tpu.io.avro_codec import write_container
+    from photon_ml_tpu.optim.config import GLMOptimizationConfiguration
+    from photon_ml_tpu.task import TaskType
+    from photon_ml_tpu.utils.profiling import peak_rss_bytes
+
+    schema = {
+        "name": "GameExample", "type": "record",
+        "fields": [
+            {"name": "uid", "type": ["null", "string"], "default": None},
+            {"name": "response", "type": "double"},
+            {"name": "metadataMap",
+             "type": ["null", {"type": "map", "values": "string"}],
+             "default": None},
+            {"name": "features",
+             "type": {"type": "array", "items": schemas.FEATURE_AVRO}},
+            {"name": "userFeatures",
+             "type": {"type": "array", "items": "FeatureAvro"}},
+        ],
+    }
+    rng = np.random.default_rng(seed)
+    tmp = tempfile.mkdtemp(prefix="photon-game-stream-bench-")
+    try:
+        w_g = np.linspace(-1, 1, d_g)
+        w_u = np.random.default_rng(7).normal(size=(n_users, d_u)) * 0.5
+        t0 = time.perf_counter()
+        for fi in range(n_files):
+            recs = []
+            for i in range(rows_per_file):
+                u = int(rng.integers(0, n_users))
+                xg = rng.normal(size=d_g)
+                xu = rng.normal(size=d_u)
+                z = float(xg @ w_g + xu @ w_u[u])
+                recs.append({
+                    "uid": f"{fi}-{i}",
+                    "response": float(
+                        1 / (1 + np.exp(-z)) > rng.uniform()
+                    ),
+                    "metadataMap": {"userId": f"user{u}"},
+                    "features": [
+                        {"name": f"g{j}", "term": "", "value": float(xg[j])}
+                        for j in range(d_g)
+                    ],
+                    "userFeatures": [
+                        {"name": f"u{j}", "term": "", "value": float(xu[j])}
+                        for j in range(d_u)
+                    ],
+                })
+            write_container(f"{tmp}/part-{fi:03d}.avro", schema, recs)
+            del recs
+        gen_s = time.perf_counter() - t0
+
+        shards = [
+            FeatureShardConfiguration("globalShard", ["features"]),
+            FeatureShardConfiguration("userShard", ["userFeatures"]),
+        ]
+        fe_data = {"global": FixedEffectDataConfiguration("globalShard")}
+        re_data = {
+            "per-user": RandomEffectDataConfiguration(
+                "userId", "userShard",
+                projector_type=ProjectorType.IDENTITY,
+            )
+        }
+        combo = {
+            "global": GLMOptimizationConfiguration.parse(
+                "20,1e-6,0.5,1,TRON,L2"
+            ),
+            "per-user": GLMOptimizationConfiguration.parse(
+                "20,1e-6,1.0,1,LBFGS,L2"
+            ),
+        }
+        n = n_files * rows_per_file
+
+        # -- streamed fit (FIRST: its RSS delta excludes the in-memory
+        # staging below) --------------------------------------------------
+        from photon_ml_tpu.game.streaming import train_streaming_game
+
+        rss_before = peak_rss_bytes()
+        t0 = time.perf_counter()
+        res, extras = train_streaming_game(
+            [tmp], shards, fe_data, re_data, combo,
+            TaskType.LOGISTIC_REGRESSION,
+            num_iterations=num_iterations,
+            memory_budget_bytes=budget_bytes,
+        )
+        stream_s = time.perf_counter() - t0
+        rss_after = peak_rss_bytes()
+
+        # -- in-memory reference ------------------------------------------
+        from photon_ml_tpu.game.coordinate import (
+            FixedEffectCoordinate,
+            RandomEffectCoordinate,
+        )
+        from photon_ml_tpu.game.coordinate_descent import CoordinateDescent
+        from photon_ml_tpu.game.data import build_game_dataset_from_files
+        from photon_ml_tpu.game.random_effect import (
+            RandomEffectOptimizationProblem,
+        )
+        from photon_ml_tpu.game.random_effect_data import (
+            build_random_effect_dataset,
+        )
+        from photon_ml_tpu.ops.losses import loss_for_task
+        from photon_ml_tpu.optim.problem import create_glm_problem
+
+        task = TaskType.LOGISTIC_REGRESSION
+        t0 = time.perf_counter()
+        ds = build_game_dataset_from_files([tmp], shards, ["userId"])
+        red = build_random_effect_dataset(ds, re_data["per-user"])
+        coords = {
+            "global": FixedEffectCoordinate(
+                name="global", dataset=ds,
+                problem=create_glm_problem(
+                    task, ds.shards["globalShard"].dim,
+                    config=combo["global"].optimizer_config,
+                    regularization=combo["global"].regularization,
+                    intercept_index=(
+                        ds.shards["globalShard"].intercept_index
+                    ),
+                ),
+                feature_shard_id="globalShard",
+                reg_weight=combo["global"].reg_weight,
+            ),
+            "per-user": RandomEffectCoordinate(
+                name="per-user", dataset=ds, re_dataset=red,
+                problem=RandomEffectOptimizationProblem(
+                    loss_for_task(task),
+                    combo["per-user"].optimizer_config,
+                    combo["per-user"].regularization,
+                    reg_weight=combo["per-user"].reg_weight,
+                ),
+            ),
+        }
+        ref = CoordinateDescent(coords, ds, task).run(num_iterations)
+        mem_s = time.perf_counter() - t0
+
+        obj_rel = abs(
+            res.objective_history[-1] - ref.objective_history[-1]
+        ) / abs(ref.objective_history[-1])
+        ex_s = round(n * num_iterations / stream_s)
+        ex_m = round(n * num_iterations / mem_s)
+        return {
+            "config": name,
+            "metric": "streaming_game_examples_per_sec",
+            "value": ex_s,
+            "unit": "examples/sec (full CD pass, streamed)",
+            "detail": {
+                "n": n,
+                "num_iterations": num_iterations,
+                "num_chunks": extras["store"].count,
+                "rows_per_chunk": extras["rows_per_chunk"],
+                "memory_budget_bytes": budget_bytes,
+                "examples_per_s": ex_s,
+                "in_memory_examples_per_s": ex_m,
+                "throughput_ratio": round(ex_s / max(ex_m, 1), 3),
+                "stream_fit_s": round(stream_s, 2),
+                "in_memory_fit_s": round(mem_s, 2),
+                "peak_rss_bytes": rss_after,
+                "rss_delta_bytes": rss_after - rss_before,
+                "objective_rel_diff": float(obj_rel),
+                "data_gen_s": round(gen_s, 1),
+                "host": {"cpu_count": os.cpu_count()},
+                "data": "synthetic GAME Avro written to scratch",
+            },
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _regen_with_model(rng, n, d, k, w_true, gen_task, noise=0.5):
     """Draw a dataset from a GIVEN planted model (shared generator for the
     train set and its held-out split)."""
@@ -1580,6 +1776,12 @@ def suite(only=None):
         results.append(_streaming_config("6_streaming"))
         print(json.dumps(results[-1]), flush=True)
 
+    # 7: out-of-core GAME coordinate descent (streamed CD A/B vs
+    # in-memory on the same files; budget-bounded RSS).
+    if want("7_streaming_game"):
+        results.append(_streaming_game_config("7_streaming_game"))
+        print(json.dumps(results[-1]), flush=True)
+
     path = "BASELINE_RESULTS.json"
     merged = {}
     if only is not None and os.path.exists(path):
@@ -1603,6 +1805,10 @@ def suite(only=None):
 if __name__ == "__main__":
     if "--overlap-ab" in sys.argv:
         print(json.dumps(overlap_ab(full="--full" in sys.argv)))
+    elif "--streaming-game" in sys.argv:
+        # dev-scripts/bench_streaming_game.sh entry: the streamed GAME
+        # CD A/B as one JSON line (gates applied by the script)
+        print(json.dumps(_streaming_game_config("streaming_game")))
     elif "--suite" in sys.argv:
         only = None
         if "--only" in sys.argv:

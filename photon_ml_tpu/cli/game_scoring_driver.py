@@ -74,10 +74,18 @@ class GameScoringParams:
     # evaluators only.
     streaming: bool = False
     rows_per_chunk: int = 100_000
+    # Optional byte budget (the training drivers' --stream-memory-budget):
+    # caps rows_per_chunk by the scored row's staged bytes so one flag
+    # bounds the whole pipeline's host memory consistently.
+    stream_memory_budget: int = 0
 
     def validate(self):
         if not self.input_dirs:
             raise ValueError("input-data-dirs is required")
+        if self.stream_memory_budget and not self.streaming:
+            raise ValueError(
+                "stream-memory-budget requires --streaming true"
+            )
         if self.streaming:
             # all param-detectable streaming misconfigurations fail HERE,
             # before __init__ touches (or deletes) the output directory
@@ -237,6 +245,7 @@ class GameScoringDriver:
         all_weights: List[np.ndarray] = []
         n_rows = 0
         part = 0
+        rows_per_chunk = p.rows_per_chunk
         with self.timer.time("score-stream"), profile_trace(p.profile_dir):
             for path in files:
                 try:
@@ -250,9 +259,37 @@ class GameScoringDriver:
                     if "empty GAME dataset" in str(e):
                         continue  # zero-record part file
                     raise
-                for a in range(0, ds_file.num_real_rows, p.rows_per_chunk):
+                if p.stream_memory_budget and n_rows == 0:
+                    # one budget flag bounds the whole pipeline: cap the
+                    # chunk rows by the scored row's staged bytes (every
+                    # shard's padded slots + the scalar columns), like
+                    # the training drivers' --stream-memory-budget
+                    from photon_ml_tpu.game.streaming import game_row_bytes
+                    from photon_ml_tpu.io.streaming import (
+                        stream_budget_rows,
+                    )
+
+                    row_bytes = game_row_bytes(
+                        {
+                            sid: sd.indices.shape[1]
+                            for sid, sd in ds_file.shards.items()
+                        },
+                        len(id_types),
+                    )
+                    rows_per_chunk = min(
+                        rows_per_chunk,
+                        stream_budget_rows(
+                            p.stream_memory_budget, row_bytes,
+                            default_rows=rows_per_chunk,
+                        ),
+                    )
+                    self.logger.info(
+                        "stream memory budget %d B -> %d rows/chunk",
+                        p.stream_memory_budget, rows_per_chunk,
+                    )
+                for a in range(0, ds_file.num_real_rows, rows_per_chunk):
                     ds = slice_game_dataset(
-                        ds_file, a, a + p.rows_per_chunk
+                        ds_file, a, a + rows_per_chunk
                     )
                     scores = np.asarray(
                         model.score(ds, p.task_type)
@@ -403,6 +440,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     )
     ap.add_argument("--rows-per-chunk", type=int, default=100_000)
     ap.add_argument(
+        "--stream-memory-budget", type=int, default=0,
+        help="byte budget capping --rows-per-chunk by the scored row's "
+        "staged bytes (one flag bounds the whole pipeline's host "
+        "memory); 0 = use --rows-per-chunk as-is",
+    )
+    ap.add_argument(
         "--no-overlap", default="false",
         help="disable the host-device overlap layer (async score-part "
         "writes) and run fully serial",
@@ -437,6 +480,7 @@ def params_from_args(argv=None) -> GameScoringParams:
         no_overlap=str(ns.no_overlap).lower() in ("true", "1", "yes"),
         streaming=str(ns.streaming).lower() in ("true", "1", "yes"),
         rows_per_chunk=ns.rows_per_chunk,
+        stream_memory_budget=ns.stream_memory_budget,
         has_response=str(ns.has_response).lower() in ("true", "1", "yes"),
         date_range=ns.date_range,
         date_range_days_ago=ns.date_range_days_ago,

@@ -240,6 +240,21 @@ class GameTrainingParams:
     # synchronous checkpoint/metrics writes (the pre-overlap behavior and
     # the dev-scripts/bench_overlap.sh A/B baseline).
     no_overlap: bool = False
+    # Out-of-core GAME training (game/streaming.py): the train set streams
+    # once per CD pass through spilled fixed-shape chunks, random effects
+    # group into disk-backed bucket segments, scores/residuals live on
+    # disk per chunk — host peak RSS is bounded by --stream-memory-budget
+    # instead of the dataset. IDENTITY-projected plain coordinates only.
+    streaming: bool = False
+    # Byte budget for the streaming layer (chunk rows + RE segment size);
+    # 0 keeps the default chunk sizing (65536 rows / 1 GiB segments).
+    stream_memory_budget: int = 0
+    # Streaming diagnostics reservoir (the GLM driver's byte-budgeted
+    # bounded sample, extended to wide-row GAME streams): rows scale DOWN
+    # when the staged row is wide so the sample cannot blow the bounded-
+    # memory contract (cli.glm_driver.budgeted_reservoir_rows).
+    diagnostic_reservoir_rows: int = 100_000
+    diagnostic_reservoir_bytes: int = 256 << 20
 
     def validate(self) -> None:
         if not self.train_input_dirs:
@@ -275,6 +290,44 @@ class GameTrainingParams:
         for name in self.random_effect_data_configs:
             if name not in self.random_effect_opt_configs:
                 raise ValueError(f"missing optimization config for {name}")
+        if self.diagnostic_reservoir_rows < 1:
+            raise ValueError("diagnostic-reservoir-rows must be >= 1")
+        if self.diagnostic_reservoir_bytes < 1:
+            raise ValueError("diagnostic-reservoir-bytes must be >= 1")
+        if self.streaming:
+            # the streaming layer's structural gates; everything else the
+            # in-memory path supports is a bounded pass over staged chunks
+            unsupported = []
+            if self.factored_re_configs:
+                unsupported.append(
+                    "factored random effects (latent re-projection "
+                    "re-materializes every row per inner iteration)"
+                )
+            if self.checkpoint_dir is not None:
+                unsupported.append("checkpoint/resume")
+            if self.distributed == "feature":
+                unsupported.append(
+                    "a feature-sharded fixed effect (use the GLM driver's "
+                    "--streaming --distributed feature for that "
+                    "composition)"
+                )
+            if self.coordinator_address is not None:
+                unsupported.append("multi-process training")
+            for et in self.evaluator_types:
+                if et.is_sharded:
+                    unsupported.append(
+                        f"the sharded evaluator {et.render()}"
+                    )
+            if unsupported:
+                raise ValueError(
+                    "streaming GAME training does not support: "
+                    + ", ".join(unsupported)
+                )
+            from photon_ml_tpu.game.streaming import (
+                validate_streaming_game_configs,
+            )
+
+            validate_streaming_game_configs(self.random_effect_data_configs)
 
 
 class GameTrainingDriver:
@@ -599,9 +652,156 @@ class GameTrainingDriver:
             return maps
         return None
 
+    # -- streaming (out-of-core) path --------------------------------------
+
+    def _run_streaming(self) -> None:
+        """Out-of-core run: scan -> stage -> streamed CD per combo, with
+        streamed validation and the model written through the standard
+        save_game_model layout (the scoring driver reads it unchanged)."""
+        from photon_ml_tpu.game.data import ShardData
+        from photon_ml_tpu.game.streaming import train_streaming_game
+        from photon_ml_tpu.utils.profiling import peak_rss_bytes
+
+        p = self.params
+        train_paths = self._expand_dated(
+            p.train_input_dirs, p.train_date_range,
+            p.train_date_range_days_ago,
+        )
+        validate_paths = None
+        if p.validate_input_dirs:
+            validate_paths = self._expand_dated(
+                p.validate_input_dirs, p.validate_date_range,
+                p.validate_date_range_days_ago,
+            )
+        combos = expand_config_grid(
+            {**p.fixed_effect_opt_configs, **p.random_effect_opt_configs}
+        )
+        self.logger.info(
+            "streaming GAME training: %d configuration combo(s), "
+            "%d B memory budget",
+            len(combos), p.stream_memory_budget,
+        )
+        maximize = p.task_type == TaskType.LOGISTIC_REGRESSION
+        best = None
+        best_extras = None
+        best_orig_idx = None
+        for ci, combo in enumerate(combos):
+            with self.timer.time(f"train-combo-{ci}"), profile_trace(
+                p.profile_dir if ci == 0 else None
+            ):
+                result, extras = train_streaming_game(
+                    train_paths,
+                    p.feature_shards,
+                    p.fixed_effect_data_configs,
+                    p.random_effect_data_configs,
+                    combo,
+                    p.task_type,
+                    num_iterations=p.num_iterations,
+                    update_sequence=p.updating_sequence,
+                    memory_budget_bytes=p.stream_memory_budget,
+                    index_maps=self._offheap_index_maps(),
+                    validate_paths=validate_paths,
+                    evaluator_types=p.evaluator_types or None,
+                    compute_variance=p.compute_variance,
+                    diagnostic_reservoir_rows=p.diagnostic_reservoir_rows,
+                    diagnostic_reservoir_bytes=p.diagnostic_reservoir_bytes,
+                    logger=self.logger,
+                )
+            self.results.append((combo, result, ci))
+            metric = result.best_metric
+            if metric is None:
+                if best is None or (
+                    best[0].best_metric is None and ci < best_orig_idx
+                ):
+                    best, best_extras, best_orig_idx = result, extras, ci
+                    self.best_config = combo
+            elif (
+                best is None
+                or best[0].best_metric is None
+                or (maximize and metric > best[0].best_metric)
+                or (not maximize and metric < best[0].best_metric)
+            ):
+                best, best_extras, best_orig_idx = result, extras, ci
+                self.best_config = combo
+        self.best_result = (best, best.best_metric if best else None)
+        if p.model_output_mode != "NONE" and best is not None:
+            # a shell dataset carrying ONLY what save_game_model reads:
+            # per-shard index maps + entity indexes (no row data)
+            shells = {
+                sid: ShardData(
+                    indices=np.zeros((0, 1), np.int32),
+                    values=np.zeros((0, 1), np.float32),
+                    index_map=imap,
+                    intercept_index=None,
+                )
+                for sid, imap in best_extras["index_maps"].items()
+            }
+            shell = GameDataset(
+                uids=[],
+                labels=np.zeros(0, np.float32),
+                offsets=np.zeros(0, np.float32),
+                weights=np.zeros(0, np.float32),
+                shards=shells,
+                entity_codes={},
+                entity_indexes=best_extras["entity_indexes"],
+                num_real_rows=0,
+            )
+            with self.timer.time("save-model"):
+                save_game_model(
+                    best.game_model, shell,
+                    os.path.join(p.output_dir, "best-model"),
+                    model_spec="\n".join(
+                        f"{name} -> {cfg.render()}"
+                        for name, cfg in self.best_config.items()
+                    ),
+                    num_re_output_files=(
+                        p.num_output_files_for_random_effect_model
+                    ),
+                )
+        sample = best_extras["diagnostics_sample"] if best_extras else None
+        diag = None
+        if sample is not None and len(sample["lab"]):
+            diag = {
+                "reservoir_rows": int(len(sample["lab"])),
+                "label_mean": float(np.mean(sample["lab"])),
+                "weight_sum": float(np.sum(sample["wgt"])),
+            }
+        with open(os.path.join(p.output_dir, "metrics.json"), "w") as f:
+            json.dump(
+                {
+                    "objective_history": (
+                        best.objective_history if best else []
+                    ),
+                    "validation_history": (
+                        best.validation_history if best else []
+                    ),
+                    "best_metric": best.best_metric if best else None,
+                    "timers": self.timer.durations,
+                    "streaming": {
+                        "memory_budget_bytes": p.stream_memory_budget,
+                        "rows_per_chunk": (
+                            best_extras["rows_per_chunk"]
+                            if best_extras else None
+                        ),
+                        "num_chunks": (
+                            best_extras["store"].count
+                            if best_extras else None
+                        ),
+                        "peak_rss_bytes": peak_rss_bytes(),
+                        "diagnostics": diag,
+                    },
+                },
+                f,
+                indent=2,
+            )
+        self.logger.info("timers:\n%s", self.timer.summary())
+
     def run(self) -> None:
         p = self.params
         self.logger.info("application: %s", p.application_name)
+        if p.streaming:
+            self._run_streaming()
+            return
         with self.timer.time("load-train"):
             dataset = self._load_dataset(
                 self._expand_dated(
@@ -955,6 +1155,28 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "background host prep, async checkpoint/metrics writes) and run "
         "fully serial — the A/B escape hatch",
     )
+    ap.add_argument(
+        "--streaming", default="false",
+        help="true: out-of-core GAME training — the train set streams "
+        "once per CD pass through spilled chunks, random effects solve "
+        "from disk-backed bucket segments, host peak RSS is bounded by "
+        "--stream-memory-budget (IDENTITY-projected plain coordinates)",
+    )
+    ap.add_argument(
+        "--stream-memory-budget", type=int, default=0,
+        help="byte budget for the streaming layer (staged-chunk rows + "
+        "random-effect segment size); 0 = default chunk sizing "
+        "(65536 rows, 1 GiB segments)",
+    )
+    ap.add_argument(
+        "--diagnostic-reservoir-rows", type=int, default=100_000,
+        help="max rows in the streaming diagnostics reservoir sample",
+    )
+    ap.add_argument(
+        "--diagnostic-reservoir-bytes", type=int, default=256 << 20,
+        help="byte budget for the diagnostics reservoir (rows scale down "
+        "for wide multi-shard rows, preserving bounded memory)",
+    )
     return ap
 
 
@@ -1051,6 +1273,10 @@ def params_from_args(argv=None) -> GameTrainingParams:
         profile_dir=ns.profile_dir,
         tile_cache_dir=ns.tile_cache_dir,
         no_overlap=_bool(ns.no_overlap),
+        streaming=_bool(ns.streaming),
+        stream_memory_budget=ns.stream_memory_budget,
+        diagnostic_reservoir_rows=ns.diagnostic_reservoir_rows,
+        diagnostic_reservoir_bytes=ns.diagnostic_reservoir_bytes,
     )
 
 

@@ -141,6 +141,12 @@ class GLMParams:
     # chunked decode) or LibSVM (line-at-a-time) input; host-driven
     # L-BFGS/OWL-QN/TRON; validation data still loads in memory.
     streaming: bool = False
+    # Explicit host-memory byte budget for the streaming layer: fixes the
+    # staged-chunk row count (budget // bytes-per-row) AND the chunk/
+    # sharded cache tiers, and is reported against the measured peak-RSS
+    # high-water in metrics.json. 0 keeps the historical default sizing
+    # (65536-row chunks, 2 GiB cache tiers).
+    stream_memory_budget: int = 0
     # jax.profiler trace of the training stage into this directory
     # (SURVEY §7.11 upgrade over Timer-only observability); conventionally
     # <output-dir>/profile, viewable in TensorBoard/Perfetto.
@@ -220,22 +226,27 @@ class GLMParams:
                 "validate-per-iteration requires a validating data directory"
             )
         if self.streaming:
-            # Round 5 closed most of the streaming guards: every driver
-            # stage is now a bounded-memory pass over staged chunks, like
-            # the reference's everything-is-an-RDD-pass design
-            # (Driver.scala:525-552): TRON streams one Hv pass per CG
-            # step, normalization/summarization come from a streamed
-            # colStats pass, variances from a streamed Hdiag pass, box
-            # constraints project host-side, validate-per-iteration
-            # tracks coefficients in the host optimizers, and TRAIN-mode
-            # diagnostics resample a bounded reservoir of the stream.
-            # What remains unsupported is structural:
+            # Round 5 closed most of the streaming guards and round 7
+            # deleted the feature-sharding exclusion: every driver stage
+            # is a bounded-memory pass over staged chunks, like the
+            # reference's everything-is-an-RDD-pass design
+            # (Driver.scala:525-552); --distributed feature now re-stages
+            # each streamed chunk per feature block on the (data, model)
+            # mesh (io.streaming.FeatureShardedStreamingObjective), with
+            # one streamed sharded Hv pass per TRON CG step. What remains
+            # unsupported is structural:
             unsupported = []
             if self.distributed == "feature":
-                # feature sharding lays the WHOLE dataset out per feature
-                # block up front; streaming re-stages rows chunk by chunk
-                # — the two layouts are mutually exclusive by design
-                unsupported.append("feature-sharded training")
+                if self.normalization_type != NormalizationType.NONE:
+                    unsupported.append(
+                        "normalization with streaming feature-sharded "
+                        "training (the shift/factor extras are not "
+                        "threaded through the per-chunk sharded programs)"
+                    )
+                if self.coordinator_address is not None:
+                    unsupported.append(
+                        "multi-process streaming feature-sharded training"
+                    )
             if (
                 self.coordinator_address is not None
                 and not self.offheap_indexmap_dir
@@ -249,6 +260,10 @@ class GLMParams:
                     "streaming training does not support: "
                     + ", ".join(unsupported)
                 )
+        if self.stream_memory_budget and not self.streaming:
+            raise ValueError(
+                "stream-memory-budget requires --streaming true"
+            )
 
 
 def budgeted_reservoir_rows(
@@ -258,9 +273,12 @@ def budgeted_reservoir_rows(
     rows x max_nnz dense (int32 indices + float32 values = 8 B/slot, plus
     12 B/row of label/offset/weight), so wide-row datasets scale rows
     DOWN to fit instead of allocating multiple GB on the host — the
-    streaming path's bounded-memory contract (ADVICE.md round 5)."""
-    bytes_per_row = max(1, max_nnz) * 8 + 12
-    return max(1, min(max_rows, budget_bytes // bytes_per_row))
+    streaming path's bounded-memory contract (ADVICE.md round 5). The
+    shared core lives in io.streaming.budgeted_rows; the GAME driver
+    budgets its (multi-shard-wide) reservoir through the same helper."""
+    from photon_ml_tpu.io.streaming import budgeted_rows, sparse_row_bytes
+
+    return budgeted_rows(max_rows, budget_bytes, sparse_row_bytes(max_nnz))
 
 
 class GLMDriver:
@@ -381,12 +399,42 @@ class GLMDriver:
                 # (no full materialization — the train data may exceed
                 # RAM); a prebuilt offheap store skips the vocabulary scan
                 # (and is required for multi-process streaming)
-                from photon_ml_tpu.io.streaming import scan_stream
+                import jax
+
+                from photon_ml_tpu.io.streaming import (
+                    scan_stream,
+                    scan_stream_with_summary,
+                )
                 from photon_ml_tpu.utils.index_map import intercept_key
 
-                index_map, stats = scan_stream(
-                    train_paths, fmt, index_map=prebuilt
+                needs_summary = (
+                    p.normalization_type != NormalizationType.NONE
+                    or bool(p.summarization_output_dir)
+                    or p.diagnostic_mode != DiagnosticMode.NONE
                 )
+                # FUSED scan: vocabulary + stats + colStats in ONE pass
+                # over the train dir (stream_scan_with_summary) instead
+                # of scan + streamed-summary re-reading it back to back.
+                # Falls back to two passes when the summary pass must
+                # ALSO draw the diagnostics reservoir (row-level sample
+                # in final index space) or reduce across processes.
+                fused_summary = None
+                use_fused = (
+                    needs_summary
+                    and p.diagnostic_mode == DiagnosticMode.NONE
+                    and jax.process_count() == 1
+                    and hasattr(fmt, "stream_scan_with_summary")
+                )
+                if use_fused:
+                    index_map, stats, fused_summary = (
+                        scan_stream_with_summary(
+                            train_paths, fmt, index_map=prebuilt
+                        )
+                    )
+                else:
+                    index_map, stats = scan_stream(
+                        train_paths, fmt, index_map=prebuilt
+                    )
                 icept = (
                     index_map.get_index(intercept_key())
                     if p.add_intercept else -1
@@ -412,51 +460,53 @@ class GLMDriver:
                     "max %d nnz/row",
                     stats.num_rows, index_map.size, stats.max_nnz,
                 )
-                needs_summary = (
-                    p.normalization_type != NormalizationType.NONE
-                    or bool(p.summarization_output_dir)
-                    or p.diagnostic_mode != DiagnosticMode.NONE
-                )
                 if needs_summary:
-                    # one more bounded-memory pass: streamed colStats
-                    # (+ a reservoir sample of rows when diagnostics will
-                    # need row-level resampling). streaming_summary
-                    # all-reduces moments across processes, so each
-                    # process must scan only ITS file shard — passing the
-                    # full set would multiply every moment by the process
-                    # count.
-                    import jax
-
-                    from photon_ml_tpu.io.streaming import streaming_summary
-
-                    summary_paths = train_paths
-                    if jax.process_count() > 1:
+                    if fused_summary is not None:
+                        # the fused pass already collected the colStats —
+                        # no second read of the train dir
+                        self._summary = fused_summary
+                    else:
+                        # one more bounded-memory pass: streamed colStats
+                        # (+ a reservoir sample of rows when diagnostics
+                        # will need row-level resampling).
+                        # streaming_summary all-reduces moments across
+                        # processes, so each process must scan only ITS
+                        # file shard — passing the full set would multiply
+                        # every moment by the process count.
                         from photon_ml_tpu.io.streaming import (
-                            shard_stream_files,
+                            streaming_summary,
                         )
 
-                        summary_paths = shard_stream_files(
-                            train_paths, fmt
-                        )
-                    reservoir = 0
-                    if p.diagnostic_mode != DiagnosticMode.NONE:
-                        reservoir = budgeted_reservoir_rows(
-                            p.diagnostic_reservoir_rows,
-                            p.diagnostic_reservoir_bytes,
-                            stats.max_nnz,
-                        )
-                        if reservoir < p.diagnostic_reservoir_rows:
-                            self.logger.info(
-                                "diagnostics reservoir scaled to %d rows "
-                                "(%d B budget at %d nnz/row)",
-                                reservoir,
+                        summary_paths = train_paths
+                        if jax.process_count() > 1:
+                            from photon_ml_tpu.io.streaming import (
+                                shard_stream_files,
+                            )
+
+                            summary_paths = shard_stream_files(
+                                train_paths, fmt
+                            )
+                        reservoir = 0
+                        if p.diagnostic_mode != DiagnosticMode.NONE:
+                            reservoir = budgeted_reservoir_rows(
+                                p.diagnostic_reservoir_rows,
                                 p.diagnostic_reservoir_bytes,
                                 stats.max_nnz,
                             )
-                    self._summary, self._stream_sample = streaming_summary(
-                        summary_paths, fmt, index_map, stats,
-                        reservoir_rows=reservoir,
-                    )
+                            if reservoir < p.diagnostic_reservoir_rows:
+                                self.logger.info(
+                                    "diagnostics reservoir scaled to %d "
+                                    "rows (%d B budget at %d nnz/row)",
+                                    reservoir,
+                                    p.diagnostic_reservoir_bytes,
+                                    stats.max_nnz,
+                                )
+                        self._summary, self._stream_sample = (
+                            streaming_summary(
+                                summary_paths, fmt, index_map, stats,
+                                reservoir_rows=reservoir,
+                            )
+                        )
                     self._norm = build_normalization(
                         p.normalization_type,
                         mean=self._summary.mean,
@@ -572,41 +622,96 @@ class GLMDriver:
             data = self._data
             mesh = self._mesh()
             if p.streaming:
-                from photon_ml_tpu.training import train_streaming_glm
+                from photon_ml_tpu.io.streaming import (
+                    sparse_row_bytes,
+                    stream_budget_rows,
+                )
 
                 train_paths, stats = self._stream
-                if mesh is not None:
-                    self.logger.warning(
-                        "streaming training computes on one device per "
-                        "process (the %d-device mesh is not used for the "
-                        "chunk passes); across PROCESSES the input files "
-                        "shard and gradients reduce automatically",
-                        mesh.devices.size,
+                rows_per_chunk = stream_budget_rows(
+                    p.stream_memory_budget, sparse_row_bytes(stats.max_nnz)
+                )
+                cache_bytes = (
+                    p.stream_memory_budget
+                    if p.stream_memory_budget > 0
+                    else 2 << 30
+                )
+                if p.stream_memory_budget:
+                    self.logger.info(
+                        "stream memory budget %d B -> %d rows/chunk, "
+                        "%d B cache tiers",
+                        p.stream_memory_budget, rows_per_chunk, cache_bytes,
                     )
-                self.logger.info(
-                    "training in streaming mode (%d rows per full-batch "
-                    "pass)",
-                    stats.num_rows,
-                )
-                self.models, self.results, _ = train_streaming_glm(
-                    train_paths,
-                    p.task,
-                    regularization_type=p.regularization_type,
-                    regularization_weights=p.regularization_weights,
-                    elastic_net_alpha=p.elastic_net_alpha,
-                    max_iter=p.max_num_iterations,
-                    tolerance=p.tolerance,
-                    kernel=p.kernel,
-                    optimizer_type=p.optimizer_type,
-                    normalization=self._norm,
-                    compute_variances=p.compute_variances,
-                    box=data.constraints,
-                    track_models=p.validate_per_iteration,
-                    fmt=self._fmt,
-                    index_map=data.index_map,
-                    stats=stats,
-                    tile_cache_dir=p.tile_cache_dir,
-                )
+                if p.distributed == "feature" and mesh is not None:
+                    from photon_ml_tpu.training import (
+                        train_streaming_feature_sharded,
+                    )
+
+                    self.logger.info(
+                        "training in streaming FEATURE-SHARDED mode over "
+                        "mesh %s (%d rows per full-batch pass)",
+                        dict(mesh.shape), stats.num_rows,
+                    )
+                    self.models, self.results, _ = (
+                        train_streaming_feature_sharded(
+                            train_paths,
+                            p.task,
+                            mesh=mesh,
+                            regularization_type=p.regularization_type,
+                            regularization_weights=p.regularization_weights,
+                            elastic_net_alpha=p.elastic_net_alpha,
+                            max_iter=p.max_num_iterations,
+                            tolerance=p.tolerance,
+                            rows_per_chunk=rows_per_chunk,
+                            cache_bytes=cache_bytes,
+                            sharded_cache_bytes=cache_bytes,
+                            optimizer_type=p.optimizer_type,
+                            compute_variances=p.compute_variances,
+                            box=data.constraints,
+                            track_models=p.validate_per_iteration,
+                            fmt=self._fmt,
+                            index_map=data.index_map,
+                            stats=stats,
+                        )
+                    )
+                else:
+                    if mesh is not None:
+                        self.logger.warning(
+                            "streaming training computes on one device "
+                            "per process (the %d-device mesh is not used "
+                            "for the chunk passes); across PROCESSES the "
+                            "input files shard and gradients reduce "
+                            "automatically",
+                            mesh.devices.size,
+                        )
+                    self.logger.info(
+                        "training in streaming mode (%d rows per "
+                        "full-batch pass)",
+                        stats.num_rows,
+                    )
+                    from photon_ml_tpu.training import train_streaming_glm
+
+                    self.models, self.results, _ = train_streaming_glm(
+                        train_paths,
+                        p.task,
+                        regularization_type=p.regularization_type,
+                        regularization_weights=p.regularization_weights,
+                        elastic_net_alpha=p.elastic_net_alpha,
+                        max_iter=p.max_num_iterations,
+                        tolerance=p.tolerance,
+                        rows_per_chunk=rows_per_chunk,
+                        cache_bytes=cache_bytes,
+                        kernel=p.kernel,
+                        optimizer_type=p.optimizer_type,
+                        normalization=self._norm,
+                        compute_variances=p.compute_variances,
+                        box=data.constraints,
+                        track_models=p.validate_per_iteration,
+                        fmt=self._fmt,
+                        index_map=data.index_map,
+                        stats=stats,
+                        tile_cache_dir=p.tile_cache_dir,
+                    )
             elif p.distributed == "feature" and mesh is not None:
                 from photon_ml_tpu.training import train_feature_sharded
 
@@ -731,6 +836,102 @@ class GLMDriver:
             )
         return metrics
 
+    def _streamed_metrics_for(self, means, validate_paths, vstats) -> Dict[str, float]:
+        """One bounded pass over the validate stream for ONE model: the
+        driver's metric set via streaming accumulators (AUC fixed-bin
+        histogram, RMSE/losses exact) — evaluation/streaming.py."""
+        import jax
+
+        from photon_ml_tpu.evaluation.streaming import (
+            finalize_metrics,
+            glm_streaming_metrics,
+            update_glm_metrics,
+        )
+        from photon_ml_tpu.io.streaming import iter_chunks
+
+        p = self.params
+        loss = loss_for_task(p.task)
+        accs = glm_streaming_metrics(p.task, loss)
+        margins_fn = self.__dict__.get("_stream_margins_fn")
+        if margins_fn is None:
+            margins_fn = jax.jit(lambda w, b: compute_margins(w, b))
+            self._stream_margins_fn = margins_fn
+        for chunk in iter_chunks(
+            validate_paths, self._fmt, self._data.index_map,
+            rows_per_chunk=65536, nnz_width=vstats.max_nnz,
+        ):
+            update_glm_metrics(
+                accs, loss, margins_fn(means, chunk),
+                chunk.labels, chunk.weights,
+            )
+        return finalize_metrics(accs)
+
+    def _validate_streaming(self, validate_paths) -> None:
+        """Streamed validation (one pass per model over the validate dir,
+        never materialized): per-lambda metrics, best-model selection,
+        and --validate-per-iteration metrics all consume the stream
+        through iter_chunks — the reference's evaluate-as-one-more-
+        RDD-pass shape (Driver.scala:329-413)."""
+        from photon_ml_tpu.io.streaming import iter_chunks, scan_stream
+
+        p = self.params
+        _, vstats = scan_stream(
+            validate_paths, self._fmt, index_map=self._data.index_map
+        )
+        self.logger.info(
+            "streamed validation scan: %d examples, max %d nnz/row",
+            vstats.num_rows, vstats.max_nnz,
+        )
+        if p.data_validation_type != DataValidationType.VALIDATE_DISABLED:
+            for chunk in iter_chunks(
+                validate_paths, self._fmt, self._data.index_map,
+                rows_per_chunk=65536, nnz_width=vstats.max_nnz,
+            ):
+                sanity_check_data(chunk, p.task, p.data_validation_type)
+        if p.validate_per_iteration:
+            from photon_ml_tpu.training import iteration_models
+
+            for lam, result in self.results.items():
+                models = iteration_models(
+                    result, p.task, self._norm, self._data.intercept_index
+                )
+                per_iter = [
+                    self._streamed_metrics_for(
+                        m.means, validate_paths, vstats
+                    )
+                    for m in models
+                ]
+                self.per_iteration_metrics[lam] = per_iter
+                msg = "\n".join(
+                    f"Iteration: [{i:6d}] " + " ".join(
+                        f"Metric: [{k}] value: {v}"
+                        for k, v in sorted(metrics.items())
+                    )
+                    for i, metrics in enumerate(per_iter)
+                )
+                self.logger.info("Model with lambda = %g:\n%s", lam, msg)
+        maximize = p.task == TaskType.LOGISTIC_REGRESSION
+        best = None
+        for lam, model in self.models.items():
+            metrics = self._streamed_metrics_for(
+                model.means, validate_paths, vstats
+            )
+            self.validation_metrics[lam] = metrics
+            key = (
+                "AUC"
+                if maximize
+                else ("RMSE" if "RMSE" in metrics else next(iter(metrics)))
+            )
+            score = metrics[key]
+            self.logger.info("lambda=%g validation %s", lam, metrics)
+            if (
+                best is None
+                or (maximize and score > best[2])
+                or (not maximize and score < best[2])
+            ):
+                best = (lam, model, score)
+        self.best_lambda, self.best_model, _ = best
+
     def validate(self) -> None:
         p = self.params
         with self.timer.time("validate"):
@@ -738,6 +939,12 @@ class GLMDriver:
                 p.validate_dir, p.validate_date_range,
                 p.validate_date_range_days_ago,
             )
+            if p.streaming:
+                # bounded-memory validation: the validate dir streams
+                # through iter_chunks per model instead of loading whole
+                self._validate_streaming(validate_paths)
+                self._advance(DriverStage.VALIDATED)
+                return
             vdata = self._fmt.load(
                 validate_paths, index_map=self._data.index_map
             )
@@ -860,23 +1067,29 @@ class GLMDriver:
                             f"  iter={i} value={float(t.values[i]):.8g} "
                             f"|grad|={float(t.grad_norms[i]):.8g}\n"
                         )
+        from photon_ml_tpu.utils.profiling import peak_rss_bytes
+
+        payload = {
+            "validation": {
+                str(k): v for k, v in self.validation_metrics.items()
+            },
+            "per_iteration_validation": {
+                str(k): v
+                for k, v in self.per_iteration_metrics.items()
+            },
+            "best_lambda": self.best_lambda,
+            "timers": self.timer.durations,
+            "schedule_cache": self._schedule_cache_stats,
+        }
+        if p.streaming:
+            # the out-of-core contract made observable: configured budget
+            # vs the measured host high-water
+            payload["streaming"] = {
+                "memory_budget_bytes": p.stream_memory_budget,
+                "peak_rss_bytes": peak_rss_bytes(),
+            }
         with open(os.path.join(out, "metrics.json"), "w") as f:
-            json.dump(
-                {
-                    "validation": {
-                        str(k): v for k, v in self.validation_metrics.items()
-                    },
-                    "per_iteration_validation": {
-                        str(k): v
-                        for k, v in self.per_iteration_metrics.items()
-                    },
-                    "best_lambda": self.best_lambda,
-                    "timers": self.timer.durations,
-                    "schedule_cache": self._schedule_cache_stats,
-                },
-                f,
-                indent=2,
-            )
+            json.dump(payload, f, indent=2)
 
     def run(self) -> None:
         from photon_ml_tpu.parallel.multihost import (
@@ -986,7 +1199,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument(
         "--streaming", default="false",
         help="true: stream the training data from disk per evaluation "
-        "(bounded memory for >RAM datasets; Avro + L-BFGS/OWL-QN)",
+        "(bounded memory for >RAM datasets; Avro + L-BFGS/OWL-QN; "
+        "composes with --distributed feature for >HBM models)",
+    )
+    ap.add_argument(
+        "--stream-memory-budget", type=int, default=0,
+        help="host-memory byte budget for the streaming layer: fixes "
+        "the staged-chunk rows and cache tiers; peak RSS is reported "
+        "against it in metrics.json. 0 = default sizing",
     )
     ap.add_argument(
         "--profile-dir", default=None,
@@ -1090,6 +1310,7 @@ def params_from_args(argv=None) -> GLMParams:
         kernel=ns.kernel,
         distributed=ns.distributed,
         streaming=_bool(ns.streaming),
+        stream_memory_budget=ns.stream_memory_budget,
         profile_dir=ns.profile_dir,
         tile_cache_dir=ns.tile_cache_dir,
         no_overlap=_bool(ns.no_overlap),

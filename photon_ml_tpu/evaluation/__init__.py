@@ -5,6 +5,14 @@ from photon_ml_tpu.evaluation.evaluator import (
     EvaluatorType,
     select_best_model,
 )
+from photon_ml_tpu.evaluation.streaming import (
+    StreamingAUC,
+    StreamingMeanLoss,
+    StreamingRMSE,
+    finalize_metrics,
+    glm_streaming_metrics,
+    update_glm_metrics,
+)
 from photon_ml_tpu.evaluation.metrics import (
     akaike_information_criterion,
     area_under_precision_recall_curve,
@@ -32,4 +40,10 @@ __all__ = [
     "sharded_auc",
     "sharded_precision_at_k",
     "total_pointwise_loss",
+    "StreamingAUC",
+    "StreamingMeanLoss",
+    "StreamingRMSE",
+    "finalize_metrics",
+    "glm_streaming_metrics",
+    "update_glm_metrics",
 ]

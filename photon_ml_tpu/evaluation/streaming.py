@@ -1,0 +1,147 @@
+"""Streaming (bounded-memory) evaluation accumulators.
+
+Reference: the reference evaluates validation data as one more pass over
+an RDD (Driver.scala:329-413, Evaluation.scala:54-125) — nothing is ever
+materialized on the driver. The in-memory evaluators here
+(evaluation/metrics.py) instead sort the WHOLE score vector on device,
+which caps validation at host/device RAM. These accumulators restore the
+pass-over-chunks shape: the validate directory streams through
+``io.streaming.iter_chunks`` and each metric folds one chunk at a time.
+
+- RMSE and the pointwise losses are EXACT (weighted sums commute).
+- AUC uses a fixed-bin histogram over the sigmoid-squashed margin
+  (AUC is invariant under strictly monotone transforms, so binning
+  sigma(z) in (0, 1) loses only within-bin orderings). With the default
+  4096 bins the error against the exact sort-based AUC is well under
+  1e-3 on realistic score distributions; ties within a bin get the same
+  0.5 credit the exact evaluator gives exact ties.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from photon_ml_tpu.ops.losses import PointwiseLoss
+
+
+class StreamingAUC:
+    """Weighted AUC from per-class histograms over sigmoid(margin) bins.
+
+    AUC = sum_b pos_b * (neg_below_b + 0.5 * neg_b) / (P * N): the exact
+    Mann-Whitney statistic computed as if every score were rounded to its
+    bin center — the fixed-bin-merge analog of MLlib's grouped-by-
+    threshold curve. Histograms merge across chunks (and hosts) by
+    addition.
+    """
+
+    def __init__(self, num_bins: int = 4096):
+        self.num_bins = int(num_bins)
+        self.pos = np.zeros(self.num_bins, np.float64)
+        self.neg = np.zeros(self.num_bins, np.float64)
+
+    def update(self, margins, labels, weights) -> None:
+        s = np.asarray(margins, np.float64)
+        y = np.asarray(labels, np.float64)
+        w = np.asarray(weights, np.float64)
+        real = w > 0
+        if not real.any():
+            return
+        s, y, w = s[real], y[real], w[real]
+        # monotone squash to (0, 1); stable for |z| large
+        p = np.where(s >= 0, 1.0 / (1.0 + np.exp(-s)),
+                     np.exp(np.minimum(s, 0)) / (1.0 + np.exp(np.minimum(s, 0))))
+        b = np.clip((p * self.num_bins).astype(np.int64), 0, self.num_bins - 1)
+        np.add.at(self.pos, b, np.where(y > 0.5, w, 0.0))
+        np.add.at(self.neg, b, np.where(y <= 0.5, w, 0.0))
+
+    def merge(self, other: "StreamingAUC") -> "StreamingAUC":
+        assert other.num_bins == self.num_bins
+        self.pos += other.pos
+        self.neg += other.neg
+        return self
+
+    def result(self) -> float:
+        wp = self.pos.sum()
+        wn = self.neg.sum()
+        if wp <= 0 or wn <= 0:
+            return float("nan")  # degenerate input, like the exact path
+        neg_below = np.cumsum(self.neg) - self.neg
+        u = np.sum(self.pos * (neg_below + 0.5 * self.neg))
+        return float(u / (wp * wn))
+
+
+class StreamingRMSE:
+    """Exact weighted RMSE over mean-space predictions, chunk by chunk."""
+
+    def __init__(self):
+        self.sq_sum = 0.0
+        self.w_sum = 0.0
+
+    def update(self, predictions, labels, weights) -> None:
+        p = np.asarray(predictions, np.float64)
+        y = np.asarray(labels, np.float64)
+        w = np.asarray(weights, np.float64)
+        d = p - y
+        self.sq_sum += float(np.sum(w * d * d))
+        self.w_sum += float(np.sum(w))
+
+    def result(self) -> float:
+        return float(np.sqrt(self.sq_sum / max(self.w_sum, 1e-30)))
+
+
+class StreamingMeanLoss:
+    """Exact weighted mean pointwise loss (margins in, like the
+    evaluators in metrics.py)."""
+
+    def __init__(self, loss: PointwiseLoss):
+        self.loss = loss
+        self.loss_sum = 0.0
+        self.w_sum = 0.0
+
+    def update(self, margins, labels, weights) -> None:
+        import jax.numpy as jnp
+
+        w = jnp.asarray(weights)
+        total = jnp.sum(w * self.loss.value(jnp.asarray(margins),
+                                            jnp.asarray(labels)))
+        self.loss_sum += float(total)
+        self.w_sum += float(np.sum(np.asarray(weights, np.float64)))
+
+    def result(self) -> float:
+        return float(self.loss_sum / max(self.w_sum, 1e-30))
+
+
+def glm_streaming_metrics(task, loss: PointwiseLoss):
+    """The GLM driver's metric set (driver._metrics_for) as streaming
+    accumulators: {metric_name: (accumulator, space)} where space is
+    "margin" or "mean" — the caller feeds margins and mean-space
+    predictions per chunk via :func:`update_glm_metrics`."""
+    from photon_ml_tpu.task import TaskType
+
+    accs: Dict[str, object] = {f"{loss.name}_loss": StreamingMeanLoss(loss)}
+    if task in (TaskType.LOGISTIC_REGRESSION,
+                TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM):
+        accs["AUC"] = StreamingAUC()
+    if task in (TaskType.LINEAR_REGRESSION, TaskType.POISSON_REGRESSION):
+        accs["RMSE"] = StreamingRMSE()
+    return accs
+
+
+def update_glm_metrics(accs: Dict[str, object], loss: PointwiseLoss,
+                       margins, labels, weights) -> None:
+    """Fold one chunk into every accumulator of a glm_streaming_metrics
+    set. Mean-space metrics (RMSE) apply the loss mean function here, the
+    same transform the in-memory driver applies before evaluating."""
+    for name, acc in accs.items():
+        if isinstance(acc, StreamingRMSE):
+            import jax.numpy as jnp
+
+            acc.update(loss.mean(jnp.asarray(margins)), labels, weights)
+        else:
+            acc.update(margins, labels, weights)
+
+
+def finalize_metrics(accs: Dict[str, object]) -> Dict[str, float]:
+    return {name: acc.result() for name, acc in accs.items()}

@@ -174,6 +174,32 @@ def _build_entity_tables(
     return entity_indexes, entity_codes
 
 
+def record_response(r: dict, is_response_required: bool = True) -> float:
+    """Response from "response" or "label" field — the single definition
+    of the record-level response rule (DataProcessingUtils.scala:57-143),
+    shared by the in-memory builder and the streaming GAME scan/stage
+    passes (game/streaming.py)."""
+    if "response" in r and r["response"] is not None:
+        return float(r["response"])
+    if "label" in r and r["label"] is not None:
+        return float(r["label"])
+    if is_response_required:
+        raise ValueError("record missing response/label field")
+    return 0.0
+
+
+def record_entity_id(r: dict, id_type: str) -> str:
+    """Entity id from a top-level field or metadataMap, stringified —
+    shared with the streaming GAME passes like :func:`record_response`."""
+    v = r.get(id_type)
+    if v is None:
+        meta = r.get("metadataMap") or {}
+        v = meta.get(id_type)
+    if v is None:
+        raise ValueError(f"record missing id {id_type!r}")
+    return str(v)
+
+
 def build_game_dataset(
     records: Iterable[dict],
     shard_configs: Sequence[FeatureShardConfiguration],
@@ -201,22 +227,10 @@ def build_game_dataset(
         raise ValueError("empty GAME dataset")
 
     def response_of(r):
-        if "response" in r and r["response"] is not None:
-            return float(r["response"])
-        if "label" in r and r["label"] is not None:
-            return float(r["label"])
-        if is_response_required:
-            raise ValueError("record missing response/label field")
-        return 0.0
+        return record_response(r, is_response_required)
 
     def id_of(r, id_type):
-        v = r.get(id_type)
-        if v is None:
-            meta = r.get("metadataMap") or {}
-            v = meta.get(id_type)
-        if v is None:
-            raise ValueError(f"record missing id {id_type!r}")
-        return str(v)
+        return record_entity_id(r, id_type)
 
     # Build or reuse per-shard index maps.
     imaps: Dict[str, IndexMap] = {}

@@ -1,0 +1,1345 @@
+"""Out-of-core GAME training: streamed coordinate descent over spilled
+chunks under an explicit host-memory budget.
+
+Reference: the whole reference pipeline is passes over RDDs — prepare
+(DataProcessingUtils.scala), per-entity grouping
+(RandomEffectDataSet.scala:169-369), coordinate updates
+(CoordinateDescent.scala:130-262) — with Spark spilling anything larger
+than executor memory to disk. The in-memory path here
+(game/coordinate_descent.py) instead stages the WHOLE train set in host
+RAM, which caps GAME at dataset <= RAM. This module restores the
+out-of-core shape on one host:
+
+- **Scan pass** (:func:`scan_game_stream`): one bounded pass over the
+  Avro files collecting per-shard vocabularies, entity indexes, row
+  counts and staging widths — O(model) memory, never O(dataset).
+- **Stage pass** (:func:`stage_game_stream`): rows stream once into
+  fixed-shape chunks spilled to scratch (:class:`GameChunkStore`, the
+  GAME analog of io.streaming's _DiskChunkStore) whose row budget comes
+  from ``--stream-memory-budget``.
+- **Streamed CD** (:class:`StreamingCoordinateDescent`): the fixed
+  effect trains through a StreamingGLMObjective-shaped chunk objective
+  with the residual folded into offsets chunk by chunk; random effects
+  solve bucket-SEGMENT by segment from a disk spill of the per-entity
+  grouping (:class:`SpilledREBuckets` — the groupByKey shuffle as a
+  budget-bounded scatter into disk-backed blocks, no sort); scores and
+  residuals live on disk per chunk (:class:`ScoreStore`) — the
+  KeyValueScore currency never needs an [n]-resident host array.
+
+Peak host memory is bounded by one staged chunk + one bucket segment +
+the models themselves (coefficients, banks, vocabularies — the parts
+that must be resident to be trained at all).
+
+Scope gates (validated up front, mirrored in the driver): IDENTITY
+random-effect projector (the local space IS the shard space, so chunk
+rows need no per-entity re-indexing pass), no reservoir cap on active
+data (the cap's sampling would need a second grouped pass), single
+process, plain (non-factored) coordinates.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_ml_tpu.game.config import (
+    FeatureShardConfiguration,
+    FixedEffectDataConfiguration,
+    ProjectorType,
+    RandomEffectDataConfiguration,
+)
+from photon_ml_tpu.game.data import (
+    EntityIndex,
+    _padded_width,
+    record_entity_id,
+    record_response,
+)
+from photon_ml_tpu.io.streaming import (
+    make_spill_dir,
+    sparse_row_bytes,
+    stream_budget_rows,
+    unregister_spill_dir,
+)
+from photon_ml_tpu.utils.index_map import IndexMap, feature_key, intercept_key
+from photon_ml_tpu.utils.logging_util import PhotonLogger
+
+
+# ---------------------------------------------------------------------------
+# scan pass
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class GameStreamStats:
+    """One-scan results fixing every later pass's shapes."""
+
+    num_rows: int
+    shard_nnz: Dict[str, int]  # padded per-row width per shard (incl icept)
+    # ACTIVE (weight > 0) row count per entity code, per RE type — fixes
+    # the bucket capacities without a grouping pass
+    entity_counts: Dict[str, np.ndarray]
+
+
+def _game_files(paths) -> List[str]:
+    from photon_ml_tpu.io.paths import expand_input_paths
+
+    files = sorted(
+        expand_input_paths(list(paths), lambda fn: fn.endswith(".avro"))
+    )
+    if not files:
+        raise ValueError(f"no .avro inputs under {paths!r}")
+    return files
+
+
+def _stream_records(paths):
+    """Record stream, ONE file resident at a time (python codec; the
+    native column decoder holds whole-file columns either way, so the
+    bounded unit is identical)."""
+    from photon_ml_tpu.io.avro_codec import read_avro_records
+
+    for path in _game_files(paths):
+        yield from read_avro_records([path])
+
+
+def scan_game_stream(
+    paths,
+    shard_configs: Sequence[FeatureShardConfiguration],
+    re_types: Sequence[str],
+    *,
+    index_maps: Optional[Mapping[str, IndexMap]] = None,
+) -> Tuple[Dict[str, IndexMap], Dict[str, EntityIndex], GameStreamStats]:
+    """One bounded pass: per-shard vocabularies (skipped per shard when a
+    prebuilt map is given), entity id sets + active counts, row count and
+    per-shard max nnz. Entity codes come out IDENTICAL to the in-memory
+    builder's (EntityIndex.build sorts distinct ids), and IndexMap.build
+    sorts keys, so the streamed fit trains in the same index space as the
+    in-memory fit over the same files."""
+    key_sets: Dict[str, set] = {
+        cfg.shard_id: set()
+        for cfg in shard_configs
+        if index_maps is None or cfg.shard_id not in index_maps
+    }
+    max_live: Dict[str, int] = {cfg.shard_id: 0 for cfg in shard_configs}
+    id_counts: Dict[str, Dict[str, int]] = {t: {} for t in re_types}
+    num_rows = 0
+    for r in _stream_records(paths):
+        wgt_v = r.get("weight")
+        w = 1.0 if wgt_v is None else float(wgt_v)
+        for cfg in shard_configs:
+            live = 0
+            for bag in cfg.feature_bags:
+                for f in r.get(bag) or []:
+                    live += 1
+                    if cfg.shard_id in key_sets:
+                        key_sets[cfg.shard_id].add(
+                            feature_key(f["name"], f["term"])
+                        )
+            max_live[cfg.shard_id] = max(max_live[cfg.shard_id], live)
+        for t in re_types:
+            rid = record_entity_id(r, t)
+            c = id_counts[t]
+            c[rid] = c.get(rid, 0) + (1 if w > 0 else 0)
+        num_rows += 1
+    if num_rows == 0:
+        raise ValueError("empty GAME dataset")
+    imaps: Dict[str, IndexMap] = {}
+    for cfg in shard_configs:
+        if index_maps is not None and cfg.shard_id in index_maps:
+            imaps[cfg.shard_id] = index_maps[cfg.shard_id]
+        else:
+            imaps[cfg.shard_id] = IndexMap.build(
+                iter(key_sets[cfg.shard_id]), add_intercept=cfg.add_intercept
+            )
+    entity_indexes: Dict[str, EntityIndex] = {}
+    entity_counts: Dict[str, np.ndarray] = {}
+    for t in re_types:
+        eidx = EntityIndex.build(t, id_counts[t].keys())
+        entity_indexes[t] = eidx
+        entity_counts[t] = np.asarray(
+            [id_counts[t][rid] for rid in eidx.ids], np.int64
+        )
+    shard_nnz = {
+        cfg.shard_id: _padded_width(
+            max_live[cfg.shard_id] + (1 if cfg.add_intercept else 0), 8
+        )
+        for cfg in shard_configs
+    }
+    return imaps, entity_indexes, GameStreamStats(
+        num_rows=num_rows, shard_nnz=shard_nnz, entity_counts=entity_counts
+    )
+
+
+def game_row_bytes(
+    shard_nnz: Mapping[str, int], num_re_types: int
+) -> int:
+    """Staged bytes per row of one GAME chunk: every shard's padded
+    sparse slots + label/offset/weight + one int32 code per RE type."""
+    return (
+        sum(sparse_row_bytes(k) - 12 for k in shard_nnz.values())
+        + 12
+        + 4 * num_re_types
+    )
+
+
+# ---------------------------------------------------------------------------
+# spilled stores
+# ---------------------------------------------------------------------------
+
+
+class GameChunkStore:
+    """Fixed-shape staged GAME chunks spilled to scratch: labels/offsets/
+    weights [R], one int32 entity-code column per RE type, one padded
+    sparse (ix, v) pair per feature shard. The final chunk pads with
+    weight-0 rows (inert in every consumer); global row id of chunk i's
+    row j is ``i * R + j`` — the join key between chunks, score stores
+    and bucket row indexes."""
+
+    def __init__(
+        self,
+        rows_per_chunk: int,
+        shard_nnz: Mapping[str, int],
+        re_types: Sequence[str],
+        spill_dir: Optional[str] = None,
+    ):
+        self.R = int(rows_per_chunk)
+        self.shard_nnz = dict(shard_nnz)
+        self.re_types = list(re_types)
+        self.dir = make_spill_dir("photon-game-spill-", spill_dir)
+        self.count = 0
+        self.num_real_rows = 0
+        self._fields = (
+            ["lab", "off", "wgt"]
+            + [f"code__{t}" for t in self.re_types]
+            + [x for s in self.shard_nnz for x in (f"ix__{s}", f"v__{s}")]
+        )
+        self._writers = {
+            f: open(os.path.join(self.dir, f + ".bin"), "wb")
+            for f in self._fields
+        }
+        self._mm: Optional[Dict[str, np.memmap]] = None
+
+    def _shape(self, field: str) -> Tuple[int, ...]:
+        if field.startswith(("ix__", "v__")):
+            return (self.R, self.shard_nnz[field.split("__", 1)[1]])
+        return (self.R,)
+
+    def _dtype(self, field: str):
+        return (
+            np.int32
+            if field.startswith(("ix__", "code__"))
+            else np.float32
+        )
+
+    def append(self, arrays: Mapping[str, np.ndarray], real_rows: int) -> None:
+        for f in self._fields:
+            a = np.ascontiguousarray(arrays[f], self._dtype(f))
+            assert a.shape == self._shape(f), (f, a.shape)
+            self._writers[f].write(a.tobytes())
+        self.count += 1
+        self.num_real_rows += int(real_rows)
+
+    def finalize(self) -> None:
+        for w in self._writers.values():
+            w.close()
+        self._mm = {
+            f: np.memmap(
+                os.path.join(self.dir, f + ".bin"),
+                self._dtype(f), "r", shape=(self.count,) + self._shape(f),
+            )
+            for f in self._fields
+        }
+
+    def chunk(self, i: int) -> Dict[str, np.ndarray]:
+        """Materialize ONE chunk's arrays (copies — bounded by R rows)."""
+        assert self._mm is not None, "finalize() the store before reading"
+        return {f: np.array(self._mm[f][i]) for f in self._fields}
+
+    @property
+    def num_rows_padded(self) -> int:
+        return self.count * self.R
+
+    def score_store(self, name: str) -> "ScoreStore":
+        return ScoreStore(self.dir, name, self.count, self.R)
+
+    def close(self) -> None:
+        import shutil
+
+        for w in self._writers.values():
+            if not w.closed:
+                w.close()
+        self._mm = None
+        unregister_spill_dir(self.dir)
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class ScoreStore:
+    """One coordinate's row-aligned scores as a [num_chunks, R] float32
+    disk file — the KeyValueScore currency spilled per chunk. Random
+    access by global row id goes through the flat memmap view (the RE
+    bucket residual gather), sequential access per chunk through
+    get/set_chunk. Lives inside its GameChunkStore's scratch dir, so the
+    atexit sweep covers it too."""
+
+    def __init__(self, base_dir: str, name: str, num_chunks: int, R: int):
+        self.path = os.path.join(base_dir, f"score__{name}.bin")
+        self.num_chunks, self.R = num_chunks, R
+        self._mm = np.memmap(
+            self.path, np.float32, "w+", shape=(num_chunks, R)
+        )  # zero-initialized: matches score(initial zero models) exactly
+
+    def get_chunk(self, i: int) -> np.ndarray:
+        return np.array(self._mm[i])
+
+    def set_chunk(self, i: int, scores) -> None:
+        self._mm[i] = np.asarray(scores, np.float32)
+
+    def flat(self) -> np.ndarray:
+        """[num_chunks * R] memmap view for global-row-id gathers."""
+        return self._mm.reshape(-1)
+
+
+def stage_game_stream(
+    paths,
+    shard_configs: Sequence[FeatureShardConfiguration],
+    re_types: Sequence[str],
+    index_maps: Mapping[str, IndexMap],
+    entity_indexes: Mapping[str, EntityIndex],
+    stats: GameStreamStats,
+    *,
+    rows_per_chunk: int,
+    spill_dir: Optional[str] = None,
+    strict_ids: bool = True,
+    reservoir_rows: int = 0,
+    seed: int = 0,
+) -> Tuple[GameChunkStore, Optional[Dict[str, np.ndarray]]]:
+    """Stream rows once into a spilled GameChunkStore. ``strict_ids``
+    False maps entity ids absent from ``entity_indexes`` to code -1
+    instead of raising — the validation staging mode, where unseen
+    entities score 0 (the reference's outer join on idTypeToValueMap).
+
+    ``reservoir_rows``: optional algorithm-R uniform sample of REAL rows
+    (labels/offsets/weights + every shard's padded features — the GAME
+    diagnostics reservoir). The caller byte-budgets the row count with
+    io.streaming.budgeted_rows over :func:`game_row_bytes`, so wide
+    multi-shard rows scale the sample DOWN exactly like the GLM driver's
+    reservoir."""
+    R = int(rows_per_chunk)
+    store = GameChunkStore(R, stats.shard_nnz, re_types, spill_dir)
+    icepts = {}
+    for cfg in shard_configs:
+        imap = index_maps[cfg.shard_id]
+        icepts[cfg.shard_id] = (
+            imap.get_index(intercept_key()) if cfg.add_intercept else -1
+        )
+    rng = np.random.default_rng(seed)
+    K = int(reservoir_rows)
+    res = None
+    if K:
+        res = {
+            "lab": np.zeros(K, np.float32),
+            "off": np.zeros(K, np.float32),
+            "wgt": np.zeros(K, np.float32),
+        }
+        for sid, k in stats.shard_nnz.items():
+            res[f"ix__{sid}"] = np.zeros((K, k), np.int32)
+            res[f"v__{sid}"] = np.zeros((K, k), np.float32)
+    seen_real = 0
+
+    def new_bufs():
+        bufs = {
+            "lab": np.zeros(R, np.float32),
+            "off": np.zeros(R, np.float32),
+            "wgt": np.zeros(R, np.float32),
+        }
+        for t in re_types:
+            bufs[f"code__{t}"] = np.full(R, -1, np.int32)
+        for sid, k in stats.shard_nnz.items():
+            bufs[f"ix__{sid}"] = np.zeros((R, k), np.int32)
+            bufs[f"v__{sid}"] = np.zeros((R, k), np.float32)
+        return bufs
+
+    bufs = new_bufs()
+    fill = 0
+    records = _stream_records(paths)
+    from photon_ml_tpu.io.streaming import _prefetched
+    from photon_ml_tpu.parallel.overlap import overlap_enabled
+
+    if overlap_enabled() and (os.cpu_count() or 1) > 1:
+        # decode-ahead through the existing prefetch pipeline: the worker
+        # decodes/normalizes ahead while this thread scatters into the
+        # staging buffers (multicore-gated exactly like iter_chunks)
+        records = _prefetched(records, depth=2 * R)
+    for r in records:
+        bufs["lab"][fill] = record_response(r)
+        off_v = r.get("offset")
+        wgt_v = r.get("weight")
+        bufs["off"][fill] = 0.0 if off_v is None else float(off_v)
+        w = 1.0 if wgt_v is None else float(wgt_v)
+        bufs["wgt"][fill] = w
+        for t in re_types:
+            rid = record_entity_id(r, t)
+            code = entity_indexes[t].code_of.get(rid, -1)
+            if code < 0 and strict_ids:
+                raise ValueError(
+                    f"entity id {rid!r} of type {t!r} missing from the "
+                    "scan-pass index (inputs changed between passes?)"
+                )
+            bufs[f"code__{t}"][fill] = code
+        for cfg in shard_configs:
+            imap = index_maps[cfg.shard_id]
+            s = 0
+            ix_row = bufs[f"ix__{cfg.shard_id}"][fill]
+            v_row = bufs[f"v__{cfg.shard_id}"][fill]
+            ix_row[:] = 0
+            v_row[:] = 0.0
+            for bag in cfg.feature_bags:
+                for f in r.get(bag) or []:
+                    j = imap.get_index(feature_key(f["name"], f["term"]))
+                    if j >= 0:
+                        ix_row[s] = j
+                        v_row[s] = float(f["value"])
+                        s += 1
+            ic = icepts[cfg.shard_id]
+            if ic >= 0:
+                ix_row[s] = ic
+                v_row[s] = 1.0
+        if res is not None and w > 0:
+            # sequential algorithm R over real rows
+            seen_real += 1
+            if seen_real <= K:
+                slot = seen_real - 1
+            else:
+                slot = int(rng.integers(0, seen_real))
+                slot = slot if slot < K else -1
+            if slot >= 0:
+                res["lab"][slot] = bufs["lab"][fill]
+                res["off"][slot] = bufs["off"][fill]
+                res["wgt"][slot] = w
+                for sid in stats.shard_nnz:
+                    res[f"ix__{sid}"][slot] = bufs[f"ix__{sid}"][fill]
+                    res[f"v__{sid}"][slot] = bufs[f"v__{sid}"][fill]
+        fill += 1
+        if fill == R:
+            store.append(bufs, real_rows=R)
+            bufs = new_bufs()
+            fill = 0
+    if fill:
+        store.append(bufs, real_rows=fill)
+    store.finalize()
+    if res is not None:
+        k_eff = min(seen_real, K)
+        res = {k: a[:k_eff] for k, a in res.items()}
+    return store, res
+
+
+# ---------------------------------------------------------------------------
+# spilled random-effect grouping
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _REBucketSegment:
+    """One disk-backed slice of a capacity class: at most
+    ``max_entities`` consecutive entity codes sharing sample capacity S."""
+
+    entity_codes: np.ndarray  # int32 [E_seg], ascending
+    capacity: int
+    dir: str
+
+    def arrays(self, k: int, mode: str = "r+") -> Dict[str, np.ndarray]:
+        E, S = len(self.entity_codes), self.capacity
+        shapes = {
+            "rows": ((E, S), np.int32),
+            "ix": ((E, S, k), np.int32),
+            "v": ((E, S, k), np.float32),
+            "lab": ((E, S), np.float32),
+            "off": ((E, S), np.float32),
+            "wgt": ((E, S), np.float32),
+        }
+        return {
+            f: np.memmap(
+                os.path.join(self.dir, f + ".bin"), dt, mode, shape=shp
+            )
+            for f, (shp, dt) in shapes.items()
+        }
+
+
+class SpilledREBuckets:
+    """Per-entity grouped active data for ONE random-effect coordinate,
+    spilled to disk in budget-bounded segments.
+
+    The in-memory builder's groupByKey (stable sort + flat scatter,
+    random_effect_data.py) becomes a direct scatter into disk-backed
+    [E_seg, S, k] blocks: entity counts are known from the scan pass, so
+    each entity's (segment, slot) is precomputed and one pass over the
+    staged chunks writes every sample into place — no sort, no resident
+    [n, k] table. Entity order inside a capacity class is ascending code,
+    identical to the in-memory buckets, and rows fill in ascending global
+    row id (chunks stream in order), identical to the stable sort.
+
+    ``segment_budget_bytes`` caps the bytes any one segment materializes
+    when solved (the in-memory path's single [E_b, S, k] class block can
+    exceed host RAM at out-of-core scale); a segment always holds at
+    least one entity.
+    """
+
+    def __init__(
+        self,
+        store: GameChunkStore,
+        re_type: str,
+        shard_id: str,
+        counts: np.ndarray,
+        *,
+        segment_budget_bytes: int = 1 << 30,
+    ):
+        self.store = store
+        self.re_type = re_type
+        self.shard_id = shard_id
+        self.k = store.shard_nnz[shard_id]
+        self.num_entities = len(counts)
+        E = self.num_entities
+        caps = np.zeros(E, np.int64)
+        nz = counts > 0
+        caps[nz] = 1 << np.ceil(
+            np.log2(np.maximum(counts[nz], 1))
+        ).astype(np.int64)
+        self.num_active_rows = int(counts.sum())
+        seg_of = np.full(E, -1, np.int64)
+        slot_of = np.zeros(E, np.int64)
+        self.segments: List[_REBucketSegment] = []
+        per_entity = lambda S: S * (self.k * 8 + 16)  # noqa: E731
+        for S in sorted(set(caps[nz].tolist())):
+            members = np.nonzero(caps == S)[0]
+            max_e = max(1, int(segment_budget_bytes // per_entity(int(S))))
+            for lo in range(0, len(members), max_e):
+                seg_members = members[lo:lo + max_e]
+                seg_dir = os.path.join(
+                    store.dir,
+                    f"re__{re_type}__seg{len(self.segments)}",
+                )
+                os.makedirs(seg_dir, exist_ok=True)
+                seg = _REBucketSegment(
+                    entity_codes=seg_members.astype(np.int32),
+                    capacity=int(S),
+                    dir=seg_dir,
+                )
+                arrs = seg.arrays(self.k, mode="w+")
+                arrs["rows"][:] = -1  # memmaps start zeroed; rows pad -1
+                for a in arrs.values():
+                    a.flush()
+                seg_of[seg_members] = len(self.segments)
+                slot_of[seg_members] = np.arange(len(seg_members))
+                self.segments.append(seg)
+        self._seg_of, self._slot_of = seg_of, slot_of
+        self._fill_pass()
+
+    def _fill_pass(self) -> None:
+        """Scatter every valid staged row into its entity's (segment,
+        slot, rank) — one chunk resident at a time, writes through the
+        segment memmaps."""
+        st = self.store
+        fill = np.zeros(self.num_entities, np.int64)
+        handles = [seg.arrays(self.k) for seg in self.segments]
+        for ci in range(st.count):
+            c = st.chunk(ci)
+            codes = c[f"code__{self.re_type}"]
+            valid = (codes >= 0) & (c["wgt"] > 0)
+            rows = np.nonzero(valid)[0]
+            if not len(rows):
+                continue
+            e = codes[rows].astype(np.int64)
+            # within-chunk occurrence rank per entity (rows ascend, so
+            # fill order == ascending global row id)
+            order = np.argsort(e, kind="stable")
+            e_s = e[order]
+            first = np.searchsorted(e_s, e_s, side="left")
+            occ = np.empty(len(rows), np.int64)
+            occ[order] = np.arange(len(rows)) - first
+            rank = fill[e] + occ
+            np.add.at(fill, e, 1)
+            gids = (ci * st.R + rows).astype(np.int32)
+            ix = c[f"ix__{self.shard_id}"][rows]
+            v = c[f"v__{self.shard_id}"][rows]
+            for si in np.unique(self._seg_of[e]):
+                m = self._seg_of[e] == si
+                sl = self._slot_of[e[m]]
+                rk = rank[m]
+                h = handles[si]
+                h["rows"][sl, rk] = gids[m]
+                h["ix"][sl, rk] = ix[m]
+                h["v"][sl, rk] = v[m]
+                h["lab"][sl, rk] = c["lab"][rows[m]]
+                h["off"][sl, rk] = c["off"][rows[m]]
+                h["wgt"][sl, rk] = c["wgt"][rows[m]]
+        for h in handles:
+            for a in h.values():
+                a.flush()
+
+    def iter_segments(self):
+        """Yield (entity_codes, arrays) with arrays MATERIALIZED (one
+        segment resident at a time)."""
+        for seg in self.segments:
+            arrs = {
+                f: np.array(a) for f, a in seg.arrays(self.k).items()
+            }
+            yield seg.entity_codes, arrs
+
+
+# ---------------------------------------------------------------------------
+# streaming coordinates
+# ---------------------------------------------------------------------------
+
+
+class _StoreChunkObjective:
+    """GLM objective over one shard's staged chunks, residual folded into
+    offsets per chunk — the StreamingGLMObjective contract with the
+    GameChunkStore as the chunk source (the FE coordinate's residual is
+    dataSet.addScoresToOffsets, applied chunk-wise from disk)."""
+
+    def __init__(self, store: GameChunkStore, shard_id: str, dim: int, loss):
+        import jax
+
+        from photon_ml_tpu.ops.normalization import identity_context
+        from photon_ml_tpu.ops.objective import GLMObjective
+
+        self.store = store
+        self.shard_id = shard_id
+        self.dim = dim
+        self._objective = GLMObjective(loss, dim, identity_context())
+        self._partial = jax.jit(
+            lambda w, b: self._objective.value_and_gradient(w, b, 0.0)
+        )
+        self._hv = jax.jit(
+            lambda w, d, b: self._objective.hessian_vector(w, d, b, 0.0)
+        )
+        self._hd = jax.jit(
+            lambda w, b: self._objective.hessian_diagonal(w, b, 0.0)
+        )
+        self.residual: Optional[ScoreStore] = None
+
+    def _batches(self):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.data.batch import SparseBatch
+
+        st = self.store
+        for i in range(st.count):
+            c = st.chunk(i)
+            off = c["off"]
+            if self.residual is not None:
+                off = off + self.residual.get_chunk(i)
+            yield SparseBatch(
+                indices=jnp.asarray(c[f"ix__{self.shard_id}"]),
+                values=jnp.asarray(c[f"v__{self.shard_id}"]),
+                labels=jnp.asarray(c["lab"]),
+                offsets=jnp.asarray(off),
+                weights=jnp.asarray(c["wgt"]),
+            )
+
+    def value_and_gradient(self, w, l2_weight=0.0):
+        import jax.numpy as jnp
+
+        value = jnp.float32(0.0)
+        grad = jnp.zeros((self.dim,), jnp.float32)
+        for b in self._batches():
+            v, g = self._partial(w, b)
+            value = value + v
+            grad = grad + g
+        value = value + 0.5 * l2_weight * jnp.vdot(w, w)
+        return value, grad + l2_weight * w
+
+    def hessian_vector(self, w, direction, l2_weight=0.0):
+        import jax.numpy as jnp
+
+        hv = jnp.zeros((self.dim,), jnp.float32)
+        for b in self._batches():
+            hv = hv + self._hv(w, direction, b)
+        return hv + l2_weight * direction
+
+    def hessian_diagonal(self, w, l2_weight=0.0):
+        import jax.numpy as jnp
+
+        diag = jnp.zeros((self.dim,), jnp.float32)
+        for b in self._batches():
+            diag = diag + self._hd(w, b)
+        return diag + l2_weight
+
+
+@dataclass
+class StreamingFixedEffectCoordinate:
+    """FixedEffectCoordinate with a streamed chunk objective: the global
+    GLM solve walks the host-driven optimizers (one disk pass per
+    evaluation over the staged chunks), matching the in-memory in-jit
+    iterate sequence."""
+
+    name: str
+    store: GameChunkStore
+    problem: object  # GLMOptimizationProblem
+    feature_shard_id: str
+    reg_weight: float = 0.0
+
+    def __post_init__(self):
+        import jax
+
+        self._chunk_obj = _StoreChunkObjective(
+            self.store, self.feature_shard_id,
+            self.problem.objective.dim, self.problem.objective.loss,
+        )
+        self._score = jax.jit(
+            lambda w, ix, v: (v * w[ix]).sum(axis=-1)
+        )
+
+    @property
+    def dim(self) -> int:
+        return self.problem.objective.dim
+
+    def initialize_coefficients(self):
+        import jax.numpy as jnp
+
+        return jnp.zeros((self.dim,), jnp.float32)
+
+    def update(self, means, residual: Optional[ScoreStore]):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.optim.config import OptimizerType
+        from photon_ml_tpu.optim.host_lbfgs import (
+            minimize_lbfgs_host,
+            minimize_owlqn_host,
+        )
+        from photon_ml_tpu.optim.host_tron import minimize_tron_host
+
+        obj = self._chunk_obj
+        obj.residual = residual
+        p = self.problem
+        l1, l2 = p.regularization.split(self.reg_weight)
+        w0 = (
+            jnp.asarray(means)
+            if means is not None
+            else self.initialize_coefficients()
+        )
+        cfg = p.config
+        try:
+            if cfg.optimizer_type == OptimizerType.TRON:
+                result = minimize_tron_host(
+                    lambda w: obj.value_and_gradient(w, l2),
+                    lambda w, d: obj.hessian_vector(w, d, l2),
+                    w0, max_iter=cfg.max_iter, tol=cfg.tolerance,
+                    max_cg=cfg.tron_max_cg, box=p.box,
+                )
+            elif l1:
+                l1_mask = p._l1_mask()
+                result = minimize_owlqn_host(
+                    lambda w: obj.value_and_gradient(w, l2),
+                    w0, l1, max_iter=cfg.max_iter, tol=cfg.tolerance,
+                    history=cfg.lbfgs_history, l1_mask=l1_mask, box=p.box,
+                )
+            else:
+                result = minimize_lbfgs_host(
+                    lambda w: obj.value_and_gradient(w, l2),
+                    w0, max_iter=cfg.max_iter, tol=cfg.tolerance,
+                    history=cfg.lbfgs_history, box=p.box,
+                )
+            variances = None
+            if p.compute_variances:
+                from photon_ml_tpu.optim.problem import _VARIANCE_EPSILON
+
+                hd = obj.hessian_diagonal(result.coefficients, l2)
+                variances = 1.0 / (hd + _VARIANCE_EPSILON)
+        finally:
+            obj.residual = None
+        return result.coefficients, variances, result
+
+    def score_chunk(self, means, chunk: Dict[str, np.ndarray]):
+        import jax.numpy as jnp
+
+        return self._score(
+            jnp.asarray(means),
+            jnp.asarray(chunk[f"ix__{self.feature_shard_id}"]),
+            jnp.asarray(chunk[f"v__{self.feature_shard_id}"]),
+        )
+
+    def regularization_term(self, means) -> float:
+        import jax.numpy as jnp
+
+        l1, l2 = self.problem.regularization.split(self.reg_weight)
+        term = 0.5 * l2 * float(jnp.vdot(means, means))
+        if l1:
+            term += l1 * float(jnp.sum(jnp.abs(means)))
+        return term
+
+
+@dataclass
+class StreamingRandomEffectCoordinate:
+    """RandomEffectCoordinate whose per-entity grouping lives on disk:
+    each update streams the bucket segments through the EXISTING fused
+    bucket solvers (RandomEffectOptimizationProblem.update_bank, one
+    single-bucket dataset per segment) instead of holding a resident
+    bank of [E_b, S, k] blocks; the residual folds into each segment's
+    offsets via a global-row-id gather against the on-disk score store."""
+
+    name: str
+    store: GameChunkStore
+    spilled: SpilledREBuckets
+    problem: object  # RandomEffectOptimizationProblem
+    config: RandomEffectDataConfiguration
+    local_dim: int = 0  # IDENTITY projector: the shard dimension
+
+    def __post_init__(self):
+        import jax
+
+        self._score = jax.jit(
+            lambda bank, codes, ix, v, valid: jax.numpy.where(
+                valid,
+                (
+                    v
+                    * jax.numpy.take_along_axis(
+                        jax.numpy.take(
+                            bank, jax.numpy.maximum(codes, 0), axis=0
+                        ),
+                        ix, axis=1,
+                    )
+                ).sum(axis=-1),
+                0.0,
+            )
+        )
+
+    @property
+    def num_entities(self) -> int:
+        return self.spilled.num_entities
+
+    def initialize_bank(self):
+        import jax.numpy as jnp
+
+        return jnp.zeros(
+            (self.num_entities, self.local_dim), jnp.float32
+        )
+
+    def _mini_dataset(self, codes: np.ndarray, arrays, offsets):
+        from photon_ml_tpu.game.random_effect_data import (
+            RandomEffectBucket,
+            RandomEffectDataset,
+        )
+
+        bucket = RandomEffectBucket(
+            entity_codes=codes,
+            row_index=arrays["rows"],
+            indices=arrays["ix"],
+            values=arrays["v"],
+            labels=arrays["lab"],
+            offsets=offsets,
+            weights=arrays["wgt"],
+        )
+        D = self.local_dim
+        return RandomEffectDataset(
+            config=self.config,
+            num_entities=self.num_entities,
+            local_dim=D,
+            # identity projection as a broadcast VIEW — never materialized
+            projection=np.broadcast_to(
+                np.arange(D, dtype=np.int32), (self.num_entities, D)
+            ),
+            row_local_indices=np.zeros((0, 1), np.int32),
+            row_local_values=np.zeros((0, 1), np.float32),
+            row_entity_codes=np.zeros((0,), np.int32),
+            buckets=[bucket],
+            num_active_rows=self.spilled.num_active_rows,
+            num_passive_rows=0,
+        )
+
+    def update(self, bank, residual: Optional[ScoreStore]):
+        import jax.numpy as jnp
+
+        res_flat = residual.flat() if residual is not None else None
+        tracker = None
+        var_bank = None
+        if self.problem.compute_variances:
+            var_bank = getattr(self, "_var_bank", None)
+            if var_bank is None:
+                var_bank = jnp.zeros_like(bank)
+        for codes, arrays in self.spilled.iter_segments():
+            off = arrays["off"]
+            if res_flat is not None:
+                rows = arrays["rows"]
+                off = (off + np.where(
+                    rows >= 0, res_flat[np.maximum(rows, 0)], 0.0
+                )).astype(np.float32)
+            ds = self._mini_dataset(codes, arrays, off)
+            if var_bank is not None:
+                bank, tracker, seg_vars = self.problem.update_bank(
+                    bank, ds, with_variances=True
+                )
+                var_bank = var_bank.at[codes].set(seg_vars[codes])
+            else:
+                bank, tracker = self.problem.update_bank(bank, ds)
+        if var_bank is not None:
+            self._var_bank = var_bank
+        return bank, tracker
+
+    @property
+    def variances(self):
+        return getattr(self, "_var_bank", None)
+
+    def score_chunk(self, bank, chunk: Dict[str, np.ndarray]):
+        import jax.numpy as jnp
+
+        codes = chunk[f"code__{self.config.random_effect_type}"]
+        valid = (codes >= 0) & (chunk["wgt"] > 0)
+        sid = self.config.feature_shard_id
+        return self._score(
+            bank,
+            jnp.asarray(codes),
+            jnp.asarray(chunk[f"ix__{sid}"]),
+            jnp.asarray(chunk[f"v__{sid}"]),
+            jnp.asarray(valid),
+        )
+
+    def regularization_term(self, bank) -> float:
+        return self.problem.regularization_term(bank)
+
+
+# ---------------------------------------------------------------------------
+# streamed coordinate descent
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class StreamingGameResult:
+    models: Dict[str, object]  # name -> coefficients / bank (+ meta below)
+    game_model: object  # GameModel (FixedEffectModel / RandomEffectModel)
+    objective_history: List[float]
+    validation_history: List[Dict[str, float]] = field(default_factory=list)
+    best_metric: Optional[float] = None
+    trackers: Dict[str, List[object]] = field(default_factory=dict)
+
+
+class StreamingCoordinateDescent:
+    """Block coordinate descent over streaming coordinates: the
+    CoordinateDescent.run loop (residual = total - own, update, rescore,
+    objective) with every [n]-sized quantity living on disk per chunk.
+
+    The residual algebra runs chunk-wise: one scratch ScoreStore holds
+    ``total - own`` for the coordinate being updated, rebuilt per update
+    from the per-coordinate score stores (O(C) chunk passes per
+    iteration, same complexity class as the in-memory incremental
+    patching — disk-sequential instead of device-resident)."""
+
+    def __init__(
+        self,
+        coordinates: Dict[str, object],
+        store: GameChunkStore,
+        task,
+        *,
+        update_sequence: Optional[List[str]] = None,
+        validation_fn=None,
+        validation_metric: Optional[str] = None,
+        validation_maximize: bool = True,
+        logger: Optional[PhotonLogger] = None,
+    ):
+        self.coordinates = coordinates
+        self.store = store
+        self.task = task
+        self.update_sequence = update_sequence or list(coordinates)
+        unknown = set(self.update_sequence) - set(coordinates)
+        if unknown:
+            raise ValueError(
+                f"update sequence references unknown coordinates {unknown}"
+            )
+        self.validation_fn = validation_fn
+        self.validation_metric = validation_metric
+        self.validation_maximize = validation_maximize
+        self.logger = logger or PhotonLogger()
+        from photon_ml_tpu.ops.losses import loss_for_task
+
+        self._loss = loss_for_task(task)
+        import jax
+
+        self._chunk_loss = jax.jit(
+            lambda z, lab, w: (w * self._loss.value(z, lab)).sum()
+        )
+
+    def _state(self, name):
+        coord = self.coordinates[name]
+        if isinstance(coord, StreamingFixedEffectCoordinate):
+            return coord.initialize_coefficients()
+        return coord.initialize_bank()
+
+    def run(self, num_iterations: int) -> StreamingGameResult:
+        import jax.numpy as jnp
+
+        seq = self.update_sequence
+        states = {name: self._state(name) for name in seq}
+        variances: Dict[str, object] = {name: None for name in seq}
+        scores = {name: self.store.score_store(name) for name in seq}
+        residual = (
+            self.store.score_store("__residual__") if len(seq) > 1 else None
+        )
+        objective_history: List[float] = []
+        validation_history: List[Dict[str, float]] = []
+        trackers: Dict[str, List[object]] = {name: [] for name in seq}
+        best_metric = None
+        for it in range(num_iterations):
+            for name in seq:
+                coord = self.coordinates[name]
+                if residual is not None:
+                    for i in range(self.store.count):
+                        acc = np.zeros(self.store.R, np.float32)
+                        for other in seq:
+                            if other != name:
+                                acc += scores[other].get_chunk(i)
+                        residual.set_chunk(i, acc)
+                if isinstance(coord, StreamingFixedEffectCoordinate):
+                    means, var, tracker = coord.update(
+                        states[name], residual
+                    )
+                    states[name] = means
+                    variances[name] = var
+                else:
+                    states[name], tracker = coord.update(
+                        states[name], residual
+                    )
+                    variances[name] = coord.variances
+                trackers[name].append(tracker)
+                for i in range(self.store.count):
+                    scores[name].set_chunk(
+                        i, coord.score_chunk(states[name], self.store.chunk(i))
+                    )
+            objective = 0.0
+            for i in range(self.store.count):
+                c = self.store.chunk(i)
+                z = c["off"].astype(np.float64)
+                for name in seq:
+                    z = z + np.asarray(scores[name].get_chunk(i), np.float64)
+                objective += float(
+                    self._chunk_loss(
+                        jnp.asarray(z, jnp.float32),
+                        jnp.asarray(c["lab"]),
+                        jnp.asarray(c["wgt"]),
+                    )
+                )
+            for name in seq:
+                objective += self.coordinates[name].regularization_term(
+                    states[name]
+                )
+            objective_history.append(objective)
+            self.logger.info(
+                "streaming coordinate descent iter %d: objective=%g",
+                it + 1, objective,
+            )
+            if self.validation_fn is not None:
+                metrics = self.validation_fn(self.coordinates, states)
+                validation_history.append(metrics)
+                self.logger.info("iter %d validation: %s", it + 1, metrics)
+                if self.validation_metric is not None:
+                    m = metrics[self.validation_metric]
+                    if (
+                        best_metric is None
+                        or (self.validation_maximize and m > best_metric)
+                        or (not self.validation_maximize and m < best_metric)
+                    ):
+                        best_metric = m
+        game_model = self._export_model(states, variances)
+        return StreamingGameResult(
+            models=dict(states),
+            game_model=game_model,
+            objective_history=objective_history,
+            validation_history=validation_history,
+            best_metric=best_metric,
+            trackers=trackers,
+        )
+
+    @staticmethod
+    def score_states_chunk(coordinates, states, chunk) -> np.ndarray:
+        """Total model score of one (train or validation) chunk."""
+        total = np.zeros(len(chunk["lab"]), np.float32)
+        for name, coord in coordinates.items():
+            total = total + np.asarray(
+                coord.score_chunk(states[name], chunk), np.float32
+            )
+        return total
+
+    def _export_model(self, states, variances):
+        """States -> a GameModel of the standard model classes, so
+        save_game_model and the scoring driver work unchanged on a
+        streamed fit."""
+        from photon_ml_tpu.game.model import (
+            FixedEffectModel,
+            GameModel,
+            RandomEffectModel,
+        )
+        from photon_ml_tpu.models.coefficients import Coefficients
+
+        models = {}
+        for name, coord in self.coordinates.items():
+            if isinstance(coord, StreamingFixedEffectCoordinate):
+                models[name] = FixedEffectModel(
+                    coord.problem.create_model(
+                        Coefficients(states[name], variances.get(name))
+                    ),
+                    coord.feature_shard_id,
+                )
+            else:
+                models[name] = RandomEffectModel(
+                    states[name],
+                    coord._mini_dataset(
+                        np.zeros(0, np.int32),
+                        {
+                            "rows": np.full((0, 1), -1, np.int32),
+                            "ix": np.zeros((0, 1, 1), np.int32),
+                            "v": np.zeros((0, 1, 1), np.float32),
+                            "lab": np.zeros((0, 1), np.float32),
+                            "wgt": np.zeros((0, 1), np.float32),
+                        },
+                        np.zeros((0, 1), np.float32),
+                    ),
+                    coord.config.random_effect_type,
+                    coord.config.feature_shard_id,
+                    variances=variances.get(name),
+                )
+        return GameModel(models, self.task)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end streamed GAME training
+# ---------------------------------------------------------------------------
+
+
+def validate_streaming_game_configs(
+    re_data_configs: Mapping[str, RandomEffectDataConfiguration],
+) -> None:
+    """The streaming scope gates, raised with actionable messages (the
+    driver calls this at parse/validate time, tests directly)."""
+    import jax
+
+    if jax.process_count() > 1:
+        raise ValueError("streaming GAME training is single-process")
+    for name, cfg in re_data_configs.items():
+        if cfg.projector_type != ProjectorType.IDENTITY:
+            raise ValueError(
+                "streaming GAME training supports the IDENTITY projector "
+                f"only (coordinate {name!r} uses {cfg.projector_type}); "
+                "INDEX_MAP/RANDOM projections need a per-entity re-index "
+                "pass over the grouped data"
+            )
+        if cfg.active_data_upper_bound is not None:
+            raise ValueError(
+                "streaming GAME training does not support "
+                f"active-data-upper-bound (coordinate {name!r}): the "
+                "reservoir cap's without-replacement draw needs a second "
+                "grouped pass"
+            )
+
+
+def train_streaming_game(
+    paths,
+    shard_configs: Sequence[FeatureShardConfiguration],
+    fe_data_configs: Mapping[str, FixedEffectDataConfiguration],
+    re_data_configs: Mapping[str, RandomEffectDataConfiguration],
+    opt_combo: Mapping[str, object],  # name -> GLMOptimizationConfiguration
+    task,
+    *,
+    num_iterations: int = 1,
+    update_sequence: Optional[List[str]] = None,
+    memory_budget_bytes: int = 0,
+    spill_dir: Optional[str] = None,
+    index_maps: Optional[Mapping[str, IndexMap]] = None,
+    validate_paths=None,
+    evaluator_types=None,
+    compute_variance: bool = False,
+    diagnostic_reservoir_rows: int = 0,
+    diagnostic_reservoir_bytes: int = 256 << 20,
+    logger: Optional[PhotonLogger] = None,
+):
+    """End-to-end streamed GAME fit: scan -> stage -> streamed CD
+    [-> streamed validation]. Returns (StreamingGameResult, extras) where
+    extras carries the index maps / entity indexes / stats / stores the
+    driver needs for model output and metrics.
+
+    ``memory_budget_bytes`` (--stream-memory-budget) fixes BOTH the
+    staged-chunk row count and the random-effect segment byte cap; 0
+    keeps the default 65536-row chunks with 1 GiB segments.
+    """
+    logger = logger or PhotonLogger()
+    validate_streaming_game_configs(re_data_configs)
+    re_types = sorted(
+        {c.random_effect_type for c in re_data_configs.values()}
+    )
+    imaps, entity_indexes, stats = scan_game_stream(
+        paths, shard_configs, re_types, index_maps=index_maps
+    )
+    row_bytes = game_row_bytes(stats.shard_nnz, len(re_types))
+    rows_per_chunk = stream_budget_rows(
+        memory_budget_bytes, row_bytes, default_rows=65536
+    )
+    rows_per_chunk = int(min(rows_per_chunk, max(stats.num_rows, 8)))
+    seg_budget = memory_budget_bytes if memory_budget_bytes > 0 else (1 << 30)
+    logger.info(
+        "streaming GAME: %d rows, %d B/row -> %d rows/chunk, "
+        "%d B RE-segment budget",
+        stats.num_rows, row_bytes, rows_per_chunk, seg_budget,
+    )
+    reservoir_rows = 0
+    if diagnostic_reservoir_rows > 0:
+        from photon_ml_tpu.io.streaming import budgeted_rows
+
+        # the GLM driver's byte-budgeted reservoir, with the (multi-shard
+        # wide) staged GAME row as the unit
+        reservoir_rows = budgeted_rows(
+            diagnostic_reservoir_rows, diagnostic_reservoir_bytes, row_bytes
+        )
+        if reservoir_rows < diagnostic_reservoir_rows:
+            logger.info(
+                "GAME diagnostics reservoir scaled to %d rows "
+                "(%d B budget at %d B/row)",
+                reservoir_rows, diagnostic_reservoir_bytes, row_bytes,
+            )
+    store, sample = stage_game_stream(
+        paths, shard_configs, re_types, imaps, entity_indexes, stats,
+        rows_per_chunk=rows_per_chunk, spill_dir=spill_dir,
+        reservoir_rows=reservoir_rows,
+    )
+    from photon_ml_tpu.game.random_effect import (
+        RandomEffectOptimizationProblem,
+    )
+    from photon_ml_tpu.ops.losses import loss_for_task
+    from photon_ml_tpu.optim.problem import create_glm_problem
+    from photon_ml_tpu.utils.index_map import intercept_key as _ik
+
+    loss = loss_for_task(task)
+    coordinates: Dict[str, object] = {}
+    for name, dcfg in fe_data_configs.items():
+        ocfg = opt_combo[name]
+        imap = imaps[dcfg.feature_shard_id]
+        icept = imap.get_index(_ik())
+        coordinates[name] = StreamingFixedEffectCoordinate(
+            name=name,
+            store=store,
+            problem=create_glm_problem(
+                task, imap.size,
+                config=ocfg.optimizer_config,
+                regularization=ocfg.regularization,
+                compute_variances=compute_variance,
+                intercept_index=icept if icept >= 0 else None,
+            ),
+            feature_shard_id=dcfg.feature_shard_id,
+            reg_weight=ocfg.reg_weight,
+        )
+    for name, dcfg in re_data_configs.items():
+        ocfg = opt_combo[name]
+        spilled = SpilledREBuckets(
+            store, dcfg.random_effect_type, dcfg.feature_shard_id,
+            stats.entity_counts[dcfg.random_effect_type],
+            segment_budget_bytes=seg_budget,
+        )
+        coordinates[name] = StreamingRandomEffectCoordinate(
+            name=name,
+            store=store,
+            spilled=spilled,
+            problem=RandomEffectOptimizationProblem(
+                loss,
+                ocfg.optimizer_config,
+                ocfg.regularization,
+                reg_weight=ocfg.reg_weight,
+                compute_variances=compute_variance,
+            ),
+            config=dcfg,
+            local_dim=imaps[dcfg.feature_shard_id].size,
+        )
+
+    validation_fn = None
+    metric_name = None
+    vstore = None
+    maximize = True
+    if validate_paths:
+        vstore, _ = stage_game_stream(
+            validate_paths, shard_configs, re_types, imaps, entity_indexes,
+            stats, rows_per_chunk=rows_per_chunk, spill_dir=spill_dir,
+            strict_ids=False,
+        )
+        from photon_ml_tpu.evaluation import EvaluatorType
+        from photon_ml_tpu.evaluation.streaming import (
+            StreamingAUC,
+            StreamingMeanLoss,
+            StreamingRMSE,
+        )
+        from photon_ml_tpu.task import TaskType
+
+        evaluators = evaluator_types or [
+            EvaluatorType.parse(
+                "AUC" if task == TaskType.LOGISTIC_REGRESSION else "RMSE"
+            )
+        ]
+        for et in evaluators:
+            if et.is_sharded:
+                raise ValueError(
+                    f"streamed GAME validation does not support the "
+                    f"sharded evaluator {et.render()} (per-group metrics "
+                    "need a grouped pass over the validation stream)"
+                )
+        metric_name = evaluators[0].render()
+        maximize = evaluators[0].maximize
+        _LOSS_BY_NAME = {
+            "LOGISTIC_LOSS": "logistic", "SQUARED_LOSS": "squared",
+            "POISSON_LOSS": "poisson", "SMOOTHED_HINGE_LOSS": "hinge",
+        }
+
+        def validation_fn(coords, states):
+            accs = {}
+            for et in evaluators:
+                key = et.render()
+                if et.name == "AUC":
+                    accs[key] = ("margin", StreamingAUC())
+                elif et.name == "RMSE":
+                    accs[key] = ("mean", StreamingRMSE())
+                else:
+                    from photon_ml_tpu.evaluation.evaluator import (
+                        _LOSS_BY_NAME as _LOSSES,
+                    )
+
+                    accs[key] = ("margin", StreamingMeanLoss(_LOSSES[et.name]))
+            import jax.numpy as jnp
+
+            for i in range(vstore.count):
+                c = vstore.chunk(i)
+                z = (
+                    StreamingCoordinateDescent.score_states_chunk(
+                        coords, states, c
+                    )
+                    + c["off"]
+                )
+                for key, (space, acc) in accs.items():
+                    vals = (
+                        np.asarray(loss.mean(jnp.asarray(z)))
+                        if space == "mean"
+                        else z
+                    )
+                    acc.update(vals, c["lab"], c["wgt"])
+            return {key: acc.result() for key, (_, acc) in accs.items()}
+
+    cd = StreamingCoordinateDescent(
+        coordinates, store, task,
+        update_sequence=update_sequence,
+        validation_fn=validation_fn,
+        validation_metric=metric_name,
+        validation_maximize=maximize,
+        logger=logger,
+    )
+    result = cd.run(num_iterations)
+    extras = dict(
+        index_maps=imaps,
+        entity_indexes=entity_indexes,
+        stats=stats,
+        store=store,
+        validate_store=vstore,
+        rows_per_chunk=rows_per_chunk,
+        diagnostics_sample=sample,
+    )
+    return result, extras

@@ -384,6 +384,160 @@ class AvroInputDataFormat:
         max_nnz = max(max_live + (1 if self.add_intercept else 0), 1)
         return index_map, StreamStats(num_rows=num_rows, max_nnz=max_nnz)
 
+    def stream_scan_with_summary(self, paths, index_map: Optional[IndexMap] = None):
+        """ONE streaming pass collecting the vocabulary, the staging-shape
+        stats AND the colStats feature summary — the fused form of
+        ``stream_scan`` + ``io.streaming.streaming_summary``, which each
+        re-read (and re-decode) the whole train directory back to back in
+        the driver's preprocess stage. Moments accumulate host-side per
+        feature KEY (the vocabulary is not fixed until the pass ends) and
+        scatter into index order once the map exists; the final summary is
+        numerically the compute_summary/streaming_summary result up to
+        fp32-vs-fp64 accumulation order.
+
+        Returns ``(index_map, StreamStats, BasicStatisticalSummary)``.
+        Memory: one decoded file + O(vocabulary) moment arrays — the same
+        class as the vocabulary scan itself."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.data.stats import finalize_summary
+        from photon_ml_tpu.io.streaming import StreamStats
+
+        files = self.stream_files(paths)
+        collect_keys = index_map is None
+
+        # growing per-key moment table (amortized append; vocab-sized)
+        slot_of: Dict[str, int] = {}
+        cap = 1024
+        s1 = np.zeros(cap); s2 = np.zeros(cap); l1 = np.zeros(cap)
+        nnz = np.zeros(cap)
+        mx = np.full(cap, -np.inf); mn = np.full(cap, np.inf)
+
+        def _ensure(n):
+            nonlocal cap, s1, s2, l1, nnz, mx, mn
+            if n <= cap:
+                return
+            new_cap = max(n, cap * 2)
+            s1 = np.concatenate([s1, np.zeros(new_cap - cap)])
+            s2 = np.concatenate([s2, np.zeros(new_cap - cap)])
+            l1 = np.concatenate([l1, np.zeros(new_cap - cap)])
+            nnz = np.concatenate([nnz, np.zeros(new_cap - cap)])
+            mx = np.concatenate([mx, np.full(new_cap - cap, -np.inf)])
+            mn = np.concatenate([mn, np.full(new_cap - cap, np.inf)])
+            cap = new_cap
+
+        def _slot(key: str) -> int:
+            s = slot_of.get(key, -1)
+            if s < 0:
+                if not collect_keys and index_map.get_index(key) < 0:
+                    return -1  # prebuilt map drops this feature
+                s = len(slot_of)
+                slot_of[key] = s
+                _ensure(s + 1)
+            return s
+
+        num_rows = 0
+        real_rows = 0.0
+        max_live = 0
+        for path in files:
+            decoded = self.decode_file(path)
+            if decoded is not None:
+                m = decoded.num_records
+                sel = np.asarray([
+                    self.selected is None or s in self.selected
+                    for s in decoded.strings
+                ]) if len(decoded.strings) else np.zeros(0, bool)
+                slot_table = np.asarray([
+                    _slot(s) if ok else -1
+                    for s, ok in zip(decoded.strings, sel)
+                ], np.int64) if len(decoded.strings) else np.zeros(0, np.int64)
+                wgt = (
+                    decoded.f64("weight")
+                    if "weight" in decoded.plan.num_slots
+                    else np.ones(m)
+                )
+                wgt = np.where(np.isnan(wgt), 1.0, wgt)
+                real = wgt > 0
+                real_rows += float(real.sum())
+                row_ptr, key_ids, values = decoded.bag("features")
+                live = sel[key_ids] if len(key_ids) else np.zeros(0, bool)
+                counts = np.add.reduceat(
+                    np.concatenate([live.astype(np.int64), [0]]),
+                    row_ptr[:-1],
+                ) if m else np.zeros(0, np.int64)
+                widths = np.diff(row_ptr)
+                counts = np.where(widths > 0, counts, 0)
+                if len(counts):
+                    max_live = max(max_live, int(counts.max()))
+                num_rows += m
+                if len(key_ids):
+                    row_of = np.repeat(np.arange(m, dtype=np.int64), widths)
+                    ks = slot_table[key_ids]
+                    # value-0 entries are moment no-ops (s1 += 0, not
+                    # counted in nnz, excluded from max/min) — drop them
+                    keep = (ks >= 0) & real[row_of] & (values != 0)
+                    sl = ks[keep]
+                    v = values[keep].astype(np.float64)
+                    np.add.at(s1, sl, v)
+                    np.add.at(s2, sl, v * v)
+                    np.add.at(l1, sl, np.abs(v))
+                    np.add.at(nnz, sl, 1.0)
+                    np.maximum.at(mx, sl, v)
+                    np.minimum.at(mn, sl, v)
+            else:
+                for record in read_avro_records([path]):
+                    wgt_v = record.get("weight")
+                    w = 1.0 if wgt_v is None else float(wgt_v)
+                    real = w > 0
+                    real_rows += 1.0 if real else 0.0
+                    live = 0
+                    for key, value in self._record_pairs(record):
+                        live += 1
+                        s = _slot(key)
+                        if s >= 0 and real and value != 0:
+                            s1[s] += value
+                            s2[s] += value * value
+                            l1[s] += abs(value)
+                            nnz[s] += 1.0
+                            mx[s] = max(mx[s], value)
+                            mn[s] = min(mn[s], value)
+                    max_live = max(max_live, live)
+                    num_rows += 1
+        if collect_keys:
+            index_map = IndexMap.build(
+                iter(slot_of), add_intercept=self.add_intercept
+            )
+        dim = index_map.size
+        f_s1 = np.zeros(dim); f_s2 = np.zeros(dim); f_l1 = np.zeros(dim)
+        f_nnz = np.zeros(dim)
+        f_mx = np.full(dim, -np.inf); f_mn = np.full(dim, np.inf)
+        for key, s in slot_of.items():
+            j = index_map.get_index(key)
+            if j >= 0:
+                f_s1[j], f_s2[j], f_l1[j] = s1[s], s2[s], l1[s]
+                f_nnz[j], f_mx[j], f_mn[j] = nnz[s], mx[s], mn[s]
+        icept = self._stream_intercept(index_map)
+        if icept is not None and real_rows > 0:
+            # every real row carries the constant-1 intercept entry
+            f_s1[icept] = f_s2[icept] = f_l1[icept] = real_rows
+            f_nnz[icept] = real_rows
+            f_mx[icept] = f_mn[icept] = 1.0
+        summary = finalize_summary(
+            jnp.float32(real_rows),
+            jnp.asarray(f_s1, jnp.float32),
+            jnp.asarray(f_s2, jnp.float32),
+            jnp.asarray(f_l1, jnp.float32),
+            jnp.asarray(f_nnz, jnp.float32),
+            jnp.asarray(f_mx, jnp.float32),
+            jnp.asarray(f_mn, jnp.float32),
+        )
+        max_nnz = max(max_live + (1 if self.add_intercept else 0), 1)
+        return (
+            index_map,
+            StreamStats(num_rows=num_rows, max_nnz=max_nnz),
+            summary,
+        )
+
     def _index_map_from_decoded(self, decoded) -> IndexMap:
         keys = (
             key

@@ -51,6 +51,21 @@ def scan_stream(
     return fmt.stream_scan(paths, index_map=index_map)
 
 
+def scan_stream_with_summary(paths, fmt, *, index_map=None):
+    """Fused scan: ONE pass collecting the vocabulary, the shape stats AND
+    the colStats feature summary — formats without the fused hook (LibSVM)
+    fall back to the classic two passes (scan, then streamed summary).
+    Returns ``(index_map, StreamStats, summary)``; single-process only
+    (the multi-host driver path shards files and all-reduces moments
+    through :func:`streaming_summary` instead)."""
+    fused = getattr(fmt, "stream_scan_with_summary", None)
+    if fused is not None:
+        return fused(paths, index_map=index_map)
+    index_map, stats = scan_stream(paths, fmt, index_map=index_map)
+    summary, _ = streaming_summary(paths, fmt, index_map, stats)
+    return index_map, stats, summary
+
+
 def _pipelined_file_rows(files, fmt, index_map: IndexMap):
     """reader->decode stage of the populate pipeline: a worker thread
     decodes file i+1 (``fmt.decode_payload`` — the expensive whole-file
@@ -364,6 +379,84 @@ def streaming_summary(
     return summary, sample
 
 
+# Live spill scratch directories, swept at interpreter exit. __del__ alone
+# is not a cleanup contract: a driver exception that keeps the objective
+# alive in a traceback, or an exit while generators still hold frames,
+# skips finalizers and leaks multi-GB scratch. Every spill dir registers
+# here at creation and unregisters on close(); the atexit sweep removes
+# whatever is left. SIGTERM is covered when the process shuts down through
+# the normal exit path (the preemption guard's iteration-boundary stop);
+# a hard kill cannot run ANY handler — PHOTON_SPILL_DIR + an external
+# scratch sweeper remain the belt-and-braces for that.
+_LIVE_SPILL_DIRS: set = set()
+
+
+def _sweep_spill_dirs() -> None:
+    import shutil
+
+    for d in list(_LIVE_SPILL_DIRS):
+        _LIVE_SPILL_DIRS.discard(d)
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def register_spill_dir(path: str) -> None:
+    """Track a scratch directory for the atexit sweep (shared by every
+    disk-spill store: GLM chunk cache, GAME chunk/score/bucket stores)."""
+    import atexit
+
+    if not _LIVE_SPILL_DIRS:
+        atexit.register(_sweep_spill_dirs)
+    _LIVE_SPILL_DIRS.add(path)
+
+
+def unregister_spill_dir(path: str) -> None:
+    _LIVE_SPILL_DIRS.discard(path)
+
+
+def make_spill_dir(prefix: str, spill_dir: Optional[str] = None) -> str:
+    """Create + register a scratch directory. On hosts with a tmpfs /tmp
+    the default scratch is RAM-backed — point spill_dir (or
+    PHOTON_SPILL_DIR) at real disk for genuinely >RAM datasets."""
+    import os
+    import tempfile
+
+    base = spill_dir or os.environ.get("PHOTON_SPILL_DIR")
+    path = tempfile.mkdtemp(prefix=prefix, dir=base)
+    register_spill_dir(path)
+    return path
+
+
+def stream_budget_rows(
+    budget_bytes: int, bytes_per_row: int, *, default_rows: int = 65536,
+    min_rows: int = 8,
+) -> int:
+    """Rows-per-chunk under an explicit host-memory byte budget
+    (--stream-memory-budget): the staging chunk is the unit every
+    streaming stage holds resident, so its row count is budget // row
+    bytes, floored at ``min_rows`` so degenerate budgets still make
+    progress (the contract is then 'one minimal chunk'). budget <= 0
+    keeps the historical default chunk sizing."""
+    if budget_bytes is None or budget_bytes <= 0:
+        return default_rows
+    return max(min_rows, budget_bytes // max(1, bytes_per_row))
+
+
+def sparse_row_bytes(nnz_width: int) -> int:
+    """Staged bytes per row of one sparse chunk: int32 index + float32
+    value per slot, plus label/offset/weight."""
+    return max(1, nnz_width) * 8 + 12
+
+
+def budgeted_rows(max_rows: int, budget_bytes: int, bytes_per_row: int) -> int:
+    """Row count of a bounded in-memory sample (diagnostics reservoirs)
+    under a byte budget: wide rows scale the count DOWN instead of
+    allocating multiple GB on the host — the streaming paths' bounded-
+    memory contract (ADVICE.md round 5). Shared by the GLM driver's
+    reservoir (sparse_row_bytes rows) and the GAME driver's
+    (game.streaming.game_row_bytes rows)."""
+    return max(1, min(max_rows, budget_bytes // max(1, bytes_per_row)))
+
+
 class _DiskChunkStore:
     """Fixed-shape staged chunks spilled to a local scratch directory —
     the disk half of Spark's persist(MEMORY_AND_DISK)
@@ -377,14 +470,9 @@ class _DiskChunkStore:
         spill_dir: Optional[str] = None,
     ):
         import os
-        import tempfile
 
         self.R, self.W = rows_per_chunk, nnz_width
-        # On hosts with a tmpfs /tmp the default scratch is RAM-backed —
-        # point spill_dir (or PHOTON_SPILL_DIR) at real disk for genuinely
-        # >RAM datasets.
-        base = spill_dir or os.environ.get("PHOTON_SPILL_DIR")
-        self.dir = tempfile.mkdtemp(prefix="photon-stream-spill-", dir=base)
+        self.dir = make_spill_dir("photon-stream-spill-", spill_dir)
         self.count = 0
         self._writers = {
             f: open(os.path.join(self.dir, f + ".bin"), "wb")
@@ -443,6 +531,7 @@ class _DiskChunkStore:
         import shutil
 
         self.finalize()
+        unregister_spill_dir(self.dir)
         shutil.rmtree(self.dir, ignore_errors=True)
 
     def __del__(self):  # scratch must not outlive the objective
@@ -863,3 +952,166 @@ class StreamingGLMObjective:
             grad = jnp.asarray(total[1:], jnp.float32)
         value = value + 0.5 * l2_weight * jnp.vdot(w, w)
         return value, grad + l2_weight * w
+
+
+class FeatureShardedStreamingObjective:
+    """Streaming x feature-sharded composition: the >host-RAM dataset AND
+    the >single-chip-HBM coefficient vector at once — the north-star
+    combination the round-5 verdict named as the open frontier.
+
+    Rows stream through the SAME staged-chunk pipeline as
+    :class:`StreamingGLMObjective` (decode once, fixed-shape chunks,
+    mem/disk cache), but every staged chunk is RE-LAID-OUT per feature
+    block on the (data, model) mesh (feature_shard_sparse_batch) — the
+    per-chunk analog of the reference's hash-partitioned feature
+    vocabulary. Each objective evaluation folds one sharded program per
+    chunk (value replicated, gradient sharded over "model"); TRON runs
+    one streamed Hv pass per CG step, exactly the host_tron driver's
+    one-aggregate-per-CG-step pattern.
+
+    Staged chunks have FIXED content after the populate pass, so each
+    chunk's sharded layout is built ONCE and kept device-resident up to
+    ``sharded_cache_bytes``; chunks past the budget re-shard from the
+    staged arrays on every pass (the spilled-cache cost model). On a
+    CPU backend "device-resident" is host RAM, so both budgets count
+    against the host-memory contract.
+
+    Scope (validated by the driver): single process, no normalization
+    (the shift/factor extras are not threaded through the per-chunk
+    entry points yet), sparse layout (the tiled per-chunk schedules ride
+    the PR-1 cache through StreamingGLMObjective on the unsharded path).
+    """
+
+    def __init__(
+        self,
+        paths,
+        fmt,
+        index_map: IndexMap,
+        stats: StreamStats,
+        task,
+        mesh,
+        *,
+        rows_per_chunk: int = 65536,
+        cache_bytes: int = 2 << 30,
+        sharded_cache_bytes: int = 2 << 30,
+        prefetch: bool = True,
+        spill_dir: Optional[str] = None,
+    ):
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+        if MODEL_AXIS not in mesh.axis_names or DATA_AXIS not in mesh.axis_names:
+            raise ValueError(
+                "streaming feature-sharded training needs a (data, model) "
+                f"mesh, got axes {mesh.axis_names}"
+            )
+        self.mesh = mesh
+        self.data_shards = int(mesh.shape[DATA_AXIS])
+        self.model_shards = int(mesh.shape[MODEL_AXIS])
+        self.dim = index_map.size
+        self.block_dim = -(-self.dim // self.model_shards)
+        self.d_pad = self.model_shards * self.block_dim
+        self.sharded_cache_bytes = int(sharded_cache_bytes)
+        # staging/cache tier only (kernel="scatter": the sharded programs
+        # below do the math; the base's own partials are never dispatched)
+        self._base = StreamingGLMObjective(
+            paths, fmt, index_map, stats, task,
+            rows_per_chunk=rows_per_chunk, cache_bytes=cache_bytes,
+            prefetch=prefetch, spill_dir=spill_dir, kernel="scatter",
+        )
+        from photon_ml_tpu.ops.losses import loss_for_task
+        from photon_ml_tpu.ops.objective import GLMObjective
+        from photon_ml_tpu.parallel.distributed import (
+            feature_sharded_hessian_diagonal,
+            feature_sharded_sparse_hessian_vector,
+            feature_sharded_sparse_value_and_grad,
+        )
+
+        self._objective = GLMObjective(loss_for_task(task), self.dim)
+        self._vg = feature_sharded_sparse_value_and_grad(
+            self._objective, mesh
+        )
+        self._hv = feature_sharded_sparse_hessian_vector(
+            self._objective, mesh
+        )
+        self._hd = feature_sharded_hessian_diagonal(
+            self._objective, mesh, None, layout="sparse"
+        )
+        # per-chunk sharded layouts: None until populated; entries are
+        # either a FeatureShardedSparseBatch (cached) or None (over
+        # budget -> re-shard per pass)
+        self._sharded: Optional[List[Optional[object]]] = None
+
+    def _shard_chunk(self, batch):
+        import jax
+
+        from photon_ml_tpu.parallel.distributed import (
+            feature_shard_sparse_batch,
+        )
+
+        host = jax.device_get(batch)
+        sharded, block_dim = feature_shard_sparse_batch(
+            host, self.dim, self.model_shards,
+            rows_multiple=self.data_shards,
+        )
+        assert block_dim == self.block_dim
+        return sharded
+
+    def _sharded_chunks(self):
+        """Yield one FeatureShardedSparseBatch per staged chunk; builds
+        (and budget-caches) the layouts on the first pass."""
+        if self._sharded is None:
+            built: List[Optional[object]] = []
+            budget = self.sharded_cache_bytes
+            for batch in self._base.chunks():
+                sb = self._shard_chunk(batch)
+                nbytes = sum(
+                    np.dtype(a.dtype).itemsize * int(np.prod(a.shape))
+                    for a in sb
+                )
+                if nbytes <= budget:
+                    budget -= nbytes
+                    built.append(sb)
+                else:
+                    built.append(None)
+                yield sb
+            self._sharded = built
+            return
+        source = None
+        for i, sb in enumerate(self._sharded):
+            if sb is not None:
+                yield sb
+                continue
+            if source is None:
+                # over-budget tail: re-shard from the staged chunk cache
+                import itertools
+
+                source = itertools.islice(self._base.chunks(), i, None)
+            yield self._shard_chunk(next(source))
+
+    def value_and_gradient(self, w, l2_weight=0.0):
+        import jax.numpy as jnp
+
+        value = jnp.float32(0.0)
+        grad = jnp.zeros((self.d_pad,), jnp.float32)
+        for sb in self._sharded_chunks():
+            v, g = self._vg(w, sb, jnp.float32(0.0))
+            value = value + v
+            grad = grad + g
+        value = value + 0.5 * l2_weight * jnp.vdot(w, w)
+        return value, grad + l2_weight * w
+
+    def hessian_vector(self, w, direction, l2_weight=0.0):
+        import jax.numpy as jnp
+
+        hv = jnp.zeros((self.d_pad,), jnp.float32)
+        for sb in self._sharded_chunks():
+            hv = hv + self._hv(w, direction, sb, jnp.float32(0.0))
+        return hv + l2_weight * direction
+
+    def hessian_diagonal(self, w, l2_weight=0.0):
+        import jax.numpy as jnp
+
+        diag = jnp.zeros((self.d_pad,), jnp.float32)
+        for sb in self._sharded_chunks():
+            diag = diag + self._hd(w, sb, jnp.float32(0.0))
+        return diag + l2_weight

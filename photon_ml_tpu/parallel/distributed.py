@@ -516,6 +516,39 @@ def feature_sharded_sparse_value_and_grad(
     return jax.jit(vg)
 
 
+def feature_sharded_sparse_hessian_vector(
+    objective: GLMObjective,
+    mesh: Mesh,
+    *,
+    data_axis: str = DATA_AXIS,
+    model_axis: str = MODEL_AXIS,
+) -> Callable:
+    """(w, direction, sharded_batch, l2) -> H(w) @ d over the sparse 2-D
+    layout, direction/result sharded over ``model_axis`` — the per-chunk
+    building block of the STREAMED feature-sharded TRON (one streamed
+    pass per CG step, accumulated chunk by chunk, exactly the
+    HessianVectorAggregator.scala:137-152 aggregate with the chunk loop
+    standing in for the executor partitions)."""
+    loss = objective.loss
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(model_axis), P(model_axis),
+        ) + _sparse_shard_specs(model_axis, data_axis)[1:],
+        out_specs=P(model_axis),
+        check_vma=False,
+    )
+    def hv(w_block, d_block, b, l2):
+        factory = _sparse_block_hvp_factory(
+            loss, b, l2, model_axis, data_axis
+        )
+        return factory(w_block)(d_block)
+
+    return jax.jit(hv)
+
+
 def feature_sharded_sparse_fit(
     objective: GLMObjective,
     mesh: Mesh,

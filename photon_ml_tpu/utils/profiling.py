@@ -57,3 +57,14 @@ def host_timings() -> Dict[str, float]:
 def reset_host_timings() -> None:
     with _HOST_TIMINGS_LOCK:
         _HOST_TIMINGS.clear()
+
+
+def peak_rss_bytes() -> int:
+    """Host peak-RSS high-water of this process in BYTES (ru_maxrss is
+    KiB on Linux, bytes on macOS) — the out-of-core layer's reported
+    memory ceiling (metrics.json / bench.py streaming sections)."""
+    import resource
+    import sys
+
+    ru = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return int(ru if sys.platform == "darwin" else ru * 1024)

@@ -694,3 +694,400 @@ class TestLibSVMStreaming:
             np.asarray(results[False].models[1.0].means),
             atol=5e-3,
         )
+
+
+class TestFusedScanSummary:
+    """One-pass scan + colStats (stream_scan_with_summary): identical
+    vocabulary/stats to the classic scan, summary matching the in-memory
+    colStats — the fused form of the preprocess stage's back-to-back
+    scan_stream + streaming_summary reads."""
+
+    def _write_weighted(self, tmp_path, rng, n_files=2, rows=120, d=30, k=6):
+        for fi in range(n_files):
+            recs = []
+            for i in range(rows):
+                ix = rng.choice(d, size=k, replace=False)
+                vs = rng.normal(size=k)
+                vs[0] = 0.0  # explicit zero entry: in-map, moment no-op
+                recs.append({
+                    "uid": f"{fi}-{i}",
+                    "label": float(rng.uniform() > 0.5),
+                    "features": [
+                        {"name": f"x{j}", "term": "", "value": float(v)}
+                        for j, v in zip(ix, vs)
+                    ],
+                    "offset": float(rng.normal()),
+                    "weight": float(
+                        rng.choice([0.0, 1.0, 2.0], p=[0.1, 0.6, 0.3])
+                    ),
+                })
+            write_container(
+                str(tmp_path / f"part-{fi}.avro"),
+                schemas.TRAINING_EXAMPLE_AVRO, recs,
+            )
+
+    def _assert_matches(self, tmp_path, fmt):
+        from photon_ml_tpu.data.stats import compute_summary
+        from photon_ml_tpu.io.streaming import scan_stream_with_summary
+
+        im1, st1 = scan_stream([str(tmp_path)], fmt)
+        im2, st2, summary = scan_stream_with_summary([str(tmp_path)], fmt)
+        assert st1 == st2
+        assert dict(im1.items()) == dict(im2.items())
+        loaded = AvroInputDataFormat().load([str(tmp_path)])
+        ref = compute_summary(loaded.batch, loaded.num_features)
+        for f in ("mean", "variance", "num_nonzeros", "max", "min",
+                  "norm_l1", "norm_l2", "mean_abs"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(summary, f)),
+                np.asarray(getattr(ref, f)),
+                rtol=1e-5, atol=1e-6, err_msg=f,
+            )
+        assert float(summary.count) == float(ref.count)
+
+    def test_native_decode_path(self, tmp_path, rng):
+        self._write_weighted(tmp_path, rng)
+        self._assert_matches(tmp_path, AvroInputDataFormat())
+
+    def test_python_codec_fallback(self, tmp_path, rng):
+        self._write_weighted(tmp_path, rng)
+
+        class NoNative(AvroInputDataFormat):
+            def decode_file(self, path):
+                return None
+
+        self._assert_matches(tmp_path, NoNative())
+
+    def test_prebuilt_map_drops_unknown_features(self, tmp_path, rng):
+        from photon_ml_tpu.io.streaming import scan_stream_with_summary
+        from photon_ml_tpu.utils.index_map import IndexMap
+
+        self._write_weighted(tmp_path, rng, d=10, k=3)
+        fmt = AvroInputDataFormat()
+        full_map, _ = scan_stream([str(tmp_path)], fmt)
+        # keep only half the vocabulary: dropped keys must contribute
+        # nothing (same behavior as the remap in iter_rows)
+        kept = {
+            key: i
+            for i, (key, _) in enumerate(sorted(full_map.items())[:5])
+        }
+        pruned = IndexMap(kept)
+        _, _, summary = scan_stream_with_summary(
+            [str(tmp_path)], fmt, index_map=pruned
+        )
+        assert np.asarray(summary.mean).shape[0] == len(kept)
+
+    def test_glm_driver_uses_fused_scan(self, tmp_path, rng, monkeypatch):
+        """Driver preprocess with normalization + no diagnostics reads
+        the train dir ONCE (fused), not twice."""
+        from photon_ml_tpu.cli.glm_driver import GLMDriver, GLMParams
+        from photon_ml_tpu.ops.normalization import NormalizationType
+
+        _write_files(tmp_path, rng)
+        calls = {"scan": 0, "fused": 0, "summary": 0}
+        import photon_ml_tpu.io.streaming as S
+
+        real_fused = AvroInputDataFormat.stream_scan_with_summary
+        real_summary = S.streaming_summary
+
+        def counting_fused(self, paths, index_map=None):
+            calls["fused"] += 1
+            return real_fused(self, paths, index_map=index_map)
+
+        def counting_summary(*a, **k):
+            calls["summary"] += 1
+            return real_summary(*a, **k)
+
+        monkeypatch.setattr(
+            AvroInputDataFormat, "stream_scan_with_summary", counting_fused
+        )
+        monkeypatch.setattr(S, "streaming_summary", counting_summary)
+        p = GLMParams(
+            train_dir=str(tmp_path),
+            output_dir=str(tmp_path / "out"),
+            streaming=True,
+            normalization_type=NormalizationType.STANDARDIZATION,
+            max_num_iterations=3,
+            data_validation_type=__import__(
+                "photon_ml_tpu.data.validators",
+                fromlist=["DataValidationType"],
+            ).DataValidationType.VALIDATE_DISABLED,
+        )
+        driver = GLMDriver(p)
+        driver.preprocess()
+        assert calls["fused"] == 1
+        assert calls["summary"] == 0
+        assert driver._summary is not None
+        assert driver._norm is not None
+
+
+class TestSpillCleanup:
+    def test_atexit_sweep_removes_leaked_scratch(self, tmp_path):
+        """A driver exception (traceback keeps the store alive, __del__
+        never fires before exit) must not leak the spill directory: the
+        atexit sweep removes every registered scratch dir."""
+        script = r"""
+import sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from photon_ml_tpu.io.streaming import _DiskChunkStore
+from photon_ml_tpu.game.streaming import GameChunkStore
+
+store = _DiskChunkStore(8, 4, sys.argv[1])
+gstore = GameChunkStore(8, {"s": 4}, ["t"], sys.argv[1])
+print("DIRS", store.dir, gstore.dir)
+# keep both alive via an exception traceback (the leak scenario)
+raise RuntimeError("driver blew up mid-stream")
+"""
+        out = subprocess.run(
+            [sys.executable, "-c", script, str(tmp_path)],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert out.returncode != 0
+        dirs = out.stdout.split("DIRS", 1)[1].split()
+        assert len(dirs) == 2
+        for d in dirs:
+            assert not os.path.exists(d), d
+
+    def test_close_unregisters(self, tmp_path):
+        from photon_ml_tpu.io.streaming import (
+            _DiskChunkStore,
+            _LIVE_SPILL_DIRS,
+        )
+
+        store = _DiskChunkStore(8, 4, str(tmp_path))
+        assert store.dir in _LIVE_SPILL_DIRS
+        store.close()
+        assert store.dir not in _LIVE_SPILL_DIRS
+        assert not os.path.exists(store.dir)
+
+
+class TestStreamBudget:
+    def test_budget_rows(self):
+        from photon_ml_tpu.io.streaming import (
+            budgeted_rows,
+            sparse_row_bytes,
+            stream_budget_rows,
+        )
+
+        # no budget -> historical default
+        assert stream_budget_rows(0, 100) == 65536
+        assert stream_budget_rows(None, 100) == 65536
+        # budget divides by row bytes, floored at min_rows
+        assert stream_budget_rows(1000, 100) == 10
+        assert stream_budget_rows(10, 100) == 8
+        assert budgeted_rows(100, 1 << 30, sparse_row_bytes(16)) == 100
+        assert budgeted_rows(100_000, 1024, sparse_row_bytes(1 << 20)) == 1
+
+
+class TestStreamingFeatureSharded:
+    """Streaming x feature-sharded composition: the guard is gone; the
+    streamed sharded fit matches the replicated in-memory fit."""
+
+    def test_matches_replicated_in_memory(self, tmp_path, rng):
+        from photon_ml_tpu.optim.config import (
+            OptimizerType,
+            RegularizationType,
+        )
+        from photon_ml_tpu.parallel.mesh import (
+            DATA_AXIS,
+            MODEL_AXIS,
+            make_mesh,
+        )
+        from photon_ml_tpu.training import train_streaming_feature_sharded
+
+        _write_files(tmp_path, rng, n_files=3, rows_per_file=120, d=50, k=8)
+        mesh = make_mesh((4, 2), (DATA_AXIS, MODEL_AXIS))
+        fmt = AvroInputDataFormat()
+        loaded = fmt.load([str(tmp_path)])
+        for opt, lambdas in (
+            (OptimizerType.LBFGS, [1.0, 0.1]),
+            (OptimizerType.TRON, [1.0]),
+        ):
+            models_s, results_s, _ = train_streaming_feature_sharded(
+                [str(tmp_path)], TaskType.LOGISTIC_REGRESSION, mesh=mesh,
+                regularization_type=RegularizationType.L2,
+                regularization_weights=lambdas, max_iter=40,
+                rows_per_chunk=100, optimizer_type=opt,
+            )
+            models_m, _ = train_generalized_linear_model(
+                loaded.batch, TaskType.LOGISTIC_REGRESSION,
+                loaded.num_features,
+                regularization_type=RegularizationType.L2,
+                regularization_weights=lambdas, max_iter=40,
+                optimizer_type=opt,
+            )
+            for lam in lambdas:
+                np.testing.assert_allclose(
+                    np.asarray(models_s[lam].coefficients.means),
+                    np.asarray(models_m[lam].coefficients.means),
+                    rtol=1e-3, atol=1e-3,
+                )
+
+    def test_elastic_net_and_overflow_cache(self, tmp_path, rng):
+        """OWL-QN on the sharded streamed layout; a tiny sharded-cache
+        budget forces the re-shard-per-pass overflow tier and must not
+        change the result."""
+        from photon_ml_tpu.optim.config import RegularizationType
+        from photon_ml_tpu.parallel.mesh import (
+            DATA_AXIS,
+            MODEL_AXIS,
+            make_mesh,
+        )
+        from photon_ml_tpu.training import train_streaming_feature_sharded
+
+        _write_files(tmp_path, rng, n_files=3, rows_per_file=100, d=40, k=6)
+        mesh = make_mesh((4, 2), (DATA_AXIS, MODEL_AXIS))
+        kw = dict(
+            regularization_type=RegularizationType.ELASTIC_NET,
+            elastic_net_alpha=0.5,
+            regularization_weights=[0.5],
+            max_iter=30,
+            rows_per_chunk=64,
+        )
+        models_a, _, _ = train_streaming_feature_sharded(
+            [str(tmp_path)], TaskType.LOGISTIC_REGRESSION, mesh=mesh, **kw
+        )
+        models_b, _, _ = train_streaming_feature_sharded(
+            [str(tmp_path)], TaskType.LOGISTIC_REGRESSION, mesh=mesh,
+            sharded_cache_bytes=1, **kw
+        )
+        np.testing.assert_allclose(
+            np.asarray(models_a[0.5].coefficients.means),
+            np.asarray(models_b[0.5].coefficients.means),
+            rtol=1e-6, atol=1e-7,
+        )
+
+    def test_driver_guard_removed_end_to_end(self, tmp_path, rng):
+        """--streaming + --distributed feature passes validation and
+        trains through the driver (the round-5 mutual-exclusion guard is
+        gone); normalization on that path still rejects cleanly."""
+        from photon_ml_tpu.cli.glm_driver import GLMDriver, GLMParams
+        from photon_ml_tpu.ops.normalization import NormalizationType
+        from photon_ml_tpu.optim.config import RegularizationType
+
+        _write_files(tmp_path, rng, n_files=3, rows_per_file=100, d=40, k=6)
+        p = GLMParams(
+            train_dir=str(tmp_path),
+            output_dir=str(tmp_path / "out"),
+            streaming=True,
+            distributed="feature",
+            model_shards=2,
+            regularization_type=RegularizationType.L2,
+            regularization_weights=[1.0],
+            max_num_iterations=15,
+        )
+        driver = GLMDriver(p)
+        driver.run()
+        assert 1.0 in driver.models
+        with pytest.raises(ValueError, match="normalization"):
+            GLMParams(
+                train_dir=str(tmp_path),
+                output_dir=str(tmp_path / "out2"),
+                streaming=True,
+                distributed="feature",
+                normalization_type=NormalizationType.STANDARDIZATION,
+            ).validate()
+
+
+class TestStreamedValidation:
+    def test_driver_streams_validation_metrics(self, tmp_path, rng):
+        """p.streaming validation consumes the validate dir through
+        iter_chunks: AUC within 1e-3 of the exact in-memory value, loss
+        exact; the in-memory loader is never called on the validate
+        dir."""
+        from photon_ml_tpu.cli.glm_driver import GLMDriver, GLMParams
+        from photon_ml_tpu.optim.config import RegularizationType
+
+        train = tmp_path / "train"
+        val = tmp_path / "val"
+        train.mkdir()
+        val.mkdir()
+        _write_files(train, rng, n_files=3, rows_per_file=120, d=40, k=6)
+        _write_files(val, rng, n_files=2, rows_per_file=150, d=40, k=6)
+        p = GLMParams(
+            train_dir=str(train),
+            output_dir=str(tmp_path / "out"),
+            validate_dir=str(val),
+            streaming=True,
+            regularization_type=RegularizationType.L2,
+            regularization_weights=[1.0, 0.1],
+            max_num_iterations=25,
+        )
+        driver = GLMDriver(p)
+        # the streamed path must never materialize the validate dir
+        real_load = AvroInputDataFormat.load
+
+        def poisoned_load(self, paths, *a, **k):
+            raise AssertionError(f"validate dir was materialized: {paths}")
+
+        AvroInputDataFormat.load = poisoned_load
+        try:
+            driver.run()
+        finally:
+            AvroInputDataFormat.load = real_load
+        assert driver.best_lambda in (1.0, 0.1)
+        fmt = AvroInputDataFormat()
+        vdata = fmt.load([str(val)], index_map=driver._data.index_map)
+        for lam, model in driver.models.items():
+            exact = driver._metrics_for(model, vdata.batch)
+            streamed = driver.validation_metrics[lam]
+            assert abs(exact["AUC"] - streamed["AUC"]) < 1e-3
+            assert abs(
+                exact["logistic_loss"] - streamed["logistic_loss"]
+            ) < 1e-6
+
+    def test_streaming_auc_histogram_accuracy(self, rng):
+        """Histogram AUC vs the exact sort-based evaluator on weighted,
+        tied, skewed score sets."""
+        from photon_ml_tpu.evaluation.metrics import area_under_roc_curve
+        from photon_ml_tpu.evaluation.streaming import StreamingAUC
+
+        for seed in (0, 1, 2):
+            r = np.random.default_rng(seed)
+            n = 5000
+            z = np.concatenate([
+                r.normal(1.0, 2.0, n // 2), r.normal(-0.5, 0.5, n // 2)
+            ])
+            y = (r.uniform(size=n) < 1 / (1 + np.exp(-z))).astype(float)
+            w = r.choice([0.0, 0.5, 1.0, 2.0], size=n)
+            z = np.round(z, 2)  # force ties
+            exact = float(area_under_roc_curve(
+                jnp.asarray(z, jnp.float32), jnp.asarray(y, jnp.float32),
+                jnp.asarray(w, jnp.float32),
+            ))
+            acc = StreamingAUC()
+            for lo in range(0, n, 700):  # chunked updates
+                acc.update(z[lo:lo + 700], y[lo:lo + 700], w[lo:lo + 700])
+            assert abs(acc.result() - exact) < 1e-3
+
+    def test_streaming_rmse_and_loss_exact(self, rng):
+        from photon_ml_tpu.evaluation.metrics import (
+            mean_pointwise_loss,
+            root_mean_squared_error,
+        )
+        from photon_ml_tpu.evaluation.streaming import (
+            StreamingMeanLoss,
+            StreamingRMSE,
+        )
+        from photon_ml_tpu.ops.losses import LOGISTIC
+
+        n = 3000
+        z = rng.normal(size=n).astype(np.float32)
+        y = (rng.uniform(size=n) > 0.4).astype(np.float32)
+        w = rng.uniform(size=n).astype(np.float32)
+        exact_rmse = float(root_mean_squared_error(
+            jnp.asarray(z), jnp.asarray(y), jnp.asarray(w)
+        ))
+        exact_loss = float(mean_pointwise_loss(
+            LOGISTIC, jnp.asarray(z), jnp.asarray(y), jnp.asarray(w)
+        ))
+        r_acc = StreamingRMSE()
+        l_acc = StreamingMeanLoss(LOGISTIC)
+        for lo in range(0, n, 512):
+            r_acc.update(z[lo:lo + 512], y[lo:lo + 512], w[lo:lo + 512])
+            l_acc.update(z[lo:lo + 512], y[lo:lo + 512], w[lo:lo + 512])
+        assert abs(r_acc.result() - exact_rmse) < 1e-6
+        assert abs(l_acc.result() - exact_loss) < 1e-6
