@@ -763,7 +763,7 @@ def _glm_fit_config(
         # a real driver builds schedules from host-loaded data, so the
         # tunnel D2H of this harness's synthetic arrays must not be billed
         # to the schedule build (it dominated: ~20 s of an observed 24 s)
-        host_batch = jax.device_get(batch)
+        host_batch = jax.device_get(batch)  # photon: allow(hidden-host-sync) — timing harness syncs deliberately
         t0 = time.perf_counter()
         batch = tiled_batch_from_sparse(host_batch, d)
         schedule_build_s = time.perf_counter() - t0
@@ -877,7 +877,7 @@ def _feature_sharded_tron_config(name, *, n, d, k, lam=1.0, seed=0):
 
     rng = np.random.default_rng(seed)
     batch, _ = _synth_sparse(rng, n, d, k, task="linear")
-    host_batch = jax.device_get(batch)
+    host_batch = jax.device_get(batch)  # photon: allow(hidden-host-sync) — timing harness syncs deliberately
     mesh = make_mesh(
         (1, 1), (DATA_AXIS, MODEL_AXIS), devices=jax.devices()[:1]
     )
@@ -949,18 +949,18 @@ def _game_fe_sharded_config(name, *, n=1 << 18, d=1 << 20, k=64, seed=0):
 
     rng = np.random.default_rng(seed)
     batch, _ = _synth_sparse(rng, n, d, k)
-    host = jax.device_get(batch)
+    host = jax.device_get(batch)  # photon: allow(hidden-host-sync) — timing harness syncs deliberately
     from photon_ml_tpu.utils.index_map import IdentityIndexMap
 
     shard = ShardData(
-        indices=np.asarray(host.indices),
-        values=np.asarray(host.values),
+        indices=np.asarray(host.indices),  # photon: allow(hidden-host-sync) — timing harness syncs deliberately
+        values=np.asarray(host.values),  # photon: allow(hidden-host-sync) — timing harness syncs deliberately
         index_map=IdentityIndexMap(d),
         intercept_index=None,
     )
     ds = GameDataset(
         uids=[""] * n,
-        labels=np.asarray(host.labels),
+        labels=np.asarray(host.labels),  # photon: allow(hidden-host-sync) — timing harness syncs deliberately
         offsets=np.zeros(n, np.float32),
         weights=np.ones(n, np.float32),
         shards={"global": shard},
@@ -990,7 +990,7 @@ def _game_fe_sharded_config(name, *, n=1 << 18, d=1 << 20, k=64, seed=0):
         def step(model):
             t0 = time.perf_counter()
             model, res = coord.update_model(model)
-            _ = float(jnp.sum(model.model.means))
+            _ = float(jnp.sum(model.model.means))  # photon: allow(hidden-host-sync) — timing harness syncs deliberately
             return model, time.perf_counter() - t0
 
         model, cold_s = step(coord.initialize_model())
@@ -1077,7 +1077,7 @@ def _streaming_config(name, *, n_files=8, rows_per_file=125_000, d=200_000,
         def one_eval():
             t0 = time.perf_counter()
             v, g = obj.value_and_gradient(w, 0.1)
-            _ = float(v) + float(jnp.sum(g))
+            _ = float(v) + float(jnp.sum(g))  # photon: allow(hidden-host-sync) — timing harness syncs deliberately
             return time.perf_counter() - t0
 
         eval1_s = one_eval()  # decode + cache populate (+ compile)
@@ -1093,7 +1093,7 @@ def _streaming_config(name, *, n_files=8, rows_per_file=125_000, d=200_000,
             for _ in range(m):
                 v, g = obj.value_and_gradient(w_, 0.1)
                 w_ = w_ - 1e-9 * g
-            _ = float(v) + float(jnp.sum(g))
+            _ = float(v) + float(jnp.sum(g))  # photon: allow(hidden-host-sync) — timing harness syncs deliberately
             return time.perf_counter() - t0
 
         t1 = min(eval_chain(1) for _ in range(2))

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Static gates, runnable anywhere the package runs:
+#   1. photon-lint — the project-specific JAX hot-path invariants
+#      (readback seam, recompile hazards, spill/IO hygiene); rules and
+#      suppression/baseline mechanics in photon_ml_tpu/lint/.
+#   2. ruff — generic hygiene (import order, unused imports/variables,
+#      mutable default args; [tool.ruff] in pyproject.toml). Soft-skips
+#      when ruff is not installed so minimal CI containers still gate
+#      on photon-lint.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m photon_ml_tpu.lint photon_ml_tpu bench.py "$@"
+
+if command -v ruff >/dev/null 2>&1; then
+    ruff check photon_ml_tpu bench.py tests dev-scripts
+elif python -c "import ruff" >/dev/null 2>&1; then
+    python -m ruff check photon_ml_tpu bench.py tests dev-scripts
+else
+    echo "lint.sh: ruff not installed — skipping ruff check" >&2
+fi

@@ -200,7 +200,10 @@ class GameScoringDriver:
 
         if is_coordinator():
             with self.timer.time("write-scores"):
-                self._write_scores(dataset, np.asarray(scores))
+                from photon_ml_tpu.parallel import overlap
+
+                # counted seam instead of a raw np.asarray readback
+                self._write_scores(dataset, overlap.device_get(scores))
         if p.evaluator_types and p.has_response:
             with self.timer.time("evaluate"):
                 self._evaluate(dataset, scores)
@@ -225,6 +228,7 @@ class GameScoringDriver:
         in GameScoringParams.validate."""
         from photon_ml_tpu.game.data import slice_game_dataset
         from photon_ml_tpu.io.paths import expand_input_paths
+        from photon_ml_tpu.parallel import overlap
         from photon_ml_tpu.parallel.multihost import is_coordinator
         from photon_ml_tpu.utils.profiling import profile_trace
 
@@ -291,7 +295,7 @@ class GameScoringDriver:
                     ds = slice_game_dataset(
                         ds_file, a, a + rows_per_chunk
                     )
-                    scores = np.asarray(
+                    scores = overlap.device_get(
                         model.score(ds, p.task_type)
                         + jnp.asarray(ds.offsets)
                     )[: ds.num_real_rows]
@@ -299,8 +303,6 @@ class GameScoringDriver:
                         # async artifact IO (overlap): chunk i's part
                         # file writes while chunk i+1 loads and scores;
                         # drained before the completion log/barrier
-                        from photon_ml_tpu.parallel import overlap
-
                         overlap.submit_io(
                             write_container,
                             os.path.join(
@@ -320,8 +322,6 @@ class GameScoringDriver:
                         all_weights.append(
                             np.asarray(ds.weights[: ds.num_real_rows])
                         )
-        from photon_ml_tpu.parallel import overlap
-
         overlap.drain_io()  # every queued part file is on disk
         if n_rows == 0:
             raise ValueError("empty GAME dataset")  # in-memory parity

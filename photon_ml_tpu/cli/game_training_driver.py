@@ -17,7 +17,7 @@ import json
 import os
 from dataclasses import dataclass, field
 from itertools import product
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 import jax.numpy as jnp
@@ -35,11 +35,7 @@ from photon_ml_tpu.game.coordinate import (
     RandomEffectCoordinate,
 )
 from photon_ml_tpu.game.coordinate_descent import CoordinateDescent
-from photon_ml_tpu.game.data import (
-    GameDataset,
-    build_game_dataset,
-    build_game_dataset_from_files,
-)
+from photon_ml_tpu.game.data import GameDataset, build_game_dataset_from_files
 from photon_ml_tpu.game.model import GameModel
 from photon_ml_tpu.game.model_io import save_game_model
 from photon_ml_tpu.game.random_effect import RandomEffectOptimizationProblem

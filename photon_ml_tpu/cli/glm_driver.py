@@ -25,8 +25,6 @@ import numpy as np
 from photon_ml_tpu.data.stats import compute_summary
 from photon_ml_tpu.data.validators import DataValidationType, sanity_check_data
 from photon_ml_tpu.evaluation import (
-    Evaluator,
-    EvaluatorType,
     area_under_roc_curve,
     mean_pointwise_loss,
     root_mean_squared_error,
@@ -525,7 +523,7 @@ class GLMDriver:
                             # drains before the output barrier
                             from photon_ml_tpu.parallel import overlap
 
-                            overlap.submit_io(
+                            overlap.submit_io(  # photon: allow(undrained-io) — run() owns the drain barrier
                                 self._write_summary,
                                 p.summarization_output_dir,
                             )
@@ -580,7 +578,7 @@ class GLMDriver:
                 if is_coordinator():
                     from photon_ml_tpu.parallel import overlap
 
-                    overlap.submit_io(
+                    overlap.submit_io(  # photon: allow(undrained-io) — run() owns the drain barrier
                         self._write_summary, p.summarization_output_dir
                     )
         self._advance(DriverStage.PREPROCESSED)
@@ -854,7 +852,9 @@ class GLMDriver:
         accs = glm_streaming_metrics(p.task, loss)
         margins_fn = self.__dict__.get("_stream_margins_fn")
         if margins_fn is None:
-            margins_fn = jax.jit(lambda w, b: compute_margins(w, b))
+            # jit the named def directly: a jit(lambda ...) here would
+            # mint a fresh compile cache per driver instance for nothing
+            margins_fn = jax.jit(compute_margins)
             self._stream_margins_fn = margins_fn
         for chunk in iter_chunks(
             validate_paths, self._fmt, self._data.index_map,

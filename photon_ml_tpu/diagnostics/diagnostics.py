@@ -18,17 +18,16 @@ photon_ml_tpu.diagnostics.reporting (logical -> HTML).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Tuple
 
 import numpy as np
 from scipy import stats as scipy_stats
 
-import jax
 import jax.numpy as jnp
 
 from photon_ml_tpu.data.batch import Batch
-from photon_ml_tpu.models.glm import GeneralizedLinearModel, compute_margins, compute_means
+from photon_ml_tpu.models.glm import GeneralizedLinearModel, compute_means
 from photon_ml_tpu.task import TaskType
 
 Array = jnp.ndarray

@@ -8,7 +8,6 @@ report written to <output>/model-diagnostics).
 from __future__ import annotations
 
 import os
-from typing import Dict
 
 import numpy as np
 

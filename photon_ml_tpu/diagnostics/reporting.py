@@ -12,7 +12,7 @@ from __future__ import annotations
 import html
 import os
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence, Tuple, Union
+from typing import List, Tuple, Union
 
 
 @dataclass

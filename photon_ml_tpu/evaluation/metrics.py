@@ -14,7 +14,6 @@ grouped-by-threshold curves).
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax.numpy as jnp
 

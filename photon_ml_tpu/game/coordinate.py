@@ -32,15 +32,12 @@ from photon_ml_tpu.game.model import (
 )
 from photon_ml_tpu.game.random_effect import (
     RandomEffectOptimizationProblem,
-    RandomEffectTracker,
     score_random_effect,
 )
 from photon_ml_tpu.game.random_effect_data import RandomEffectDataset
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.glm import create_model
-from photon_ml_tpu.optim.common import OptResult
 from photon_ml_tpu.optim.problem import GLMOptimizationProblem
-from photon_ml_tpu.task import TaskType
 
 Array = jnp.ndarray
 
@@ -178,6 +175,7 @@ class FixedEffectCoordinate(Coordinate):
             feature_shard_tiled_batch,
         )
         from photon_ml_tpu.optim.config import OptimizerType
+        from photon_ml_tpu.parallel import overlap
         from photon_ml_tpu.parallel.distributed import (
             feature_shard_sparse_batch,
             feature_sharded_glm_fit,
@@ -221,7 +219,9 @@ class FixedEffectCoordinate(Coordinate):
             sharded, block_dim, meta, layout, rows_total = hit
         else:
             base = self.dataset.batch_for_shard(self.feature_shard_id)
-            host = jax.device_get(base)
+            # counted seam: a one-time layout-build fetch, but still a
+            # device->host round trip the discipline tests should see
+            host = overlap.device_get(base)
             if tiled:
                 sharded, block_dim = feature_shard_tiled_batch(
                     host, dim, data_shards, model_shards, mesh=self.mesh
@@ -838,7 +838,18 @@ class MatrixFactorizationCoordinate(Coordinate):
         return model.score(self.dataset)
 
     def regularization_term(self, model: MatrixFactorizationModel) -> float:
+        from photon_ml_tpu.parallel import overlap
+
+        return float(
+            overlap.device_get(self.regularization_term_device(model))
+        )
+
+    def regularization_term_device(
+        self, model: MatrixFactorizationModel
+    ) -> Array:
+        # device scalar, like the FE/RE coordinates: the CD loop folds it
+        # into its one batched readback instead of a per-coordinate pull
         l1, l2 = self.problem.regularization.split(self.problem.reg_weight)
-        return 0.5 * l2 * float(
+        return 0.5 * l2 * (
             jnp.sum(model.row_latent**2) + jnp.sum(model.col_latent**2)
         )

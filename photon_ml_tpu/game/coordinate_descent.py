@@ -15,7 +15,7 @@ row-aligned vector arithmetic on device.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np

@@ -18,12 +18,10 @@ from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 import jax.numpy as jnp
-import numpy as np
 
 from photon_ml_tpu.game.data import GameDataset
 from photon_ml_tpu.game.random_effect import score_random_effect
 from photon_ml_tpu.game.random_effect_data import RandomEffectDataset
-from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
 from photon_ml_tpu.task import TaskType
 

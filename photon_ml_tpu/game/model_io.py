@@ -34,14 +34,11 @@ from photon_ml_tpu.game.model import (
 from photon_ml_tpu.game.coordinate import FactoredRandomEffectModel
 from photon_ml_tpu.io import schemas
 from photon_ml_tpu.io.avro_codec import read_avro_records, write_container
-from photon_ml_tpu.io.model_io import (
-    bayesian_avro_to_model,
-    model_to_bayesian_avro,
-)
+from photon_ml_tpu.io.model_io import model_to_bayesian_avro
 from photon_ml_tpu.models.coefficients import Coefficients
 from photon_ml_tpu.models.glm import create_model
 from photon_ml_tpu.task import TaskType
-from photon_ml_tpu.utils.index_map import IndexMap, split_feature_key
+from photon_ml_tpu.utils.index_map import split_feature_key
 
 FIXED_EFFECT = "fixed-effect"
 RANDOM_EFFECT = "random-effect"

@@ -25,19 +25,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from photon_ml_tpu.game.random_effect_data import (
-    RandomEffectBucket,
-    RandomEffectDataset,
-)
+from photon_ml_tpu.game.random_effect_data import RandomEffectDataset
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.optim.common import (
     CONVERGENCE_REASON_NAMES,
     FUNCTION_VALUES_WITHIN_TOLERANCE,
     GRADIENT_WITHIN_TOLERANCE,
     LINE_SEARCH_STALLED,
-    MAX_ITERATIONS,
     NOT_CONVERGED,
-    OptResult,
     check_convergence,
 )
 from photon_ml_tpu.optim.config import (

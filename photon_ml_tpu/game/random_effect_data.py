@@ -18,7 +18,7 @@ bucket (SURVEY P2: entities are the expert-parallel analog).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional
 
 import numpy as np
 

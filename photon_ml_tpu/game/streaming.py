@@ -615,13 +615,13 @@ class _StoreChunkObjective:
         self.shard_id = shard_id
         self.dim = dim
         self._objective = GLMObjective(loss, dim, identity_context())
-        self._partial = jax.jit(
+        self._partial = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
             lambda w, b: self._objective.value_and_gradient(w, b, 0.0)
         )
-        self._hv = jax.jit(
+        self._hv = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
             lambda w, d, b: self._objective.hessian_vector(w, d, b, 0.0)
         )
-        self._hd = jax.jit(
+        self._hd = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
             lambda w, b: self._objective.hessian_diagonal(w, b, 0.0)
         )
         self.residual: Optional[ScoreStore] = None
@@ -694,7 +694,7 @@ class StreamingFixedEffectCoordinate:
             self.store, self.feature_shard_id,
             self.problem.objective.dim, self.problem.objective.loss,
         )
-        self._score = jax.jit(
+        self._score = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
             lambda w, ix, v: (v * w[ix]).sum(axis=-1)
         )
 
@@ -770,11 +770,14 @@ class StreamingFixedEffectCoordinate:
     def regularization_term(self, means) -> float:
         import jax.numpy as jnp
 
+        from photon_ml_tpu.parallel import overlap
+
         l1, l2 = self.problem.regularization.split(self.reg_weight)
-        term = 0.5 * l2 * float(jnp.vdot(means, means))
+        term = 0.5 * l2 * jnp.vdot(means, means)
         if l1:
-            term += l1 * float(jnp.sum(jnp.abs(means)))
-        return term
+            term = term + l1 * jnp.sum(jnp.abs(means))
+        # ONE counted fetch for the whole term, not one float() per part
+        return float(overlap.device_get(term))
 
 
 @dataclass
@@ -796,7 +799,7 @@ class StreamingRandomEffectCoordinate:
     def __post_init__(self):
         import jax
 
-        self._score = jax.jit(
+        self._score = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
             lambda bank, codes, ix, v, valid: jax.numpy.where(
                 valid,
                 (
@@ -962,7 +965,7 @@ class StreamingCoordinateDescent:
         self._loss = loss_for_task(task)
         import jax
 
-        self._chunk_loss = jax.jit(
+        self._chunk_loss = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
             lambda z, lab, w: (w * self._loss.value(z, lab)).sum()
         )
 

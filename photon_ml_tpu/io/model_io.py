@@ -10,7 +10,7 @@ modelClass = the reference's GLM class names for cross-compat).
 from __future__ import annotations
 
 import os
-from typing import Dict, Iterable, Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 

@@ -14,7 +14,7 @@ Avro data (:128-160) — here :func:`generate_name_and_term_lists`.
 from __future__ import annotations
 
 import os
-from typing import Dict, Iterable, List, Mapping, Sequence, Set
+from typing import Dict, Iterable, Mapping, Sequence, Set
 
 from photon_ml_tpu.utils.index_map import IndexMap, feature_key
 

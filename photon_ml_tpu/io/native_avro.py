@@ -25,7 +25,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from photon_ml_tpu.io.avro_codec import parse_schema, read_container
+from photon_ml_tpu.io.avro_codec import read_container
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -21,7 +21,7 @@ objective evaluation (including line-search trials).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -246,6 +246,23 @@ def shard_avro_files(paths):
     return shard_stream_files(paths, AvroInputDataFormat())
 
 
+_MOMENTS_JIT = None
+
+
+def _sparse_moments_jit():
+    """Module-level jitted sparse-moments wrapper (dim static): ONE
+    compile cache shared across every streaming_summary call, instead of
+    a fresh jit(lambda) — and a fresh XLA compilation — per scan."""
+    global _MOMENTS_JIT
+    if _MOMENTS_JIT is None:
+        import jax
+
+        from photon_ml_tpu.data.stats import sparse_moments
+
+        _MOMENTS_JIT = jax.jit(sparse_moments, static_argnums=(1,))
+    return _MOMENTS_JIT
+
+
 def streaming_summary(
     paths,
     fmt,
@@ -275,10 +292,14 @@ def streaming_summary(
     import jax
     import jax.numpy as jnp
 
-    from photon_ml_tpu.data.stats import finalize_summary, sparse_moments
+    from photon_ml_tpu.data.stats import finalize_summary
+    from photon_ml_tpu.parallel import overlap
 
     dim = index_map.size
-    moments_fn = jax.jit(lambda b: sparse_moments(b, dim))
+    jitted_moments = _sparse_moments_jit()
+
+    def moments_fn(b):
+        return jitted_moments(b, dim)
     acc = None
     K = int(reservoir_rows)
     rng = np.random.default_rng(seed)
@@ -360,7 +381,7 @@ def streaming_summary(
         acc[6] = jnp.asarray(
             multihost_utils.process_allgather(acc[6]).min(axis=0)
         )
-        if int(acc[0]) == 0:
+        if int(overlap.device_get(acc[0])) == 0:
             # same contract as single-process: .avro files that exist but
             # hold zero rows must not produce a benign-looking summary
             # (mean 0 / variance 1) and train garbage normalization
@@ -613,7 +634,7 @@ class StreamingGLMObjective:
         self._loss = loss_for_task(task)
         self.norm = norm if norm is not None else identity_context()
         self._objective = GLMObjective(self._loss, self.dim, self.norm)
-        self._partial = jax.jit(
+        self._partial = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
             lambda w, b: self._objective.value_and_gradient(w, b, 0.0)
         )
         if kernel not in ("auto", "tiled", "scatter"):
@@ -783,18 +804,18 @@ class StreamingGLMObjective:
             carry, _ = jax.lax.scan(body, init, stacked)
             return carry
 
-        self._tiled_vg_all = jax.jit(
+        self._tiled_vg_all = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
             lambda w, st: _scan(
                 w, st, lambda w_, tb: obj.value_and_gradient(w_, tb, 0.0)
             )
         )
-        self._tiled_hv_all = jax.jit(
+        self._tiled_hv_all = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
             lambda w, d, st: _scan(
                 (w, d), st,
                 lambda wd, tb: obj.hessian_vector(wd[0], wd[1], tb, 0.0),
             )
         )
-        self._tiled_hd_all = jax.jit(
+        self._tiled_hd_all = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
             lambda w, st: _scan(
                 w, st, lambda w_, tb: obj.hessian_diagonal(w_, tb, 0.0)
             )
@@ -886,7 +907,7 @@ class StreamingGLMObjective:
         else:
             chunks = self.chunks()
         if getattr(self, "_scatter_hv", None) is None:
-            self._scatter_hv = jax.jit(
+            self._scatter_hv = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
                 lambda w_, d_, b: self._objective.hessian_vector(
                     w_, d_, b, 0.0
                 )
@@ -910,7 +931,7 @@ class StreamingGLMObjective:
         else:
             chunks = self.chunks()
         if getattr(self, "_scatter_hd", None) is None:
-            self._scatter_hd = jax.jit(
+            self._scatter_hd = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
                 lambda w_, b: self._objective.hessian_diagonal(w_, b, 0.0)
             )
         for batch in chunks:
@@ -1042,13 +1063,14 @@ class FeatureShardedStreamingObjective:
         self._sharded: Optional[List[Optional[object]]] = None
 
     def _shard_chunk(self, batch):
-        import jax
-
+        from photon_ml_tpu.parallel import overlap
         from photon_ml_tpu.parallel.distributed import (
             feature_shard_sparse_batch,
         )
 
-        host = jax.device_get(batch)
+        # counted seam: the re-staging fetch happens once per chunk per
+        # pass (cached under the budget) — route it through the counter
+        host = overlap.device_get(batch)
         sharded, block_dim = feature_shard_sparse_batch(
             host, self.dim, self.model_shards,
             rows_multiple=self.data_shards,

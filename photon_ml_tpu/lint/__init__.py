@@ -1,0 +1,71 @@
+"""photon-lint: AST-based static checks for the JAX hot-path invariants.
+
+PRs 1-3 established performance invariants that only runtime tests
+enforced: every device->host fetch routes through the counted
+``parallel/overlap.py`` seam, every spill scratch dir registers for the
+atexit sweep, every ``submit_io`` is drained before exit. This package
+makes those invariants machine-checked at review time — a
+project-specific analyzer over the stdlib ``ast``, no new runtime deps.
+
+Rules (see ``photon_ml_tpu/lint/rules/``):
+
+==========  ===================  ==============================================
+id          slug                 protects
+==========  ===================  ==============================================
+``PL001``   hidden-host-sync     all device->host fetches go through the
+                                 counted ``overlap.device_get`` seam
+``PL002``   recompile-hazard     no jit-of-lambda / jit-in-loop / unhashable
+                                 static_argnums (silent recompilations)
+``PL003``   tracer-leak          no tracers stored on ``self``/globals or
+                                 Python-branched inside jitted bodies
+``PL004``   spill-hygiene        scratch dirs under ``io/`` / GAME streaming
+                                 register for the atexit sweep
+``PL005``   undrained-io         ``submit_io`` scopes reach a ``drain_io``
+==========  ===================  ==============================================
+
+Usage::
+
+    python -m photon_ml_tpu.lint photon_ml_tpu bench.py
+    python -m photon_ml_tpu.lint --json photon_ml_tpu
+    dev-scripts/lint.sh            # photon-lint + ruff (when installed)
+
+Suppress a single line with ``# photon: allow(<rule>)`` (id or slug);
+grandfathered sites live in the checked-in ``.photon-lint-baseline.json``
+(regenerate with ``--write-baseline``). ``tests/test_lint_clean.py`` runs
+the analyzer over the whole package under tier-1, so a new raw readback
+fails CI instead of landing silently.
+"""
+
+from photon_ml_tpu.lint.core import (
+    FileContext,
+    Report,
+    Rule,
+    RULES,
+    Violation,
+    analyze_paths,
+    analyze_source,
+    iter_python_files,
+    register,
+)
+from photon_ml_tpu.lint.baseline import (
+    apply_baseline,
+    baseline_key,
+    load_baseline,
+    write_baseline,
+)
+
+__all__ = [
+    "FileContext",
+    "Report",
+    "Rule",
+    "RULES",
+    "Violation",
+    "analyze_paths",
+    "analyze_source",
+    "iter_python_files",
+    "register",
+    "apply_baseline",
+    "baseline_key",
+    "load_baseline",
+    "write_baseline",
+]

@@ -1,0 +1,7 @@
+"""``python -m photon_ml_tpu.lint`` entry point."""
+
+import sys
+
+from photon_ml_tpu.lint.cli import main
+
+sys.exit(main())

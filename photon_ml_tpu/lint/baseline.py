@@ -1,0 +1,79 @@
+"""Baseline handling: grandfathered violations, keyed content-wise.
+
+Entries match on ``(file, rule, stripped source line)`` rather than line
+numbers, so unrelated edits above a grandfathered site don't churn the
+baseline. Each key carries a count — two identical raw readback lines in
+one file need two entries' worth of allowance, and FIXING one of them
+makes the spare allowance visible as an unused entry."""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import List, Sequence, Tuple
+
+from photon_ml_tpu.lint.core import Report, Violation
+
+BASELINE_VERSION = 1
+
+Key = Tuple[str, str, str]
+
+
+def baseline_key(v: Violation) -> Key:
+    return (v.path, v.rule, v.snippet)
+
+
+def load_baseline(path: str) -> Counter:
+    """Baseline file -> Counter of (file, rule, snippet) allowances."""
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"unsupported baseline version {data.get('version')!r} "
+            f"in {path} (expected {BASELINE_VERSION})"
+        )
+    allow: Counter = Counter()
+    for e in data.get("entries", []):
+        allow[(e["file"], e["rule"], e["snippet"])] += int(
+            e.get("count", 1)
+        )
+    return allow
+
+
+def write_baseline(path: str, violations: Sequence[Violation]) -> dict:
+    counts: Counter = Counter(baseline_key(v) for v in violations)
+    entries: List[dict] = [
+        {"file": f, "rule": r, "snippet": s, "count": c}
+        for (f, r, s), c in sorted(counts.items())
+    ]
+    data = {"version": BASELINE_VERSION, "entries": entries}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return data
+
+
+def apply_baseline(report: Report, allow: Counter) -> Report:
+    """Filter baselined violations out of ``report`` (in place): each
+    (file, rule, snippet) key absorbs up to its count. Leftover
+    allowances are surfaced as ``unused_baseline`` so stale entries are
+    visible (and removable) instead of silently masking future
+    regressions at the same key."""
+    remaining = Counter(allow)
+    kept: List[Violation] = []
+    baselined = 0
+    for v in report.violations:
+        k = baseline_key(v)
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            baselined += 1
+        else:
+            kept.append(v)
+    report.violations = kept
+    report.baselined = baselined
+    report.unused_baseline = [
+        {"file": f, "rule": r, "snippet": s, "count": c}
+        for (f, r, s), c in sorted(remaining.items())
+        if c > 0
+    ]
+    return report

@@ -1,0 +1,152 @@
+"""photon-lint CLI: text (clickable file:line:col) and --json modes.
+
+Exit codes: 0 clean, 1 non-baselined violations, 2 analysis/usage error
+(a file that does not parse is an error, not a pass).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from photon_ml_tpu.lint.baseline import (
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from photon_ml_tpu.lint.core import RULES, _load_rules, analyze_paths
+
+DEFAULT_BASELINE = ".photon-lint-baseline.json"
+DEFAULT_PATHS = ("photon_ml_tpu", "bench.py")
+
+
+def _default_paths() -> List[str]:
+    return [p for p in DEFAULT_PATHS if os.path.exists(p)]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m photon_ml_tpu.lint",
+        description=(
+            "AST-based invariant checker for the JAX hot path "
+            "(readback seam, recompile hazards, spill/IO hygiene). "
+            "Suppress a line with '# photon: allow(<rule>)'."
+        ),
+    )
+    p.add_argument(
+        "paths", nargs="*",
+        help="files or directories (default: photon_ml_tpu bench.py)",
+    )
+    p.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable report (violations, baselined count, "
+             "allow-sites with seam accounting, unused baseline entries)",
+    )
+    p.add_argument(
+        "--baseline", default=None, metavar="PATH",
+        help=f"baseline file (default: {DEFAULT_BASELINE} when present)",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any baseline file",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="write the current violation set as the new baseline "
+             "and exit 0",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule table and exit",
+    )
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        _load_rules()
+        for rule in sorted(RULES.values(), key=lambda r: r.id):
+            print(f"{rule.id}  {rule.slug:20s}  {rule.doc}")
+        return 0
+
+    paths = args.paths or _default_paths()
+    if not paths:
+        print(
+            "photon-lint: no paths given and no default targets found",
+            file=sys.stderr,
+        )
+        return 2
+
+    report = analyze_paths(paths)
+
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None
+    )
+    if args.write_baseline:
+        target = args.baseline or DEFAULT_BASELINE
+        data = write_baseline(target, report.violations)
+        print(
+            f"photon-lint: wrote {len(data['entries'])} baseline "
+            f"entr{'y' if len(data['entries']) == 1 else 'ies'} "
+            f"({len(report.violations)} violation(s)) to {target}"
+        )
+        return 0
+
+    if baseline_path and not args.no_baseline:
+        try:
+            allow = load_baseline(baseline_path)
+        except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+            print(f"photon-lint: bad baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        apply_baseline(report, allow)
+
+    exit_code = 0
+    if report.violations:
+        exit_code = 1
+    if report.errors:
+        exit_code = 2
+
+    if args.as_json:
+        print(json.dumps(
+            {
+                "version": 1,
+                "files_checked": len(report.files),
+                "violations": [v.to_dict() for v in report.violations],
+                "baselined": report.baselined,
+                "allow_sites": [
+                    s.to_dict() for s in report.allow_sites
+                ],
+                "unused_baseline": report.unused_baseline,
+                "errors": [
+                    {"file": f, "message": m} for f, m in report.errors
+                ],
+                "exit_code": exit_code,
+            },
+            indent=2,
+        ))
+        return exit_code
+
+    for f, m in report.errors:
+        print(f"{f}:1:0: ERROR {m}")
+    for v in report.violations:
+        print(f"{v.location()}: {v.rule} [{v.slug}] {v.message}")
+    for e in report.unused_baseline:
+        print(
+            f"warning: unused baseline entry {e['file']} {e['rule']} "
+            f"{e['snippet']!r} x{e['count']} — fixed? remove it",
+        )
+    n = len(report.violations)
+    print(
+        f"photon-lint: {n} violation(s), {report.baselined} baselined, "
+        f"{len(report.files)} file(s) checked"
+    )
+    return exit_code
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via __main__
+    sys.exit(main())

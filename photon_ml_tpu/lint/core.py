@@ -1,0 +1,512 @@
+"""Analyzer core: file contexts, the rule registry, suppressions.
+
+Everything here is stdlib-only (``ast`` + ``tokenize``) — the analyzer
+must run in any environment the package runs in, including the minimal
+CI container, without importing jax or the package under analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import (
+    Callable,
+    Dict,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+)
+
+# -- violations and the rule registry ----------------------------------------
+
+
+@dataclass
+class Violation:
+    rule: str  # "PL001"
+    slug: str  # "hidden-host-sync"
+    path: str  # normalized (posix, relative when possible)
+    line: int
+    col: int
+    message: str
+    snippet: str = ""  # stripped source line, the baseline matching key
+    # The allow-site audit emits violations AT suppression comments; those
+    # must not be swallowed by the very comment they audit.
+    suppressable: bool = True
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "slug": self.slug,
+            "file": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "snippet": self.snippet,
+        }
+
+
+@dataclass
+class Rule:
+    id: str
+    slug: str
+    doc: str
+    check: Callable[["FileContext"], Iterable[Violation]]
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Rule:
+    RULES[rule.id] = rule
+    return rule
+
+
+def _load_rules() -> None:
+    """Import the rule modules (each registers itself on import)."""
+    if not RULES:
+        import photon_ml_tpu.lint.rules  # noqa: F401
+
+
+# -- suppression comments ----------------------------------------------------
+
+_ALLOW_RE = re.compile(r"#\s*photon:\s*allow\(\s*([A-Za-z0-9_\-,\s]*?)\s*\)")
+
+
+@dataclass
+class AllowSite:
+    line: int  # line the comment is ON
+    applies_to: int  # line the suppression covers
+    rules: Set[str]  # tokens as written (ids and/or slugs)
+    path: str = ""
+    # set by the PL001 audit for hidden-host-sync sites: does the
+    # enclosing scope feed the counted seam / serial switch?
+    seam_ok: Optional[bool] = None
+
+    def to_dict(self) -> dict:
+        d = {
+            "file": self.path,
+            "line": self.line,
+            "applies_to": self.applies_to,
+            "rules": sorted(self.rules),
+        }
+        if self.seam_ok is not None:
+            d["seam_ok"] = self.seam_ok
+        return d
+
+
+# -- per-file analysis context -----------------------------------------------
+
+# module roots whose values are device arrays (taint sources)
+_JAX_ROOT_MODULES = ("jax",)
+_STATIC_ATTRS = {
+    "shape", "ndim", "dtype", "size", "sharding", "weak_type", "aval",
+}
+# jax.* calls returning host metadata, not device arrays
+_JAX_METADATA_FUNCS = {
+    "devices", "local_devices", "device_count", "local_device_count",
+    "process_index", "process_count", "default_backend", "make_mesh",
+}
+
+
+class FileContext:
+    """Parsed source + the cross-rule queries every check needs: parent
+    links, enclosing scopes, import aliases, suppressions, and a local
+    (per-scope) jax-value taint."""
+
+    def __init__(self, path: str, source: str):
+        self.path = norm_path(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[id(child)] = node
+        self.allow_sites: List[AllowSite] = []
+        self._suppressed: Dict[int, Set[str]] = {}
+        self._scan_comments()
+        # import aliases
+        self.jax_modules: Set[str] = set()  # names aliasing jax[. ...]
+        self.numpy_modules: Set[str] = set()  # names aliasing numpy
+        self.jax_names: Set[str] = set()  # from jax import <name>
+        self.overlap_modules: Set[str] = set()  # names aliasing ...overlap
+        self.overlap_names: Set[str] = set()  # from ...overlap import <n>
+        self._scan_imports()
+        self._taint_cache: Dict[int, Set[str]] = {}
+
+    # -- structure ----------------------------------------------------------
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(id(node))
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def scope_of(self, node: ast.AST) -> ast.AST:
+        """Innermost function scope, or the module itself."""
+        return self.enclosing_function(node) or self.tree
+
+    def path_parts(self) -> Tuple[str, ...]:
+        return tuple(p for p in self.path.split("/") if p)
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def violation(
+        self, rule: "Rule", node: ast.AST, message: str, **kw
+    ) -> Violation:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Violation(
+            rule=rule.id, slug=rule.slug, path=self.path, line=line,
+            col=col, message=message, snippet=self.snippet(line), **kw,
+        )
+
+    # -- scope queries -------------------------------------------------------
+
+    def scope_calls(self, scope: ast.AST, names: Set[str]) -> bool:
+        """Does ``scope`` directly call (or reference) any of ``names``
+        (bare name or attribute), not counting nested function bodies?"""
+        for node in self.walk_scope(scope):
+            if isinstance(node, ast.Name) and node.id in names:
+                return True
+            if isinstance(node, ast.Attribute) and node.attr in names:
+                return True
+        return False
+
+    def walk_scope(self, scope: ast.AST) -> Iterator[ast.AST]:
+        """Walk a function/module body without descending into nested
+        function/class definitions."""
+        body = scope.body if hasattr(scope, "body") else []
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                     ast.Lambda),
+                ):
+                    continue
+                stack.append(child)
+
+    # -- imports -------------------------------------------------------------
+
+    def _scan_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    top = alias.name.split(".")[0]
+                    name = alias.asname or alias.name.split(".")[0]
+                    if top in _JAX_ROOT_MODULES:
+                        self.jax_modules.add(alias.asname or top)
+                    if top == "numpy":
+                        self.numpy_modules.add(alias.asname or top)
+                    if alias.name.endswith("parallel.overlap"):
+                        self.overlap_modules.add(name)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for alias in node.names:
+                    name = alias.asname or alias.name
+                    if mod.split(".")[0] in _JAX_ROOT_MODULES:
+                        if alias.name == "numpy":
+                            self.jax_modules.add(name)
+                        else:
+                            self.jax_names.add(name)
+                    if mod == "numpy":
+                        self.numpy_modules.add(name)  # from numpy import *
+                    if mod.endswith("parallel.overlap"):
+                        self.overlap_names.add(name)
+                    if mod.endswith("parallel") and alias.name == "overlap":
+                        self.overlap_modules.add(name)
+
+    def is_jax_module(self, node: ast.AST) -> bool:
+        return isinstance(node, ast.Name) and node.id in self.jax_modules
+
+    def is_numpy_module(self, node: ast.AST) -> bool:
+        return isinstance(node, ast.Name) and node.id in self.numpy_modules
+
+    def is_overlap_module(self, node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Name) and node.id in self.overlap_modules
+        )
+
+    # -- suppressions --------------------------------------------------------
+
+    def _scan_comments(self) -> None:
+        try:
+            tokens = list(
+                tokenize.generate_tokens(io.StringIO(self.source).readline)
+            )
+        except tokenize.TokenError:
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _ALLOW_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {
+                r.strip() for r in m.group(1).split(",") if r.strip()
+            }
+            line = tok.start[0]
+            text_before = self.lines[line - 1][: tok.start[1]].strip()
+            applies_to = line if text_before else self._next_code_line(line)
+            site = AllowSite(
+                line=line, applies_to=applies_to, rules=rules,
+                path=self.path,
+            )
+            self.allow_sites.append(site)
+            self._suppressed.setdefault(applies_to, set()).update(rules)
+
+    def _next_code_line(self, comment_line: int) -> int:
+        for ln in range(comment_line + 1, len(self.lines) + 1):
+            text = self.lines[ln - 1].strip()
+            if text and not text.startswith("#"):
+                return ln
+        return comment_line
+
+    def suppressed(self, violation: Violation) -> bool:
+        if not violation.suppressable:
+            return False
+        toks = self._suppressed.get(violation.line)
+        if not toks:
+            return False
+        return bool(
+            toks & {violation.rule, violation.slug, "*", "all"}
+        )
+
+    # -- local jax-value taint ----------------------------------------------
+
+    def jax_taint(
+        self, scope: ast.AST, include_params: bool = False,
+        exclude_params: Sequence[str] = (),
+    ) -> Set[str]:
+        """Names in ``scope`` that provably hold jax values: assigned from
+        ``jax.*``/``jnp.*`` expressions (or derived from such names).
+        With ``include_params`` the scope's own parameters seed the set —
+        the right semantics inside a jitted body, where every non-static
+        argument is a tracer."""
+        key = (id(scope), include_params, tuple(exclude_params))
+        cached = self._taint_cache.get(key)
+        if cached is not None:
+            return cached
+        tainted: Set[str] = set()
+        if include_params and hasattr(scope, "args"):
+            a = scope.args
+            params = [
+                p.arg
+                for p in (
+                    list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                )
+            ]
+            if a.vararg:
+                params.append(a.vararg.arg)
+            if a.kwarg:
+                params.append(a.kwarg.arg)
+            tainted.update(
+                p for p in params
+                if p not in exclude_params and p != "self"
+            )
+        # fixpoint over straight-line assignments (monotone, so a couple
+        # of passes converge; bound defensively)
+        for _ in range(10):
+            before = len(tainted)
+            for node in self.walk_scope(scope):
+                if isinstance(node, ast.Assign):
+                    if self.expr_tainted(node.value, tainted):
+                        for tgt in node.targets:
+                            self._taint_target(tgt, tainted)
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    if self.expr_tainted(node.value, tainted):
+                        self._taint_target(node.target, tainted)
+                elif isinstance(node, ast.AugAssign):
+                    if self.expr_tainted(node.value, tainted):
+                        self._taint_target(node.target, tainted)
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    if self.expr_tainted(node.iter, tainted):
+                        self._taint_target(node.target, tainted)
+            if len(tainted) == before:
+                break
+        self._taint_cache[key] = tainted
+        return tainted
+
+    def _taint_target(self, target: ast.AST, tainted: Set[str]) -> None:
+        if isinstance(target, ast.Name):
+            tainted.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._taint_target(elt, tainted)
+        elif isinstance(target, ast.Starred):
+            self._taint_target(target.value, tainted)
+
+    def expr_tainted(self, expr: ast.AST, tainted: Set[str]) -> bool:
+        """Does this expression (conservatively, low-false-positive)
+        evaluate to a jax value?"""
+        if isinstance(expr, ast.Name):
+            return expr.id in tainted
+        if isinstance(expr, ast.Call):
+            root = _attr_root(expr.func)
+            if root is not None and root.id in self.jax_modules:
+                tail = (
+                    expr.func.attr
+                    if isinstance(expr.func, ast.Attribute)
+                    else ""
+                )
+                return tail not in _JAX_METADATA_FUNCS
+            if isinstance(expr.func, ast.Name) and expr.func.id in tainted:
+                return True  # calling a jitted/taint-derived callable
+            if isinstance(expr.func, ast.Attribute):
+                # method on a tainted value: x.sum(), x.astype(...)
+                return self.expr_tainted(expr.func.value, tainted)
+            return False
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _STATIC_ATTRS:
+                return False
+            return self.expr_tainted(expr.value, tainted)
+        if isinstance(expr, ast.Subscript):
+            return self.expr_tainted(expr.value, tainted)
+        if isinstance(expr, ast.BinOp):
+            return self.expr_tainted(
+                expr.left, tainted
+            ) or self.expr_tainted(expr.right, tainted)
+        if isinstance(expr, ast.UnaryOp):
+            return self.expr_tainted(expr.operand, tainted)
+        if isinstance(expr, ast.Compare):
+            return self.expr_tainted(expr.left, tainted) or any(
+                self.expr_tainted(c, tainted) for c in expr.comparators
+            )
+        if isinstance(expr, ast.BoolOp):
+            return any(self.expr_tainted(v, tainted) for v in expr.values)
+        if isinstance(expr, ast.IfExp):
+            return self.expr_tainted(
+                expr.body, tainted
+            ) or self.expr_tainted(expr.orelse, tainted)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self.expr_tainted(e, tainted) for e in expr.elts)
+        if isinstance(expr, ast.Starred):
+            return self.expr_tainted(expr.value, tainted)
+        return False
+
+
+def _attr_root(node: ast.AST) -> Optional[ast.Name]:
+    """Root Name of a dotted chain: ``jax.numpy.asarray`` -> Name(jax)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node if isinstance(node, ast.Name) else None
+
+
+def attr_root(node: ast.AST) -> Optional[ast.Name]:
+    return _attr_root(node)
+
+
+def call_name(node: ast.Call) -> str:
+    """Trailing callee name: ``overlap.submit_io(...)`` -> ``submit_io``."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+# -- file walking and reports ------------------------------------------------
+
+
+def norm_path(path: str) -> str:
+    p = os.path.normpath(path)
+    try:
+        rel = os.path.relpath(p)
+        # only relativize when it stays inside the tree (no ../ escapes)
+        if not rel.startswith(".."):
+            p = rel
+    except ValueError:
+        pass
+    return p.replace(os.sep, "/")
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(
+                d for d in dirs
+                if d != "__pycache__" and not d.startswith(".")
+            )
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+@dataclass
+class Report:
+    files: List[str] = field(default_factory=list)
+    violations: List[Violation] = field(default_factory=list)
+    allow_sites: List[AllowSite] = field(default_factory=list)
+    errors: List[Tuple[str, str]] = field(default_factory=list)
+    # filled by baseline application (cli)
+    baselined: int = 0
+    unused_baseline: List[dict] = field(default_factory=list)
+
+
+def analyze_source(path: str, source: str) -> Report:
+    """Run every registered rule over one in-memory source blob."""
+    _load_rules()
+    report = Report(files=[norm_path(path)])
+    try:
+        ctx = FileContext(path, source)
+    except SyntaxError as e:
+        report.errors.append((norm_path(path), f"syntax error: {e}"))
+        return report
+    for rule in RULES.values():
+        for v in rule.check(ctx):
+            if not ctx.suppressed(v):
+                report.violations.append(v)
+    report.allow_sites.extend(ctx.allow_sites)
+    report.violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return report
+
+
+def analyze_paths(paths: Sequence[str]) -> Report:
+    _load_rules()
+    report = Report()
+    for fp in iter_python_files(paths):
+        try:
+            with open(fp, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as e:
+            report.errors.append((norm_path(fp), str(e)))
+            continue
+        sub = analyze_source(fp, source)
+        report.files.extend(sub.files)
+        report.violations.extend(sub.violations)
+        report.allow_sites.extend(sub.allow_sites)
+        report.errors.extend(sub.errors)
+    report.violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return report

@@ -1,0 +1,9 @@
+"""Rule modules — importing this package registers every rule."""
+
+from photon_ml_tpu.lint.rules import (  # noqa: F401
+    host_sync,
+    io_drain,
+    recompile,
+    spill,
+    tracer_leak,
+)

@@ -1,0 +1,131 @@
+"""PL002 recompile-hazard: jit caches key on callable identity.
+
+``jax.jit(lambda ...)`` mints a fresh callable — and a fresh compilation
+cache — every time the line runs; the same applies to a ``@jax.jit`` def
+re-executed inside a loop, and to unhashable ``static_argnums``/
+``static_argnames`` literals. The pjit/TPUv4 scaling report calls silent
+recompilation the dominant wall-clock regression class in XLA training
+stacks; this rule catches the three shapes that cause it here. Named
+module-level (or build-once factory) defs passed to ``jax.jit`` are
+fine — identity is stable across calls to the jitted wrapper.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from photon_ml_tpu.lint.core import (
+    FileContext,
+    Rule,
+    Violation,
+    attr_root,
+    register,
+)
+
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def is_jit_expr(ctx: FileContext, expr: ast.AST) -> bool:
+    """``jax.jit`` / ``pjit`` / ``jax.experimental.pjit.pjit`` as an
+    expression (decorator or call target)."""
+    if isinstance(expr, ast.Attribute) and expr.attr in _JIT_NAMES:
+        root = attr_root(expr)
+        return root is not None and root.id in ctx.jax_modules
+    if isinstance(expr, ast.Name) and expr.id in _JIT_NAMES:
+        return expr.id in ctx.jax_names
+    return False
+
+
+def jit_call_parts(
+    ctx: FileContext, node: ast.Call
+) -> Optional[ast.Call]:
+    """If ``node`` is a jit invocation — ``jax.jit(...)`` or
+    ``partial(jax.jit, ...)`` — return the Call carrying jit's args."""
+    if is_jit_expr(ctx, node.func):
+        return node
+    func = node.func
+    name = func.attr if isinstance(func, ast.Attribute) else (
+        func.id if isinstance(func, ast.Name) else ""
+    )
+    if name == "partial" and node.args and is_jit_expr(ctx, node.args[0]):
+        return node
+    return None
+
+
+def _in_loop(ctx: FileContext, node: ast.AST) -> bool:
+    """Is ``node`` lexically inside a loop body, within its own function
+    (a def boundary resets the question — calling the inner function in
+    a loop is a runtime property, not a lexical one)?"""
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+    return False
+
+
+def _check(ctx: FileContext) -> Iterator[Violation]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            jc = jit_call_parts(ctx, node)
+            if jc is None:
+                continue
+            target = None
+            # partial(jax.jit, f, ...) puts the callee at args[1]
+            args = jc.args[1:] if jc.args and is_jit_expr(
+                ctx, jc.args[0]
+            ) else jc.args
+            if args:
+                target = args[0]
+            if isinstance(target, ast.Lambda):
+                yield ctx.violation(
+                    RULE, node,
+                    "jit of a lambda: a fresh callable (and a fresh "
+                    "compile cache) every time this line runs — jit a "
+                    "module-level def, or close over statics with "
+                    "static_argnums on a named function",
+                )
+            if _in_loop(ctx, node):
+                yield ctx.violation(
+                    RULE, node,
+                    "jit call inside a loop re-wraps (and recompiles) "
+                    "per iteration — hoist the jitted callable out of "
+                    "the loop",
+                )
+            for kw in jc.keywords:
+                if kw.arg in ("static_argnums", "static_argnames"):
+                    if isinstance(kw.value, (ast.List, ast.Set, ast.Dict)):
+                        yield ctx.violation(
+                            RULE, kw.value,
+                            f"{kw.arg} given a "
+                            f"{type(kw.value).__name__.lower()} literal: "
+                            "unhashable values defeat the jit cache key "
+                            "— use a tuple",
+                        )
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            jitted = any(
+                is_jit_expr(ctx, d)
+                or (
+                    isinstance(d, ast.Call)
+                    and jit_call_parts(ctx, d) is not None
+                )
+                for d in node.decorator_list
+            )
+            if jitted and _in_loop(ctx, node):
+                yield ctx.violation(
+                    RULE, node,
+                    "@jit def inside a loop body is re-created (and "
+                    "recompiled) every iteration — define it once "
+                    "outside the loop",
+                )
+
+
+RULE = register(
+    Rule(
+        id="PL002",
+        slug="recompile-hazard",
+        doc="no jit-of-lambda, jit-in-loop, or unhashable static args",
+        check=_check,
+    )
+)

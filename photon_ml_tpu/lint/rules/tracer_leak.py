@@ -1,0 +1,205 @@
+"""PL003 tracer-leak: traced values escaping or steering a jitted body.
+
+Inside a jit trace every non-static argument is a tracer. Storing one on
+``self``/a global outlives the trace (a leaked tracer errors — or worse,
+silently captures a stale constant on re-trace); branching on one with
+Python ``if``/``while`` either crashes at trace time or, when the value
+happens to be concrete on the first call, bakes one branch in and trains
+the wrong model on every later call. veScale's eager-SPMD consistency
+work stresses exactly this class: host-visible control flow must not
+depend on device values. Static metadata (``.shape``/``.ndim``/
+``.dtype``/``len()``/``isinstance``/``is None``) stays legal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set
+
+from photon_ml_tpu.lint.core import (
+    FileContext,
+    Rule,
+    Violation,
+    register,
+)
+from photon_ml_tpu.lint.rules.recompile import is_jit_expr, jit_call_parts
+
+_STATIC_ATTRS = {
+    "shape", "ndim", "dtype", "size", "sharding", "weak_type", "aval",
+}
+_STATIC_CALLS = {
+    "isinstance", "len", "getattr", "hasattr", "type", "callable", "id",
+}
+
+
+def _jit_static_params(
+    fdef: ast.AST, jit_call: ast.Call
+) -> Set[str]:
+    """Param names marked static via static_argnums/static_argnames
+    literals on the jit decorator/call."""
+    args = fdef.args
+    positional = [
+        p.arg for p in list(args.posonlyargs) + list(args.args)
+    ]
+    static: Set[str] = set()
+    for kw in jit_call.keywords:
+        vals: List[ast.AST] = (
+            list(kw.value.elts)
+            if isinstance(kw.value, (ast.Tuple, ast.List))
+            else [kw.value]
+        )
+        if kw.arg == "static_argnums":
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(
+                    v.value, int
+                ) and 0 <= v.value < len(positional):
+                    static.add(positional[v.value])
+        elif kw.arg == "static_argnames":
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(
+                    v.value, str
+                ):
+                    static.add(v.value)
+    return static
+
+
+def _jitted_defs(ctx: FileContext):
+    """(FunctionDef, static_params) for every def that is jit-compiled:
+    decorated with jit (directly or partial-wrapped), or passed by name
+    to a jit call anywhere in the module."""
+    by_name = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+    out = []
+    seen = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in node.decorator_list:
+                if is_jit_expr(ctx, d):
+                    out.append((node, set()))
+                    seen.add(id(node))
+                elif isinstance(d, ast.Call):
+                    jc = jit_call_parts(ctx, d)
+                    if jc is not None:
+                        out.append((node, _jit_static_params(node, jc)))
+                        seen.add(id(node))
+        elif isinstance(node, ast.Call):
+            jc = jit_call_parts(ctx, node)
+            if jc is None:
+                continue
+            cargs = jc.args[1:] if jc.args and is_jit_expr(
+                ctx, jc.args[0]
+            ) else jc.args
+            if cargs and isinstance(cargs[0], ast.Name):
+                for fdef in by_name.get(cargs[0].id, []):
+                    if id(fdef) not in seen:
+                        out.append((fdef, _jit_static_params(fdef, jc)))
+                        seen.add(id(fdef))
+    return out
+
+
+def _uses_traced_value(
+    ctx: FileContext, expr: ast.AST, tainted: Set[str]
+) -> bool:
+    """Does the VALUE (not static metadata) of a traced name feed this
+    expression?"""
+    if isinstance(expr, ast.Name):
+        return expr.id in tainted
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in _STATIC_ATTRS:
+            return False
+        return _uses_traced_value(ctx, expr.value, tainted)
+    if isinstance(expr, ast.Call):
+        func = expr.func
+        if isinstance(func, ast.Name) and func.id in _STATIC_CALLS:
+            return False
+        if _uses_traced_value(ctx, func, tainted):
+            return True  # method on a traced value: x.any(), x.item()
+        return any(
+            _uses_traced_value(ctx, a, tainted) for a in expr.args
+        ) or any(
+            _uses_traced_value(ctx, kw.value, tainted)
+            for kw in expr.keywords
+        )
+    if isinstance(expr, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+            return False  # `x is None` is a static identity test
+        return _uses_traced_value(ctx, expr.left, tainted) or any(
+            _uses_traced_value(ctx, c, tainted)
+            for c in expr.comparators
+        )
+    if isinstance(expr, ast.BoolOp):
+        return any(
+            _uses_traced_value(ctx, v, tainted) for v in expr.values
+        )
+    if isinstance(expr, (ast.BinOp,)):
+        return _uses_traced_value(
+            ctx, expr.left, tainted
+        ) or _uses_traced_value(ctx, expr.right, tainted)
+    if isinstance(expr, ast.UnaryOp):
+        return _uses_traced_value(ctx, expr.operand, tainted)
+    if isinstance(expr, ast.Subscript):
+        return _uses_traced_value(ctx, expr.value, tainted)
+    if isinstance(expr, ast.IfExp):
+        return (
+            _uses_traced_value(ctx, expr.test, tainted)
+            or _uses_traced_value(ctx, expr.body, tainted)
+            or _uses_traced_value(ctx, expr.orelse, tainted)
+        )
+    return False
+
+
+def _check(ctx: FileContext) -> Iterator[Violation]:
+    for fdef, static in _jitted_defs(ctx):
+        tainted = ctx.jax_taint(
+            fdef, include_params=True, exclude_params=sorted(static)
+        )
+        for node in ctx.walk_scope(fdef):
+            if isinstance(node, ast.Global):
+                yield ctx.violation(
+                    RULE, node,
+                    "global statement inside a jitted body: a traced "
+                    "value written to module state outlives the trace "
+                    "(leaked tracer / stale capture on re-trace)",
+                )
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for tgt in targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        yield ctx.violation(
+                            RULE, tgt,
+                            "assignment to self.%s inside a jitted body "
+                            "stores a tracer on the instance — it "
+                            "escapes the trace and is invalid (or "
+                            "silently stale) outside it" % tgt.attr,
+                        )
+            elif isinstance(node, (ast.If, ast.While)):
+                if _uses_traced_value(ctx, node.test, tainted):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield ctx.violation(
+                        RULE, node,
+                        f"Python {kind} on a traced value inside a "
+                        "jitted body — use jnp.where / lax.cond / "
+                        "lax.while_loop (shape/dtype/is-None tests "
+                        "stay legal)",
+                    )
+
+
+RULE = register(
+    Rule(
+        id="PL003",
+        slug="tracer-leak",
+        doc="no tracers stored on self/globals or Python-branched on "
+            "inside jitted bodies",
+        check=_check,
+    )
+)

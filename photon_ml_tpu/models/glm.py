@@ -14,7 +14,6 @@ carry the task type and expose the reference's API surface.
 from __future__ import annotations
 
 from dataclasses import dataclass, replace
-from typing import Optional
 
 import jax.numpy as jnp
 

@@ -34,7 +34,12 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from photon_ml_tpu.data.batch import Batch, DenseBatch, SparseBatch, sparse_dot, sparse_scatter_add
+from photon_ml_tpu.data.batch import (
+    Batch,
+    SparseBatch,
+    sparse_dot,
+    sparse_scatter_add,
+)
 from photon_ml_tpu.ops.losses import PointwiseLoss
 from photon_ml_tpu.ops.normalization import NormalizationContext, identity_context
 

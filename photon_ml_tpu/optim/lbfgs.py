@@ -34,7 +34,6 @@ from photon_ml_tpu.optim.common import (
     BoxConstraints,
     GRADIENT_WITHIN_TOLERANCE,
     LINE_SEARCH_STALLED,
-    MAX_ITERATIONS,
     NOT_CONVERGED,
     OptResult,
     Tracker,

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-from photon_ml_tpu.data.batch import Batch, DenseBatch
+from photon_ml_tpu.data.batch import Batch
 from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.optim.common import OptResult
 from photon_ml_tpu.optim.lbfgs import minimize_lbfgs

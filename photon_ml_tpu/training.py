@@ -11,7 +11,6 @@ runtime scalar).
 
 from __future__ import annotations
 
-import logging
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp

@@ -14,7 +14,6 @@ import os
 from typing import Any, Dict, Optional
 
 import jax.numpy as jnp
-import numpy as np
 import orbax.checkpoint as ocp
 
 

@@ -9,7 +9,7 @@ key-policy fixes cannot drift between copies.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable
 
 
 def get_or_build(cache: dict, max_size: int, key, build: Callable):

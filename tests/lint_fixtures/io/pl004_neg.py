@@ -1,0 +1,15 @@
+"""PL004 negatives: registered scratch dirs."""
+
+import tempfile
+
+from photon_ml_tpu.io.streaming import make_spill_dir, register_spill_dir
+
+
+def registered_scratch():
+    path = tempfile.mkdtemp(prefix="photon-spill-")
+    register_spill_dir(path)  # paired with the sweep — fine
+    return path
+
+
+def through_helper():
+    return make_spill_dir("photon-spill-")  # the blessed factory — fine

@@ -1,0 +1,16 @@
+"""PL004 positives (path contains an io/ segment, so the rule applies)."""
+
+import tempfile
+from tempfile import TemporaryDirectory, mkdtemp
+
+
+def unswept_scratch():
+    return tempfile.mkdtemp(prefix="photon-spill-")  # violation
+
+
+def unswept_bare():
+    return mkdtemp(prefix="photon-spill-")  # violation
+
+
+def unswept_tempdir():
+    return TemporaryDirectory(prefix="photon-spill-")  # violation

@@ -1,0 +1,20 @@
+"""Seam-audit negatives: allowed raw fetches that stay accounted — the
+scope either gates on the overlap-off serial switch or also feeds the
+counted seam."""
+
+import jax
+
+from photon_ml_tpu.parallel import overlap
+from photon_ml_tpu.parallel.overlap import overlap_enabled
+
+
+def serial_path_fetch(tree):
+    if not overlap_enabled():
+        return jax.device_get(tree)  # photon: allow(hidden-host-sync)
+    return overlap.device_get(tree)
+
+
+def counted_alongside(tree, other):
+    host = overlap.device_get(other)  # the counted fetch
+    raw = jax.device_get(tree)  # photon: allow(hidden-host-sync)
+    return host, raw

@@ -1,0 +1,9 @@
+"""Seam-audit positive: an allow(hidden-host-sync) in package-path code
+whose scope never touches the seam — the readback routes around the
+counter, so the allow itself is a violation (and unsuppressable)."""
+
+import jax
+
+
+def rogue_allowed_fetch(tree):
+    return jax.device_get(tree)  # photon: allow(hidden-host-sync)

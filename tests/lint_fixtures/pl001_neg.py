@@ -1,0 +1,33 @@
+"""PL001 negatives: counted-seam fetches and genuinely-host values."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_ml_tpu.parallel import overlap
+from photon_ml_tpu.parallel.overlap import device_get
+
+
+def seam_fetch(tree):
+    return overlap.device_get(tree)  # the counted seam — fine
+
+
+def seam_fetch_bare(tree):
+    return device_get(tree)  # imported FROM overlap — fine
+
+
+def host_values_stay_host():
+    xs = [1.0, 2.0]
+    a = float(xs[0])  # plain python — fine
+    b = np.asarray(xs)  # numpy on host data — fine
+    return a, b
+
+
+def jnp_asarray_is_not_a_sync():
+    host = np.zeros((4,))
+    return jnp.asarray(host)  # host->device, not a readback — fine
+
+
+def metadata_is_host_side():
+    devs = jax.devices()
+    return np.asarray(devs), int(jax.device_count())  # metadata — fine

@@ -1,0 +1,33 @@
+"""PL001 positives: every statement here is a hidden host sync."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def raw_device_get(tree):
+    return jax.device_get(tree)  # violation: raw fetch
+
+
+def raw_block(x):
+    x.block_until_ready()  # violation: hidden sync
+    return x
+
+
+def np_asarray_on_jax():
+    device = jnp.ones((4,))
+    return np.asarray(device)  # violation: host copy of a jax value
+
+
+def scalar_casts():
+    total = jnp.sum(jnp.arange(3))
+    a = float(total)  # violation
+    b = int(total)  # violation
+    c = bool(total > 0)  # violation
+    return a, b, c
+
+
+def derived_taint():
+    x = jnp.zeros((2,))
+    y = x + 1.0  # taint flows through arithmetic
+    return float(y[0])  # violation

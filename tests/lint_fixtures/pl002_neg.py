@@ -1,0 +1,37 @@
+"""PL002 negatives: stable-identity jit usage."""
+
+import jax
+from functools import partial
+
+import jax.numpy as jnp
+
+
+def _moments(b, dim):
+    return jnp.sum(b) * dim
+
+
+_MOMENTS_JIT = jax.jit(_moments, static_argnums=(1,))  # tuple — fine
+
+
+@jax.jit
+def decorated(x):
+    return x * 2.0
+
+
+@partial(jax.jit, static_argnums=(1,))
+def decorated_partial(x, flag):
+    return x if flag else -x
+
+
+def factory(dim):
+    def fit(w):
+        return jnp.sum(w) * dim
+
+    return jax.jit(fit)  # named def, built once per factory call — fine
+
+
+def loop_calls_prebuilt(xs):
+    out = []
+    for x in xs:
+        out.append(decorated(x))  # calling a jitted fn in a loop — fine
+    return out

@@ -1,0 +1,37 @@
+"""PL002 positives: recompile hazards."""
+
+import jax
+from functools import partial
+
+import jax.numpy as jnp
+
+
+def jit_of_lambda(dim):
+    return jax.jit(lambda b: jnp.sum(b) * dim)  # violation: lambda
+
+
+def jit_in_loop(fns, xs):
+    out = []
+    for f in fns:
+        jf = jax.jit(f)  # violation: re-wrapped per iteration
+        out.append(jf(xs))
+    return out
+
+
+def jit_def_in_loop(xs):
+    outs = []
+    for x in xs:
+        @jax.jit  # violation: def re-created per iteration
+        def step(v):
+            return v * 2.0
+
+        outs.append(step(x))
+    return outs
+
+
+def unhashable_static(f):
+    return jax.jit(f, static_argnums=[0, 1])  # violation: list literal
+
+
+def unhashable_static_partial(f):
+    return partial(jax.jit, static_argnames=["dim"])(f)  # violation

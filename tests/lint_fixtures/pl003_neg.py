@@ -1,0 +1,43 @@
+"""PL003 negatives: static control flow inside jitted bodies."""
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+@jax.jit
+def static_metadata(x):
+    if x.shape[0] > 4:  # shapes are static at trace time — fine
+        return x[:4]
+    if x.ndim == 1:  # fine
+        return x
+    return jnp.ravel(x)
+
+
+@jax.jit
+def none_and_isinstance(x, scale=None):
+    if scale is None:  # identity test — fine
+        scale = 1.0
+    if isinstance(x, tuple):  # fine
+        x = x[0]
+    if len(x.shape) == 2:  # fine
+        x = x[0]
+    return x * scale
+
+
+@partial(jax.jit, static_argnames=("flag",))
+def static_arg_branch(x, flag):
+    if flag:  # static argument — fine
+        return x * 2.0
+    return x
+
+
+def not_jitted(x):
+    if x > 0:  # plain python function — fine
+        return x
+    return -x
+
+
+@jax.jit
+def device_branching(x):
+    return jnp.where(x > 0, x, -x)  # the jax-native branch — fine

@@ -1,0 +1,42 @@
+"""PL003 positives: tracers escaping or steering jitted bodies."""
+
+import jax
+import jax.numpy as jnp
+
+_LAST = None
+
+
+class Holder:
+    @jax.jit
+    def store_on_self(self, x):
+        self.cache = x  # violation: tracer stored on the instance
+        return x * 2.0
+
+
+@jax.jit
+def branch_on_traced(x):
+    if x > 0:  # violation: python branch on a tracer
+        return x
+    return -x
+
+
+@jax.jit
+def while_on_traced(x):
+    while x < 10.0:  # violation: python loop on a tracer
+        x = x * 2.0
+    return x
+
+
+@jax.jit
+def leak_to_global(x):
+    global _LAST  # violation: traced value written to module state
+    _LAST = x
+    return x
+
+
+@jax.jit
+def branch_on_derived(x):
+    y = jnp.sum(x)
+    if y.item() > 0:  # violation: .item() concretizes the tracer
+        return x
+    return -x

@@ -1,0 +1,8 @@
+"""PL004 scope negative: outside io// game streaming the rule is silent
+(bench harnesses and tests own their own cleanup)."""
+
+import tempfile
+
+
+def bench_scratch():
+    return tempfile.mkdtemp(prefix="bench-")  # out of scope — fine

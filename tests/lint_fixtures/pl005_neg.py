@@ -1,0 +1,20 @@
+"""PL005 negatives: submit_io scopes that reach their barrier."""
+
+from photon_ml_tpu.parallel import overlap
+
+
+def submit_then_drain(write, paths):
+    for p in paths:
+        overlap.submit_io(write, p)
+    overlap.drain_io()  # barrier before return — fine
+
+
+def drain_in_finally(write, path):
+    try:
+        overlap.submit_io(write, path)
+    finally:
+        overlap.drain_io()  # fine
+
+
+def only_drains():
+    overlap.drain_io()  # draining without submitting is always fine

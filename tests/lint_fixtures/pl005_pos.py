@@ -1,0 +1,13 @@
+"""PL005 positives: submitted IO with no drain barrier in scope."""
+
+from photon_ml_tpu.parallel import overlap
+from photon_ml_tpu.parallel.overlap import submit_io
+
+
+def fire_and_forget(write, path):
+    overlap.submit_io(write, path)  # violation: nothing drains
+
+
+def fire_and_forget_bare(write, path):
+    submit_io(write, path)  # violation
+    return path

@@ -1,0 +1,32 @@
+"""Suppression fixtures: every violation here carries an allow comment.
+
+(Not under a photon_ml_tpu/ segment, so the PL001 allow-site audit stays
+informational — see the photon_ml_tpu/ fixture subtree for the audit.)
+"""
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.parallel import overlap
+
+
+def same_line_id(tree):
+    return jax.device_get(tree)  # photon: allow(PL001)
+
+
+def same_line_slug(tree):
+    return jax.device_get(tree)  # photon: allow(hidden-host-sync)
+
+
+def standalone_comment(tree):
+    # photon: allow(hidden-host-sync)
+    return jax.device_get(tree)
+
+
+def multi_rule(write, path):
+    # photon: allow(undrained-io, recompile-hazard)
+    return overlap.submit_io(write, path), jax.jit(lambda x: x)
+
+
+def wrong_rule_does_not_suppress(tree):
+    return jax.device_get(tree)  # photon: allow(recompile-hazard)

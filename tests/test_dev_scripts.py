@@ -4,9 +4,7 @@ tutorial, README.md:226-229)."""
 
 import importlib.util
 import os
-import sys
 
-import pytest
 
 _SCRIPT = os.path.join(
     os.path.dirname(__file__), "..", "dev-scripts",

@@ -7,14 +7,11 @@ mesh and asserts the results agree (fp32 reduction-order noise only).
 """
 
 import numpy as np
-import pytest
 
-import jax
 import jax.numpy as jnp
 
 from photon_ml_tpu.data.batch import make_sparse_batch
 from photon_ml_tpu.game import build_game_dataset
-from photon_ml_tpu.game.config import FeatureShardConfiguration
 from photon_ml_tpu.game.coordinate import (
     FixedEffectCoordinate,
     RandomEffectCoordinate,

@@ -9,7 +9,6 @@ import os
 import numpy as np
 import pytest
 
-import jax.numpy as jnp
 
 from photon_ml_tpu.cli.game_scoring_driver import (
     GameScoringDriver,

@@ -474,11 +474,22 @@ class TestStreamingDriver:
             streaming=True,
             input_format="LIBSVM",
         ).validate()
-        # what remains unsupported is structural: conflicting layouts
+        # Round 8 deleted the streaming x feature-sharding exclusion:
+        # plain streaming + feature-sharded validates cleanly too
+        GLMParams(
+            train_dir=train,
+            output_dir=str(tmp_path / "y"),
+            streaming=True,
+            distributed="feature",
+        ).validate()
+        # what remains unsupported is structural: normalization's
+        # shift/factor extras aren't threaded through the per-chunk
+        # sharded programs
         with pytest.raises(ValueError, match="streaming training"):
             GLMParams(
                 train_dir=train,
                 output_dir=str(tmp_path / "y"),
                 streaming=True,
                 distributed="feature",
+                normalization_type=NormalizationType.STANDARDIZATION,
             ).validate()

@@ -2,7 +2,6 @@
 optimization tracker output, deprecated/obviated flags
 (OptionNames.scala surface)."""
 
-import os
 
 import numpy as np
 import pytest

@@ -6,7 +6,6 @@ validators.
 import numpy as np
 import pytest
 
-import jax.numpy as jnp
 
 from photon_ml_tpu.data.stats import compute_summary
 from photon_ml_tpu.data.validators import (

@@ -2,12 +2,11 @@
 loader, duplicate rejection, scale smoke test, IndexMap interchangeability.
 """
 
-import os
 
 import numpy as np
 import pytest
 
-from photon_ml_tpu.utils.index_map import IndexMap, feature_key
+from photon_ml_tpu.utils.index_map import feature_key
 from photon_ml_tpu.utils.native_index import (
     NativeIndexStore,
     PartitionedIndexMap,

@@ -17,7 +17,6 @@ from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.optim import (
     BoxConstraints,
     GLMOptimizationConfiguration,
-    MAX_ITERATIONS,
     NOT_CONVERGED,
     OptimizerConfig,
     OptimizerType,

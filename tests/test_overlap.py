@@ -3,7 +3,6 @@ discipline (ONE batched fetch per GAME CD iteration, zero per-bucket
 readbacks), overlap == serial parity, pipelined == serial staging parity,
 and async checkpoint IO ordering."""
 
-import os
 
 import numpy as np
 import pytest

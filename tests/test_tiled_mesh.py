@@ -23,7 +23,6 @@ from photon_ml_tpu.ops.tiled_sparse import (
     TiledGLMObjective,
     build_sharded_tiled_batch,
     ensure_tiled_sharded,
-    tiled_batch_from_sparse,
 )
 from photon_ml_tpu.parallel.mesh import DATA_AXIS, make_mesh
 from photon_ml_tpu.task import TaskType

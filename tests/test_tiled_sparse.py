@@ -15,7 +15,6 @@ from photon_ml_tpu.ops.objective import GLMObjective
 from photon_ml_tpu.ops.tiled_sparse import (
     TileParams,
     TiledGLMObjective,
-    build_tiled_batch,
     tiled_batch_from_sparse,
 )
 

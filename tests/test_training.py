@@ -17,7 +17,7 @@ from photon_ml_tpu.data.sampler import (
     binary_classification_down_sample,
     default_down_sample,
 )
-from photon_ml_tpu.models import Coefficients, logistic_regression_model
+from photon_ml_tpu.models import logistic_regression_model
 from photon_ml_tpu.ops.normalization import (
     NormalizationType,
     build_normalization,
