@@ -251,6 +251,16 @@ class GameTrainingParams:
     # memory contract (cli.glm_driver.budgeted_reservoir_rows).
     diagnostic_reservoir_rows: int = 100_000
     diagnostic_reservoir_bytes: int = 256 << 20
+    # Fixed-effect λ-tuning policy for the combo grid (the GAME analog of
+    # the GLM driver's --grid-mode): when the grid is a PURE FE λ sweep
+    # (one fixed-effect coordinate, no random effects, 1 CD iteration,
+    # combos differing only in regWeight), "batched" solves every combo's
+    # FE GLM in ONE vmapped program; "auto" does so when the G×d state
+    # bank fits --grid-memory-budget; "sequential" keeps the warm-started
+    # per-combo sweep. Grids that are not pure FE λ sweeps always run
+    # sequential.
+    grid_mode: str = "auto"
+    grid_memory_budget: int = 1 << 30
 
     def validate(self) -> None:
         if not self.train_input_dirs:
@@ -290,6 +300,13 @@ class GameTrainingParams:
             raise ValueError("diagnostic-reservoir-rows must be >= 1")
         if self.diagnostic_reservoir_bytes < 1:
             raise ValueError("diagnostic-reservoir-bytes must be >= 1")
+        if self.grid_mode not in ("batched", "sequential", "auto"):
+            raise ValueError(
+                f"unknown grid mode {self.grid_mode!r}; expected "
+                "batched | sequential | auto"
+            )
+        if self.grid_memory_budget < 1:
+            raise ValueError("grid-memory-budget must be >= 1")
         if self.streaming:
             # the streaming layer's structural gates; everything else the
             # in-memory path supports is a bounded pass over staged chunks
@@ -476,6 +493,167 @@ class GameTrainingDriver:
                     name=name, dataset=dataset, re_dataset=red, problem=problem
                 )
         return coords
+
+    def _fe_grid_lambdas(self, combos) -> Optional[List[float]]:
+        """The combo grid as a pure fixed-effect λ sweep, or None.
+
+        Batchable when: one FE coordinate, no random effects, 1 CD
+        iteration (a single-coordinate CD iteration IS one GLM solve),
+        no down-sampling / checkpointing / feature-sharded FE, and every
+        combo identical except the FE regWeight. Then the whole sweep
+        collapses into training.train_grid_batched's engine — one
+        vmapped program for all G combos (--grid-mode; auto applies the
+        memory-budget fallback).
+        """
+        p = self.params
+        if p.grid_mode == "sequential":
+            return None
+        if (
+            len(p.fixed_effect_data_configs) != 1
+            or p.random_effect_data_configs
+            or p.factored_re_configs
+            or p.num_iterations != 1
+            or p.checkpoint_dir is not None
+            or p.distributed == "feature"
+            or len(combos) <= 1
+        ):
+            return None
+        name = next(iter(p.fixed_effect_data_configs))
+        base = combos[0][name]
+        for combo in combos:
+            cfg = combo[name]
+            if (
+                cfg.optimizer_config != base.optimizer_config
+                or cfg.regularization != base.regularization
+                or cfg.down_sampling_rate != 1.0
+            ):
+                return None
+        lambdas = [combo[name].reg_weight for combo in combos]
+        if len(set(lambdas)) != len(lambdas):
+            return None
+        if p.grid_mode == "auto":
+            from photon_ml_tpu.training import resolve_grid_mode
+
+            dcfg = p.fixed_effect_data_configs[name]
+            shard = dcfg.feature_shard_id
+            dim = None
+            try:
+                dim = self._dataset_dim_hint(shard)
+            except Exception:
+                dim = None
+            if dim is not None:
+                mode = resolve_grid_mode(
+                    "auto",
+                    num_weights=len(lambdas),
+                    dim=dim,
+                    optimizer_type=base.optimizer_config.optimizer_type,
+                    history=base.optimizer_config.lbfgs_history,
+                    memory_budget_bytes=p.grid_memory_budget,
+                )
+                if mode != "batched":
+                    self.logger.info(
+                        "grid-mode auto: FE grid bank over %d features "
+                        "exceeds the %d-byte budget; sequential sweep",
+                        dim, p.grid_memory_budget,
+                    )
+                    return None
+        return lambdas
+
+    def _dataset_dim_hint(self, shard_id: str) -> Optional[int]:
+        """Coefficient dimension of a feature shard if a dataset is
+        already loaded (the auto budget check); None before load."""
+        ds = getattr(self, "_train_dataset", None)
+        if ds is None:
+            return None
+        return ds.shards[shard_id].dim
+
+    def _train_fe_grid_batched(
+        self, combos, dataset, re_datasets, validation_fn, maximize
+    ) -> None:
+        """Pure-FE λ sweep on the batched grid engine: ONE vmapped
+        program solves every combo's fixed effect; per-combo objectives
+        (loss + the combo's reg term) stay device-resident and return in
+        ONE batched fetch; validation/selection then runs per combo
+        exactly like the sequential sweep."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.game.coordinate_descent import (
+            CoordinateDescentResult,
+        )
+        from photon_ml_tpu.game.model import GameModel
+        from photon_ml_tpu.ops.losses import loss_for_task
+        from photon_ml_tpu.parallel import overlap
+
+        p = self.params
+        name = next(iter(p.fixed_effect_data_configs))
+        lambdas = [combo[name].reg_weight for combo in combos]
+        self.logger.info(
+            "training the %d-combo FE lambda grid BATCHED (one vmapped "
+            "program; no cross-combo warm starts)", len(combos),
+        )
+        with self.timer.time("train-fe-grid-batched"):
+            coords = self._build_coordinates(dataset, re_datasets, combos[0])
+            coord = coords[name]
+            fitted = coord.update_model_grid(lambdas)
+
+            loss = loss_for_task(p.task_type)
+            offsets = jnp.asarray(dataset.offsets)
+            labels = jnp.asarray(dataset.labels)
+            weights = jnp.asarray(dataset.weights)
+            regularization = coord.problem.regularization
+            objective_ds = []
+            for lam, (fe_model, _res) in zip(lambdas, fitted):
+                z = coord.score(fe_model) + offsets
+                value = jnp.sum(weights * loss.value(z, labels))
+                l1, l2 = regularization.split(lam)
+                w = fe_model.model.means
+                value = value + 0.5 * l2 * jnp.vdot(w, w)
+                if l1:
+                    value = value + l1 * jnp.sum(jnp.abs(w))
+                objective_ds.append(overlap.Deferred(value, float))
+            # the grid's CD objectives materialize in ONE batched fetch
+            overlap.fetch_all(objective_ds)
+
+        best_orig_idx = None
+        for ci, combo in enumerate(combos):
+            fe_model, res = fitted[ci]
+            game_model = GameModel({name: fe_model}, p.task_type)
+            validation_history = []
+            best_metric = None
+            if validation_fn is not None:
+                metrics = validation_fn(game_model)
+                validation_history.append(metrics)
+                self.logger.info(
+                    "combo %d validation: %s", ci, metrics
+                )
+                if self._evaluators:
+                    best_metric = metrics[self._evaluators[0].render()]
+            result = CoordinateDescentResult(
+                model=game_model,
+                objective_history=[objective_ds[ci].result()],
+                trackers={name: [res]},
+                validation_history=validation_history,
+                best_model=game_model,
+                best_metric=best_metric,
+            )
+            self.results.append((combo, result, ci))
+            metric = result.best_metric
+            if metric is None:
+                if self.best_result is None or (
+                    self.best_result[1] is None and ci < best_orig_idx
+                ):
+                    self.best_result = (result, None)
+                    self.best_config = combo
+                    best_orig_idx = ci
+            elif (
+                self.best_result is None
+                or self.best_result[1] is None
+                or (maximize and metric > self.best_result[1])
+                or (not maximize and metric < self.best_result[1])
+            ):
+                self.best_result = (result, metric)
+                self.best_config = combo
+                best_orig_idx = ci
 
     # -- validation --------------------------------------------------------
 
@@ -838,163 +1016,170 @@ class GameTrainingDriver:
         )
         self.logger.info("training %d configuration combo(s)", len(combos))
         maximize = p.task_type == TaskType.LOGISTIC_REGRESSION
-        # Cross-combo warm start: train the most-regularized combo first
-        # and seed each subsequent combo's coordinate models from the
-        # previous fit — the GLM lambda-grid warm start
-        # (ModelTraining.scala:183-208) lifted to the GAME grid, which the
-        # reference retrains from scratch per combo. Original grid indices
-        # ride along so timer labels and metric-less best selection keep
-        # the user's configured order.
-        order = sorted(
-            range(len(combos)),
-            key=lambda i: -sum(
-                cfg.reg_weight for cfg in combos[i].values()
-            ),
-        )
-        guard = None
-        run_manifest = None
-        if p.checkpoint_dir is not None:
-            from photon_ml_tpu.utils.preemption import PreemptionGuard
-
-            guard = PreemptionGuard().install()
-            run_manifest = {
-                "train_input_dirs": list(p.train_input_dirs),
-                "train_date_range": p.train_date_range,
-                "train_date_range_days_ago": p.train_date_range_days_ago,
-                "task_type": p.task_type.name,
-                "updating_sequence": list(p.updating_sequence or []),
-                "feature_shards": [repr(s) for s in p.feature_shards],
-                "fixed_effect_data_configs": {
-                    k: repr(v)
-                    for k, v in sorted(p.fixed_effect_data_configs.items())
-                },
-                "random_effect_data_configs": {
-                    k: repr(v)
-                    for k, v in sorted(p.random_effect_data_configs.items())
-                },
-                # the feature-map source defines the coefficient index
-                # space — a changed source must not resume old weights
-                "offheap_indexmap_dir": p.offheap_indexmap_dir,
-                "feature_name_and_term_set_path": (
-                    p.feature_name_and_term_set_path
+        if self._fe_grid_lambdas(combos) is not None:
+            # pure FE lambda sweep: every combo's fixed effect solves in
+            # ONE vmapped grid program (--grid-mode batched/auto)
+            self._train_fe_grid_batched(
+                combos, dataset, re_datasets, validation_fn, maximize
+            )
+        else:
+            # Cross-combo warm start: train the most-regularized combo first
+            # and seed each subsequent combo's coordinate models from the
+            # previous fit — the GLM lambda-grid warm start
+            # (ModelTraining.scala:183-208) lifted to the GAME grid, which the
+            # reference retrains from scratch per combo. Original grid indices
+            # ride along so timer labels and metric-less best selection keep
+            # the user's configured order.
+            order = sorted(
+                range(len(combos)),
+                key=lambda i: -sum(
+                    cfg.reg_weight for cfg in combos[i].values()
                 ),
-            }
-        prev_model = None
-        best_orig_idx = None
-        build_futures: Dict[int, object] = {}
-        try:
-            for ti, ci in enumerate(order):
-                combo = combos[ci]
-                if guard is not None and guard.requested:
-                    self.logger.warning(
-                        "preemption requested: not starting combo %d/%d",
-                        ti + 1,
-                        len(combos),
-                    )
-                    break
-                with self.timer.time(f"train-combo-{ci}"), profile_trace(
-                    # trace the FIRST combo actually trained (combos run
-                    # in warm-start order, not grid order)
-                    p.profile_dir if ti == 0 else None
-                ):
-                    from photon_ml_tpu.parallel import overlap
+            )
+            guard = None
+            run_manifest = None
+            if p.checkpoint_dir is not None:
+                from photon_ml_tpu.utils.preemption import PreemptionGuard
 
-                    fut = build_futures.pop(ci, None)
-                    coords = (
-                        overlap.wait(fut)
-                        if fut is not None
-                        else self._build_coordinates(dataset, re_datasets, combo)
-                    )
-                    if ti + 1 < len(order):
-                        # the NEXT combo's problem setup builds on the
-                        # background worker UNDER this combo's training
-                        # (overlap prefetched dispatch on the grid axis)
-                        nci = order[ti + 1]
-                        build_futures[nci] = overlap.submit(
-                            self._build_coordinates,
-                            dataset, re_datasets, combos[nci],
+                guard = PreemptionGuard().install()
+                run_manifest = {
+                    "train_input_dirs": list(p.train_input_dirs),
+                    "train_date_range": p.train_date_range,
+                    "train_date_range_days_ago": p.train_date_range_days_ago,
+                    "task_type": p.task_type.name,
+                    "updating_sequence": list(p.updating_sequence or []),
+                    "feature_shards": [repr(s) for s in p.feature_shards],
+                    "fixed_effect_data_configs": {
+                        k: repr(v)
+                        for k, v in sorted(p.fixed_effect_data_configs.items())
+                    },
+                    "random_effect_data_configs": {
+                        k: repr(v)
+                        for k, v in sorted(p.random_effect_data_configs.items())
+                    },
+                    # the feature-map source defines the coefficient index
+                    # space — a changed source must not resume old weights
+                    "offheap_indexmap_dir": p.offheap_indexmap_dir,
+                    "feature_name_and_term_set_path": (
+                        p.feature_name_and_term_set_path
+                    ),
+                }
+            prev_model = None
+            best_orig_idx = None
+            build_futures: Dict[int, object] = {}
+            try:
+                for ti, ci in enumerate(order):
+                    combo = combos[ci]
+                    if guard is not None and guard.requested:
+                        self.logger.warning(
+                            "preemption requested: not starting combo %d/%d",
+                            ti + 1,
+                            len(combos),
                         )
-                    metric_name = None
-                    if validation_fn is not None:
-                        metric_name = (self._evaluators[0].render())
-                    checkpointer = None
-                    if p.checkpoint_dir is not None:
-                        from photon_ml_tpu.utils.checkpoint import (
-                            TrainingCheckpointer,
-                        )
-
-                        # key the directory by the combo's CONTENT so a
-                        # changed grid cannot silently resume from another
-                        # combo's weights (a different config gets a fresh
-                        # directory, not a wrong restore)
-                        fp = hashlib.sha1(
-                            "|".join(
-                                f"{name}:{cfg.render()}"
-                                for name, cfg in sorted(combo.items())
-                            ).encode()
-                        ).hexdigest()[:12]
-                        combo_dir = os.path.join(
-                            p.checkpoint_dir, f"combo-{fp}"
-                        )
-                        # data/shard/sequence changes fail loudly instead
-                        # of silently resuming foreign weights
-                        _ensure_manifest(combo_dir, run_manifest)
-                        checkpointer = TrainingCheckpointer(combo_dir)
-                    cd = CoordinateDescent(
-                        coords,
-                        dataset,
-                        p.task_type,
-                        update_sequence=p.updating_sequence,
-                        validation_fn=validation_fn,
-                        validation_metric=metric_name,
-                        validation_maximize=maximize,
-                        logger=self.logger,
-                        checkpointer=checkpointer,
-                        preemption_guard=guard,
-                    )
-                    try:
-                        result = cd.run(
-                            p.num_iterations, initial_model=prev_model
-                        )
-                    finally:
-                        if checkpointer is not None:
-                            from photon_ml_tpu.parallel import overlap
-
-                            # queued step writes must land before close
-                            overlap.drain_io()
-                            checkpointer.close()
-                    prev_model = result.model
-                self.results.append((combo, result, ci))
-                metric = result.best_metric
-                if metric is None:
-                    # no validation metric: selection falls back to the
-                    # user's configured grid order (parity with the
-                    # pre-warm-start sweep), not training order
-                    if self.best_result is None or (
-                        self.best_result[1] is None and ci < best_orig_idx
+                        break
+                    with self.timer.time(f"train-combo-{ci}"), profile_trace(
+                        # trace the FIRST combo actually trained (combos run
+                        # in warm-start order, not grid order)
+                        p.profile_dir if ti == 0 else None
                     ):
-                        self.best_result = (result, None)
+                        from photon_ml_tpu.parallel import overlap
+
+                        fut = build_futures.pop(ci, None)
+                        coords = (
+                            overlap.wait(fut)
+                            if fut is not None
+                            else self._build_coordinates(dataset, re_datasets, combo)
+                        )
+                        if ti + 1 < len(order):
+                            # the NEXT combo's problem setup builds on the
+                            # background worker UNDER this combo's training
+                            # (overlap prefetched dispatch on the grid axis)
+                            nci = order[ti + 1]
+                            build_futures[nci] = overlap.submit(
+                                self._build_coordinates,
+                                dataset, re_datasets, combos[nci],
+                            )
+                        metric_name = None
+                        if validation_fn is not None:
+                            metric_name = (self._evaluators[0].render())
+                        checkpointer = None
+                        if p.checkpoint_dir is not None:
+                            from photon_ml_tpu.utils.checkpoint import (
+                                TrainingCheckpointer,
+                            )
+
+                            # key the directory by the combo's CONTENT so a
+                            # changed grid cannot silently resume from another
+                            # combo's weights (a different config gets a fresh
+                            # directory, not a wrong restore)
+                            fp = hashlib.sha1(
+                                "|".join(
+                                    f"{name}:{cfg.render()}"
+                                    for name, cfg in sorted(combo.items())
+                                ).encode()
+                            ).hexdigest()[:12]
+                            combo_dir = os.path.join(
+                                p.checkpoint_dir, f"combo-{fp}"
+                            )
+                            # data/shard/sequence changes fail loudly instead
+                            # of silently resuming foreign weights
+                            _ensure_manifest(combo_dir, run_manifest)
+                            checkpointer = TrainingCheckpointer(combo_dir)
+                        cd = CoordinateDescent(
+                            coords,
+                            dataset,
+                            p.task_type,
+                            update_sequence=p.updating_sequence,
+                            validation_fn=validation_fn,
+                            validation_metric=metric_name,
+                            validation_maximize=maximize,
+                            logger=self.logger,
+                            checkpointer=checkpointer,
+                            preemption_guard=guard,
+                        )
+                        try:
+                            result = cd.run(
+                                p.num_iterations, initial_model=prev_model
+                            )
+                        finally:
+                            if checkpointer is not None:
+                                from photon_ml_tpu.parallel import overlap
+
+                                # queued step writes must land before close
+                                overlap.drain_io()
+                                checkpointer.close()
+                        prev_model = result.model
+                    self.results.append((combo, result, ci))
+                    metric = result.best_metric
+                    if metric is None:
+                        # no validation metric: selection falls back to the
+                        # user's configured grid order (parity with the
+                        # pre-warm-start sweep), not training order
+                        if self.best_result is None or (
+                            self.best_result[1] is None and ci < best_orig_idx
+                        ):
+                            self.best_result = (result, None)
+                            self.best_config = combo
+                            best_orig_idx = ci
+                    elif (
+                        self.best_result is None
+                        or self.best_result[1] is None
+                        or (maximize and metric > self.best_result[1])
+                        or (not maximize and metric < self.best_result[1])
+                    ):
+                        self.best_result = (result, metric)
                         self.best_config = combo
                         best_orig_idx = ci
-                elif (
-                    self.best_result is None
-                    or self.best_result[1] is None
-                    or (maximize and metric > self.best_result[1])
-                    or (not maximize and metric < self.best_result[1])
-                ):
-                    self.best_result = (result, metric)
-                    self.best_config = combo
-                    best_orig_idx = ci
-                if result.preempted:
-                    self.logger.warning(
-                        "stopping combo sweep after preemption (combo %d/%d)",
-                        ti + 1,
-                        len(combos),
-                    )
-                    break
-        finally:
-            if guard is not None:
-                guard.uninstall()
+                    if result.preempted:
+                        self.logger.warning(
+                            "stopping combo sweep after preemption (combo %d/%d)",
+                            ti + 1,
+                            len(combos),
+                        )
+                        break
+            finally:
+                if guard is not None:
+                    guard.uninstall()
 
         from photon_ml_tpu.parallel.multihost import (
             is_coordinator,
@@ -1152,6 +1337,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "fully serial — the A/B escape hatch",
     )
     ap.add_argument(
+        "--grid-mode", default="auto",
+        choices=["batched", "sequential", "auto"],
+        help="fixed-effect lambda-tuning policy: when the combo grid is "
+        "a pure FE regWeight sweep (one FE coordinate, no REs, 1 CD "
+        "iteration), batched solves every combo in ONE vmapped program; "
+        "auto applies the --grid-memory-budget fallback; sequential "
+        "keeps the warm-started per-combo sweep",
+    )
+    ap.add_argument(
+        "--grid-memory-budget", type=int, default=1 << 30,
+        help="byte budget for the batched FE grid's G x d coefficient "
+        "bank + vmapped optimizer state (default 1 GiB)",
+    )
+    ap.add_argument(
         "--streaming", default="false",
         help="true: out-of-core GAME training — the train set streams "
         "once per CD pass through spilled chunks, random effects solve "
@@ -1269,6 +1468,8 @@ def params_from_args(argv=None) -> GameTrainingParams:
         profile_dir=ns.profile_dir,
         tile_cache_dir=ns.tile_cache_dir,
         no_overlap=_bool(ns.no_overlap),
+        grid_mode=ns.grid_mode,
+        grid_memory_budget=ns.grid_memory_budget,
         streaming=_bool(ns.streaming),
         stream_memory_budget=ns.stream_memory_budget,
         diagnostic_reservoir_rows=ns.diagnostic_reservoir_rows,

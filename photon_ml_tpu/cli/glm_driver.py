@@ -165,6 +165,16 @@ class GLMParams:
     # byte budget (ADVICE.md round 5).
     diagnostic_reservoir_rows: int = 100_000
     diagnostic_reservoir_bytes: int = 256 << 20
+    # λ-grid execution policy (training.resolve_grid_mode): "batched"
+    # stacks the grid into a [G, d] bank and runs ONE vmapped optimizer
+    # program over a grid-fused objective (1 compile / 1 loop / 1
+    # readback round for the whole grid, no cross-λ warm starts);
+    # "sequential" keeps the warm-started one-solve-per-λ path; "auto"
+    # picks batched when the in-memory grid has >1 member and the G×d
+    # state bank fits --grid-memory-budget, and falls back to sequential
+    # otherwise (streaming/out-of-core always runs sequential).
+    grid_mode: str = "auto"
+    grid_memory_budget: int = 1 << 30
     # Multi-host orchestration (the SparkContextConfiguration analog):
     # address of process 0's coordination service. None = single-process.
     coordinator_address: Optional[str] = None
@@ -207,6 +217,22 @@ class GLMParams:
             )
         if any(w < 0 for w in self.regularization_weights):
             raise ValueError("regularization weights must be non-negative")
+        if self.grid_mode not in ("batched", "sequential", "auto"):
+            raise ValueError(
+                f"unknown grid mode {self.grid_mode!r}; expected "
+                "batched | sequential | auto"
+            )
+        if self.grid_mode == "batched" and self.streaming:
+            # surface the incompatibility at parse time, not mid-train
+            # (training.resolve_grid_mode enforces the same rule)
+            raise ValueError(
+                "--grid-mode batched is incompatible with --streaming: "
+                "streamed objectives evaluate through host IO, which one "
+                "vmapped optimizer program cannot trace; the streaming "
+                "path always runs the warm-started sequential grid"
+            )
+        if self.grid_memory_budget < 1:
+            raise ValueError("grid-memory-budget must be >= 1")
         if self.diagnostic_reservoir_rows < 1:
             raise ValueError("diagnostic-reservoir-rows must be >= 1")
         if self.diagnostic_reservoir_bytes < 1:
@@ -711,60 +737,152 @@ class GLMDriver:
                         tile_cache_dir=p.tile_cache_dir,
                     )
             elif p.distributed == "feature" and mesh is not None:
-                from photon_ml_tpu.training import train_feature_sharded
+                grid_mode = self._resolved_grid_mode(data.num_features)
+                if grid_mode == "batched":
+                    from photon_ml_tpu.training import (
+                        train_grid_batched_feature_sharded,
+                    )
 
-                self.logger.info(
-                    "training feature-sharded over mesh %s",
-                    dict(mesh.shape),
-                )
-                self.models, self.results = train_feature_sharded(
-                    data.batch,
-                    p.task,
-                    data.num_features,
-                    mesh=mesh,
-                    regularization_type=p.regularization_type,
-                    regularization_weights=p.regularization_weights,
-                    elastic_net_alpha=p.elastic_net_alpha,
-                    max_iter=p.max_num_iterations,
-                    tolerance=p.tolerance,
-                    normalization=self._norm,
-                    compute_variances=p.compute_variances,
-                    box=data.constraints,
-                    intercept_index=data.intercept_index,
-                    kernel=p.kernel,
-                    optimizer_type=p.optimizer_type,
-                    track_models=p.validate_per_iteration,
-                    tile_cache_dir=p.tile_cache_dir,
-                )
+                    self.logger.info(
+                        "training feature-sharded over mesh %s with a "
+                        "BATCHED %d-member lambda grid (one vmapped "
+                        "program)",
+                        dict(mesh.shape),
+                        len(set(p.regularization_weights)),
+                    )
+                    self.models, self.results = (
+                        train_grid_batched_feature_sharded(
+                            data.batch,
+                            p.task,
+                            data.num_features,
+                            mesh=mesh,
+                            regularization_type=p.regularization_type,
+                            regularization_weights=p.regularization_weights,
+                            elastic_net_alpha=p.elastic_net_alpha,
+                            max_iter=p.max_num_iterations,
+                            tolerance=p.tolerance,
+                            normalization=self._norm,
+                            compute_variances=p.compute_variances,
+                            box=data.constraints,
+                            intercept_index=data.intercept_index,
+                            kernel=p.kernel,
+                            optimizer_type=p.optimizer_type,
+                            track_models=p.validate_per_iteration,
+                            tile_cache_dir=p.tile_cache_dir,
+                        )
+                    )
+                else:
+                    from photon_ml_tpu.training import train_feature_sharded
+
+                    self.logger.info(
+                        "training feature-sharded over mesh %s",
+                        dict(mesh.shape),
+                    )
+                    self.models, self.results = train_feature_sharded(
+                        data.batch,
+                        p.task,
+                        data.num_features,
+                        mesh=mesh,
+                        regularization_type=p.regularization_type,
+                        regularization_weights=p.regularization_weights,
+                        elastic_net_alpha=p.elastic_net_alpha,
+                        max_iter=p.max_num_iterations,
+                        tolerance=p.tolerance,
+                        normalization=self._norm,
+                        compute_variances=p.compute_variances,
+                        box=data.constraints,
+                        intercept_index=data.intercept_index,
+                        kernel=p.kernel,
+                        optimizer_type=p.optimizer_type,
+                        track_models=p.validate_per_iteration,
+                        tile_cache_dir=p.tile_cache_dir,
+                    )
             else:
                 if mesh is not None:
                     self.logger.info(
                         "training data-parallel over %d devices",
                         mesh.devices.size,
                     )
-                self.models, self.results = train_generalized_linear_model(
-                    data.batch,
-                    p.task,
-                    data.num_features,
-                    optimizer_type=p.optimizer_type,
-                    regularization_type=p.regularization_type,
-                    regularization_weights=p.regularization_weights,
-                    elastic_net_alpha=p.elastic_net_alpha,
-                    max_iter=p.max_num_iterations,
-                    tolerance=p.tolerance,
-                    normalization=self._norm,
-                    compute_variances=p.compute_variances,
-                    box=data.constraints,
-                    intercept_index=data.intercept_index,
-                    kernel=p.kernel,
-                    mesh=mesh,
-                    track_models=p.validate_per_iteration,
-                    tile_cache_dir=p.tile_cache_dir,
-                )
+                grid_mode = self._resolved_grid_mode(data.num_features)
+                if grid_mode == "batched":
+                    from photon_ml_tpu.training import train_grid_batched
+
+                    self.logger.info(
+                        "training a BATCHED %d-member lambda grid (one "
+                        "vmapped optimizer program; no cross-lambda warm "
+                        "starts)",
+                        len(set(p.regularization_weights)),
+                    )
+                    self.models, self.results = train_grid_batched(
+                        data.batch,
+                        p.task,
+                        data.num_features,
+                        optimizer_type=p.optimizer_type,
+                        regularization_type=p.regularization_type,
+                        regularization_weights=p.regularization_weights,
+                        elastic_net_alpha=p.elastic_net_alpha,
+                        max_iter=p.max_num_iterations,
+                        tolerance=p.tolerance,
+                        normalization=self._norm,
+                        compute_variances=p.compute_variances,
+                        box=data.constraints,
+                        intercept_index=data.intercept_index,
+                        kernel=p.kernel,
+                        mesh=mesh,
+                        track_models=p.validate_per_iteration,
+                        tile_cache_dir=p.tile_cache_dir,
+                    )
+                else:
+                    self.models, self.results = train_generalized_linear_model(
+                        data.batch,
+                        p.task,
+                        data.num_features,
+                        optimizer_type=p.optimizer_type,
+                        regularization_type=p.regularization_type,
+                        regularization_weights=p.regularization_weights,
+                        elastic_net_alpha=p.elastic_net_alpha,
+                        max_iter=p.max_num_iterations,
+                        tolerance=p.tolerance,
+                        normalization=self._norm,
+                        compute_variances=p.compute_variances,
+                        box=data.constraints,
+                        intercept_index=data.intercept_index,
+                        kernel=p.kernel,
+                        mesh=mesh,
+                        track_models=p.validate_per_iteration,
+                        tile_cache_dir=p.tile_cache_dir,
+                    )
             self._log_results()
         self._log_schedule_cache()
         self.emitter.send(TrainingFinishEvent(p.job_name))
         self._advance(DriverStage.TRAINED)
+
+    def _resolved_grid_mode(self, dim: int) -> str:
+        """Resolve --grid-mode for the in-memory training stage (the
+        streaming branches never call this — out-of-core always runs the
+        warm-started sequential path)."""
+        from photon_ml_tpu.training import resolve_grid_mode
+
+        p = self.params
+        mode = resolve_grid_mode(
+            p.grid_mode,
+            num_weights=len(set(p.regularization_weights)),
+            dim=dim,
+            optimizer_type=p.optimizer_type,
+            memory_budget_bytes=p.grid_memory_budget,
+            streaming=False,
+        )
+        if p.grid_mode == "auto" and mode == "sequential" and (
+            len(set(p.regularization_weights)) > 1
+        ):
+            self.logger.info(
+                "grid-mode auto: %d-member grid over %d features does "
+                "not fit the %d-byte bank budget; using the warm-started "
+                "sequential path",
+                len(set(p.regularization_weights)), dim,
+                p.grid_memory_budget,
+            )
+        return mode
 
     def _log_schedule_cache(self) -> None:
         """Surface the tile-schedule cache outcome of the training stage
@@ -1241,6 +1359,22 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "background host prep, async artifact writes) and run fully "
         "serial — the A/B escape hatch",
     )
+    ap.add_argument(
+        "--grid-mode", default="auto",
+        choices=["batched", "sequential", "auto"],
+        help="lambda-grid execution: batched = ONE vmapped optimizer "
+        "program over a [G, d] coefficient bank (1 compile / 1 loop / 1 "
+        "readback round, no cross-lambda warm starts); sequential = "
+        "warm-started one-solve-per-lambda; auto = batched when the "
+        "in-memory grid has >1 member and the bank fits "
+        "--grid-memory-budget (streaming always runs sequential)",
+    )
+    ap.add_argument(
+        "--grid-memory-budget", type=int, default=1 << 30,
+        help="byte budget for the batched grid's G x d coefficient bank "
+        "+ vmapped optimizer state; auto falls back to sequential above "
+        "it (default 1 GiB)",
+    )
     return ap
 
 
@@ -1314,6 +1448,8 @@ def params_from_args(argv=None) -> GLMParams:
         profile_dir=ns.profile_dir,
         tile_cache_dir=ns.tile_cache_dir,
         no_overlap=_bool(ns.no_overlap),
+        grid_mode=ns.grid_mode,
+        grid_memory_budget=ns.grid_memory_budget,
         diagnostic_reservoir_rows=ns.diagnostic_reservoir_rows,
         diagnostic_reservoir_bytes=ns.diagnostic_reservoir_bytes,
         model_shards=ns.model_shards,

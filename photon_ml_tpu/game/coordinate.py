@@ -347,6 +347,65 @@ class FixedEffectCoordinate(Coordinate):
             result,
         )
 
+    def update_model_grid(self, reg_weights):
+        """Batched λ tuning for this fixed effect: solve EVERY grid
+        weight in ONE vmapped program (training.train_grid_batched's
+        engine, GLMOptimizationProblem.run_grid) instead of one
+        warm-started solve per combo — the GAME grid sweep's FE λ axis
+        collapses to 1 compile / 1 optimizer loop / 1 dispatch. Replicated
+        and data-parallel solves only (the feature-sharded FE keeps the
+        sequential sweep), no down-sampling, cold starts per member.
+
+        Returns ``[(FixedEffectModel, OptResult), ...]`` aligned with
+        ``reg_weights``; result scalars stay device-resident for the
+        caller's batched fetch.
+        """
+        if self._is_feature_sharded():
+            raise ValueError(
+                "batched FE grid tuning does not support the "
+                "feature-sharded mesh; use the sequential sweep"
+            )
+        if self.down_sampling_rate < 1.0:
+            raise ValueError(
+                "batched FE grid tuning does not compose with "
+                "down-sampling"
+            )
+        from photon_ml_tpu.models.coefficients import Coefficients
+        from photon_ml_tpu.optim.common import OptResult, Tracker
+
+        batch = self._batch(None)
+        variances, result = self.problem.run_grid(
+            batch, [float(w) for w in reg_weights], mesh=self.mesh
+        )
+        out = []
+        tracker = result.tracker
+        for i in range(len(reg_weights)):
+            var_i = variances[i] if variances is not None else None
+            coefficients = Coefficients(result.coefficients[i], var_i)
+            out.append((
+                FixedEffectModel(
+                    self.problem.create_model(coefficients),
+                    self.feature_shard_id,
+                ),
+                OptResult(
+                    coefficients=result.coefficients[i],
+                    value=result.value[i],
+                    grad_norm=result.grad_norm[i],
+                    iterations=result.iterations[i],
+                    reason=result.reason[i],
+                    tracker=Tracker(
+                        values=tracker.values[i],
+                        grad_norms=tracker.grad_norms[i],
+                        count=tracker.count[i],
+                        coefs=(
+                            tracker.coefs[i]
+                            if tracker.coefs is not None else None
+                        ),
+                    ),
+                ),
+            ))
+        return out
+
     def score(self, model: FixedEffectModel) -> Array:
         return model.score(self.dataset)
 

@@ -599,6 +599,51 @@ class SpilledREBuckets:
 # ---------------------------------------------------------------------------
 
 
+# -- shared chunk scoring / loss programs ------------------------------------
+#
+# Module-level jits shared by every streamed coordinate / CD instance
+# (no per-instance jit(lambda): one persistent compile cache per shape).
+
+_CHUNK_JITS = {}
+
+
+def _chunk_jit(which: str):
+    global _CHUNK_JITS
+    if which in _CHUNK_JITS:
+        return _CHUNK_JITS[which]
+    import jax
+    import jax.numpy as jnp
+
+    if which == "score_rows":
+
+        @jax.jit
+        def fn(w, ix, v):
+            return (v * w[ix]).sum(axis=-1)
+    elif which == "score_bank":
+
+        @jax.jit
+        def fn(bank, codes, ix, v, valid):
+            return jnp.where(
+                valid,
+                (
+                    v
+                    * jnp.take_along_axis(
+                        jnp.take(bank, jnp.maximum(codes, 0), axis=0),
+                        ix, axis=1,
+                    )
+                ).sum(axis=-1),
+                0.0,
+            )
+    else:  # weighted pointwise chunk loss, loss kernel static
+
+        def _chunk_loss(loss, z, lab, w):
+            return (w * loss.value(z, lab)).sum()
+
+        fn = jax.jit(_chunk_loss, static_argnums=(0,))
+    _CHUNK_JITS[which] = fn
+    return fn
+
+
 class _StoreChunkObjective:
     """GLM objective over one shard's staged chunks, residual folded into
     offsets per chunk — the StreamingGLMObjective contract with the
@@ -606,24 +651,16 @@ class _StoreChunkObjective:
     dataSet.addScoresToOffsets, applied chunk-wise from disk)."""
 
     def __init__(self, store: GameChunkStore, shard_id: str, dim: int, loss):
-        import jax
-
         from photon_ml_tpu.ops.normalization import identity_context
         from photon_ml_tpu.ops.objective import GLMObjective
 
         self.store = store
         self.shard_id = shard_id
         self.dim = dim
+        # chunk partials run the SHARED module-level jits (the objective
+        # is a pytree argument — one persistent compile cache across
+        # every streamed coordinate instead of per-instance jit(lambda)s)
         self._objective = GLMObjective(loss, dim, identity_context())
-        self._partial = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
-            lambda w, b: self._objective.value_and_gradient(w, b, 0.0)
-        )
-        self._hv = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
-            lambda w, d, b: self._objective.hessian_vector(w, d, b, 0.0)
-        )
-        self._hd = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
-            lambda w, b: self._objective.hessian_diagonal(w, b, 0.0)
-        )
         self.residual: Optional[ScoreStore] = None
 
     def _batches(self):
@@ -650,8 +687,10 @@ class _StoreChunkObjective:
 
         value = jnp.float32(0.0)
         grad = jnp.zeros((self.dim,), jnp.float32)
+        from photon_ml_tpu.ops.objective import partial_value_and_gradient
+
         for b in self._batches():
-            v, g = self._partial(w, b)
+            v, g = partial_value_and_gradient(self._objective, w, b)
             value = value + v
             grad = grad + g
         value = value + 0.5 * l2_weight * jnp.vdot(w, w)
@@ -661,16 +700,20 @@ class _StoreChunkObjective:
         import jax.numpy as jnp
 
         hv = jnp.zeros((self.dim,), jnp.float32)
+        from photon_ml_tpu.ops.objective import partial_hessian_vector
+
         for b in self._batches():
-            hv = hv + self._hv(w, direction, b)
+            hv = hv + partial_hessian_vector(self._objective, w, direction, b)
         return hv + l2_weight * direction
 
     def hessian_diagonal(self, w, l2_weight=0.0):
         import jax.numpy as jnp
 
         diag = jnp.zeros((self.dim,), jnp.float32)
+        from photon_ml_tpu.ops.objective import partial_hessian_diagonal
+
         for b in self._batches():
-            diag = diag + self._hd(w, b)
+            diag = diag + partial_hessian_diagonal(self._objective, w, b)
         return diag + l2_weight
 
 
@@ -688,14 +731,9 @@ class StreamingFixedEffectCoordinate:
     reg_weight: float = 0.0
 
     def __post_init__(self):
-        import jax
-
         self._chunk_obj = _StoreChunkObjective(
             self.store, self.feature_shard_id,
             self.problem.objective.dim, self.problem.objective.loss,
-        )
-        self._score = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
-            lambda w, ix, v: (v * w[ix]).sum(axis=-1)
         )
 
     @property
@@ -761,7 +799,7 @@ class StreamingFixedEffectCoordinate:
     def score_chunk(self, means, chunk: Dict[str, np.ndarray]):
         import jax.numpy as jnp
 
-        return self._score(
+        return _chunk_jit("score_rows")(
             jnp.asarray(means),
             jnp.asarray(chunk[f"ix__{self.feature_shard_id}"]),
             jnp.asarray(chunk[f"v__{self.feature_shard_id}"]),
@@ -797,23 +835,7 @@ class StreamingRandomEffectCoordinate:
     local_dim: int = 0  # IDENTITY projector: the shard dimension
 
     def __post_init__(self):
-        import jax
-
-        self._score = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
-            lambda bank, codes, ix, v, valid: jax.numpy.where(
-                valid,
-                (
-                    v
-                    * jax.numpy.take_along_axis(
-                        jax.numpy.take(
-                            bank, jax.numpy.maximum(codes, 0), axis=0
-                        ),
-                        ix, axis=1,
-                    )
-                ).sum(axis=-1),
-                0.0,
-            )
-        )
+        pass
 
     @property
     def num_entities(self) -> int:
@@ -897,7 +919,7 @@ class StreamingRandomEffectCoordinate:
         codes = chunk[f"code__{self.config.random_effect_type}"]
         valid = (codes >= 0) & (chunk["wgt"] > 0)
         sid = self.config.feature_shard_id
-        return self._score(
+        return _chunk_jit("score_bank")(
             bank,
             jnp.asarray(codes),
             jnp.asarray(chunk[f"ix__{sid}"]),
@@ -963,11 +985,6 @@ class StreamingCoordinateDescent:
         from photon_ml_tpu.ops.losses import loss_for_task
 
         self._loss = loss_for_task(task)
-        import jax
-
-        self._chunk_loss = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
-            lambda z, lab, w: (w * self._loss.value(z, lab)).sum()
-        )
 
     def _state(self, name):
         coord = self.coordinates[name]
@@ -1022,7 +1039,8 @@ class StreamingCoordinateDescent:
                 for name in seq:
                     z = z + np.asarray(scores[name].get_chunk(i), np.float64)
                 objective += float(
-                    self._chunk_loss(
+                    _chunk_jit("loss")(
+                        self._loss,
                         jnp.asarray(z, jnp.float32),
                         jnp.asarray(c["lab"]),
                         jnp.asarray(c["wgt"]),

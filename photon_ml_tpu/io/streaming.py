@@ -562,6 +562,60 @@ class _DiskChunkStore:
             pass
 
 
+# -- shared tiled-chunk fold programs ----------------------------------------
+#
+# The tiled cached path folds every chunk inside ONE jitted lax.scan over
+# the chunk-stacked TiledSparseBatch. Module-level (objective passed as a
+# pytree argument) so every StreamingGLMObjective instance with the same
+# chunk structure shares one persistent compile cache — these replace the
+# per-instance constructor jit(lambda)s of PERF_NOTES round 9.
+
+_TILED_FOLDS = {}
+
+
+def _tiled_fold_jit(which: str):
+    global _TILED_FOLDS
+    if which in _TILED_FOLDS:
+        return _TILED_FOLDS[which]
+    import jax
+    import jax.numpy as jnp
+
+    def _scan(stacked, fold):
+        def body(carry, tb):
+            return jax.tree.map(jnp.add, carry, fold(tb)), None
+
+        init = jax.tree.map(
+            jnp.zeros_like,
+            jax.eval_shape(fold, jax.tree.map(lambda x: x[0], stacked)),
+        )
+        return jax.lax.scan(body, init, stacked)[0]
+
+    if which == "vg":
+
+        @jax.jit
+        def fn(objective, w, stacked):
+            return _scan(
+                stacked, lambda tb: objective.value_and_gradient(w, tb, 0.0)
+            )
+    elif which == "hv":
+
+        @jax.jit
+        def fn(objective, w, d, stacked):
+            return _scan(
+                stacked, lambda tb: objective.hessian_vector(w, d, tb, 0.0)
+            )
+    else:
+
+        @jax.jit
+        def fn(objective, w, stacked):
+            return _scan(
+                stacked, lambda tb: objective.hessian_diagonal(w, tb, 0.0)
+            )
+
+    _TILED_FOLDS[which] = fn
+    return fn
+
+
 class StreamingGLMObjective:
     """GLMObjective facade whose (value, gradient) stream the input from
     disk per evaluation — full-batch semantics with bounded memory.
@@ -611,8 +665,6 @@ class StreamingGLMObjective:
         norm=None,
         tile_cache_dir: Optional[str] = None,
     ):
-        import jax
-
         from photon_ml_tpu.ops.losses import loss_for_task
         from photon_ml_tpu.ops.objective import GLMObjective
 
@@ -633,10 +685,11 @@ class StreamingGLMObjective:
 
         self._loss = loss_for_task(task)
         self.norm = norm if norm is not None else identity_context()
+        # per-chunk partials run the SHARED module-level jits
+        # (ops.objective.partial_value_and_gradient and friends): the
+        # objective is a pytree argument, so every instance with the
+        # same structure/chunk shape hits one persistent compile cache.
         self._objective = GLMObjective(self._loss, self.dim, self.norm)
-        self._partial = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
-            lambda w, b: self._objective.value_and_gradient(w, b, 0.0)
-        )
         if kernel not in ("auto", "tiled", "scatter"):
             raise ValueError(f"unknown kernel {kernel!r}")
         from photon_ml_tpu.utils.backend import effective_platform
@@ -669,7 +722,6 @@ class StreamingGLMObjective:
         training, the persisted-RDD analog)."""
         from concurrent.futures import ThreadPoolExecutor
 
-        import jax
 
         from photon_ml_tpu.ops import tiled_sparse as ts
 
@@ -760,10 +812,10 @@ class StreamingGLMObjective:
         del built
 
         def lead(items):
-            arrs = list(items)
-            return jnp.asarray(
-                np.stack(arrs) if n_chunks > 1 else arrs[0]
-            )
+            # ALWAYS stacked with a leading chunk axis (even at 1 chunk)
+            # so the shared module-level scan programs below see one
+            # uniform structure across instances
+            return jnp.asarray(np.stack(list(items)))
 
         self._tiled_stacked = ts.TiledSparseBatch(
             meta=meta,
@@ -786,40 +838,6 @@ class StreamingGLMObjective:
             interpret=effective_platform() == "cpu",
         )
         self._tiled_chunk_count = n_chunks
-        obj = self._tiled_objective
-
-        def _scan(w, stacked, fold):
-            if n_chunks <= 1:
-                return fold(w, stacked)
-
-            def body(carry, tb):
-                out = fold(w, tb)
-                return jax.tree.map(jnp.add, carry, out), None
-
-            init = jax.tree.map(
-                jnp.zeros_like, jax.eval_shape(fold, w, jax.tree.map(
-                    lambda x: x[0], stacked
-                ))
-            )
-            carry, _ = jax.lax.scan(body, init, stacked)
-            return carry
-
-        self._tiled_vg_all = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
-            lambda w, st: _scan(
-                w, st, lambda w_, tb: obj.value_and_gradient(w_, tb, 0.0)
-            )
-        )
-        self._tiled_hv_all = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
-            lambda w, d, st: _scan(
-                (w, d), st,
-                lambda wd, tb: obj.hessian_vector(wd[0], wd[1], tb, 0.0),
-            )
-        )
-        self._tiled_hd_all = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
-            lambda w, st: _scan(
-                w, st, lambda w_, tb: obj.hessian_diagonal(w_, tb, 0.0)
-            )
-        )
 
     def _ensure_tiled(self) -> bool:
         if not (self._use_tiled and self._cached):
@@ -897,23 +915,22 @@ class StreamingGLMObjective:
         the reference's exact second-order pattern (one cluster aggregate
         per CG step, HessianVectorAggregator.scala:137-152). Rides the
         tiled chunk cache when built."""
-        import jax
         import jax.numpy as jnp
+
+        from photon_ml_tpu.ops.objective import partial_hessian_vector
 
         hv = jnp.zeros((self.dim,), jnp.float32)
         if self._ensure_tiled():
-            hv = hv + self._tiled_hv_all(w, direction, self._tiled_stacked)
+            hv = hv + _tiled_fold_jit("hv")(
+                self._tiled_objective, w, direction, self._tiled_stacked
+            )
             chunks = self._overflow_chunks()
         else:
             chunks = self.chunks()
-        if getattr(self, "_scatter_hv", None) is None:
-            self._scatter_hv = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
-                lambda w_, d_, b: self._objective.hessian_vector(
-                    w_, d_, b, 0.0
-                )
-            )
         for batch in chunks:
-            hv = hv + self._scatter_hv(w, direction, batch)
+            hv = hv + partial_hessian_vector(
+                self._objective, w, direction, batch
+            )
         hv = self._reduce_hosts(hv)
         return hv + l2_weight * direction
 
@@ -921,26 +938,27 @@ class StreamingGLMObjective:
         """Streamed Hessian diagonal (the variance pass,
         DistributedOptimizationProblem.scala:79-93): one pass over the
         cached staged chunks."""
-        import jax
         import jax.numpy as jnp
+
+        from photon_ml_tpu.ops.objective import partial_hessian_diagonal
 
         diag = jnp.zeros((self.dim,), jnp.float32)
         if self._ensure_tiled():
-            diag = diag + self._tiled_hd_all(w, self._tiled_stacked)
+            diag = diag + _tiled_fold_jit("hd")(
+                self._tiled_objective, w, self._tiled_stacked
+            )
             chunks = self._overflow_chunks()
         else:
             chunks = self.chunks()
-        if getattr(self, "_scatter_hd", None) is None:
-            self._scatter_hd = jax.jit(  # photon: allow(recompile-hazard) — build-once per instance
-                lambda w_, b: self._objective.hessian_diagonal(w_, b, 0.0)
-            )
         for batch in chunks:
-            diag = diag + self._scatter_hd(w, batch)
+            diag = diag + partial_hessian_diagonal(self._objective, w, batch)
         return self._reduce_hosts(diag) + l2_weight
 
     def value_and_gradient(self, w, l2_weight=0.0):
         import jax
         import jax.numpy as jnp
+
+        from photon_ml_tpu.ops.objective import partial_value_and_gradient
 
         value = jnp.float32(0.0)
         grad = jnp.zeros((self.dim,), jnp.float32)
@@ -948,16 +966,18 @@ class StreamingGLMObjective:
             # cached fast path: EVERY tiled chunk folds inside one
             # jitted lax.scan dispatch (per-chunk dispatches cost ~10 ms
             # each over a tunneled chip)
-            v, g = self._tiled_vg_all(w, self._tiled_stacked)
+            v, g = _tiled_fold_jit("vg")(
+                self._tiled_objective, w, self._tiled_stacked
+            )
             value = value + v
             grad = grad + g
             for batch in self._overflow_chunks():
-                v, g = self._partial(w, batch)
+                v, g = partial_value_and_gradient(self._objective, w, batch)
                 value = value + v
                 grad = grad + g
         else:
             for batch in self.chunks():
-                v, g = self._partial(w, batch)
+                v, g = partial_value_and_gradient(self._objective, w, batch)
                 value = value + v
                 grad = grad + g
         if jax.process_count() > 1:

@@ -7,8 +7,17 @@ io/streaming.py). This variant drives the SAME math from Python:
 two-loop recursion, cautious memory updates (skip pairs with y.s <= eps),
 steepest-descent fallback, Armijo backtracking with the same constants,
 and the reference's convergence rules (Optimizer.scala:156-170 via
-optim.common.check_convergence). Per-iteration host control costs
-microseconds against evaluations that stream gigabytes from disk.
+optim.common.check_convergence).
+
+Readback discipline (PERF_NOTES round 10; the round-9 baseline debt):
+ONLY the scalars that gate host control flow come back, and they come
+back BATCHED through the counted ``overlap.device_get`` seam — one fetch
+for the direction setup, one per line-search trial (the trial's
+accept/F-value pair; inherently serial, each trial depends on the
+previous decision), one for the iteration's convergence batch
+(y.s, ‖g‖, reason). The two-loop recursion itself stays entirely on
+device — its α/ρ/γ scalars only feed arithmetic, never branches, so the
+round-9 grandfathered per-pair ``float()`` pulls are simply gone.
 """
 
 from __future__ import annotations
@@ -27,6 +36,7 @@ from photon_ml_tpu.optim.common import (
     Tracker,
     check_convergence,
 )
+from photon_ml_tpu.parallel import overlap
 
 Array = jnp.ndarray
 ValueAndGrad = Callable[[Array], Tuple[Array, Array]]
@@ -35,20 +45,22 @@ _MEM_EPS = 1e-10  # cautious-update threshold, matches optim.lbfgs
 
 
 def _direction(g: Array, s_list: List[Array], y_list: List[Array]) -> Array:
-    """Two-loop recursion over the host-side (s, y) history."""
+    """Two-loop recursion over the host-side (s, y) history — all
+    arithmetic on DEVICE scalars (α/ρ/γ never gate control flow, so
+    nothing here needs a readback)."""
     q = -g
     alphas = []
-    rhos = [1.0 / float(jnp.vdot(y, s)) for s, y in zip(s_list, y_list)]
+    rhos = [1.0 / jnp.vdot(y, s) for s, y in zip(s_list, y_list)]
     for s, y, rho in zip(reversed(s_list), reversed(y_list), reversed(rhos)):
-        a = rho * float(jnp.vdot(s, q))
+        a = rho * jnp.vdot(s, q)
         q = q - a * y
         alphas.append((a, rho))
     if s_list:
         s, y = s_list[-1], y_list[-1]
-        gamma = float(jnp.vdot(s, y)) / max(float(jnp.vdot(y, y)), 1e-30)
+        gamma = jnp.vdot(s, y) / jnp.maximum(jnp.vdot(y, y), 1e-30)
         q = q * gamma
     for (a, rho), s, y in zip(reversed(alphas), s_list, y_list):
-        b = rho * float(jnp.vdot(y, q))
+        b = rho * jnp.vdot(y, q)
         q = q + (a - b) * s
     return q
 
@@ -76,13 +88,17 @@ def minimize_lbfgs_host(
     w = jnp.asarray(w0, jnp.float32)
     if box is not None:
         w = box.project(w)
-    f, g = value_and_grad_fn(w)
-    f0 = float(f)
-    g0_norm = float(jnp.linalg.norm(g))
+    f_dev, g = value_and_grad_fn(w)
+    # one batched fetch for the initial state's control scalars
+    f, g0_norm = (
+        float(v) for v in overlap.device_get((f_dev, jnp.linalg.norm(g)))
+    )
+    f0 = f
+    g_norm = g0_norm
     tracker = Tracker.create(
         max_iter + 1,
         coef_dim=w.shape[0] if track_coefficients else None,
-    ).record(f, jnp.linalg.norm(g), w if track_coefficients else None)
+    ).record(f, g0_norm, w if track_coefficients else None)
 
     s_list: List[Array] = []
     y_list: List[Array] = []
@@ -92,10 +108,18 @@ def minimize_lbfgs_host(
     it = 0
     while reason == NOT_CONVERGED:
         d = _direction(g, s_list, y_list)
-        if float(jnp.vdot(d, g)) >= 0:  # not a descent direction
+        # ONE fetch for the direction's control scalars (descent test +
+        # the Armijo slope + the first-step scaling norm)
+        gd, d_norm = (
+            float(v) for v in overlap.device_get(
+                (jnp.vdot(d, g), jnp.linalg.norm(d))
+            )
+        )
+        if gd >= 0:  # not a descent direction: steepest-descent fallback
             d = -g
-        t = 1.0 if s_list else 1.0 / max(float(jnp.linalg.norm(d)), 1.0)
-        gd = float(jnp.vdot(g, d))
+            gd = -(g_norm * g_norm)
+            d_norm = g_norm
+        t = 1.0 if s_list else 1.0 / max(d_norm, 1.0)
         ok = False
         f_new, g_new, w_new = f, g, w
         for _ in range(ls_max_steps):
@@ -103,32 +127,43 @@ def minimize_lbfgs_host(
             if box is not None:
                 w_t = box.project(w_t)
             f_t, g_t = value_and_grad_fn(w_t)
-            if float(f_t) <= float(f) + ls_c1 * t * gd and bool(
-                jnp.isfinite(f_t)
-            ):
+            # one fetch per trial: the Armijo accept flag and the trial
+            # value together (the decision is inherently sequential —
+            # each trial's step size depends on the previous verdict)
+            ok_t, f_t_host = overlap.device_get((
+                (f_t <= f + ls_c1 * t * gd) & jnp.isfinite(f_t), f_t,
+            ))
+            if bool(ok_t):
                 ok = True
-                w_new, f_new, g_new = w_t, f_t, g_t
+                w_new, f_new, g_new = w_t, float(f_t_host), g_t
                 break
             t *= ls_shrink
         it += 1
         if ok:
             s = w_new - w
             y = g_new - g
-            if float(jnp.vdot(y, s)) > _MEM_EPS:  # cautious update
+            # the iteration's convergence batch: memory-update gate,
+            # gradient norm and the convergence reason in ONE fetch
+            ys, g_norm_new, reason_new = overlap.device_get((
+                jnp.vdot(y, s),
+                jnp.linalg.norm(g_new),
+                check_convergence(
+                    jnp.int32(it), jnp.float32(f), jnp.float32(f_new),
+                    jnp.linalg.norm(g_new), jnp.float32(f0),
+                    jnp.float32(g0_norm), max_iter=max_iter, tol=tol,
+                ),
+            ))
+            if float(ys) > _MEM_EPS:  # cautious update
                 s_list.append(s)
                 y_list.append(y)
                 if len(s_list) > history:
                     s_list.pop(0)
                     y_list.pop(0)
-            g_norm = float(jnp.linalg.norm(g_new))
-            reason = int(check_convergence(
-                jnp.int32(it), f, f_new, jnp.float32(g_norm),
-                jnp.float32(f0), jnp.float32(g0_norm),
-                max_iter=max_iter, tol=tol,
-            ))
+            g_norm = float(g_norm_new)
+            reason = int(reason_new)
             w, f, g = w_new, f_new, g_new
             tracker = tracker.record(
-                f, jnp.float32(g_norm), w if track_coefficients else None
+                f, g_norm, w if track_coefficients else None
             )
         else:
             # stalled line search: no decreasing step exists from here —
@@ -136,7 +171,7 @@ def minimize_lbfgs_host(
             reason = LINE_SEARCH_STALLED
     return OptResult(
         coefficients=w,
-        value=jnp.float32(float(f)),
+        value=jnp.float32(f),
         grad_norm=jnp.linalg.norm(g),
         iterations=jnp.int32(it),
         reason=jnp.int32(reason),
@@ -164,8 +199,8 @@ def minimize_owlqn_host(
     elastic-net). Same Andrew & Gao rules as optim.lbfgs.minimize_owlqn —
     pseudo-gradient, orthant-constrained direction, orthant projection of
     trial points, memory pairs on SMOOTH gradients — driven from Python
-    like minimize_lbfgs_host. ``value_and_grad_fn`` returns the SMOOTH
-    (value, gradient)."""
+    like minimize_lbfgs_host, with the same batched-fetch discipline.
+    ``value_and_grad_fn`` returns the SMOOTH (value, gradient)."""
     from photon_ml_tpu.optim.lbfgs import _pseudo_gradient
 
     w = jnp.asarray(w0, jnp.float32)
@@ -175,14 +210,19 @@ def minimize_owlqn_host(
         jnp.ones_like(w) if l1_mask is None else jnp.asarray(l1_mask)
     )
 
-    def total(w_t, f_smooth):
-        return float(f_smooth) + float(jnp.sum(l1_vec * jnp.abs(w_t)))
+    def total_dev(w_t, f_smooth):
+        return f_smooth + jnp.sum(l1_vec * jnp.abs(w_t))
 
     f_s, g = value_and_grad_fn(w)
     pg = _pseudo_gradient(w, g, l1_vec)
-    f_tot = total(w, f_s)
+    # one batched fetch for the initial control scalars
+    f_tot, g0_norm = (
+        float(v) for v in overlap.device_get(
+            (total_dev(w, f_s), jnp.linalg.norm(pg))
+        )
+    )
     f0 = f_tot
-    g0_norm = float(jnp.linalg.norm(pg))
+    pg_norm = g0_norm
     tracker = Tracker.create(
         max_iter + 1,
         coef_dim=w.shape[0] if track_coefficients else None,
@@ -202,10 +242,17 @@ def minimize_owlqn_host(
         d = _direction(pg, s_list, y_list)
         # constrain to the descent orthant of the pseudo-gradient
         d = jnp.where(d * pg < 0, d, 0.0)
-        if float(jnp.vdot(d, pg)) >= 0:
+        # ONE fetch for the direction's control scalars
+        dpg, d_norm = (
+            float(v) for v in overlap.device_get(
+                (jnp.vdot(d, pg), jnp.linalg.norm(d))
+            )
+        )
+        if dpg >= 0:
             d = -pg
+            d_norm = pg_norm
         orthant = jnp.where(w != 0, jnp.sign(w), jnp.sign(-pg))
-        t = 1.0 if s_list else 1.0 / max(float(jnp.linalg.norm(d)), 1.0)
+        t = 1.0 if s_list else 1.0 / max(d_norm, 1.0)
         ok = False
         w_new, f_new_tot, g_new = w, f_tot, g
         for _ in range(ls_max_steps):
@@ -216,32 +263,43 @@ def minimize_owlqn_host(
                 # minimize_owlqn)
                 w_t = box.project(w_t)
             f_t_s, g_t = value_and_grad_fn(w_t)
-            f_t_tot = total(w_t, f_t_s)
-            # Armijo on the projected point against the pseudo-gradient
-            if f_t_tot <= f_tot + ls_c1 * float(
-                jnp.vdot(pg, w_t - w)
-            ) and np.isfinite(f_t_tot):
+            f_t_tot_dev = total_dev(w_t, f_t_s)
+            # Armijo on the projected point against the pseudo-gradient:
+            # one fetch per trial (flag + total value together)
+            ok_t, f_t_tot = overlap.device_get((
+                (f_t_tot_dev <= f_tot + ls_c1 * jnp.vdot(pg, w_t - w))
+                & jnp.isfinite(f_t_tot_dev),
+                f_t_tot_dev,
+            ))
+            if bool(ok_t) and np.isfinite(float(f_t_tot)):
                 ok = True
-                w_new, f_new_tot, g_new = w_t, f_t_tot, g_t
+                w_new, f_new_tot, g_new = w_t, float(f_t_tot), g_t
                 break
             t *= ls_shrink
         it += 1
         if ok:
             s = w_new - w
             y = g_new - g  # smooth gradients, per Andrew & Gao
-            if float(jnp.vdot(y, s)) > _MEM_EPS:
+            pg_new = _pseudo_gradient(w_new, g_new, l1_vec)
+            # the iteration's convergence batch in ONE fetch
+            ys, pg_norm_new, reason_new = overlap.device_get((
+                jnp.vdot(y, s),
+                jnp.linalg.norm(pg_new),
+                check_convergence(
+                    jnp.int32(it), jnp.float32(f_tot),
+                    jnp.float32(f_new_tot), jnp.linalg.norm(pg_new),
+                    jnp.float32(f0), jnp.float32(g0_norm),
+                    max_iter=max_iter, tol=tol,
+                ),
+            ))
+            if float(ys) > _MEM_EPS:
                 s_list.append(s)
                 y_list.append(y)
                 if len(s_list) > history:
                     s_list.pop(0)
                     y_list.pop(0)
-            pg_new = _pseudo_gradient(w_new, g_new, l1_vec)
-            pg_norm = float(jnp.linalg.norm(pg_new))
-            reason = int(check_convergence(
-                jnp.int32(it), jnp.float32(f_tot), jnp.float32(f_new_tot),
-                jnp.float32(pg_norm), jnp.float32(f0), jnp.float32(g0_norm),
-                max_iter=max_iter, tol=tol,
-            ))
+            pg_norm = float(pg_norm_new)
+            reason = int(reason_new)
             w, f_tot, g = w_new, f_new_tot, g_new
             tracker = tracker.record(
                 jnp.float32(f_tot), jnp.float32(pg_norm),

@@ -157,6 +157,15 @@ def minimize_lbfgs(
 
     Defaults mirror LBFGS.scala:152-156 (maxIter=100, m=10, tol=1e-7).
 
+    Under ``jax.vmap`` (the batched λ-grid path, problem.run_grid) the
+    batching rule of ``lax.while_loop`` active-masks the carry per
+    member: ``cond`` is this member's ``reason == NOT_CONVERGED``, so a
+    converged member's whole state — coefficients, memory, tracker,
+    reason — is selected UNCHANGED on every further trip and the loop
+    exits when all members are done. The grid tests pin that freeze
+    bitwise (test_grid_batched.py::TestFreezeSemantics); keep ``cond``
+    a pure per-member predicate or the batched path loses it.
+
     ``axis_name``: run over a FEATURE-SHARDED coefficient block inside
     shard_map — w0 (and every state vector) is this device's block, and
     all inner products / norms psum over the axis, so the optimizer is

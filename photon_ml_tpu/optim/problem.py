@@ -152,6 +152,204 @@ class GLMOptimizationProblem:
         cache[key] = (fit, mesh)
         return fit
 
+    def _get_grid_fit(self, track_models: bool, mesh=None, axis: str = ""):
+        """Jitted GRID fit: ``fit(w0_bank, batch, l1_vec, l2_vec)`` runs
+        ``vmap(minimize_lbfgs/owlqn/tron)`` over a [G, d] coefficient bank
+        — the whole λ grid as ONE XLA program (1 compile, 1 optimizer
+        loop, 1 dispatch for G solves).
+
+        Per-member convergence is active-masked by the batched
+        ``lax.while_loop`` itself: jax's batching rule selects each
+        member's carry only while its own cond holds, so a converged λ's
+        state (coefficients, reason, tracker) is frozen bit-stable while
+        the loop runs on for the stragglers, and the loop exits when all
+        G are done. The objective's data pass evaluates the whole bank
+        fused: the scatter objective batches into one (n×d)@(d×G)-shaped
+        gather/contract under vmap, and the tiled objective's Pallas
+        passes swap in the flat fused grid pass via custom_vmap
+        (ops.tiled_sparse._bilinear_pass_auto) — one schedule walk for
+        the whole grid. Cached like :meth:`_get_fit`.
+        """
+        import jax
+
+        key = (
+            "grid",
+            self.objective,
+            self.config,
+            self.regularization,
+            self.box,
+            self.intercept_index,
+            track_models,
+            id(mesh) if mesh is not None else None,
+            axis,
+        )
+        try:
+            hash(key)
+            cache = _FIT_CACHE
+        except TypeError:
+            if "_local_fit_cache" not in self.__dict__:
+                object.__setattr__(self, "_local_fit_cache", {})
+            cache = self._local_fit_cache
+            key = (
+                "grid", track_models,
+                id(mesh) if mesh is not None else None, axis,
+            )
+        hit = cache.get(key)
+        if hit is not None:
+            return hit[0]
+        optimize = make_optimizer(
+            self.config,
+            self.regularization,
+            loss_has_hessian=self.objective.loss.has_hessian,
+            box=self.box,
+            l1_mask=self._l1_mask(),
+            track_coefficients=track_models,
+        )
+        needs_hvp = self.config.optimizer_type == OptimizerType.TRON
+        objective = (
+            self.objective if mesh is None else self.objective.with_axis(axis)
+        )
+
+        def fit(w0_bank, batch, l1_vec, l2_vec):
+            def run_one(w0, l1, l2):
+                def vg(w):
+                    return objective.value_and_gradient(w, batch, l2)
+
+                def hvp(w, d):
+                    return objective.hessian_vector(w, d, batch, l2)
+
+                return optimize(
+                    vg, w0, l1_weight=l1, hvp_fn=hvp if needs_hvp else None
+                )
+
+            return jax.vmap(run_one)(w0_bank, l1_vec, l2_vec)
+
+        if mesh is not None:
+            from functools import partial as _partial
+
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            fit = _partial(
+                shard_map,
+                mesh=mesh,
+                in_specs=(P(), P(axis), P(), P()),
+                out_specs=P(),
+                check_vma=False,
+            )(fit)
+        fit = jax.jit(fit)
+
+        while len(cache) >= _FIT_CACHE_MAX:
+            cache.pop(next(iter(cache)))
+        cache[key] = (fit, mesh)
+        return fit
+
+    def run_grid(
+        self,
+        batch: Batch,
+        reg_weights,
+        initial: Optional[Array] = None,
+        mesh=None,
+        track_models: bool = False,
+    ):
+        """Solve the whole λ grid in ONE batched program.
+
+        ``reg_weights`` is the (deduplicated, ordered) λ sequence;
+        ``initial`` is either a [d] vector broadcast to every member or a
+        [G, d] bank. Returns ``(variances_bank, OptResult)`` where every
+        OptResult field carries a leading grid axis (slice i belongs to
+        reg_weights[i]); ``variances_bank`` is None unless
+        ``compute_variances`` (the Hdiag pass is a second program — the
+        1-compile contract covers the fit itself).
+
+        Unlike :meth:`run` driven sequentially, members do NOT warm-start
+        from each other — every λ starts from ``initial`` (see the README
+        "Regularization paths" discussion of when that trade wins).
+        """
+        weights = [float(w) for w in reg_weights]
+        G = len(weights)
+        splits = [self.regularization.split(w) for w in weights]
+        l1_vec = jnp.asarray([s[0] for s in splits], jnp.float32)
+        l2_vec = jnp.asarray([s[1] for s in splits], jnp.float32)
+        if initial is None:
+            w0_bank = jnp.zeros((G, self.objective.dim), jnp.float32)
+        else:
+            w0 = jnp.asarray(initial, jnp.float32)
+            w0_bank = (
+                w0 if w0.ndim == 2 else jnp.broadcast_to(
+                    w0, (G, self.objective.dim)
+                )
+            )
+
+        if mesh is None:
+            from photon_ml_tpu.data.batch import SparseBatch
+            from photon_ml_tpu.ops.tiled_sparse import (
+                TiledGLMObjective,
+                ensure_tiled,
+            )
+
+            if isinstance(self.objective, TiledGLMObjective) and isinstance(
+                batch, SparseBatch
+            ):
+                batch = ensure_tiled(batch, self.objective.dim)
+            fit = self._get_grid_fit(track_models)
+            result = fit(w0_bank, batch, l1_vec, l2_vec)
+            variances = None
+            if self.compute_variances:
+                import jax
+
+                hdiag = jax.jit(jax.vmap(
+                    lambda w, l2: self.objective.hessian_diagonal(
+                        w, batch, l2
+                    )
+                ))(result.coefficients, l2_vec)
+                variances = 1.0 / (hdiag + _VARIANCE_EPSILON)
+            return variances, result
+
+        from functools import partial as _partial
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from photon_ml_tpu.parallel.mesh import DATA_AXIS, ensure_data_sharded
+
+        axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
+        from photon_ml_tpu.ops.tiled_sparse import (
+            TiledGLMObjective,
+            ensure_tiled_sharded,
+        )
+
+        if isinstance(self.objective, TiledGLMObjective):
+            sharded = ensure_tiled_sharded(batch, self.objective.dim, mesh, axis)
+        else:
+            sharded = ensure_data_sharded(batch, mesh, axis)
+        fit = self._get_grid_fit(track_models, mesh=mesh, axis=axis)
+        result = fit(w0_bank, sharded, l1_vec, l2_vec)
+        variances = None
+        if self.compute_variances:
+            import jax
+
+            objective = self.objective.with_axis(axis)
+
+            @jax.jit
+            @_partial(
+                shard_map,
+                mesh=mesh,
+                in_specs=(P(), P(axis), P()),
+                out_specs=P(),
+                check_vma=False,
+            )
+            def _hdiag_grid(w_bank, b, l2v):
+                import jax as _jax
+
+                return _jax.vmap(
+                    lambda w, l2_: objective.hessian_diagonal(w, b, l2_)
+                )(w_bank, l2v)
+
+            hdiag = _hdiag_grid(result.coefficients, sharded, l2_vec)
+            variances = 1.0 / (hdiag + _VARIANCE_EPSILON)
+        return variances, result
+
     def run(
         self,
         batch: Batch,

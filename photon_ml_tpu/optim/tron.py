@@ -151,6 +151,12 @@ def minimize_tron(
     psums over the axis, so the optimizer is numerically identical to its
     replicated self with fully sharded state (same contract as
     minimize_lbfgs).
+
+    Under ``jax.vmap`` (the batched λ-grid path) both while_loops — the
+    outer trust-region loop and the truncated CG — are carry-masked per
+    member by the batching rule, so converged members freeze bit-stable
+    while stragglers iterate (see minimize_lbfgs's note; pinned by the
+    grid tests). Keep the ``cond``s pure per-member predicates.
     """
     from photon_ml_tpu.optim.lbfgs import make_global_prims
 

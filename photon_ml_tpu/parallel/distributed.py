@@ -158,6 +158,28 @@ def _opt_result_specs(model_axis: str, track_models: bool = False) -> OptResult:
     )
 
 
+def _opt_result_grid_specs(
+    model_axis: str, track_models: bool = False
+) -> OptResult:
+    """Grid-batched variant of :func:`_opt_result_specs`: every field
+    carries a leading [G] grid axis (replicated — the grid members live
+    on every device), with the coefficient banks still sharded over
+    ``model_axis`` on their feature axis."""
+    from photon_ml_tpu.optim.common import Tracker
+
+    return OptResult(
+        coefficients=P(None, model_axis),
+        value=P(),
+        grad_norm=P(),
+        iterations=P(),
+        reason=P(),
+        tracker=Tracker(
+            values=P(), grad_norms=P(), count=P(),
+            coefs=P(None, None, model_axis) if track_models else None,
+        ),
+    )
+
+
 def feature_sharded_fit(
     objective: GLMObjective,
     mesh: Mesh,
@@ -800,6 +822,7 @@ def feature_sharded_glm_fit(
     with_box: bool = False,
     track_models: bool = False,
     interpret: Optional[bool] = None,
+    grid: bool = False,
 ) -> Callable:
     """Unified feature-sharded fit builder: every optimizer x layout x
     feature combination the replicated path supports, on the 2-D
@@ -815,11 +838,15 @@ def feature_sharded_glm_fit(
     ``l1, l1_mask`` (owlqn), ``shift, factor`` (with_norm; full [d_pad]
     vectors, sharded over the model axis), ``lower, upper`` (with_box;
     full [d_pad] vectors). ``meta`` is required for the tiled layout.
-    """
-    from photon_ml_tpu.optim.common import BoxConstraints
-    from photon_ml_tpu.optim.lbfgs import minimize_owlqn
-    from photon_ml_tpu.optim.tron import minimize_tron
 
+    ``grid=True`` builds the batched λ-grid variant: ``w0`` becomes a
+    [G, d_pad] coefficient bank, ``l2`` (and owlqn's ``l1``) become [G]
+    vectors, and the shard_map body runs ``vmap(optimizer)`` over the
+    grid axis — every member's block solve shares ONE compiled program
+    and, on the tiled layout, one fused schedule walk per data pass
+    (ops.tiled_sparse._bilinear_pass_auto's custom_vmap rule). The
+    returned OptResult carries a leading grid axis on every field.
+    """
     if optimizer not in ("lbfgs", "owlqn", "tron"):
         raise ValueError(f"unknown optimizer {optimizer!r}")
     if layout not in ("sparse", "tiled"):
@@ -834,7 +861,7 @@ def feature_sharded_glm_fit(
     cache_key = (
         objective, _mesh_content_key(mesh), meta, layout, optimizer,
         data_axis, model_axis, max_iter, tol, history, max_cg,
-        with_norm, with_box, track_models, interpret,
+        with_norm, with_box, track_models, interpret, grid,
     )
     from photon_ml_tpu.utils.memo import get_or_build
 
@@ -845,7 +872,7 @@ def feature_sharded_glm_fit(
             data_axis=data_axis, model_axis=model_axis, max_iter=max_iter,
             tol=tol, history=history, max_cg=max_cg, with_norm=with_norm,
             with_box=with_box, track_models=track_models,
-            interpret=interpret,
+            interpret=interpret, grid=grid,
         ),
     )
 
@@ -867,6 +894,7 @@ def _build_feature_sharded_glm_fit(
     with_box: bool,
     track_models: bool,
     interpret: Optional[bool],
+    grid: bool = False,
 ) -> Callable:
     from photon_ml_tpu.optim.common import BoxConstraints
     from photon_ml_tpu.optim.lbfgs import minimize_owlqn
@@ -915,7 +943,32 @@ def _build_feature_sharded_glm_fit(
             box=box, axis_name=model_axis, track_coefficients=track_models,
         )
 
-    out_specs = _opt_result_specs(model_axis, track_models)
+    def _solve(make_vg, make_factory, w0_block, l1, l2, l1_mask, box):
+        """One block solve (grid=False) or the vmapped bank of G solves
+        (grid=True: w0_block is [G, d_block], l1/l2 are [G] — one
+        program, per-member convergence masked by the batched
+        while_loop)."""
+        if not grid:
+            vg = make_vg(l2)
+            factory = make_factory(l2) if tron else None
+            return _dispatch(vg, factory, w0_block, l1, l1_mask, box)
+        l1_vec = (
+            l1 if l1 is not None
+            else jnp.zeros((w0_block.shape[0],), w0_block.dtype)
+        )
+
+        def run_one(w0_b, l1_, l2_):
+            vg = make_vg(l2_)
+            factory = make_factory(l2_) if tron else None
+            return _dispatch(vg, factory, w0_b, l1_, l1_mask, box)
+
+        return jax.vmap(run_one)(w0_block, l1_vec, l2)
+
+    w0_spec = P(None, model_axis) if grid else P(model_axis)
+    out_specs = (
+        _opt_result_grid_specs(model_axis, track_models)
+        if grid else _opt_result_specs(model_axis, track_models)
+    )
 
     if layout == "tiled":
         from photon_ml_tpu.ops.tiled_sparse import (
@@ -930,7 +983,7 @@ def _build_feature_sharded_glm_fit(
             shard_map,
             mesh=mesh,
             in_specs=(
-                P(model_axis), sched_spec, sched_spec,
+                w0_spec, sched_spec, sched_spec,
                 P(data_axis), P(data_axis), P(data_axis), P(),
                 tuple(extra_specs),
             ),
@@ -943,18 +996,22 @@ def _build_feature_sharded_glm_fit(
             cell = FeatureShardedTiledBatch(
                 meta, z_sched, g_sched, labels, offsets, weights
             )
-            vg = tiled_block_local_vg(
-                loss, cell, data_axis, model_axis, l2,
-                shift=shift, factor=factor, interpret=interpret,
-            )
-            factory = (
-                tiled_block_local_hvp_factory(
-                    loss, cell, data_axis, model_axis, l2,
+
+            def make_vg(l2_):
+                return tiled_block_local_vg(
+                    loss, cell, data_axis, model_axis, l2_,
                     shift=shift, factor=factor, interpret=interpret,
                 )
-                if tron else None
+
+            def make_factory(l2_):
+                return tiled_block_local_hvp_factory(
+                    loss, cell, data_axis, model_axis, l2_,
+                    shift=shift, factor=factor, interpret=interpret,
+                )
+
+            return _solve(
+                make_vg, make_factory, w0_block, l1, l2, l1_mask, box
             )
-            return _dispatch(vg, factory, w0_block, l1, l1_mask, box)
 
         def fit(w0, batch, l2, *extras):
             return _fit(
@@ -966,25 +1023,30 @@ def _build_feature_sharded_glm_fit(
         @partial(
             shard_map,
             mesh=mesh,
-            in_specs=_sparse_shard_specs(model_axis, data_axis)
+            in_specs=(w0_spec,)
+            + _sparse_shard_specs(model_axis, data_axis)[1:]
             + (tuple(extra_specs),),
             out_specs=out_specs,
             check_vma=False,
         )
         def _fit(w0_block, b, l2, extras):
             l1, l1_mask, shift, factor, box = _unpack(extras)
-            vg = _sparse_block_vg(
-                loss, b, l2, model_axis, data_axis,
-                shift=shift, factor=factor,
-            )
-            factory = (
-                _sparse_block_hvp_factory(
-                    loss, b, l2, model_axis, data_axis,
+
+            def make_vg(l2_):
+                return _sparse_block_vg(
+                    loss, b, l2_, model_axis, data_axis,
                     shift=shift, factor=factor,
                 )
-                if tron else None
+
+            def make_factory(l2_):
+                return _sparse_block_hvp_factory(
+                    loss, b, l2_, model_axis, data_axis,
+                    shift=shift, factor=factor,
+                )
+
+            return _solve(
+                make_vg, make_factory, w0_block, l1, l2, l1_mask, box
             )
-            return _dispatch(vg, factory, w0_block, l1, l1_mask, box)
 
         def fit(w0, batch, l2, *extras):
             return _fit(w0, batch, l2, tuple(extras))

@@ -157,6 +157,204 @@ def train_generalized_linear_model(
     return models, results
 
 
+# Default host-memory budget for the batched grid's coefficient bank +
+# vmapped optimizer state ("auto" falls back to the warm-started
+# sequential path above it). 1 GiB leaves the usual batch-dominated HBM
+# headroom on every supported device class.
+DEFAULT_GRID_MEMORY_BUDGET = 1 << 30
+
+
+def grid_bank_bytes(
+    num_weights: int,
+    dim: int,
+    optimizer_type: OptimizerType = OptimizerType.LBFGS,
+    history: int = 10,
+) -> int:
+    """Estimated device bytes for the batched grid's [G, d] coefficient
+    bank plus the vmapped optimizer's per-member state (L-BFGS memory is
+    the dominant term: the [m, d] s/y buffers; TRON carries the CG
+    vectors instead)."""
+    if optimizer_type == OptimizerType.TRON:
+        vectors_per_member = 12  # w, g + CG s/r/d/hd + trial w/g + slack
+    else:
+        vectors_per_member = 2 * history + 8
+    return int(num_weights) * vectors_per_member * int(dim) * 4
+
+
+def resolve_grid_mode(
+    mode: str,
+    *,
+    num_weights: int,
+    dim: int,
+    optimizer_type: OptimizerType = OptimizerType.LBFGS,
+    history: int = 10,
+    memory_budget_bytes: int = DEFAULT_GRID_MEMORY_BUDGET,
+    streaming: bool = False,
+) -> str:
+    """Resolve ``--grid-mode {batched,sequential,auto}`` to a concrete
+    path. ``auto`` picks batched when the grid has >1 member, the data
+    fits in memory (not streaming — out-of-core stays the warm-started
+    sequential default), and the G×d state bank fits the budget;
+    everything else falls back to sequential. An explicit ``batched``
+    with streaming input is a configuration error (the host-driven
+    streamed optimizers cannot vmap over disk passes)."""
+    if mode not in ("batched", "sequential", "auto"):
+        raise ValueError(
+            f"unknown grid mode {mode!r}; expected batched | sequential "
+            "| auto"
+        )
+    if mode == "sequential":
+        return "sequential"
+    if streaming:
+        if mode == "batched":
+            raise ValueError(
+                "--grid-mode batched is incompatible with streaming "
+                "input: the streamed objectives evaluate through host "
+                "IO, which the single vmapped optimizer program cannot "
+                "trace; use sequential or auto"
+            )
+        return "sequential"
+    if mode == "batched":
+        return "batched"
+    if num_weights <= 1:
+        return "sequential"
+    bank = grid_bank_bytes(num_weights, dim, optimizer_type, history)
+    return "batched" if bank <= memory_budget_bytes else "sequential"
+
+
+def train_grid_batched(
+    batch: Batch,
+    task: TaskType,
+    dim: int,
+    *,
+    optimizer_type: OptimizerType = OptimizerType.LBFGS,
+    regularization_type: RegularizationType = RegularizationType.NONE,
+    regularization_weights: Sequence[float] = (0.0,),
+    elastic_net_alpha: Optional[float] = None,
+    max_iter: Optional[int] = None,
+    tolerance: Optional[float] = None,
+    normalization: Optional[NormalizationContext] = None,
+    compute_variances: bool = False,
+    box: Optional[BoxConstraints] = None,
+    intercept_index: Optional[int] = None,
+    initial: Optional[Array] = None,
+    kernel: str = "scatter",
+    mesh=None,
+    track_models: bool = False,
+    tile_cache_dir: Optional[str] = None,
+) -> Tuple[Dict[float, GeneralizedLinearModel], Dict[float, OptResult]]:
+    """Batched λ-grid twin of :func:`train_generalized_linear_model`:
+    the grid stacks into a [G, d] coefficient bank and ONE jitted
+    ``vmap(minimize_lbfgs/owlqn/tron)`` over a grid-batched objective
+    solves every λ simultaneously — G compiles + G optimizer loops + G
+    readback rounds become 1/1/1 (the final 1 via
+    :func:`grid_result_scalars`' single batched fetch).
+
+    The data pass is fused across the grid: the scatter objective's
+    sparse matvec batches into one (n×d)@(d×G)-shaped gather/contract
+    under vmap, and the tiled objective reuses its tile schedule (and
+    the persistent schedule cache) ONCE for the whole grid via the flat
+    grid pass (ops.tiled_sparse._grid_bilinear_pass). Box constraints,
+    normalization and offsets broadcast across the grid member axis.
+    Per-λ convergence is active-masked inside the while_loop carry:
+    converged members freeze bit-stable while stragglers run on.
+
+    There are NO warm starts between members (each λ starts from
+    ``initial``) — that is the trade against the sequential path; see
+    README "Regularization paths". Returns the same
+    ({lambda: model}, {lambda: OptResult}) contract as the sequential
+    trainer; result scalars stay device-resident for the batched fetch.
+    """
+    from photon_ml_tpu.optim.common import Tracker
+
+    base = OptimizerConfig.default_for(optimizer_type)
+    config = OptimizerConfig(
+        optimizer_type=optimizer_type,
+        max_iter=max_iter if max_iter is not None else base.max_iter,
+        tolerance=tolerance if tolerance is not None else base.tolerance,
+        lbfgs_history=base.lbfgs_history,
+        tron_max_cg=base.tron_max_cg,
+    )
+    regularization = RegularizationContext(regularization_type, elastic_net_alpha)
+    kernel = resolve_kernel(kernel, batch)
+    if mesh is not None and kernel != "tiled":
+        from photon_ml_tpu.parallel.mesh import ensure_data_sharded
+
+        batch = ensure_data_sharded(batch, mesh)
+    if kernel == "tiled":
+        from photon_ml_tpu.data.batch import SparseBatch
+        from photon_ml_tpu.ops.schedule_cache import cache_scope
+        from photon_ml_tpu.ops.tiled_sparse import (
+            TiledSparseBatch,
+            ensure_tiled_sharded,
+            tiled_batch_from_sparse,
+        )
+
+        with cache_scope(tile_cache_dir):
+            if mesh is not None:
+                if not isinstance(batch, (SparseBatch, TiledSparseBatch)):
+                    raise TypeError(
+                        "kernel='tiled' requires a SparseBatch or "
+                        f"TiledSparseBatch, got {type(batch).__name__}; use "
+                        "kernel='scatter' for dense batches"
+                    )
+                batch = ensure_tiled_sharded(batch, dim, mesh)
+            elif isinstance(batch, SparseBatch):
+                batch = tiled_batch_from_sparse(batch, dim)
+            elif not isinstance(batch, TiledSparseBatch):
+                raise TypeError(
+                    "kernel='tiled' requires a SparseBatch or "
+                    f"TiledSparseBatch, got {type(batch).__name__}; use "
+                    "kernel='scatter' for dense batches"
+                )
+    problem = create_glm_problem(
+        task,
+        dim,
+        config=config,
+        regularization=regularization,
+        norm=normalization,
+        compute_variances=compute_variances,
+        box=box,
+        intercept_index=intercept_index,
+        kernel=kernel,
+    )
+    # Same descending order as the sequential path, so the returned dict
+    # iterates identically — the order is cosmetic here (no warm starts).
+    weights_desc: List[float] = sorted(
+        set(float(w) for w in regularization_weights), reverse=True
+    )
+    variances, result = problem.run_grid(
+        batch, weights_desc, initial=initial, mesh=mesh,
+        track_models=track_models,
+    )
+
+    from photon_ml_tpu.models.coefficients import Coefficients
+
+    models: Dict[float, GeneralizedLinearModel] = {}
+    results: Dict[float, OptResult] = {}
+    for i, lam in enumerate(weights_desc):
+        var_i = variances[i] if variances is not None else None
+        coefficients = Coefficients(result.coefficients[i], var_i)
+        models[lam] = problem.create_model(coefficients, normalization)
+        tracker = result.tracker
+        results[lam] = OptResult(
+            coefficients=result.coefficients[i],
+            value=result.value[i],
+            grad_norm=result.grad_norm[i],
+            iterations=result.iterations[i],
+            reason=result.reason[i],
+            tracker=Tracker(
+                values=tracker.values[i],
+                grad_norms=tracker.grad_norms[i],
+                count=tracker.count[i],
+                coefs=(
+                    tracker.coefs[i] if tracker.coefs is not None else None
+                ),
+            ),
+        )
+    return models, results
+
+
 def train_feature_sharded(
     batch: Batch,
     task: TaskType,
@@ -336,6 +534,170 @@ def train_feature_sharded(
         )
         if warm_start:
             current = result.coefficients
+    return models, results
+
+
+def train_grid_batched_feature_sharded(
+    batch: Batch,
+    task: TaskType,
+    dim: int,
+    *,
+    mesh,
+    regularization_type: RegularizationType = RegularizationType.NONE,
+    regularization_weights: Sequence[float] = (0.0,),
+    elastic_net_alpha: Optional[float] = None,
+    max_iter: Optional[int] = None,
+    tolerance: Optional[float] = None,
+    history: int = 10,
+    normalization: Optional[NormalizationContext] = None,
+    compute_variances: bool = False,
+    box: Optional[BoxConstraints] = None,
+    intercept_index: Optional[int] = None,
+    kernel: str = "scatter",
+    optimizer_type: OptimizerType = OptimizerType.LBFGS,
+    track_models: bool = False,
+    tile_cache_dir: Optional[str] = None,
+) -> Tuple[Dict[float, GeneralizedLinearModel], Dict[float, OptResult]]:
+    """Batched λ-grid twin of :func:`train_feature_sharded`: the grid
+    stacks into a [G, d_pad] bank whose feature axis shards over the
+    (data, model) mesh while the grid axis is vmapped INSIDE the
+    shard_map body — one compiled program, one optimizer loop, one
+    schedule layout for every λ (sparse and tiled layouts both; the
+    tiled cells ride the fused grid pass). No cross-member warm starts
+    (each λ starts from zero), same trade as :func:`train_grid_batched`.
+    """
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.data.batch import SparseBatch
+    from photon_ml_tpu.models.coefficients import Coefficients
+    from photon_ml_tpu.models.glm import create_model
+    from photon_ml_tpu.ops.losses import loss_for_task
+    from photon_ml_tpu.ops.objective import GLMObjective
+    from photon_ml_tpu.optim.common import Tracker
+    from photon_ml_tpu.optim.factory import validate_optimizer_choice
+    from photon_ml_tpu.parallel.distributed import (
+        feature_shard_sparse_batch,
+        feature_sharded_extras,
+        feature_sharded_glm_fit,
+        feature_sharded_hessian_diagonal,
+    )
+    from photon_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    if not isinstance(batch, SparseBatch):
+        raise TypeError(
+            "feature-sharded training requires a SparseBatch, got "
+            f"{type(batch).__name__}"
+        )
+    if MODEL_AXIS not in mesh.axis_names or DATA_AXIS not in mesh.axis_names:
+        raise ValueError(
+            f"feature-sharded training needs a (data, model) mesh, got "
+            f"axes {mesh.axis_names}"
+        )
+    num_blocks = int(mesh.shape[MODEL_AXIS])
+    data_shards = int(mesh.shape[DATA_AXIS])
+    regularization = RegularizationContext(regularization_type, elastic_net_alpha)
+    objective = GLMObjective(loss_for_task(task), dim)
+    use_tron = optimizer_type == OptimizerType.TRON
+    use_owlqn = regularization.has_l1
+    base = OptimizerConfig.default_for(optimizer_type)
+    max_iter = max_iter if max_iter is not None else base.max_iter
+    tolerance = tolerance if tolerance is not None else base.tolerance
+    validate_optimizer_choice(
+        OptimizerConfig(optimizer_type=optimizer_type),
+        regularization,
+        loss_has_hessian=objective.loss.has_hessian,
+    )
+    kernel = resolve_kernel(kernel, batch)
+    with_norm = normalization is not None and not normalization.is_identity
+
+    if kernel == "tiled":
+        from photon_ml_tpu.ops.schedule_cache import cache_scope
+        from photon_ml_tpu.ops.tiled_sparse import feature_shard_tiled_batch
+
+        with cache_scope(tile_cache_dir):
+            sharded, block_dim = feature_shard_tiled_batch(
+                batch, dim, data_shards, num_blocks, mesh=mesh,
+                data_axis=DATA_AXIS, model_axis=MODEL_AXIS,
+            )
+        meta = sharded.meta
+    else:
+        sharded, block_dim = feature_shard_sparse_batch(
+            batch, dim, num_blocks, rows_multiple=data_shards
+        )
+        meta = None
+    optimizer = "tron" if use_tron else ("owlqn" if use_owlqn else "lbfgs")
+    layout = "tiled" if kernel == "tiled" else "sparse"
+    fit = feature_sharded_glm_fit(
+        objective, mesh, meta, layout=layout, optimizer=optimizer,
+        max_iter=max_iter, tol=tolerance, history=history,
+        with_norm=with_norm, with_box=box is not None,
+        track_models=track_models, grid=True,
+    )
+    d_pad = num_blocks * block_dim
+    extras_tail, l1_mask, _ = feature_sharded_extras(
+        dim, d_pad, normalization=normalization, box=box,
+        use_owlqn=use_owlqn, intercept_index=intercept_index,
+    )
+
+    hdiag_fn = None
+    if compute_variances:
+        hdiag_fn = feature_sharded_hessian_diagonal(
+            objective, mesh, meta, layout=layout, with_norm=with_norm,
+        )
+        norm_extras = extras_tail[:2] if with_norm else []
+
+    def _to_original_space(means):
+        if not with_norm:
+            return means
+        orig = normalization.model_to_original_space(means)
+        if intercept_index is not None:
+            orig = orig.at[intercept_index].add(
+                normalization.intercept_adjustment(means)
+            )
+        return orig
+
+    weights_desc = sorted(
+        set(float(w) for w in regularization_weights), reverse=True
+    )
+    G = len(weights_desc)
+    splits = [regularization.split(w) for w in weights_desc]
+    l1_vec = jnp.asarray([s[0] for s in splits], jnp.float32)
+    l2_vec = jnp.asarray([s[1] for s in splits], jnp.float32)
+    w0_bank = jnp.zeros((G, d_pad), jnp.float32)
+    extras = ([l1_vec, l1_mask] if use_owlqn else []) + extras_tail
+    result = fit(w0_bank, sharded, l2_vec, *extras)
+
+    models: Dict[float, GeneralizedLinearModel] = {}
+    results: Dict[float, OptResult] = {}
+    tracker = result.tracker
+    for i, lam in enumerate(weights_desc):
+        coefs_pad = result.coefficients[i]
+        variances = None
+        if hdiag_fn is not None:
+            from photon_ml_tpu.optim.problem import _VARIANCE_EPSILON
+
+            hd = hdiag_fn(coefs_pad, sharded, l2_vec[i], *norm_extras)
+            variances = (1.0 / (hd + _VARIANCE_EPSILON))[:dim]
+        models[lam] = create_model(
+            task,
+            Coefficients(_to_original_space(coefs_pad[:dim]), variances),
+        )
+        results[lam] = OptResult(
+            coefficients=coefs_pad[:dim],
+            value=result.value[i],
+            grad_norm=result.grad_norm[i],
+            iterations=result.iterations[i],
+            reason=result.reason[i],
+            tracker=Tracker(
+                values=tracker.values[i],
+                grad_norms=tracker.grad_norms[i],
+                count=tracker.count[i],
+                coefs=(
+                    tracker.coefs[i][:, :dim]
+                    if tracker.coefs is not None else None
+                ),
+            ),
+        )
     return models, results
 
 

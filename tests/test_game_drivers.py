@@ -187,6 +187,78 @@ class TestGameTrainingEndToEnd:
         with pytest.raises(ValueError, match="different run configuration"):
             GameTrainingDriver(params3).run()
 
+    def test_fe_lambda_grid_batched_matches_sequential(self, tmp_path, rng):
+        """A pure fixed-effect λ sweep (one FE coordinate, no REs, 1 CD
+        iteration) collapses to ONE vmapped grid program under
+        --grid-mode batched; per-combo objectives, validation metrics
+        and best-combo selection match the sequential sweep."""
+        import dataclasses
+
+        base = self._params(
+            tmp_path, rng,
+            fixed_effect_opt_configs={
+                "global": (
+                    "30,1e-6,0.1,1,LBFGS,L2;30,1e-6,10.0,1,LBFGS,L2;"
+                    "30,1e-6,1000.0,1,LBFGS,L2"
+                )
+            },
+            random_effect_data_configs={},
+            random_effect_opt_configs={},
+            num_iterations=1,
+            grid_mode="batched",
+        )
+        d_b = GameTrainingDriver(base)
+        d_b.run()
+        d_s = GameTrainingDriver(dataclasses.replace(
+            base, grid_mode="sequential",
+            output_dir=str(tmp_path / "out_seq"),
+        ))
+        d_s.run()
+        assert len(d_b.results) == 3
+        assert (
+            d_b.best_config["global"].reg_weight
+            == d_s.best_config["global"].reg_weight
+        )
+        by_lam_s = {
+            c["global"].reg_weight: r for c, r, _ in d_s.results
+        }
+        for combo, result, _ci in d_b.results:
+            lam = combo["global"].reg_weight
+            ref = by_lam_s[lam]
+            assert result.objective_history[-1] == pytest.approx(
+                ref.objective_history[-1], rel=2e-3
+            )
+            assert result.best_metric == pytest.approx(
+                ref.best_metric, abs=5e-3
+            )
+        # batched path still writes the reference model layout
+        assert os.path.isfile(os.path.join(
+            base.output_dir, "best-model", "fixed-effect", "global",
+            "id-info",
+        ))
+
+    def test_fe_grid_not_batchable_with_random_effects(self, tmp_path, rng):
+        """Grids that are NOT pure FE λ sweeps (here: an RE coordinate in
+        the model) always run the sequential warm-started sweep, even
+        under --grid-mode batched."""
+        params = self._params(
+            tmp_path, rng,
+            fixed_effect_opt_configs={
+                "global": "30,1e-6,0.1,1,LBFGS,L2;30,1e-6,1000.0,1,LBFGS,L2"
+            },
+            num_iterations=1,
+            grid_mode="batched",
+        )
+        driver = GameTrainingDriver(params)
+        assert driver._fe_grid_lambdas(expand_config_grid({
+            **params.fixed_effect_opt_configs,
+            **params.random_effect_opt_configs,
+        })) is None
+        driver.run()
+        assert len(driver.results) == 2
+        # sequential sweep trains strongest-λ first (warm-start order)
+        assert driver.results[0][0]["global"].reg_weight == 1000.0
+
     def test_grid_picks_best(self, tmp_path, rng):
         params = self._params(
             tmp_path, rng,

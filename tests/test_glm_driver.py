@@ -113,6 +113,70 @@ class TestGLMDriverEndToEnd:
         metrics = json.load(open(os.path.join(out, "metrics.json")))
         assert metrics["best_lambda"] is not None
 
+    def test_grid_mode_batched_matches_sequential(self, tmp_path, avro_dirs):
+        """--grid-mode batched: the whole λ grid trains as ONE vmapped
+        program and the driver pipeline (validation, best-model
+        selection, outputs) lands on the same answers as the sequential
+        sweep within the fp32 envelope."""
+        train, val = avro_dirs
+        drivers = {}
+        for mode in ("batched", "sequential"):
+            params = GLMParams(
+                train_dir=train,
+                validate_dir=val,
+                output_dir=str(tmp_path / f"out_{mode}"),
+                task=TaskType.LOGISTIC_REGRESSION,
+                regularization_weights=[0.1, 1.0, 10.0],
+                regularization_type=RegularizationType.L2,
+                grid_mode=mode,
+            )
+            drivers[mode] = GLMDriver(params)
+            drivers[mode].run()
+        b, s = drivers["batched"], drivers["sequential"]
+        assert b.best_lambda == s.best_lambda
+        for lam in (0.1, 1.0, 10.0):
+            assert float(b.results[lam].value) == pytest.approx(
+                float(s.results[lam].value), rel=2e-3
+            )
+            np.testing.assert_allclose(
+                np.asarray(b.models[lam].means),
+                np.asarray(s.models[lam].means), atol=5e-3,
+            )
+        assert os.path.isfile(
+            os.path.join(str(tmp_path / "out_batched"), "metrics.json")
+        )
+
+    def test_grid_mode_auto_budget_falls_back(self, tmp_path, avro_dirs):
+        """--grid-mode auto with a budget too small for the G×d bank must
+        fall back to the warm-started sequential path and still complete
+        the pipeline."""
+        train, val = avro_dirs
+        params = GLMParams(
+            train_dir=train,
+            validate_dir=val,
+            output_dir=str(tmp_path / "out_auto"),
+            task=TaskType.LOGISTIC_REGRESSION,
+            regularization_weights=[0.1, 1.0, 10.0],
+            regularization_type=RegularizationType.L2,
+            grid_mode="auto",
+            grid_memory_budget=1,  # nothing fits: sequential fallback
+        )
+        driver = GLMDriver(params)
+        driver.run()
+        assert set(driver.models) == {0.1, 1.0, 10.0}
+        assert driver.best_model is not None
+
+    def test_grid_mode_batched_rejected_with_streaming(self, tmp_path,
+                                                       avro_dirs):
+        train, val = avro_dirs
+        with pytest.raises(ValueError, match="incompatible with"):
+            GLMParams(
+                train_dir=train,
+                output_dir=str(tmp_path / "out"),
+                streaming=True,
+                grid_mode="batched",
+            ).validate()
+
     def test_output_dir_guard(self, tmp_path, avro_dirs):
         train, _ = avro_dirs
         out = tmp_path / "out"

@@ -125,6 +125,36 @@ class TestLintClean:
         )
         assert r.returncode == 1, r.stdout + r.stderr
 
+    def test_pl002_allow_sites_are_gone(self, full_report):
+        """Round 10 deleted the 12 constructor-time jit(lambda) allow
+        sites: the streaming objectives became pytree jit ARGUMENTS with
+        shared module-level programs (ops.objective partials,
+        io.streaming._tiled_fold_jit, game.streaming._chunk_jit), so the
+        recompile-hazard allow-count must stay ZERO — a new allow is a
+        regression, not a style choice."""
+        pl002 = [
+            s for s in full_report.allow_sites
+            if s.rules & {"PL002", "recompile-hazard"}
+        ]
+        assert pl002 == [], (
+            "recompile-hazard allow() sites reappeared (round 9 had 12, "
+            f"round 10 removed all): {pl002}"
+        )
+
+    def test_pl001_baseline_shrank_to_one_entry(self):
+        """Round 10 rewrote the host-driven optimizers to batch their
+        control scalars through the counted overlap.device_get seam,
+        retiring all 40 grandfathered host_lbfgs/host_tron float() pulls
+        (round-9 baseline: 41 entries / 43 sites). The baseline must
+        never grow back past the single remaining entry."""
+        entries = json.load(open(BASELINE))["entries"]
+        assert len(entries) == 1, entries
+        assert sum(e.get("count", 1) for e in entries) == 1
+        assert not any(
+            "host_lbfgs" in e["file"] or "host_tron" in e["file"]
+            for e in entries
+        )
+
     def test_json_lists_allow_sites_with_seam_accounting(self, repo_cwd):
         r = subprocess.run(
             [sys.executable, "-m", "photon_ml_tpu.lint",
