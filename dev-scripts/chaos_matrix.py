@@ -1,0 +1,338 @@
+#!/usr/bin/env python
+"""Chaos matrix: one injected fault per seam class, end-to-end through
+both drivers, asserting completion + accounting + bitwise parity.
+
+Driven by ``dev-scripts/chaos.sh``. Arms (each driver invocation is a
+fresh subprocess, so fault plans and reliability counters are
+per-arm):
+
+1. **GLM clean** — streaming λ-grid (tiled kernel + tile cache +
+   checkpoint dir + async summary write) with NO fault plan: the
+   reference outputs.
+2. **GLM faulted cold** — same args, fresh dirs, plan injecting one
+   transient fault at chunk_read, spill_write, spill_read, io_worker,
+   ckpt_save and cache_store. Must complete; ``models-text`` and the
+   models container must be BITWISE equal to arm 1; metrics.json must
+   account every injected fault and retry.
+3. **GLM faulted warm** — rerun over arm 2's tile cache with a
+   cache_load fault + a cache_load CORRUPT: the faulted artifact
+   quarantines (``*.corrupt`` on disk, counted in metrics) and the run
+   still completes bitwise-equal.
+4. **GAME clean** — streamed GAME CD (chunks + RE segments + score
+   stores + per-iteration CD snapshots).
+5. **GAME faulted** — same args, fresh dirs, faults at chunk_read,
+   spill_write, spill_read and ckpt_save. Completion + bitwise model
+   parity + accounting.
+
+Every asserted invariant is printed; any failure exits non-zero.
+"""
+
+import filecmp
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+GLM_PLAN_COLD = (
+    "chunk_read:2:EIO,spill_write:2:EIO,spill_read:2:EIO,"
+    "io_worker:1:EIO,ckpt_save:1:ENOSPC,cache_store:1:EIO"
+)
+GLM_PLAN_WARM = "cache_load:1:EIO,cache_load:3:CORRUPT"
+GAME_PLAN = (
+    "chunk_read:3:EIO,spill_write:4:EIO,spill_read:3:EIO,"
+    "ckpt_save:2:ENOSPC"
+)
+
+
+def log(msg):
+    print(f"[chaos] {msg}", flush=True)
+
+
+def run(cmd, **env):
+    e = {**os.environ, "JAX_PLATFORMS": "cpu",
+         "PHOTON_RETRY_BASE_S": "0.002", **env}
+    r = subprocess.run(
+        cmd, cwd=REPO, env=e, capture_output=True, text=True, timeout=900
+    )
+    if r.returncode != 0:
+        sys.exit(
+            f"[chaos] FAILED: {' '.join(cmd)}\n--- stdout\n"
+            f"{r.stdout[-4000:]}\n--- stderr\n{r.stderr[-4000:]}"
+        )
+    return r
+
+
+# -- synthetic data -----------------------------------------------------------
+
+
+def gen_glm_data(train_dir, *, n_files=3, rows=400, d=40, k=8, seed=0):
+    from photon_ml_tpu.io import schemas
+    from photon_ml_tpu.io.avro_codec import write_container
+
+    rng = np.random.default_rng(seed)
+    os.makedirs(train_dir, exist_ok=True)
+    w = rng.normal(size=d) * 0.5
+    for fi in range(n_files):
+        recs = []
+        for i in range(rows):
+            ix = rng.integers(0, d, size=k)
+            vs = rng.normal(size=k)
+            z = float((w[ix] * vs).sum())
+            recs.append({
+                "uid": f"{fi}-{i}",
+                "label": float(1 / (1 + np.exp(-z)) > rng.uniform()),
+                "features": [
+                    {"name": str(int(j)), "term": "", "value": float(v)}
+                    for j, v in zip(ix, vs)
+                ],
+                "offset": 0.0,
+                "weight": 1.0,
+            })
+        write_container(
+            os.path.join(train_dir, f"part-{fi:03d}.avro"),
+            schemas.TRAINING_EXAMPLE_AVRO, recs,
+        )
+
+
+def gen_game_data(train_dir, *, n_files=3, rows=150, n_users=8, d_g=5,
+                  d_u=3, seed=0):
+    from photon_ml_tpu.io import schemas
+    from photon_ml_tpu.io.avro_codec import write_container
+
+    schema = {
+        "name": "GameExample", "type": "record",
+        "fields": [
+            {"name": "uid", "type": ["null", "string"], "default": None},
+            {"name": "response", "type": "double"},
+            {"name": "metadataMap",
+             "type": ["null", {"type": "map", "values": "string"}],
+             "default": None},
+            {"name": "features",
+             "type": {"type": "array", "items": schemas.FEATURE_AVRO}},
+            {"name": "userFeatures",
+             "type": {"type": "array", "items": "FeatureAvro"}},
+        ],
+    }
+    rng = np.random.default_rng(seed)
+    os.makedirs(train_dir, exist_ok=True)
+    w_g = np.linspace(-1, 1, d_g)
+    w_u = np.random.default_rng(7).normal(size=(n_users, d_u))
+    for fi in range(n_files):
+        recs = []
+        for i in range(rows):
+            u = int(rng.integers(0, n_users))
+            xg = rng.normal(size=d_g)
+            xu = rng.normal(size=d_u)
+            z = float(xg @ w_g + xu @ w_u[u])
+            recs.append({
+                "uid": f"f{fi}-{i}",
+                "response": float(1 / (1 + np.exp(-z)) > rng.uniform()),
+                "metadataMap": {"userId": f"user{u}"},
+                "features": [
+                    {"name": f"g{j}", "term": "", "value": float(xg[j])}
+                    for j in range(d_g)
+                ],
+                "userFeatures": [
+                    {"name": f"u{j}", "term": "", "value": float(xu[j])}
+                    for j in range(d_u)
+                ],
+            })
+        write_container(
+            os.path.join(train_dir, f"part-{fi}.avro"), schema, recs
+        )
+
+
+# -- assertions ---------------------------------------------------------------
+
+
+def assert_trees_bitwise_equal(a, b, label):
+    diffs = []
+
+    def walk(rel):
+        da, db = os.path.join(a, rel), os.path.join(b, rel)
+        ents_a = sorted(os.listdir(da))
+        ents_b = sorted(os.listdir(db))
+        if ents_a != ents_b:
+            diffs.append(f"{rel}: {ents_a} != {ents_b}")
+            return
+        for e in ents_a:
+            r = os.path.join(rel, e) if rel else e
+            if os.path.isdir(os.path.join(a, r)):
+                walk(r)
+            elif not filecmp.cmp(
+                os.path.join(a, r), os.path.join(b, r), shallow=False
+            ):
+                diffs.append(r)
+
+    walk("")
+    assert not diffs, f"{label}: files differ between arms: {diffs}"
+    log(f"{label}: bitwise equal")
+
+
+def assert_accounting(metrics_path, plan, label):
+    m = json.load(open(metrics_path))
+    rel = m["reliability"]
+    injected = rel["faults"]["injected"]
+    retries = rel["retries"]["retries"]
+    assert rel["faults"]["plan"] == plan, (rel["faults"]["plan"], plan)
+    planned_seams = {e.split(":")[0] for e in plan.split(",")}
+    for seam in planned_seams:
+        assert injected.get(seam, 0) >= 1, (
+            f"{label}: planned fault at {seam} never fired "
+            f"(seam not crossed?): injected={injected}"
+        )
+    # every transient (EIO/ENOSPC) injection must be visible as a retry
+    transient = {
+        e.split(":")[0] for e in plan.split(",")
+        if e.split(":")[2] != "CORRUPT"
+    }
+    for seam in transient:
+        assert retries.get(seam, 0) >= 1, (
+            f"{label}: injected transient fault at {seam} not retried: "
+            f"{retries}"
+        )
+    log(f"{label}: accounting OK — injected={injected} retries={retries}")
+    return m
+
+
+# -- arms ---------------------------------------------------------------------
+
+
+def glm_args(train, out, ckpt, cache, plan=None):
+    args = [
+        sys.executable, "-m", "photon_ml_tpu.cli.glm_driver",
+        "--training-data-directory", train,
+        "--output-directory", out,
+        "--task", "LOGISTIC_REGRESSION",
+        "--regularization-weights", "10,1,0.1",
+        "--num-iterations", "12",
+        "--streaming", "true",
+        "--stream-memory-budget", str(64 << 10),
+        "--kernel", "tiled",
+        "--tile-cache-dir", cache,
+        "--checkpoint-dir", ckpt,
+        "--summarization-output-dir", os.path.join(out, "summary"),
+        "--normalization-type", "STANDARDIZATION",
+        "--delete-output-dirs-if-exist", "true",
+    ]
+    if plan:
+        args += ["--fault-plan", plan]
+    return args
+
+
+def game_args(train, out, ckpt, plan=None):
+    args = [
+        sys.executable, "-m", "photon_ml_tpu.cli.game_training_driver",
+        "--train-input-dirs", train,
+        "--output-dir", out,
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--feature-shard-id-to-feature-section-keys-map",
+        "globalShard:features|userShard:userFeatures",
+        "--fixed-effect-data-configurations",
+        "global:globalShard,1",
+        "--fixed-effect-optimization-configurations",
+        "global:20,1e-6,0.5,1,TRON,L2",
+        "--random-effect-data-configurations",
+        "per-user:userId,userShard,1,none,none,none,identity",
+        "--random-effect-optimization-configurations",
+        "per-user:20,1e-6,1.0,1,LBFGS,L2",
+        "--num-iterations", "2",
+        "--streaming", "true",
+        "--stream-memory-budget", str(64 << 10),
+        "--checkpoint-dir", ckpt,
+        "--delete-output-dir-if-exists", "true",
+    ]
+    if plan:
+        args += ["--fault-plan", plan]
+    return args
+
+
+def main():
+    base = tempfile.mkdtemp(prefix="photon-chaos-")
+    try:
+        glm_train = os.path.join(base, "glm-train")
+        game_train = os.path.join(base, "game-train")
+        gen_glm_data(glm_train)
+        gen_game_data(game_train)
+        log(f"synthetic data under {base}")
+
+        # -- GLM arms -----------------------------------------------------
+        out1 = os.path.join(base, "glm-out-clean")
+        out2 = os.path.join(base, "glm-out-faulted")
+        out3 = os.path.join(base, "glm-out-warm")
+        run(glm_args(glm_train, out1, os.path.join(base, "glm-ck1"),
+                     os.path.join(base, "glm-cache1")))
+        log("GLM clean arm completed")
+        run(glm_args(glm_train, out2, os.path.join(base, "glm-ck2"),
+                     os.path.join(base, "glm-cache2"), plan=GLM_PLAN_COLD))
+        log("GLM faulted (cold-cache) arm completed")
+        assert_accounting(
+            os.path.join(out2, "metrics.json"), GLM_PLAN_COLD, "GLM cold"
+        )
+        for sub in ("models-text", "models"):
+            # (no validate dir in the chaos arms, so there is no
+            # best-model tree; the full grid's models ARE the output)
+            assert_trees_bitwise_equal(
+                os.path.join(out1, sub), os.path.join(out2, sub),
+                f"GLM {sub}",
+            )
+        # warm arm: rerun over arm 2's populated tile cache with a
+        # transient + a corrupting cache_load fault
+        run(glm_args(glm_train, out3, os.path.join(base, "glm-ck3"),
+                     os.path.join(base, "glm-cache2"), plan=GLM_PLAN_WARM))
+        log("GLM faulted (warm-cache) arm completed")
+        m = assert_accounting(
+            os.path.join(out3, "metrics.json"), GLM_PLAN_WARM, "GLM warm"
+        )
+        quarantined = m["reliability"]["retries"]["quarantined"]
+        qpaths = m["reliability"]["retries"]["quarantined_artifacts"]
+        assert quarantined.get("cache_load", 0) >= 1, quarantined
+        assert any(".corrupt" in p for p in qpaths), qpaths
+        on_disk = [
+            p for p in qpaths
+            if os.path.exists(p) and ".corrupt" in p
+        ]
+        assert on_disk, f"quarantined artifacts not found on disk: {qpaths}"
+        log(f"GLM warm: quarantine OK — {os.path.basename(on_disk[0])}")
+        for sub in ("models-text", "models"):
+            assert_trees_bitwise_equal(
+                os.path.join(out1, sub), os.path.join(out3, sub),
+                f"GLM warm {sub}",
+            )
+
+        # -- GAME arms ----------------------------------------------------
+        gout1 = os.path.join(base, "game-out-clean")
+        gout2 = os.path.join(base, "game-out-faulted")
+        run(game_args(game_train, gout1, os.path.join(base, "game-ck1")))
+        log("GAME clean arm completed")
+        run(game_args(game_train, gout2, os.path.join(base, "game-ck2"),
+                      plan=GAME_PLAN))
+        log("GAME faulted arm completed")
+        assert_accounting(
+            os.path.join(gout2, "metrics.json"), GAME_PLAN, "GAME"
+        )
+        assert_trees_bitwise_equal(
+            os.path.join(gout1, "best-model"),
+            os.path.join(gout2, "best-model"),
+            "GAME best-model",
+        )
+        m1 = json.load(open(os.path.join(gout1, "metrics.json")))
+        m2 = json.load(open(os.path.join(gout2, "metrics.json")))
+        assert m1["objective_history"] == m2["objective_history"], (
+            m1["objective_history"], m2["objective_history"]
+        )
+        log("GAME: objective history identical across arms")
+        log("chaos matrix: PASS")
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -10,7 +10,6 @@ cli/game/scoring/Params.scala (option names kept), ScoredItem.scala.
 from __future__ import annotations
 
 import argparse
-import json
 import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -78,6 +77,9 @@ class GameScoringParams:
     # caps rows_per_chunk by the scored row's staged bytes so one flag
     # bounds the whole pipeline's host memory consistently.
     stream_memory_budget: int = 0
+    # Deterministic fault plan (reliability.faults); also via
+    # PHOTON_FAULT_PLAN. Chaos harness: dev-scripts/chaos.sh.
+    fault_plan: Optional[str] = None
 
     def validate(self):
         if not self.input_dirs:
@@ -125,6 +127,10 @@ class GameScoringDriver:
             from photon_ml_tpu.parallel import overlap
 
             overlap.set_overlap(False)
+        if params.fault_plan:
+            from photon_ml_tpu.reliability import install_plan
+
+            install_plan(params.fault_plan)
         from photon_ml_tpu.parallel.multihost import prepare_output_dir
 
         prepare_output_dir(
@@ -208,10 +214,16 @@ class GameScoringDriver:
             with self.timer.time("evaluate"):
                 self._evaluate(dataset, scores)
             if is_coordinator():
-                with open(
-                    os.path.join(p.output_dir, "metrics.json"), "w"
-                ) as f:
-                    json.dump(self.metrics, f, indent=2)
+                from photon_ml_tpu.reliability import (
+                    atomic_write_json,
+                    reliability_metrics,
+                )
+
+                atomic_write_json(
+                    os.path.join(p.output_dir, "metrics.json"),
+                    {**self.metrics,
+                     "reliability": reliability_metrics()},
+                )
         sync_processes("scores-written")
         self.logger.info("timers:\n%s", self.timer.summary())
 
@@ -311,6 +323,7 @@ class GameScoringDriver:
                             ),
                             schemas.SCORING_RESULT_AVRO,
                             self._score_records(ds, scores),
+                            artifact=f"scores/part-{part:05d}.avro",
                         )
                     part += 1
                     n_rows += ds.num_real_rows
@@ -337,10 +350,16 @@ class GameScoringDriver:
                     jnp.asarray(np.concatenate(all_weights)),
                 )
             if is_coordinator():
-                with open(
-                    os.path.join(p.output_dir, "metrics.json"), "w"
-                ) as f:
-                    json.dump(self.metrics, f, indent=2)
+                from photon_ml_tpu.reliability import (
+                    atomic_write_json,
+                    reliability_metrics,
+                )
+
+                atomic_write_json(
+                    os.path.join(p.output_dir, "metrics.json"),
+                    {**self.metrics,
+                     "reliability": reliability_metrics()},
+                )
 
     def _score_records(self, dataset, scores: np.ndarray) -> list:
         id_types = sorted(dataset.entity_indexes)
@@ -450,6 +469,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="disable the host-device overlap layer (async score-part "
         "writes) and run fully serial",
     )
+    ap.add_argument(
+        "--fault-plan", default=None,
+        help="deterministic fault injection "
+        "(seam:nth:error[:times], comma-separated); also via "
+        "PHOTON_FAULT_PLAN",
+    )
     return ap
 
 
@@ -481,6 +506,7 @@ def params_from_args(argv=None) -> GameScoringParams:
         streaming=str(ns.streaming).lower() in ("true", "1", "yes"),
         rows_per_chunk=ns.rows_per_chunk,
         stream_memory_budget=ns.stream_memory_budget,
+        fault_plan=ns.fault_plan,
         has_response=str(ns.has_response).lower() in ("true", "1", "yes"),
         date_range=ns.date_range,
         date_range_days_ago=ns.date_range_days_ago,

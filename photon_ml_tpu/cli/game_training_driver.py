@@ -124,10 +124,9 @@ def _ensure_manifest(directory: str, manifest: Dict[str, object]) -> None:
     # atomic write: concurrent processes sharing the directory either see
     # no file (and write identical content) or a complete one — never a
     # partial JSON
-    tmp = f"{path}.{os.getpid()}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(manifest, f, indent=2)
-    os.replace(tmp, path)
+    from photon_ml_tpu.reliability import atomic_write_json
+
+    atomic_write_json(path, manifest)
 
 
 def expand_config_grid(
@@ -261,6 +260,9 @@ class GameTrainingParams:
     # sequential.
     grid_mode: str = "auto"
     grid_memory_budget: int = 1 << 30
+    # Deterministic fault plan (reliability.faults), e.g.
+    # "spill_write:2:EIO,ckpt_save:1:ENOSPC"; also via PHOTON_FAULT_PLAN.
+    fault_plan: Optional[str] = None
 
     def validate(self) -> None:
         if not self.train_input_dirs:
@@ -316,8 +318,6 @@ class GameTrainingParams:
                     "factored random effects (latent re-projection "
                     "re-materializes every row per inner iteration)"
                 )
-            if self.checkpoint_dir is not None:
-                unsupported.append("checkpoint/resume")
             if self.distributed == "feature":
                 unsupported.append(
                     "a feature-sharded fixed effect (use the GLM driver's "
@@ -366,6 +366,10 @@ class GameTrainingDriver:
             from photon_ml_tpu.parallel import overlap
 
             overlap.set_overlap(False)
+        if params.fault_plan:
+            from photon_ml_tpu.reliability import install_plan
+
+            install_plan(params.fault_plan)
         prepare_output_dir(
             params.output_dir,
             delete_if_exists=params.delete_output_dir_if_exists,
@@ -859,44 +863,91 @@ class GameTrainingDriver:
         best = None
         best_extras = None
         best_orig_idx = None
-        for ci, combo in enumerate(combos):
-            with self.timer.time(f"train-combo-{ci}"), profile_trace(
-                p.profile_dir if ci == 0 else None
-            ):
-                result, extras = train_streaming_game(
-                    train_paths,
-                    p.feature_shards,
-                    p.fixed_effect_data_configs,
-                    p.random_effect_data_configs,
-                    combo,
-                    p.task_type,
-                    num_iterations=p.num_iterations,
-                    update_sequence=p.updating_sequence,
-                    memory_budget_bytes=p.stream_memory_budget,
-                    index_maps=self._offheap_index_maps(),
-                    validate_paths=validate_paths,
-                    evaluator_types=p.evaluator_types or None,
-                    compute_variance=p.compute_variance,
-                    diagnostic_reservoir_rows=p.diagnostic_reservoir_rows,
-                    diagnostic_reservoir_bytes=p.diagnostic_reservoir_bytes,
-                    logger=self.logger,
-                )
-            self.results.append((combo, result, ci))
-            metric = result.best_metric
-            if metric is None:
-                if best is None or (
-                    best[0].best_metric is None and ci < best_orig_idx
+        guard = None
+        if p.checkpoint_dir is not None:
+            from photon_ml_tpu.utils.preemption import PreemptionGuard
+
+            guard = PreemptionGuard().install()
+        preempted = False
+        try:
+            for ci, combo in enumerate(combos):
+                if guard is not None and guard.requested:
+                    self.logger.warning(
+                        "preemption requested: not starting combo %d/%d",
+                        ci + 1, len(combos),
+                    )
+                    preempted = True
+                    break
+                combo_ckpt_dir = None
+                if p.checkpoint_dir is not None:
+                    # combo-content keyed directory, like the in-memory
+                    # sweep: a changed grid can never resume foreign
+                    # staged chunks or CD snapshots
+                    fp = hashlib.sha1(
+                        "|".join(
+                            f"{name}:{cfg.render()}"
+                            for name, cfg in sorted(combo.items())
+                        ).encode()
+                    ).hexdigest()[:12]
+                    combo_ckpt_dir = os.path.join(
+                        p.checkpoint_dir, f"combo-{fp}"
+                    )
+                with self.timer.time(f"train-combo-{ci}"), profile_trace(
+                    p.profile_dir if ci == 0 else None
+                ):
+                    result, extras = train_streaming_game(
+                        train_paths,
+                        p.feature_shards,
+                        p.fixed_effect_data_configs,
+                        p.random_effect_data_configs,
+                        combo,
+                        p.task_type,
+                        num_iterations=p.num_iterations,
+                        update_sequence=p.updating_sequence,
+                        memory_budget_bytes=p.stream_memory_budget,
+                        index_maps=self._offheap_index_maps(),
+                        validate_paths=validate_paths,
+                        evaluator_types=p.evaluator_types or None,
+                        compute_variance=p.compute_variance,
+                        diagnostic_reservoir_rows=p.diagnostic_reservoir_rows,
+                        diagnostic_reservoir_bytes=p.diagnostic_reservoir_bytes,
+                        logger=self.logger,
+                        checkpoint_dir=combo_ckpt_dir,
+                        preemption_guard=guard,
+                    )
+                self.results.append((combo, result, ci))
+                metric = result.best_metric
+                if metric is None:
+                    if best is None or (
+                        best[0].best_metric is None and ci < best_orig_idx
+                    ):
+                        best, best_extras, best_orig_idx = result, extras, ci
+                        self.best_config = combo
+                elif (
+                    best is None
+                    or best[0].best_metric is None
+                    or (maximize and metric > best[0].best_metric)
+                    or (not maximize and metric < best[0].best_metric)
                 ):
                     best, best_extras, best_orig_idx = result, extras, ci
                     self.best_config = combo
-            elif (
-                best is None
-                or best[0].best_metric is None
-                or (maximize and metric > best[0].best_metric)
-                or (not maximize and metric < best[0].best_metric)
-            ):
-                best, best_extras, best_orig_idx = result, extras, ci
-                self.best_config = combo
+                if result.preempted:
+                    self.logger.warning(
+                        "stopping streaming combo sweep after preemption "
+                        "(combo %d/%d)", ci + 1, len(combos),
+                    )
+                    preempted = True
+                    break
+        finally:
+            if guard is not None:
+                guard.uninstall()
+        if preempted:
+            # best-so-far still publishes (mirroring the in-memory sweep);
+            # the checkpoints carry everything needed to resume and finish
+            self.logger.warning(
+                "preempted: publishing best-so-far; rerun with the same "
+                "args to resume the sweep from the checkpoints"
+            )
         self.best_result = (best, best.best_metric if best else None)
         if p.model_output_mode != "NONE" and best is not None:
             # a shell dataset carrying ONLY what save_game_model reads:
@@ -940,34 +991,38 @@ class GameTrainingDriver:
                 "label_mean": float(np.mean(sample["lab"])),
                 "weight_sum": float(np.sum(sample["wgt"])),
             }
-        with open(os.path.join(p.output_dir, "metrics.json"), "w") as f:
-            json.dump(
-                {
-                    "objective_history": (
-                        best.objective_history if best else []
+        from photon_ml_tpu.reliability import (
+            atomic_write_json,
+            reliability_metrics,
+        )
+
+        atomic_write_json(
+            os.path.join(p.output_dir, "metrics.json"),
+            {
+                "objective_history": (
+                    best.objective_history if best else []
+                ),
+                "validation_history": (
+                    best.validation_history if best else []
+                ),
+                "best_metric": best.best_metric if best else None,
+                "timers": self.timer.durations,
+                "streaming": {
+                    "memory_budget_bytes": p.stream_memory_budget,
+                    "rows_per_chunk": (
+                        best_extras["rows_per_chunk"]
+                        if best_extras else None
                     ),
-                    "validation_history": (
-                        best.validation_history if best else []
+                    "num_chunks": (
+                        best_extras["store"].count
+                        if best_extras else None
                     ),
-                    "best_metric": best.best_metric if best else None,
-                    "timers": self.timer.durations,
-                    "streaming": {
-                        "memory_budget_bytes": p.stream_memory_budget,
-                        "rows_per_chunk": (
-                            best_extras["rows_per_chunk"]
-                            if best_extras else None
-                        ),
-                        "num_chunks": (
-                            best_extras["store"].count
-                            if best_extras else None
-                        ),
-                        "peak_rss_bytes": peak_rss_bytes(),
-                        "diagnostics": diag,
-                    },
+                    "peak_rss_bytes": peak_rss_bytes(),
+                    "diagnostics": diag,
                 },
-                f,
-                indent=2,
-            )
+                "reliability": reliability_metrics(),
+            },
+        )
         self.logger.info("timers:\n%s", self.timer.summary())
 
     def run(self) -> None:
@@ -1229,17 +1284,21 @@ class GameTrainingDriver:
                                 p.num_output_files_for_random_effect_model
                             ),
                         )
-        with open(os.path.join(p.output_dir, "metrics.json"), "w") as f:
-            json.dump(
-                {
-                    "objective_history": best.objective_history,
-                    "validation_history": best.validation_history,
-                    "best_metric": best.best_metric,
-                    "timers": self.timer.durations,
-                },
-                f,
-                indent=2,
-            )
+        from photon_ml_tpu.reliability import (
+            atomic_write_json,
+            reliability_metrics,
+        )
+
+        atomic_write_json(
+            os.path.join(p.output_dir, "metrics.json"),
+            {
+                "objective_history": best.objective_history,
+                "validation_history": best.validation_history,
+                "best_metric": best.best_metric,
+                "timers": self.timer.durations,
+                "reliability": reliability_metrics(),
+            },
+        )
         sync_processes("outputs-written")
         self.logger.info("timers:\n%s", self.timer.summary())
 
@@ -1314,6 +1373,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument(
         "--model-shards", type=int, default=None,
         help="model-axis size for --distributed feature (default 2)",
+    )
+    ap.add_argument(
+        "--fault-plan", default=None,
+        help="deterministic fault injection, e.g. "
+        "'spill_write:2:EIO,ckpt_save:1:ENOSPC' (seam:nth:error[:times])"
+        "; also via PHOTON_FAULT_PLAN. Chaos harness: dev-scripts/"
+        "chaos.sh",
     )
     ap.add_argument(
         "--checkpoint-dir", default=None,
@@ -1465,6 +1531,7 @@ def params_from_args(argv=None) -> GameTrainingParams:
         num_processes=ns.num_processes,
         process_id=ns.process_id,
         checkpoint_dir=ns.checkpoint_dir,
+        fault_plan=ns.fault_plan,
         profile_dir=ns.profile_dir,
         tile_cache_dir=ns.tile_cache_dir,
         no_overlap=_bool(ns.no_overlap),

@@ -180,6 +180,17 @@ class GLMParams:
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
+    # Crash-safe λ-grid resume (reliability.GridCheckpointer): when set,
+    # every completed λ snapshots here (warm-start means + model +
+    # result), a SIGTERM stops the sweep at the next λ boundary, and a
+    # rerun with the same args resumes mid-path with bitwise-identical
+    # final models. Sequential, batched, and streaming grids all resume;
+    # feature-sharded paths run without snapshots (warned, not failed).
+    checkpoint_dir: Optional[str] = None
+    # Deterministic fault plan (reliability.faults): inject transient
+    # IO errors / corruption at named seams, e.g.
+    # "chunk_read:3:EIO,ckpt_save:1:ENOSPC". Also via PHOTON_FAULT_PLAN.
+    fault_plan: Optional[str] = None
 
     def validate(self) -> None:
         """Cross-field checks (Params.validate, Params.scala:200-222)."""
@@ -342,6 +353,10 @@ class GLMDriver:
             from photon_ml_tpu.parallel import overlap
 
             overlap.set_overlap(False)
+        if params.fault_plan:
+            from photon_ml_tpu.reliability import install_plan
+
+            install_plan(params.fault_plan)
         prepare_output_dir(
             params.output_dir,
             delete_if_exists=params.delete_output_dirs_if_exist,
@@ -552,6 +567,7 @@ class GLMDriver:
                             overlap.submit_io(  # photon: allow(undrained-io) — run() owns the drain barrier
                                 self._write_summary,
                                 p.summarization_output_dir,
+                                artifact="feature summary",
                             )
                 if p.data_validation_type != DataValidationType.VALIDATE_DISABLED:
                     # chunk-wise sanity checks — same DataValidators rules
@@ -605,7 +621,8 @@ class GLMDriver:
                     from photon_ml_tpu.parallel import overlap
 
                     overlap.submit_io(  # photon: allow(undrained-io) — run() owns the drain barrier
-                        self._write_summary, p.summarization_output_dir
+                        self._write_summary, p.summarization_output_dir,
+                        artifact="feature summary",
                     )
         self._advance(DriverStage.PREPROCESSED)
 
@@ -637,11 +654,53 @@ class GLMDriver:
             self.params.distributed, self.params.model_shards
         )
 
+    def _grid_checkpoint_setup(self):
+        """(GridCheckpointer, PreemptionGuard) for --checkpoint-dir, or
+        (None, None). The run manifest fingerprints everything that
+        shapes the λ iterate chain — resuming under a changed config
+        fails loudly instead of mixing foreign snapshots in."""
+        p = self.params
+        if p.checkpoint_dir is None:
+            return None, None
+        if p.distributed == "feature":
+            self.logger.warning(
+                "--checkpoint-dir is not wired through the feature-"
+                "sharded trainers yet; training without λ snapshots"
+            )
+            return None, None
+        from photon_ml_tpu.reliability import GridCheckpointer
+        from photon_ml_tpu.utils.preemption import PreemptionGuard
+
+        run_config = {
+            "train_dir": p.train_dir,
+            "train_date_range": p.train_date_range,
+            "train_date_range_days_ago": p.train_date_range_days_ago,
+            "task": p.task.name,
+            "optimizer": p.optimizer_type.value,
+            "regularization_type": p.regularization_type.value,
+            "regularization_weights": sorted(
+                set(float(w) for w in p.regularization_weights)
+            ),
+            "elastic_net_alpha": p.elastic_net_alpha,
+            "max_num_iterations": p.max_num_iterations,
+            "tolerance": p.tolerance,
+            "normalization_type": p.normalization_type.value,
+            "intercept": p.add_intercept,
+            "kernel": p.kernel,
+            "grid_mode": p.grid_mode,
+            "streaming": p.streaming,
+            "constraint_string": p.constraint_string,
+        }
+        guard = PreemptionGuard().install()
+        return GridCheckpointer(p.checkpoint_dir, run_config), guard
+
     def train(self) -> None:
         p = self.params
         self.emitter.send(TrainingStartEvent(p.job_name))
         from photon_ml_tpu.utils.profiling import profile_trace
 
+        grid_ckpt, guard = self._grid_checkpoint_setup()
+        self._preempted = False
         with self.timer.time("train"), profile_trace(p.profile_dir):
             data = self._data
             mesh = self._mesh()
@@ -735,6 +794,8 @@ class GLMDriver:
                         index_map=data.index_map,
                         stats=stats,
                         tile_cache_dir=p.tile_cache_dir,
+                        grid_checkpointer=grid_ckpt,
+                        preemption_guard=guard,
                     )
             elif p.distributed == "feature" and mesh is not None:
                 grid_mode = self._resolved_grid_mode(data.num_features)
@@ -831,6 +892,7 @@ class GLMDriver:
                         mesh=mesh,
                         track_models=p.validate_per_iteration,
                         tile_cache_dir=p.tile_cache_dir,
+                        grid_checkpointer=grid_ckpt,
                     )
                 else:
                     self.models, self.results = train_generalized_linear_model(
@@ -851,8 +913,19 @@ class GLMDriver:
                         mesh=mesh,
                         track_models=p.validate_per_iteration,
                         tile_cache_dir=p.tile_cache_dir,
+                        grid_checkpointer=grid_ckpt,
+                        preemption_guard=guard,
                     )
             self._log_results()
+        if guard is not None:
+            self._preempted = guard.requested
+            guard.uninstall()
+            if self._preempted:
+                self.logger.warning(
+                    "preemption requested: lambda sweep stopped at a "
+                    "lambda boundary (%d snapshot(s) on disk); rerun "
+                    "with the same args to resume", len(self.models),
+                )
         self._log_schedule_cache()
         self.emitter.send(TrainingFinishEvent(p.job_name))
         self._advance(DriverStage.TRAINED)
@@ -1171,7 +1244,9 @@ class GLMDriver:
                 self._data.index_map,
             )
         if p.enable_optimization_tracker:
-            with open(os.path.join(out, "optimization-log.txt"), "w") as f:
+            from photon_ml_tpu.reliability import atomic_writer
+
+            with atomic_writer(os.path.join(out, "optimization-log.txt")) as f:
                 for lam, res in sorted(self.results.items()):
                     t = res.tracker
                     n = int(t.count)
@@ -1206,8 +1281,15 @@ class GLMDriver:
                 "memory_budget_bytes": p.stream_memory_budget,
                 "peak_rss_bytes": peak_rss_bytes(),
             }
-        with open(os.path.join(out, "metrics.json"), "w") as f:
-            json.dump(payload, f, indent=2)
+        # fault/retry/quarantine accounting: every injected fault, retry
+        # and quarantined artifact this run performed, by seam
+        from photon_ml_tpu.reliability import (
+            atomic_write_json,
+            reliability_metrics,
+        )
+
+        payload["reliability"] = reliability_metrics()
+        atomic_write_json(os.path.join(out, "metrics.json"), payload)
 
     def run(self) -> None:
         from photon_ml_tpu.parallel.multihost import (
@@ -1218,6 +1300,17 @@ class GLMDriver:
         p = self.params
         self.preprocess()
         self.train()
+        if getattr(self, "_preempted", False):
+            # stopped mid-sweep on SIGTERM: the λ snapshots carry the
+            # partial state; publishing models/metrics from a partial
+            # grid would let a half-result masquerade as a full one
+            from photon_ml_tpu.parallel import overlap
+
+            overlap.drain_io()
+            sync_processes("outputs-written")
+            self.logger.info("preempted: outputs withheld; resume to finish")
+            self.emitter.close()
+            return
         if p.validate_dir:
             self.validate()
         if p.diagnostic_mode != DiagnosticMode.NONE and is_coordinator():
@@ -1375,6 +1468,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "+ vmapped optimizer state; auto falls back to sequential above "
         "it (default 1 GiB)",
     )
+    ap.add_argument(
+        "--checkpoint-dir", default=None,
+        help="crash-safe lambda-grid resume: completed lambdas snapshot "
+        "here, SIGTERM stops at the next lambda boundary, and a rerun "
+        "with the same args resumes mid-path (bitwise-identical final "
+        "models)",
+    )
+    ap.add_argument(
+        "--fault-plan", default=None,
+        help="deterministic fault injection, e.g. "
+        "'chunk_read:3:EIO,ckpt_save:1:ENOSPC:2' (seam:nth:error[:times]"
+        "); also via PHOTON_FAULT_PLAN. Chaos harness: dev-scripts/"
+        "chaos.sh",
+    )
     return ap
 
 
@@ -1456,6 +1563,8 @@ def params_from_args(argv=None) -> GLMParams:
         coordinator_address=ns.coordinator_address,
         num_processes=ns.num_processes,
         process_id=ns.process_id,
+        checkpoint_dir=ns.checkpoint_dir,
+        fault_plan=ns.fault_plan,
         event_listeners=(
             ns.event_listeners.split(",") if ns.event_listeners else []
         ),

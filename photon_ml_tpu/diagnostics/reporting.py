@@ -170,8 +170,10 @@ def render_html(doc: Document) -> str:
 
 
 def write_html_report(doc: Document, path: str) -> None:
+    from photon_ml_tpu.reliability.artifacts import atomic_writer
+
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "w", encoding="utf-8") as f:
+    with atomic_writer(path, encoding="utf-8") as f:
         f.write(render_html(doc))
 
 
@@ -237,6 +239,8 @@ def render_text(doc: Document) -> str:
 
 
 def write_text_report(doc: Document, path: str) -> None:
+    from photon_ml_tpu.reliability.artifacts import atomic_writer
+
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "w", encoding="utf-8") as f:
+    with atomic_writer(path, encoding="utf-8") as f:
         f.write(render_text(doc))

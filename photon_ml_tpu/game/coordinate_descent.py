@@ -255,7 +255,10 @@ class CoordinateDescent:
             if self.checkpointer is not None:
                 # async artifact IO: the write leaves the critical path;
                 # drain_io() below is the barrier before any stop
-                overlap.submit_io(self.checkpointer.save, it + 1, dict(models))
+                overlap.submit_io(
+                    self.checkpointer.save, it + 1, dict(models),
+                    artifact=f"checkpoint step {it + 1}",
+                )
 
             if self.validation_fn is not None:
                 game_model = GameModel(
@@ -284,6 +287,7 @@ class CoordinateDescent:
                         "best_metric": best_metric,
                         "metric_name": self.validation_metric,
                     },
+                    artifact="checkpoint meta",
                 )
 
             if self._preemption_agreed():

@@ -48,8 +48,10 @@ COEFFICIENTS = "coefficients"
 
 
 def _write_lines(path: str, lines: List[str]) -> None:
+    from photon_ml_tpu.reliability.artifacts import atomic_writer
+
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as f:
+    with atomic_writer(path) as f:
         f.write("\n".join(lines) + "\n")
 
 
@@ -77,7 +79,9 @@ def save_game_model(
 ) -> None:
     os.makedirs(out_dir, exist_ok=True)
     if model_spec:
-        with open(os.path.join(out_dir, "model-spec"), "w") as f:
+        from photon_ml_tpu.reliability.artifacts import atomic_writer
+
+        with atomic_writer(os.path.join(out_dir, "model-spec")) as f:
             f.write(model_spec)
     for name, sub in model.models.items():
         if isinstance(sub, FixedEffectModel):

@@ -97,11 +97,18 @@ def _game_files(paths) -> List[str]:
 def _stream_records(paths):
     """Record stream, ONE file resident at a time (python codec; the
     native column decoder holds whole-file columns either way, so the
-    bounded unit is identical)."""
+    bounded unit is identical). Each file's decode runs behind the
+    ``chunk_read`` seam — the whole-file read is the idempotent retry
+    unit, exactly like io.streaming._file_rows."""
     from photon_ml_tpu.io.avro_codec import read_avro_records
+    from photon_ml_tpu.reliability.retry import io_call
 
     for path in _game_files(paths):
-        yield from read_avro_records([path])
+        yield from io_call(
+            "chunk_read",
+            lambda path=path: list(read_avro_records([path])),
+            detail=path,
+        )
 
 
 def scan_game_stream(
@@ -195,7 +202,15 @@ class GameChunkStore:
     sparse (ix, v) pair per feature shard. The final chunk pads with
     weight-0 rows (inert in every consumer); global row id of chunk i's
     row j is ``i * R + j`` — the join key between chunks, score stores
-    and bucket row indexes."""
+    and bucket row indexes.
+
+    ``persist_dir``: crash-safe mode — the store lives in a NAMED
+    directory (under the driver's --checkpoint-dir) instead of swept
+    scratch, with a manifest updated atomically after every appended
+    chunk. A killed stage pass resumes from the completed chunks: the
+    constructor truncates any torn trailing partial chunk and reopens
+    the field files for append, and ``stage_game_stream`` skips the
+    records already staged instead of restaging everything."""
 
     def __init__(
         self,
@@ -203,11 +218,14 @@ class GameChunkStore:
         shard_nnz: Mapping[str, int],
         re_types: Sequence[str],
         spill_dir: Optional[str] = None,
+        *,
+        persist_dir: Optional[str] = None,
     ):
         self.R = int(rows_per_chunk)
         self.shard_nnz = dict(shard_nnz)
         self.re_types = list(re_types)
-        self.dir = make_spill_dir("photon-game-spill-", spill_dir)
+        self.persistent = persist_dir is not None
+        self._manifest: Dict[str, object] = {}
         self.count = 0
         self.num_real_rows = 0
         self._fields = (
@@ -215,11 +233,82 @@ class GameChunkStore:
             + [f"code__{t}" for t in self.re_types]
             + [x for s in self.shard_nnz for x in (f"ix__{s}", f"v__{s}")]
         )
-        self._writers = {
-            f: open(os.path.join(self.dir, f + ".bin"), "wb")
-            for f in self._fields
-        }
+        if not self.persistent:
+            self.dir = make_spill_dir("photon-game-spill-", spill_dir)
+            self._writers = {
+                f: open(os.path.join(self.dir, f + ".bin"), "wb")
+                for f in self._fields
+            }
+        else:
+            self.dir = os.path.abspath(persist_dir)
+            self._open_persistent()
         self._mm: Optional[Dict[str, np.memmap]] = None
+
+    # -- crash-safe persistence --------------------------------------------
+
+    def _config(self) -> Dict[str, object]:
+        return {
+            "rows_per_chunk": self.R,
+            "shard_nnz": dict(sorted(self.shard_nnz.items())),
+            "re_types": list(self.re_types),
+        }
+
+    def _open_persistent(self) -> None:
+        from photon_ml_tpu.reliability.manifest import ensure_run_manifest
+
+        manifest = ensure_run_manifest(
+            self.dir, self._config(), kind="game-chunk-store"
+        )
+        self._manifest = manifest
+        self.count = int(manifest.get("chunks", 0))
+        self.num_real_rows = int(manifest.get("real_rows", 0))
+        # reopen for append; truncate each field file to exactly the
+        # manifest's completed chunks — a torn trailing partial chunk
+        # (killed mid-append) is dropped and restaged
+        self._writers = {}
+        for f in self._fields:
+            path = os.path.join(self.dir, f + ".bin")
+            if not os.path.exists(path):
+                open(path, "wb").close()
+            fh = open(path, "r+b")
+            shape = self._shape(f)
+            per_chunk = int(
+                np.dtype(self._dtype(f)).itemsize * int(np.prod(shape))
+            )
+            fh.truncate(self.count * per_chunk)
+            self._writers[f] = fh
+
+    def _sync_manifest(self, **extra) -> None:
+        """Publish progress atomically (persistent stores only)."""
+        if not self.persistent:
+            return
+        from photon_ml_tpu.reliability.manifest import write_manifest
+
+        self._manifest.update(
+            chunks=self.count, real_rows=self.num_real_rows, **extra
+        )
+        write_manifest(self.dir, self._manifest)
+
+    @property
+    def rows_consumed(self) -> int:
+        """Records consumed from the input stream by the appended chunks
+        — ``real_rows`` counts every staged record (weight-0 included;
+        padding rows are not records), so it doubles as the resume skip
+        count for an interrupted stage pass."""
+        return self.num_real_rows
+
+    @property
+    def staged(self) -> bool:
+        return bool(self._manifest.get("staged"))
+
+    def mark_staged(self) -> None:
+        self._sync_manifest(staged=True)
+
+    def fill_done(self, tag: str) -> bool:
+        return bool(self._manifest.get(f"fill__{tag}"))
+
+    def mark_fill_done(self, tag: str) -> None:
+        self._sync_manifest(**{f"fill__{tag}": True})
 
     def _shape(self, field: str) -> Tuple[int, ...]:
         if field.startswith(("ix__", "v__")):
@@ -234,12 +323,31 @@ class GameChunkStore:
         )
 
     def append(self, arrays: Mapping[str, np.ndarray], real_rows: int) -> None:
+        from photon_ml_tpu.reliability.retry import io_call
+
         for f in self._fields:
             a = np.ascontiguousarray(arrays[f], self._dtype(f))
             assert a.shape == self._shape(f), (f, a.shape)
-            self._writers[f].write(a.tobytes())
+            data = a.tobytes()
+            w = self._writers[f]
+            off = self.count * len(data)
+
+            def _write(w=w, data=data, off=off):
+                # fixed per-chunk offset: a retried attempt overwrites in
+                # place, so a partial write can never shift later chunks
+                w.seek(off)
+                w.write(data)
+
+            io_call(
+                "spill_write", _write,
+                detail=f"{self.dir}/{f}.bin[{self.count}]",
+            )
         self.count += 1
         self.num_real_rows += int(real_rows)
+        if self.persistent:
+            for w in self._writers.values():
+                w.flush()
+            self._sync_manifest()
 
     def finalize(self) -> None:
         for w in self._writers.values():
@@ -253,9 +361,17 @@ class GameChunkStore:
         }
 
     def chunk(self, i: int) -> Dict[str, np.ndarray]:
-        """Materialize ONE chunk's arrays (copies — bounded by R rows)."""
+        """Materialize ONE chunk's arrays (copies — bounded by R rows),
+        behind the spill_read seam (idempotent, so transient errors
+        retry in place)."""
+        from photon_ml_tpu.reliability.retry import io_call
+
         assert self._mm is not None, "finalize() the store before reading"
-        return {f: np.array(self._mm[f][i]) for f in self._fields}
+        return io_call(
+            "spill_read",
+            lambda: {f: np.array(self._mm[f][i]) for f in self._fields},
+            detail=f"{self.dir}[{i}]",
+        )
 
     @property
     def num_rows_padded(self) -> int:
@@ -271,6 +387,11 @@ class GameChunkStore:
             if not w.closed:
                 w.close()
         self._mm = None
+        if self.persistent:
+            # a crash-safe store is the RESUME currency — it outlives the
+            # process on purpose; the driver removes it after a completed
+            # run publishes its model
+            return
         unregister_spill_dir(self.dir)
         shutil.rmtree(self.dir, ignore_errors=True)
 
@@ -286,8 +407,10 @@ class ScoreStore:
     disk file — the KeyValueScore currency spilled per chunk. Random
     access by global row id goes through the flat memmap view (the RE
     bucket residual gather), sequential access per chunk through
-    get/set_chunk. Lives inside its GameChunkStore's scratch dir, so the
-    atexit sweep covers it too."""
+    get/set_chunk (both behind the spill seams). Lives inside its
+    GameChunkStore's scratch dir, so the atexit sweep covers it too.
+    Scores are always recomputed from coordinate states, so a resumed
+    run simply re-creates the files — no manifest needed."""
 
     def __init__(self, base_dir: str, name: str, num_chunks: int, R: int):
         self.path = os.path.join(base_dir, f"score__{name}.bin")
@@ -297,10 +420,22 @@ class ScoreStore:
         )  # zero-initialized: matches score(initial zero models) exactly
 
     def get_chunk(self, i: int) -> np.ndarray:
-        return np.array(self._mm[i])
+        from photon_ml_tpu.reliability.retry import io_call
+
+        return io_call(
+            "spill_read", lambda: np.array(self._mm[i]),
+            detail=f"{self.path}[{i}]",
+        )
 
     def set_chunk(self, i: int, scores) -> None:
-        self._mm[i] = np.asarray(scores, np.float32)
+        from photon_ml_tpu.reliability.retry import io_call
+
+        data = np.asarray(scores, np.float32)
+
+        def _write():
+            self._mm[i] = data
+
+        io_call("spill_write", _write, detail=f"{self.path}[{i}]")
 
     def flat(self) -> np.ndarray:
         """[num_chunks * R] memmap view for global-row-id gathers."""
@@ -320,6 +455,7 @@ def stage_game_stream(
     strict_ids: bool = True,
     reservoir_rows: int = 0,
     seed: int = 0,
+    persist_dir: Optional[str] = None,
 ) -> Tuple[GameChunkStore, Optional[Dict[str, np.ndarray]]]:
     """Stream rows once into a spilled GameChunkStore. ``strict_ids``
     False maps entity ids absent from ``entity_indexes`` to code -1
@@ -331,9 +467,24 @@ def stage_game_stream(
     diagnostics reservoir). The caller byte-budgets the row count with
     io.streaming.budgeted_rows over :func:`game_row_bytes`, so wide
     multi-shard rows scale the sample DOWN exactly like the GLM driver's
-    reservoir."""
+    reservoir.
+
+    ``persist_dir``: crash-safe staging — the store persists there with
+    a progress manifest, and an interrupted stage pass RESUMES: records
+    already staged (``store.rows_consumed``) are skipped from the input
+    stream instead of restaged, and a store already marked staged
+    returns immediately. The staged bytes are bitwise identical to an
+    uninterrupted pass (records stream deterministically); only the
+    diagnostics reservoir differs on resume (it samples the remaining
+    tail — diagnostics-only, never model-affecting)."""
     R = int(rows_per_chunk)
-    store = GameChunkStore(R, stats.shard_nnz, re_types, spill_dir)
+    store = GameChunkStore(
+        R, stats.shard_nnz, re_types, spill_dir, persist_dir=persist_dir
+    )
+    if store.staged:
+        store.finalize()
+        return store, None
+    skip_records = store.rows_consumed
     icepts = {}
     for cfg in shard_configs:
         imap = index_maps[cfg.shard_id]
@@ -370,6 +521,13 @@ def stage_game_stream(
     bufs = new_bufs()
     fill = 0
     records = _stream_records(paths)
+    if skip_records:
+        # resume: fast-forward past the records the completed chunks
+        # already staged (the decode cost of the skip is unavoidable;
+        # the staging/scatter cost is not)
+        import itertools
+
+        records = itertools.islice(records, skip_records, None)
     from photon_ml_tpu.io.streaming import _prefetched
     from photon_ml_tpu.parallel.overlap import overlap_enabled
 
@@ -434,6 +592,7 @@ def stage_game_stream(
             fill = 0
     if fill:
         store.append(bufs, real_rows=fill)
+    store.mark_staged()
     store.finalize()
     if res is not None:
         k_eff = min(seen_real, K)
@@ -490,6 +649,14 @@ class SpilledREBuckets:
     when solved (the in-memory path's single [E_b, S, k] class block can
     exceed host RAM at out-of-core scale); a segment always holds at
     least one entity.
+
+    Crash-safe resume: on a PERSISTENT store, a completed fill pass is
+    recorded in the store manifest (keyed by re_type + shard). A
+    restarted run whose manifest carries the flag reopens the segment
+    files as-is and skips the fill; an INTERRUPTED fill restarts from
+    scratch — the scatter is idempotent (every (segment, slot, rank)
+    write lands the same value), so re-running it converges without
+    restaging anything.
     """
 
     def __init__(
@@ -513,6 +680,8 @@ class SpilledREBuckets:
             np.log2(np.maximum(counts[nz], 1))
         ).astype(np.int64)
         self.num_active_rows = int(counts.sum())
+        fill_tag = f"re__{re_type}__{shard_id}"
+        resume = store.fill_done(fill_tag)
         seg_of = np.full(E, -1, np.int64)
         slot_of = np.zeros(E, np.int64)
         self.segments: List[_REBucketSegment] = []
@@ -524,7 +693,7 @@ class SpilledREBuckets:
                 seg_members = members[lo:lo + max_e]
                 seg_dir = os.path.join(
                     store.dir,
-                    f"re__{re_type}__seg{len(self.segments)}",
+                    f"re__{re_type}__{shard_id}__seg{len(self.segments)}",
                 )
                 os.makedirs(seg_dir, exist_ok=True)
                 seg = _REBucketSegment(
@@ -532,15 +701,18 @@ class SpilledREBuckets:
                     capacity=int(S),
                     dir=seg_dir,
                 )
-                arrs = seg.arrays(self.k, mode="w+")
-                arrs["rows"][:] = -1  # memmaps start zeroed; rows pad -1
-                for a in arrs.values():
-                    a.flush()
+                if not resume:
+                    arrs = seg.arrays(self.k, mode="w+")
+                    arrs["rows"][:] = -1  # memmaps zero; rows pad -1
+                    for a in arrs.values():
+                        a.flush()
                 seg_of[seg_members] = len(self.segments)
                 slot_of[seg_members] = np.arange(len(seg_members))
                 self.segments.append(seg)
         self._seg_of, self._slot_of = seg_of, slot_of
-        self._fill_pass()
+        if not resume:
+            self._fill_pass()
+            store.mark_fill_done(fill_tag)
 
     def _fill_pass(self) -> None:
         """Scatter every valid staged row into its entity's (segment,
@@ -569,28 +741,45 @@ class SpilledREBuckets:
             gids = (ci * st.R + rows).astype(np.int32)
             ix = c[f"ix__{self.shard_id}"][rows]
             v = c[f"v__{self.shard_id}"][rows]
-            for si in np.unique(self._seg_of[e]):
-                m = self._seg_of[e] == si
-                sl = self._slot_of[e[m]]
-                rk = rank[m]
-                h = handles[si]
-                h["rows"][sl, rk] = gids[m]
-                h["ix"][sl, rk] = ix[m]
-                h["v"][sl, rk] = v[m]
-                h["lab"][sl, rk] = c["lab"][rows[m]]
-                h["off"][sl, rk] = c["off"][rows[m]]
-                h["wgt"][sl, rk] = c["wgt"][rows[m]]
+
+            def _scatter():
+                # spill_write seam; the slot assignments are idempotent,
+                # so a retried attempt rewrites the same values in place
+                for si in np.unique(self._seg_of[e]):
+                    m = self._seg_of[e] == si
+                    sl = self._slot_of[e[m]]
+                    rk = rank[m]
+                    h = handles[si]
+                    h["rows"][sl, rk] = gids[m]
+                    h["ix"][sl, rk] = ix[m]
+                    h["v"][sl, rk] = v[m]
+                    h["lab"][sl, rk] = c["lab"][rows[m]]
+                    h["off"][sl, rk] = c["off"][rows[m]]
+                    h["wgt"][sl, rk] = c["wgt"][rows[m]]
+
+            from photon_ml_tpu.reliability.retry import io_call
+
+            io_call(
+                "spill_write", _scatter,
+                detail=f"re__{self.re_type}__{self.shard_id} fill[{ci}]",
+            )
         for h in handles:
             for a in h.values():
                 a.flush()
 
     def iter_segments(self):
         """Yield (entity_codes, arrays) with arrays MATERIALIZED (one
-        segment resident at a time)."""
+        segment resident at a time), behind the spill_read seam."""
+        from photon_ml_tpu.reliability.retry import io_call
+
         for seg in self.segments:
-            arrs = {
-                f: np.array(a) for f, a in seg.arrays(self.k).items()
-            }
+            arrs = io_call(
+                "spill_read",
+                lambda seg=seg: {
+                    f: np.array(a) for f, a in seg.arrays(self.k).items()
+                },
+                detail=seg.dir,
+            )
             yield seg.entity_codes, arrs
 
 
@@ -944,6 +1133,9 @@ class StreamingGameResult:
     validation_history: List[Dict[str, float]] = field(default_factory=list)
     best_metric: Optional[float] = None
     trackers: Dict[str, List[object]] = field(default_factory=dict)
+    # True when the run stopped early on a preemption signal; the last
+    # completed iteration is checkpointed, so a restarted job resumes.
+    preempted: bool = False
 
 
 class StreamingCoordinateDescent:
@@ -968,6 +1160,8 @@ class StreamingCoordinateDescent:
         validation_metric: Optional[str] = None,
         validation_maximize: bool = True,
         logger: Optional[PhotonLogger] = None,
+        checkpointer=None,  # reliability.checkpoint.StreamingCDCheckpointer
+        preemption_guard=None,  # utils.preemption.PreemptionGuard
     ):
         self.coordinates = coordinates
         self.store = store
@@ -982,6 +1176,8 @@ class StreamingCoordinateDescent:
         self.validation_metric = validation_metric
         self.validation_maximize = validation_maximize
         self.logger = logger or PhotonLogger()
+        self.checkpointer = checkpointer
+        self.preemption_guard = preemption_guard
         from photon_ml_tpu.ops.losses import loss_for_task
 
         self._loss = loss_for_task(task)
@@ -991,6 +1187,16 @@ class StreamingCoordinateDescent:
         if isinstance(coord, StreamingFixedEffectCoordinate):
             return coord.initialize_coefficients()
         return coord.initialize_bank()
+
+    def _preemption_agreed(self) -> bool:
+        """Streaming CD is single-process (validated up front), so the
+        cooperative stop is just the local guard's flag — the name
+        mirrors CoordinateDescent._preemption_agreed, which adds the
+        cross-process allgather the multi-host path needs."""
+        return (
+            self.preemption_guard is not None
+            and self.preemption_guard.requested
+        )
 
     def run(self, num_iterations: int) -> StreamingGameResult:
         import jax.numpy as jnp
@@ -1006,7 +1212,47 @@ class StreamingCoordinateDescent:
         validation_history: List[Dict[str, float]] = []
         trackers: Dict[str, List[object]] = {name: [] for name in seq}
         best_metric = None
-        for it in range(num_iterations):
+        preempted = False
+        start_iteration = 0
+        if self.checkpointer is not None:
+            latest = self.checkpointer.latest_step()
+            if latest is not None:
+                st, var, hist = self.checkpointer.load(latest)
+                for name in seq:
+                    states[name] = jnp.asarray(st[name])
+                    v = var.get(name)
+                    variances[name] = (
+                        jnp.asarray(v) if v is not None else None
+                    )
+                    coord = self.coordinates[name]
+                    if isinstance(coord, StreamingRandomEffectCoordinate):
+                        # the RE variance bank accumulates across segment
+                        # updates — reseed it so later iterations patch
+                        # the restored values instead of zeros
+                        if variances[name] is not None:
+                            coord._var_bank = variances[name]
+                objective_history = list(hist.get("objective") or [])
+                validation_history = list(hist.get("validation") or [])
+                best_metric = hist.get("best_metric")
+                start_iteration = latest
+                # rebuild every coordinate's score store from the
+                # restored states: score_chunk is deterministic, so the
+                # rebuilt scores are bitwise what the interrupted run
+                # held after this iteration
+                for name in seq:
+                    coord = self.coordinates[name]
+                    for i in range(self.store.count):
+                        scores[name].set_chunk(
+                            i,
+                            coord.score_chunk(
+                                states[name], self.store.chunk(i)
+                            ),
+                        )
+                self.logger.info(
+                    "resumed streaming coordinate descent from "
+                    "checkpoint step %d", latest,
+                )
+        for it in range(start_iteration, num_iterations):
             for name in seq:
                 coord = self.coordinates[name]
                 if residual is not None:
@@ -1067,6 +1313,34 @@ class StreamingCoordinateDescent:
                         or (not self.validation_maximize and m < best_metric)
                     ):
                         best_metric = m
+            if self.checkpointer is not None:
+                # iteration it+1 is a complete resume point: states (+
+                # variances) are everything iteration it+2 depends on —
+                # scores/residuals recompute deterministically from them
+                self.checkpointer.save(
+                    it + 1,
+                    {name: np.asarray(states[name]) for name in seq},
+                    {
+                        name: (
+                            np.asarray(variances[name])
+                            if variances[name] is not None
+                            else None
+                        )
+                        for name in seq
+                    },
+                    {
+                        "objective": objective_history,
+                        "validation": validation_history,
+                        "best_metric": best_metric,
+                    },
+                )
+            if self._preemption_agreed():
+                preempted = True
+                self.logger.warning(
+                    "preemption requested: stopping after iteration %d/%d",
+                    it + 1, num_iterations,
+                )
+                break
         game_model = self._export_model(states, variances)
         return StreamingGameResult(
             models=dict(states),
@@ -1075,6 +1349,7 @@ class StreamingCoordinateDescent:
             validation_history=validation_history,
             best_metric=best_metric,
             trackers=trackers,
+            preempted=preempted,
         )
 
     @staticmethod
@@ -1178,6 +1453,8 @@ def train_streaming_game(
     diagnostic_reservoir_rows: int = 0,
     diagnostic_reservoir_bytes: int = 256 << 20,
     logger: Optional[PhotonLogger] = None,
+    checkpoint_dir: Optional[str] = None,
+    preemption_guard=None,
 ):
     """End-to-end streamed GAME fit: scan -> stage -> streamed CD
     [-> streamed validation]. Returns (StreamingGameResult, extras) where
@@ -1187,9 +1464,46 @@ def train_streaming_game(
     ``memory_budget_bytes`` (--stream-memory-budget) fixes BOTH the
     staged-chunk row count and the random-effect segment byte cap; 0
     keeps the default 65536-row chunks with 1 GiB segments.
+
+    ``checkpoint_dir``: crash-safe resume for the WHOLE pipeline — the
+    staged chunk stores persist there with progress manifests (an
+    interrupted stage pass resumes from completed chunks, an interrupted
+    RE fill pass re-scatters from the staged chunks without restaging),
+    and the CD loop snapshots every iteration
+    (reliability.StreamingCDCheckpointer). A restarted run with the same
+    args produces a bitwise-identical final model. ``preemption_guard``
+    stops at the next iteration boundary on SIGTERM, mirroring the
+    in-memory CoordinateDescent.
     """
     logger = logger or PhotonLogger()
     validate_streaming_game_configs(re_data_configs)
+    stage_train_dir = stage_validate_dir = cd_dir = None
+    if checkpoint_dir is not None:
+        from photon_ml_tpu.reliability.manifest import ensure_run_manifest
+
+        ensure_run_manifest(
+            os.path.abspath(checkpoint_dir),
+            {
+                "paths": [str(p) for p in paths],
+                "shards": [repr(s) for s in shard_configs],
+                "fe": {k: repr(v) for k, v in sorted(fe_data_configs.items())},
+                "re": {k: repr(v) for k, v in sorted(re_data_configs.items())},
+                "combo": {
+                    k: getattr(v, "render", lambda: repr(v))()
+                    for k, v in sorted(opt_combo.items())
+                },
+                "task": getattr(task, "name", str(task)),
+                "num_iterations": int(num_iterations),
+                "update_sequence": list(update_sequence or []),
+                "memory_budget_bytes": int(memory_budget_bytes),
+                "validate_paths": [str(p) for p in (validate_paths or [])],
+            },
+            kind="game-streaming-run",
+        )
+        stage_train_dir = os.path.join(checkpoint_dir, "stage-train")
+        if validate_paths:
+            stage_validate_dir = os.path.join(checkpoint_dir, "stage-validate")
+        cd_dir = os.path.join(checkpoint_dir, "cd")
     re_types = sorted(
         {c.random_effect_type for c in re_data_configs.values()}
     )
@@ -1225,7 +1539,7 @@ def train_streaming_game(
     store, sample = stage_game_stream(
         paths, shard_configs, re_types, imaps, entity_indexes, stats,
         rows_per_chunk=rows_per_chunk, spill_dir=spill_dir,
-        reservoir_rows=reservoir_rows,
+        reservoir_rows=reservoir_rows, persist_dir=stage_train_dir,
     )
     from photon_ml_tpu.game.random_effect import (
         RandomEffectOptimizationProblem,
@@ -1283,7 +1597,7 @@ def train_streaming_game(
         vstore, _ = stage_game_stream(
             validate_paths, shard_configs, re_types, imaps, entity_indexes,
             stats, rows_per_chunk=rows_per_chunk, spill_dir=spill_dir,
-            strict_ids=False,
+            strict_ids=False, persist_dir=stage_validate_dir,
         )
         from photon_ml_tpu.evaluation import EvaluatorType
         from photon_ml_tpu.evaluation.streaming import (
@@ -1345,6 +1659,13 @@ def train_streaming_game(
                     acc.update(vals, c["lab"], c["wgt"])
             return {key: acc.result() for key, (_, acc) in accs.items()}
 
+    cd_checkpointer = None
+    if cd_dir is not None:
+        from photon_ml_tpu.reliability.checkpoint import (
+            StreamingCDCheckpointer,
+        )
+
+        cd_checkpointer = StreamingCDCheckpointer(cd_dir)
     cd = StreamingCoordinateDescent(
         coordinates, store, task,
         update_sequence=update_sequence,
@@ -1352,6 +1673,8 @@ def train_streaming_game(
         validation_metric=metric_name,
         validation_maximize=maximize,
         logger=logger,
+        checkpointer=cd_checkpointer,
+        preemption_guard=preemption_guard,
     )
     result = cd.run(num_iterations)
     extras = dict(

@@ -339,7 +339,13 @@ def write_container(
     codec: str = "deflate",
     sync_interval: int = DEFAULT_SYNC_INTERVAL,
 ) -> int:
-    """Write an Avro object container file; returns the record count."""
+    """Write an Avro object container file; returns the record count.
+
+    Atomic: bytes land in a same-directory temp file and ``os.replace``
+    publishes them, so a reader (or a resumed run) never sees a torn
+    container — part files, model files and summaries are all artifacts
+    a crash must not leave half-written (reliability layer contract,
+    enforced by lint rule PL006)."""
     # parse_schema mutates nested dicts while resolving references — give it
     # a copy so the caller's schema object stays pristine.
     parsed = parse_schema(
@@ -348,12 +354,25 @@ def write_container(
     schema_json = json.dumps(schema) if isinstance(schema, (dict, list)) else schema
     if codec not in ("null", "deflate"):
         raise ValueError(f"unsupported codec: {codec}")
-    sync = os.urandom(SYNC_SIZE)
+    # DETERMINISTIC sync marker (was os.urandom): the marker only
+    # delimits blocks (the reader walks block counts/sizes and checks
+    # it), so deriving it from the schema alone makes the container
+    # byte-reproducible for identical records — the chaos matrix and
+    # the kill-9 resume tests assert fault-injected / resumed runs are
+    # BITWISE equal to clean runs, artifact files included (which also
+    # means it must NOT depend on the output path).
+    import hashlib
+
+    sync = hashlib.blake2b(
+        f"photon-avro-sync|{schema_json}".encode(), digest_size=SYNC_SIZE
+    ).digest()
     count_total = 0
     parent = os.path.dirname(path)
     if parent:
         os.makedirs(parent, exist_ok=True)
-    with open(path, "wb") as f:
+    from photon_ml_tpu.reliability.artifacts import atomic_writer
+
+    with atomic_writer(path, "wb") as f:
         f.write(MAGIC)
         meta_enc = BinaryEncoder(f)
         write_datum(

@@ -54,7 +54,9 @@ def write_models_in_text(
                 continue
             name, term = split_feature_key(key)
             lines.append(f"{name}\t{term}\t{means[i]}\t{lam}")
-        with open(os.path.join(model_dir, f"{lam}.txt"), "w") as f:
+        from photon_ml_tpu.reliability.artifacts import atomic_writer
+
+        with atomic_writer(os.path.join(model_dir, f"{lam}.txt")) as f:
             f.write("\n".join(lines) + "\n")
 
 

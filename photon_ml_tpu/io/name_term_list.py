@@ -68,7 +68,11 @@ def save_name_and_term_feature_sets(
     for section, keys in sets.items():
         d = os.path.join(output_dir, section)
         os.makedirs(d, exist_ok=True)
-        with open(os.path.join(d, "part-00000"), "w", encoding="utf-8") as f:
+        from photon_ml_tpu.reliability.artifacts import atomic_writer
+
+        with atomic_writer(
+            os.path.join(d, "part-00000"), encoding="utf-8"
+        ) as f:
             for key in sorted(set(keys)):
                 f.write(key + "\n")  # key is already name<TAB>term
 

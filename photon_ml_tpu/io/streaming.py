@@ -66,6 +66,26 @@ def scan_stream_with_summary(paths, fmt, *, index_map=None):
     return index_map, stats, summary
 
 
+def _file_rows(fmt, path, index_map: IndexMap):
+    """One file's decoded row stream behind the ``chunk_read`` seam: the
+    whole-file decode is the retryable unit (re-decoding a file is
+    idempotent). Formats with the split decode hook (Avro) retry the
+    actual column decode; line-at-a-time formats (LibSVM) only cover
+    stream construction — their per-line reads are not restartable
+    mid-file, so a mid-stream error propagates (and the seam accounting
+    still names the file)."""
+    from photon_ml_tpu.reliability.retry import io_call
+
+    decode = getattr(fmt, "decode_payload", None)
+    rows_from = getattr(fmt, "stream_rows_from_payload", None)
+    if decode is not None and rows_from is not None:
+        payload = io_call("chunk_read", decode, path, detail=path)
+        return rows_from(payload, path, index_map)
+    return io_call(
+        "chunk_read", fmt.stream_rows, path, index_map, detail=path
+    )
+
+
 def _pipelined_file_rows(files, fmt, index_map: IndexMap):
     """reader->decode stage of the populate pipeline: a worker thread
     decodes file i+1 (``fmt.decode_payload`` — the expensive whole-file
@@ -73,17 +93,21 @@ def _pipelined_file_rows(files, fmt, index_map: IndexMap):
     double-buffering: at most one decoded payload queued + one being
     staged + one in flight on the worker. Formats without the split
     decode hook (LibSVM is line-at-a-time) fall back to the serial
-    ``stream_rows``."""
+    ``stream_rows``. Decodes run behind the ``chunk_read`` seam on the
+    worker thread — an injected/transient decode fault retries THERE,
+    invisible to the consumer."""
+    from photon_ml_tpu.reliability.retry import io_call
+
     decode = getattr(fmt, "decode_payload", None)
     rows_from = getattr(fmt, "stream_rows_from_payload", None)
     if decode is None or rows_from is None:
         for path in files:
-            yield from fmt.stream_rows(path, index_map)
+            yield from _file_rows(fmt, path, index_map)
         return
 
     def decoded():
         for path in files:
-            yield path, decode(path)
+            yield path, io_call("chunk_read", decode, path, detail=path)
 
     for path, payload in _prefetched(decoded(), depth=1):
         yield from rows_from(payload, path, index_map)
@@ -149,7 +173,7 @@ def iter_chunks(
         else (
             row
             for path in files
-            for row in fmt.stream_rows(path, index_map)
+            for row in _file_rows(fmt, path, index_map)
         )
     )
     for ix, vs, lab, off, wgt in rows:
@@ -207,6 +231,13 @@ def _prefetched(source: Iterator, depth: int = 2) -> Iterator:
 
     def worker():
         try:
+            # decode_ahead seam: accounts the worker-thread handoff (and
+            # gives chaos plans a handle on the thread itself). The
+            # retryable IO underneath it is covered by the chunk_read /
+            # spill_read seams the source generator crosses.
+            from photon_ml_tpu.reliability.faults import inject
+
+            inject("decode_ahead")
             for item in source:
                 if not _put(item):
                     return
@@ -501,6 +532,8 @@ class _DiskChunkStore:
         }
 
     def append(self, batch: SparseBatch) -> None:
+        from photon_ml_tpu.reliability.retry import io_call
+
         arrays = {
             "ix": np.asarray(batch.indices, np.int32),
             "v": np.asarray(batch.values, np.float32),
@@ -509,7 +542,21 @@ class _DiskChunkStore:
             "wgt": np.asarray(batch.weights, np.float32),
         }
         for f, a in arrays.items():
-            self._writers[f].write(a.tobytes())
+            data = a.tobytes()
+            w = self._writers[f]
+            # seek to the chunk's fixed offset per attempt: a retry after
+            # a partial write overwrites in place instead of appending
+            # garbage (every chunk field has a fixed record size)
+            off = self.count * len(data)
+
+            def _write(w=w, data=data, off=off):
+                w.seek(off)
+                w.write(data)
+
+            io_call(
+                "spill_write", _write,
+                detail=f"{self.dir}/{f}.bin[{self.count}]",
+            )
         self.count += 1
 
     def finalize(self) -> None:
@@ -539,13 +586,22 @@ class _DiskChunkStore:
                 os.path.join(self.dir, "wgt.bin"), np.float32, "r", shape=(n, R)
             ),
         }
+        from photon_ml_tpu.reliability.retry import io_call
+
         for i in range(n):
+            # spill_read seam: materializing one chunk from the memmaps
+            # is idempotent, so transient read errors retry in place
+            arrs = io_call(
+                "spill_read",
+                lambda i=i: {f: np.array(mm[f][i]) for f in self._FIELDS},
+                detail=f"{self.dir}[{i}]",
+            )
             yield SparseBatch(
-                indices=jnp.asarray(np.array(mm["ix"][i])),
-                values=jnp.asarray(np.array(mm["v"][i])),
-                labels=jnp.asarray(np.array(mm["lab"][i])),
-                offsets=jnp.asarray(np.array(mm["off"][i])),
-                weights=jnp.asarray(np.array(mm["wgt"][i])),
+                indices=jnp.asarray(arrs["ix"]),
+                values=jnp.asarray(arrs["v"]),
+                labels=jnp.asarray(arrs["lab"]),
+                offsets=jnp.asarray(arrs["off"]),
+                weights=jnp.asarray(arrs["wgt"]),
             )
 
     def close(self) -> None:

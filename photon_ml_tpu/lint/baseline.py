@@ -9,6 +9,7 @@ makes the spare allowance visible as an unused entry."""
 from __future__ import annotations
 
 import json
+import os
 from collections import Counter
 from typing import List, Sequence, Tuple
 
@@ -47,9 +48,13 @@ def write_baseline(path: str, violations: Sequence[Violation]) -> dict:
         for (f, r, s), c in sorted(counts.items())
     ]
     data = {"version": BASELINE_VERSION, "entries": entries}
-    with open(path, "w", encoding="utf-8") as fh:
+    # temp + os.replace (PL006's own contract) without importing the
+    # reliability helpers: the analyzer stays stdlib-only by design
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
         json.dump(data, fh, indent=2, sort_keys=True)
         fh.write("\n")
+    os.replace(tmp, path)
     return data
 
 

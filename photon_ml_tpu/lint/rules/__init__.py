@@ -4,6 +4,7 @@ from photon_ml_tpu.lint.rules import (  # noqa: F401
     host_sync,
     io_drain,
     recompile,
+    reliability,
     spill,
     tracer_leak,
 )

@@ -120,6 +120,7 @@ class CacheStats:
     misses: int = 0
     builds: int = 0  # schedules actually built (disk hit skips this)
     corrupt: int = 0  # artifacts rejected (version/checksum/shape)
+    quarantined: int = 0  # rejected artifacts renamed to *.corrupt
     stores: int = 0
     hash_s: float = 0.0
     load_s: float = 0.0
@@ -287,7 +288,8 @@ def store_schedule(
     t0 = time.perf_counter()
     final = _artifact_dir(cache_dir, key)
     tmp = f"{final}.{os.getpid()}.{threading.get_ident()}.tmp"
-    try:
+
+    def _store_once() -> bool:
         if os.path.isdir(final):
             return True  # already stored (concurrent writer won)
         os.makedirs(tmp, exist_ok=True)
@@ -325,7 +327,14 @@ def store_schedule(
             shutil.rmtree(tmp, ignore_errors=True)
         _bump("stores")
         return True
-    except Exception as e:  # disk full, permissions, ...
+
+    try:
+        # cache_store seam: transient write errors retry into a fresh
+        # temp-dir attempt (the temp+rename protocol is idempotent)
+        from photon_ml_tpu.reliability.retry import io_call
+
+        return io_call("cache_store", _store_once, detail=final)
+    except Exception as e:  # disk full, permissions, retry budget spent
         logger.warning("tile-schedule cache store failed (%s): %s", key, e)
         import shutil
 
@@ -335,26 +344,44 @@ def store_schedule(
         _add_time("store_s", time.perf_counter() - t0)
 
 
+def _quarantine_artifact_dir(d: str, key: str, why: str) -> None:
+    """A rejected artifact must not fail every future run: rename the
+    whole artifact directory to ``*.corrupt`` (accounted in both the
+    cache stats and the reliability quarantine list) so the next run
+    rebuilds and re-stores a clean copy instead of re-tripping on the
+    poison forever."""
+    from photon_ml_tpu.reliability.retry import quarantine_artifact
+
+    dst = quarantine_artifact(d, "cache_load")
+    if dst is not None:
+        _bump("quarantined")
+        logger.warning(
+            "tile-schedule cache artifact %s quarantined to %s (%s)",
+            key, dst, why,
+        )
+
+
 def load_schedule(
     cache_dir: str, key: str
 ) -> Optional[Tuple[np.ndarray, ...]]:
     """Load one schedule artifact as mmap-backed read-only arrays, or
-    None on miss / version skew / corruption (callers rebuild)."""
+    None on miss / version skew / corruption (callers rebuild). Runs
+    behind the ``cache_load`` seam: transient IO errors retry; an
+    artifact still failing (or failing integrity checks) is QUARANTINED
+    (renamed ``*.corrupt``) so it cannot poison future runs."""
+    from photon_ml_tpu.reliability.retry import SeamFailure, io_call
+
     t0 = time.perf_counter()
     d = _artifact_dir(cache_dir, key)
     meta_path = os.path.join(d, "meta.json")
-    try:
-        if not os.path.isfile(meta_path):
-            _bump("misses")
-            return None
+
+    def _load_once() -> Optional[Tuple[np.ndarray, ...]]:
         with open(meta_path) as f:
             meta = json.load(f)
         if meta.get("version") != SCHEDULE_CACHE_VERSION or meta.get(
             "key"
         ) != key:
-            _bump("corrupt")
-            _bump("misses")
-            return None
+            raise ValueError("version/key mismatch")
         out = []
         for name in SCHEDULE_ARRAY_NAMES:
             spec = meta["arrays"][name]
@@ -367,15 +394,31 @@ def load_schedule(
             ):
                 raise ValueError(f"dtype/shape mismatch for {name}")
             out.append(a)
-        _bump("hits")
         return tuple(out)
-    except Exception as e:
+
+    try:
+        if not os.path.isfile(meta_path):
+            _bump("misses")
+            return None
+        out = io_call("cache_load", _load_once, detail=d)
+        _bump("hits")
+        return out
+    except (ValueError, KeyError, json.JSONDecodeError) as e:
+        # artifact damage: re-reading yields the same bytes — quarantine
+        _bump("corrupt")
+        _bump("misses")
+        _quarantine_artifact_dir(d, key, str(e))
+        return None
+    except (SeamFailure, OSError) as e:
+        # persistent IO trouble on this artifact: same quarantine path
+        # (the cache is an accelerator, never a correctness dependency)
         logger.warning(
-            "tile-schedule cache artifact %s rejected, rebuilding: %s",
+            "tile-schedule cache artifact %s unreadable, rebuilding: %s",
             key, e,
         )
         _bump("corrupt")
         _bump("misses")
+        _quarantine_artifact_dir(d, key, str(e))
         return None
     finally:
         _add_time("load_s", time.perf_counter() - t0)

@@ -209,6 +209,12 @@ def fetch_all(deferreds: Sequence[Optional[Deferred]]) -> None:
 _PREP_POOL = None
 _IO_POOL = None
 _IO_PENDING: List = []
+# (artifact, exception) of failed async writes, in submission order. The
+# worker wrapper records instead of raising so the FIFO keeps draining
+# the writes QUEUED BEHIND a failure; drain_io() re-raises the first one
+# with its artifact name — a failed checkpoint/part-file write can never
+# masquerade as success.
+_IO_FAILURES: List = []
 
 
 def _pool(which: str):
@@ -280,22 +286,39 @@ def wait(future) -> Any:
 # -- async artifact IO -------------------------------------------------------
 
 
-def submit_io(fn: Callable, *args, **kwargs) -> None:
+def submit_io(fn: Callable, *args, artifact: str = "", **kwargs) -> None:
     """Queue an artifact write (checkpoint step, metrics.json) on the IO
-    worker; FIFO order is preserved. Overlap off -> synchronous write."""
+    worker; FIFO order is preserved. Overlap off -> synchronous write.
+
+    ``artifact`` names what is being written — it travels with any
+    failure to :func:`drain_io` so the error is attributable. The write
+    runs behind the reliability layer's ``io_worker`` seam (fault
+    injection + bounded retries, photon_ml_tpu/reliability)."""
+    from photon_ml_tpu.reliability.retry import io_call
+
     if not overlap_enabled():
-        fn(*args, **kwargs)
+        io_call("io_worker", fn, *args, detail=artifact, **kwargs)
         return
+
+    def _guarded() -> None:
+        try:
+            io_call("io_worker", fn, *args, detail=artifact, **kwargs)
+        except BaseException as e:
+            with _LOCK:
+                _IO_FAILURES.append((artifact, e))
+
     pool = _pool("io")  # resolves OUTSIDE _LOCK (it takes _LOCK itself)
     with _LOCK:
-        _IO_PENDING.append(pool.submit(fn, *args, **kwargs))
+        _IO_PENDING.append(pool.submit(_guarded))
 
 
 def drain_io() -> None:
     """Barrier: every queued IO write is on disk (or raised) after this.
     Call before anything that requires the artifacts — preemption stop,
-    checkpoint restore, run exit. Wait time accrues to the
-    ``overlap_io_wait_s`` host-timing bucket."""
+    checkpoint restore, run exit. The FIRST recorded worker failure
+    re-raises here with its artifact name (later queued writes still
+    drained first — write order stays FIFO even across a failure). Wait
+    time accrues to the ``overlap_io_wait_s`` host-timing bucket."""
     import time
 
     from photon_ml_tpu.utils.profiling import record_host_timing
@@ -305,8 +328,18 @@ def drain_io() -> None:
         while True:
             with _LOCK:
                 if not _IO_PENDING:
-                    return
+                    break
                 fut = _IO_PENDING.pop(0)
-            fut.result()  # propagate write failures to the training loop
+            fut.result()  # _guarded never raises; this waits completion
+        with _LOCK:
+            if not _IO_FAILURES:
+                return
+            artifact, exc = _IO_FAILURES[0]
+            _IO_FAILURES.clear()
+        raise RuntimeError(
+            "async artifact write failed"
+            + (f" for {artifact!r}" if artifact else "")
+            + f": {exc}"
+        ) from exc
     finally:
         record_host_timing("overlap_io_wait_s", time.perf_counter() - t0)

@@ -31,6 +31,86 @@ from photon_ml_tpu.task import TaskType
 Array = jnp.ndarray
 
 
+# -- λ-grid crash-safe snapshots (reliability.GridCheckpointer) ---------------
+#
+# One snapshot per COMPLETED λ: warm-start means (optimization space —
+# the currency the next λ's solve starts from, so a resumed sweep walks
+# bitwise the same iterate chain), the exported model (original space),
+# and the OptResult arrays. kill -9 mid-λ loses only that λ's solve; the
+# restart re-solves it from the SAME warm start and continues.
+
+
+def _snapshot_result_arrays(result: OptResult) -> Dict[str, object]:
+    import numpy as np
+
+    t = result.tracker
+    arrs = {
+        "coefficients": np.asarray(result.coefficients),
+        "value": np.asarray(result.value),
+        "grad_norm": np.asarray(result.grad_norm),
+        "iterations": np.asarray(result.iterations),
+        "reason": np.asarray(result.reason),
+        "tracker_values": np.asarray(t.values),
+        "tracker_grad_norms": np.asarray(t.grad_norms),
+        "tracker_count": np.asarray(t.count),
+    }
+    if t.coefs is not None:
+        arrs["tracker_coefs"] = np.asarray(t.coefs)
+    return arrs
+
+
+def _result_from_snapshot(d: Dict[str, object]) -> OptResult:
+    from photon_ml_tpu.optim.common import Tracker
+
+    coefs = d.get("tracker_coefs")
+    return OptResult(
+        coefficients=jnp.asarray(d["coefficients"]),
+        value=jnp.asarray(d["value"]),
+        grad_norm=jnp.asarray(d["grad_norm"]),
+        iterations=jnp.asarray(d["iterations"]),
+        reason=jnp.asarray(d["reason"]),
+        tracker=Tracker(
+            values=jnp.asarray(d["tracker_values"]),
+            grad_norms=jnp.asarray(d["tracker_grad_norms"]),
+            count=jnp.asarray(d["tracker_count"]),
+            coefs=jnp.asarray(coefs) if coefs is not None else None,
+        ),
+    )
+
+
+def _model_from_snapshot(
+    task: TaskType, snap: Dict[str, object]
+) -> GeneralizedLinearModel:
+    from photon_ml_tpu.models.coefficients import Coefficients
+
+    var = snap.get("model_variances")
+    return GeneralizedLinearModel(
+        task,
+        Coefficients(
+            jnp.asarray(snap["model_means"]),
+            jnp.asarray(var) if var is not None else None,
+        ),
+    )
+
+
+def _save_lambda_snapshot(
+    checkpointer, lam: float, warm_means, model, result: OptResult
+) -> None:
+    import numpy as np
+
+    checkpointer.save(
+        lam,
+        warm_means=np.asarray(warm_means),
+        model_means=np.asarray(model.means),
+        model_variances=(
+            np.asarray(model.coefficients.variances)
+            if model.coefficients.variances is not None
+            else None
+        ),
+        result_arrays=_snapshot_result_arrays(result),
+    )
+
+
 def train_generalized_linear_model(
     batch: Batch,
     task: TaskType,
@@ -53,6 +133,8 @@ def train_generalized_linear_model(
     mesh=None,
     track_models: bool = False,
     tile_cache_dir: Optional[str] = None,
+    grid_checkpointer=None,
+    preemption_guard=None,
 ) -> Tuple[Dict[float, GeneralizedLinearModel], Dict[float, OptResult]]:
     """Train one model per regularization weight with warm starts.
 
@@ -80,6 +162,14 @@ def train_generalized_linear_model(
     rerun over the same dataset loads the schedules instead of
     rebuilding. None falls back to the process configuration /
     PHOTON_TILE_CACHE_DIR env var (unset = off).
+
+    ``grid_checkpointer`` (reliability.GridCheckpointer): per-λ
+    crash-safe snapshots — completed λs load instead of re-solving, and
+    the resumed sweep warm-starts from the snapshotted means, so the
+    final models are bitwise what an uninterrupted run produces.
+    ``preemption_guard``: a SIGTERM stops the sweep BEFORE the next λ's
+    solve (the λ boundary is the safe point); already-solved λs are
+    checkpointed and returned.
     """
     base = OptimizerConfig.default_for(optimizer_type)
     config = OptimizerConfig(
@@ -146,12 +236,35 @@ def train_generalized_linear_model(
     results: Dict[float, OptResult] = {}
     current = initial
     for lam in weights_desc:
+        snap = (
+            grid_checkpointer.load(lam)
+            if grid_checkpointer is not None
+            else None
+        )
+        if snap is not None:
+            # completed in a previous (interrupted) run: restore instead
+            # of re-solving; the snapshotted warm means keep the iterate
+            # chain bitwise identical for the λs still to solve
+            models[lam] = _model_from_snapshot(task, snap)
+            results[lam] = _result_from_snapshot(snap["result"])
+            if warm_start:
+                current = jnp.asarray(snap["warm_means"])
+            continue
+        if preemption_guard is not None and preemption_guard.requested:
+            # stop at the λ boundary: solved λs are snapshotted; the
+            # restarted run resumes the sweep here
+            break
         coefficients, result = problem.run(
             batch, initial=current, reg_weight=lam, mesh=mesh,
             track_models=track_models,
         )
         models[lam] = problem.create_model(coefficients, normalization)
         results[lam] = result
+        if grid_checkpointer is not None:
+            _save_lambda_snapshot(
+                grid_checkpointer, lam, coefficients.means,
+                models[lam], result,
+            )
         if warm_start:
             current = coefficients.means
     return models, results
@@ -242,6 +355,7 @@ def train_grid_batched(
     mesh=None,
     track_models: bool = False,
     tile_cache_dir: Optional[str] = None,
+    grid_checkpointer=None,
 ) -> Tuple[Dict[float, GeneralizedLinearModel], Dict[float, OptResult]]:
     """Batched λ-grid twin of :func:`train_generalized_linear_model`:
     the grid stacks into a [G, d] coefficient bank and ONE jitted
@@ -323,6 +437,20 @@ def train_grid_batched(
     weights_desc: List[float] = sorted(
         set(float(w) for w in regularization_weights), reverse=True
     )
+    if grid_checkpointer is not None and all(
+        grid_checkpointer.has(lam) for lam in weights_desc
+    ):
+        # the whole grid solved in ONE vmapped program last run: the
+        # snapshot unit is the completed grid (there is no per-λ
+        # mid-solve boundary inside a single jitted while_loop), so a
+        # restart after the solve skips it entirely
+        models = {}
+        results = {}
+        for lam in weights_desc:
+            snap = grid_checkpointer.load(lam)
+            models[lam] = _model_from_snapshot(task, snap)
+            results[lam] = _result_from_snapshot(snap["result"])
+        return models, results
     variances, result = problem.run_grid(
         batch, weights_desc, initial=initial, mesh=mesh,
         track_models=track_models,
@@ -352,6 +480,11 @@ def train_grid_batched(
                 ),
             ),
         )
+        if grid_checkpointer is not None:
+            _save_lambda_snapshot(
+                grid_checkpointer, lam, result.coefficients[i],
+                models[lam], results[lam],
+            )
     return models, results
 
 
@@ -728,6 +861,8 @@ def train_streaming_glm(
     index_map=None,
     stats=None,
     tile_cache_dir: Optional[str] = None,
+    grid_checkpointer=None,
+    preemption_guard=None,
 ):
     """Train a GLM over Avro inputs LARGER than host RAM: every objective
     evaluation streams fixed-shape chunks from disk (io/streaming.py), so
@@ -870,6 +1005,21 @@ def train_streaming_glm(
     results: Dict[float, OptResult] = {}
     current = jnp.zeros((objective.dim,), jnp.float32)
     for lam in weights_desc:
+        snap = (
+            grid_checkpointer.load(lam)
+            if grid_checkpointer is not None
+            else None
+        )
+        if snap is not None:
+            # λ completed before the crash/preemption: restore model +
+            # result and keep the warm-start chain bitwise intact
+            models[lam] = _model_from_snapshot(task, snap)
+            results[lam] = _result_from_snapshot(snap["result"])
+            if warm_start:
+                current = jnp.asarray(snap["warm_means"])
+            continue
+        if preemption_guard is not None and preemption_guard.requested:
+            break
         l1, l2 = regularization.split(lam)
         if use_tron:
             # one streamed Hv pass per CG step — the reference's exact
@@ -906,6 +1056,11 @@ def train_streaming_glm(
             ),
         )
         results[lam] = result
+        if grid_checkpointer is not None:
+            _save_lambda_snapshot(
+                grid_checkpointer, lam, result.coefficients,
+                models[lam], result,
+            )
         if warm_start:
             current = result.coefficients
     return models, results, index_map

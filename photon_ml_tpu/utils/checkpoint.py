@@ -88,9 +88,22 @@ class TrainingCheckpointer:
         )
 
     def save(self, step: int, models: Dict[str, Any]) -> None:
+        from photon_ml_tpu.reliability.retry import io_call
+
         state = {name: model_state(m) for name, m in models.items()}
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
-        self._mgr.wait_until_finished()
+
+        def _save():
+            self._mgr.save(
+                step, args=ocp.args.StandardSave(state), force=True
+            )
+            self._mgr.wait_until_finished()
+
+        # ckpt_save seam: orbax's own protocol is atomic per step, and
+        # force=True overwrites a half-finished attempt — so a retried
+        # save converges on a complete step directory
+        io_call(
+            "ckpt_save", _save, detail=f"{self.directory} step {step}"
+        )
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -101,19 +114,26 @@ class TrainingCheckpointer:
     # -- host-side metadata sidecar (best-iteration tracking etc.) --------
     def save_meta(self, meta: Dict[str, Any]) -> None:
         """Small JSON sidecar next to the step checkpoints — resume needs
-        more than weights (e.g. which iteration was validation-best)."""
+        more than weights (e.g. which iteration was validation-best).
+        Atomic write-rename behind the ckpt_save seam."""
+        from photon_ml_tpu.reliability.artifacts import atomic_write_json
+        from photon_ml_tpu.reliability.retry import io_call
+
         path = os.path.join(self.directory, "cd_meta.json")
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(meta, f)
-        os.replace(tmp, path)
+        io_call("ckpt_save", atomic_write_json, path, meta, detail=path)
 
     def load_meta(self) -> Optional[Dict[str, Any]]:
+        from photon_ml_tpu.reliability.retry import io_call
+
         path = os.path.join(self.directory, "cd_meta.json")
         if not os.path.isfile(path):
             return None
-        with open(path) as f:
-            return json.load(f)
+
+        def _load():
+            with open(path) as f:
+                return json.load(f)
+
+        return io_call("ckpt_restore", _load, detail=path)
 
     def restore(self, step: int, models: Dict[str, Any]) -> Dict[str, Any]:
         """-> {name: restored model}, using ``models`` as type templates.
@@ -124,7 +144,15 @@ class TrainingCheckpointer:
         same process — without the args the restore raises KeyError
         ("provide a CheckpointHandlerRegistry"). The host-side topology
         check happens in restore_model (template-typed)."""
-        state = self._mgr.restore(step, args=ocp.args.StandardRestore())
+        from photon_ml_tpu.reliability.retry import io_call
+
+        state = io_call(
+            "ckpt_restore",
+            lambda: self._mgr.restore(
+                step, args=ocp.args.StandardRestore()
+            ),
+            detail=f"{self.directory} step {step}",
+        )
         return {
             name: restore_model(models[name], state[name]) for name in models
         }

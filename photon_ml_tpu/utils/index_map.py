@@ -89,8 +89,10 @@ class IndexMap:
     #    utils/native_index.py handles the >200k-vocabulary PalDB case) ----
 
     def save(self, path: str) -> None:
+        from photon_ml_tpu.reliability.artifacts import atomic_writer
+
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(path, "w", encoding="utf-8") as f:
+        with atomic_writer(path, encoding="utf-8") as f:
             json.dump(self._fwd, f)
 
     @staticmethod
@@ -156,8 +158,10 @@ class IdentityIndexMap:
     def save(self, path: str) -> None:
         """A small descriptor instead of materializing stringified
         indices; IndexMap.load reconstructs the identity map from it."""
+        from photon_ml_tpu.reliability.artifacts import atomic_writer
+
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(path, "w", encoding="utf-8") as f:
+        with atomic_writer(path, encoding="utf-8") as f:
             json.dump(
                 {
                     "identity_index_map": self._features,

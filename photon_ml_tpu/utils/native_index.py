@@ -225,8 +225,10 @@ class PartitionedIndexMap:
         for the driver's feature-index output). ``IndexMap.load``
         recognizes the pointer and reopens the store; the relative path
         keeps an output directory relocatable together with its index."""
+        from photon_ml_tpu.reliability.artifacts import atomic_writer
+
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(path, "w", encoding="utf-8") as f:
+        with atomic_writer(path, encoding="utf-8") as f:
             json.dump(
                 {
                     "offheap_index_store": os.path.abspath(self.directory),

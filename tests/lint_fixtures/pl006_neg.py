@@ -1,0 +1,59 @@
+"""PL006 negatives: atomic publishes, seam-routed IO, teardown scopes."""
+
+import json
+import os
+
+from photon_ml_tpu.reliability.artifacts import atomic_write_json, atomic_writer
+from photon_ml_tpu.reliability.retry import io_call
+
+
+def write_via_helper(path, payload):
+    atomic_write_json(path, payload)  # the blessed path
+
+
+def write_via_writer(path, lines):
+    with atomic_writer(path) as f:  # helper in scope — fine
+        f.write("\n".join(lines))
+
+
+def write_with_explicit_replace(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:  # fine: os.replace publishes atomically
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def read_through_seam(path):
+    def _load():
+        with open(path) as f:
+            return json.load(f)
+
+    try:
+        return io_call("cache_load", _load, detail=path)
+    except Exception:
+        pass  # fine: the operation already went through the retry layer
+    return None
+
+
+def reads_are_not_writes(path):
+    with open(path) as f:  # read mode: not an artifact publish
+        return f.read()
+
+
+def appends_are_stream_writers(path, data):
+    with open(path, "ab") as f:  # append: the spill-writer protocol
+        f.write(data)
+
+
+class Store:
+    def close(self):
+        try:
+            os.remove(self._path)
+        except OSError:
+            pass  # teardown scope: best-effort cleanup is the contract
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,33 @@
+"""PL006 positives: torn-artifact writes and swallowed IO failures."""
+
+import json
+import os
+
+
+def write_metrics_torn(path, payload):
+    with open(path, "w") as f:  # violation: no atomic publish in scope
+        json.dump(payload, f)
+
+
+def write_blob_torn(path, data):
+    f = open(path, mode="wb")  # violation: keyword mode, still a write
+    f.write(data)
+    f.close()
+
+
+def swallow_io_failure(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:  # violation: IO failure silently swallowed
+        pass
+
+
+def swallow_in_loop(paths):
+    out = []
+    for p in paths:
+        try:
+            out.append(os.path.getsize(p) and open(p).read())
+        except Exception:  # violation: blanket except-and-continue
+            continue
+    return out

@@ -68,6 +68,17 @@ class TestRuleFixtures:
         # same factory calls, but not under io// game streaming
         assert _violations("pl004_out_of_scope.py") == []
 
+    def test_pl006_positive(self):
+        vs = _violations("pl006_pos.py")
+        # two torn artifact writes + two swallowed IO failures
+        assert _rules(vs) == ["PL006"] * 4, vs
+        assert {v.line for v in vs} == {8, 13, 22, 31}
+
+    def test_pl006_negative(self):
+        # atomic helpers, explicit temp+os.replace, io_call-routed
+        # swallows, read/append modes, and teardown scopes all pass
+        assert _violations("pl006_neg.py") == []
+
     def test_pl005_positive(self):
         vs = _violations("pl005_pos.py")
         assert _rules(vs) == ["PL005"] * 2

@@ -141,14 +141,46 @@ class TestDeferred:
             assert seen == [1]  # ran before submit returned
             overlap.wait(fut)
 
-    def test_submit_io_failure_surfaces_at_drain(self):
+    def test_submit_io_failure_surfaces_at_drain(self, monkeypatch):
+        # retries exhaust quickly so the test doesn't sleep through the
+        # io_worker backoff schedule
+        monkeypatch.setenv("PHOTON_RETRY_ATTEMPTS", "1")
+        monkeypatch.setenv("PHOTON_RETRY_BASE_S", "0.001")
+
         def boom():
             raise OSError("disk gone")
 
         with overlap.overlap_scope(True):
-            overlap.submit_io(boom)
-            with pytest.raises(OSError, match="disk gone"):
+            overlap.submit_io(boom, artifact="scores/part-00007.avro")
+            # the failure re-raises at the drain barrier NAMING the
+            # artifact (round-11 reliability contract) with the original
+            # error chained underneath
+            with pytest.raises(
+                RuntimeError, match="scores/part-00007.avro"
+            ) as ei:
                 overlap.drain_io()
+            # chain: RuntimeError -> SeamFailure (retry budget) -> the
+            # original OSError
+            assert "disk gone" in str(ei.value.__cause__.__cause__)
+            overlap.drain_io()  # failure is consumed, barrier is clean
+
+    def test_submit_io_failure_does_not_block_later_writes(
+        self, monkeypatch, tmp_path
+    ):
+        monkeypatch.setenv("PHOTON_RETRY_ATTEMPTS", "1")
+        monkeypatch.setenv("PHOTON_RETRY_BASE_S", "0.001")
+
+        def boom():
+            raise OSError("disk gone")
+
+        ok = tmp_path / "later.txt"
+        with overlap.overlap_scope(True):
+            overlap.submit_io(boom, artifact="first")
+            overlap.submit_io(ok.write_text, "landed", artifact="second")
+            with pytest.raises(RuntimeError, match="first"):
+                overlap.drain_io()
+        # the write QUEUED BEHIND the failure still drained to disk
+        assert ok.read_text() == "landed"
 
 
 class TestReadbackDiscipline:

@@ -1,0 +1,630 @@
+"""Reliability layer (round 11): deterministic fault injection, retrying
+IO, quarantine, atomic artifacts, and crash-safe resume.
+
+The crash tests use the fault plan's ``KILL`` kind — SIGKILL delivered
+to the process itself at an exact seam crossing — so "kill -9 mid-stage"
+is a deterministic, replayable event, not a sleep-and-hope race. The
+resume contract under test: restart with the SAME args and the final
+artifacts are BITWISE equal to an uninterrupted run (Avro containers
+included — their sync markers are schema-derived, not random).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.reliability import (
+    FaultPlan,
+    GridCheckpointer,
+    InjectedCorruption,
+    SeamFailure,
+    StreamingCDCheckpointer,
+    atomic_write_json,
+    atomic_writer,
+    ensure_run_manifest,
+    install_plan,
+    io_call,
+    quarantine_artifact,
+    read_manifest,
+    reset_fault_stats,
+    reset_retry_stats,
+    retry_stats,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_reliability(monkeypatch):
+    monkeypatch.setenv("PHOTON_RETRY_BASE_S", "0.001")
+    reset_fault_stats()
+    reset_retry_stats()
+    yield
+    reset_fault_stats()
+    reset_retry_stats()
+
+
+# ---------------------------------------------------------------------------
+# fault plans
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_parse_forms(self):
+        plan = FaultPlan.parse(
+            "chunk_read:3:EIO,ckpt_save:1:ENOSPC:2,spill_write:2:eio:once,"
+            "cache_load:1:CORRUPT,spill_read:4:EIO:*"
+        )
+        assert len(plan.entries) == 5
+        e = {x.seam: x for x in plan.entries}
+        assert e["chunk_read"].nth == 3 and e["chunk_read"].times == 1
+        assert e["ckpt_save"].times == 2
+        assert e["spill_write"].times == 1
+        assert e["cache_load"].error == "CORRUPT"
+        assert e["spill_read"].times == -1  # poisoned: every call from 4
+
+    @pytest.mark.parametrize("bad", [
+        "not_a_seam:1:EIO",        # unknown seam
+        "chunk_read:0:EIO",        # nth < 1
+        "chunk_read:1:EFOO",       # unknown error
+        "chunk_read:1",            # too few fields
+        "chunk_read:1:EIO:0",      # times < 1
+    ])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+    def test_deterministic_by_occurrence(self):
+        """The same plan over the same call sequence injects at exactly
+        the same crossings — replayability is the whole point."""
+        for _ in range(2):
+            plan = FaultPlan.parse("chunk_read:3:EIO:2")
+            outcomes = []
+            for _ in range(6):
+                try:
+                    plan.check("chunk_read")
+                    outcomes.append("ok")
+                except OSError:
+                    outcomes.append("EIO")
+            assert outcomes == ["ok", "ok", "EIO", "EIO", "ok", "ok"]
+
+    def test_env_plan_single_transient_retries(self, monkeypatch):
+        monkeypatch.setenv("PHOTON_FAULT_PLAN", "spill_read:1:EIO")
+        reset_fault_stats()  # force re-resolution from the env var
+        assert io_call("spill_read", lambda: 7, detail="x") == 7
+        assert retry_stats()["retries"]["spill_read"] == 1
+
+
+# ---------------------------------------------------------------------------
+# io_call / retry / quarantine
+# ---------------------------------------------------------------------------
+
+
+class TestIoCall:
+    def test_transient_fault_retries_to_success(self):
+        install_plan("chunk_read:1:EIO")
+        calls = []
+        assert io_call("chunk_read", lambda: calls.append(1) or 42) == 42
+        assert len(calls) == 1  # first ATTEMPT failed at inject, not in fn
+        r = retry_stats()
+        assert r["retries"]["chunk_read"] == 1
+        assert r["giveups"] == {}
+
+    def test_budget_exhaustion_names_the_artifact(self):
+        install_plan("spill_write:1:EIO:*")
+        with pytest.raises(SeamFailure) as ei:
+            io_call("spill_write", lambda: None, detail="chunks/ix.bin[3]")
+        assert "spill_write" in str(ei.value)
+        assert "chunks/ix.bin[3]" in str(ei.value)
+        assert retry_stats()["giveups"]["spill_write"] == 1
+
+    def test_corruption_is_not_retried(self):
+        install_plan("cache_load:1:CORRUPT")
+        with pytest.raises(InjectedCorruption):
+            io_call("cache_load", lambda: None, detail="artifact")
+        assert retry_stats()["retries"] == {}  # straight through
+
+    def test_real_oserror_retries_without_a_plan(self):
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise OSError("transient")
+            return "done"
+
+        assert io_call("spill_read", flaky) == "done"
+        assert len(attempts) == 3
+
+    def test_quarantine_accounts_and_renames(self, tmp_path):
+        p = tmp_path / "poison.npy"
+        p.write_bytes(b"bad")
+        dst = quarantine_artifact(str(p), "cache_load")
+        assert dst.endswith(".corrupt") and os.path.exists(dst)
+        assert not p.exists()
+        # collision gets a numbered suffix, never overwrites evidence
+        p.write_bytes(b"bad again")
+        dst2 = quarantine_artifact(str(p), "cache_load")
+        assert dst2.endswith(".corrupt-1")
+        r = retry_stats()
+        assert r["quarantined"]["cache_load"] == 2
+        assert dst in r["quarantined_artifacts"]
+
+
+# ---------------------------------------------------------------------------
+# atomic artifacts + manifests
+# ---------------------------------------------------------------------------
+
+
+class TestAtomicArtifacts:
+    def test_atomic_writer_publishes_complete_files(self, tmp_path):
+        p = tmp_path / "nested" / "out.txt"
+        with atomic_writer(str(p)) as f:
+            f.write("payload")
+        assert p.read_text() == "payload"
+
+    def test_atomic_writer_error_leaves_nothing(self, tmp_path):
+        p = tmp_path / "out.json"
+        p.write_text("old")
+        with pytest.raises(RuntimeError):
+            with atomic_writer(str(p)) as f:
+                f.write("half-writ")
+                raise RuntimeError("crash mid-write")
+        assert p.read_text() == "old"  # old content intact, no temp left
+        assert os.listdir(tmp_path) == ["out.json"]
+
+    def test_atomic_write_json(self, tmp_path):
+        p = tmp_path / "m.json"
+        atomic_write_json(str(p), {"k": [1, 2]})
+        assert json.load(open(p)) == {"k": [1, 2]}
+
+    def test_run_manifest_guard(self, tmp_path):
+        d = str(tmp_path / "ck")
+        ensure_run_manifest(d, {"grid": [1.0, 0.1]}, kind="glm-grid")
+        ensure_run_manifest(d, {"grid": [1.0, 0.1]}, kind="glm-grid")  # ok
+        with pytest.raises(ValueError, match="different run configuration"):
+            ensure_run_manifest(d, {"grid": [9.0]}, kind="glm-grid")
+        with pytest.raises(ValueError, match="different run configuration"):
+            ensure_run_manifest(d, {"grid": [1.0, 0.1]}, kind="other")
+
+    def test_torn_manifest_quarantined_not_trusted(self, tmp_path):
+        d = str(tmp_path)
+        (tmp_path / "manifest.json").write_text('{"kind": "ga')  # torn
+        assert read_manifest(d) is None
+        assert any(
+            f.startswith("manifest.json.corrupt") for f in os.listdir(d)
+        )
+
+
+# ---------------------------------------------------------------------------
+# schedule-cache quarantine (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+class TestScheduleCacheQuarantine:
+    def _store_one(self, cache_dir):
+        from photon_ml_tpu.ops import schedule_cache as sc
+
+        arrays = [
+            np.arange(8, dtype=np.int32) + i
+            for i in range(len(sc.SCHEDULE_ARRAY_NAMES))
+        ]
+        assert sc.store_schedule(cache_dir, "k" * 32, arrays)
+        return sc, arrays
+
+    def test_corrupt_artifact_quarantined_and_rebuilt(self, tmp_path):
+        cache = str(tmp_path)
+        sc, arrays = self._store_one(cache)
+        sc.reset_stats()
+        d = sc._artifact_dir(cache, "k" * 32)
+        # damage one array file's tail -> spot digest mismatch
+        with open(os.path.join(d, "step_out.npy"), "r+b") as f:
+            f.seek(0, 2)
+            f.truncate(max(f.tell() - 4, 0))
+        assert sc.load_schedule(cache, "k" * 32) is None
+        s = sc.stats()
+        assert s.corrupt == 1 and s.quarantined == 1 and s.misses == 1
+        assert os.path.isdir(d + ".corrupt")
+        assert not os.path.isdir(d)
+        # the poison is OUT of the way: a re-store succeeds and loads
+        assert sc.store_schedule(cache, "k" * 32, arrays)
+        assert sc.load_schedule(cache, "k" * 32) is not None
+
+    def test_transient_load_fault_retries(self, tmp_path):
+        cache = str(tmp_path)
+        sc, _ = self._store_one(cache)
+        sc.reset_stats()
+        install_plan("cache_load:1:EIO")
+        out = sc.load_schedule(cache, "k" * 32)
+        assert out is not None  # retried through the transient fault
+        assert sc.stats().hits == 1
+        assert retry_stats()["retries"]["cache_load"] == 1
+
+
+# ---------------------------------------------------------------------------
+# checkpointers
+# ---------------------------------------------------------------------------
+
+
+class TestGridCheckpointer:
+    def test_round_trip(self, tmp_path):
+        g = GridCheckpointer(str(tmp_path / "g"), {"grid": [1.0]})
+        g.save(
+            1.0,
+            warm_means=np.arange(4, dtype=np.float32),
+            model_means=np.arange(4, dtype=np.float32) * 2,
+            model_variances=np.ones(4, np.float32),
+            result_arrays={
+                "value": np.float32(3.5),
+                "iterations": np.int32(7),
+            },
+        )
+        assert g.has(1.0) and not g.has(0.1)
+        snap = g.load(1.0)
+        np.testing.assert_array_equal(snap["warm_means"], np.arange(4))
+        assert snap["result"]["iterations"] == 7
+
+    def test_snapshot_without_marker_is_invisible(self, tmp_path):
+        """The commit protocol: npz first, JSON marker second. A crash
+        between the two (npz on disk, no marker) must read as 'not
+        checkpointed' — resume re-solves that λ instead of trusting an
+        unconfirmed snapshot."""
+        g = GridCheckpointer(str(tmp_path / "g"), {"grid": [1.0]})
+        g.save(
+            1.0, warm_means=np.zeros(2), model_means=np.zeros(2),
+            model_variances=None, result_arrays={},
+        )
+        os.unlink(g._base(1.0) + ".json")
+        assert not g.has(1.0)
+        assert g.load(1.0) is None
+
+
+class TestStreamingCDCheckpointer:
+    def test_round_trip_and_pruning(self, tmp_path):
+        cd = StreamingCDCheckpointer(str(tmp_path), max_to_keep=2)
+        for it in range(1, 4):
+            cd.save(
+                it,
+                {"global": np.full(3, float(it)), "per-user": np.eye(2)},
+                {"global": None, "per-user": np.ones((2, 2))},
+                {"objective": [float(i) for i in range(it)]},
+            )
+        assert cd.steps() == [2, 3]
+        states, variances, hist = cd.load(3)
+        np.testing.assert_array_equal(states["global"], [3.0, 3.0, 3.0])
+        assert variances["global"] is None
+        np.testing.assert_array_equal(variances["per-user"], np.ones((2, 2)))
+        assert hist["objective"] == [0.0, 1.0, 2.0]
+
+
+# ---------------------------------------------------------------------------
+# interrupted stage pass resumes from completed chunks (tentpole 3)
+# ---------------------------------------------------------------------------
+
+
+def _write_game_files(base, seed=0):
+    sys.path.insert(0, os.path.join(REPO, "dev-scripts"))
+    import chaos_matrix
+
+    chaos_matrix.gen_game_data(base, seed=seed)
+
+
+class TestStageResume:
+    def test_interrupted_stage_resumes_bitwise(self, tmp_path):
+        """Stage with a poisoned spill_write (budget exhausts mid-pass),
+        then resume with no plan: the resumed store's chunk files must
+        be bitwise identical to an uninterrupted store's, WITHOUT
+        re-consuming the already-staged records."""
+        from photon_ml_tpu.game.config import FeatureShardConfiguration
+        from photon_ml_tpu.game.streaming import (
+            scan_game_stream,
+            stage_game_stream,
+        )
+
+        data = str(tmp_path / "data")
+        _write_game_files(data)
+        shards = [
+            FeatureShardConfiguration("globalShard", ["features"]),
+            FeatureShardConfiguration("userShard", ["userFeatures"]),
+        ]
+        imaps, eidx, stats = scan_game_stream([data], shards, ["userId"])
+
+        def stage(persist, plan):
+            install_plan(plan)
+            try:
+                return stage_game_stream(
+                    [data], shards, ["userId"], imaps, eidx, stats,
+                    rows_per_chunk=64, persist_dir=persist,
+                )
+            finally:
+                install_plan(None)
+
+        clean = str(tmp_path / "clean")
+        stage(clean, None)
+        # interrupted arm: every spill_write from crossing 30 on fails
+        # -> SeamFailure mid-stage, some chunks already committed
+        broken = str(tmp_path / "broken")
+        with pytest.raises(SeamFailure):
+            stage(broken, "spill_write:30:EIO:*")
+        m = read_manifest(broken)
+        assert 0 < m["chunks"] < json.load(
+            open(os.path.join(clean, "manifest.json"))
+        )["chunks"] + 1
+        resumed_store, _ = stage(broken, None)
+        assert resumed_store.staged
+        clean_manifest = read_manifest(clean)
+        broken_manifest = read_manifest(broken)
+        for key in ("chunks", "real_rows"):
+            assert broken_manifest[key] == clean_manifest[key]
+        for fn in sorted(os.listdir(clean)):
+            if fn.endswith(".bin"):
+                a = open(os.path.join(clean, fn), "rb").read()
+                b = open(os.path.join(broken, fn), "rb").read()
+                assert a == b, f"{fn} differs after resume"
+
+
+# ---------------------------------------------------------------------------
+# λ-grid checkpoint/preemption wiring (training.py)
+# ---------------------------------------------------------------------------
+
+
+class TestGridCheckpointWiring:
+    def _fit(self, tmp_path, **kw):
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.data.batch import SparseBatch
+        from photon_ml_tpu.task import TaskType
+        from photon_ml_tpu.training import train_generalized_linear_model
+
+        # fixed seed: every _fit in a test must see the SAME batch, or
+        # the bitwise comparisons compare different problems
+        rng = np.random.default_rng(42)
+        n, d, k = 400, 20, 4
+        ix = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        vs = rng.normal(size=(n, k)).astype(np.float32)
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        batch = SparseBatch(
+            jnp.asarray(ix), jnp.asarray(vs), jnp.asarray(y),
+            jnp.zeros(n), jnp.ones(n),
+        )
+        return train_generalized_linear_model(
+            batch, TaskType.LOGISTIC_REGRESSION, d,
+            regularization_weights=[10.0, 1.0, 0.1], max_iter=10, **kw
+        )
+
+    def test_snapshots_reload_bitwise(self, tmp_path):
+        """A sweep under a GridCheckpointer snapshots every λ, and a
+        second sweep over the same checkpointer loads them all without
+        re-solving — bitwise equal to a checkpointer-less reference fit
+        (the mid-path variant runs as a subprocess kill -9 test below)."""
+        from photon_ml_tpu.reliability import GridCheckpointer
+
+        models_ref, _ = self._fit(tmp_path)
+        ck = GridCheckpointer(str(tmp_path / "g"), {"v": 1})
+        m_a, r_a = self._fit(tmp_path, grid_checkpointer=ck)
+        assert sorted(m_a) == [0.1, 1.0, 10.0]
+        # a fresh sweep over the SAME checkpointer loads every λ without
+        # solving, bitwise equal to the reference fit
+        m_b, r_b = self._fit(tmp_path, grid_checkpointer=ck)
+        for lam in m_a:
+            np.testing.assert_array_equal(
+                np.asarray(m_a[lam].means), np.asarray(m_b[lam].means)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(models_ref[lam].means),
+                np.asarray(m_b[lam].means),
+            )
+            assert int(r_b[lam].iterations) == int(r_a[lam].iterations)
+
+    def test_preemption_stops_at_lambda_boundary(self, tmp_path):
+        from photon_ml_tpu.reliability import GridCheckpointer
+
+        class Guard:
+            def __init__(self):
+                self.requested = False
+
+        guard = Guard()
+        ck = GridCheckpointer(str(tmp_path / "g"), {"v": 1})
+        # pre-request: the sweep must stop BEFORE solving anything new
+        # once λs already loaded from snapshots are exhausted
+        guard.requested = True
+        models, results = self._fit(
+            tmp_path, grid_checkpointer=ck, preemption_guard=guard
+        )
+        assert models == {} and results == {}
+
+
+# ---------------------------------------------------------------------------
+# kill -9 resume, end-to-end through the drivers (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+def _run_driver(args, *, expect_kill=False, env=None, timeout=560):
+    e = {**os.environ, "JAX_PLATFORMS": "cpu",
+         "PHOTON_RETRY_BASE_S": "0.001", **(env or {})}
+    r = subprocess.run(
+        args, cwd=REPO, env=e, capture_output=True, text=True,
+        timeout=timeout,
+    )
+    if expect_kill:
+        assert r.returncode == -9, (
+            f"expected SIGKILL, got rc={r.returncode}\n{r.stderr[-2000:]}"
+        )
+    else:
+        assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-3000:]
+    return r
+
+
+def _tree_bytes(root):
+    out = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            p = os.path.join(dirpath, fn)
+            out[os.path.relpath(p, root)] = open(p, "rb").read()
+    return out
+
+
+def _assert_tree_equal(a, b, label):
+    ta, tb = _tree_bytes(a), _tree_bytes(b)
+    assert ta.keys() == tb.keys(), (label, ta.keys() ^ tb.keys())
+    diff = [k for k in ta if ta[k] != tb[k]]
+    assert not diff, f"{label}: files differ after resume: {diff}"
+
+
+class TestKillMinusNineResume:
+    def _glm_args(self, train, out, ckpt, plan=None):
+        args = [
+            sys.executable, "-m", "photon_ml_tpu.cli.glm_driver",
+            "--training-data-directory", train,
+            "--output-directory", out,
+            "--task", "LOGISTIC_REGRESSION",
+            "--regularization-weights", "10,1,0.1",
+            "--grid-mode", "sequential",
+            "--num-iterations", "15",
+            "--delete-output-dirs-if-exist", "true",
+        ]
+        if ckpt:
+            args += ["--checkpoint-dir", ckpt]
+        if plan:
+            args += ["--fault-plan", plan]
+        return args
+
+    def test_glm_lambda_grid_killed_mid_path_resumes_bitwise(
+        self, tmp_path
+    ):
+        """kill -9 during the 2nd λ's snapshot write: λ1 is committed,
+        λ2 is not. Restart with the same args; the resumed sweep loads
+        λ1, re-solves λ2 from λ1's snapshotted warm means, and the final
+        model artifacts are bitwise equal to an uninterrupted run."""
+        sys.path.insert(0, os.path.join(REPO, "dev-scripts"))
+        import chaos_matrix
+
+        train = str(tmp_path / "train")
+        chaos_matrix.gen_glm_data(train)
+        clean_out = str(tmp_path / "out-clean")
+        kill_out = str(tmp_path / "out-kill")
+        ckpt = str(tmp_path / "ckpt")
+        _run_driver(self._glm_args(train, clean_out, None))
+        # ckpt_save crossings: 1 = run manifest, 2-3 = λ1 npz+marker,
+        # 4 = λ2 npz -> SIGKILL lands mid-λ2-snapshot
+        _run_driver(
+            self._glm_args(train, kill_out, ckpt, plan="ckpt_save:4:KILL"),
+            expect_kill=True,
+        )
+        assert os.path.isdir(ckpt), "no snapshots before the kill"
+        assert any(f.endswith(".json") and f.startswith("lambda-")
+                   for f in os.listdir(ckpt)), os.listdir(ckpt)
+        _run_driver(self._glm_args(train, kill_out, ckpt))
+        _assert_tree_equal(
+            os.path.join(clean_out, "models"),
+            os.path.join(kill_out, "models"), "GLM models",
+        )
+        _assert_tree_equal(
+            os.path.join(clean_out, "models-text"),
+            os.path.join(kill_out, "models-text"), "GLM models-text",
+        )
+
+    def _game_args(self, train, out, ckpt, plan=None):
+        args = [
+            sys.executable, "-m", "photon_ml_tpu.cli.game_training_driver",
+            "--train-input-dirs", train,
+            "--output-dir", out,
+            "--task-type", "LOGISTIC_REGRESSION",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "globalShard:features|userShard:userFeatures",
+            "--fixed-effect-data-configurations", "global:globalShard,1",
+            "--fixed-effect-optimization-configurations",
+            "global:20,1e-6,0.5,1,TRON,L2",
+            "--random-effect-data-configurations",
+            "per-user:userId,userShard,1,none,none,none,identity",
+            "--random-effect-optimization-configurations",
+            "per-user:20,1e-6,1.0,1,LBFGS,L2",
+            "--num-iterations", "2",
+            "--streaming", "true",
+            # ~8 KiB budget -> ~56-row chunks over 450 records, so the
+            # stage pass spans ~9 chunks and a kill can land INSIDE it
+            "--stream-memory-budget", str(8 << 10),
+            "--checkpoint-dir", ckpt,
+            "--delete-output-dir-if-exists", "true",
+        ]
+        if plan:
+            args += ["--fault-plan", plan]
+        return args
+
+    def test_game_streaming_killed_mid_stage_resumes_bitwise(
+        self, tmp_path
+    ):
+        """kill -9 inside the stage pass (a spill_write crossing early
+        in chunk staging): the restart resumes staging from the
+        manifest's completed chunks and the final best-model is bitwise
+        equal to an uninterrupted run."""
+        train = str(tmp_path / "train")
+        _write_game_files(train)
+        clean_out = str(tmp_path / "out-clean")
+        kill_out = str(tmp_path / "out-kill")
+        _run_driver(
+            self._game_args(train, clean_out, str(tmp_path / "ck-clean"))
+        )
+        ckpt = str(tmp_path / "ck-kill")
+        _run_driver(
+            self._game_args(
+                train, kill_out, ckpt, plan="spill_write:12:KILL"
+            ),
+            expect_kill=True,
+        )
+        combo_dir = os.path.join(ckpt, sorted(os.listdir(ckpt))[0])
+        stage_manifest = read_manifest(os.path.join(combo_dir, "stage-train"))
+        assert stage_manifest is not None and not stage_manifest.get(
+            "staged"
+        ), stage_manifest
+        _run_driver(self._game_args(train, kill_out, ckpt))
+        _assert_tree_equal(
+            os.path.join(clean_out, "best-model"),
+            os.path.join(kill_out, "best-model"),
+            "GAME best-model (killed mid-stage)",
+        )
+
+    def test_game_streaming_killed_mid_cd_resumes_bitwise(self, tmp_path):
+        """kill -9 after at least one CD iteration checkpointed (a
+        spill_read crossing deep into the CD loop): the restart skips
+        the stage pass (manifest), restores the latest CD snapshot,
+        rebuilds scores from states, finishes the remaining iterations
+        — final model bitwise equal to the uninterrupted run."""
+        train = str(tmp_path / "train")
+        _write_game_files(train)
+        clean_out = str(tmp_path / "out-clean")
+        kill_out = str(tmp_path / "out-kill")
+        _run_driver(
+            self._game_args(train, clean_out, str(tmp_path / "ck-clean"))
+        )
+        ckpt = str(tmp_path / "ck-kill")
+        # crossing budget (counted on a clean run of this exact config):
+        # fill pass = 9 spill_reads, each CD iteration ~260, whole run
+        # ~529 — crossing 300 lands inside ITERATION 2, after iteration
+        # 1's snapshot committed
+        _run_driver(
+            self._game_args(
+                train, kill_out, ckpt, plan="spill_read:300:KILL"
+            ),
+            expect_kill=True,
+        )
+        combo_dir = os.path.join(ckpt, sorted(os.listdir(ckpt))[0])
+        cd_dir = os.path.join(combo_dir, "cd")
+        assert os.path.isdir(cd_dir) and any(
+            f.endswith(".json") for f in os.listdir(cd_dir)
+        ), "kill landed before the first CD snapshot — adjust the crossing"
+        stage_manifest = read_manifest(
+            os.path.join(combo_dir, "stage-train")
+        )
+        assert stage_manifest.get("staged"), "stage should have completed"
+        _run_driver(self._game_args(train, kill_out, ckpt))
+        _assert_tree_equal(
+            os.path.join(clean_out, "best-model"),
+            os.path.join(kill_out, "best-model"),
+            "GAME best-model (killed mid-CD)",
+        )

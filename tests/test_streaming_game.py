@@ -319,10 +319,14 @@ class TestStreamingGameGates:
         with pytest.raises(ValueError, match="active-data-upper-bound"):
             p.validate()
 
-    def test_rejects_checkpoint_and_sharded_evaluator(self, tmp_path):
+    def test_streaming_checkpoint_supported_sharded_evaluator_not(
+        self, tmp_path
+    ):
+        # round 11 (reliability layer): streaming + --checkpoint-dir is
+        # now a SUPPORTED combination (staged-store manifests + per-
+        # iteration CD snapshots), so validate() must accept it
         p = self._params(tmp_path, checkpoint_dir=str(tmp_path / "ckpt"))
-        with pytest.raises(ValueError, match="checkpoint"):
-            p.validate()
+        p.validate()
         p = self._params(
             tmp_path, evaluator_types=[EvaluatorType.parse("AUC:userId")]
         )
