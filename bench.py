@@ -74,6 +74,9 @@ def main():
         result["detail"]["grid_batched"] = _grid_batched_config(
             "grid_batched"
         )["detail"]
+        # the serving A/B is host+transfer-side too: latency/QPS at the
+        # CPU-scaled shapes, plus the zero-recompile contract numbers
+        result["detail"]["serving"] = _serving_config("serving")["detail"]
         result["detail"]["note"] = (
             "CPU-only host (accelerator unreachable); kernel-path "
             "microbench and BASELINE suite skipped — see the last "
@@ -1543,6 +1546,180 @@ def _grid_batched_config(name, *, n=20_000, d=2_000, k=16,
     }
 
 
+def _serving_config(name, *, seed=0):
+    """Online scoring service bench (ISSUE 7 / photon_ml_tpu.serving):
+    a synthetic GAME bank at config-5-class model shapes (FE 1M dims +
+    600k-user RE bank on chip-attached hosts; scaled down on the CPU
+    container, stated in the output) served through the real stack —
+    device bank, AOT shape ladder, micro-batcher — under two loads:
+
+    - **single-request closed loop**: one request in flight, every
+      dispatch shape 1 — the latency floor (p50/p99 reported);
+    - **saturating open loop**: N submitter threads, continuous
+      batching coalesces to the ladder — the QPS headline.
+
+    Both phases run with jax's lowering counter active: the request
+    path must lower ZERO programs after the AOT warmup (the
+    fixed-shape contract). Gates live in dev-scripts/bench_serving.sh
+    (p99 bound + zero recompiles everywhere; QPS chip-attached only).
+    """
+    import jax
+    import jax._src.test_util as jtu
+
+    from photon_ml_tpu.parallel import overlap
+    from photon_ml_tpu.serving import (
+        MicroBatcher,
+        ScoreRequest,
+        ServingMetrics,
+        ServingPrograms,
+        bank_from_arrays,
+    )
+
+    on_chip = any(p.platform != "cpu" for p in jax.devices())
+    if on_chip:
+        d_fixed, n_users, d_user = 1 << 20, 600_000, 1000
+        k_fixed, k_user = 64, 32
+        n_closed, n_open, concurrency = 2_000, 20_000, 32
+        shape_note = "config-5 FE/RE shapes (1M dims, 600k users x 1000)"
+    else:
+        d_fixed, n_users, d_user = 1 << 17, 20_000, 64
+        k_fixed, k_user = 32, 16
+        n_closed, n_open, concurrency = 300, 4_000, 8
+        shape_note = "CPU-scaled shapes (131k dims, 20k users x 64)"
+
+    rng = np.random.default_rng(seed)
+    t0 = time.perf_counter()
+    bank = bank_from_arrays(
+        fixed=[(
+            "global", "g",
+            rng.standard_normal(d_fixed, dtype=np.float32) * 0.1,
+        )],
+        random=[(
+            "per-user", "userId", "u",
+            rng.standard_normal((n_users, d_user), dtype=np.float32) * 0.1,
+            [f"user{i}" for i in range(n_users)],
+        )],
+        shard_widths={"g": k_fixed, "u": k_user},
+    )
+    stage_s = time.perf_counter() - t0
+    programs = ServingPrograms()
+    t0 = time.perf_counter()
+    programs.ensure_compiled(bank)
+    warmup_s = time.perf_counter() - t0
+
+    def make_requests(n):
+        gi = rng.integers(0, d_fixed, size=(n, k_fixed)).astype(np.int32)
+        gv = rng.standard_normal((n, k_fixed), dtype=np.float32)
+        ui = rng.integers(0, d_user, size=(n, k_user)).astype(np.int32)
+        uv = rng.standard_normal((n, k_user), dtype=np.float32)
+        codes = rng.integers(0, n_users, size=n)
+        return [
+            ScoreRequest(
+                uid=str(i),
+                indices={"g": gi[i], "u": ui[i]},
+                values={"g": gv[i], "u": uv[i]},
+                codes={"userId": int(codes[i])},
+            )
+            for i in range(n)
+        ]
+
+    compiles_before = programs.stats()["compile_count"]
+    out = {}
+    with jtu.count_jit_and_pmap_lowerings() as lowerings:
+        # -- closed loop: the single-request latency floor ------------------
+        closed_metrics = ServingMetrics()
+        reqs = make_requests(n_closed)
+        overlap.reset_readback_stats()
+        with MicroBatcher(
+            lambda: bank, programs, closed_metrics
+        ) as batcher:
+            for r in reqs:
+                batcher.score(r)
+        snap = closed_metrics.snapshot()
+        out["closed"] = {
+            "requests": snap["requests"],
+            "p50_ms": snap["latency_p50_ms"],
+            "p99_ms": snap["latency_p99_ms"],
+            "mean_ms": snap["latency_mean_ms"],
+            "qps": snap["qps"],
+            "dispatches": snap["dispatches"],
+            "readbacks": overlap.readback_stats(),
+        }
+
+        # -- open loop: saturating concurrent submitters --------------------
+        import threading
+
+        open_metrics = ServingMetrics()
+        reqs = make_requests(n_open)
+        it = iter(reqs)
+        lock = threading.Lock()
+        overlap.reset_readback_stats()
+
+        def worker():
+            while True:
+                with lock:
+                    r = next(it, None)
+                if r is None:
+                    return
+                batcher.score(r)
+
+        with MicroBatcher(lambda: bank, programs, open_metrics) as batcher:
+            threads = [
+                threading.Thread(target=worker)
+                for _ in range(concurrency)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            open_wall_s = time.perf_counter() - t0
+        snap = open_metrics.snapshot()
+        out["open"] = {
+            "requests": snap["requests"],
+            "concurrency": concurrency,
+            "qps": round(n_open / open_wall_s, 1),
+            "p50_ms": snap["latency_p50_ms"],
+            "p99_ms": snap["latency_p99_ms"],
+            "dispatches": snap["dispatches"],
+            "readbacks": overlap.readback_stats(),
+            "batch_occupancy_mean": snap["batch_occupancy_mean"],
+            "pad_waste_frac": snap["pad_waste_frac"],
+            "shape_counts": snap["shape_counts"],
+        }
+
+    stats = programs.stats()
+    return {
+        "config": name,
+        "metric": "serving_p99_ms_single_request",
+        "value": out["closed"]["p99_ms"],
+        "unit": "ms (closed-loop p99; open-loop QPS in detail)",
+        "detail": {
+            "device": str(jax.devices()[0]),
+            "host": {"cpu_count": os.cpu_count(), "on_chip": on_chip},
+            "shape_note": shape_note,
+            "model": {
+                "d_fixed": d_fixed, "n_users": n_users, "d_user": d_user,
+                "k_fixed": k_fixed, "k_user": k_user,
+                "bank_bytes": bank.device_bytes(),
+            },
+            "ladder": list(programs.ladder),
+            "stage_s": round(stage_s, 3),
+            "aot_warmup_s": round(warmup_s, 3),
+            "aot_programs": stats["compiled_programs"],
+            "closed": out["closed"],
+            "open": out["open"],
+            # the fixed-shape contract, measured over BOTH phases
+            "request_path_lowerings": int(lowerings[0]),
+            "recompiles_after_warmup": (
+                stats["compile_count"] - compiles_before
+            ),
+            "cold_dispatch_compiles": stats["cold_dispatch_compiles"],
+            "data": "synthetic bank + synthetic request trace",
+        },
+    }
+
+
 def _regen_with_model(rng, n, d, k, w_true, gen_task, noise=0.5):
     """Draw a dataset from a GIVEN planted model (shared generator for the
     train set and its held-out split)."""
@@ -2022,6 +2199,13 @@ def suite(only=None):
         results.append(_reliability_config("9_reliability"))
         print(json.dumps(results[-1]), flush=True)
 
+    # 10: online scoring service (round 12): single-request latency +
+    # saturating QPS over a device-resident bank at config-5 shapes;
+    # gates in dev-scripts/bench_serving.sh.
+    if want("10_serving"):
+        results.append(_serving_config("10_serving"))
+        print(json.dumps(results[-1]), flush=True)
+
     path = "BASELINE_RESULTS.json"
     merged = {}
     if only is not None and os.path.exists(path):
@@ -2059,6 +2243,10 @@ if __name__ == "__main__":
         # dev-scripts/bench_grid.sh entry: the batched λ-grid A/B as one
         # JSON line (gates applied by the script)
         print(json.dumps(_grid_batched_config("grid_batched")))
+    elif "--serving" in sys.argv:
+        # dev-scripts/bench_serving.sh entry: the online-scoring bench
+        # as one JSON line (gates applied by the script)
+        print(json.dumps(_serving_config("serving")))
     elif "--reliability" in sys.argv:
         # dev-scripts/chaos.sh entry: the seam-overhead A/B as one JSON
         # line (the <2% gate is applied by the script)

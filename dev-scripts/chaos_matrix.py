@@ -23,6 +23,15 @@ per-arm):
 5. **GAME faulted** — same args, fresh dirs, faults at chunk_read,
    spill_write, spill_read and ckpt_save. Completion + bitwise model
    parity + accounting.
+6. **Serving clean** — the GAME best-model replayed through the online
+   scoring driver (AOT ladder + micro-batcher): the reference scores.
+7. **Serving faulted** — transient EIO at ``serving.model_load`` on the
+   initial bank load: retried, completes, scores bitwise-equal arm 6.
+8. **Serving swap-corrupt** — a hot swap staged mid-replay from a model
+   copy whose load injects CORRUPT: the copy quarantines to
+   ``*.corrupt``, the swap ROLLS BACK, the run completes on generation
+   1 with scores bitwise-equal arm 6, and metrics.json accounts the
+   quarantine + rollback.
 
 Every asserted invariant is printed; any failure exits non-zero.
 """
@@ -49,6 +58,10 @@ GAME_PLAN = (
     "chunk_read:3:EIO,spill_write:4:EIO,spill_read:3:EIO,"
     "ckpt_save:2:ENOSPC"
 )
+SERVING_PLAN = "serving.model_load:1:EIO"
+# crossing 1 = the initial bank load (clean), crossing 2 = the hot-swap
+# staging read (corrupted -> quarantine + rollback)
+SERVING_SWAP_PLAN = "serving.model_load:2:CORRUPT"
 
 
 def log(msg):
@@ -254,6 +267,27 @@ def game_args(train, out, ckpt, plan=None):
     return args
 
 
+def serving_args(train, model_dir, out, plan=None, swap_dir=None):
+    args = [
+        sys.executable, "-m", "photon_ml_tpu.cli.serving_driver",
+        "--game-model-input-dir", model_dir,
+        "--request-paths", train,
+        "--output-dir", out,
+        "--task-type", "LOGISTIC_REGRESSION",
+        "--feature-shard-id-to-feature-section-keys-map",
+        "globalShard:features|userShard:userFeatures",
+        "--mode", "open",
+        "--concurrency", "4",
+        "--delete-output-dir-if-exists", "true",
+    ]
+    if swap_dir:
+        args += ["--swap-model-dir", swap_dir,
+                 "--swap-after-requests", "50"]
+    if plan:
+        args += ["--fault-plan", plan]
+    return args
+
+
 def main():
     base = tempfile.mkdtemp(prefix="photon-chaos-")
     try:
@@ -329,6 +363,48 @@ def main():
             m1["objective_history"], m2["objective_history"]
         )
         log("GAME: objective history identical across arms")
+
+        # -- Serving arms -------------------------------------------------
+        model_dir = os.path.join(gout1, "best-model")
+        sout1 = os.path.join(base, "serving-out-clean")
+        sout2 = os.path.join(base, "serving-out-faulted")
+        sout3 = os.path.join(base, "serving-out-swap")
+        run(serving_args(game_train, model_dir, sout1))
+        log("serving clean arm completed")
+        run(serving_args(game_train, model_dir, sout2, plan=SERVING_PLAN))
+        log("serving faulted (transient model-load) arm completed")
+        assert_accounting(
+            os.path.join(sout2, "metrics.json"), SERVING_PLAN, "serving"
+        )
+        assert_trees_bitwise_equal(
+            os.path.join(sout1, "scores"), os.path.join(sout2, "scores"),
+            "serving scores",
+        )
+        # swap-corrupt arm: the staged generation is a COPY of the model
+        # (the quarantine renames it; the served model must stay put)
+        swap_copy = os.path.join(base, "serving-swap-gen2")
+        shutil.copytree(model_dir, swap_copy)
+        run(serving_args(game_train, model_dir, sout3,
+                         plan=SERVING_SWAP_PLAN, swap_dir=swap_copy))
+        log("serving swap-corrupt arm completed")
+        m = json.load(open(os.path.join(sout3, "metrics.json")))
+        swaps = m["swap_history"]
+        assert len(swaps) == 1 and swaps[0]["rolled_back"], swaps
+        assert swaps[0]["quarantined"] and os.path.exists(
+            swaps[0]["quarantined"]
+        ), swaps
+        assert m["generation"] == 1, m["generation"]
+        quarantined = m["reliability"]["retries"]["quarantined"]
+        assert quarantined.get("serving.model_load", 0) >= 1, quarantined
+        log(
+            "serving swap: corrupt generation quarantined "
+            f"({os.path.basename(swaps[0]['quarantined'])}), rolled back "
+            "to generation 1"
+        )
+        assert_trees_bitwise_equal(
+            os.path.join(sout1, "scores"), os.path.join(sout3, "scores"),
+            "serving swap-rollback scores",
+        )
         log("chaos matrix: PASS")
     finally:
         shutil.rmtree(base, ignore_errors=True)

@@ -1,0 +1,13 @@
+"""Driver entry points (the reference's cli/ job mains):
+
+- ``glm_driver`` — staged GLM pipeline (train/validate/diagnose).
+- ``game_training_driver`` — GAME coordinate descent over config grids.
+- ``game_scoring_driver`` — offline batch scoring + evaluation.
+- ``serving_driver`` — the online low-latency request path
+  (photon_ml_tpu/serving): device-resident banks, micro-batching,
+  hot model swaps.
+- ``feature_indexing_driver`` — off-heap feature index build.
+
+Each is runnable as ``python -m photon_ml_tpu.cli.<name>`` with
+reference-parity option names where a reference job exists.
+"""

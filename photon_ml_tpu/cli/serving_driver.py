@@ -1,0 +1,611 @@
+"""Online scoring driver: load a GAME model into a device-resident bank
+and serve score requests through the micro-batched request path.
+
+The request source is a replayed trace — an Avro file/dir (the batch
+scoring driver's own input format, which is what makes serving-vs-batch
+bitwise parity a one-line diff) or JSON lines on stdin — so the driver
+exercises the full serving stack (bank, AOT ladder, batcher, hot swap,
+metrics) with no network dependency. A production front-end would
+replace the trace reader with a socket accept loop; everything behind
+``MicroBatcher.submit`` stays the same.
+
+Two load modes:
+
+- ``closed`` (default): one request in flight at a time — the
+  single-request latency floor (every dispatch is shape 1).
+- ``open``: ``--concurrency N`` submitter threads each run their own
+  closed loop over a shared trace iterator — the saturating-load mode
+  where the batcher's coalescing fills the ladder.
+
+``--swap-model-dir`` stages a second model generation and flips it
+after ``--swap-after-requests`` completions, under live traffic — the
+hot-swap demonstration the chaos matrix drives with fault plans.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from photon_ml_tpu.evaluation import EvaluatorType
+from photon_ml_tpu.game.config import FeatureShardConfiguration
+from photon_ml_tpu.task import TaskType
+from photon_ml_tpu.utils.logging_util import PhotonLogger, Timer
+
+DEFAULT_LADDER_TEXT = "1,8,64,256"
+
+
+@dataclass
+class ServingParams:
+    game_model_input_dir: str = ""
+    output_dir: str = ""
+    # Replay source: an Avro file/dir trace (request_paths) or "-" for
+    # JSON lines on stdin.
+    request_paths: List[str] = field(default_factory=list)
+    feature_shards: List[FeatureShardConfiguration] = field(
+        default_factory=list
+    )
+    task_type: TaskType = TaskType.LOGISTIC_REGRESSION
+    model_id: str = ""
+    has_response: bool = True
+    evaluator_types: List[EvaluatorType] = field(default_factory=list)
+    # Prebuilt feature maps (required for stdin; the Avro replay path
+    # can fall back to building maps from the trace itself, which is
+    # exactly what the batch scorer's in-memory mode does).
+    offheap_indexmap_dir: Optional[str] = None
+    offheap_indexmap_num_partitions: Optional[int] = None
+    feature_name_and_term_set_path: Optional[str] = None
+    # Padded micro-batch shape ladder + batching policy.
+    ladder: List[int] = field(default_factory=lambda: [1, 8, 64, 256])
+    max_wait_ms: float = 0.0
+    max_queue: int = 4096
+    # Per-shard request nnz width for stdin mode ("shard:k|shard:k" or
+    # one integer for all shards); Avro replay derives widths from the
+    # trace's padded layout.
+    request_nnz_width: Optional[str] = None
+    # Load mode.
+    mode: str = "closed"
+    concurrency: int = 8
+    # Hot swap demonstration: stage + flip this model generation after
+    # N completed requests.
+    swap_model_dir: Optional[str] = None
+    swap_after_requests: int = 0
+    entity_pad_to: int = 256
+    write_scores: bool = True
+    delete_output_dir_if_exists: bool = False
+    application_name: str = "photon-ml-tpu-serving"
+    no_overlap: bool = False
+    fault_plan: Optional[str] = None
+
+    @property
+    def stdin_mode(self) -> bool:
+        return self.request_paths == ["-"]
+
+    def validate(self) -> None:
+        if not self.game_model_input_dir:
+            raise ValueError("game-model-input-dir is required")
+        if not self.output_dir:
+            raise ValueError("output-dir is required")
+        if not self.request_paths:
+            raise ValueError("request-paths is required ('-' for stdin)")
+        if not self.feature_shards:
+            raise ValueError("feature shard configuration is required")
+        if self.mode not in ("closed", "open"):
+            raise ValueError(f"mode must be closed|open, got {self.mode!r}")
+        if self.mode == "open" and self.concurrency < 1:
+            raise ValueError("concurrency must be >= 1")
+        if sorted(set(self.ladder)) != list(self.ladder) or not self.ladder:
+            raise ValueError(f"ladder must be increasing: {self.ladder}")
+        if self.swap_model_dir and self.swap_after_requests < 1:
+            raise ValueError(
+                "swap-model-dir requires --swap-after-requests >= 1"
+            )
+        if self.stdin_mode:
+            if not (
+                self.offheap_indexmap_dir
+                or self.feature_name_and_term_set_path
+            ):
+                raise ValueError(
+                    "stdin serving requires prebuilt feature maps "
+                    "(--offheap-indexmap-dir or "
+                    "--feature-name-and-term-set-path): a request stream "
+                    "has no vocabulary to build from"
+                )
+            if not self.request_nnz_width:
+                raise ValueError(
+                    "stdin serving requires --request-nnz-width (the "
+                    "fixed per-shard feature width baked into the AOT "
+                    "program shapes)"
+                )
+
+
+def _parse_widths(text: str, shard_ids: List[str]) -> Dict[str, int]:
+    text = text.strip()
+    if "|" not in text and ":" not in text:
+        return {sid: int(text) for sid in shard_ids}
+    out: Dict[str, int] = {}
+    for part in text.split("|"):
+        sid, _, k = part.partition(":")
+        out[sid.strip()] = int(k)
+    missing = [sid for sid in shard_ids if sid not in out]
+    if missing:
+        raise ValueError(f"request-nnz-width missing shards {missing}")
+    return out
+
+
+class ServingDriver:
+    def __init__(self, params: ServingParams, logger=None):
+        params.validate()
+        self.params = params
+        if params.no_overlap:
+            from photon_ml_tpu.parallel import overlap
+
+            overlap.set_overlap(False)
+        if params.fault_plan:
+            from photon_ml_tpu.reliability import install_plan
+
+            install_plan(params.fault_plan)
+        from photon_ml_tpu.parallel.multihost import prepare_output_dir
+
+        prepare_output_dir(
+            params.output_dir,
+            delete_if_exists=params.delete_output_dir_if_exists,
+        )
+        self.logger = logger or PhotonLogger(params.output_dir)
+        self.timer = Timer()
+        self.serving_model = None
+        self.metrics = None
+        self.results: List[float] = []
+
+    # -- setup ---------------------------------------------------------------
+
+    def _prebuilt_index_maps(self):
+        p = self.params
+        if p.offheap_indexmap_dir:
+            from photon_ml_tpu.utils.native_index import (
+                load_offheap_index_maps,
+            )
+
+            return load_offheap_index_maps(
+                p.offheap_indexmap_dir,
+                [cfg.shard_id for cfg in p.feature_shards],
+                num_partitions=p.offheap_indexmap_num_partitions,
+            )
+        if p.feature_name_and_term_set_path:
+            from photon_ml_tpu.io.name_term_list import (
+                index_maps_from_name_term_lists,
+            )
+
+            return index_maps_from_name_term_lists(
+                p.feature_name_and_term_set_path, p.feature_shards
+            )
+        return None
+
+    def _build(self):
+        """Load the model artifact (behind the serving.model_load seam),
+        resolve feature maps + widths, stage the device bank, AOT-warm
+        the whole ladder. Returns the replayable request list."""
+        from photon_ml_tpu.serving import (
+            ServingModel,
+            ServingPrograms,
+            build_model_bank,
+            load_model_artifact,
+            requests_from_dataset,
+        )
+        from photon_ml_tpu.serving.batcher import request_from_record
+
+        p = self.params
+        with self.timer.time("load-model"):
+            loaded = load_model_artifact(p.game_model_input_dir)
+        id_types = sorted(
+            {re_t for re_t, _, _ in loaded.random_effects.values()}
+            | {
+                t
+                for rt, ct, _, _ in loaded.matrix_factorizations.values()
+                for t in (rt, ct)
+            }
+        )
+        index_maps = self._prebuilt_index_maps()
+        requests = None
+        dataset = None
+        if p.stdin_mode:
+            widths = _parse_widths(
+                p.request_nnz_width,
+                [cfg.shard_id for cfg in p.feature_shards],
+            )
+        else:
+            with self.timer.time("load-trace"):
+                from photon_ml_tpu.game.data import (
+                    build_game_dataset_from_files,
+                )
+
+                dataset = build_game_dataset_from_files(
+                    p.request_paths,
+                    p.feature_shards,
+                    id_types,
+                    index_maps=index_maps,
+                    is_response_required=p.has_response,
+                )
+            if index_maps is None:
+                # batch-scorer in-memory parity mode: the trace itself
+                # defines the vocabulary
+                index_maps = {
+                    sid: sd.index_map for sid, sd in dataset.shards.items()
+                }
+            widths = (
+                _parse_widths(
+                    p.request_nnz_width,
+                    [cfg.shard_id for cfg in p.feature_shards],
+                )
+                if p.request_nnz_width
+                else {
+                    sid: sd.indices.shape[1]
+                    for sid, sd in dataset.shards.items()
+                }
+            )
+        with self.timer.time("stage-bank"):
+            bank = build_model_bank(
+                loaded,
+                index_maps,
+                widths,
+                entity_pad_to=p.entity_pad_to,
+                model_id=p.model_id,
+            )
+        with self.timer.time("warmup-programs"):
+            self.serving_model = ServingModel(
+                bank, ServingPrograms(tuple(p.ladder))
+            )
+        self.logger.info(
+            "bank generation %d staged: %d coordinate(s), %.1f MiB on "
+            "device, ladder %s AOT-compiled (%d program(s))",
+            bank.generation,
+            len(bank.spec),
+            bank.device_bytes() / (1 << 20),
+            tuple(p.ladder),
+            self.serving_model.programs.stats()["compiled_programs"],
+        )
+        if dataset is not None:
+            with self.timer.time("assemble-requests"):
+                requests = requests_from_dataset(dataset, bank)
+        else:
+            def stdin_requests():
+                for line in sys.stdin:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    yield request_from_record(
+                        json.loads(line),
+                        bank,
+                        p.feature_shards,
+                        has_response=p.has_response,
+                    )
+
+            requests = stdin_requests()
+        return requests
+
+    # -- replay --------------------------------------------------------------
+
+    def _maybe_swap(self, completed: int, swap_once: threading.Lock):
+        p = self.params
+        if (
+            p.swap_model_dir
+            and completed >= p.swap_after_requests
+            # non-blocking acquire = atomic test-and-set: exactly one
+            # thread stages the flip, racers skip past
+            and swap_once.acquire(blocking=False)
+        ):
+            with self.timer.time("hot-swap"):
+                res = self.serving_model.stage_and_swap(
+                    p.swap_model_dir,
+                    entity_pad_to=p.entity_pad_to,
+                    model_id=p.model_id,
+                )
+            self.logger.info(
+                "hot swap after %d request(s): ok=%s generation=%d "
+                "donated=%s recompiled=%d rolled_back=%s%s",
+                completed, res.ok, res.generation, res.donated,
+                res.recompiled_programs, res.rolled_back,
+                f" quarantined={res.quarantined}" if res.quarantined else "",
+            )
+
+    def _replay_closed(self, batcher, requests) -> List[tuple]:
+        swap_once = threading.Lock()
+        out = []
+        for req in requests:
+            out.append((req, batcher.score(req)))
+            self._maybe_swap(len(out), swap_once)
+        return out
+
+    def _replay_open(self, batcher, requests) -> List[tuple]:
+        """``concurrency`` closed-loop submitters over one shared
+        iterator: results keep trace order via their request index."""
+        p = self.params
+        it = iter(enumerate(requests))
+        it_lock = threading.Lock()
+        out_lock = threading.Lock()
+        swap_once = threading.Lock()
+        results: Dict[int, tuple] = {}
+        errors: List[BaseException] = []
+
+        def worker():
+            while True:
+                with it_lock:
+                    try:
+                        i, req = next(it)
+                    except StopIteration:
+                        return
+                try:
+                    score = batcher.score(req)
+                except BaseException as e:
+                    with out_lock:
+                        errors.append(e)
+                    return
+                with out_lock:
+                    results[i] = (req, score)
+                    n = len(results)
+                self._maybe_swap(n, swap_once)
+
+        threads = [
+            threading.Thread(target=worker, name=f"photon-serving-load-{t}")
+            for t in range(p.concurrency)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return [results[i] for i in sorted(results)]
+
+    # -- output --------------------------------------------------------------
+
+    def _write_scores(self, scored: List[tuple]) -> None:
+        from photon_ml_tpu.io import schemas
+        from photon_ml_tpu.io.avro_codec import write_container
+
+        p = self.params
+
+        def records():
+            for req, score in scored:
+                yield {
+                    "uid": req.uid,
+                    "label": req.label if p.has_response else None,
+                    "modelId": p.model_id or "game-model",
+                    "predictionScore": float(score),
+                    "weight": req.weight,
+                    "metadataMap": req.metadata or None,
+                }
+
+        write_container(
+            os.path.join(p.output_dir, "scores", "part-00000.avro"),
+            schemas.SCORING_RESULT_AVRO,
+            records(),
+        )
+
+    def _evaluate(self, scored: List[tuple]) -> Dict[str, float]:
+        """Pointwise trace metrics (AUC/RMSE/losses) over the replayed
+        scores — the same evaluator path as the batch driver, on host
+        arrays the request loop already paid for."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.evaluation import Evaluator
+        from photon_ml_tpu.ops.losses import loss_for_task
+
+        p = self.params
+        out: Dict[str, float] = {}
+        if not (p.evaluator_types and p.has_response):
+            return out
+        scores = jnp.asarray(
+            np.asarray([s for _, s in scored], np.float32)
+        )
+        labels = jnp.asarray(
+            np.asarray([r.label for r, _ in scored], np.float32)
+        )
+        weights = jnp.asarray(
+            np.asarray([r.weight for r, _ in scored], np.float32)
+        )
+        loss = loss_for_task(p.task_type)
+        for et in p.evaluator_types:
+            if et.is_sharded:
+                raise ValueError(
+                    f"sharded evaluator {et.render()!r} needs global "
+                    "per-group data; evaluate with the batch driver"
+                )
+            metric_in = loss.mean(scores) if et.name == "RMSE" else scores
+            value = float(Evaluator(et).evaluate(metric_in, labels, weights))
+            out[et.render()] = value
+            self.logger.info("%s = %g", et.render(), value)
+        return out
+
+    def run(self) -> None:
+        from photon_ml_tpu.parallel import overlap
+        from photon_ml_tpu.serving import MicroBatcher, ServingMetrics
+
+        p = self.params
+        self.logger.info("application: %s", p.application_name)
+        requests = self._build()
+        self.metrics = ServingMetrics()
+        overlap.reset_readback_stats()
+        batcher = MicroBatcher(
+            self.serving_model.current,
+            self.serving_model.programs,
+            self.metrics,
+            max_wait_s=p.max_wait_ms / 1e3,
+            max_queue=p.max_queue,
+        )
+        try:
+            with self.timer.time("serve"):
+                scored = (
+                    self._replay_closed(batcher, requests)
+                    if p.mode == "closed"
+                    else self._replay_open(batcher, requests)
+                )
+        finally:
+            batcher.close()
+        if not scored:
+            raise ValueError("empty request trace")
+        self.logger.info(
+            "served %d request(s) in %s mode", len(scored), p.mode
+        )
+        if p.write_scores:
+            with self.timer.time("write-scores"):
+                self._write_scores(scored)
+        eval_metrics = self._evaluate(scored)
+        prog_stats = self.serving_model.programs.stats()
+        self.metrics.write(
+            os.path.join(p.output_dir, "metrics.json"),
+            extra={
+                **eval_metrics,
+                "mode": p.mode,
+                "generation": self.serving_model.generation,
+                "programs": prog_stats,
+                "readbacks": overlap.readback_stats(),
+                "swap_history": [
+                    {
+                        "ok": s.ok,
+                        "generation": s.generation,
+                        "donated": s.donated,
+                        "recompiled_programs": s.recompiled_programs,
+                        "rolled_back": s.rolled_back,
+                        "quarantined": s.quarantined,
+                        "error": s.error,
+                    }
+                    for s in self.serving_model.swap_history
+                ],
+            },
+        )
+        self.results = [s for _, s in scored]
+        self.logger.info("timers:\n%s", self.timer.summary())
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="photon-ml-tpu serving")
+    ap.add_argument("--game-model-input-dir", required=True)
+    ap.add_argument("--output-dir", required=True)
+    ap.add_argument(
+        "--request-paths", required=True,
+        help="Avro trace file(s)/dir(s), comma-separated, or '-' for "
+        "JSON-lines requests on stdin",
+    )
+    ap.add_argument(
+        "--feature-shard-id-to-feature-section-keys-map", required=True
+    )
+    ap.add_argument("--feature-shard-id-to-intercept-map", default=None)
+    ap.add_argument("--task-type", default="LOGISTIC_REGRESSION")
+    ap.add_argument("--evaluator-types", default=None)
+    ap.add_argument("--game-model-id", default=None)
+    ap.add_argument("--has-response", default="true")
+    ap.add_argument("--offheap-indexmap-dir", default=None)
+    ap.add_argument(
+        "--offheap-indexmap-num-partitions", type=int, default=None
+    )
+    ap.add_argument("--feature-name-and-term-set-path", default=None)
+    ap.add_argument(
+        "--ladder", default=DEFAULT_LADDER_TEXT,
+        help="padded micro-batch shapes, comma-separated increasing "
+        f"(default {DEFAULT_LADDER_TEXT}); every shape AOT-compiles at "
+        "startup",
+    )
+    ap.add_argument(
+        "--max-wait-ms", type=float, default=0.0,
+        help="linger for coalescing before dispatching a partial batch "
+        "(0 = continuous batching: dispatch whatever accumulated)",
+    )
+    ap.add_argument("--max-queue", type=int, default=4096)
+    ap.add_argument(
+        "--request-nnz-width", default=None,
+        help="per-shard request feature width ('shard:k|shard:k' or one "
+        "int for all); required for stdin, defaults to the trace's "
+        "padded width for Avro replay",
+    )
+    ap.add_argument(
+        "--mode", default="closed",
+        help="closed = one request in flight (latency floor); open = "
+        "--concurrency submitter threads (saturating load)",
+    )
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument(
+        "--swap-model-dir", default=None,
+        help="stage + hot-swap this model generation mid-replay",
+    )
+    ap.add_argument("--swap-after-requests", type=int, default=0)
+    ap.add_argument("--entity-pad-to", type=int, default=256)
+    ap.add_argument("--write-scores", default="true")
+    ap.add_argument("--delete-output-dir-if-exists", default="false")
+    ap.add_argument("--application-name", default=None)
+    ap.add_argument(
+        "--no-overlap", default="false",
+        help="disable the host-device overlap layer (A/B baseline)",
+    )
+    ap.add_argument(
+        "--fault-plan", default=None,
+        help="deterministic fault injection "
+        "(seam:nth:error[:times], comma-separated); also via "
+        "PHOTON_FAULT_PLAN",
+    )
+    return ap
+
+
+def params_from_args(argv=None) -> ServingParams:
+    from photon_ml_tpu.cli.game_training_driver import (
+        apply_intercept_map,
+        parse_shard_map,
+    )
+
+    ns = build_arg_parser().parse_args(argv)
+
+    def truthy(s) -> bool:
+        return str(s).lower() in ("true", "1", "yes")
+
+    return ServingParams(
+        game_model_input_dir=ns.game_model_input_dir,
+        output_dir=ns.output_dir,
+        request_paths=(
+            ["-"] if ns.request_paths.strip() == "-"
+            else ns.request_paths.split(",")
+        ),
+        feature_shards=apply_intercept_map(
+            parse_shard_map(ns.feature_shard_id_to_feature_section_keys_map),
+            ns.feature_shard_id_to_intercept_map,
+        ),
+        task_type=TaskType.parse(ns.task_type),
+        evaluator_types=(
+            [EvaluatorType.parse(s) for s in ns.evaluator_types.split(",")]
+            if ns.evaluator_types
+            else []
+        ),
+        model_id=ns.game_model_id or "",
+        has_response=truthy(ns.has_response),
+        offheap_indexmap_dir=ns.offheap_indexmap_dir,
+        offheap_indexmap_num_partitions=ns.offheap_indexmap_num_partitions,
+        feature_name_and_term_set_path=ns.feature_name_and_term_set_path,
+        ladder=[int(b) for b in ns.ladder.split(",")],
+        max_wait_ms=ns.max_wait_ms,
+        max_queue=ns.max_queue,
+        request_nnz_width=ns.request_nnz_width,
+        mode=ns.mode,
+        concurrency=ns.concurrency,
+        swap_model_dir=ns.swap_model_dir,
+        swap_after_requests=ns.swap_after_requests,
+        entity_pad_to=ns.entity_pad_to,
+        write_scores=truthy(ns.write_scores),
+        delete_output_dir_if_exists=truthy(ns.delete_output_dir_if_exists),
+        application_name=ns.application_name or "photon-ml-tpu-serving",
+        no_overlap=truthy(ns.no_overlap),
+        fault_plan=ns.fault_plan,
+    )
+
+
+def main(argv=None) -> None:
+    ServingDriver(params_from_args(argv)).run()
+
+
+if __name__ == "__main__":
+    main()

@@ -71,6 +71,7 @@ SEAMS = (
     "ckpt_restore",   # checkpoint restore / meta load
     "io_worker",      # overlap.submit_io async artifact writes
     "decode_ahead",   # decode-ahead worker thread handoff
+    "serving.model_load",  # serving bank load / hot-swap staging reads
 )
 
 _ERRNO = {

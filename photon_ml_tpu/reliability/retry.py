@@ -79,6 +79,9 @@ _POLICIES: Dict[str, RetryPolicy] = {
     "ckpt_restore": RetryPolicy(max_attempts=3),
     "io_worker": RetryPolicy(max_attempts=3),
     "decode_ahead": RetryPolicy(max_attempts=1),
+    # a failed swap load rolls back to the serving generation, so the
+    # budget is shallow-ish: three attempts, then keep serving N
+    "serving.model_load": RetryPolicy(max_attempts=3),
 }
 
 

@@ -1,0 +1,42 @@
+"""Online scoring service: the low-latency request path over
+device-resident GAME model banks.
+
+Four pieces, composed by ``cli/serving_driver.py``:
+
+- :mod:`photon_ml_tpu.serving.model_bank` — fixed/random-effect
+  coefficients as padded device arrays + O(1) host entity->row index;
+- :mod:`photon_ml_tpu.serving.programs` — the AOT fixed-shape program
+  ladder (every batch shape compiled before it can reach the hot path);
+- :mod:`photon_ml_tpu.serving.batcher` — micro-batching dispatch loop,
+  exactly one counted readback per dispatched batch;
+- :mod:`photon_ml_tpu.serving.swap` — zero-copy hot swap of model
+  generations with quarantine + rollback on poisoned artifacts;
+- :mod:`photon_ml_tpu.serving.metrics` — p50/p99 latency, QPS,
+  occupancy and pad-waste accounting for metrics.json.
+"""
+
+from photon_ml_tpu.serving.batcher import (  # noqa: F401
+    MicroBatcher,
+    ScoreRequest,
+    request_from_record,
+    requests_from_dataset,
+)
+from photon_ml_tpu.serving.metrics import ServingMetrics  # noqa: F401
+from photon_ml_tpu.serving.model_bank import (  # noqa: F401
+    DEFAULT_ENTITY_PAD,
+    EntityRowIndex,
+    ModelBank,
+    bank_from_arrays,
+    build_model_bank,
+)
+from photon_ml_tpu.serving.programs import (  # noqa: F401
+    DEFAULT_LADDER,
+    RequestBatch,
+    ServingPrograms,
+    select_shape,
+)
+from photon_ml_tpu.serving.swap import (  # noqa: F401
+    ServingModel,
+    SwapResult,
+    load_model_artifact,
+)

@@ -1,0 +1,363 @@
+"""Micro-batching request loop: coalesce, pad, dispatch, demux.
+
+The Podracer idiom (PAPERS.md): the serving loop is its own component —
+it never blocks on training, model publication, or artifact IO. Here it
+also never blocks on the DEVICE more than one dispatch at a time:
+concurrent submitters enqueue; a single dispatcher thread drains the
+queue into the smallest padded ladder shape that fits, runs ONE
+precompiled device program, performs exactly ONE counted readback
+(``overlap.device_get``) and resolves each request's future with its own
+row.
+
+Batching is continuous by default (``max_wait_s = 0``): whatever
+accumulated while the previous dispatch executed forms the next batch,
+so an idle service answers a lone request at shape 1 with zero imposed
+wait, and a saturated service coalesces to the ladder cap without any
+timer tuning. ``max_wait_s > 0`` forces coalescing for bursty open-loop
+sources.
+
+Request assembly lives here too: :func:`requests_from_dataset` turns a
+``GameDataset`` into per-row requests (the file-replay path — identical
+padding/width to the batch scorer, which is what the bitwise parity bar
+needs), and :func:`request_from_record` maps one raw record dict
+through prebuilt index maps (the stdin path).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Mapping, Optional
+
+import numpy as np
+
+from photon_ml_tpu.parallel import overlap
+from photon_ml_tpu.serving.model_bank import ModelBank
+from photon_ml_tpu.serving.programs import (
+    RequestBatch,
+    ServingPrograms,
+    select_shape,
+)
+
+__all__ = [
+    "ScoreRequest",
+    "MicroBatcher",
+    "request_from_record",
+    "requests_from_dataset",
+]
+
+_NO_LOCK = contextlib.nullcontext()
+
+
+@dataclass
+class ScoreRequest:
+    """One scoring request, already in device layout: per-shard padded
+    (indices, values) rows at the bank's shard widths, raw entity ids
+    resolved to bank rows at submit time (the O(1) host hash lookup)."""
+
+    uid: str
+    indices: Dict[str, np.ndarray]  # shard -> int32 [k]
+    values: Dict[str, np.ndarray]  # shard -> float32 [k]
+    codes: Dict[str, int]  # id type -> bank row (-1 = unknown entity)
+    offset: float = 0.0
+    # passthrough columns for the scores artifact (batch-scorer record
+    # layout); never touch the device
+    label: Optional[float] = None
+    weight: float = 1.0
+    metadata: Optional[Dict[str, str]] = None
+    _enqueue_t: float = field(default=0.0, repr=False)
+
+
+def request_from_record(
+    record: Mapping,
+    bank: ModelBank,
+    shard_configs,
+    *,
+    has_response: bool = True,
+) -> ScoreRequest:
+    """One raw GameExample-shaped dict -> ScoreRequest through the
+    bank's index maps (the stdin/JSON path; the Avro replay path goes
+    through :func:`requests_from_dataset` instead)."""
+    from photon_ml_tpu.game.data import record_entity_id, record_response
+    from photon_ml_tpu.utils.index_map import feature_key, intercept_key
+
+    indices: Dict[str, np.ndarray] = {}
+    values: Dict[str, np.ndarray] = {}
+    for cfg in shard_configs:
+        imap = bank.index_maps[cfg.shard_id]
+        k = bank.shard_widths[cfg.shard_id]
+        ix = np.zeros((k,), np.int32)
+        vs = np.zeros((k,), np.float32)
+        pos = 0
+        for bag in cfg.feature_bags:
+            for f in record.get(bag) or []:
+                j = imap.get_index(feature_key(f["name"], f["term"]))
+                if j < 0:
+                    continue  # unknown feature: dropped, like the builder
+                if pos >= k:
+                    raise ValueError(
+                        f"request {record.get('uid')!r} exceeds shard "
+                        f"{cfg.shard_id!r} width {k}"
+                    )
+                ix[pos] = j
+                vs[pos] = float(f["value"])
+                pos += 1
+        if cfg.add_intercept:
+            j = imap.get_index(intercept_key())
+            if j >= 0:
+                if pos >= k:
+                    raise ValueError(
+                        f"request {record.get('uid')!r} exceeds shard "
+                        f"{cfg.shard_id!r} width {k}"
+                    )
+                ix[pos] = j
+                vs[pos] = 1.0
+                pos += 1
+        indices[cfg.shard_id] = ix
+        values[cfg.shard_id] = vs
+    codes = {
+        t: bank.entity_row(t, record_entity_id(record, t))
+        for t in bank.re_types
+    }
+    off = record.get("offset")
+    wgt = record.get("weight")
+    uid = record.get("uid")
+    meta = {
+        t: str((record.get(t) if record.get(t) is not None
+                else (record.get("metadataMap") or {}).get(t)))
+        for t in bank.re_types
+    }
+    return ScoreRequest(
+        uid="" if uid is None else str(uid),
+        indices=indices,
+        values=values,
+        codes=codes,
+        offset=0.0 if off is None else float(off),
+        label=(
+            record_response(record, True) if has_response else None
+        ),
+        weight=1.0 if wgt is None else float(wgt),
+        metadata=meta or None,
+    )
+
+
+def requests_from_dataset(ds, bank: ModelBank) -> List[ScoreRequest]:
+    """Per-row requests from a GameDataset built with the bank's index
+    maps — row slices are views, entity codes are re-resolved against
+    the BANK's entity rows (the dataset's codes index the dataset's own
+    entity table, not the model's)."""
+    # one vectorized id->row resolve per id type, not one hash per row
+    bank_codes: Dict[str, np.ndarray] = {}
+    for t in bank.re_types:
+        ds_codes = ds.entity_codes[t]
+        ids = ds.entity_indexes[t].ids
+        table = bank.entity_rows[t].rows_of(ids) if ids else np.zeros(
+            0, np.int32
+        )
+        resolved = np.full(ds_codes.shape, -1, np.int32)
+        valid = ds_codes >= 0
+        resolved[valid] = table[ds_codes[valid]]
+        bank_codes[t] = resolved
+    out: List[ScoreRequest] = []
+    id_types = sorted(ds.entity_indexes)
+    for i in range(ds.num_real_rows):
+        meta = {
+            t: ds.entity_indexes[t].ids[int(ds.entity_codes[t][i])]
+            for t in id_types
+            if int(ds.entity_codes[t][i]) >= 0
+        }
+        out.append(
+            ScoreRequest(
+                uid=ds.uids[i],
+                indices={
+                    sid: sd.indices[i] for sid, sd in ds.shards.items()
+                },
+                values={
+                    sid: sd.values[i] for sid, sd in ds.shards.items()
+                },
+                codes={
+                    t: int(bank_codes[t][i]) for t in bank.re_types
+                },
+                offset=float(ds.offsets[i]),
+                label=float(ds.labels[i]),
+                weight=float(ds.weights[i]),
+                metadata=meta or None,
+            )
+        )
+    return out
+
+
+class MicroBatcher:
+    """Bounded-queue micro-batcher over a live bank reference.
+
+    ``bank_ref`` is a zero-arg callable returning the CURRENT ModelBank
+    — the hot-swap seam: the dispatcher reads it once per dispatch, so a
+    generation flip lands exactly on a batch boundary, never inside one.
+    """
+
+    def __init__(
+        self,
+        bank_ref: Callable[[], ModelBank],
+        programs: ServingPrograms,
+        metrics=None,
+        *,
+        max_wait_s: float = 0.0,
+        max_queue: int = 4096,
+        swap_lock: Optional[threading.Lock] = None,
+    ):
+        self._bank_ref = bank_ref
+        self._programs = programs
+        self._metrics = metrics
+        # exclusion against a DONATING hot swap (see ServingModel.
+        # dispatch_lock): inferred from a bound ServingModel.current
+        # bank_ref so the safe wiring is the default wiring
+        owner = getattr(bank_ref, "__self__", None)
+        self._swap_lock = (
+            swap_lock
+            if swap_lock is not None
+            else getattr(owner, "dispatch_lock", None)
+        )
+        self._max_wait_s = float(max_wait_s)
+        self._max_queue = int(max_queue)
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._space = threading.Condition(self._lock)
+        self._queue: List = []  # (ScoreRequest, Future)
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._dispatch_loop,
+            name="photon-serving-dispatch",
+            daemon=True,
+        )
+        self._worker.start()
+
+    # -- submit side ---------------------------------------------------------
+
+    def submit(self, request: ScoreRequest) -> Future:
+        """Enqueue one request; blocks only when the bounded queue is
+        full (backpressure, not unbounded memory)."""
+        fut: Future = Future()
+        request._enqueue_t = time.perf_counter()
+        with self._lock:
+            while len(self._queue) >= self._max_queue and not self._closed:
+                self._space.wait()
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            self._queue.append((request, fut))
+            self._nonempty.notify()
+        return fut
+
+    def score(self, request: ScoreRequest) -> float:
+        """Closed-loop convenience: submit and wait."""
+        return self.submit(request).result()
+
+    def close(self) -> None:
+        """Drain the queue, stop the dispatcher. Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._nonempty.notify_all()
+            self._space.notify_all()
+        self._worker.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- dispatch side -------------------------------------------------------
+
+    def _take(self) -> List:
+        """Block until work exists, optionally linger ``max_wait_s`` for
+        coalescing, then claim up to ``max(ladder)`` requests."""
+        cap = self._programs.ladder[-1]
+        with self._lock:
+            while not self._queue and not self._closed:
+                self._nonempty.wait()
+            if not self._queue:
+                return []  # closed and drained
+            if self._max_wait_s > 0.0:
+                deadline = self._queue[0][0]._enqueue_t + self._max_wait_s
+                while (
+                    len(self._queue) < cap
+                    and not self._closed
+                    and (remaining := deadline - time.perf_counter()) > 0
+                ):
+                    self._nonempty.wait(timeout=remaining)
+            take = self._queue[:cap]
+            del self._queue[:cap]
+            self._space.notify_all()
+            return take
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            take = self._take()
+            if not take:
+                return
+            try:
+                self._dispatch(take)
+            except BaseException as e:  # resolve, never wedge submitters
+                for _req, fut in take:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+    def _assemble(self, requests: List[ScoreRequest], bank: ModelBank,
+                  B: int) -> RequestBatch:
+        n = len(requests)
+        indices: Dict[str, np.ndarray] = {}
+        values: Dict[str, np.ndarray] = {}
+        for sid, k in bank.shard_widths.items():
+            ix = np.zeros((B, k), np.int32)
+            vs = np.zeros((B, k), np.float32)
+            for i, r in enumerate(requests):
+                ix[i] = r.indices[sid]
+                vs[i] = r.values[sid]
+            indices[sid] = ix
+            values[sid] = vs
+        codes: Dict[str, np.ndarray] = {}
+        for t in bank.re_types:
+            c = np.full((B,), -1, np.int32)
+            for i, r in enumerate(requests):
+                c[i] = r.codes.get(t, -1)
+            codes[t] = c
+        offsets = np.zeros((B,), np.float32)
+        offsets[:n] = [r.offset for r in requests]
+        return RequestBatch(
+            indices=indices, values=values, codes=codes, offsets=offsets
+        )
+
+    def _dispatch(self, take: List) -> None:
+        t0 = time.perf_counter()
+        requests = [r for r, _ in take]
+        # the whole device section (bank read -> assemble -> execute ->
+        # readback) is exclusive with a donating hot swap, so a flip
+        # lands BETWEEN batches and can never invalidate the buffers of
+        # one in flight; uncontended, the lock costs nanoseconds
+        lock = self._swap_lock if self._swap_lock is not None else _NO_LOCK
+        with lock:
+            bank = self._bank_ref()
+            B = select_shape(len(requests), self._programs.ladder)
+            batch = self._assemble(requests, bank, B)
+            scores_dev = self._programs.score(bank, batch)
+            # the ONE counted device->host transfer for this whole batch
+            scores = overlap.device_get(scores_dev)
+        t1 = time.perf_counter()
+        for i, (req, fut) in enumerate(take):
+            if not fut.done():
+                fut.set_result(float(scores[i]))
+        if self._metrics is not None:
+            self._metrics.record_dispatch(
+                shape=B,
+                occupancy=len(requests),
+                queue_wait_s=t0 - min(r._enqueue_t for r in requests),
+                device_s=t1 - t0,
+                generation=bank.generation,
+            )
+            done = time.perf_counter()
+            for req in requests:
+                self._metrics.record_latency(done - req._enqueue_t)

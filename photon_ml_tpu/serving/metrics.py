@@ -1,0 +1,169 @@
+"""Serving-side observability: latency percentiles, QPS, batch
+occupancy, pad waste, swap/compile accounting.
+
+Everything here is host arithmetic over host timestamps — nothing in
+this module may touch a device value (the request path's readback
+budget is exactly one ``overlap.device_get`` per dispatch, owned by the
+batcher). Latencies keep a bounded reservoir: full fidelity up to the
+cap, then uniform-by-stride thinning so a week of traffic cannot grow
+host memory — percentiles stay estimates over a deterministic subset,
+never a stopped service.
+
+``snapshot()`` is the metrics.json block; the driver merges it with the
+reliability accounting (faults/retries/quarantines) so one artifact
+answers both "how fast" and "what broke".
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["ServingMetrics"]
+
+
+class ServingMetrics:
+    """Thread-safe accumulator shared by the batcher, the swap path and
+    the driver."""
+
+    def __init__(self, *, max_latency_samples: int = 1 << 20):
+        self._lock = threading.Lock()
+        self._max_samples = int(max_latency_samples)
+        self._lat: List[float] = []
+        self._stride = 1
+        self._seen = 0
+        self._dispatches = 0
+        self._rows_real = 0
+        self._rows_padded = 0
+        self._queue_wait_s = 0.0
+        self._device_s = 0.0
+        self._shape_counts: Dict[int, int] = {}
+        self._gen_dispatches: Dict[int, int] = {}
+        self._first_t: Optional[float] = None
+        self._last_t: Optional[float] = None
+
+    # -- recording -----------------------------------------------------------
+
+    def record_dispatch(
+        self,
+        *,
+        shape: int,
+        occupancy: int,
+        queue_wait_s: float,
+        device_s: float,
+        generation: int,
+    ) -> None:
+        import time
+
+        now = time.perf_counter()
+        with self._lock:
+            self._dispatches += 1
+            self._rows_real += occupancy
+            self._rows_padded += shape
+            self._queue_wait_s += queue_wait_s
+            self._device_s += device_s
+            self._shape_counts[shape] = self._shape_counts.get(shape, 0) + 1
+            self._gen_dispatches[generation] = (
+                self._gen_dispatches.get(generation, 0) + 1
+            )
+            if self._first_t is None:
+                self._first_t = now - device_s - queue_wait_s
+            self._last_t = now
+
+    def record_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._seen += 1
+            if (self._seen - 1) % self._stride == 0:
+                self._lat.append(seconds)
+                if len(self._lat) >= self._max_samples:
+                    # thin deterministically: keep every 2nd sample,
+                    # double the stride for future arrivals
+                    self._lat = self._lat[::2]
+                    self._stride *= 2
+
+    # -- reporting -----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            lat = np.asarray(self._lat, np.float64)
+            elapsed = (
+                (self._last_t - self._first_t)
+                if self._first_t is not None and self._last_t is not None
+                else 0.0
+            )
+            out: Dict[str, object] = {
+                "requests": self._seen,
+                "dispatches": self._dispatches,
+                "qps": (
+                    round(self._seen / elapsed, 3) if elapsed > 0 else None
+                ),
+                "batch_occupancy_mean": (
+                    round(self._rows_real / self._rows_padded, 6)
+                    if self._rows_padded
+                    else None
+                ),
+                "pad_waste_frac": (
+                    round(1.0 - self._rows_real / self._rows_padded, 6)
+                    if self._rows_padded
+                    else None
+                ),
+                "rows_per_dispatch_mean": (
+                    round(self._rows_real / self._dispatches, 3)
+                    if self._dispatches
+                    else None
+                ),
+                "queue_wait_s_mean": (
+                    round(self._queue_wait_s / self._dispatches, 9)
+                    if self._dispatches
+                    else None
+                ),
+                "device_s_mean": (
+                    round(self._device_s / self._dispatches, 9)
+                    if self._dispatches
+                    else None
+                ),
+                "shape_counts": {
+                    str(k): v for k, v in sorted(self._shape_counts.items())
+                },
+                "generation_dispatches": {
+                    str(k): v
+                    for k, v in sorted(self._gen_dispatches.items())
+                },
+                "latency_samples": int(lat.size),
+                "latency_sample_stride": self._stride,
+            }
+            if lat.size:
+                out.update(
+                    {
+                        "latency_p50_ms": round(
+                            float(np.percentile(lat, 50)) * 1e3, 6
+                        ),
+                        "latency_p99_ms": round(
+                            float(np.percentile(lat, 99)) * 1e3, 6
+                        ),
+                        "latency_max_ms": round(float(lat.max()) * 1e3, 6),
+                        "latency_mean_ms": round(
+                            float(lat.mean()) * 1e3, 6
+                        ),
+                    }
+                )
+            return out
+
+    def write(self, path: str, extra: Optional[Dict] = None) -> None:
+        """metrics.json: the serving block + reliability accounting +
+        caller extras, atomically."""
+        from photon_ml_tpu.reliability import (
+            atomic_write_json,
+            reliability_metrics,
+        )
+
+        atomic_write_json(
+            path,
+            {
+                "serving": self.snapshot(),
+                **(extra or {}),
+                "reliability": reliability_metrics(),
+            },
+        )

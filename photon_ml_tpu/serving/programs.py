@@ -1,0 +1,213 @@
+"""AOT fixed-shape scoring programs over a model bank.
+
+Per-request latency on XLA is only predictable when nothing in the
+request path can trigger a compile (the pjit/TPUv4 discipline: a small
+closed set of shapes, all lowered ahead of time). The request path here
+sees exactly ``len(ladder)`` program shapes per model signature — one
+padded batch shape per ladder rung — and every one of them is
+``lower().compile()``d at bank-load/swap-stage time, BEFORE the shape
+can appear on the hot path. After warmup the dispatch loop only ever
+calls precompiled executables; the zero-recompile contract is pinned by
+``tests/test_serving.py`` with jax's lowering counter.
+
+The executable cache is keyed like the tile-schedule cache: by content
+signature — ``(bank spec, padded batch shape)`` — not by bank object
+identity, so a hot-swapped generation with unchanged shapes reuses every
+program, and a re-load of the same model costs zero compiles.
+
+The scoring function replays the batch scorer's per-coordinate algebra
+(`game.model_io.LoadedGameModel.score`) term for term — same gathers,
+same per-row reductions, same accumulation order — which is what makes
+serving scores bitwise-equal to the batch driver's.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.serving.model_bank import ModelBank
+
+__all__ = [
+    "RequestBatch",
+    "ServingPrograms",
+    "DEFAULT_LADDER",
+    "select_shape",
+]
+
+# Padded micro-batch shapes, smallest to largest. 1 serves the idle
+# closed loop with no pad waste; 256 is the saturating-load coalescing
+# cap (past ~256 rows the per-dispatch fixed cost is already amortized
+# to noise and bigger shapes only add tail latency).
+DEFAULT_LADDER = (1, 8, 64, 256)
+
+
+def select_shape(n: int, ladder: Sequence[int]) -> int:
+    """Smallest ladder shape that fits ``n`` rows (callers cap takes at
+    ``max(ladder)``, so there is always one)."""
+    for b in ladder:
+        if n <= b:
+            return b
+    raise ValueError(f"batch of {n} exceeds the ladder {tuple(ladder)}")
+
+
+class RequestBatch(NamedTuple):
+    """One padded micro-batch: per-shard features, per-id-type entity
+    codes, offsets. Padded rows carry zero features, code -1 and offset
+    0 — they score finite garbage that the demux discards."""
+
+    indices: Dict[str, jnp.ndarray]  # shard -> int32 [B, k]
+    values: Dict[str, jnp.ndarray]  # shard -> float32 [B, k]
+    codes: Dict[str, jnp.ndarray]  # re/mf id type -> int32 [B]
+    offsets: jnp.ndarray  # float32 [B]
+
+
+def _score_spec(spec, arrays, batch: RequestBatch):
+    """Margins + offsets for one padded batch. ``spec`` is static (the
+    bank signature); the loop unrolls at trace time into the exact
+    coordinate-order sum the batch scorer computes eagerly."""
+    total = jnp.zeros(batch.offsets.shape, jnp.float32)
+    for entry in spec:
+        kind, name = entry[0], entry[1]
+        if kind == "fe":
+            shard_id = entry[2]
+            w = arrays[name]
+            total = total + jnp.sum(
+                batch.values[shard_id]
+                * jnp.take(w, batch.indices[shard_id], axis=0),
+                axis=-1,
+            )
+        elif kind == "re":
+            re_type, shard_id = entry[2], entry[3]
+            bank = arrays[name]
+            codes = batch.codes[re_type]
+            valid = codes >= 0
+            w_rows = jnp.take(bank, jnp.maximum(codes, 0), axis=0)
+            score = jnp.sum(
+                batch.values[shard_id]
+                * jnp.take_along_axis(
+                    w_rows, batch.indices[shard_id], axis=1
+                ),
+                axis=-1,
+            )
+            total = total + jnp.where(valid, score, 0.0)
+        else:  # mf
+            row_t, col_t = entry[2], entry[3]
+            R, C = arrays[name]
+            rows = batch.codes[row_t]
+            cols = batch.codes[col_t]
+            valid = (rows >= 0) & (cols >= 0)
+            r = jnp.take(R, jnp.maximum(rows, 0), axis=0)
+            c = jnp.take(C, jnp.maximum(cols, 0), axis=0)
+            total = total + jnp.where(valid, jnp.sum(r * c, axis=-1), 0.0)
+    return total + batch.offsets
+
+
+_score_jit = jax.jit(_score_spec, static_argnums=(0,))
+
+
+def _batch_structs(spec, B: int) -> RequestBatch:
+    """ShapeDtypeStructs of a padded batch at ladder shape ``B`` (the
+    lowering inputs; shard widths/id types come from the spec)."""
+    f32, i32 = jnp.float32, jnp.int32
+    indices: Dict[str, jax.ShapeDtypeStruct] = {}
+    values: Dict[str, jax.ShapeDtypeStruct] = {}
+    codes: Dict[str, jax.ShapeDtypeStruct] = {}
+    for entry in spec:
+        kind = entry[0]
+        if kind == "fe":
+            shard_id, _d, k = entry[2], entry[3], entry[4]
+            indices[shard_id] = jax.ShapeDtypeStruct((B, k), i32)
+            values[shard_id] = jax.ShapeDtypeStruct((B, k), f32)
+        elif kind == "re":
+            re_type, shard_id, k = entry[2], entry[3], entry[6]
+            indices[shard_id] = jax.ShapeDtypeStruct((B, k), i32)
+            values[shard_id] = jax.ShapeDtypeStruct((B, k), f32)
+            codes[re_type] = jax.ShapeDtypeStruct((B,), i32)
+        else:
+            for t in (entry[2], entry[3]):
+                codes[t] = jax.ShapeDtypeStruct((B,), i32)
+    return RequestBatch(
+        indices=indices,
+        values=values,
+        codes=codes,
+        offsets=jax.ShapeDtypeStruct((B,), f32),
+    )
+
+
+def _array_structs(arrays):
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), arrays
+    )
+
+
+class ServingPrograms:
+    """The per-shape executable cache. ``ensure_compiled`` is the warmup
+    seam (bank load + swap staging); ``score`` is the hot path and — by
+    contract — never lowers anything the cache does not already hold
+    unless an unwarmed shape arrives (counted, and zero after warmup)."""
+
+    def __init__(self, ladder: Sequence[int] = DEFAULT_LADDER, max_entries: int = 64):
+        if not ladder or list(ladder) != sorted(set(int(b) for b in ladder)):
+            raise ValueError(
+                f"ladder must be strictly increasing and non-empty: {ladder}"
+            )
+        self.ladder: Tuple[int, ...] = tuple(int(b) for b in ladder)
+        self._max_entries = max_entries
+        self._lock = threading.Lock()
+        self._cache: Dict[tuple, object] = {}
+        self.compile_count = 0
+        self.cold_dispatch_compiles = 0
+
+    def _compile(self, spec, arrays, B: int):
+        exe = _score_jit.lower(
+            spec, _array_structs(arrays), _batch_structs(spec, B)
+        ).compile()
+        with self._lock:
+            while len(self._cache) >= self._max_entries:
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[(spec, B)] = exe
+            self.compile_count += 1
+        return exe
+
+    def ensure_compiled(self, bank: ModelBank) -> int:
+        """AOT-compile every ladder shape for this bank's signature;
+        returns how many programs were newly compiled (0 when the spec
+        was already warm — the swap-without-recompile case)."""
+        fresh = 0
+        for B in self.ladder:
+            with self._lock:
+                hit = (bank.spec, B) in self._cache
+            if not hit:
+                self._compile(bank.spec, bank.arrays, B)
+                fresh += 1
+        return fresh
+
+    def executable(self, spec, B: int):
+        with self._lock:
+            return self._cache.get((spec, B))
+
+    def score(self, bank: ModelBank, batch: RequestBatch) -> jnp.ndarray:
+        """Device scores for one padded batch (no readback here — the
+        batcher owns the single counted device_get per dispatch)."""
+        B = batch.offsets.shape[0]
+        exe = self.executable(bank.spec, B)
+        if exe is None:
+            # an unwarmed shape reached the hot path: compile it now and
+            # count the miss — the bench/test gates pin this at zero
+            # after warmup
+            with self._lock:
+                self.cold_dispatch_compiles += 1
+            exe = self._compile(bank.spec, bank.arrays, B)
+        return exe(bank.arrays, batch)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "compiled_programs": len(self._cache),
+                "compile_count": self.compile_count,
+                "cold_dispatch_compiles": self.cold_dispatch_compiles,
+            }

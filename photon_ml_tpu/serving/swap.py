@@ -1,0 +1,260 @@
+"""Zero-copy hot swap: stage a new model generation under live traffic,
+flip atomically between micro-batches, roll back on a poisoned artifact.
+
+The decoupled-acting/learning contract (Podracer, PAPERS.md): model
+publication must never stall the serving loop. Staging therefore does
+ALL the slow work — artifact load (behind the ``serving.model_load``
+reliability seam), dense bank assembly, device placement, AOT program
+warmup for every ladder shape — while the previous generation keeps
+serving. The flip itself is one reference assignment under the manager
+lock; the batcher reads the reference once per dispatch, so a
+generation change lands exactly on a micro-batch boundary.
+
+"Zero-copy" is literal on two axes:
+
+- the flip copies nothing — generation N+1 is already device-resident;
+- when the new generation's signature matches the old one's (same
+  coordinate shapes — the overwhelmingly common retrain case, which the
+  entity-axis padding in `model_bank` is designed to preserve), staging
+  routes the new values through a DONATING refresh program: XLA reuses
+  generation N's buffers for generation N+1's outputs, so device memory
+  holds ~one bank instead of two. The refresh is a bitwise move
+  (``select`` on a constant predicate), pinned by the swap parity test.
+
+A corrupt artifact (decode failure or an injected ``CORRUPT`` at the
+seam) quarantines the model directory to ``*.corrupt`` via the
+reliability layer and ROLLS BACK: the previous generation keeps
+serving, the failure is accounted, and nothing about the request path
+changes.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from functools import partial
+from typing import Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_ml_tpu.serving.model_bank import (
+    DEFAULT_ENTITY_PAD,
+    ModelBank,
+    build_model_bank,
+    place_on_device,
+)
+from photon_ml_tpu.serving.programs import ServingPrograms
+
+__all__ = ["SwapResult", "ServingModel", "SEAM", "load_model_artifact"]
+
+SEAM = "serving.model_load"
+
+
+def load_model_artifact(model_dir: str):
+    """Read a GAME model directory behind the ``serving.model_load``
+    seam: transient IO errors retry on the per-seam budget; a corrupt
+    artifact quarantines to ``*.corrupt`` and raises (callers with a
+    live previous generation catch and roll back instead)."""
+    from photon_ml_tpu.reliability import InjectedCorruption, io_call
+    from photon_ml_tpu.reliability.retry import quarantine_artifact
+
+    try:
+        return io_call(SEAM, _load_model, model_dir, detail=model_dir)
+    except (InjectedCorruption, ValueError) as e:
+        quarantine_artifact(model_dir, SEAM)
+        raise RuntimeError(
+            f"model artifact at {model_dir} is corrupt (quarantined): {e}"
+        ) from e
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _donating_refresh(old_arrays, new_arrays):
+    """Write generation N+1's values into buffers XLA may alias from
+    generation N's donated ones. ``where`` on a constant-true predicate
+    is a select — the output carries ``new``'s exact bits (a plain
+    ``new + 0.0`` would flip -0.0 to +0.0), while consuming ``old`` so
+    its buffers are donatable."""
+    return jax.tree_util.tree_map(
+        lambda o, n: jnp.where(jnp.bool_(True), n, o),
+        old_arrays,
+        new_arrays,
+    )
+
+
+@dataclass
+class SwapResult:
+    ok: bool
+    generation: int
+    donated: bool = False
+    recompiled_programs: int = 0
+    rolled_back: bool = False
+    quarantined: Optional[str] = None
+    error: str = ""
+
+
+class ServingModel:
+    """Generation manager: owns the current ModelBank, the program
+    cache, and the stage/flip/rollback protocol."""
+
+    def __init__(
+        self,
+        bank: ModelBank,
+        programs: Optional[ServingPrograms] = None,
+    ):
+        self._lock = threading.Lock()
+        self._bank = bank
+        self.programs = programs or ServingPrograms()
+        self.programs.ensure_compiled(bank)
+        self.swap_history = []
+        # Mutual exclusion between a DONATING flip and an in-flight
+        # dispatch: donation invalidates generation N's device buffers,
+        # so the refresh must not run while a dispatch is executing
+        # against them. MicroBatcher picks this lock up automatically
+        # from a bound `ServingModel.current` bank_ref and holds it for
+        # the duration of each dispatch; the donated flip takes it for
+        # the (sub-ms, all-cache-hit) refresh — which is exactly the
+        # "flipped atomically between requests" contract.
+        self.dispatch_lock = threading.Lock()
+
+    # the batcher's bank_ref
+    def current(self) -> ModelBank:
+        with self._lock:
+            return self._bank
+
+    @property
+    def generation(self) -> int:
+        return self.current().generation
+
+    @classmethod
+    def load(
+        cls,
+        model_dir: str,
+        index_maps: Mapping[str, object],
+        shard_widths: Mapping[str, int],
+        *,
+        ladder=None,
+        entity_pad_to: int = DEFAULT_ENTITY_PAD,
+        native_index_threshold: Optional[int] = None,
+        model_id: str = "",
+    ) -> "ServingModel":
+        """Initial load: the artifact read runs behind the
+        ``serving.model_load`` seam (transient IO errors retry on the
+        per-seam budget); a corrupt artifact quarantines and raises —
+        with no previous generation there is nothing to roll back to."""
+        loaded = load_model_artifact(model_dir)
+        bank = build_model_bank(
+            loaded,
+            index_maps,
+            shard_widths,
+            generation=1,
+            entity_pad_to=entity_pad_to,
+            native_index_threshold=native_index_threshold,
+            model_id=model_id,
+        )
+        programs = (
+            ServingPrograms(ladder) if ladder is not None else None
+        )
+        return cls(bank, programs)
+
+    def stage_and_swap(
+        self,
+        model_dir: str,
+        *,
+        entity_pad_to: int = DEFAULT_ENTITY_PAD,
+        native_index_threshold: Optional[int] = None,
+        model_id: str = "",
+    ) -> SwapResult:
+        """Load generation N+1, stage it on device, warm its programs,
+        flip. Never raises on a bad artifact: quarantines + rolls back,
+        returning the failure in the SwapResult."""
+        from photon_ml_tpu.reliability import (
+            InjectedCorruption,
+            SeamFailure,
+            io_call,
+        )
+        from photon_ml_tpu.reliability.retry import quarantine_artifact
+
+        prev = self.current()
+        try:
+            loaded = io_call(SEAM, _load_model, model_dir, detail=model_dir)
+        except (InjectedCorruption, ValueError) as e:
+            q = quarantine_artifact(model_dir, SEAM)
+            result = SwapResult(
+                ok=False,
+                generation=prev.generation,
+                rolled_back=True,
+                quarantined=q,
+                error=str(e),
+            )
+            self.swap_history.append(result)
+            return result
+        except SeamFailure as e:
+            result = SwapResult(
+                ok=False,
+                generation=prev.generation,
+                rolled_back=True,
+                error=str(e),
+            )
+            self.swap_history.append(result)
+            return result
+
+        staged = build_model_bank(
+            loaded,
+            index_maps=prev.index_maps,
+            shard_widths=prev.shard_widths,
+            generation=prev.generation + 1,
+            entity_pad_to=entity_pad_to,
+            native_index_threshold=native_index_threshold,
+            device=False,  # host arrays: device placement happens below
+            model_id=model_id,
+        )
+        return self._flip(staged)
+
+    def swap_to_bank(self, staged: ModelBank) -> SwapResult:
+        """Flip to an already-built bank (in-memory publication path —
+        e.g. a co-located trainer handing over arrays directly)."""
+        prev = self.current()
+        staged.generation = prev.generation + 1
+        return self._flip(staged)
+
+    def _flip(self, staged: ModelBank) -> SwapResult:
+        prev = self.current()
+        donated = staged.spec == prev.spec
+        if donated:
+            # same shapes: refresh in place — the old generation's
+            # buffers are donated to the new one's outputs. Exclusive
+            # with dispatch (dispatch_lock): a batch mid-execution must
+            # not have its bank donated out from under it.
+            with self.dispatch_lock:
+                staged.arrays = _donating_refresh(
+                    prev.arrays, staged.arrays
+                )
+                recompiled = self.programs.ensure_compiled(staged)
+                with self._lock:
+                    self._bank = staged
+                    prev.retired = True
+        else:
+            # changed shapes: stage fresh buffers (both generations
+            # coexist briefly); prev stays valid, no exclusion needed.
+            # Every ladder shape compiles BEFORE the flip: a swap can
+            # slow staging, never the first post-swap request.
+            staged.arrays = place_on_device(staged.arrays)
+            recompiled = self.programs.ensure_compiled(staged)
+            with self._lock:
+                self._bank = staged
+                prev.retired = True
+        result = SwapResult(
+            ok=True,
+            generation=staged.generation,
+            donated=donated,
+            recompiled_programs=recompiled,
+        )
+        self.swap_history.append(result)
+        return result
+
+
+def _load_model(model_dir: str):
+    from photon_ml_tpu.game.model_io import load_game_model
+
+    return load_game_model(model_dir)

@@ -190,6 +190,31 @@ class TestLintClean:
         assert len(pl006) == 1, pl006
         assert pl006[0].path.endswith("reliability/artifacts.py")
 
+    def test_serving_subsystem_is_covered_and_clean(self, full_report):
+        """ISSUE 7: photon_ml_tpu/serving/ + the serving driver are in
+        the analyzed file set (PL001/PL002 and friends apply to the
+        request path) and contribute ZERO baseline entries and ZERO
+        allow() sites — the new subsystem starts at the post-round-10
+        hygiene bar, not grandfathered."""
+        serving_files = [
+            f for f in full_report.files
+            if "photon_ml_tpu/serving/" in f.replace(os.sep, "/")
+        ]
+        assert len(serving_files) >= 5, serving_files
+        assert any(
+            f.replace(os.sep, "/").endswith("cli/serving_driver.py")
+            for f in full_report.files
+        )
+        entries = json.load(open(BASELINE))["entries"]
+        assert not [
+            e for e in entries
+            if "serving" in e["file"]
+        ], "serving code must not be baselined"
+        assert not [
+            s for s in full_report.allow_sites
+            if "serving" in s.path.replace(os.sep, "/")
+        ], "serving code must not carry allow() suppressions"
+
     def test_json_lists_allow_sites_with_seam_accounting(self, repo_cwd):
         r = subprocess.run(
             [sys.executable, "-m", "photon_ml_tpu.lint",

@@ -98,6 +98,22 @@ class TestFaultPlan:
         assert io_call("spill_read", lambda: 7, detail="x") == 7
         assert retry_stats()["retries"]["spill_read"] == 1
 
+    def test_serving_model_load_is_a_registered_seam(self):
+        """ISSUE 7: the serving bank-load/swap seam is a first-class
+        member of the fault surface — plans parse it (the dot is part
+        of the name, not plan syntax) and it carries its own retry
+        budget instead of the default policy."""
+        from photon_ml_tpu.reliability import SEAMS, policy_for
+        from photon_ml_tpu.reliability.retry import _POLICIES
+
+        assert "serving.model_load" in SEAMS
+        assert "serving.model_load" in _POLICIES
+        assert policy_for("serving.model_load").max_attempts == 3
+        plan = FaultPlan.parse("serving.model_load:2:CORRUPT")
+        assert plan.entries[0].seam == "serving.model_load"
+        assert not plan.entries[0].fires_at(1)
+        assert plan.entries[0].fires_at(2)
+
 
 # ---------------------------------------------------------------------------
 # io_call / retry / quarantine

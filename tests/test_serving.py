@@ -1,0 +1,771 @@
+"""Online scoring service tests (ISSUE 7): scoring parity with the
+batch driver (bitwise), micro-batch demux under concurrent submitters,
+hot-swap parity + rollback, padded-shape ladder selection, and the
+zero-recompile / one-readback-per-dispatch contract.
+"""
+
+import json
+import os
+import shutil
+import threading
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from photon_ml_tpu.game.config import FeatureShardConfiguration
+from photon_ml_tpu.game.data import build_game_dataset
+from photon_ml_tpu.game.model_io import LoadedGameModel
+from photon_ml_tpu.parallel import overlap
+from photon_ml_tpu.serving import (
+    EntityRowIndex,
+    MicroBatcher,
+    ServingMetrics,
+    ServingModel,
+    ServingPrograms,
+    build_model_bank,
+    request_from_record,
+    requests_from_dataset,
+    select_shape,
+)
+from photon_ml_tpu.task import TaskType
+
+SHARDS = [
+    FeatureShardConfiguration("g", ["features"]),
+    FeatureShardConfiguration("u", ["userFeatures"]),
+]
+
+
+def synth_records(rng, n=60, n_users=7, d_g=5, d_u=3):
+    recs = []
+    for i in range(n):
+        u = int(rng.integers(0, n_users))
+        recs.append({
+            "uid": f"r{i}",
+            "response": float(rng.integers(0, 2)),
+            "offset": float(rng.normal() * 0.1),
+            "weight": float(rng.uniform(0.5, 2.0)),
+            "metadataMap": {"userId": f"user{u}"},
+            "features": [
+                {"name": f"g{j}", "term": "", "value": float(rng.normal())}
+                for j in range(d_g)
+            ],
+            "userFeatures": [
+                {"name": f"u{j}", "term": "", "value": float(rng.normal())}
+                for j in range(d_u)
+            ],
+        })
+    return recs
+
+
+def synth_model(rng, n_users=7, d_g=5, d_u=3, *, scale=1.0, drop_user=True):
+    """A LoadedGameModel with one FE + one per-user RE coordinate; one
+    user deliberately has NO model (the unknown-entity path)."""
+    lm = LoadedGameModel()
+    lm.fixed_effects["global"] = (
+        "g",
+        {f"g{j}\t": float(rng.normal()) * scale for j in range(d_g)},
+    )
+    users = range(n_users - 1) if drop_user else range(n_users)
+    lm.random_effects["per-user"] = (
+        "userId",
+        "u",
+        {
+            f"user{e}": {
+                f"u{j}\t": float(rng.normal()) * scale for j in range(d_u)
+            }
+            for e in users
+        },
+    )
+    return lm
+
+
+def batch_reference_scores(lm, ds):
+    """What the batch scoring driver writes: raw scores + offsets."""
+    return np.asarray(
+        lm.score(ds, TaskType.LOGISTIC_REGRESSION) + jnp.asarray(ds.offsets)
+    )[: ds.num_real_rows]
+
+
+def make_bank(lm, ds, **kw):
+    imaps = {sid: sd.index_map for sid, sd in ds.shards.items()}
+    widths = {sid: sd.indices.shape[1] for sid, sd in ds.shards.items()}
+    return build_model_bank(lm, imaps, widths, **kw)
+
+
+@pytest.fixture
+def served(rng):
+    recs = synth_records(rng)
+    ds = build_game_dataset(recs, SHARDS, ["userId"])
+    lm = synth_model(rng)
+    bank = make_bank(lm, ds)
+    programs = ServingPrograms((1, 8, 64))
+    programs.ensure_compiled(bank)
+    return recs, ds, lm, bank, programs
+
+
+class TestScoringParity:
+    def test_serving_scores_bitwise_match_batch_scorer(self, served):
+        """The acceptance bar: the request path reproduces the batch
+        scoring driver's scores BITWISE, including offsets, masked
+        unknown entities, and weights-irrelevance."""
+        _, ds, lm, bank, programs = served
+        ref = batch_reference_scores(lm, ds)
+        metrics = ServingMetrics()
+        with MicroBatcher(lambda: bank, programs, metrics) as mb:
+            futs = [mb.submit(r) for r in requests_from_dataset(ds, bank)]
+            got = np.asarray([f.result() for f in futs], np.float32)
+        assert np.array_equal(got, ref)
+
+    def test_single_request_dispatches_shape_one(self, served):
+        _, ds, lm, bank, programs = served
+        ref = batch_reference_scores(lm, ds)
+        metrics = ServingMetrics()
+        reqs = requests_from_dataset(ds, bank)
+        with MicroBatcher(lambda: bank, programs, metrics) as mb:
+            for i in (0, 7, 23):
+                assert mb.score(reqs[i]) == ref[i]
+        snap = metrics.snapshot()
+        assert snap["shape_counts"] == {"1": 3}
+        assert snap["pad_waste_frac"] == 0.0
+
+    def test_unknown_entity_scores_through_fe_only(self, served):
+        """A request whose entity the model never saw gets code -1 and
+        scores 0 through the RE coordinate — exactly the batch scorer's
+        masked-code semantics (synth_model drops the last user)."""
+        recs, ds, lm, bank, _ = served
+        missing = f"user{6}"
+        assert any(
+            r["metadataMap"]["userId"] == missing for r in recs
+        ), "fixture must exercise the unknown entity"
+        assert bank.entity_row("userId", missing) == -1
+        assert bank.entity_row("userId", "user0") >= 0
+
+    def test_record_assembly_matches_dataset_assembly(self, served):
+        """The stdin path (request_from_record through index maps) and
+        the Avro replay path (requests_from_dataset) produce identical
+        scores for the same logical record."""
+        recs, ds, lm, bank, programs = served
+        ref = batch_reference_scores(lm, ds)
+        with MicroBatcher(lambda: bank, programs) as mb:
+            for i in (0, 11, 42):
+                req = request_from_record(recs[i], bank, SHARDS)
+                assert mb.score(req) == ref[i]
+
+    def test_record_width_overflow_raises(self, served):
+        recs, ds, lm, bank, _ = served
+        fat = dict(recs[0])
+        fat["features"] = [
+            {"name": f"g{j % 5}", "term": "", "value": 1.0}
+            for j in range(bank.shard_widths["g"] + 1)
+        ]
+        with pytest.raises(ValueError, match="exceeds shard"):
+            request_from_record(fat, bank, SHARDS)
+
+
+class TestEntityRowIndex:
+    def test_dict_backend(self):
+        idx = EntityRowIndex(["a", "b", "c"])
+        assert idx.backend == "dict"
+        assert [idx.row_of(e) for e in ("a", "c", "zz")] == [0, 2, -1]
+        assert idx.rows_of(["b", "nope", "a"]).tolist() == [1, -1, 0]
+
+    def test_native_backend_matches_dict(self):
+        ids = [f"member-{i}" for i in range(257)]
+        try:
+            native = EntityRowIndex(ids, native_threshold=1)
+        except Exception:
+            pytest.skip("native toolchain unavailable")
+        if native.backend != "native":
+            pytest.skip("native store fell back")
+        plain = EntityRowIndex(ids)
+        probe = ids[::13] + ["member-9999", ""]
+        assert native.rows_of(probe).tolist() == plain.rows_of(probe).tolist()
+
+
+class TestLadder:
+    def test_select_shape_picks_smallest_fit(self):
+        ladder = (1, 8, 64, 256)
+        assert select_shape(1, ladder) == 1
+        assert select_shape(2, ladder) == 8
+        assert select_shape(8, ladder) == 8
+        assert select_shape(65, ladder) == 256
+        with pytest.raises(ValueError):
+            select_shape(257, ladder)
+
+    def test_bad_ladder_rejected(self):
+        with pytest.raises(ValueError):
+            ServingPrograms((8, 1))
+        with pytest.raises(ValueError):
+            ServingPrograms(())
+
+    def test_coalesced_batches_use_ladder_shapes(self, served):
+        """Submitting a burst while the dispatcher is busy coalesces the
+        backlog into the smallest fitting padded shape."""
+        _, ds, lm, bank, programs = served
+        metrics = ServingMetrics()
+        reqs = requests_from_dataset(ds, bank)
+        with MicroBatcher(lambda: bank, programs, metrics) as mb:
+            futs = [mb.submit(r) for r in reqs]
+            for f in futs:
+                f.result()
+        snap = metrics.snapshot()
+        shapes = {int(s) for s in snap["shape_counts"]}
+        assert shapes <= {1, 8, 64}
+        assert snap["requests"] == len(reqs)
+        # occupancy accounting is consistent with the shape counts
+        padded = sum(
+            int(s) * c for s, c in snap["shape_counts"].items()
+        )
+        assert snap["batch_occupancy_mean"] == pytest.approx(
+            len(reqs) / padded
+        )
+
+    def test_max_wait_coalesces_trickled_requests(self, served):
+        """With a linger window, requests trickling in one at a time
+        still form a multi-row batch."""
+        _, ds, lm, bank, programs = served
+        metrics = ServingMetrics()
+        reqs = requests_from_dataset(ds, bank)[:8]
+        with MicroBatcher(
+            lambda: bank, programs, metrics, max_wait_s=0.25
+        ) as mb:
+            futs = [mb.submit(r) for r in reqs]
+            for f in futs:
+                f.result()
+        snap = metrics.snapshot()
+        assert snap["dispatches"] < len(reqs)
+
+
+class TestMicroBatchDemux:
+    def test_concurrent_submitters_each_get_their_own_score(self, served):
+        """The demux invariant under contention: N threads hammering
+        submit() each receive exactly their request's row."""
+        _, ds, lm, bank, programs = served
+        ref = batch_reference_scores(lm, ds)
+        reqs = requests_from_dataset(ds, bank)
+        errors = []
+
+        def worker(idx):
+            try:
+                for i in idx:
+                    got = mb.score(reqs[i])
+                    assert got == ref[i], (i, got, ref[i])
+            except BaseException as e:
+                errors.append(e)
+
+        with MicroBatcher(lambda: bank, programs) as mb:
+            threads = [
+                threading.Thread(
+                    target=worker, args=(range(t, len(reqs), 6),)
+                )
+                for t in range(6)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert not errors, errors
+
+    def test_submit_after_close_raises(self, served):
+        _, ds, _, bank, programs = served
+        reqs = requests_from_dataset(ds, bank)
+        mb = MicroBatcher(lambda: bank, programs)
+        mb.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            mb.submit(reqs[0])
+
+
+class TestCompileAndReadbackContract:
+    def test_zero_recompiles_after_warmup(self, served):
+        """After ensure_compiled walks the ladder, a replayed trace
+        lowers NOTHING — every dispatch hits a precompiled executable
+        (the AOT fixed-shape contract, pinned with jax's own counter)."""
+        import jax._src.test_util as jtu
+
+        _, ds, lm, bank, programs = served
+        reqs = requests_from_dataset(ds, bank)
+        before = programs.stats()
+        with MicroBatcher(lambda: bank, programs) as mb:
+            with jtu.count_jit_and_pmap_lowerings() as count:
+                futs = [mb.submit(r) for r in reqs]
+                for f in futs:
+                    f.result()
+        assert count[0] == 0, f"request path lowered {count[0]} program(s)"
+        after = programs.stats()
+        assert after["compile_count"] == before["compile_count"]
+        assert after["cold_dispatch_compiles"] == 0
+
+    def test_exactly_one_readback_per_dispatched_batch(self, served):
+        _, ds, lm, bank, programs = served
+        metrics = ServingMetrics()
+        reqs = requests_from_dataset(ds, bank)
+        with MicroBatcher(lambda: bank, programs, metrics) as mb:
+            overlap.reset_readback_stats()
+            futs = [mb.submit(r) for r in reqs]
+            for f in futs:
+                f.result()
+            assert overlap.readback_stats() == metrics.snapshot()[
+                "dispatches"
+            ]
+
+
+class TestHotSwap:
+    def _save(self, lm, ds, path, rng):
+        """Persist a LoadedGameModel-shaped model through the real
+        artifact writer (reference directory layout)."""
+        from photon_ml_tpu.game.model_io import save_game_model
+        from photon_ml_tpu.game.model import (
+            FixedEffectModel,
+            GameModel,
+        )
+        from photon_ml_tpu.models.coefficients import Coefficients
+        from photon_ml_tpu.models.glm import create_model
+
+        shard_id, means = lm.fixed_effects["global"]
+        imap = ds.shards[shard_id].index_map
+        w = np.zeros((imap.size,), np.float32)
+        for k, v in means.items():
+            i = imap.get_index(k)
+            if i >= 0:
+                w[i] = v
+        gm = GameModel({
+            "global": FixedEffectModel(
+                create_model(
+                    TaskType.LOGISTIC_REGRESSION,
+                    Coefficients(jnp.asarray(w)),
+                ),
+                shard_id,
+            )
+        })
+        save_game_model(gm, ds, path)
+
+    def _fe_only(self, rng, scale):
+        lm = LoadedGameModel()
+        lm.fixed_effects["global"] = (
+            "g", {f"g{j}\t": float(rng.normal()) * scale for j in range(5)},
+        )
+        return lm
+
+    @pytest.fixture
+    def two_generations(self, rng, tmp_path):
+        recs = synth_records(rng)
+        ds = build_game_dataset(recs, [SHARDS[0]], [])
+        gens = {}
+        for name, scale in (("g1", 1.0), ("g2", -2.0)):
+            lm = self._fe_only(rng, scale)
+            self._save(lm, ds, str(tmp_path / name), rng)
+            gens[name] = lm
+        return ds, gens, tmp_path
+
+    def _serving_model(self, ds, model_dir):
+        imaps = {"g": ds.shards["g"].index_map}
+        widths = {"g": ds.shards["g"].indices.shape[1]}
+        return ServingModel.load(
+            str(model_dir), imaps, widths, ladder=(1, 8)
+        ), imaps, widths
+
+    def test_swap_parity_mid_load(self, two_generations):
+        """Requests completing before the flip score generation 1,
+        requests after score generation 2, and the swapped bank is
+        BITWISE the bank a fresh load of generation 2 builds — through
+        the donating refresh path (same shapes)."""
+        ds, gens, tmp = two_generations
+        sm, imaps, widths = self._serving_model(ds, tmp / "g1")
+        ref1 = batch_reference_scores(gens["g1"], ds)
+        ref2 = batch_reference_scores(gens["g2"], ds)
+        reqs = requests_from_dataset(ds, sm.current())
+        with MicroBatcher(sm.current, sm.programs) as mb:
+            for i in range(5):
+                assert mb.score(reqs[i]) == ref1[i]
+            res = sm.stage_and_swap(str(tmp / "g2"))
+            assert res.ok and res.generation == 2
+            assert res.donated, "same-shape swap must take the donated path"
+            assert res.recompiled_programs == 0
+            for i in range(5, 10):
+                assert mb.score(reqs[i]) == ref2[i]
+        fresh = build_model_bank(gens["g2"], imaps, widths)
+        assert np.array_equal(
+            overlap.device_get(sm.current().arrays["global"]),
+            overlap.device_get(fresh.arrays["global"]),
+        ), "donated refresh must be a bitwise move"
+        assert sm.current().generation == 2
+
+    def test_swap_under_concurrent_traffic(self, two_generations):
+        """Flip while submitters hammer: every result is EITHER gen-1's
+        or gen-2's score for its row (a flip lands on a batch boundary,
+        never inside one), and after the swap only gen-2 scores appear."""
+        ds, gens, tmp = two_generations
+        sm, _, _ = self._serving_model(ds, tmp / "g1")
+        ref1 = batch_reference_scores(gens["g1"], ds)
+        ref2 = batch_reference_scores(gens["g2"], ds)
+        reqs = requests_from_dataset(ds, sm.current())
+        errors = []
+
+        def worker(idx):
+            try:
+                for i in idx:
+                    got = mb.score(reqs[i])
+                    assert got in (ref1[i], ref2[i]), (i, got)
+            except BaseException as e:
+                errors.append(e)
+
+        with MicroBatcher(sm.current, sm.programs) as mb:
+            threads = [
+                threading.Thread(
+                    target=worker, args=(range(t, len(reqs), 4),)
+                )
+                for t in range(4)
+            ]
+            for t in threads:
+                t.start()
+            sm.stage_and_swap(str(tmp / "g2"))
+            for t in threads:
+                t.join()
+            assert not errors, errors
+            for i in range(4):
+                assert mb.score(reqs[i]) == ref2[i]
+
+    def test_batcher_autowires_the_dispatch_lock(self, two_generations):
+        """A bound ServingModel.current bank_ref hands the batcher the
+        swap/dispatch exclusion lock automatically: a DONATING flip
+        (which invalidates generation N's buffers) can never overlap a
+        dispatch that is executing against them."""
+        ds, gens, tmp = two_generations
+        sm, _, _ = self._serving_model(ds, tmp / "g1")
+        mb = MicroBatcher(sm.current, sm.programs)
+        try:
+            assert mb._swap_lock is sm.dispatch_lock
+        finally:
+            mb.close()
+        plain = MicroBatcher(lambda: sm.current(), sm.programs)
+        try:
+            assert plain._swap_lock is None
+        finally:
+            plain.close()
+
+    def test_repeated_swaps_under_fire_never_break_a_dispatch(
+        self, two_generations
+    ):
+        """Donation stress: flip generations repeatedly while
+        submitters hammer — no dispatch may ever observe a donated
+        (deleted) buffer, and every result matches one generation."""
+        ds, gens, tmp = two_generations
+        sm, _, _ = self._serving_model(ds, tmp / "g1")
+        ref1 = batch_reference_scores(gens["g1"], ds)
+        ref2 = batch_reference_scores(gens["g2"], ds)
+        reqs = requests_from_dataset(ds, sm.current())
+        errors = []
+        stop = threading.Event()
+
+        def submitter():
+            try:
+                i = 0
+                while not stop.is_set():
+                    got = mb.score(reqs[i % len(reqs)])
+                    j = i % len(reqs)
+                    assert got in (ref1[j], ref2[j]), (j, got)
+                    i += 1
+            except BaseException as e:
+                errors.append(e)
+
+        with MicroBatcher(sm.current, sm.programs) as mb:
+            threads = [
+                threading.Thread(target=submitter) for _ in range(3)
+            ]
+            for t in threads:
+                t.start()
+            try:
+                for gen_dir in ("g2", "g1", "g2", "g1", "g2"):
+                    res = sm.stage_and_swap(str(tmp / gen_dir))
+                    assert res.ok and res.donated, res
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join()
+        assert not errors, errors
+        assert sm.current().generation == 6
+
+    def test_corrupt_swap_quarantines_and_rolls_back(
+        self, two_generations
+    ):
+        """An injected CORRUPT at the serving.model_load seam during
+        staging: the artifact moves to *.corrupt, the swap reports
+        rolled_back, and generation 1 keeps serving bit-identically."""
+        from photon_ml_tpu.reliability import install_plan
+        from photon_ml_tpu.reliability.retry import (
+            reset_retry_stats,
+            retry_stats,
+        )
+
+        ds, gens, tmp = two_generations
+        sm, _, _ = self._serving_model(ds, tmp / "g1")
+        ref1 = batch_reference_scores(gens["g1"], ds)
+        reqs = requests_from_dataset(ds, sm.current())
+        victim = str(tmp / "g2-copy")
+        shutil.copytree(str(tmp / "g2"), victim)
+        reset_retry_stats()
+        install_plan("serving.model_load:1:CORRUPT")
+        try:
+            res = sm.stage_and_swap(victim)
+        finally:
+            install_plan(None)
+        assert not res.ok and res.rolled_back
+        assert res.quarantined and os.path.exists(res.quarantined)
+        assert not os.path.exists(victim)
+        assert (
+            retry_stats()["quarantined"].get("serving.model_load", 0) == 1
+        )
+        assert sm.current().generation == 1
+        with MicroBatcher(sm.current, sm.programs) as mb:
+            for i in range(3):
+                assert mb.score(reqs[i]) == ref1[i]
+
+    def test_transient_load_fault_retries(self, two_generations):
+        """A once-EIO at the seam is absorbed by the retry budget: the
+        swap still completes and the retry is accounted."""
+        from photon_ml_tpu.reliability import install_plan
+        from photon_ml_tpu.reliability.retry import (
+            reset_retry_stats,
+            retry_stats,
+        )
+
+        ds, gens, tmp = two_generations
+        sm, _, _ = self._serving_model(ds, tmp / "g1")
+        reset_retry_stats()
+        install_plan("serving.model_load:1:EIO")
+        try:
+            res = sm.stage_and_swap(str(tmp / "g2"))
+        finally:
+            install_plan(None)
+        assert res.ok and res.generation == 2
+        assert retry_stats()["retries"].get("serving.model_load", 0) >= 1
+
+    def test_exhausted_load_budget_rolls_back(self, two_generations):
+        from photon_ml_tpu.reliability import install_plan
+
+        ds, gens, tmp = two_generations
+        sm, _, _ = self._serving_model(ds, tmp / "g1")
+        install_plan("serving.model_load:1:EIO:*")
+        try:
+            res = sm.stage_and_swap(str(tmp / "g2"))
+        finally:
+            install_plan(None)
+        assert not res.ok and res.rolled_back
+        assert sm.current().generation == 1
+        # a transient give-up does NOT quarantine the (healthy) artifact
+        assert os.path.isdir(str(tmp / "g2"))
+
+
+class TestVectorizedScoreRecords:
+    """Satellite: the batch scorer's record assembly is a vectorized,
+    sliceable, re-iterable column view — same records as the old
+    per-row loop, no per-cell Python casts, retry-safe."""
+
+    def _rows(self, rng):
+        from photon_ml_tpu.cli.game_scoring_driver import (
+            GameScoringDriver,
+            GameScoringParams,
+        )
+
+        recs = synth_records(rng, n=20)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        scores = np.asarray(rng.normal(size=ds.num_real_rows), np.float32)
+        params = GameScoringParams.__new__(GameScoringParams)
+        params.has_response = True
+        params.model_id = "m7"
+        fake = GameScoringDriver.__new__(GameScoringDriver)
+        fake.params = params
+        return ds, scores, GameScoringDriver._score_records(
+            fake, ds, scores
+        )
+
+    def _expected(self, ds, scores):
+        id_types = sorted(ds.entity_indexes)
+        out = []
+        for i in range(ds.num_real_rows):
+            meta = {
+                t: ds.entity_indexes[t].ids[int(ds.entity_codes[t][i])]
+                for t in id_types
+                if int(ds.entity_codes[t][i]) >= 0
+            }
+            out.append({
+                "uid": ds.uids[i],
+                "label": float(ds.labels[i]),
+                "modelId": "m7",
+                "predictionScore": float(scores[i]),
+                "weight": float(ds.weights[i]),
+                "metadataMap": meta or None,
+            })
+        return out
+
+    def test_rows_match_reference_loop(self, rng):
+        ds, scores, rows = self._rows(rng)
+        assert len(rows) == ds.num_real_rows
+        assert list(rows) == self._expected(ds, scores)
+
+    def test_reiteration_and_split_slicing(self, rng):
+        ds, scores, rows = self._rows(rng)
+        first = list(rows)
+        assert list(rows) == first, "view must re-iterate identically"
+        expected = self._expected(ds, scores)
+        n = 3
+        split = [list(rows[i::n]) for i in range(n)]
+        assert [r for part in split for r in part] != []
+        for i in range(n):
+            assert split[i] == expected[i::n]
+
+
+class TestServingDriverValidation:
+    def _params(self, **kw):
+        from photon_ml_tpu.cli.serving_driver import ServingParams
+
+        base = dict(
+            game_model_input_dir="m",
+            output_dir="o",
+            request_paths=["trace"],
+            feature_shards=[SHARDS[0]],
+        )
+        base.update(kw)
+        return ServingParams(**base)
+
+    def test_stdin_requires_prebuilt_maps_and_width(self):
+        with pytest.raises(ValueError, match="prebuilt feature maps"):
+            self._params(request_paths=["-"]).validate()
+        with pytest.raises(ValueError, match="request-nnz-width"):
+            self._params(
+                request_paths=["-"], offheap_indexmap_dir="idx"
+            ).validate()
+
+    def test_swap_requires_threshold(self):
+        with pytest.raises(ValueError, match="swap-after-requests"):
+            self._params(swap_model_dir="m2").validate()
+
+    def test_bad_ladder_and_mode(self):
+        with pytest.raises(ValueError, match="ladder"):
+            self._params(ladder=[8, 1]).validate()
+        with pytest.raises(ValueError, match="mode"):
+            self._params(mode="burst").validate()
+
+
+@pytest.mark.slow
+class TestServingDriverEndToEnd:
+    def _train(self, tmp_path, rng):
+        from tests.test_game_drivers import write_game_avro
+        from photon_ml_tpu.cli.game_training_driver import (
+            GameTrainingDriver,
+            GameTrainingParams,
+        )
+        from photon_ml_tpu.game.config import (
+            FixedEffectDataConfiguration,
+            RandomEffectDataConfiguration,
+        )
+
+        train = tmp_path / "train"
+        train.mkdir()
+        write_game_avro(str(train / "p0.avro"), rng)
+        params = GameTrainingParams(
+            train_input_dirs=[str(train)],
+            output_dir=str(tmp_path / "out"),
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            feature_shards=[
+                FeatureShardConfiguration("g", ["features"]),
+                FeatureShardConfiguration("u", ["userFeatures"]),
+            ],
+            fixed_effect_data_configs={
+                "global": FixedEffectDataConfiguration("g")
+            },
+            fixed_effect_opt_configs={"global": "10,1e-6,0.1,1,LBFGS,L2"},
+            random_effect_data_configs={
+                "per-user": RandomEffectDataConfiguration("userId", "u")
+            },
+            random_effect_opt_configs={"per-user": "10,1e-6,1.0,1,LBFGS,L2"},
+            num_iterations=1,
+        )
+        GameTrainingDriver(params).run()
+        return str(train), os.path.join(params.output_dir, "best-model")
+
+    def test_replayed_trace_matches_batch_driver_bitwise(
+        self, tmp_path, rng
+    ):
+        """Driver-level acceptance: the serving driver's score records
+        equal the batch scoring driver's record for record, and its
+        metrics.json carries the latency/occupancy/compile accounting."""
+        from photon_ml_tpu.cli.game_scoring_driver import (
+            GameScoringDriver,
+            GameScoringParams,
+        )
+        from photon_ml_tpu.cli.serving_driver import (
+            ServingDriver,
+            params_from_args,
+        )
+        from photon_ml_tpu.io.avro_codec import read_avro_records
+
+        train, model_dir = self._train(tmp_path, rng)
+        shards = [
+            FeatureShardConfiguration("g", ["features"]),
+            FeatureShardConfiguration("u", ["userFeatures"]),
+        ]
+        GameScoringDriver(GameScoringParams(
+            input_dirs=[train],
+            game_model_input_dir=model_dir,
+            output_dir=str(tmp_path / "batch"),
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            feature_shards=shards,
+        )).run()
+        driver = ServingDriver(params_from_args([
+            "--game-model-input-dir", model_dir,
+            "--output-dir", str(tmp_path / "serve"),
+            "--request-paths", train,
+            "--feature-shard-id-to-feature-section-keys-map",
+            "g:features|u:userFeatures",
+            "--mode", "open",
+            "--concurrency", "4",
+            "--evaluator-types", "AUC",
+        ]))
+        driver.run()
+        batch = {
+            r["uid"]: r
+            for r in read_avro_records(str(tmp_path / "batch" / "scores"))
+        }
+        serve = {
+            r["uid"]: r
+            for r in read_avro_records(str(tmp_path / "serve" / "scores"))
+        }
+        assert batch == serve
+        m = json.load(open(str(tmp_path / "serve" / "metrics.json")))
+        assert m["programs"]["cold_dispatch_compiles"] == 0
+        assert m["readbacks"] == m["serving"]["dispatches"]
+        assert m["serving"]["latency_p99_ms"] > 0
+        assert m["serving"]["qps"] > 0
+        assert 0 < m["AUC"] <= 1
+
+    def test_driver_hot_swap_mid_replay(self, tmp_path, rng):
+        """--swap-model-dir flips generations mid-trace: both
+        generations appear in the dispatch accounting and the swap
+        history records a donated, non-recompiling flip."""
+        from photon_ml_tpu.cli.serving_driver import (
+            ServingDriver,
+            params_from_args,
+        )
+
+        train, model_dir = self._train(tmp_path, rng)
+        driver = ServingDriver(params_from_args([
+            "--game-model-input-dir", model_dir,
+            "--output-dir", str(tmp_path / "serve-swap"),
+            "--request-paths", train,
+            "--feature-shard-id-to-feature-section-keys-map",
+            "g:features|u:userFeatures",
+            "--swap-model-dir", model_dir,
+            "--swap-after-requests", "40",
+        ]))
+        driver.run()
+        m = json.load(
+            open(str(tmp_path / "serve-swap" / "metrics.json"))
+        )
+        assert m["generation"] == 2
+        swaps = m["swap_history"]
+        assert len(swaps) == 1 and swaps[0]["ok"] and swaps[0]["donated"]
+        assert swaps[0]["recompiled_programs"] == 0
+        gens = m["serving"]["generation_dispatches"]
+        assert set(gens) == {"1", "2"}
