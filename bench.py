@@ -1612,13 +1612,15 @@ def _serving_config(name, *, seed=0):
         gv = rng.standard_normal((n, k_fixed), dtype=np.float32)
         ui = rng.integers(0, d_user, size=(n, k_user)).astype(np.int32)
         uv = rng.standard_normal((n, k_user), dtype=np.float32)
-        codes = rng.integers(0, n_users, size=n)
+        users = rng.integers(0, n_users, size=n)
+        # raw ids, like production traffic: the dispatch loop pays the
+        # per-batch id->row resolve, so the measured latency includes it
         return [
             ScoreRequest(
                 uid=str(i),
                 indices={"g": gi[i], "u": ui[i]},
                 values={"g": gv[i], "u": uv[i]},
-                codes={"userId": int(codes[i])},
+                entity_ids={"userId": f"user{int(users[i])}"},
             )
             for i in range(n)
         ]

@@ -54,14 +54,19 @@ _NO_LOCK = contextlib.nullcontext()
 
 @dataclass
 class ScoreRequest:
-    """One scoring request, already in device layout: per-shard padded
-    (indices, values) rows at the bank's shard widths, raw entity ids
-    resolved to bank rows at submit time (the O(1) host hash lookup)."""
+    """One scoring request: per-shard padded (indices, values) rows at
+    the bank's shard widths, plus RAW entity ids. Entity ids resolve to
+    bank rows at DISPATCH time, against the bank the batch actually
+    runs on — never at build time. A request is therefore valid across
+    hot swaps: a generation flip that keeps device shapes but changes
+    the entity set (the exact case entity padding preserves) re-resolves
+    every queued and replayed request against the new generation's rows
+    instead of scoring stale ones."""
 
     uid: str
     indices: Dict[str, np.ndarray]  # shard -> int32 [k]
     values: Dict[str, np.ndarray]  # shard -> float32 [k]
-    codes: Dict[str, int]  # id type -> bank row (-1 = unknown entity)
+    entity_ids: Dict[str, Optional[str]]  # id type -> raw id (None = absent)
     offset: float = 0.0
     # passthrough columns for the scores artifact (batch-scorer record
     # layout); never touch the device
@@ -81,7 +86,7 @@ def request_from_record(
     """One raw GameExample-shaped dict -> ScoreRequest through the
     bank's index maps (the stdin/JSON path; the Avro replay path goes
     through :func:`requests_from_dataset` instead)."""
-    from photon_ml_tpu.game.data import record_entity_id, record_response
+    from photon_ml_tpu.game.data import record_response
     from photon_ml_tpu.utils.index_map import feature_key, intercept_key
 
     indices: Dict[str, np.ndarray] = {}
@@ -118,23 +123,26 @@ def request_from_record(
                 pos += 1
         indices[cfg.shard_id] = ix
         values[cfg.shard_id] = vs
-    codes = {
-        t: bank.entity_row(t, record_entity_id(record, t))
-        for t in bank.re_types
-    }
+    # raw ids only — the dispatcher resolves them against whichever
+    # bank generation the batch runs on. A record missing an id type
+    # scores FE-only (unknown-entity semantics), and its key is OMITTED
+    # from metadata (never the literal "None"), matching the dataset
+    # path's records.
+    entity_ids: Dict[str, Optional[str]] = {}
+    for t in bank.re_types:
+        v = record.get(t)
+        if v is None:
+            v = (record.get("metadataMap") or {}).get(t)
+        entity_ids[t] = None if v is None else str(v)
     off = record.get("offset")
     wgt = record.get("weight")
     uid = record.get("uid")
-    meta = {
-        t: str((record.get(t) if record.get(t) is not None
-                else (record.get("metadataMap") or {}).get(t)))
-        for t in bank.re_types
-    }
+    meta = {t: e for t, e in entity_ids.items() if e is not None}
     return ScoreRequest(
         uid="" if uid is None else str(uid),
         indices=indices,
         values=values,
-        codes=codes,
+        entity_ids=entity_ids,
         offset=0.0 if off is None else float(off),
         label=(
             record_response(record, True) if has_response else None
@@ -146,29 +154,33 @@ def request_from_record(
 
 def requests_from_dataset(ds, bank: ModelBank) -> List[ScoreRequest]:
     """Per-row requests from a GameDataset built with the bank's index
-    maps — row slices are views, entity codes are re-resolved against
-    the BANK's entity rows (the dataset's codes index the dataset's own
-    entity table, not the model's)."""
-    # one vectorized id->row resolve per id type, not one hash per row
-    bank_codes: Dict[str, np.ndarray] = {}
-    for t in bank.re_types:
-        ds_codes = ds.entity_codes[t]
-        ids = ds.entity_indexes[t].ids
-        table = bank.entity_rows[t].rows_of(ids) if ids else np.zeros(
-            0, np.int32
-        )
-        resolved = np.full(ds_codes.shape, -1, np.int32)
-        valid = ds_codes >= 0
-        resolved[valid] = table[ds_codes[valid]]
-        bank_codes[t] = resolved
+    maps — row slices are views. Requests carry the RAW entity id
+    strings (the dataset's codes index the dataset's own entity table,
+    not the model's); the dispatcher resolves id -> bank row against
+    whichever generation each batch runs on, so a replayed trace stays
+    correct across hot swaps whose entity sets differ. ``bank`` pins the
+    per-shard widths the AOT program shapes were compiled for."""
+    for sid, k in bank.shard_widths.items():
+        sd = ds.shards.get(sid)
+        if sd is None or sd.indices.shape[1] != k:
+            got = None if sd is None else sd.indices.shape[1]
+            raise ValueError(
+                f"dataset shard {sid!r} width {got!r} != bank request "
+                f"width {k} (the trace must be built at the bank's "
+                "padded layout)"
+            )
     out: List[ScoreRequest] = []
     id_types = sorted(ds.entity_indexes)
     for i in range(ds.num_real_rows):
-        meta = {
-            t: ds.entity_indexes[t].ids[int(ds.entity_codes[t][i])]
+        entity_ids = {
+            t: (
+                ds.entity_indexes[t].ids[int(ds.entity_codes[t][i])]
+                if int(ds.entity_codes[t][i]) >= 0
+                else None
+            )
             for t in id_types
-            if int(ds.entity_codes[t][i]) >= 0
         }
+        meta = {t: e for t, e in entity_ids.items() if e is not None}
         out.append(
             ScoreRequest(
                 uid=ds.uids[i],
@@ -178,9 +190,7 @@ def requests_from_dataset(ds, bank: ModelBank) -> List[ScoreRequest]:
                 values={
                     sid: sd.values[i] for sid, sd in ds.shards.items()
                 },
-                codes={
-                    t: int(bank_codes[t][i]) for t in bank.re_types
-                },
+                entity_ids=entity_ids,
                 offset=float(ds.offsets[i]),
                 label=float(ds.labels[i]),
                 weight=float(ds.weights[i]),
@@ -319,11 +329,22 @@ class MicroBatcher:
                 vs[i] = r.values[sid]
             indices[sid] = ix
             values[sid] = vs
+        # resolve raw entity ids against the bank THIS batch dispatches
+        # on (one vectorized rows_of per id type): requests pre-built or
+        # queued before a hot swap score the new generation's rows, not
+        # stale build-time ones
         codes: Dict[str, np.ndarray] = {}
         for t in bank.re_types:
             c = np.full((B,), -1, np.int32)
+            present: List[int] = []
+            ids: List[str] = []
             for i, r in enumerate(requests):
-                c[i] = r.codes.get(t, -1)
+                e = r.entity_ids.get(t) if r.entity_ids else None
+                if e is not None:
+                    present.append(i)
+                    ids.append(e)
+            if present:
+                c[np.asarray(present)] = bank.entity_rows[t].rows_of(ids)
             codes[t] = c
         offsets = np.zeros((B,), np.float32)
         offsets[:n] = [r.offset for r in requests]

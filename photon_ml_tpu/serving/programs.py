@@ -158,20 +158,54 @@ class ServingPrograms:
         self.ladder: Tuple[int, ...] = tuple(int(b) for b in ladder)
         self._max_entries = max_entries
         self._lock = threading.Lock()
+        # insertion-ordered dict used as an LRU: every hit re-inserts at
+        # the end, so eviction (front pop) drops the coldest entry and
+        # spec churn can never push out the live bank's ladder rungs
         self._cache: Dict[tuple, object] = {}
+        # single-flight guard: key -> Event held by the thread compiling
+        # it, so racing callers wait instead of compiling redundantly
+        self._inflight: Dict[tuple, threading.Event] = {}
         self.compile_count = 0
         self.cold_dispatch_compiles = 0
 
-    def _compile(self, spec, arrays, B: int):
-        exe = _score_jit.lower(
-            spec, _array_structs(arrays), _batch_structs(spec, B)
-        ).compile()
-        with self._lock:
-            while len(self._cache) >= self._max_entries:
-                self._cache.pop(next(iter(self._cache)))
-            self._cache[(spec, B)] = exe
-            self.compile_count += 1
+    def _lru_get(self, key):
+        """Cache lookup + recency touch. Caller holds ``self._lock``."""
+        exe = self._cache.get(key)
+        if exe is not None:
+            self._cache[key] = self._cache.pop(key)
         return exe
+
+    def _get_or_compile(self, spec, arrays, B: int):
+        """Returns ``(executable, freshly_compiled)``. Exactly one
+        thread lowers a given (spec, B); losers of the race wait on the
+        winner's event and take the cached result. If the winner's
+        compile raises, waiters retry (and may compile themselves)."""
+        key = (spec, B)
+        while True:
+            with self._lock:
+                exe = self._lru_get(key)
+                if exe is not None:
+                    return exe, False
+                ev = self._inflight.get(key)
+                if ev is None:
+                    ev = threading.Event()
+                    self._inflight[key] = ev
+                    break
+            ev.wait()
+        try:
+            exe = _score_jit.lower(
+                spec, _array_structs(arrays), _batch_structs(spec, B)
+            ).compile()
+            with self._lock:
+                while len(self._cache) >= self._max_entries:
+                    self._cache.pop(next(iter(self._cache)))
+                self._cache[key] = exe
+                self.compile_count += 1
+            return exe, True
+        finally:
+            with self._lock:
+                self._inflight.pop(key, None)
+            ev.set()
 
     def ensure_compiled(self, bank: ModelBank) -> int:
         """AOT-compile every ladder shape for this bank's signature;
@@ -179,16 +213,13 @@ class ServingPrograms:
         was already warm — the swap-without-recompile case)."""
         fresh = 0
         for B in self.ladder:
-            with self._lock:
-                hit = (bank.spec, B) in self._cache
-            if not hit:
-                self._compile(bank.spec, bank.arrays, B)
-                fresh += 1
+            _, new = self._get_or_compile(bank.spec, bank.arrays, B)
+            fresh += int(new)
         return fresh
 
     def executable(self, spec, B: int):
         with self._lock:
-            return self._cache.get((spec, B))
+            return self._lru_get((spec, B))
 
     def score(self, bank: ModelBank, batch: RequestBatch) -> jnp.ndarray:
         """Device scores for one padded batch (no readback here — the
@@ -201,7 +232,7 @@ class ServingPrograms:
             # after warmup
             with self._lock:
                 self.cold_dispatch_compiles += 1
-            exe = self._compile(bank.spec, bank.arrays, B)
+            exe, _ = self._get_or_compile(bank.spec, bank.arrays, B)
         return exe(bank.arrays, batch)
 
     def stats(self) -> Dict[str, int]:

@@ -5,10 +5,11 @@ The decoupled-acting/learning contract (Podracer, PAPERS.md): model
 publication must never stall the serving loop. Staging therefore does
 ALL the slow work — artifact load (behind the ``serving.model_load``
 reliability seam), dense bank assembly, device placement, AOT program
-warmup for every ladder shape — while the previous generation keeps
-serving. The flip itself is one reference assignment under the manager
-lock; the batcher reads the reference once per dispatch, so a
-generation change lands exactly on a micro-batch boundary.
+warmup for every ladder shape, and (on the donating path) the refresh
+program's own compile — while the previous generation keeps serving.
+The flip itself is one reference assignment under the manager lock; the
+batcher reads the reference once per dispatch, so a generation change
+lands exactly on a micro-batch boundary.
 
 "Zero-copy" is literal on two axes:
 
@@ -17,9 +18,15 @@ generation change lands exactly on a micro-batch boundary.
   coordinate shapes — the overwhelmingly common retrain case, which the
   entity-axis padding in `model_bank` is designed to preserve), staging
   routes the new values through a DONATING refresh program: XLA reuses
-  generation N's buffers for generation N+1's outputs, so device memory
-  holds ~one bank instead of two. The refresh is a bitwise move
+  generation N's buffers for generation N+1's outputs, so steady-state
+  device memory holds one bank (both exist only transiently while the
+  refresh consumes the old one). The refresh is a bitwise move
   (``select`` on a constant predicate), pinned by the swap parity test.
+
+Entity-set changes are safe under a donating swap: requests carry RAW
+entity ids and the batcher resolves them to bank rows per dispatch
+(serving/batcher.py), so a generation whose entity set differs inside
+the same padded bucket never scores stale rows.
 
 A corrupt artifact (decode failure or an injected ``CORRUPT`` at the
 seam) quarantines the model directory to ``*.corrupt`` via the
@@ -80,6 +87,33 @@ def _donating_refresh(old_arrays, new_arrays):
         old_arrays,
         new_arrays,
     )
+
+
+_REFRESH_LOCK = threading.Lock()
+_REFRESH_CACHE: dict = {}
+
+
+def _refresh_executable(arrays):
+    """AOT ``lower().compile()`` of the donating refresh for these
+    array shapes, cached by tree/shape/dtype signature. Staging calls
+    this BEFORE taking ``dispatch_lock``, so the first donating swap
+    pays its compile off the request path and the flip itself stays an
+    all-cache-hit device-to-device select."""
+    leaves, treedef = jax.tree_util.tree_flatten(arrays)
+    key = (
+        treedef,
+        tuple((tuple(a.shape), jnp.dtype(a.dtype).str) for a in leaves),
+    )
+    with _REFRESH_LOCK:
+        exe = _REFRESH_CACHE.get(key)
+    if exe is None:
+        structs = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), arrays
+        )
+        exe = _donating_refresh.lower(structs, structs).compile()
+        with _REFRESH_LOCK:
+            _REFRESH_CACHE[key] = exe
+    return exe
 
 
 @dataclass
@@ -223,14 +257,19 @@ class ServingModel:
         donated = staged.spec == prev.spec
         if donated:
             # same shapes: refresh in place — the old generation's
-            # buffers are donated to the new one's outputs. Exclusive
-            # with dispatch (dispatch_lock): a batch mid-execution must
-            # not have its bank donated out from under it.
+            # buffers are donated to the new one's outputs. ALL slow
+            # work happens before the lock: program warmup (all cache
+            # hits when the spec is warm), host->device placement of the
+            # new values, and the refresh program's own compile
+            # (_refresh_executable, cached across swaps). Only the
+            # refresh call + reference flip run under dispatch_lock —
+            # exclusive with dispatch, because a batch mid-execution
+            # must not have its bank donated out from under it.
+            recompiled = self.programs.ensure_compiled(staged)
+            staged.arrays = place_on_device(staged.arrays)
+            refresh = _refresh_executable(staged.arrays)
             with self.dispatch_lock:
-                staged.arrays = _donating_refresh(
-                    prev.arrays, staged.arrays
-                )
-                recompiled = self.programs.ensure_compiled(staged)
+                staged.arrays = refresh(prev.arrays, staged.arrays)
                 with self._lock:
                     self._bank = staged
                     prev.retired = True

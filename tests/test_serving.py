@@ -162,6 +162,25 @@ class TestScoringParity:
         with pytest.raises(ValueError, match="exceeds shard"):
             request_from_record(fat, bank, SHARDS)
 
+    def test_record_missing_id_omits_metadata_and_scores_fe_only(
+        self, served
+    ):
+        """A record with no resolvable entity id scores FE-only (same as
+        an unknown entity) and its metadataMap OMITS the key — never the
+        literal string "None" — matching the dataset path's records."""
+        recs, ds, lm, bank, programs = served
+        bare = dict(recs[0])
+        bare.pop("metadataMap")
+        req = request_from_record(bare, bank, SHARDS)
+        assert req.entity_ids == {"userId": None}
+        assert req.metadata is None
+        unknown = dict(recs[0])
+        unknown["metadataMap"] = {"userId": "no-such-user"}
+        req_unknown = request_from_record(unknown, bank, SHARDS)
+        assert req_unknown.metadata == {"userId": "no-such-user"}
+        with MicroBatcher(lambda: bank, programs) as mb:
+            assert mb.score(req) == mb.score(req_unknown)
+
 
 class TestEntityRowIndex:
     def test_dict_backend(self):
@@ -235,6 +254,58 @@ class TestLadder:
                 f.result()
         snap = metrics.snapshot()
         assert snap["dispatches"] < len(reqs)
+
+
+class TestProgramCache:
+    def _bank(self, rng, d):
+        from photon_ml_tpu.serving import bank_from_arrays
+
+        return bank_from_arrays(
+            fixed=[(
+                "global", "g",
+                rng.standard_normal(d).astype(np.float32),
+            )],
+            shard_widths={"g": 4},
+        )
+
+    def test_eviction_is_lru_not_fifo(self, rng):
+        """Eviction under spec churn drops the COLDEST entry: a rung
+        the live bank just used survives insertions from another spec
+        (FIFO would evict it and force a hot-path recompile)."""
+        bank_a = self._bank(rng, 16)
+        bank_b = self._bank(rng, 32)
+        programs = ServingPrograms((1, 8), max_entries=3)
+        programs.ensure_compiled(bank_a)
+        # touch (spec_a, 1): now the most recently used entry
+        assert programs.executable(bank_a.spec, 1) is not None
+        programs.ensure_compiled(bank_b)  # 4th insert evicts ONE entry
+        assert programs.executable(bank_a.spec, 1) is not None, (
+            "LRU must keep the just-used rung"
+        )
+        assert programs.executable(bank_a.spec, 8) is None, (
+            "the untouched rung is the eviction victim"
+        )
+
+    def test_concurrent_warmup_compiles_each_shape_once(self, rng):
+        """ensure_compiled is single-flight per (spec, shape): racing
+        threads never compile the same program twice."""
+        bank = self._bank(rng, 16)
+        programs = ServingPrograms((1, 8, 64))
+        errors = []
+
+        def warm():
+            try:
+                programs.ensure_compiled(bank)
+            except BaseException as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=warm) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert programs.stats()["compile_count"] == 3
 
 
 class TestMicroBatchDemux:
@@ -540,6 +611,77 @@ class TestHotSwap:
             install_plan(None)
         assert res.ok and res.generation == 2
         assert retry_stats()["retries"].get("serving.model_load", 0) >= 1
+
+    def test_entity_set_change_resolves_rows_at_dispatch(self, rng):
+        """The case entity padding exists for: generation 2 adds an
+        entity inside the same padded bucket, and the new id sorts
+        BEFORE existing ones so every bank row shifts. The swap is
+        donated (same spec), yet requests built BEFORE the swap — both
+        the dataset-replay path and the stdin path — must score
+        generation 2 bitwise: entity ids resolve to bank rows at
+        dispatch time, never at request-build time."""
+        recs = synth_records(rng)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        lm1 = synth_model(rng)
+        lm2 = synth_model(rng, scale=-1.5)
+        # "user00" sorts between "user0" and "user1": rows of
+        # user1..user5 all shift by one in generation 2's bank
+        lm2.random_effects["per-user"][2]["user00"] = {
+            "u0\t": 3.0, "u1\t": -2.0, "u2\t": 1.0
+        }
+        bank1 = make_bank(lm1, ds)
+        sm = ServingModel(bank1, ServingPrograms((1, 8, 64)))
+        ref1 = batch_reference_scores(lm1, ds)
+        ref2 = batch_reference_scores(lm2, ds)
+        reqs = requests_from_dataset(ds, bank1)  # pre-built, gen 1
+        stdin_reqs = [
+            request_from_record(recs[i], bank1, SHARDS) for i in (1, 9)
+        ]
+        imaps = {sid: sd.index_map for sid, sd in ds.shards.items()}
+        widths = {sid: sd.indices.shape[1] for sid, sd in ds.shards.items()}
+        staged = build_model_bank(lm2, imaps, widths, device=False)
+        with MicroBatcher(sm.current, sm.programs) as mb:
+            for i in range(3):
+                assert mb.score(reqs[i]) == ref1[i]
+            res = sm.swap_to_bank(staged)
+            assert res.ok and res.generation == 2
+            assert res.donated, "same padded bucket must stay donated"
+            assert res.recompiled_programs == 0
+            got = np.asarray(
+                [mb.score(r) for r in reqs], np.float32
+            )
+            assert np.array_equal(got, ref2), (
+                "pre-swap requests scored stale bank rows"
+            )
+            for req, i in zip(stdin_reqs, (1, 9)):
+                assert mb.score(req) == ref2[i]
+
+    def test_second_donated_swap_lowers_nothing(self, rng):
+        """After the first donating swap compiles the refresh program
+        (during staging, OFF the request path), further same-shape swaps
+        are all-cache-hit: zero lowerings, including the refresh."""
+        import jax._src.test_util as jtu
+
+        recs = synth_records(rng)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        imaps = {sid: sd.index_map for sid, sd in ds.shards.items()}
+        widths = {sid: sd.indices.shape[1] for sid, sd in ds.shards.items()}
+        sm = ServingModel(
+            make_bank(synth_model(rng), ds), ServingPrograms((1, 8))
+        )
+        sm.swap_to_bank(
+            build_model_bank(synth_model(rng, scale=2.0), imaps, widths,
+                             device=False)
+        )
+        staged = build_model_bank(
+            synth_model(rng, scale=-3.0), imaps, widths, device=False
+        )
+        with jtu.count_jit_and_pmap_lowerings() as count:
+            res = sm.swap_to_bank(staged)
+        assert res.ok and res.donated
+        assert count[0] == 0, (
+            f"donated swap lowered {count[0]} program(s) after warmup"
+        )
 
     def test_exhausted_load_budget_rolls_back(self, two_generations):
         from photon_ml_tpu.reliability import install_plan
