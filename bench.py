@@ -77,6 +77,12 @@ def main():
         # the serving A/B is host+transfer-side too: latency/QPS at the
         # CPU-scaled shapes, plus the zero-recompile contract numbers
         result["detail"]["serving"] = _serving_config("serving")["detail"]
+        # overload discipline is host-side by construction (admission,
+        # shed, deadline drops, bounded drain): the contract numbers
+        # belong in the round artifact even with the tunnel down
+        result["detail"]["overload"] = _overload_config(
+            "overload"
+        )["detail"]
         result["detail"]["note"] = (
             "CPU-only host (accelerator unreachable); kernel-path "
             "microbench and BASELINE suite skipped — see the last "
@@ -1722,6 +1728,214 @@ def _serving_config(name, *, seed=0):
     }
 
 
+def _overload_config(name, *, seed=0):
+    """Serving-under-fire bench (ISSUE 8): an open-loop flood PAST
+    capacity through the admission-controlled micro-batcher.
+
+    Unlike ``10_serving``'s closed-loop submitters (which self-pace to
+    the service rate), this section fires ``n_flood`` requests with a
+    tight ``deadline_ms`` from ``flood_threads`` threads as fast as
+    they can — deliberately more offered load than the device can
+    absorb. The service's job is NOT to finish them all; it is to
+
+    - give EVERY submitted request exactly one terminal outcome
+      (scored, SHED, DEADLINE_EXCEEDED) — counted here, gated by
+      ``dev-scripts/bench_overload.sh``;
+    - keep the ADMITTED requests' p99 bounded (shedding is what buys
+      this: an unbounded queue converts overload into unbounded p99);
+    - lower ZERO programs on the request path while overloaded;
+    - then drain a parting burst inside ``drain_timeout_s`` with no
+      hung futures (the SIGTERM protocol, timed).
+    """
+    import threading
+
+    import jax
+    import jax._src.test_util as jtu
+
+    from photon_ml_tpu.serving import (
+        DeadlineExceeded,
+        MicroBatcher,
+        RequestShed,
+        ScoreRequest,
+        ServingError,
+        ServingMetrics,
+        ServingPrograms,
+        bank_from_arrays,
+    )
+
+    on_chip = any(p.platform != "cpu" for p in jax.devices())
+    if on_chip:
+        d_fixed, n_users, d_user = 1 << 20, 600_000, 1000
+        k_fixed, k_user = 64, 32
+        n_flood, flood_threads = 20_000, 64
+        deadline_ms, max_queue = 5.0, 8192
+        shape_note = "config-5 FE/RE shapes (1M dims, 600k users x 1000)"
+    else:
+        d_fixed, n_users, d_user = 1 << 15, 2_000, 32
+        k_fixed, k_user = 16, 8
+        n_flood, flood_threads = 3_000, 16
+        deadline_ms, max_queue = 25.0, 2048
+        shape_note = "CPU-scaled shapes (32k dims, 2k users x 32)"
+    drain_timeout_s = float(
+        os.environ.get("PHOTON_OVERLOAD_DRAIN_TIMEOUT_S", "5")
+    )
+    drain_burst = 256
+
+    rng = np.random.default_rng(seed)
+    bank = bank_from_arrays(
+        fixed=[(
+            "global", "g",
+            rng.standard_normal(d_fixed, dtype=np.float32) * 0.1,
+        )],
+        random=[(
+            "per-user", "userId", "u",
+            rng.standard_normal((n_users, d_user), dtype=np.float32) * 0.1,
+            [f"user{i}" for i in range(n_users)],
+        )],
+        shard_widths={"g": k_fixed, "u": k_user},
+    )
+    programs = ServingPrograms()
+    programs.ensure_compiled(bank)
+
+    def make_requests(n, deadline):
+        gi = rng.integers(0, d_fixed, size=(n, k_fixed)).astype(np.int32)
+        gv = rng.standard_normal((n, k_fixed), dtype=np.float32)
+        ui = rng.integers(0, d_user, size=(n, k_user)).astype(np.int32)
+        uv = rng.standard_normal((n, k_user), dtype=np.float32)
+        users = rng.integers(0, n_users, size=n)
+        return [
+            ScoreRequest(
+                uid=str(i),
+                indices={"g": gi[i], "u": ui[i]},
+                values={"g": gv[i], "u": uv[i]},
+                entity_ids={"userId": f"user{int(users[i])}"},
+                deadline_ms=deadline,
+            )
+            for i in range(n)
+        ]
+
+    metrics = ServingMetrics()
+    compiles_before = programs.stats()["compile_count"]
+    outcomes = {}
+    out_lock = threading.Lock()
+
+    def note(outcome):
+        with out_lock:
+            outcomes[outcome] = outcomes.get(outcome, 0) + 1
+
+    with jtu.count_jit_and_pmap_lowerings() as lowerings:
+        batcher = MicroBatcher(
+            lambda: bank, programs, metrics, max_queue=max_queue
+        )
+        reqs = make_requests(n_flood, deadline_ms)
+        it = iter(reqs)
+        it_lock = threading.Lock()
+        futures = []
+        fut_lock = threading.Lock()
+
+        def flood():
+            # TRUE open loop: submit as fast as admission allows, never
+            # wait for results — offered load exceeds capacity by
+            # construction
+            while True:
+                with it_lock:
+                    r = next(it, None)
+                if r is None:
+                    return
+                try:
+                    fut = batcher.submit(r)
+                except RequestShed:
+                    note("shed")
+                    continue
+                except ServingError as e:
+                    note(f"error:{e.code}")
+                    continue
+                with fut_lock:
+                    futures.append(fut)
+
+        threads = [
+            threading.Thread(target=flood) for _ in range(flood_threads)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        flood_submit_s = time.perf_counter() - t0
+        for fut in futures:
+            try:
+                fut.result(timeout=60.0)
+                note("ok")
+            except DeadlineExceeded:
+                note("deadline_exceeded")
+            except ServingError as e:
+                note(f"error:{e.code}")
+        flood_wall_s = time.perf_counter() - t0
+
+        # -- drain phase: a parting burst, then the bounded SIGTERM
+        # drain — zero hung futures inside the budget ------------------
+        burst = make_requests(drain_burst, None)
+        burst_futs = []
+        burst_refused = 0
+        for r in burst:
+            try:
+                burst_futs.append(batcher.submit(r))
+            except ServingError:
+                burst_refused += 1
+        report = batcher.drain(drain_timeout_s)
+        burst_terminal = sum(1 for f in burst_futs if f.done())
+
+    snap = metrics.snapshot()
+    stats = programs.stats()
+    terminal = sum(outcomes.values())
+    refused = outcomes.get("shed", 0) + outcomes.get("deadline_exceeded", 0)
+    shed_rate = round(refused / n_flood, 6)
+    return {
+        "config": name,
+        "metric": "overload_shed_rate",
+        "value": shed_rate,
+        "unit": "refused/submitted under 0-pacing flood (details gated)",
+        "detail": {
+            "device": str(jax.devices()[0]),
+            "host": {"cpu_count": os.cpu_count(), "on_chip": on_chip},
+            "shape_note": shape_note,
+            "deadline_ms": deadline_ms,
+            "max_queue": max_queue,
+            "flood": {
+                "submitted": n_flood,
+                "threads": flood_threads,
+                "submit_wall_s": round(flood_submit_s, 3),
+                "wall_s": round(flood_wall_s, 3),
+                "outcomes": dict(sorted(outcomes.items())),
+                "terminal": terminal,
+                "ok": outcomes.get("ok", 0),
+                "refused": refused,
+                "shed_rate": shed_rate,
+                "sheds_by_reason": snap["sheds"],
+                "deadline_expired_at_dispatch": snap["deadline_expired"],
+                "admitted_p50_ms": snap.get("latency_p50_ms"),
+                "admitted_p99_ms": snap.get("latency_p99_ms"),
+                "dispatches": snap["dispatches"],
+                "batch_occupancy_mean": snap["batch_occupancy_mean"],
+            },
+            "drain": {
+                **report.to_dict(),
+                "burst": drain_burst,
+                "burst_admitted": len(burst_futs),
+                "burst_refused": burst_refused,
+                "burst_terminal": burst_terminal,
+                "budget_s": drain_timeout_s,
+            },
+            "request_path_lowerings": int(lowerings[0]),
+            "recompiles_after_warmup": (
+                stats["compile_count"] - compiles_before
+            ),
+            "cold_dispatch_compiles": stats["cold_dispatch_compiles"],
+            "data": "synthetic bank + synthetic open-loop flood",
+        },
+    }
+
+
 def _regen_with_model(rng, n, d, k, w_true, gen_task, noise=0.5):
     """Draw a dataset from a GIVEN planted model (shared generator for the
     train set and its held-out split)."""
@@ -2208,6 +2422,13 @@ def suite(only=None):
         results.append(_serving_config("10_serving"))
         print(json.dumps(results[-1]), flush=True)
 
+    # 11: serving under fire (ISSUE 8): open-loop flood past capacity
+    # through admission control — shed rate, admitted p99, bounded
+    # drain; gates in dev-scripts/bench_overload.sh.
+    if want("11_overload"):
+        results.append(_overload_config("11_overload"))
+        print(json.dumps(results[-1]), flush=True)
+
     path = "BASELINE_RESULTS.json"
     merged = {}
     if only is not None and os.path.exists(path):
@@ -2249,6 +2470,10 @@ if __name__ == "__main__":
         # dev-scripts/bench_serving.sh entry: the online-scoring bench
         # as one JSON line (gates applied by the script)
         print(json.dumps(_serving_config("serving")))
+    elif "--overload" in sys.argv:
+        # dev-scripts/bench_overload.sh entry: the serving-under-fire
+        # flood as one JSON line (gates applied by the script)
+        print(json.dumps(_overload_config("overload")))
     elif "--reliability" in sys.argv:
         # dev-scripts/chaos.sh entry: the seam-overhead A/B as one JSON
         # line (the <2% gate is applied by the script)

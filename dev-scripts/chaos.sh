@@ -1,8 +1,11 @@
 #!/usr/bin/env bash
-# Chaos harness (round 11, reliability layer): a tier-1-sized fault
-# matrix — one injected fault per seam class (chunk read, spill
-# write/read, cache load/store, checkpoint save, async IO worker) —
-# driven end-to-end through the GLM and GAME drivers, asserting:
+# Chaos harness (round 11, reliability layer; serving-under-fire arms
+# added round 13): a tier-1-sized fault matrix — one injected fault per
+# seam class (chunk read, spill write/read, cache load/store,
+# checkpoint save, async IO worker, serving model-load/frontend-read/
+# dispatch) — driven end-to-end through the GLM, GAME and serving
+# drivers (replay, stdin deadline mix, and the TCP front-end under
+# flood + mid-flood swap + SIGTERM drain), asserting:
 #
 #   1. every faulted run COMPLETES (transient faults retry; corrupt
 #      cache artifacts quarantine to *.corrupt and rebuild);

@@ -32,6 +32,19 @@ per-arm):
    ``*.corrupt``, the swap ROLLS BACK, the run completes on generation
    1 with scores bitwise-equal arm 6, and metrics.json accounts the
    quarantine + rollback.
+9. **Serving overload (stdin deadlines)** — the same trace replayed as
+   JSON lines with every 3rd request carrying an already-expired
+   deadline: every request reaches exactly ONE terminal outcome
+   (ok/deadline_exceeded, conserved), the admitted scores are bitwise
+   arm 6's, and the dropped rows never reach the device.
+10. **Frontend under fire** — the real TCP front-end flooded over a
+    socket with injected read + dispatch faults, a mid-flood hot swap,
+    expired-deadline requests, a malformed client, a stalled
+    (half-line) slow client, and an operator RE quarantine — every
+    request one terminal response, non-degraded scores bitwise arm 6,
+    degraded scores bitwise the FE-only batch reference, and SIGTERM
+    drains to exit 0 with zero hung futures and zero leaked
+    connections.
 
 Every asserted invariant is printed; any failure exits non-zero.
 """
@@ -40,9 +53,12 @@ import filecmp
 import json
 import os
 import shutil
+import signal
+import socket
 import subprocess
 import sys
 import tempfile
+import time
 
 import numpy as np
 
@@ -68,11 +84,12 @@ def log(msg):
     print(f"[chaos] {msg}", flush=True)
 
 
-def run(cmd, **env):
+def run(cmd, stdin_text=None, **env):
     e = {**os.environ, "JAX_PLATFORMS": "cpu",
          "PHOTON_RETRY_BASE_S": "0.002", **env}
     r = subprocess.run(
-        cmd, cwd=REPO, env=e, capture_output=True, text=True, timeout=900
+        cmd, cwd=REPO, env=e, capture_output=True, text=True, timeout=900,
+        input=stdin_text,
     )
     if r.returncode != 0:
         sys.exit(
@@ -288,6 +305,295 @@ def serving_args(train, model_dir, out, plan=None, swap_dir=None):
     return args
 
 
+# -- serving-under-fire arms (ISSUE 8) ---------------------------------------
+
+FRONTEND_PLAN = "serving.frontend.read:5:EIO,serving.dispatch:3:EIO"
+
+
+def write_name_term_lists(nt_dir):
+    """Prebuilt feature vocabularies for the stdin/front-end request
+    sources (a request stream has no dataset to build maps from)."""
+    from photon_ml_tpu.io.name_term_list import (
+        save_name_and_term_feature_sets,
+    )
+
+    save_name_and_term_feature_sets(
+        {
+            "features": {f"g{j}\t" for j in range(5)},
+            "userFeatures": {f"u{j}\t" for j in range(3)},
+        },
+        nt_dir,
+    )
+
+
+def trace_json_records(train_dir):
+    from photon_ml_tpu.io.avro_codec import read_avro_records
+
+    return [
+        {
+            k: r[k]
+            for k in ("uid", "response", "metadataMap", "features",
+                      "userFeatures")
+        }
+        for r in read_avro_records(train_dir)
+    ]
+
+
+def scores_by_uid(scores_dir):
+    from photon_ml_tpu.io.avro_codec import read_avro_records
+
+    return {
+        r["uid"]: r["predictionScore"]
+        for r in read_avro_records(scores_dir)
+    }
+
+
+def fe_only_model_copy(model_dir, dst):
+    """The batch scorer's FE-only path, as an artifact: the same model
+    with its random-effect coordinates removed."""
+    shutil.copytree(model_dir, dst)
+    shutil.rmtree(os.path.join(dst, "random-effect"))
+    return dst
+
+
+def stream_serving_args(model_dir, out, nt_dir):
+    return [
+        sys.executable, "-m", "photon_ml_tpu.cli.serving_driver",
+        "--game-model-input-dir", model_dir,
+        "--output-dir", out,
+        "--feature-shard-id-to-feature-section-keys-map",
+        "globalShard:features|userShard:userFeatures",
+        "--feature-name-and-term-set-path", nt_dir,
+        "--request-nnz-width", "globalShard:6|userShard:4",
+        "--ladder", "1,8,64",
+        "--delete-output-dir-if-exists", "true",
+    ]
+
+
+def serving_overload_arm(base, game_train, model_dir, nt_dir, clean_scores):
+    """Arm 9: deadline-mixed stdin replay — exact outcome conservation,
+    dropped-before-dispatch, bitwise-subset scores."""
+    out = os.path.join(base, "serving-stdin-overload")
+    records = trace_json_records(game_train)
+    lines = []
+    expired_uids = set()
+    for i, obj in enumerate(records):
+        if i % 3 == 2:
+            # an already-expired client deadline: admission accepts it
+            # (empty-queue prediction is 0) and the dispatcher MUST
+            # drop it before the device sees it
+            obj = {**obj, "deadline_ms": 1e-4}
+            expired_uids.add(obj["uid"])
+        lines.append(json.dumps(obj))
+    args = stream_serving_args(model_dir, out, nt_dir)
+    args += ["--request-paths", "-"]
+    run(args, stdin_text="\n".join(lines) + "\n")
+    log("serving overload (stdin deadlines) arm completed")
+    m = json.load(open(os.path.join(out, "metrics.json")))
+    outcomes = m["outcomes"]
+    assert outcomes.get("deadline_exceeded", 0) == len(expired_uids), (
+        outcomes, len(expired_uids)
+    )
+    assert outcomes.get("ok", 0) == len(records) - len(expired_uids), (
+        outcomes
+    )
+    assert sum(outcomes.values()) == len(records), outcomes
+    assert m["serving"]["deadline_expired"] == len(expired_uids)
+    assert m["interrupted"] is False
+    got = scores_by_uid(os.path.join(out, "scores"))
+    assert set(got) == set(clean_scores) - expired_uids, (
+        "admitted set must be exactly the non-expired trace rows"
+    )
+    mismatched = [u for u, s in got.items() if s != clean_scores[u]]
+    assert not mismatched, f"admitted scores differ: {mismatched[:5]}"
+    log(
+        f"serving overload: {outcomes['ok']} ok bitwise-equal clean arm, "
+        f"{outcomes['deadline_exceeded']} dropped before dispatch, "
+        "outcomes conserved"
+    )
+
+
+class _Wire:
+    """One JSON-lines client connection for the front-end arm."""
+
+    def __init__(self, port, timeout=60.0):
+        self.sock = socket.create_connection(
+            ("127.0.0.1", port), timeout=timeout
+        )
+        self.reader = self.sock.makefile("rb")
+
+    def send(self, obj_or_bytes):
+        data = (
+            obj_or_bytes if isinstance(obj_or_bytes, bytes)
+            else (json.dumps(obj_or_bytes) + "\n").encode()
+        )
+        self.sock.sendall(data)
+
+    def recv(self):
+        line = self.reader.readline()
+        return json.loads(line) if line else None
+
+    def ask(self, obj):
+        self.send(obj)
+        return self.recv()
+
+    def close(self):
+        try:
+            self.reader.close()
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def frontend_under_fire_arm(
+    base, game_train, model_dir, nt_dir, clean_scores, fe_scores
+):
+    """Arm 10: the TCP front-end under flood + faults + mid-flood swap
+    + deadline drops + malformed/slow clients + RE quarantine, then a
+    SIGTERM drain. See the module docstring for the invariants."""
+    out = os.path.join(base, "serving-frontend-out")
+    swap_copy = os.path.join(base, "frontend-swap-gen2")
+    shutil.copytree(model_dir, swap_copy)
+    args = stream_serving_args(model_dir, out, nt_dir) + [
+        "--frontend-port", "0",
+        "--drain-timeout", "20",
+        "--swap-model-dir", swap_copy,
+        "--swap-after-requests", "30",
+        "--fault-plan", FRONTEND_PLAN,
+    ]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PHOTON_RETRY_BASE_S": "0.002"}
+    proc = subprocess.Popen(
+        args, cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        fj = os.path.join(out, "frontend.json")
+        deadline = time.time() + 240
+        while not os.path.exists(fj):
+            assert proc.poll() is None, proc.communicate()[0][-4000:]
+            assert time.time() < deadline, "front-end never came up"
+            time.sleep(0.1)
+        port = json.load(open(fj))["port"]
+
+        records = trace_json_records(game_train)[:150]
+        # line 5 (1-based) takes the planned read fault; every 10th
+        # record (offset 7) carries an expired deadline
+        fault_idx = 4
+        deadline_idx = {
+            i for i in range(len(records)) if i % 10 == 7
+        } - {fault_idx}
+
+        main_c = _Wire(port)
+        n_ok = 0
+        generations = set()
+        for i, rec in enumerate(records):
+            obj = (
+                {**rec, "deadline_ms": 1e-4} if i in deadline_idx else rec
+            )
+            resp = main_c.ask(obj)
+            if i == fault_idx:
+                assert resp["status"] == "error", (i, resp)
+                assert resp["error"] == "READ_FAULT", resp
+            elif i in deadline_idx:
+                assert resp["status"] == "deadline_exceeded", (i, resp)
+            else:
+                assert resp["status"] == "ok", (i, resp)
+                assert resp["degraded"] is False, resp
+                assert resp["score"] == clean_scores[rec["uid"]], (
+                    i, resp["score"], clean_scores[rec["uid"]],
+                )
+                generations.add(resp["generation"])
+                n_ok += 1
+        log(
+            f"frontend flood: {n_ok} ok bitwise-equal clean arm, "
+            f"{len(deadline_idx)} deadline drops, 1 read fault, "
+            f"generations {sorted(generations)}"
+        )
+
+        # the mid-flood swap ran in the background; wait for the flip,
+        # then prove post-swap traffic is still bitwise (donated,
+        # same-content generation 2)
+        deadline = time.time() + 60
+        while True:
+            status = main_c.ask({"op": "status"})
+            if status["generation"] == 2:
+                break
+            assert time.time() < deadline, (
+                f"mid-flood swap never landed: {status}"
+            )
+            time.sleep(0.1)
+        for rec in records[:3]:
+            resp = main_c.ask(rec)
+            assert resp["status"] == "ok" and resp["generation"] == 2
+            assert resp["score"] == clean_scores[rec["uid"]], resp
+        log("mid-flood swap: generation 2 serving, scores still bitwise")
+
+        # malformed client: named error, no crash
+        bad_c = _Wire(port)
+        resp = bad_c.ask(b"this is not json\n")
+        assert resp["status"] == "error" and resp["error"] == "BAD_REQUEST"
+        bad_c.close()
+
+        # slow client: half a line, never completed — must not wedge
+        # the drain below
+        slow_c = _Wire(port)
+        slow_c.send(b'{"uid": "stalled')
+
+        # operator quarantine: degraded responses, bitwise the batch
+        # scorer's FE-only path
+        resp = main_c.ask({"op": "quarantine_re", "re_type": "userId"})
+        assert resp["status"] == "ok", resp
+        degraded_uids = []
+        for rec in records[:10]:
+            resp = main_c.ask(rec)
+            assert resp["status"] == "ok" and resp["degraded"] is True
+            assert resp["score"] == fe_scores[rec["uid"]], (
+                resp["score"], fe_scores[rec["uid"]],
+            )
+            degraded_uids.append(rec["uid"])
+        log(
+            f"quarantined RE: {len(degraded_uids)} degraded responses "
+            "bitwise-equal the FE-only batch reference"
+        )
+
+        # SIGTERM: drained exit 0, zero hung futures, zero leaks
+        proc.send_signal(signal.SIGTERM)
+        stdout, _ = proc.communicate(timeout=120)
+        assert proc.returncode == 0, stdout[-4000:]
+        assert main_c.recv() is None, "client must observe EOF"
+        main_c.close()
+        slow_c.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=60)
+
+    m = json.load(open(os.path.join(out, "metrics.json")))
+    assert m["interrupted"] is True
+    assert m["leaked_connections"] == 0, m["leaked_connections"]
+    assert m["drain"]["timed_out"] is False, m["drain"]
+    srv = m["serving"]
+    assert srv["frontend"]["malformed"] >= 1
+    assert srv["frontend"]["read_faults"] == 1
+    assert srv["frontend"]["connections_opened"] >= 3
+    assert srv["deadline_expired"] == len(deadline_idx)
+    assert srv["degraded_responses"] == len(degraded_uids)
+    swaps = m["swap_history"]
+    assert len(swaps) == 1 and swaps[0]["ok"] and swaps[0]["donated"], swaps
+    rel = m["reliability"]
+    assert rel["faults"]["injected"].get("serving.frontend.read", 0) == 1
+    assert rel["faults"]["injected"].get("serving.dispatch", 0) >= 1
+    assert rel["retries"]["retries"].get("serving.dispatch", 0) >= 1, (
+        "the injected dispatch fault must be absorbed by a retry"
+    )
+    log(
+        "frontend under fire: SIGTERM drained exit 0, 0 hung futures, "
+        "0 leaked connections, dispatch fault retried bitwise, "
+        "accounting complete"
+    )
+
+
 def main():
     base = tempfile.mkdtemp(prefix="photon-chaos-")
     try:
@@ -404,6 +710,27 @@ def main():
         assert_trees_bitwise_equal(
             os.path.join(sout1, "scores"), os.path.join(sout3, "scores"),
             "serving swap-rollback scores",
+        )
+
+        # -- serving-under-fire arms (ISSUE 8) ----------------------------
+        nt_dir = os.path.join(base, "name-terms")
+        write_name_term_lists(nt_dir)
+        clean_scores = scores_by_uid(os.path.join(sout1, "scores"))
+        serving_overload_arm(
+            base, game_train, model_dir, nt_dir, clean_scores
+        )
+        # FE-only reference scores: the SAME model with its RE
+        # coordinates removed, replayed clean — what a degraded
+        # response must reproduce bitwise
+        fe_model = fe_only_model_copy(
+            model_dir, os.path.join(base, "fe-only-model")
+        )
+        fout = os.path.join(base, "serving-fe-only-out")
+        run(serving_args(game_train, fe_model, fout))
+        log("serving FE-only reference arm completed")
+        fe_scores = scores_by_uid(os.path.join(fout, "scores"))
+        frontend_under_fire_arm(
+            base, game_train, model_dir, nt_dir, clean_scores, fe_scores
         )
         log("chaos matrix: PASS")
     finally:

@@ -1,15 +1,18 @@
 """Online scoring driver: load a GAME model into a device-resident bank
 and serve score requests through the micro-batched request path.
 
-The request source is a replayed trace — an Avro file/dir (the batch
-scoring driver's own input format, which is what makes serving-vs-batch
-bitwise parity a one-line diff) or JSON lines on stdin — so the driver
-exercises the full serving stack (bank, AOT ladder, batcher, hot swap,
-metrics) with no network dependency. A production front-end would
-replace the trace reader with a socket accept loop; everything behind
-``MicroBatcher.submit`` stays the same.
+Three request sources:
 
-Two load modes:
+- a replayed Avro trace (the batch scoring driver's own input format,
+  which is what makes serving-vs-batch bitwise parity a one-line diff);
+- JSON lines on stdin (``--request-paths -``);
+- a real TCP network front-end (``--frontend-port``): the JSON-lines
+  accept loop from :mod:`photon_ml_tpu.serving.frontend`, with
+  admission control, deadlines, readiness/liveness status requests and
+  a SIGTERM drain protocol. The bound port (0 = ephemeral) is published
+  to ``<output-dir>/frontend.json``.
+
+Two replay load modes:
 
 - ``closed`` (default): one request in flight at a time — the
   single-request latency floor (every dispatch is shape 1).
@@ -20,6 +23,12 @@ Two load modes:
 ``--swap-model-dir`` stages a second model generation and flips it
 after ``--swap-after-requests`` completions, under live traffic — the
 hot-swap demonstration the chaos matrix drives with fault plans.
+
+Lifecycle: SIGTERM (or Ctrl-C) anywhere stops admitting, drains the
+batcher within ``--drain-timeout`` (leftover futures fail with the
+named ``DRAIN_TIMEOUT`` outcome — never a hang), drains async IO, and
+writes metrics.json with an ``interrupted`` marker so a partial run
+still accounts for everything it did.
 """
 
 from __future__ import annotations
@@ -27,6 +36,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import sys
 import threading
 from dataclasses import dataclass, field
@@ -83,18 +93,41 @@ class ServingParams:
     application_name: str = "photon-ml-tpu-serving"
     no_overlap: bool = False
     fault_plan: Optional[str] = None
+    # Network front-end (ISSUE 8): serve over a TCP JSON-lines socket
+    # instead of replaying a trace. 0 = ephemeral port, published to
+    # <output-dir>/frontend.json.
+    frontend_host: str = "127.0.0.1"
+    frontend_port: Optional[int] = None
+    # SIGTERM drain budget: pending requests past it fail with the
+    # named DRAIN_TIMEOUT outcome — zero hung futures.
+    drain_timeout_s: float = 10.0
+    # Admission default: requests that carry no deadline_ms of their
+    # own get this one (None = no deadline).
+    default_deadline_ms: Optional[float] = None
 
     @property
     def stdin_mode(self) -> bool:
         return self.request_paths == ["-"]
+
+    @property
+    def frontend_mode(self) -> bool:
+        return self.frontend_port is not None
 
     def validate(self) -> None:
         if not self.game_model_input_dir:
             raise ValueError("game-model-input-dir is required")
         if not self.output_dir:
             raise ValueError("output-dir is required")
-        if not self.request_paths:
-            raise ValueError("request-paths is required ('-' for stdin)")
+        if not self.request_paths and not self.frontend_mode:
+            raise ValueError(
+                "request-paths is required ('-' for stdin) unless "
+                "--frontend-port starts the network front-end"
+            )
+        if self.frontend_mode and self.request_paths:
+            raise ValueError(
+                "choose ONE request source: --request-paths (replay) or "
+                "--frontend-port (network front-end)"
+            )
         if not self.feature_shards:
             raise ValueError("feature shard configuration is required")
         if self.mode not in ("closed", "open"):
@@ -107,20 +140,33 @@ class ServingParams:
             raise ValueError(
                 "swap-model-dir requires --swap-after-requests >= 1"
             )
-        if self.stdin_mode:
+        if self.drain_timeout_s <= 0:
+            raise ValueError(
+                f"drain-timeout must be > 0, got {self.drain_timeout_s}"
+            )
+        if (
+            self.default_deadline_ms is not None
+            and self.default_deadline_ms <= 0
+        ):
+            raise ValueError(
+                "default-deadline-ms must be > 0 when set, got "
+                f"{self.default_deadline_ms}"
+            )
+        if self.stdin_mode or self.frontend_mode:
+            source = "stdin" if self.stdin_mode else "front-end"
             if not (
                 self.offheap_indexmap_dir
                 or self.feature_name_and_term_set_path
             ):
                 raise ValueError(
-                    "stdin serving requires prebuilt feature maps "
+                    f"{source} serving requires prebuilt feature maps "
                     "(--offheap-indexmap-dir or "
                     "--feature-name-and-term-set-path): a request stream "
                     "has no vocabulary to build from"
                 )
             if not self.request_nnz_width:
                 raise ValueError(
-                    "stdin serving requires --request-nnz-width (the "
+                    f"{source} serving requires --request-nnz-width (the "
                     "fixed per-shard feature width baked into the AOT "
                     "program shapes)"
                 )
@@ -163,6 +209,13 @@ class ServingDriver:
         self.serving_model = None
         self.metrics = None
         self.results: List[float] = []
+        # replay interrupt machinery (satellite: SIGTERM/Ctrl-C writes
+        # partial accounting instead of losing it)
+        self._stop_replay = threading.Event()
+        self._closed_scored: List[tuple] = []
+        self._open_results: Dict[int, tuple] = {}
+        self.drain_report = None
+        self.interrupted = False
 
     # -- setup ---------------------------------------------------------------
 
@@ -215,7 +268,7 @@ class ServingDriver:
         index_maps = self._prebuilt_index_maps()
         requests = None
         dataset = None
-        if p.stdin_mode:
+        if p.stdin_mode or p.frontend_mode:
             widths = _parse_widths(
                 p.request_nnz_width,
                 [cfg.shard_id for cfg in p.feature_shards],
@@ -274,7 +327,7 @@ class ServingDriver:
         if dataset is not None:
             with self.timer.time("assemble-requests"):
                 requests = requests_from_dataset(dataset, bank)
-        else:
+        elif p.stdin_mode:
             def stdin_requests():
                 for line in sys.stdin:
                     line = line.strip()
@@ -288,6 +341,7 @@ class ServingDriver:
                     )
 
             requests = stdin_requests()
+        # frontend mode: requests arrive over the socket, not here
         return requests
 
     # -- replay --------------------------------------------------------------
@@ -315,11 +369,42 @@ class ServingDriver:
                 f" quarantined={res.quarantined}" if res.quarantined else "",
             )
 
+    def _score_one(self, batcher, req) -> tuple:
+        """One request -> one named terminal outcome: ("ok", score) or
+        (outcome_name, None). Sheds, deadline drops, drain failures and
+        seam-named dispatch failures are RESULTS of an overloaded or
+        draining service, not driver crashes — they are accounted, and
+        the replay keeps going."""
+        import concurrent.futures
+
+        from photon_ml_tpu.reliability import SeamFailure
+        from photon_ml_tpu.serving import (
+            DeadlineExceeded,
+            RequestShed,
+            ServingError,
+        )
+
+        try:
+            return ("ok", batcher.score(req))
+        except RequestShed:
+            return ("shed", None)
+        except DeadlineExceeded:
+            return ("deadline_exceeded", None)
+        except ServingError as e:
+            return (f"error:{e.code}", None)
+        except SeamFailure:
+            return ("error:DISPATCH_FAILED", None)
+        except concurrent.futures.TimeoutError:
+            return ("error:TIMEOUT", None)
+
     def _replay_closed(self, batcher, requests) -> List[tuple]:
         swap_once = threading.Lock()
-        out = []
+        out = self._closed_scored
         for req in requests:
-            out.append((req, batcher.score(req)))
+            if self._stop_replay.is_set():
+                break
+            outcome, score = self._score_one(batcher, req)
+            out.append((req, outcome, score))
             self._maybe_swap(len(out), swap_once)
         return out
 
@@ -331,29 +416,31 @@ class ServingDriver:
         it_lock = threading.Lock()
         out_lock = threading.Lock()
         swap_once = threading.Lock()
-        results: Dict[int, tuple] = {}
+        results = self._open_results
         errors: List[BaseException] = []
 
         def worker():
-            while True:
+            while not self._stop_replay.is_set():
                 with it_lock:
                     try:
                         i, req = next(it)
                     except StopIteration:
                         return
                 try:
-                    score = batcher.score(req)
+                    outcome, score = self._score_one(batcher, req)
                 except BaseException as e:
                     with out_lock:
                         errors.append(e)
                     return
                 with out_lock:
-                    results[i] = (req, score)
+                    results[i] = (req, outcome, score)
                     n = len(results)
                 self._maybe_swap(n, swap_once)
 
         threads = [
-            threading.Thread(target=worker, name=f"photon-serving-load-{t}")
+            threading.Thread(
+                target=worker, name=f"photon-serving-load-{t}", daemon=True
+            )
             for t in range(p.concurrency)
         ]
         for t in threads:
@@ -364,6 +451,12 @@ class ServingDriver:
             raise errors[0]
         return [results[i] for i in sorted(results)]
 
+    def _partial_results(self) -> List[tuple]:
+        """Whatever the replay completed before an interrupt."""
+        if self.params.mode == "closed":
+            return list(self._closed_scored)
+        return [self._open_results[i] for i in sorted(self._open_results)]
+
     # -- output --------------------------------------------------------------
 
     def _write_scores(self, scored: List[tuple]) -> None:
@@ -373,7 +466,9 @@ class ServingDriver:
         p = self.params
 
         def records():
-            for req, score in scored:
+            for req, outcome, score in scored:
+                if outcome != "ok":
+                    continue  # shed/expired/failed: accounted, not scored
                 yield {
                     "uid": req.uid,
                     "label": req.label if p.has_response else None,
@@ -400,16 +495,17 @@ class ServingDriver:
 
         p = self.params
         out: Dict[str, float] = {}
-        if not (p.evaluator_types and p.has_response):
+        ok = [(r, s) for r, outcome, s in scored if outcome == "ok"]
+        if not (p.evaluator_types and p.has_response and ok):
             return out
         scores = jnp.asarray(
-            np.asarray([s for _, s in scored], np.float32)
+            np.asarray([s for _, s in ok], np.float32)
         )
         labels = jnp.asarray(
-            np.asarray([r.label for r, _ in scored], np.float32)
+            np.asarray([r.label for r, _ in ok], np.float32)
         )
         weights = jnp.asarray(
-            np.asarray([r.weight for r, _ in scored], np.float32)
+            np.asarray([r.weight for r, _ in ok], np.float32)
         )
         loss = loss_for_task(p.task_type)
         for et in p.evaluator_types:
@@ -423,6 +519,62 @@ class ServingDriver:
             out[et.render()] = value
             self.logger.info("%s = %g", et.render(), value)
         return out
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _install_signal_handlers(self, handler) -> List[tuple]:
+        """Install SIGTERM/SIGINT handlers (main thread only — a driver
+        constructed inside a test worker skips them); returns what to
+        restore."""
+        prev = []
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev.append((sig, signal.signal(sig, handler)))
+            except ValueError:
+                pass  # not the main thread
+        return prev
+
+    @staticmethod
+    def _restore_signal_handlers(prev: List[tuple]) -> None:
+        for sig, old in prev:
+            try:
+                signal.signal(sig, old)
+            except (ValueError, TypeError):
+                pass
+
+    def _metrics_extra(self, scored, eval_metrics) -> Dict:
+        from photon_ml_tpu.parallel import overlap
+
+        outcomes: Dict[str, int] = {}
+        for _req, outcome, _s in scored:
+            outcomes[outcome] = outcomes.get(outcome, 0) + 1
+        extra = {
+            **eval_metrics,
+            "mode": (
+                "frontend" if self.params.frontend_mode else self.params.mode
+            ),
+            "interrupted": self.interrupted,
+            "generation": self.serving_model.generation,
+            "programs": self.serving_model.programs.stats(),
+            "readbacks": overlap.readback_stats(),
+            "swap_history": [
+                {
+                    "ok": s.ok,
+                    "generation": s.generation,
+                    "donated": s.donated,
+                    "recompiled_programs": s.recompiled_programs,
+                    "rolled_back": s.rolled_back,
+                    "quarantined": s.quarantined,
+                    "error": s.error,
+                }
+                for s in self.serving_model.swap_history
+            ],
+        }
+        if outcomes:
+            extra["outcomes"] = dict(sorted(outcomes.items()))
+        if self.drain_report is not None:
+            extra["drain"] = self.drain_report.to_dict()
+        return extra
 
     def run(self) -> None:
         from photon_ml_tpu.parallel import overlap
@@ -439,49 +591,133 @@ class ServingDriver:
             self.metrics,
             max_wait_s=p.max_wait_ms / 1e3,
             max_queue=p.max_queue,
+            default_deadline_ms=p.default_deadline_ms,
         )
+        if p.frontend_mode:
+            self._run_frontend(batcher)
+            return
+
+        def _interrupt(signum, frame):
+            # raised in the main thread: aborts the replay loop / joins;
+            # workers observe _stop_replay and stop submitting
+            self._stop_replay.set()
+            raise KeyboardInterrupt(f"signal {signum}")
+
+        prev = self._install_signal_handlers(_interrupt)
+        scored = []
         try:
-            with self.timer.time("serve"):
-                scored = (
-                    self._replay_closed(batcher, requests)
-                    if p.mode == "closed"
-                    else self._replay_open(batcher, requests)
+            try:
+                with self.timer.time("serve"):
+                    scored = (
+                        self._replay_closed(batcher, requests)
+                        if p.mode == "closed"
+                        else self._replay_open(batcher, requests)
+                    )
+            except KeyboardInterrupt:
+                # satellite: Ctrl-C / SIGTERM must not lose the
+                # accounting — drain within budget, mark the artifact
+                self.interrupted = True
+                self._stop_replay.set()
+                self.logger.info(
+                    "interrupted: draining batcher (budget %.1fs)",
+                    p.drain_timeout_s,
                 )
+                self.drain_report = batcher.drain(p.drain_timeout_s)
+                scored = self._partial_results()
         finally:
+            self._restore_signal_handlers(prev)
             batcher.close()
-        if not scored:
+            overlap.drain_io()
+        if not scored and not self.interrupted:
             raise ValueError("empty request trace")
         self.logger.info(
-            "served %d request(s) in %s mode", len(scored), p.mode
+            "served %d request(s) in %s mode%s",
+            len(scored), p.mode,
+            " (interrupted)" if self.interrupted else "",
         )
-        if p.write_scores:
+        if p.write_scores and scored:
             with self.timer.time("write-scores"):
                 self._write_scores(scored)
         eval_metrics = self._evaluate(scored)
-        prog_stats = self.serving_model.programs.stats()
+        self.metrics.write(
+            os.path.join(p.output_dir, "metrics.json"),
+            extra=self._metrics_extra(scored, eval_metrics),
+        )
+        self.results = [s for _, outcome, s in scored if outcome == "ok"]
+        self.logger.info("timers:\n%s", self.timer.summary())
+
+    def _run_frontend(self, batcher) -> None:
+        """Network-serving main loop: publish the bound port, serve
+        until SIGTERM/SIGINT, then the drain protocol — stop accepting,
+        drain the batcher within ``--drain-timeout`` (leftovers fail
+        with DRAIN_TIMEOUT), flush + close every connection, write
+        metrics.json with the interrupted marker."""
+        from photon_ml_tpu.parallel import overlap
+        from photon_ml_tpu.reliability import atomic_write_json
+        from photon_ml_tpu.serving import ServingFrontend
+
+        p = self.params
+        swap_once = threading.Lock()
+        on_completion = (
+            (lambda n: self._maybe_swap(n, swap_once))
+            if p.swap_model_dir
+            else None
+        )
+        frontend = ServingFrontend(
+            batcher,
+            self.serving_model,
+            p.feature_shards,
+            metrics=self.metrics,
+            host=p.frontend_host,
+            port=p.frontend_port,
+            has_response=p.has_response,
+            on_completion=on_completion,
+        )
+        frontend.start()
+        atomic_write_json(
+            os.path.join(p.output_dir, "frontend.json"),
+            {
+                "host": p.frontend_host,
+                "port": frontend.port,
+                "pid": os.getpid(),
+            },
+        )
+        self.logger.info(
+            "front-end listening on %s:%d (drain budget %.1fs)",
+            p.frontend_host, frontend.port, p.drain_timeout_s,
+        )
+        shutdown = threading.Event()
+        prev = self._install_signal_handlers(
+            lambda signum, frame: shutdown.set()
+        )
+        try:
+            try:
+                while not shutdown.wait(timeout=0.2):
+                    pass
+            except KeyboardInterrupt:
+                pass
+            self.interrupted = True
+            with self.timer.time("drain"):
+                frontend.stop_accepting()
+                self.drain_report = batcher.drain(p.drain_timeout_s)
+                frontend.close()
+        finally:
+            self._restore_signal_handlers(prev)
+            batcher.close()
+            overlap.drain_io()
+        leaked = frontend.open_connections()
+        self.logger.info(
+            "drained: %s; open connections after close: %d",
+            self.drain_report.to_dict(), leaked,
+        )
         self.metrics.write(
             os.path.join(p.output_dir, "metrics.json"),
             extra={
-                **eval_metrics,
-                "mode": p.mode,
-                "generation": self.serving_model.generation,
-                "programs": prog_stats,
-                "readbacks": overlap.readback_stats(),
-                "swap_history": [
-                    {
-                        "ok": s.ok,
-                        "generation": s.generation,
-                        "donated": s.donated,
-                        "recompiled_programs": s.recompiled_programs,
-                        "rolled_back": s.rolled_back,
-                        "quarantined": s.quarantined,
-                        "error": s.error,
-                    }
-                    for s in self.serving_model.swap_history
-                ],
+                **self._metrics_extra([], {}),
+                "frontend_completed": frontend.completed(),
+                "leaked_connections": leaked,
             },
         )
-        self.results = [s for _, s in scored]
         self.logger.info("timers:\n%s", self.timer.summary())
 
 
@@ -490,9 +726,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--game-model-input-dir", required=True)
     ap.add_argument("--output-dir", required=True)
     ap.add_argument(
-        "--request-paths", required=True,
+        "--request-paths", default=None,
         help="Avro trace file(s)/dir(s), comma-separated, or '-' for "
-        "JSON-lines requests on stdin",
+        "JSON-lines requests on stdin (omit when --frontend-port serves "
+        "over the network)",
     )
     ap.add_argument(
         "--feature-shard-id-to-feature-section-keys-map", required=True
@@ -550,6 +787,23 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "(seam:nth:error[:times], comma-separated); also via "
         "PHOTON_FAULT_PLAN",
     )
+    ap.add_argument("--frontend-host", default="127.0.0.1")
+    ap.add_argument(
+        "--frontend-port", type=int, default=None,
+        help="serve over a TCP JSON-lines front-end on this port "
+        "(0 = ephemeral; the bound port is published to "
+        "<output-dir>/frontend.json); SIGTERM drains and exits",
+    )
+    ap.add_argument(
+        "--drain-timeout", type=float, default=10.0,
+        help="seconds to finish pending requests on SIGTERM/Ctrl-C; "
+        "leftovers fail with the named DRAIN_TIMEOUT outcome",
+    )
+    ap.add_argument(
+        "--default-deadline-ms", type=float, default=None,
+        help="deadline applied to requests that carry none of their "
+        "own; enables load shedding under overload",
+    )
     return ap
 
 
@@ -568,7 +822,10 @@ def params_from_args(argv=None) -> ServingParams:
         game_model_input_dir=ns.game_model_input_dir,
         output_dir=ns.output_dir,
         request_paths=(
-            ["-"] if ns.request_paths.strip() == "-"
+            []
+            if ns.request_paths is None
+            else ["-"]
+            if ns.request_paths.strip() == "-"
             else ns.request_paths.split(",")
         ),
         feature_shards=apply_intercept_map(
@@ -600,6 +857,10 @@ def params_from_args(argv=None) -> ServingParams:
         application_name=ns.application_name or "photon-ml-tpu-serving",
         no_overlap=truthy(ns.no_overlap),
         fault_plan=ns.fault_plan,
+        frontend_host=ns.frontend_host,
+        frontend_port=ns.frontend_port,
+        drain_timeout_s=ns.drain_timeout,
+        default_deadline_ms=ns.default_deadline_ms,
     )
 
 

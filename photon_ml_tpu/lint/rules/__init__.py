@@ -5,6 +5,7 @@ from photon_ml_tpu.lint.rules import (  # noqa: F401
     io_drain,
     recompile,
     reliability,
+    request_path,
     spill,
     tracer_leak,
 )

@@ -72,6 +72,8 @@ SEAMS = (
     "io_worker",      # overlap.submit_io async artifact writes
     "decode_ahead",   # decode-ahead worker thread handoff
     "serving.model_load",  # serving bank load / hot-swap staging reads
+    "serving.frontend.read",   # network front-end per-line reads
+    "serving.dispatch",        # micro-batch device dispatch (idempotent)
 )
 
 _ERRNO = {

@@ -82,6 +82,14 @@ _POLICIES: Dict[str, RetryPolicy] = {
     # a failed swap load rolls back to the serving generation, so the
     # budget is shallow-ish: three attempts, then keep serving N
     "serving.model_load": RetryPolicy(max_attempts=3),
+    # a failed connection read is the CLIENT's problem: one named error
+    # response, no retry — the service must not burn dispatcher time on
+    # a broken socket
+    "serving.frontend.read": RetryPolicy(max_attempts=1),
+    # dispatch is pure compute + one readback (idempotent); a transient
+    # fault retries bitwise, an exhausted budget fails the batch's
+    # futures with the seam-named error
+    "serving.dispatch": RetryPolicy(max_attempts=3, base_delay_s=0.002),
 }
 
 

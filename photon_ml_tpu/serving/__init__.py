@@ -1,26 +1,43 @@
 """Online scoring service: the low-latency request path over
 device-resident GAME model banks.
 
-Four pieces, composed by ``cli/serving_driver.py``:
+Seven pieces, composed by ``cli/serving_driver.py``:
 
 - :mod:`photon_ml_tpu.serving.model_bank` — fixed/random-effect
   coefficients as padded device arrays + O(1) host entity->row index;
 - :mod:`photon_ml_tpu.serving.programs` — the AOT fixed-shape program
   ladder (every batch shape compiled before it can reach the hot path);
+- :mod:`photon_ml_tpu.serving.admission` — deadlines, the load-shed
+  predictor, and the named terminal outcomes every request resolves to;
 - :mod:`photon_ml_tpu.serving.batcher` — micro-batching dispatch loop,
-  exactly one counted readback per dispatched batch;
+  exactly one counted readback per dispatched batch, deadline drops
+  before dispatch, FE-only graceful degradation, bounded drain;
+- :mod:`photon_ml_tpu.serving.frontend` — the TCP JSON-lines accept
+  loop (bounded reads, per-connection writers, readiness/liveness,
+  SIGTERM drain);
 - :mod:`photon_ml_tpu.serving.swap` — zero-copy hot swap of model
   generations with quarantine + rollback on poisoned artifacts;
 - :mod:`photon_ml_tpu.serving.metrics` — p50/p99 latency, QPS,
-  occupancy and pad-waste accounting for metrics.json.
+  occupancy, shed/deadline/degraded/drain accounting for metrics.json.
 """
 
+from photon_ml_tpu.serving.admission import (  # noqa: F401
+    AdmissionController,
+    BatcherClosed,
+    DeadlineExceeded,
+    DrainTimeout,
+    RequestShed,
+    ScoreOutcome,
+    ServingError,
+)
 from photon_ml_tpu.serving.batcher import (  # noqa: F401
+    DrainReport,
     MicroBatcher,
     ScoreRequest,
     request_from_record,
     requests_from_dataset,
 )
+from photon_ml_tpu.serving.frontend import ServingFrontend  # noqa: F401
 from photon_ml_tpu.serving.metrics import ServingMetrics  # noqa: F401
 from photon_ml_tpu.serving.model_bank import (  # noqa: F401
     DEFAULT_ENTITY_PAD,

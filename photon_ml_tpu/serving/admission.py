@@ -1,0 +1,157 @@
+"""Admission control for the request path: named terminal outcomes,
+deadline bookkeeping, and the queue-wait predictor behind load shedding.
+
+The overload-control contract (the Orca-style continuous-batching
+schedulers, PAPERS.md): a serving loop that is past capacity must say
+"no" QUICKLY and KEEP its latency promise to the requests it admits —
+an unbounded queue converts overload into unbounded p99 for everyone.
+Three mechanisms, all host-side and allocation-free on the hot path:
+
+- **Deadlines.** Every request may carry a client-propagated
+  ``deadline_ms`` (milliseconds from enqueue). A request whose deadline
+  passes while queued is dropped *before* dispatch — the device never
+  scores dead work — and its future fails with
+  :class:`DeadlineExceeded`.
+- **Shedding.** ``MicroBatcher.submit`` consults
+  :class:`AdmissionController` — an EWMA model of per-row service time
+  — and refuses immediately (:class:`RequestShed`) when the predicted
+  queue wait already exceeds the request's deadline. A full queue
+  blocks only for the request's own remaining budget, never forever.
+- **Named outcomes.** Every accepted request reaches EXACTLY ONE
+  terminal state: a result, or one of the :class:`ServingError`
+  subclasses below, each carrying a stable ``code`` the front-end maps
+  onto the wire. Nothing on the request path hangs, and nothing fails
+  anonymously.
+
+:class:`ScoreOutcome` is a ``float`` subclass so existing callers (and
+the bitwise parity tests) keep comparing scores as plain numbers while
+the front-end reads the ``degraded``/``generation`` annotations off the
+same object.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+__all__ = [
+    "ServingError",
+    "RequestShed",
+    "DeadlineExceeded",
+    "DrainTimeout",
+    "BatcherClosed",
+    "ScoreOutcome",
+    "AdmissionController",
+]
+
+
+class ServingError(RuntimeError):
+    """Base of the request path's named terminal failures. ``code`` is
+    the stable wire-level identifier (the front-end's ``error`` field
+    and the metrics outcome key) — messages are for humans, codes are
+    the contract."""
+
+    code = "INTERNAL"
+
+    def __init__(self, message: str):
+        super().__init__(message)
+
+
+class RequestShed(ServingError):
+    """Admission refused the request up front: the predicted queue wait
+    (or a bounded full-queue wait) already exceeds its deadline. Shed
+    requests never occupy a queue slot past their budget and never reach
+    the device."""
+
+    code = "SHED"
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline passed while it sat in the queue; it was
+    dropped before dispatch so the device never scored dead work."""
+
+    code = "DEADLINE_EXCEEDED"
+
+
+class DrainTimeout(ServingError):
+    """The batcher was asked to drain and this request was still
+    pending when the drain budget ran out. The named leftover-failure
+    of the SIGTERM path — never a hung future."""
+
+    code = "DRAIN_TIMEOUT"
+
+
+class BatcherClosed(ServingError):
+    """Submitted to a closed (or draining) batcher."""
+
+    code = "CLOSED"
+
+
+class ScoreOutcome(float):
+    """A score that is still a ``float`` (bitwise comparisons, numpy
+    coercion and the existing parity tests all work unchanged) but
+    carries the response annotations the front-end needs:
+
+    - ``degraded`` — True when one or more random-effect coordinates
+      could not be resolved (quarantined bank or a failed row lookup)
+      and the request was scored FE-only instead of failed;
+    - ``generation`` — the model-bank generation the batch ran on.
+    """
+
+    __slots__ = ("degraded", "generation")
+
+    def __new__(
+        cls, value: float, *, degraded: bool = False, generation: int = 0
+    ) -> "ScoreOutcome":
+        self = super().__new__(cls, value)
+        self.degraded = bool(degraded)
+        self.generation = int(generation)
+        return self
+
+    def __repr__(self) -> str:  # float repr + the annotations
+        return (
+            f"ScoreOutcome({float(self)!r}, degraded={self.degraded}, "
+            f"generation={self.generation})"
+        )
+
+
+class AdmissionController:
+    """EWMA service-time model -> predicted queue wait.
+
+    ``note_dispatch`` feeds it one (rows, busy seconds) observation per
+    dispatched micro-batch; ``predicted_wait_s(queue_len)`` is the
+    expected time a request joining the back of the queue waits before
+    its own dispatch starts. Deliberately simple and conservative:
+
+    - per-ROW time (busy_s / rows) already amortizes batching, so the
+      prediction scales with queue DEPTH, not dispatch count;
+    - cold start (no observations yet) predicts 0 — admit everything
+      until there is evidence of cost, so an idle service never sheds
+      its first request;
+    - the EWMA (default ``alpha=0.2``) tracks shape changes (a hot swap
+      to a bigger model, a ladder rung change) within a few dispatches
+      without oscillating on scheduler noise.
+    """
+
+    def __init__(self, alpha: float = 0.2):
+        self._alpha = float(alpha)
+        self._lock = threading.Lock()
+        self._per_row_s: Optional[float] = None
+
+    def note_dispatch(self, rows: int, busy_s: float) -> None:
+        per_row = max(busy_s, 0.0) / max(int(rows), 1)
+        with self._lock:
+            if self._per_row_s is None:
+                self._per_row_s = per_row
+            else:
+                self._per_row_s += self._alpha * (per_row - self._per_row_s)
+
+    def per_row_s(self) -> float:
+        with self._lock:
+            return self._per_row_s or 0.0
+
+    def predicted_wait_s(self, queue_len: int) -> float:
+        with self._lock:
+            if self._per_row_s is None:
+                return 0.0
+            return max(int(queue_len), 0) * self._per_row_s

@@ -1,0 +1,510 @@
+"""Network front-end: a TCP JSON-lines accept loop in front of
+``MicroBatcher.submit``.
+
+The wire protocol is one JSON object per ``\\n``-terminated line, both
+directions — the same GameExample-shaped records the stdin replay path
+consumes (plus an optional ``deadline_ms``), so a request that works
+through ``--request-paths -`` works verbatim over a socket. Responses
+carry exactly one terminal ``status`` per request line:
+
+- ``{"uid":…, "status":"ok", "score":…, "generation":…, "degraded":…}``
+- ``{"uid":…, "status":"shed", "error":"SHED", "message":…}``
+- ``{"uid":…, "status":"deadline_exceeded", "error":"DEADLINE_EXCEEDED",…}``
+- ``{"uid":…, "status":"error", "error":<NAME>, "message":…}`` — named
+  errors (``BAD_REQUEST``, ``READ_FAULT``, ``DRAIN_TIMEOUT``,
+  ``DISPATCH_FAILED``, ``CLOSED``, ``INTERNAL``), never a crash and
+  never silence.
+
+Control lines ``{"op": "status"|"ready"|"live"}`` answer the lifecycle
+questions without touching the device: **readiness** (bank loaded +
+ladder warm — ``ServingModel.ready()``) says "this replica may take
+traffic"; **liveness** (the dispatcher heartbeat — beating even when
+idle) says "this replica is not wedged". A load balancer drains on
+not-ready and restarts on not-live; conflating them turns every staging
+pause into a restart. ``{"op": "quarantine_re", "re_type": …}`` is the
+operator's graceful-degradation lever: the named random-effect bank of
+the CURRENT generation stops being consulted and affected requests
+score FE-only with ``degraded: true`` until the next swap.
+
+Robustness invariants (the "serving under fire" contract):
+
+- **Bounded reads.** Per-connection reads are buffered with a hard
+  ``max_line_bytes`` cap — an unframed flood gets a named error and the
+  connection closed, never unbounded host memory.
+- **Per-connection writer threads.** Responses are demuxed onto a
+  bounded per-connection queue drained by a writer thread with a send
+  timeout: a slow (or stalled) client backs up only its OWN queue; when
+  that overflows the connection is dropped and counted
+  (``frontend.connections_dropped_slow``) — the dispatcher and every
+  other client are unaffected.
+- **Fault seam.** Every received line crosses the
+  ``serving.frontend.read`` reliability seam: a planned fault surfaces
+  as a ``READ_FAULT`` error response on that connection, bit-for-bit
+  reproducible from the fault plan, with the service still up.
+- **Drain protocol.** ``stop_accepting()`` (SIGTERM) closes the
+  listener and refuses new score lines with ``CLOSED``; the driver then
+  drains the batcher within its budget (leftovers fail with
+  ``DRAIN_TIMEOUT``) and ``close()`` flushes every writer queue and
+  joins every connection thread — zero hung futures, zero leaked
+  connections (``open_connections()`` is asserted by the chaos arm).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional
+
+from photon_ml_tpu.serving.admission import (
+    DeadlineExceeded,
+    DrainTimeout,
+    RequestShed,
+    ServingError,
+)
+from photon_ml_tpu.serving.batcher import MicroBatcher, request_from_record
+
+__all__ = ["ServingFrontend", "READ_SEAM"]
+
+READ_SEAM = "serving.frontend.read"
+
+# Framing cap: a line that exceeds this without a newline is not a
+# request, it is a flood — named error, connection closed.
+DEFAULT_MAX_LINE_BYTES = 1 << 20
+# Bounded per-connection response queue (slow-client protection).
+DEFAULT_WRITER_QUEUE = 1024
+# Socket poll period: every blocking socket wait wakes at this beat to
+# observe shutdown — no untimed waits anywhere on the request path.
+POLL_S = 0.25
+# A client that cannot absorb one response within this budget is
+# stalled; its connection is dropped rather than wedging the writer.
+DEFAULT_SEND_TIMEOUT_S = 5.0
+
+_STATUS_OPS = ("status", "ready", "readiness", "live", "liveness", "health")
+
+
+def _error_response(uid, code: str, message: str) -> Dict[str, object]:
+    return {
+        "uid": uid,
+        "status": "error",
+        "error": code,
+        "message": message,
+    }
+
+
+def _outcome_response(uid, outcome) -> Dict[str, object]:
+    return {
+        "uid": uid,
+        "status": "ok",
+        "score": float(outcome),
+        "generation": getattr(outcome, "generation", 0),
+        "degraded": bool(getattr(outcome, "degraded", False)),
+    }
+
+
+def _failure_response(uid, exc: BaseException) -> Dict[str, object]:
+    from photon_ml_tpu.reliability import SeamFailure
+
+    if isinstance(exc, RequestShed):
+        return {
+            "uid": uid, "status": "shed", "error": exc.code,
+            "message": str(exc),
+        }
+    if isinstance(exc, DeadlineExceeded):
+        return {
+            "uid": uid, "status": "deadline_exceeded", "error": exc.code,
+            "message": str(exc),
+        }
+    if isinstance(exc, DrainTimeout):
+        return _error_response(uid, exc.code, str(exc))
+    if isinstance(exc, ServingError):
+        return _error_response(uid, exc.code, str(exc))
+    if isinstance(exc, SeamFailure):
+        return _error_response(uid, "DISPATCH_FAILED", str(exc))
+    if isinstance(exc, TimeoutError):
+        return _error_response(uid, "TIMEOUT", str(exc))
+    return _error_response(uid, "INTERNAL", str(exc))
+
+
+class _Connection:
+    """One accepted socket: a reader thread (bounded line framing ->
+    request handling) and a writer thread (bounded queue -> sendall
+    with a send timeout). Either side failing closes both."""
+
+    def __init__(self, frontend: "ServingFrontend", sock: socket.socket,
+                 peer: str):
+        self.fe = frontend
+        self.sock = sock
+        self.peer = peer
+        self.outq: "queue.Queue" = queue.Queue(
+            maxsize=frontend.writer_queue_max
+        )
+        self.closing = threading.Event()
+        self.pending = 0
+        self._pending_lock = threading.Lock()
+        sock.settimeout(POLL_S)
+        self.reader = threading.Thread(
+            target=self._read_loop, name=f"photon-fe-read-{peer}",
+            daemon=True,
+        )
+        self.writer = threading.Thread(
+            target=self._write_loop, name=f"photon-fe-write-{peer}",
+            daemon=True,
+        )
+        self.reader.start()
+        self.writer.start()
+
+    # -- response side -------------------------------------------------------
+
+    def send(self, response: Dict[str, object]) -> None:
+        """Enqueue one response; a full queue means THIS client is not
+        keeping up — drop the connection (counted), never block the
+        caller (which may be the dispatcher's done-callback)."""
+        try:
+            self.outq.put_nowait(response)
+        except queue.Full:
+            self.fe._note("connections_dropped_slow")
+            self.closing.set()
+
+    def _note_pending(self, delta: int) -> None:
+        with self._pending_lock:
+            self.pending += delta
+
+    def _write_loop(self) -> None:
+        while True:
+            try:
+                resp = self.outq.get(timeout=POLL_S)
+            except queue.Empty:
+                if self.closing.is_set():
+                    with self._pending_lock:
+                        drained = self.pending == 0
+                    if drained and self.outq.empty():
+                        break
+                continue
+            data = (json.dumps(resp) + "\n").encode("utf-8")
+            try:
+                self.sock.settimeout(DEFAULT_SEND_TIMEOUT_S)
+                self.sock.sendall(data)
+                self.sock.settimeout(POLL_S)
+            except OSError:
+                self.fe._note("connections_dropped_slow")
+                self.closing.set()
+                break
+            if self.fe.metrics is not None:
+                self.fe.metrics.record_response(str(resp.get("status")))
+        self._shutdown_socket()
+
+    # -- request side --------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        from photon_ml_tpu.reliability import (
+            InjectedCorruption,
+            InjectedFault,
+            inject,
+        )
+
+        buf = b""
+        while not self.closing.is_set():
+            nl = buf.find(b"\n")
+            if nl < 0:
+                if len(buf) > self.fe.max_line_bytes:
+                    # unframed flood: named error, then close — framing
+                    # cannot be recovered past the cap
+                    self.fe._note("oversized")
+                    self.send(_error_response(
+                        None, "BAD_REQUEST",
+                        f"line exceeds {self.fe.max_line_bytes} bytes",
+                    ))
+                    break
+                try:
+                    chunk = self.sock.recv(1 << 16)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                if not chunk:
+                    break  # EOF
+                buf += chunk
+                continue
+            line, buf = buf[:nl], buf[nl + 1:]
+            if not line.strip():
+                continue
+            self.fe._note("lines")
+            try:
+                # the reliability seam: one crossing per received line,
+                # so "fail the 3rd read with EIO" replays exactly
+                inject(READ_SEAM, detail=self.peer)
+            except (InjectedFault, InjectedCorruption, OSError) as e:
+                self.fe._note("read_faults")
+                self.send(_error_response(None, "READ_FAULT", str(e)))
+                continue
+            self._handle_line(line)
+        self.closing.set()
+
+    def _handle_line(self, line: bytes) -> None:
+        try:
+            obj = json.loads(line.decode("utf-8"))
+            if not isinstance(obj, dict):
+                raise ValueError("request must be a JSON object")
+        except (ValueError, UnicodeDecodeError) as e:
+            self.fe._note("malformed")
+            self.send(_error_response(None, "BAD_REQUEST", str(e)))
+            return
+        op = obj.get("op")
+        if op is not None:
+            self.fe._note("control")
+            if str(op) in _STATUS_OPS:
+                self.send(self.fe.status_response(str(op)))
+            elif str(op) == "quarantine_re":
+                # operator lever for graceful degradation: mark one RE
+                # coordinate of the CURRENT generation unusable —
+                # affected requests score FE-only with degraded=True
+                # until the next swap installs a clean bank
+                re_type = str(obj.get("re_type"))
+                try:
+                    self.fe.serving_model.quarantine_re(re_type)
+                except ValueError as e:
+                    self.send(_error_response(
+                        obj.get("uid"), "BAD_REQUEST", str(e)
+                    ))
+                    return
+                self.send({
+                    "status": "ok",
+                    "op": op,
+                    "re_type": re_type,
+                    "generation": self.fe.serving_model.generation,
+                })
+            else:
+                self.send(_error_response(
+                    obj.get("uid"), "BAD_REQUEST", f"unknown op {op!r}"
+                ))
+            return
+        self.fe._handle_score(self, obj)
+
+    # -- teardown ------------------------------------------------------------
+
+    def _shutdown_socket(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            self.fe._note("socket_close_errors")
+        self.fe._forget(self)
+
+    def join(self, timeout_s: float) -> None:
+        self.closing.set()
+        self.reader.join(timeout=timeout_s)
+        self.writer.join(timeout=timeout_s)
+
+
+class ServingFrontend:
+    """The accept loop + connection registry in front of one
+    :class:`MicroBatcher`. See the module docstring for the protocol
+    and the robustness contract."""
+
+    def __init__(
+        self,
+        batcher: MicroBatcher,
+        serving_model,
+        shard_configs,
+        *,
+        metrics=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        has_response: bool = True,
+        max_line_bytes: int = DEFAULT_MAX_LINE_BYTES,
+        writer_queue_max: int = DEFAULT_WRITER_QUEUE,
+        on_completion: Optional[Callable[[int], None]] = None,
+    ):
+        self.batcher = batcher
+        self.serving_model = serving_model
+        self.shard_configs = shard_configs
+        self.metrics = metrics
+        self.host = host
+        self.has_response = bool(has_response)
+        self.max_line_bytes = int(max_line_bytes)
+        self.writer_queue_max = int(writer_queue_max)
+        self.on_completion = on_completion
+        self._completed = 0
+        self._completed_lock = threading.Lock()
+        self._conns: List[_Connection] = []
+        self._conns_lock = threading.Lock()
+        self._accepting = threading.Event()
+        self._stopped = threading.Event()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(
+            socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+        )
+        self._listener.bind((host, int(port)))
+        self.port = self._listener.getsockname()[1]
+        self._accept_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ServingFrontend":
+        self._listener.listen(128)
+        self._listener.settimeout(POLL_S)
+        self._accepting.set()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="photon-fe-accept", daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop_accepting(self) -> None:
+        """SIGTERM step 1: close the listener; established connections
+        keep receiving responses for already-admitted work, but new
+        score lines are refused with ``CLOSED``.
+
+        The shutdown() wakes an accept() blocked in another thread —
+        CPython defers the actual close until accept returns, so
+        without it the port would keep accepting for up to one poll
+        period after "stop"."""
+        self._accepting.clear()
+        self._stopped.set()
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass  # already shut down / never listened
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2 * POLL_S + 1.0)
+        try:
+            self._listener.close()
+        except OSError:
+            self._note("socket_close_errors")
+
+    def close(self, timeout_s: float = DEFAULT_SEND_TIMEOUT_S) -> None:
+        """Final teardown: flush + close every connection, join every
+        thread (all bounded). Call after the batcher has drained so
+        every pending future already holds its terminal outcome."""
+        self.stop_accepting()
+        with self._conns_lock:
+            conns = list(self._conns)
+        deadline = time.perf_counter() + max(timeout_s, 0.1)
+        for c in conns:
+            c.join(max(deadline - time.perf_counter(), 0.1))
+        if self._accept_thread is not None:
+            self._accept_thread.join(
+                timeout=max(deadline - time.perf_counter(), 0.1)
+            )
+        with self._conns_lock:
+            leaked = list(self._conns)
+        for c in leaked:
+            c._shutdown_socket()
+
+    def open_connections(self) -> int:
+        with self._conns_lock:
+            return len(self._conns)
+
+    def completed(self) -> int:
+        with self._completed_lock:
+            return self._completed
+
+    @property
+    def draining(self) -> bool:
+        return self._stopped.is_set()
+
+    def status_response(self, op: str = "status") -> Dict[str, object]:
+        """Readiness + liveness in one payload: ``ready`` gates traffic
+        (bank live, ladder warm), ``alive``/``heartbeat_age_s`` gate
+        restarts (dispatcher beating)."""
+        return {
+            "status": "ok",
+            "op": op,
+            "ready": bool(
+                self.serving_model.ready()
+                and not self.batcher.draining
+                and not self.batcher.closed
+                and not self._stopped.is_set()
+            ),
+            "alive": self.batcher.alive(),
+            "heartbeat_age_s": round(self.batcher.heartbeat_age_s(), 4),
+            "draining": self._stopped.is_set() or self.batcher.draining,
+            "generation": self.serving_model.generation,
+            "queue_depth": self.batcher.queue_depth(),
+        }
+
+    # -- internals -----------------------------------------------------------
+
+    def _note(self, event: str, n: int = 1) -> None:
+        if self.metrics is not None:
+            self.metrics.record_frontend(event, n)
+
+    def _forget(self, conn: _Connection) -> None:
+        with self._conns_lock:
+            if conn in self._conns:
+                self._conns.remove(conn)
+        self._note("connections_closed")
+
+    def _accept_loop(self) -> None:
+        while self._accepting.is_set():
+            try:
+                sock, addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break  # listener closed (drain)
+            if not self._accepting.is_set():
+                try:
+                    sock.close()
+                except OSError:
+                    self._note("socket_close_errors")
+                break
+            peer = f"{addr[0]}:{addr[1]}"
+            conn = _Connection(self, sock, peer)
+            with self._conns_lock:
+                self._conns.append(conn)
+            self._note("connections_opened")
+
+    def _handle_score(self, conn: _Connection, record: Dict) -> None:
+        uid = record.get("uid")
+        if self._stopped.is_set():
+            conn.send(_error_response(
+                uid, "CLOSED", "front-end is draining"
+            ))
+            return
+        try:
+            req = request_from_record(
+                record,
+                self.serving_model.current(),
+                self.shard_configs,
+                has_response=self.has_response,
+            )
+        except (ValueError, KeyError, TypeError) as e:
+            self._note("malformed")
+            conn.send(_error_response(uid, "BAD_REQUEST", str(e)))
+            return
+        try:
+            fut = self.batcher.submit(req)
+        except ServingError as e:
+            conn.send(_failure_response(uid, e))
+            return
+        conn._note_pending(+1)
+        fut.add_done_callback(
+            lambda f, c=conn, u=req.uid: self._on_done(c, u, f)
+        )
+
+    def _on_done(self, conn: _Connection, uid: str, fut: Future) -> None:
+        # runs on the dispatcher (or drain) thread: the future is
+        # already terminal, so result(timeout=0) cannot block
+        try:
+            outcome = fut.result(timeout=0)
+            resp = _outcome_response(uid, outcome)
+        except BaseException as e:
+            resp = _failure_response(uid, e)
+        conn._note_pending(-1)
+        conn.send(resp)
+        with self._completed_lock:
+            self._completed += 1
+            n = self._completed
+        hook = self.on_completion
+        if hook is not None:
+            try:
+                hook(n)
+            except Exception:
+                # a completion hook (e.g. the driver's swap trigger)
+                # must never take down the response path; failures are
+                # visible in its own accounting
+                self._note("completion_hook_errors")

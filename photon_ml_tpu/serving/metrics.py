@@ -25,8 +25,8 @@ __all__ = ["ServingMetrics"]
 
 
 class ServingMetrics:
-    """Thread-safe accumulator shared by the batcher, the swap path and
-    the driver."""
+    """Thread-safe accumulator shared by the batcher, the front-end,
+    the swap path and the driver."""
 
     def __init__(self, *, max_latency_samples: int = 1 << 20):
         self._lock = threading.Lock()
@@ -43,6 +43,19 @@ class ServingMetrics:
         self._gen_dispatches: Dict[int, int] = {}
         self._first_t: Optional[float] = None
         self._last_t: Optional[float] = None
+        # overload/degradation/lifecycle accounting (ISSUE 8): sheds by
+        # reason, deadline drops, degraded (FE-only) responses, RE
+        # lookup failures/quarantines, front-end line/connection
+        # counters, and the drain report. All host counters — the
+        # one-readback-per-dispatch budget is untouched.
+        self._sheds: Dict[str, int] = {}
+        self._deadline_expired = 0
+        self._degraded = 0
+        self._re_resolution_failures: Dict[str, int] = {}
+        self._re_quarantines: Dict[str, int] = {}
+        self._frontend: Dict[str, int] = {}
+        self._responses: Dict[str, int] = {}
+        self._drain: Optional[Dict[str, object]] = None
 
     # -- recording -----------------------------------------------------------
 
@@ -71,6 +84,49 @@ class ServingMetrics:
             if self._first_t is None:
                 self._first_t = now - device_s - queue_wait_s
             self._last_t = now
+
+    def record_shed(self, reason: str) -> None:
+        """One refused request: ``predicted_wait`` (admission said no up
+        front) or ``queue_full`` (the bounded full-queue wait expired)."""
+        with self._lock:
+            self._sheds[reason] = self._sheds.get(reason, 0) + 1
+
+    def record_deadline_expired(self, n: int = 1) -> None:
+        with self._lock:
+            self._deadline_expired += int(n)
+
+    def record_degraded(self, n: int = 1) -> None:
+        with self._lock:
+            self._degraded += int(n)
+
+    def record_re_resolution_failure(self, re_type: str) -> None:
+        with self._lock:
+            self._re_resolution_failures[re_type] = (
+                self._re_resolution_failures.get(re_type, 0) + 1
+            )
+
+    def record_re_quarantine(self, re_type: str) -> None:
+        with self._lock:
+            self._re_quarantines[re_type] = (
+                self._re_quarantines.get(re_type, 0) + 1
+            )
+
+    def record_frontend(self, event: str, n: int = 1) -> None:
+        """Front-end counters: ``connections_opened`` / ``_closed`` /
+        ``_dropped_slow``, ``lines`` / ``malformed`` / ``oversized`` /
+        ``read_faults`` / ``control``."""
+        with self._lock:
+            self._frontend[event] = self._frontend.get(event, 0) + int(n)
+
+    def record_response(self, status: str) -> None:
+        """One wire response by terminal status (``ok`` / ``shed`` /
+        ``deadline_exceeded`` / ``error`` / ``degraded`` rides on ok)."""
+        with self._lock:
+            self._responses[status] = self._responses.get(status, 0) + 1
+
+    def record_drain(self, report) -> None:
+        with self._lock:
+            self._drain = report.to_dict()
 
     def record_latency(self, seconds: float) -> None:
         with self._lock:
@@ -133,7 +189,25 @@ class ServingMetrics:
                 },
                 "latency_samples": int(lat.size),
                 "latency_sample_stride": self._stride,
+                "sheds": {
+                    **{k: v for k, v in sorted(self._sheds.items())},
+                    "total": sum(self._sheds.values()),
+                },
+                "deadline_expired": self._deadline_expired,
+                "degraded_responses": self._degraded,
+                "re_resolution_failures": dict(
+                    sorted(self._re_resolution_failures.items())
+                ),
+                "re_quarantines": dict(
+                    sorted(self._re_quarantines.items())
+                ),
             }
+            if self._frontend:
+                out["frontend"] = dict(sorted(self._frontend.items()))
+            if self._responses:
+                out["responses"] = dict(sorted(self._responses.items()))
+            if self._drain is not None:
+                out["drain"] = dict(self._drain)
             if lat.size:
                 out.update(
                     {

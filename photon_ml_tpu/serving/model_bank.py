@@ -161,6 +161,44 @@ class ModelBank:
     # donated to its successor — using a retired bank is a bug
     retired: bool = False
     model_id: str = ""
+    # random-effect id types whose bank is unusable for THIS generation
+    # (poisoned artifact slice, repeated row-resolution failures):
+    # requests touching them score FE-ONLY with a degraded flag instead
+    # of failing. Per-generation by construction — a hot swap installs a
+    # fresh bank with an empty set.
+    quarantined_re_types: set = None  # set in __post_init__
+
+    def __post_init__(self):
+        if self.quarantined_re_types is None:
+            self.quarantined_re_types = set()
+
+    def quarantine_re(self, re_type: str) -> None:
+        """Mark one random-effect coordinate unusable for this
+        generation; the batcher degrades affected rows to FE-only."""
+        if re_type not in self.re_types:
+            raise ValueError(
+                f"unknown random-effect type {re_type!r}; "
+                f"known: {self.re_types}"
+            )
+        self.quarantined_re_types.add(re_type)
+
+    @property
+    def used_shards(self) -> Tuple[str, ...]:
+        """Feature shards the spec actually scores. ``shard_widths``
+        may cover MORE shards than the model references (an FE-only
+        model served under a multi-shard request config): requests
+        still carry those features, but the program pytree — and
+        therefore batch assembly — must only see the spec's shards."""
+        shards = []
+        for entry in self.spec:
+            sid = (
+                entry[2] if entry[0] == "fe"
+                else entry[3] if entry[0] == "re"
+                else None
+            )
+            if sid is not None and sid not in shards:
+                shards.append(sid)
+        return tuple(shards)
 
     @property
     def re_types(self) -> Tuple[str, ...]:

@@ -191,7 +191,11 @@ class ServingPrograms:
                     ev = threading.Event()
                     self._inflight[key] = ev
                     break
-            ev.wait()
+            # timed wait (request-path hygiene, PL007): re-check the
+            # cache each beat instead of parking unbounded on the
+            # winner's event
+            while not ev.wait(timeout=0.1):
+                continue
         try:
             exe = _score_jit.lower(
                 spec, _array_structs(arrays), _batch_structs(spec, B)

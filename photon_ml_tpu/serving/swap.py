@@ -160,6 +160,29 @@ class ServingModel:
     def generation(self) -> int:
         return self.current().generation
 
+    def ready(self) -> bool:
+        """Readiness: the current bank is live (not retired) and every
+        ladder rung holds a precompiled executable for its spec — a
+        ready service can answer ANY admissible batch shape without a
+        hot-path compile. Distinct from liveness (the dispatcher
+        heartbeat, owned by the batcher): a service can be alive but
+        not yet ready (mid-staging) and must not take traffic."""
+        bank = self.current()
+        if bank.retired:
+            return False
+        return all(
+            self.programs.executable(bank.spec, B) is not None
+            for B in self.programs.ladder
+        )
+
+    def quarantine_re(self, re_type: str) -> None:
+        """Operator/fault-path entry: mark one RE coordinate of the
+        CURRENT generation unusable. Requests touching it score FE-only
+        (degraded), everything else is unaffected — the graceful-
+        degradation contract, scoped to this generation (the next swap
+        installs a clean bank)."""
+        self.current().quarantine_re(re_type)
+
     @classmethod
     def load(
         cls,

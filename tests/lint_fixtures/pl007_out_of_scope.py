@@ -1,0 +1,11 @@
+"""PL007 scope check: the same untimed waits OUTSIDE serving/ are not
+request-path code (driver replay loops may block on their own futures)."""
+
+import threading
+from concurrent.futures import Future
+
+
+def untimed_wait_is_fine_here(cond: threading.Condition, fut: Future):
+    with cond:
+        cond.wait()
+    return fut.result()

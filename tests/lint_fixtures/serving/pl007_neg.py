@@ -1,0 +1,33 @@
+"""PL007 negatives: bounded waits, done-callback reads, non-primitive
+helpers."""
+
+import threading
+from concurrent.futures import Future
+
+
+def timed_condition_wait(cond: threading.Condition, budget: float):
+    with cond:
+        while not cond.wait(timeout=budget):
+            break
+
+
+def timed_keyword_wait(ev: threading.Event):
+    while not ev.wait(timeout=0.1):
+        continue
+
+
+def timed_future_result(fut: Future, timeout: float):
+    return fut.result(timeout=timeout)
+
+
+def done_callback_read(fut: Future):
+    # inside a done-callback the future is terminal: timeout=0 cannot
+    # block, and satisfies the bounded-wait contract
+    return fut.result(timeout=0)
+
+
+def bare_helper_named_result():
+    def result():
+        return 1
+
+    return result()  # a local helper, not the stdlib primitive

@@ -1,0 +1,17 @@
+"""PL007 positives: untimed blocking waits on the request path."""
+
+import threading
+from concurrent.futures import Future
+
+
+def untimed_condition_wait(cond: threading.Condition):
+    with cond:
+        cond.wait()  # PL007: unbounded — cannot observe shutdown
+
+
+def untimed_event_wait(ev: threading.Event):
+    ev.wait()  # PL007: unbounded park
+
+
+def untimed_future_result(fut: Future):
+    return fut.result()  # PL007: hangs forever on a lost wakeup

@@ -215,6 +215,35 @@ class TestLintClean:
             if "serving" in s.path.replace(os.sep, "/")
         ], "serving code must not carry allow() suppressions"
 
+    def test_pl007_lands_at_zero(self, full_report):
+        """ISSUE 8: the request-path-hygiene rule (no untimed
+        Condition.wait / Future.result in serving/) ships with a ZERO
+        baseline and zero allow() sites — every wait the request path
+        performs is bounded from day one, and any new unbounded wait is
+        a lint failure, not a grandfathered hang."""
+        from photon_ml_tpu.lint.core import RULES, _load_rules
+
+        _load_rules()
+        assert "PL007" in RULES, sorted(RULES)
+        entries = [
+            e for e in json.load(open(BASELINE))["entries"]
+            if e["rule"] == "PL007"
+        ]
+        assert entries == [], entries
+        pl007_allows = [
+            s for s in full_report.allow_sites
+            if s.rules & {"PL007", "request-path-hygiene"}
+        ]
+        assert pl007_allows == [], pl007_allows
+        # the rule applies to the live request path: frontend + batcher
+        # + programs are all in the analyzed set
+        serving = [
+            f for f in full_report.files
+            if "photon_ml_tpu/serving/" in f.replace(os.sep, "/")
+        ]
+        assert any(f.endswith("frontend.py") for f in serving), serving
+        assert any(f.endswith("admission.py") for f in serving), serving
+
     def test_json_lists_allow_sites_with_seam_accounting(self, repo_cwd):
         r = subprocess.run(
             [sys.executable, "-m", "photon_ml_tpu.lint",

@@ -86,6 +86,19 @@ class TestRuleFixtures:
     def test_pl005_negative(self):
         assert _violations("pl005_neg.py") == []
 
+    def test_pl007_positive(self):
+        vs = _violations("serving/pl007_pos.py")
+        # untimed Condition.wait, Event.wait, Future.result
+        assert _rules(vs) == ["PL007"] * 3, vs
+
+    def test_pl007_negative(self):
+        # timed waits, done-callback result(timeout=0), local helpers
+        assert _violations("serving/pl007_neg.py") == []
+
+    def test_pl007_out_of_scope(self):
+        # the same untimed waits outside serving/ are not flagged
+        assert _violations("pl007_out_of_scope.py") == []
+
 
 class TestSuppression:
     def test_allow_comments_suppress(self):

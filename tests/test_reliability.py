@@ -114,6 +114,23 @@ class TestFaultPlan:
         assert not plan.entries[0].fires_at(1)
         assert plan.entries[0].fires_at(2)
 
+    def test_serving_request_path_seams_are_registered(self):
+        """ISSUE 8: the front-end read and dispatch seams join the
+        fault surface. Reads never retry (a broken socket is the
+        client's named error, not the service's backoff loop);
+        dispatch is idempotent pure compute, so transients retry on a
+        fast budget."""
+        from photon_ml_tpu.reliability import SEAMS, policy_for
+        from photon_ml_tpu.reliability.retry import _POLICIES
+
+        for seam in ("serving.frontend.read", "serving.dispatch"):
+            assert seam in SEAMS
+            assert seam in _POLICIES
+            plan = FaultPlan.parse(f"{seam}:3:EIO")
+            assert plan.entries[0].seam == seam
+        assert policy_for("serving.frontend.read").max_attempts == 1
+        assert policy_for("serving.dispatch").max_attempts == 3
+
 
 # ---------------------------------------------------------------------------
 # io_call / retry / quarantine

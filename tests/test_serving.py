@@ -1,13 +1,19 @@
-"""Online scoring service tests (ISSUE 7): scoring parity with the
+"""Online scoring service tests (ISSUES 7+8): scoring parity with the
 batch driver (bitwise), micro-batch demux under concurrent submitters,
-hot-swap parity + rollback, padded-shape ladder selection, and the
-zero-recompile / one-readback-per-dispatch contract.
+hot-swap parity + rollback, padded-shape ladder selection, the
+zero-recompile / one-readback-per-dispatch contract, and the
+serving-under-fire layer — admission control (shed/deadline), graceful
+FE-only degradation, and bounded shutdown (every future exactly one
+terminal outcome under clean close, drain, and a KILL fault plan).
 """
 
 import json
 import os
 import shutil
+import subprocess
+import sys
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -18,8 +24,14 @@ from photon_ml_tpu.game.data import build_game_dataset
 from photon_ml_tpu.game.model_io import LoadedGameModel
 from photon_ml_tpu.parallel import overlap
 from photon_ml_tpu.serving import (
+    AdmissionController,
+    BatcherClosed,
+    DeadlineExceeded,
+    DrainTimeout,
     EntityRowIndex,
     MicroBatcher,
+    RequestShed,
+    ScoreOutcome,
     ServingMetrics,
     ServingModel,
     ServingPrograms,
@@ -140,6 +152,27 @@ class TestScoringParity:
         ), "fixture must exercise the unknown entity"
         assert bank.entity_row("userId", missing) == -1
         assert bank.entity_row("userId", "user0") >= 0
+
+    def test_fe_only_model_under_multi_shard_config(self, served):
+        """An FE-only model served with a multi-shard request config:
+        requests carry features for shards the spec never scores — the
+        batch must assemble (and the AOT program run) on exactly the
+        spec's shards, scoring bitwise the FE-only batch path."""
+        _, ds, lm, _bank, _ = served
+        fe = LoadedGameModel()
+        fe.fixed_effects = dict(lm.fixed_effects)
+        bank = make_bank(fe, ds)  # widths cover BOTH shards
+        assert set(bank.shard_widths) == {"g", "u"}
+        assert bank.used_shards == ("g",)
+        programs = ServingPrograms((1, 8))
+        programs.ensure_compiled(bank)
+        ref = batch_reference_scores(fe, ds)
+        with MicroBatcher(lambda: bank, programs) as mb:
+            got = np.asarray(
+                [mb.score(r) for r in requests_from_dataset(ds, bank)],
+                np.float32,
+            )
+        assert np.array_equal(got, ref)
 
     def test_record_assembly_matches_dataset_assembly(self, served):
         """The stdin path (request_from_record through index maps) and
@@ -756,6 +789,389 @@ class TestVectorizedScoreRecords:
         assert [r for part in split for r in part] != []
         for i in range(n):
             assert split[i] == expected[i::n]
+
+
+def _wait_until(cond, timeout=5.0):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if cond():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+class TestAdmissionControl:
+    """ISSUE 8: deadlines, load shedding and bounded submit — every
+    request reaches exactly one NAMED terminal outcome, fast."""
+
+    def _blocked_batcher(self, served, **kw):
+        """A batcher whose dispatcher is parked on a held lock — the
+        deterministic way to build queue depth."""
+        _, ds, lm, bank, programs = served
+        gate = threading.Lock()
+        gate.acquire()
+        metrics = ServingMetrics()
+        mb = MicroBatcher(
+            lambda: bank, programs, metrics, swap_lock=gate, **kw
+        )
+        reqs = requests_from_dataset(ds, bank)
+        return mb, metrics, reqs, gate
+
+    def test_predicted_wait_sheds_immediately(self, served):
+        """Admission refuses a deadlined request UP FRONT when the EWMA
+        service model says the queue already costs more than its
+        deadline — no queue slot, no device work, a named SHED."""
+        admission = AdmissionController()
+        admission.note_dispatch(rows=1, busy_s=10.0)  # 10s per row
+        _, ds, lm, bank, programs = served
+        gate = threading.Lock()
+        gate.acquire()
+        metrics = ServingMetrics()
+        mb = MicroBatcher(
+            lambda: bank, programs, metrics,
+            swap_lock=gate, admission=admission,
+        )
+        reqs = requests_from_dataset(ds, bank)
+        try:
+            f1 = mb.submit(reqs[0])  # claimed by the blocked dispatcher
+            assert _wait_until(lambda: not mb._queue and mb._inflight)
+            f2 = mb.submit(reqs[1])  # no deadline: admitted, queued
+            r3 = reqs[2]
+            r3.deadline_ms = 50.0
+            t0 = time.perf_counter()
+            with pytest.raises(RequestShed, match="predicted queue wait"):
+                mb.submit(r3)
+            assert time.perf_counter() - t0 < 1.0, "shed must be instant"
+        finally:
+            gate.release()
+        assert isinstance(f1.result(timeout=30), float)
+        assert isinstance(f2.result(timeout=30), float)
+        mb.close()
+        assert metrics.snapshot()["sheds"] == {
+            "predicted_wait": 1, "total": 1,
+        }
+
+    def test_full_queue_submit_sheds_after_bounded_wait(self, served):
+        """The round-12 indefinite block is gone: a submitter facing a
+        full queue waits at most its own deadline, then gets SHED."""
+        mb, metrics, reqs, gate = self._blocked_batcher(
+            served, max_queue=1
+        )
+        try:
+            f1 = mb.submit(reqs[0])
+            assert _wait_until(lambda: not mb._queue and mb._inflight)
+            f2 = mb.submit(reqs[1])  # fills the queue
+            r3 = reqs[2]
+            r3.deadline_ms = 100.0
+            t0 = time.perf_counter()
+            with pytest.raises(RequestShed, match="queue full"):
+                mb.submit(r3)
+            elapsed = time.perf_counter() - t0
+            assert 0.05 < elapsed < 5.0, elapsed
+        finally:
+            gate.release()
+        assert isinstance(f1.result(timeout=30), float)
+        assert isinstance(f2.result(timeout=30), float)
+        mb.close()
+        assert metrics.snapshot()["sheds"]["queue_full"] == 1
+
+    def test_expired_request_dropped_before_dispatch(self, served):
+        """A deadline that passes in the queue fails the future with
+        DeadlineExceeded and the device NEVER scores the dead row (the
+        dispatch count does not move)."""
+        mb, metrics, reqs, gate = self._blocked_batcher(served)
+        try:
+            f1 = mb.submit(reqs[0])
+            assert _wait_until(lambda: not mb._queue and mb._inflight)
+            r2 = reqs[1]
+            r2.deadline_ms = 20.0
+            f2 = mb.submit(r2)
+            time.sleep(0.1)  # let the deadline lapse while queued
+        finally:
+            gate.release()
+        assert isinstance(f1.result(timeout=30), float)
+        with pytest.raises(DeadlineExceeded, match="deadline"):
+            f2.result(timeout=30)
+        mb.close()
+        snap = metrics.snapshot()
+        assert snap["deadline_expired"] == 1
+        assert snap["dispatches"] == 1, (
+            "the expired request must never reach the device"
+        )
+
+    def test_default_deadline_applies_to_undeadlined_requests(
+        self, served
+    ):
+        _, ds, lm, bank, programs = served
+        reqs = requests_from_dataset(ds, bank)
+        with MicroBatcher(
+            lambda: bank, programs, default_deadline_ms=1234.0
+        ) as mb:
+            assert reqs[0].deadline_ms is None
+            mb.score(reqs[0])
+            assert reqs[0].deadline_ms == 1234.0
+
+    def test_outcome_is_an_annotated_float(self, served):
+        _, ds, lm, bank, programs = served
+        ref = batch_reference_scores(lm, ds)
+        reqs = requests_from_dataset(ds, bank)
+        with MicroBatcher(lambda: bank, programs) as mb:
+            out = mb.score(reqs[0])
+        assert isinstance(out, ScoreOutcome)
+        assert out == ref[0]  # still a float, still bitwise
+        assert out.degraded is False
+        assert out.generation == bank.generation
+
+    def test_record_deadline_propagates(self, served):
+        recs, ds, lm, bank, _ = served
+        rec = dict(recs[0])
+        rec["deadline_ms"] = 75.5
+        req = request_from_record(rec, bank, SHARDS)
+        assert req.deadline_ms == 75.5
+        assert request_from_record(recs[0], bank, SHARDS).deadline_ms is None
+
+
+class TestGracefulDegradation:
+    """ISSUE 8: RE-bank trouble degrades to the FE-only score (bitwise
+    the batch scorer's unknown-entity semantics) with a flag — never a
+    failed request."""
+
+    def _fe_only_reference(self, lm, ds):
+        fe = LoadedGameModel()
+        fe.fixed_effects = dict(lm.fixed_effects)
+        return batch_reference_scores(fe, ds)
+
+    def test_quarantined_re_scores_fe_only_bitwise(self, served):
+        _, ds, lm, bank, programs = served
+        ref_fe = self._fe_only_reference(lm, ds)
+        ref_full = batch_reference_scores(lm, ds)
+        assert not np.array_equal(ref_fe, ref_full), (
+            "fixture must make degradation observable"
+        )
+        bank.quarantine_re("userId")
+        metrics = ServingMetrics()
+        reqs = requests_from_dataset(ds, bank)
+        with MicroBatcher(lambda: bank, programs, metrics) as mb:
+            outs = [mb.score(r) for r in reqs]
+        got = np.asarray(outs, np.float32)
+        assert np.array_equal(got, ref_fe), (
+            "degraded scores must be bitwise the batch scorer's "
+            "FE-only path"
+        )
+        assert all(o.degraded for o in outs)
+        assert metrics.snapshot()["degraded_responses"] == len(reqs)
+
+    def test_unknown_re_type_quarantine_rejected(self, served):
+        _, ds, lm, bank, _ = served
+        with pytest.raises(ValueError, match="unknown random-effect"):
+            bank.quarantine_re("no-such-type")
+
+    def test_row_resolution_failure_degrades_then_quarantines(
+        self, served
+    ):
+        """A dying entity index (e.g. the native mmap store lost mid-
+        swap) degrades affected rows FE-only; after RE_QUARANTINE_AFTER
+        consecutive failures the type is quarantined so later requests
+        stop paying the failing lookup."""
+        from photon_ml_tpu.serving.batcher import RE_QUARANTINE_AFTER
+
+        _, ds, lm, bank, programs = served
+        ref_fe = self._fe_only_reference(lm, ds)
+
+        class DyingIndex:
+            calls = 0
+
+            def rows_of(self, ids):
+                DyingIndex.calls += 1
+                raise RuntimeError("entity store died")
+
+        bank.entity_rows["userId"] = DyingIndex()
+        metrics = ServingMetrics()
+        reqs = requests_from_dataset(ds, bank)
+        n = RE_QUARANTINE_AFTER + 2
+        with MicroBatcher(lambda: bank, programs, metrics) as mb:
+            outs = [mb.score(reqs[i]) for i in range(n)]
+        got = np.asarray(outs, np.float32)
+        assert np.array_equal(got, ref_fe[:n])
+        assert all(o.degraded for o in outs)
+        assert "userId" in bank.quarantined_re_types
+        # after quarantine the failing store is no longer consulted
+        assert DyingIndex.calls == RE_QUARANTINE_AFTER
+        snap = metrics.snapshot()
+        assert snap["re_resolution_failures"] == {
+            "userId": RE_QUARANTINE_AFTER
+        }
+        assert snap["re_quarantines"] == {"userId": 1}
+        assert snap["degraded_responses"] == n
+
+    def test_swap_installs_a_clean_bank(self, served, rng):
+        """Quarantine is per-generation: a hot swap's fresh bank starts
+        with no quarantined coordinates."""
+        _, ds, lm, bank, programs = served
+        bank.quarantine_re("userId")
+        sm = ServingModel(bank, programs)
+        imaps = {sid: sd.index_map for sid, sd in ds.shards.items()}
+        widths = {sid: sd.indices.shape[1] for sid, sd in ds.shards.items()}
+        staged = build_model_bank(
+            synth_model(rng, scale=2.0), imaps, widths, device=False
+        )
+        res = sm.swap_to_bank(staged)
+        assert res.ok
+        assert sm.current().quarantined_re_types == set()
+
+
+class TestShutdownAndDrain:
+    """Satellites 1+3: close/drain semantics — blocked submitters wake
+    and raise, every in-flight future reaches exactly one terminal
+    state, and a bounded drain never leaves a hung future."""
+
+    def test_close_under_saturated_queue_wakes_blocked_submitters(
+        self, served
+    ):
+        """Satellite 1: a submitter parked on a FULL queue must wake
+        and raise when another thread closes the batcher — not hang."""
+        _, ds, lm, bank, programs = served
+        gate = threading.Lock()
+        gate.acquire()
+        mb = MicroBatcher(
+            lambda: bank, programs, swap_lock=gate, max_queue=1
+        )
+        reqs = requests_from_dataset(ds, bank)
+        f1 = mb.submit(reqs[0])
+        assert _wait_until(lambda: not mb._queue and mb._inflight)
+        f2 = mb.submit(reqs[1])  # saturates the queue
+        blocked_outcome = []
+
+        def blocked_submitter():
+            try:
+                mb.submit(reqs[2])
+                blocked_outcome.append("admitted")
+            except BatcherClosed:
+                blocked_outcome.append("closed")
+            except BaseException as e:  # pragma: no cover
+                blocked_outcome.append(e)
+
+        t = threading.Thread(target=blocked_submitter)
+        t.start()
+        time.sleep(0.1)  # park it on the full queue
+        closer = threading.Thread(target=mb.close)
+        closer.start()
+        t.join(timeout=10)
+        assert not t.is_alive(), "blocked submitter hung across close()"
+        assert blocked_outcome == ["closed"]
+        gate.release()  # let the dispatcher finish the claimed work
+        closer.join(timeout=10)
+        assert not closer.is_alive()
+        # the admitted requests still reached their terminal results
+        assert isinstance(f1.result(timeout=10), float)
+        assert isinstance(f2.result(timeout=10), float)
+
+    def test_clean_close_resolves_every_future(self, served):
+        _, ds, lm, bank, programs = served
+        reqs = requests_from_dataset(ds, bank)
+        mb = MicroBatcher(lambda: bank, programs)
+        futs = [mb.submit(r) for r in reqs]
+        mb.close()
+        assert all(f.done() for f in futs)
+        assert [f.result(timeout=0) for f in futs]
+
+    def test_drain_serves_queue_inside_budget(self, served):
+        _, ds, lm, bank, programs = served
+        metrics = ServingMetrics()
+        reqs = requests_from_dataset(ds, bank)
+        mb = MicroBatcher(lambda: bank, programs, metrics)
+        futs = [mb.submit(r) for r in reqs]
+        report = mb.drain(30.0)
+        assert report.failed == 0 and not report.timed_out
+        assert all(f.done() for f in futs)
+        assert [f.result(timeout=0) for f in futs]
+        assert metrics.snapshot()["drain"]["failed"] == 0
+        with pytest.raises(BatcherClosed):
+            mb.submit(reqs[0])
+
+    def test_drain_timeout_fails_leftovers_with_named_error(
+        self, served
+    ):
+        """A wedged dispatcher cannot turn SIGTERM into a hang: at the
+        budget, every still-pending future (queued AND in-flight) fails
+        with DRAIN_TIMEOUT — exactly one terminal outcome each."""
+        _, ds, lm, bank, programs = served
+        gate = threading.Lock()
+        gate.acquire()
+        metrics = ServingMetrics()
+        mb = MicroBatcher(lambda: bank, programs, metrics, swap_lock=gate)
+        reqs = requests_from_dataset(ds, bank)
+        futs = [mb.submit(r) for r in reqs[:5]]
+        assert _wait_until(lambda: mb._inflight)
+        report = mb.drain(0.3)
+        assert report.timed_out and report.failed == len(futs)
+        for f in futs:
+            assert f.done(), "drain left a hung future"
+            with pytest.raises(DrainTimeout):
+                f.result(timeout=0)
+        snap = metrics.snapshot()
+        assert snap["drain"]["failed"] == len(futs)
+        assert snap["drain"]["timed_out"] is True
+        # un-wedge: the dispatcher finishes its claimed batch, finds
+        # every future already terminal (no double resolution), exits
+        gate.release()
+        assert _wait_until(lambda: not mb.alive(), timeout=10)
+
+    def test_drain_is_idempotent_after_close(self, served):
+        _, ds, lm, bank, programs = served
+        mb = MicroBatcher(lambda: bank, programs)
+        mb.close()
+        report = mb.drain(1.0)
+        assert report.pending_at_start == 0 and report.failed == 0
+
+    def test_heartbeat_beats_while_idle(self, served):
+        _, ds, lm, bank, programs = served
+        with MicroBatcher(lambda: bank, programs) as mb:
+            assert mb.alive()
+            time.sleep(0.6)  # > 2 heartbeat intervals, zero traffic
+            assert mb.heartbeat_age_s() < 0.5, (
+                "idle dispatcher must keep beating"
+            )
+
+    def test_kill_fault_plan_dies_instead_of_hanging(self, tmp_path):
+        """Satellite 3, the KILL arm: a deterministic SIGKILL at the
+        serving.dispatch crossing kills the process AT that crossing —
+        promptly (no drain, no atexit, no hang), which is the crash the
+        resume/ops machinery must assume."""
+        script = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from photon_ml_tpu.reliability import install_plan
+from photon_ml_tpu.serving import (
+    MicroBatcher, ScoreRequest, ServingPrograms, bank_from_arrays,
+)
+
+bank = bank_from_arrays(
+    fixed=[("global", "g", np.ones(8, np.float32))],
+    shard_widths={"g": 2},
+)
+programs = ServingPrograms((1, 4))
+programs.ensure_compiled(bank)
+install_plan("serving.dispatch:1:KILL")
+mb = MicroBatcher(lambda: bank, programs)
+fut = mb.submit(ScoreRequest(
+    uid="x",
+    indices={"g": np.zeros(2, np.int32)},
+    values={"g": np.zeros(2, np.float32)},
+    entity_ids={},
+))
+import time
+time.sleep(60)
+print("SURVIVED")
+"""
+        r = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert r.returncode == -9, (r.returncode, r.stdout, r.stderr)
+        assert "SURVIVED" not in r.stdout
 
 
 class TestServingDriverValidation:
