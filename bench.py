@@ -83,6 +83,23 @@ def main():
         result["detail"]["overload"] = _overload_config(
             "overload"
         )["detail"]
+        # pod-scale GAME weak-scaling accounting is bytes + parity +
+        # readback discipline — all valid on the virtual CPU mesh; only
+        # the throughput-scaling gate is chip-only. Force the 8-device
+        # mesh when this process hasn't pinned one (fresh subprocess
+        # path; in-process callers already chose their device count).
+        if len(jax.devices()) >= 2:
+            result["detail"]["pod_game"] = _pod_game_config(
+                "pod_game"
+            )["detail"]
+        else:
+            result["detail"]["pod_game"] = {
+                "note": (
+                    "single visible device: run "
+                    "dev-scripts/bench_pod_game.sh (forces the 8-device "
+                    "virtual CPU mesh) for the sharded A/B"
+                )
+            }
         result["detail"]["note"] = (
             "CPU-only host (accelerator unreachable); kernel-path "
             "microbench and BASELINE suite skipped — see the last "
@@ -1330,6 +1347,186 @@ def _streaming_game_config(name, *, n_files=3, rows_per_file=6000,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _pod_game_config(name, *, n=16384, E=2048, d=32, k=8, iters=3, seed=0):
+    """Pod-scale GAME A/B (game/pod.py): entity-hash-sharded RE bank
+    update + two-hop routed scoring vs the replicated bucket path on the
+    SAME in-memory dataset, at every available shard count.
+
+    Emits the weak-scaling accounting the round artifact carries:
+    per-device bank + optimizer-state bytes (replicated vs sharded at
+    N = all visible devices), a weak-scaling table where total
+    coefficients GROW with N while per-device bytes stay flat, parity
+    (bank/score max-abs-diff vs the replicated update), routed-path
+    readback count (must be 0 — the overlap.device_get seam), and
+    update+score throughput both ways. Gates live in
+    dev-scripts/bench_pod_game.sh (host-class-aware: bytes + parity +
+    zero-readback everywhere; the throughput-scaling gate is chip-only —
+    virtual CPU devices EMULATE collectives on one core, so sharded
+    wall-clock on this container measures emulation, not ICI)."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.config import (
+        ProjectorType,
+        RandomEffectDataConfiguration,
+    )
+    from photon_ml_tpu.game.data import EntityIndex, GameDataset, ShardData
+    from photon_ml_tpu.game.pod import (
+        EntityShardSpec,
+        PodRandomEffectProblem,
+        ShardedREBank,
+        per_device_bytes,
+    )
+    from photon_ml_tpu.game.random_effect import (
+        RandomEffectOptimizationProblem,
+        score_random_effect,
+    )
+    from photon_ml_tpu.game.random_effect_data import (
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.ops.losses import LOGISTIC
+    from photon_ml_tpu.optim.config import (
+        OptimizerConfig,
+        RegularizationContext,
+        RegularizationType,
+    )
+    from photon_ml_tpu.parallel import overlap
+    from photon_ml_tpu.parallel.mesh import entity_mesh
+    from photon_ml_tpu.utils.index_map import IndexMap, feature_key
+
+    rng = np.random.default_rng(seed)
+    codes = rng.integers(0, E, size=n).astype(np.int32)
+    ix = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    v = rng.normal(size=(n, k)).astype(np.float32)
+    lab = (rng.uniform(size=n) > 0.5).astype(np.float32)
+    w = np.ones(n, np.float32)
+    off = (rng.normal(size=n) * 0.1).astype(np.float32)
+    imap = IndexMap.build(
+        (feature_key(f"f{i}", "") for i in range(d)), add_intercept=False
+    )
+    ds = GameDataset(
+        uids=[str(i) for i in range(n)],
+        labels=lab, offsets=off, weights=w,
+        shards={"s": ShardData(ix, v, imap, None)},
+        entity_codes={"user": codes},
+        entity_indexes={
+            "user": EntityIndex.build("user", [f"e{i:06d}" for i in range(E)])
+        },
+        num_real_rows=n,
+    )
+    red = build_random_effect_dataset(
+        ds,
+        RandomEffectDataConfiguration(
+            random_effect_type="user", feature_shard_id="s",
+            projector_type=ProjectorType.IDENTITY,
+        ),
+    )
+    resid = jnp.asarray(off)
+
+    def make_problem():
+        return RandomEffectOptimizationProblem(
+            LOGISTIC, OptimizerConfig(max_iter=5),
+            RegularizationContext(RegularizationType.L2), reg_weight=1.0,
+        )
+
+    def run_replicated():
+        problem = make_problem()
+        bank = jnp.zeros((red.num_entities, red.local_dim), jnp.float32)
+        bank, _, var = problem.update_bank(
+            bank, red, residual_offsets=resid, with_variances=True
+        )
+        scores = score_random_effect(bank, red)
+        jax.block_until_ready((bank, var, scores))  # photon: allow(hidden-host-sync) — timing harness syncs deliberately
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            bank, _, var = problem.update_bank(
+                bank, red, residual_offsets=resid, with_variances=True
+            )
+            scores = score_random_effect(bank, red)
+        jax.block_until_ready((bank, var, scores))  # photon: allow(hidden-host-sync) — timing harness syncs deliberately
+        return bank, var, scores, (time.perf_counter() - t0) / iters
+
+    ref_bank, ref_var, ref_scores, rep_s = run_replicated()
+    replicated_state_bytes = int(ref_bank.nbytes) + int(ref_var.nbytes)
+
+    n_dev = len(jax.devices())
+    mesh = entity_mesh(n_dev)
+    pod = PodRandomEffectProblem(make_problem(), mesh)
+    view = pod.pod_view(red)
+    bank = pod.init_bank(red)
+    bank, _, var = pod.update_bank(
+        bank, red, residual_offsets=resid, with_variances=True,
+        defer_tracker=True,
+    )
+    scores = pod.score(bank, red)
+    jax.block_until_ready((bank.data, var.data, scores))  # photon: allow(hidden-host-sync) — timing harness syncs deliberately
+    overlap.reset_readback_stats()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        bank, _, var = pod.update_bank(
+            bank, red, residual_offsets=resid, with_variances=True,
+            defer_tracker=True,
+        )
+        scores = pod.score(bank, red)
+    jax.block_until_ready((bank.data, var.data, scores))  # photon: allow(hidden-host-sync) — timing harness syncs deliberately
+    pod_s = (time.perf_counter() - t0) / iters
+    routed_readbacks = overlap.readback_stats()
+
+    bank_diff, score_diff = (
+        float(x) for x in overlap.device_get((
+            jnp.max(jnp.abs(bank.to_global() - ref_bank)),
+            jnp.max(jnp.abs(scores - ref_scores)),
+        ))
+    )
+    sharded_state_bytes = per_device_bytes(bank, var)
+
+    # weak scaling: total coefficients GROW with the shard count while
+    # per-device bank+optimizer bytes stay ~flat (the "hundreds of
+    # billions of coefficients" shape, PAPER.md, at toy scale)
+    weak = []
+    for ns in (1, 2, 4, 8):
+        if ns > n_dev:
+            continue
+        spec = EntityShardSpec(ns, E * ns)
+        m = entity_mesh(ns)
+        b = ShardedREBank.zeros(m, spec, d)
+        vb = ShardedREBank.zeros(m, spec, d)
+        weak.append({
+            "shards": ns,
+            "entities": E * ns,
+            "coefficients": E * ns * d,
+            "per_device_state_bytes": per_device_bytes(b, vb),
+        })
+
+    return {
+        "config": name,
+        "metric": "pod_game_per_device_state_bytes",
+        "value": sharded_state_bytes,
+        "unit": f"bytes/device at {n_dev} entity shards (bank + variances)",
+        "detail": {
+            "n": n, "entities": E, "dim": d, "n_shards": n_dev,
+            "replicated_state_bytes": replicated_state_bytes,
+            "sharded_per_device_state_bytes": sharded_state_bytes,
+            "bytes_ratio": round(
+                sharded_state_bytes / max(replicated_state_bytes, 1), 4
+            ),
+            "per_device_data_bytes": view.per_device_data_bytes(),
+            "bank_max_abs_diff": bank_diff,
+            "score_max_abs_diff": score_diff,
+            "routed_readbacks": routed_readbacks,
+            "replicated_step_s": round(rep_s, 4),
+            "sharded_step_s": round(pod_s, 4),
+            "throughput_ratio": round(rep_s / max(pod_s, 1e-9), 3),
+            "weak_scaling": weak,
+            "host": {
+                "cpu_count": os.cpu_count(),
+                "devices": n_dev,
+                "platform": jax.devices()[0].platform,
+            },
+        },
+    }
+
+
 def _reliability_config(name, *, n_chunks=8, rows=65536, k=16,
                         passes=10, seed=0):
     """Reliability-layer overhead A/B (round 11): the spill-read/write
@@ -2429,6 +2626,14 @@ def suite(only=None):
         results.append(_overload_config("11_overload"))
         print(json.dumps(results[-1]), flush=True)
 
+    # 12: pod-scale GAME (ISSUE 9): entity-sharded RE banks + two-hop
+    # routed residuals vs the replicated path — per-device state bytes,
+    # parity, zero routed readbacks, weak-scaling table; gates in
+    # dev-scripts/bench_pod_game.sh.
+    if want("12_pod_game"):
+        results.append(_pod_game_config("12_pod_game"))
+        print(json.dumps(results[-1]), flush=True)
+
     path = "BASELINE_RESULTS.json"
     merged = {}
     if only is not None and os.path.exists(path):
@@ -2482,6 +2687,10 @@ if __name__ == "__main__":
         # dev-scripts/bench_streaming_game.sh entry: the streamed GAME
         # CD A/B as one JSON line (gates applied by the script)
         print(json.dumps(_streaming_game_config("streaming_game")))
+    elif "--pod-game" in sys.argv:
+        # dev-scripts/bench_pod_game.sh entry: the entity-sharded GAME
+        # A/B as one JSON line (gates applied by the script)
+        print(json.dumps(_pod_game_config("pod_game")))
     elif "--suite" in sys.argv:
         only = None
         if "--only" in sys.argv:

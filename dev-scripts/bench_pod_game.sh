@@ -1,0 +1,97 @@
+#!/usr/bin/env bash
+# Pod-scale GAME A/B (game/pod.py): entity-hash-sharded RE banks +
+# two-hop routed residuals vs the replicated bucket path
+# (bench.py --pod-game) with host-class-aware gates.
+#
+# Gates applied EVERYWHERE (correctness-grade, device-count only needs
+# the virtual CPU mesh):
+#   - weak scaling: per-device bank+optimizer-state bytes stay ~flat
+#     (<= 1.3x spread) while total coefficients grow with the shard
+#     count, and the sharded bytes at N shards are <= 1/N of the
+#     replicated path + hash-padding slack;
+#   - parity: sharded bank and routed scores match the replicated
+#     update within the fp32 envelope;
+#   - zero host-side gathers on the routed path (the counted
+#     overlap.device_get seam).
+# The throughput-scaling gate is CHIP-ONLY: virtual CPU devices emulate
+# every collective participant on one core, so sharded wall-clock here
+# measures XLA's emulation, not ICI (PHOTON_POD_GAME_MIN_RATIO
+# overrides the chip gate, default 0.9x at equal model size — the win
+# this path buys is CAPACITY, per-device bytes, not single-model speed).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# no accelerator -> force the 8-device virtual CPU mesh
+if [ "${JAX_PLATFORMS:-}" = "" ] || [ "${JAX_PLATFORMS:-}" = "cpu" ]; then
+  export JAX_PLATFORMS=cpu
+  case "${XLA_FLAGS:-}" in
+    *xla_force_host_platform_device_count*) ;;
+    *) export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" ;;
+  esac
+fi
+
+OUT=$(mktemp -t photon-pod-game-XXXXXX.json)
+trap 'rm -f "$OUT"' EXIT
+
+python bench.py --pod-game | tail -1 > "$OUT"
+
+python - "$OUT" <<'EOF'
+import json, os, sys
+
+r = json.load(open(sys.argv[1]))
+d = r["detail"]
+print(json.dumps(r, indent=2))
+
+n = d["n_shards"]
+assert n >= 2, f"pod A/B needs >= 2 devices, got {n}"
+
+# -- weak scaling: per-device bytes flat as coefficients grow ----------
+per_dev = [row["per_device_state_bytes"] for row in d["weak_scaling"]]
+spread = max(per_dev) / max(min(per_dev), 1)
+assert spread <= 1.3, (
+    f"per-device state bytes not flat across the weak-scaling table: "
+    f"{per_dev} (spread {spread:.2f}x)"
+)
+coef = [row["coefficients"] for row in d["weak_scaling"]]
+assert coef[-1] > coef[0], coef
+print(f"weak scaling: coefficients {coef[0]} -> {coef[-1]}, "
+      f"per-device state bytes {per_dev} (spread {spread:.2f}x)")
+
+# -- sharded bytes <= 1/N of replicated + hash-padding slack -----------
+ratio = d["bytes_ratio"]
+assert ratio <= 1.0 / n * 1.25 + 1e-9, (
+    f"sharded per-device state {ratio:.4f}x of replicated exceeds "
+    f"1/{n} + 25% padding slack"
+)
+print(f"per-device state {d['sharded_per_device_state_bytes']} B = "
+      f"{ratio:.4f}x of replicated {d['replicated_state_bytes']} B "
+      f"(gate <= {1.0 / n * 1.25:.4f}x)")
+
+# -- parity ------------------------------------------------------------
+assert d["bank_max_abs_diff"] <= 1e-3, d["bank_max_abs_diff"]
+assert d["score_max_abs_diff"] <= 1e-3, d["score_max_abs_diff"]
+print(f"parity: bank diff {d['bank_max_abs_diff']}, "
+      f"score diff {d['score_max_abs_diff']}")
+
+# -- routed path crosses the host ZERO times ---------------------------
+assert d["routed_readbacks"] == 0, (
+    f"routed update/score path performed {d['routed_readbacks']} "
+    "host readbacks (expected 0)"
+)
+print("routed path: 0 host readbacks")
+
+# -- throughput gate (chip-only) ---------------------------------------
+platform = d["host"]["platform"]
+if platform == "cpu":
+    print(f"cpu host-class: throughput ratio {d['throughput_ratio']}x "
+          "recorded (chip-only gate; virtual devices emulate "
+          "collectives on one core)")
+else:
+    gate = float(os.environ.get("PHOTON_POD_GAME_MIN_RATIO", "0.9"))
+    ratio = d["throughput_ratio"]
+    print(f"sharded step {d['sharded_step_s']}s vs replicated "
+          f"{d['replicated_step_s']}s ({ratio}x; gate >= {gate}x)")
+    assert ratio >= gate, f"throughput ratio {ratio}x below {gate}x"
+
+print("bench_pod_game: PASS")
+EOF

@@ -211,6 +211,13 @@ class GameTrainingParams:
     # banks keep sharding entities over a 1-D mesh
     distributed: str = "auto"
     model_shards: Optional[int] = None  # model-axis size for "feature"
+    # Pod-scale GAME (game/pod.py): shard every random-effect bank —
+    # plus its optimizer/tracker state and per-entity data — over an
+    # N-device "entity" mesh by entity hash, with two-hop all_to_all
+    # residual routing. 0/None keeps the replicated banks; -1 uses
+    # every visible device; N uses the first N. Composes with
+    # --streaming (each device stages only its shard of a segment).
+    entity_shards: Optional[int] = None
     # Multi-host orchestration (SparkContextConfiguration analog).
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
@@ -307,6 +314,25 @@ class GameTrainingParams:
                 f"unknown grid mode {self.grid_mode!r}; expected "
                 "batched | sequential | auto"
             )
+        if self.entity_shards is not None and self.entity_shards not in (
+            0, -1
+        ) and self.entity_shards < 1:
+            raise ValueError(
+                f"entity-shards must be -1, 0 or >= 1, got "
+                f"{self.entity_shards}"
+            )
+        if self.entity_shards not in (None, 0):
+            if self.factored_re_configs:
+                raise ValueError(
+                    "--entity-shards supports plain random-effect "
+                    "coordinates only (factored REs re-project rows "
+                    "through a replicated latent view)"
+                )
+            if self.compute_variance and self.streaming:
+                raise ValueError(
+                    "--entity-shards with --streaming does not support "
+                    "--compute-variance yet"
+                )
         if self.grid_memory_budget < 1:
             raise ValueError("grid-memory-budget must be >= 1")
         if self.streaming:
@@ -419,6 +445,15 @@ class GameTrainingDriver:
         mode = self.params.distributed
         return maybe_make_mesh("auto" if mode == "feature" else mode)
 
+    def _entity_mesh(self):
+        """Pod-scale entity mesh (--entity-shards), or None for the
+        replicated random-effect banks."""
+        from photon_ml_tpu.parallel.mesh import entity_mesh
+        from photon_ml_tpu.training import resolve_entity_shards
+
+        n = resolve_entity_shards(self.params.entity_shards)
+        return entity_mesh(n) if n is not None else None
+
     def _fe_mesh(self):
         """Mesh for the fixed-effect solves: the 2-D (data, model) mesh in
         "feature" mode (feature-sharded coefficients inside the GAME CD),
@@ -439,6 +474,7 @@ class GameTrainingDriver:
         p = self.params
         mesh = self._mesh()
         fe_mesh = self._fe_mesh()
+        pod_mesh = self._entity_mesh()
         coords = {}
         for name, dcfg in p.fixed_effect_data_configs.items():
             ocfg = opt_combo[name]
@@ -468,7 +504,8 @@ class GameTrainingDriver:
                 ocfg.optimizer_config,
                 ocfg.regularization,
                 reg_weight=ocfg.reg_weight,
-                mesh=mesh,
+                # the pod layer owns placement on the entity-sharded path
+                mesh=None if pod_mesh is not None else mesh,
                 # plain RE coordinates attach per-entity variances; the
                 # factored path persists in the ORIGINAL space where the
                 # latent-space Hdiag does not transform diagonally
@@ -491,6 +528,15 @@ class GameTrainingDriver:
                     ),
                     config=fcfg,
                     reg_weight_projection=ocfg.reg_weight,
+                )
+            elif pod_mesh is not None:
+                from photon_ml_tpu.game.coordinate import (
+                    PodRandomEffectCoordinate,
+                )
+
+                coords[name] = PodRandomEffectCoordinate(
+                    name=name, dataset=dataset, re_dataset=red,
+                    problem=problem, mesh=pod_mesh,
                 )
             else:
                 coords[name] = RandomEffectCoordinate(
@@ -914,6 +960,7 @@ class GameTrainingDriver:
                         logger=self.logger,
                         checkpoint_dir=combo_ckpt_dir,
                         preemption_guard=guard,
+                        entity_mesh=self._entity_mesh(),
                     )
                 self.results.append((combo, result, ci))
                 metric = result.best_metric
@@ -1375,6 +1422,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="model-axis size for --distributed feature (default 2)",
     )
     ap.add_argument(
+        "--entity-shards", type=int, default=None,
+        help="pod-scale GAME: shard random-effect banks + their "
+        "optimizer state over an N-device entity mesh by entity hash "
+        "(all_to_all residual routing); -1 = all devices, 0/unset = "
+        "replicated banks",
+    )
+    ap.add_argument(
         "--fault-plan", default=None,
         help="deterministic fault injection, e.g. "
         "'spill_write:2:EIO,ckpt_save:1:ENOSPC' (seam:nth:error[:times])"
@@ -1527,6 +1581,7 @@ def params_from_args(argv=None) -> GameTrainingParams:
         delete_output_dir_if_exists=_bool(ns.delete_output_dir_if_exists),
         distributed=ns.distributed,
         model_shards=ns.model_shards,
+        entity_shards=ns.entity_shards,
         coordinator_address=ns.coordinator_address,
         num_processes=ns.num_processes,
         process_id=ns.process_id,

@@ -27,12 +27,19 @@ from photon_ml_tpu.game.data import (
     build_game_dataset,
     build_game_dataset_from_files,
 )
+from photon_ml_tpu.game.coordinate import PodRandomEffectCoordinate
 from photon_ml_tpu.game.model import (
     DatumScoringModel,
     FixedEffectModel,
     GameModel,
     MatrixFactorizationModel,
     RandomEffectModel,
+)
+from photon_ml_tpu.game.pod import (
+    EntityShardSpec,
+    PodRandomEffectModel,
+    PodRandomEffectProblem,
+    ShardedREBank,
 )
 from photon_ml_tpu.game.random_effect import (
     RandomEffectOptimizationProblem,
@@ -57,6 +64,11 @@ __all__ = [
     "FixedEffectCoordinate",
     "MatrixFactorizationCoordinate",
     "RandomEffectCoordinate",
+    "PodRandomEffectCoordinate",
+    "EntityShardSpec",
+    "PodRandomEffectModel",
+    "PodRandomEffectProblem",
+    "ShardedREBank",
     "CoordinateDescent",
     "CoordinateDescentResult",
     "EntityIndex",

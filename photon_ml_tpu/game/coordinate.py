@@ -499,6 +499,93 @@ class RandomEffectCoordinate(Coordinate):
 
 
 @dataclass
+class PodRandomEffectCoordinate(Coordinate):
+    """Entity-sharded random-effect block (pod-scale GAME, game/pod.py):
+    the bank, variances and per-entity data shard over the ``entity``
+    mesh axis by entity hash, each replica solves only its own entities
+    (cross-replica sharded update), and the residual currency rides a
+    two-hop all_to_all — residuals in, scores out — instead of any
+    host gather. Model state is a PodRandomEffectModel whose replicated
+    ``bank`` view materializes lazily (export/validation only)."""
+
+    name: str
+    dataset: GameDataset
+    re_dataset: RandomEffectDataset
+    problem: RandomEffectOptimizationProblem  # mesh-less base
+    mesh: object = None  # 1-D entity mesh (required)
+
+    def __post_init__(self):
+        from photon_ml_tpu.game.pod import PodRandomEffectProblem
+
+        if self.mesh is None:
+            raise ValueError("PodRandomEffectCoordinate requires an entity mesh")
+        self.pod = PodRandomEffectProblem(self.problem, self.mesh)
+
+    def initialize_model(self):
+        from photon_ml_tpu.game.pod import PodRandomEffectModel
+
+        return PodRandomEffectModel(
+            self.pod.init_bank(self.re_dataset),
+            self.re_dataset,
+            self.re_dataset.config.random_effect_type,
+            self.re_dataset.config.feature_shard_id,
+        )
+
+    def update_model(self, model, residual=None):
+        from photon_ml_tpu.game.pod import PodRandomEffectModel
+
+        offsets = self.dataset.offsets
+        if residual is not None:
+            offsets = jnp.asarray(offsets) + residual  # device-resident
+        bank = getattr(model, "sharded_bank", None)
+        if bank is None and model is not None:
+            bank = model.bank  # warm start from a replicated model
+        variances = None
+        if self.problem.compute_variances:
+            bank, tracker, variances = self.pod.update_bank(
+                bank, self.re_dataset, residual_offsets=offsets,
+                with_variances=True, defer_tracker=True,
+            )
+        else:
+            bank, tracker = self.pod.update_bank(
+                bank, self.re_dataset, residual_offsets=offsets,
+                defer_tracker=True,
+            )
+        return (
+            PodRandomEffectModel(
+                bank,
+                self.re_dataset,
+                self.re_dataset.config.random_effect_type,
+                self.re_dataset.config.feature_shard_id,
+                variances_sharded=variances,
+            ),
+            tracker,
+        )
+
+    def score(self, model) -> Array:
+        bank = getattr(model, "sharded_bank", None)
+        if bank is None:
+            return score_random_effect(model.bank, self.re_dataset)
+        return self.pod.score(bank, self.re_dataset)
+
+    def regularization_term(self, model) -> float:
+        from photon_ml_tpu.parallel import overlap
+
+        return float(
+            overlap.device_get(self.regularization_term_device(model))
+        )
+
+    def regularization_term_device(self, model) -> Array:
+        bank = getattr(model, "sharded_bank", None)
+        if bank is None:
+            bank = model.bank
+        return self.pod.regularization_term_device(bank)
+
+    def prepare(self, model=None) -> None:
+        self.pod.prepare(self.re_dataset)
+
+
+@dataclass
 class FactoredRandomEffectCoordinate(Coordinate):
     """Random effects in a LEARNED latent projection: alternate
     (1) per-entity solves in latent space and (2) a distributed fit of the

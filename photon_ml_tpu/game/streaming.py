@@ -1014,7 +1014,14 @@ class StreamingRandomEffectCoordinate:
     bucket solvers (RandomEffectOptimizationProblem.update_bank, one
     single-bucket dataset per segment) instead of holding a resident
     bank of [E_b, S, k] blocks; the residual folds into each segment's
-    offsets via a global-row-id gather against the on-disk score store."""
+    offsets via a global-row-id gather against the on-disk score store.
+
+    ``mesh`` (pod-scale GAME, game/pod.py): with an entity mesh the
+    bank lives SHARDED over the mesh by entity hash and each segment's
+    solve is a cross-replica sharded step — every device stages and
+    solves only its own shard of the segment, so streaming composes
+    with entity sharding: disk bounds the host, the hash bounds each
+    device."""
 
     name: str
     store: GameChunkStore
@@ -1022,9 +1029,20 @@ class StreamingRandomEffectCoordinate:
     problem: object  # RandomEffectOptimizationProblem
     config: RandomEffectDataConfiguration
     local_dim: int = 0  # IDENTITY projector: the shard dimension
+    mesh: object = None  # optional 1-D entity mesh (sharded banks)
 
     def __post_init__(self):
-        pass
+        self._pod = None
+        if self.mesh is not None:
+            if self.problem.compute_variances:
+                raise ValueError(
+                    "streaming entity-sharded training does not support "
+                    "compute_variances yet; drop --entity-shards or the "
+                    "variance flag"
+                )
+            from photon_ml_tpu.game.pod import PodRandomEffectProblem
+
+            self._pod = PodRandomEffectProblem(self.problem, self.mesh)
 
     @property
     def num_entities(self) -> int:
@@ -1033,6 +1051,14 @@ class StreamingRandomEffectCoordinate:
     def initialize_bank(self):
         import jax.numpy as jnp
 
+        if self._pod is not None:
+            from photon_ml_tpu.game.pod import EntityShardSpec, ShardedREBank
+
+            return ShardedREBank.zeros(
+                self.mesh,
+                EntityShardSpec(self._pod.num_shards, self.num_entities),
+                self.local_dim,
+            )
         return jnp.zeros(
             (self.num_entities, self.local_dim), jnp.float32
         )
@@ -1073,6 +1099,8 @@ class StreamingRandomEffectCoordinate:
         import jax.numpy as jnp
 
         res_flat = residual.flat() if residual is not None else None
+        if self._pod is not None:
+            return self._update_sharded(bank, res_flat)
         tracker = None
         var_bank = None
         if self.problem.compute_variances:
@@ -1098,6 +1126,55 @@ class StreamingRandomEffectCoordinate:
             self._var_bank = var_bank
         return bank, tracker
 
+    def _coerce_sharded(self, bank):
+        from photon_ml_tpu.game.pod import EntityShardSpec, ShardedREBank
+
+        if isinstance(bank, ShardedREBank):
+            return bank
+        # replicated [E, d] (checkpoint restore / warm start): shard it
+        return ShardedREBank.from_global(
+            self.mesh,
+            EntityShardSpec(self._pod.num_shards, self.num_entities),
+            bank,
+        )
+
+    def _update_sharded(self, bank, res_flat):
+        """Pod path: every segment solves as a cross-replica sharded
+        step — the residual fold stays a host gather against the
+        on-disk score store (the out-of-core contract), the solve and
+        the bank never leave their shards."""
+        bank = self._coerce_sharded(bank)
+        from photon_ml_tpu.game.random_effect_data import RandomEffectBucket
+
+        stat_vecs = []
+        for codes, arrays in self.spilled.iter_segments():
+            off = arrays["off"]
+            if res_flat is not None:
+                rows = arrays["rows"]
+                off = (off + np.where(
+                    rows >= 0, res_flat[np.maximum(rows, 0)], 0.0
+                )).astype(np.float32)
+            bucket = RandomEffectBucket(
+                entity_codes=codes,
+                row_index=arrays["rows"],
+                indices=arrays["ix"],
+                values=arrays["v"],
+                labels=arrays["lab"],
+                offsets=off,
+                weights=arrays["wgt"],
+            )
+            kind = self.problem._bucket_kind(bucket, self.local_dim)
+            bank, stat_vec = self._pod.update_segment(
+                bank, codes, arrays, off, kind=kind
+            )
+            stat_vecs.append(stat_vec)
+        tracker = (
+            self._pod.segment_tracker(stat_vecs, self.num_entities)
+            if stat_vecs
+            else None
+        )
+        return bank, tracker
+
     @property
     def variances(self):
         return getattr(self, "_var_bank", None)
@@ -1108,6 +1185,13 @@ class StreamingRandomEffectCoordinate:
         codes = chunk[f"code__{self.config.random_effect_type}"]
         valid = (codes >= 0) & (chunk["wgt"] > 0)
         sid = self.config.feature_shard_id
+        from photon_ml_tpu.game.pod import ShardedREBank
+
+        if isinstance(bank, ShardedREBank):
+            return self._pod.score_chunk(
+                bank, codes,
+                chunk[f"ix__{sid}"], chunk[f"v__{sid}"], valid,
+            )
         return _chunk_jit("score_bank")(
             bank,
             jnp.asarray(codes),
@@ -1117,6 +1201,10 @@ class StreamingRandomEffectCoordinate:
         )
 
     def regularization_term(self, bank) -> float:
+        from photon_ml_tpu.game.pod import ShardedREBank
+
+        if isinstance(bank, ShardedREBank):
+            return self._pod.regularization_term(bank)
         return self.problem.regularization_term(bank)
 
 
@@ -1373,6 +1461,8 @@ class StreamingCoordinateDescent:
         )
         from photon_ml_tpu.models.coefficients import Coefficients
 
+        from photon_ml_tpu.game.pod import ShardedREBank
+
         models = {}
         for name, coord in self.coordinates.items():
             if isinstance(coord, StreamingFixedEffectCoordinate):
@@ -1383,8 +1473,13 @@ class StreamingCoordinateDescent:
                     coord.feature_shard_id,
                 )
             else:
+                state = states[name]
+                if isinstance(state, ShardedREBank):
+                    # export materializes the replicated view once — the
+                    # model artifact is host-side by definition
+                    state = state.to_global()
                 models[name] = RandomEffectModel(
-                    states[name],
+                    state,
                     coord._mini_dataset(
                         np.zeros(0, np.int32),
                         {
@@ -1455,6 +1550,7 @@ def train_streaming_game(
     logger: Optional[PhotonLogger] = None,
     checkpoint_dir: Optional[str] = None,
     preemption_guard=None,
+    entity_mesh=None,
 ):
     """End-to-end streamed GAME fit: scan -> stage -> streamed CD
     [-> streamed validation]. Returns (StreamingGameResult, extras) where
@@ -1474,6 +1570,11 @@ def train_streaming_game(
     args produces a bitwise-identical final model. ``preemption_guard``
     stops at the next iteration boundary on SIGTERM, mirroring the
     in-memory CoordinateDescent.
+
+    ``entity_mesh`` (pod-scale GAME): a 1-D ``entity`` mesh shards
+    every random-effect bank — and each staged segment's solve — over
+    the mesh by entity hash (game/pod.py), composing out-of-core
+    streaming with entity sharding.
     """
     logger = logger or PhotonLogger()
     validate_streaming_game_configs(re_data_configs)
@@ -1587,6 +1688,7 @@ def train_streaming_game(
             ),
             config=dcfg,
             local_dim=imaps[dcfg.feature_shard_id].size,
+            mesh=entity_mesh,
         )
 
     validation_fn = None

@@ -26,6 +26,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+# Pod-scale GAME (game/pod.py): random-effect banks + their optimizer/
+# tracker state shard entities over this axis by entity hash
+# (code % n_shards — the LongHashPartitioner analog), residuals ride
+# all_to_alls instead of host gathers. Distinct from DATA_AXIS so an
+# entity mesh can coexist with a (data, model) FE mesh in one driver.
+ENTITY_AXIS = "entity"
 
 
 def make_mesh(
@@ -116,6 +122,25 @@ def ensure_data_sharded(batch, mesh: Mesh, axis: str = DATA_AXIS):
         return batch
     n_shards = int(mesh.shape[axis])
     return shard_batch(pad_batch_rows(batch, n_shards), mesh, axis)
+
+
+def entity_mesh(
+    num_shards: Optional[int] = None, devices=None
+) -> Mesh:
+    """1-D mesh over the ``entity`` axis for hash-sharded random-effect
+    banks (game/pod.py). ``num_shards`` defaults to every visible
+    device; fewer shards use the first ``num_shards`` devices (the
+    virtual-mesh weak-scaling harness runs N in {1, 2, 4, 8} on an
+    8-device host)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if num_shards is None:
+        num_shards = len(devices)
+    if not 1 <= num_shards <= len(devices):
+        raise ValueError(
+            f"entity shards {num_shards} out of range for "
+            f"{len(devices)} visible devices"
+        )
+    return make_mesh((num_shards,), (ENTITY_AXIS,), devices[:num_shards])
 
 
 def maybe_make_mesh(

@@ -119,10 +119,31 @@ def prepare_output_dir(
     sync_processes("output-dir-ready")
 
 
+def shard_assignment(item: T, num_shards: int) -> int:
+    """Deterministic CONTENT-keyed shard of one work item: a stable
+    CRC32 over the item's string form, mod the shard count. This is the
+    assignment contract entity-hash sharding (game/pod.py) and the
+    streaming input split both lean on: it depends only on the item
+    itself, never on list position, so two processes that enumerate the
+    same set in different orders agree on every item's owner. (Python's
+    builtin ``hash`` is salted per process — exactly the wrong tool.)"""
+    import zlib
+
+    return zlib.crc32(str(item).encode("utf-8")) % num_shards
+
+
 def process_shard(items: Sequence[T]) -> List[T]:
     """This process's slice of a host-side work list (input files, daily
-    paths): round-robin by process index, so any ordering skew in the list
-    spreads evenly. Single-process returns the list unchanged.
+    paths). Single-process returns the list unchanged.
+
+    Assignment is CONTENT-keyed (:func:`shard_assignment`), not
+    positional: the pre-round-14 round-robin (``index % n``) silently
+    depended on every process enumerating the list in the same order —
+    a filesystem whose listing order differs across hosts would both
+    drop and double-read files. Now any reordering of the same item set
+    yields the same per-process shard (pinned by test_multihost).
+    Balance is probabilistic (CRC32-uniform) rather than exact, which
+    for file lists is the same property the entity hash gives banks.
 
     NOTE: feeding device_put with per-process DIFFERENT batch contents is
     wrong — cross-process device_put requires the same global value on all
@@ -133,7 +154,7 @@ def process_shard(items: Sequence[T]) -> List[T]:
     if n <= 1:
         return list(items)
     i = process_index()
-    return [x for j, x in enumerate(items) if j % n == i]
+    return [x for x in items if shard_assignment(x, n) == i]
 
 
 def sync_processes(name: str = "photon-ml-barrier") -> None:

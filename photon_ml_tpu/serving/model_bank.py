@@ -44,6 +44,7 @@ __all__ = [
     "ModelBank",
     "build_model_bank",
     "bank_from_arrays",
+    "shard_entity_ids",
     "DEFAULT_ENTITY_PAD",
 ]
 
@@ -66,6 +67,26 @@ def _native_threshold(explicit: Optional[int]) -> int:
     return int(env) if env else NATIVE_INDEX_THRESHOLD
 
 
+def shard_entity_ids(
+    ids: Sequence[str], entity_shard: Optional[Tuple[int, int]]
+) -> List[str]:
+    """One entity SHARD of a sorted entity-id list, by the pod hash rule
+    (game/pod.py): an entity's code is its position in the model's
+    sorted order and its owner is ``code % num_shards`` — identical to
+    the training-side bank placement, so a server loading shard ``s``
+    of a pod-trained model holds exactly the rows device ``s`` trained.
+    ``entity_shard`` is ``(shard_index, num_shards)`` or None (all)."""
+    if entity_shard is None:
+        return list(ids)
+    s, n = entity_shard
+    if not (isinstance(n, int) and n >= 1 and 0 <= s < n):
+        raise ValueError(
+            f"entity_shard must be (shard, num_shards) with "
+            f"0 <= shard < num_shards, got {entity_shard!r}"
+        )
+    return [x for i, x in enumerate(ids) if i % n == s]
+
+
 class EntityRowIndex:
     """O(1) entity id -> bank row for one random-effect type.
 
@@ -74,6 +95,11 @@ class EntityRowIndex:
     open addressing, the PalDB analog) so the host-side index costs mmap
     pages instead of a Python dict over millions of ids. Lookups are
     lock-free either way (both structures are immutable after build).
+
+    ``shard``: when this index covers ONE entity shard of a sharded
+    GAME model (``(shard_index, num_shards)``), ``ids`` is the owned
+    subset and every other entity resolves to row -1 — those requests
+    score FE-only through the batcher's existing masked-row semantics.
     """
 
     def __init__(
@@ -81,7 +107,9 @@ class EntityRowIndex:
         ids: Sequence[str],
         *,
         native_threshold: Optional[int] = None,
+        shard: Optional[Tuple[int, int]] = None,
     ):
+        self.shard = shard
         self.ids: List[str] = list(ids)
         self.num_entities = len(self.ids)
         self._store = None
@@ -263,8 +291,19 @@ def build_model_bank(
     native_index_threshold: Optional[int] = None,
     device: bool = True,
     model_id: str = "",
+    entity_shard: Optional[Tuple[int, int]] = None,
 ) -> ModelBank:
     """A `game.model_io.LoadedGameModel` -> device-resident ModelBank.
+
+    ``entity_shard=(s, n)``: load ONE entity shard of a sharded GAME
+    model — each random-effect bank keeps only the entities the pod
+    hash rule assigns to shard ``s`` (:func:`shard_entity_ids`), its
+    EntityRowIndex resolves every other entity to -1, and those
+    requests score FE-only exactly like unknown entities do today.
+    This is the serving seam for the ROADMAP's entity-sharded serving
+    banks: N servers each load 1/N of the rows. Matrix factorizations
+    are not sharded (their two latent banks pair row AND column
+    entities per request).
 
     ``index_maps`` must cover every shard the model references (serving
     has no dataset to infer a vocabulary from — the same prebuilt-maps
@@ -309,7 +348,7 @@ def build_model_bank(
 
     for name, (re_type, shard_id, per_entity) in loaded.random_effects.items():
         imap = _imap(shard_id)
-        ids = sorted(per_entity)
+        ids = shard_entity_ids(sorted(per_entity), entity_shard)
         e_pad = max(_round_up(max(len(ids), 1), entity_pad_to), entity_pad_to)
         bank = _re_bank(per_entity, ids, imap, e_pad)
         if re_type in entity_rows and entity_rows[re_type].ids != ids:
@@ -319,7 +358,10 @@ def build_model_bank(
             )
         entity_rows.setdefault(
             re_type,
-            EntityRowIndex(ids, native_threshold=native_index_threshold),
+            EntityRowIndex(
+                ids, native_threshold=native_index_threshold,
+                shard=entity_shard,
+            ),
         )
         spec.append(
             ("re", name, re_type, shard_id, e_pad, imap.size,
@@ -386,12 +428,16 @@ def bank_from_arrays(
     index_maps: Optional[Mapping[str, object]] = None,
     entity_pad_to: int = DEFAULT_ENTITY_PAD,
     native_index_threshold: Optional[int] = None,
+    entity_shard: Optional[Tuple[int, int]] = None,
 ) -> ModelBank:
     """Assemble a bank directly from coefficient arrays — the synthetic/
     bench entry point (no Avro artifacts, same device layout).
 
     ``fixed``: (name, shard_id, w[d]); ``random``: (name, re_type,
-    shard_id, bank[E, d], entity_ids).
+    shard_id, bank[E, d], entity_ids). ``entity_shard=(s, n)`` keeps
+    only shard ``s``'s rows of each random-effect bank (the pod hash
+    rule over each bank's given row order — callers pass sorted ids,
+    matching the artifact layout).
     """
     spec: List[tuple] = []
     arrays: Dict[str, object] = {}
@@ -410,12 +456,22 @@ def bank_from_arrays(
             raise ValueError(
                 f"bank rows {bank.shape[0]} != entity ids {len(ids)}"
             )
+        if entity_shard is not None:
+            keep = [
+                i for i in range(len(ids))
+                if i % entity_shard[1] == entity_shard[0]
+            ]
+            ids = shard_entity_ids(ids, entity_shard)
+            bank = bank[keep]
         e_pad = max(_round_up(max(len(ids), 1), entity_pad_to), entity_pad_to)
         padded = np.zeros((e_pad, bank.shape[1]), np.float32)
         padded[: bank.shape[0]] = bank
         entity_rows.setdefault(
             re_type,
-            EntityRowIndex(ids, native_threshold=native_index_threshold),
+            EntityRowIndex(
+                ids, native_threshold=native_index_threshold,
+                shard=entity_shard,
+            ),
         )
         spec.append(
             ("re", name, re_type, shard_id, e_pad, int(bank.shape[1]),

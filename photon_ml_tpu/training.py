@@ -335,6 +335,36 @@ def resolve_grid_mode(
     return "batched" if bank <= memory_budget_bytes else "sequential"
 
 
+def resolve_entity_shards(
+    requested: Optional[int],
+    *,
+    num_devices: Optional[int] = None,
+) -> Optional[int]:
+    """Resolve the GAME driver's ``--entity-shards`` to a concrete
+    entity-mesh size (pod-scale GAME, game/pod.py), or None for the
+    replicated bank path.
+
+    ``None``/``0`` keeps the replicated default (entity sharding is
+    opt-in: the sharded path changes the bank's device layout, so the
+    operator asks for it explicitly); ``-1`` means "every visible
+    device"; an explicit N must fit the device count. N == 1 is valid —
+    the single-shard pod path, the parity baseline the weak-scaling
+    tests anchor on."""
+    if requested is None or requested == 0:
+        return None
+    import jax
+
+    n_dev = num_devices if num_devices is not None else len(jax.devices())
+    if requested == -1:
+        return n_dev
+    if not 1 <= requested <= n_dev:
+        raise ValueError(
+            f"--entity-shards {requested} out of range for {n_dev} "
+            "visible devices (use -1 for all devices, 0 to disable)"
+        )
+    return int(requested)
+
+
 def train_grid_batched(
     batch: Batch,
     task: TaskType,

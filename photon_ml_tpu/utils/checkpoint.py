@@ -56,6 +56,21 @@ def restore_model(model, state: Dict[str, Any]):
             Coefficients(jnp.asarray(state["means"]))
         )
         return replace(model, model=glm)
+    from photon_ml_tpu.game.pod import PodRandomEffectModel, ShardedREBank
+
+    if isinstance(model, PodRandomEffectModel) and "bank" in state:
+        # re-shard the checkpointed replicated bank over the template's
+        # entity mesh (dataclasses.replace cannot rebuild the lazy-bank
+        # subclass)
+        sb = ShardedREBank.from_global(
+            model.sharded_bank.mesh,
+            model.sharded_bank.spec,
+            jnp.asarray(state["bank"]),
+        )
+        return PodRandomEffectModel(
+            sb, model.re_dataset, model.random_effect_type,
+            model.feature_shard_id,
+        )
     if isinstance(model, RandomEffectModel) and "bank" in state:
         return replace(model, bank=jnp.asarray(state["bank"]))
     if isinstance(model, FactoredRandomEffectModel) and "projection" in state:

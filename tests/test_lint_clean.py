@@ -215,6 +215,25 @@ class TestLintClean:
             if "serving" in s.path.replace(os.sep, "/")
         ], "serving code must not carry allow() suppressions"
 
+    def test_pod_sharding_modules_covered_and_clean(self, full_report):
+        """ISSUE 9: the pod-scale sharding modules (game/pod.py and the
+        extended residual router) are in the analyzed set and contribute
+        ZERO baseline entries and ZERO allow() sites — the routed hot
+        path's no-hidden-host-sync discipline is structural, not
+        grandfathered."""
+        files = [f.replace(os.sep, "/") for f in full_report.files]
+        assert any(f.endswith("game/pod.py") for f in files)
+        assert any(f.endswith("game/residual_routing.py") for f in files)
+        entries = json.load(open(BASELINE))["entries"]
+        for mod in ("game/pod.py", "game/residual_routing.py"):
+            assert not [
+                e for e in entries if e["file"].replace(os.sep, "/").endswith(mod)
+            ], f"{mod} must not be baselined"
+            assert not [
+                s for s in full_report.allow_sites
+                if s.path.replace(os.sep, "/").endswith(mod)
+            ], f"{mod} must not carry allow() suppressions"
+
     def test_pl007_lands_at_zero(self, full_report):
         """ISSUE 8: the request-path-hygiene rule (no untimed
         Condition.wait / Future.result in serving/) ships with a ZERO

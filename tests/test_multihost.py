@@ -45,6 +45,69 @@ class TestSingleProcessSemantics:
         assert calls == [7]
 
 
+class TestShardAssignmentContract:
+    """process_shard's assignment must be CONTENT-keyed: stable under
+    item reordering across processes (a filesystem listing order that
+    differs between hosts must not change any item's owner), disjoint,
+    and covering. Entity-hash sharding (game/pod.py) and the streaming
+    input split both rely on exactly this contract."""
+
+    def test_stable_under_reordering(self):
+        from photon_ml_tpu.parallel.multihost import shard_assignment
+
+        items = [f"part-{i:05d}.avro" for i in range(64)]
+        n = 4
+        owners = {x: shard_assignment(x, n) for x in items}
+        import random
+
+        shuffled = list(items)
+        random.Random(123).shuffle(shuffled)
+        assert {x: shard_assignment(x, n) for x in shuffled} == owners
+
+    def test_disjoint_and_covering(self, monkeypatch):
+        import photon_ml_tpu.parallel.multihost as mh
+
+        items = [f"day={d}/part-{i}.avro" for d in range(4) for i in range(8)]
+        n = 3
+        monkeypatch.setattr(mh, "process_count", lambda: n)
+        shards = []
+        for pid in range(n):
+            monkeypatch.setattr(mh, "process_index", lambda pid=pid: pid)
+            shards.append(mh.process_shard(items))
+        flat = [x for s in shards for x in s]
+        assert sorted(flat) == sorted(items)  # covering, no double-reads
+        assert len(set(flat)) == len(items)  # disjoint
+
+    def test_reordered_lists_agree_per_process(self, monkeypatch):
+        """The actual multi-host failure mode the fix closes: process 0
+        enumerates the list in one order, process 1 in another. Every
+        item must still have exactly one owner."""
+        import random
+
+        import photon_ml_tpu.parallel.multihost as mh
+
+        items = [f"f{i}" for i in range(40)]
+        reordered = list(items)
+        random.Random(7).shuffle(reordered)
+        monkeypatch.setattr(mh, "process_count", lambda: 2)
+        monkeypatch.setattr(mh, "process_index", lambda: 0)
+        shard0 = set(mh.process_shard(items))
+        monkeypatch.setattr(mh, "process_index", lambda: 1)
+        shard1 = set(mh.process_shard(reordered))  # DIFFERENT order
+        assert shard0 | shard1 == set(items)
+        assert not (shard0 & shard1)
+
+    def test_stability_as_the_list_grows(self):
+        """Appending new items never re-homes existing ones (the daily
+        incremental-input case): owners are per-item, not positional."""
+        from photon_ml_tpu.parallel.multihost import shard_assignment
+
+        base = [f"part-{i}" for i in range(20)]
+        owners = {x: shard_assignment(x, 4) for x in base}
+        grown = base + [f"part-{i}" for i in range(20, 40)]
+        assert {x: shard_assignment(x, 4) for x in grown if x in owners} == owners
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
