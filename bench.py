@@ -2133,6 +2133,167 @@ def _overload_config(name, *, seed=0):
     }
 
 
+def _retrain_config(name, *, n_files=8, rows_per_file=4000, d=2000,
+                    k=12, max_iter=30, seed=0):
+    """Incremental retrain vs full retrain (ISSUE 10, ROADMAP metric):
+    after a parent generation trains and publishes, data is appended at
+    1% and 10% of the base rows and the model retrains two ways —
+
+    - FULL: fresh uncached scan of every partition + cold solve from
+      zeros (what an hourly cron without the registry pays);
+    - INCREMENTAL: per-partition stats cache (only the NEW partition is
+      re-read — counted) + drift-safe warm start from the parent
+      generation's coefficients.
+
+    Reported per fraction: wall-clock both ways, speedup, the
+    partitions-scanned counters, and iteration counts. The correctness
+    pins ride along: scanned == new-partitions-only, and the no-drift
+    warm-start alignment is BITWISE the parent coefficients
+    (warm_start_bitwise). Speedup gates are host-class-aware in
+    dev-scripts/bench_retrain.sh (the 1-core CPU container measures the
+    counters, not throughput)."""
+    import shutil
+    import tempfile
+
+    from photon_ml_tpu.io import schemas
+    from photon_ml_tpu.io.avro_codec import write_container
+    from photon_ml_tpu.io.input_format import AvroInputDataFormat
+    from photon_ml_tpu.io.model_io import save_glm_models_avro
+    from photon_ml_tpu.io.streaming import scan_stream
+    from photon_ml_tpu.registry import (
+        ModelRegistry,
+        align_coefficients,
+        cached_scan_stream,
+    )
+    from photon_ml_tpu.task import TaskType
+    from photon_ml_tpu.training import train_streaming_glm
+    from photon_ml_tpu.utils.index_map import feature_key
+
+    rng = np.random.default_rng(seed)
+    tmp = tempfile.mkdtemp(prefix="photon-retrain-bench-")
+    try:
+        w_true = rng.normal(size=d).astype(np.float32) * 0.3
+        train_dir = os.path.join(tmp, "train")
+        os.makedirs(train_dir)
+
+        def write_part(fi, rows):
+            ix = rng.integers(0, d, size=(rows, k))
+            vs = rng.normal(size=(rows, k)).astype(np.float32)
+            z = (w_true[ix] * vs).sum(axis=1)
+            y = rng.uniform(size=rows) < 1 / (1 + np.exp(-z))
+            recs = [
+                {
+                    "uid": f"{fi}-{i}",
+                    "label": float(y[i]),
+                    "features": [
+                        {"name": str(int(j)), "term": "",
+                         "value": float(v)}
+                        for j, v in zip(ix[i], vs[i])
+                    ],
+                    "offset": 0.0,
+                    "weight": 1.0,
+                }
+                for i in range(rows)
+            ]
+            write_container(
+                os.path.join(train_dir, f"part-{fi:03d}.avro"),
+                schemas.TRAINING_EXAMPLE_AVRO, recs,
+            )
+
+        for fi in range(n_files):
+            write_part(fi, rows_per_file)
+        base_rows = n_files * rows_per_file
+        fmt = AvroInputDataFormat()
+        cache_dir = os.path.join(tmp, "scan-cache")
+
+        def fit(index_map, stats, initial=None):
+            models, results, _ = train_streaming_glm(
+                [train_dir], TaskType.LOGISTIC_REGRESSION,
+                regularization_weights=[1.0], max_iter=max_iter,
+                fmt=fmt, index_map=index_map, stats=stats,
+                initial=initial, prefetch=False,
+            )
+            (model,) = models.values()
+            (result,) = results.values()
+            return model, int(result.iterations)
+
+        # parent generation: cold scan (primes the cache) + cold solve
+        imap, stats, cs0 = cached_scan_stream([train_dir], fmt, cache_dir)
+        parent_model, parent_iters = fit(imap, stats)
+        parent_means = {
+            key: float(np.asarray(parent_model.means)[i])
+            for key, i in imap.items()
+        }
+        # publish through the REAL registry so the bench exercises the
+        # lease/stage/commit path too
+        cand = os.path.join(tmp, "candidate")
+        os.makedirs(cand)
+        save_glm_models_avro(
+            {1.0: parent_model}, os.path.join(cand, "model.avro"), imap
+        )
+        registry = ModelRegistry(os.path.join(tmp, "registry"))
+        t0 = time.perf_counter()
+        gen1 = registry.publish(cand, data_ranges={"train_dir": train_dir})
+        publish_s = time.perf_counter() - t0
+
+        # no-drift alignment bitwise pin (the warm-start parity gate)
+        aligned = align_coefficients(parent_means, imap)
+        warm_bitwise = bool(
+            np.array_equal(aligned, np.asarray(parent_model.means))
+        )
+
+        phases = {}
+        next_fi = n_files
+        for frac in (0.01, 0.10):
+            rows_new = max(int(base_rows * frac), 1)
+            write_part(next_fi, rows_new)
+            next_fi += 1
+
+            t0 = time.perf_counter()
+            imap_f, stats_f = scan_stream([train_dir], fmt)
+            _model_f, iters_full = fit(imap_f, stats_f)
+            full_s = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            imap_i, stats_i, cs = cached_scan_stream(
+                [train_dir], fmt, cache_dir
+            )
+            initial = align_coefficients(parent_means, imap_i)
+            _model_i, iters_inc = fit(imap_i, stats_i, initial=initial)
+            inc_s = time.perf_counter() - t0
+
+            phases[f"{int(frac * 100)}pct"] = {
+                "rows_appended": rows_new,
+                "full_s": round(full_s, 2),
+                "incremental_s": round(inc_s, 2),
+                "speedup": round(full_s / max(inc_s, 1e-9), 2),
+                "iters_full": iters_full,
+                "iters_incremental": iters_inc,
+                "partitions": cs.partitions,
+                "partitions_scanned": cs.scanned,
+                "partitions_cached": cs.cached,
+            }
+        return {
+            "config": name,
+            "metric": "retrain_speedup_10pct",
+            "value": phases["10pct"]["speedup"],
+            "unit": "x (full retrain / incremental retrain)",
+            "detail": {
+                "n_base_rows": base_rows,
+                "dim": d,
+                "nnz_per_row": k,
+                "parent_iters": parent_iters,
+                "publish_s": round(publish_s, 3),
+                "published_generation": gen1.generation,
+                "warm_start_bitwise": warm_bitwise,
+                "scan0_scanned": cs0.scanned,
+                **phases,
+            },
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _regen_with_model(rng, n, d, k, w_true, gen_task, noise=0.5):
     """Draw a dataset from a GIVEN planted model (shared generator for the
     train set and its held-out split)."""
@@ -2634,6 +2795,14 @@ def suite(only=None):
         results.append(_pod_game_config("12_pod_game"))
         print(json.dumps(results[-1]), flush=True)
 
+    # 13: continuous retraining (ISSUE 10): incremental retrain
+    # (per-partition stats cache + registry warm start) vs full retrain
+    # at 1%/10% appended data — the ROADMAP metric; gates in
+    # dev-scripts/bench_retrain.sh.
+    if want("13_retrain"):
+        results.append(_retrain_config("13_retrain"))
+        print(json.dumps(results[-1]), flush=True)
+
     path = "BASELINE_RESULTS.json"
     merged = {}
     if only is not None and os.path.exists(path):
@@ -2691,6 +2860,10 @@ if __name__ == "__main__":
         # dev-scripts/bench_pod_game.sh entry: the entity-sharded GAME
         # A/B as one JSON line (gates applied by the script)
         print(json.dumps(_pod_game_config("pod_game")))
+    elif "--retrain" in sys.argv:
+        # dev-scripts/bench_retrain.sh entry: incremental vs full
+        # retrain as one JSON line (gates applied by the script)
+        print(json.dumps(_retrain_config("retrain")))
     elif "--suite" in sys.argv:
         only = None
         if "--only" in sys.argv:

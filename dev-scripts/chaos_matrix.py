@@ -45,6 +45,24 @@ per-arm):
     degraded scores bitwise the FE-only batch reference, and SIGTERM
     drains to exit 0 with zero hung futures and zero leaked
     connections.
+11. **Kill-mid-publish (ISSUE 10)** — the GLM driver publishes into a
+    model registry with a KILL planted at a ``registry.publish`` seam
+    crossing (the stage->rename->commit protocol): after the SIGKILL
+    the registry lists NOTHING (never a half-visible generation), and
+    the re-run republishes a generation BITWISE equal to an
+    uninterrupted publish on a twin registry.
+12. **Gate refusal** — a retrain over label-flipped appended data
+    fails its AUC gate against the parent generation: the driver
+    exits 0 (a refusal is a terminal outcome, not a crash), the named
+    verdict lands in metrics.json AND the registry's refusal record,
+    and the candidate is absent from the loader listing.
+13. **Post-swap auto-rollback** — the serving driver follows a
+    registry (--registry-dir): generation 2 publishes and is promoted
+    under live traffic; a post-swap health regression (degraded
+    responses past the rollback window policy) flips serving BACK to
+    generation 1 bitwise (scores equal the pre-swap clean scores),
+    and the bad generation is quarantined in the registry so it is
+    never re-promoted.
 
 Every asserted invariant is printed; any failure exits non-zero.
 """
@@ -594,6 +612,291 @@ def frontend_under_fire_arm(
     )
 
 
+# -- continuous-retraining arms (ISSUE 10) ------------------------------------
+
+
+def run_allow_kill(cmd, **env):
+    """Like run(), but a SIGKILL exit (the planted registry KILL) is an
+    expected outcome; any OTHER failure still aborts the matrix."""
+    e = {**os.environ, "JAX_PLATFORMS": "cpu",
+         "PHOTON_RETRY_BASE_S": "0.002", **env}
+    r = subprocess.run(
+        cmd, cwd=REPO, env=e, capture_output=True, text=True, timeout=900
+    )
+    if r.returncode not in (0, -9):
+        sys.exit(
+            f"[chaos] FAILED: {' '.join(cmd)} (rc={r.returncode})\n"
+            f"--- stdout\n{r.stdout[-4000:]}\n--- stderr\n{r.stderr[-4000:]}"
+        )
+    return r
+
+
+def glm_publish_args(train, val, out, registry, plan=None, extra=()):
+    args = [
+        sys.executable, "-m", "photon_ml_tpu.cli.glm_driver",
+        "--training-data-directory", train,
+        "--output-directory", out,
+        "--validating-data-directory", val,
+        "--task", "LOGISTIC_REGRESSION",
+        "--regularization-weights", "1.0",
+        "--num-iterations", "12",
+        "--streaming", "true",
+        "--retrain-from", registry,
+        "--publish-registry", registry,
+        "--gate-max-auc-drop", "0.5",
+        "--delete-output-dirs-if-exist", "true",
+        *extra,
+    ]
+    if plan:
+        args += ["--fault-plan", plan]
+    return args
+
+
+def registry_generations(registry_dir):
+    from photon_ml_tpu.registry import ModelRegistry
+
+    return [g.generation for g in ModelRegistry(registry_dir).list_generations()]
+
+
+def _retrain_val_dir(base):
+    """Holdout for the retrain arms: SAME true model as gen_glm_data's
+    training draw (w comes from seed 0), fresh example noise — the
+    gates compare candidate vs parent on data they can both predict."""
+    val = os.path.join(base, "glm-val")
+    if os.path.isdir(val):
+        return val
+    import numpy as _np
+    from photon_ml_tpu.io import schemas as _schemas
+    from photon_ml_tpu.io.avro_codec import write_container as _wc
+
+    d, k = 40, 8
+    w = _np.random.default_rng(0).normal(size=d) * 0.5
+    rng = _np.random.default_rng(11)
+    recs = []
+    for i in range(1200):
+        ix = rng.integers(0, d, size=k)
+        vs = rng.normal(size=k)
+        z = float((w[ix] * vs).sum())
+        recs.append({
+            "uid": f"val-{i}",
+            "label": float(1 / (1 + _np.exp(-z)) > rng.uniform()),
+            "features": [
+                {"name": str(int(j)), "term": "", "value": float(v)}
+                for j, v in zip(ix, vs)
+            ],
+            "offset": 0.0, "weight": 1.0,
+        })
+    os.makedirs(val)
+    _wc(os.path.join(val, "part-000.avro"),
+        _schemas.TRAINING_EXAMPLE_AVRO, recs)
+    return val
+
+
+def kill_mid_publish_arm(base, glm_train):
+    """Arm 11: KILL at a registry.publish crossing -> nothing visible;
+    resume -> bitwise the uninterrupted publish."""
+    val = _retrain_val_dir(base)
+    reg_ref = os.path.join(base, "retrain-reg-ref")
+    reg_kill = os.path.join(base, "retrain-reg-kill")
+    run(glm_publish_args(glm_train, val, os.path.join(base, "pub-ref"),
+                         reg_ref))
+    assert registry_generations(reg_ref) == [1]
+    # crossing 3 is the staging->final rename: the worst place to die
+    r = run_allow_kill(
+        glm_publish_args(glm_train, val, os.path.join(base, "pub-kill"),
+                         reg_kill, plan="registry.publish:3:KILL")
+    )
+    assert r.returncode == -9, "planned KILL never fired"
+    assert registry_generations(reg_kill) == [], (
+        "a killed publish left a visible generation"
+    )
+    log("kill-mid-publish: SIGKILL at the rename crossing, registry empty")
+    run(glm_publish_args(glm_train, val, os.path.join(base, "pub-resume"),
+                         reg_kill))
+    assert registry_generations(reg_kill) == [1]
+    assert_trees_bitwise_equal(
+        os.path.join(reg_ref, "generations", "g000001"),
+        os.path.join(reg_kill, "generations", "g000001"),
+        "kill-mid-publish resumed generation",
+    )
+
+
+def gate_refusal_arm(base, glm_train):
+    """Arm 12: poisoned retrain -> named verdict, candidate never
+    loadable, exit 0."""
+    val = _retrain_val_dir(base)
+    reg = os.path.join(base, "retrain-reg-gate")
+    train = os.path.join(base, "glm-train-poisoned")
+    shutil.copytree(glm_train, train)
+    run(glm_publish_args(train, val, os.path.join(base, "gate-gen1"), reg))
+    assert registry_generations(reg) == [1]
+    # poison: a flood of label-flipped rows swamps the signal
+    import numpy as _np
+    from photon_ml_tpu.io import schemas as _schemas
+    from photon_ml_tpu.io.avro_codec import write_container as _wc
+
+    rng = _np.random.default_rng(3)
+    d, k = 40, 8
+    w = _np.random.default_rng(0).normal(size=d) * 0.5  # gen_glm_data's w
+    recs = []
+    for i in range(3000):
+        ix = rng.integers(0, d, size=k)
+        vs = rng.normal(size=k)
+        z = float((-w[ix] * vs).sum())  # FLIPPED signal
+        recs.append({
+            "uid": f"poison-{i}",
+            "label": float(1 / (1 + _np.exp(-z)) > rng.uniform()),
+            "features": [
+                {"name": str(int(j)), "term": "", "value": float(v)}
+                for j, v in zip(ix, vs)
+            ],
+            "offset": 0.0, "weight": 1.0,
+        })
+    _wc(os.path.join(train, "part-poison.avro"),
+        _schemas.TRAINING_EXAMPLE_AVRO, recs)
+    out = os.path.join(base, "gate-refused")
+    run(glm_publish_args(train, val, out, reg,
+                         extra=["--gate-max-auc-drop", "0.02"]))
+    m = json.load(open(os.path.join(out, "metrics.json")))
+    verdict = m["registry"]["gates"]["verdict"]
+    assert verdict == "AUC_REGRESSION", m["registry"]["gates"]
+    assert m["registry"]["published_generation"] is None
+    assert registry_generations(reg) == [1], (
+        "refused candidate leaked into the loader listing"
+    )
+    from photon_ml_tpu.registry import ModelRegistry
+
+    refusals = ModelRegistry(reg).refused_candidates()
+    assert refusals and refusals[0]["gates"]["verdict"] == "AUC_REGRESSION"
+    log(
+        "gate refusal: AUC_REGRESSION recorded (driver exit 0), "
+        "registry still serves generation 1 only"
+    )
+
+
+def auto_rollback_arm(base, game_train, model_dir, nt_dir, clean_scores):
+    """Arm 13: registry-following frontend promotes generation 2 under
+    traffic; a degraded-response health regression auto-rolls back to
+    generation 1 BITWISE and quarantines generation 2."""
+    from photon_ml_tpu.registry import ModelRegistry
+
+    reg = os.path.join(base, "serving-registry")
+    registry = ModelRegistry(reg)
+    registry.publish(model_dir, data_ranges={"train": "arm4"})
+
+    out = os.path.join(base, "serving-rollback-out")
+    args = [
+        sys.executable, "-m", "photon_ml_tpu.cli.serving_driver",
+        "--registry-dir", reg,
+        "--registry-poll-s", "0.3",
+        "--rollback-window", "16",
+        "--rollback-min-requests", "6",
+        "--rollback-max-unhealthy", "0.5",
+        "--output-dir", out,
+        "--feature-shard-id-to-feature-section-keys-map",
+        "globalShard:features|userShard:userFeatures",
+        "--feature-name-and-term-set-path", nt_dir,
+        "--request-nnz-width", "globalShard:6|userShard:4",
+        "--ladder", "1,8,64",
+        "--frontend-port", "0",
+        "--drain-timeout", "20",
+        "--delete-output-dir-if-exists", "true",
+    ]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PHOTON_RETRY_BASE_S": "0.002"}
+    proc = subprocess.Popen(
+        args, cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        fj = os.path.join(out, "frontend.json")
+        deadline = time.time() + 240
+        while not os.path.exists(fj):
+            assert proc.poll() is None, proc.communicate()[0][-4000:]
+            assert time.time() < deadline, "front-end never came up"
+            time.sleep(0.1)
+        front = json.load(open(fj))
+        assert front["registry"] == os.path.abspath(reg), front
+        port = front["port"]
+        records = trace_json_records(game_train)[:60]
+        c = _Wire(port)
+        for rec in records[:20]:
+            resp = c.ask(rec)
+            assert resp["status"] == "ok" and not resp["degraded"]
+            assert resp["score"] == clean_scores[rec["uid"]], resp
+        status = c.ask({"op": "status"})
+        assert status["registry"]["registry_generation"] == 1
+
+        # publish generation 2 (same scores, distinct content) and wait
+        # for the watcher to promote it
+        gen2_src = os.path.join(base, "rollback-gen2")
+        shutil.copytree(model_dir, gen2_src)
+        with open(os.path.join(gen2_src, "model-spec"), "a") as f:
+            f.write("\n# generation 2\n")
+        registry.publish(gen2_src, parent=1)
+        deadline = time.time() + 60
+        while True:
+            status = c.ask({"op": "status"})
+            if status["registry"]["registry_generation"] == 2:
+                break
+            assert time.time() < deadline, f"gen 2 never promoted: {status}"
+            time.sleep(0.1)
+        for rec in records[:5]:
+            resp = c.ask(rec)
+            assert resp["status"] == "ok"
+            assert resp["score"] == clean_scores[rec["uid"]], resp
+        log("auto-rollback arm: generation 2 promoted under traffic")
+
+        # health regression: quarantine the RE bank (the degraded-rate
+        # signal a broken generation produces) and drive traffic until
+        # the watcher rolls back
+        resp = c.ask({"op": "quarantine_re", "re_type": "userId"})
+        assert resp["status"] == "ok", resp
+        deadline = time.time() + 60
+        i = 0
+        while True:
+            rec = records[i % len(records)]
+            i += 1
+            resp = c.ask(rec)
+            assert resp["status"] == "ok", resp
+            status = c.ask({"op": "status"})
+            if status["registry"]["registry_generation"] == 1:
+                break
+            assert time.time() < deadline, (
+                f"auto-rollback never fired: {status}"
+            )
+        assert status["registry"]["last_swap"]["action"] == "rollback"
+        # post-rollback traffic scores BITWISE the parent generation,
+        # not degraded (the restored bank is a clean reload)
+        for rec in records[:20]:
+            resp = c.ask(rec)
+            assert resp["status"] == "ok" and not resp["degraded"], resp
+            assert resp["score"] == clean_scores[rec["uid"]], resp
+        # the bad generation is quarantined in the registry
+        assert registry_generations(reg) == [1]
+        assert any(
+            name.startswith("g000002")
+            for name in os.listdir(os.path.join(reg, "quarantine"))
+        )
+        log(
+            "auto-rollback: degraded window tripped, serving restored "
+            "to generation 1 bitwise, generation 2 quarantined"
+        )
+        proc.send_signal(signal.SIGTERM)
+        stdout, _ = proc.communicate(timeout=120)
+        assert proc.returncode == 0, stdout[-4000:]
+        c.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=60)
+    m = json.load(open(os.path.join(out, "metrics.json")))
+    actions = [h["action"] for h in m["registry"]["watcher_history"]]
+    assert actions == ["swap", "rollback"], actions
+    assert m["leaked_connections"] == 0
+    log("auto-rollback arm: watcher history = swap -> rollback, 0 leaks")
+
+
 def main():
     base = tempfile.mkdtemp(prefix="photon-chaos-")
     try:
@@ -731,6 +1034,13 @@ def main():
         fe_scores = scores_by_uid(os.path.join(fout, "scores"))
         frontend_under_fire_arm(
             base, game_train, model_dir, nt_dir, clean_scores, fe_scores
+        )
+
+        # -- continuous-retraining arms (ISSUE 10) ------------------------
+        kill_mid_publish_arm(base, glm_train)
+        gate_refusal_arm(base, glm_train)
+        auto_rollback_arm(
+            base, game_train, model_dir, nt_dir, clean_scores
         )
         log("chaos matrix: PASS")
     finally:

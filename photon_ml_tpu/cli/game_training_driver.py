@@ -270,6 +270,19 @@ class GameTrainingParams:
     # Deterministic fault plan (reliability.faults), e.g.
     # "spill_write:2:EIO,ckpt_save:1:ENOSPC"; also via PHOTON_FAULT_PLAN.
     fault_plan: Optional[str] = None
+    # Continuous retraining (registry/): --retrain-from warm-starts the
+    # FE coefficient vectors AND the per-entity RE banks from the latest
+    # committed generation with drift-safe alignment (new vocab terms
+    # zero-init, removed terms dropped with accounting, churned entities
+    # prior-mean-initialized; bitwise pass-through when nothing
+    # drifted); --publish-registry publishes best-model as the next
+    # generation, gated against the parent on the validation data.
+    retrain_from: Optional[str] = None
+    publish_registry: Optional[str] = None
+    gate_max_auc_drop: float = 0.005
+    gate_max_rmse_increase: float = 0.01
+    gate_max_coef_norm_ratio: float = 10.0
+    gate_max_prediction_drift: Optional[float] = None
 
     def validate(self) -> None:
         if not self.train_input_dirs:
@@ -367,6 +380,39 @@ class GameTrainingParams:
             )
 
             validate_streaming_game_configs(self.random_effect_data_configs)
+        if self.retrain_from:
+            unsupported = []
+            if self.streaming:
+                unsupported.append(
+                    "--streaming (the out-of-core CD builds its banks "
+                    "from disk segments; warm-starting them is not "
+                    "wired yet)"
+                )
+            if self.entity_shards not in (None, 0):
+                unsupported.append(
+                    "--entity-shards (the pod coordinates own their "
+                    "sharded bank layout)"
+                )
+            if unsupported:
+                raise ValueError(
+                    "--retrain-from does not support: "
+                    + ", ".join(unsupported)
+                )
+        if (
+            self.retrain_from
+            and self.publish_registry
+            and not self.validate_input_dirs
+        ):
+            raise ValueError(
+                "validation-gated promotion (--retrain-from + "
+                "--publish-registry) requires validate-input-dirs: the "
+                "gates compare candidate vs parent on held-out data"
+            )
+        if self.publish_registry and self.model_output_mode == "NONE":
+            raise ValueError(
+                "--publish-registry publishes the saved best-model; "
+                "model-output-mode NONE writes none"
+            )
 
 
 class GameTrainingDriver:
@@ -407,6 +453,12 @@ class GameTrainingDriver:
         self.results = []
         self.best_result = None
         self.best_config = None
+        # continuous retraining state (--retrain-from / --publish-registry)
+        self._parent_generation = None   # registry.GenerationInfo
+        self._parent_loaded = None       # game.model_io.LoadedGameModel
+        self._drift_reports = {}
+        self._published_generation = None
+        self._gate_report = None
 
     # -- data --------------------------------------------------------------
 
@@ -565,6 +617,8 @@ class GameTrainingDriver:
             or p.num_iterations != 1
             or p.checkpoint_dir is not None
             or p.distributed == "feature"
+            or p.retrain_from is not None  # warm start needs the
+            # sequential sweep's initial_model seam
             or len(combos) <= 1
         ):
             return None
@@ -837,6 +891,203 @@ class GameTrainingDriver:
             buckets=[],
         )
 
+    # -- continuous retraining (registry/) ----------------------------------
+
+    def _load_parent(self) -> None:
+        """Resolve --retrain-from to the latest committed generation's
+        loaded GAME artifact (cold start when the registry is empty)."""
+        p = self.params
+        if not p.retrain_from:
+            return
+        from photon_ml_tpu.game.model_io import load_game_model
+        from photon_ml_tpu.registry import ModelRegistry
+
+        registry = ModelRegistry(p.retrain_from)
+        info = registry.latest()
+        if info is None:
+            self.logger.info(
+                "retrain-from registry %s has no committed generation; "
+                "cold start", p.retrain_from,
+            )
+            return
+        self._parent_generation = info
+        with self.timer.time("load-parent"):
+            self._parent_loaded = load_game_model(info.model_dir)
+        self.logger.info(
+            "retraining from generation %d (lineage %s, coordinates %s)",
+            info.generation,
+            registry.lineage(info.generation),
+            self._parent_loaded.coordinate_names(),
+        )
+
+    def _warm_start_model(self, dataset, re_datasets):
+        """The initial GameModel for the first combo: parent FE vectors
+        and RE banks aligned to the NEW dataset (coordinates the parent
+        lacks fall back to zero-init inside CoordinateDescent.run)."""
+        if self._parent_loaded is None:
+            return None
+        from photon_ml_tpu.registry import warm_start_game_model
+
+        model, reports = warm_start_game_model(
+            self._parent_loaded, dataset, re_datasets,
+            self.params.task_type,
+        )
+        self._drift_reports = reports
+        for name, rep in reports.items():
+            self.logger.info(
+                "warm-start %s: %d kept, %d new, %d dropped, "
+                "%d entities kept, %d churned (prior-mean), "
+                "%d entities dropped%s",
+                name, rep.kept, rep.new_zero_init, rep.dropped,
+                rep.kept_entities, rep.churned_entities_prior_init,
+                rep.dropped_entities,
+                "" if rep.no_drift else " [DRIFT]",
+            )
+        return model
+
+    def _model_norms(self, best_model):
+        """(candidate_norm, parent_norm): FE + RE coefficient L2 norms
+        for the coefficient-sanity gate, both sides over their own
+        stored coefficients."""
+        from photon_ml_tpu.game.model import (
+            FixedEffectModel,
+            RandomEffectModel,
+        )
+        from photon_ml_tpu.parallel import overlap
+
+        sq_terms = []
+        for sub in best_model.models.values():
+            if isinstance(sub, FixedEffectModel):
+                w = sub.model.means
+                sq_terms.append(jnp.vdot(w, w))
+            elif isinstance(sub, RandomEffectModel):
+                sq_terms.append(jnp.vdot(sub.bank, sub.bank))
+        cand_sq = (
+            sum(float(x) for x in overlap.device_get(sq_terms))
+            if sq_terms else 0.0
+        )
+        par_sq = 0.0
+        for _name, (_sid, means) in self._parent_loaded.fixed_effects.items():
+            par_sq += sum(float(v) ** 2 for v in means.values())
+        for _name, (_rt, _sid, per_entity) in (
+            self._parent_loaded.random_effects.items()
+        ):
+            for means in per_entity.values():
+                par_sq += sum(float(v) ** 2 for v in means.values())
+        return float(np.sqrt(cand_sq)), float(np.sqrt(par_sq))
+
+    def _run_gates(self, best_model, vdata):
+        """Candidate-vs-parent gates on the loaded validation dataset
+        (both models score the SAME rows; the parent resolves features/
+        entities by key, so drift costs it exactly its vanished terms)."""
+        from photon_ml_tpu.parallel import overlap
+        from photon_ml_tpu.registry import GateConfig, evaluate_gates
+
+        p = self.params
+        config = GateConfig(
+            max_auc_drop=p.gate_max_auc_drop,
+            max_rmse_increase=p.gate_max_rmse_increase,
+            max_coef_norm_ratio=p.gate_max_coef_norm_ratio,
+            max_prediction_drift=p.gate_max_prediction_drift,
+        )
+        offsets = jnp.asarray(vdata.offsets)
+        cand, par, labels, weights = overlap.device_get(
+            (
+                self._score_on(best_model, vdata) + offsets,
+                self._parent_loaded.score(vdata, p.task_type) + offsets,
+                vdata.labels,
+                vdata.weights,
+            )
+        )
+        cand_norm, par_norm = self._model_norms(best_model)
+        report = evaluate_gates(
+            [(cand, par, labels, weights)],
+            p.task_type,
+            config=config,
+            candidate_norm=cand_norm,
+            parent_norm=par_norm,
+        )
+        self._gate_report = report
+        self.logger.info(
+            "validation gates: %s %s", report.verdict,
+            {k: v.get("passed") for k, v in report.checks.items()},
+        )
+        return report
+
+    def _publish_to_registry(self, vdata) -> None:
+        """Publish the saved best-model directory as the next
+        generation; a failed gate records its named verdict (registry
+        refusal + metrics.json) and leaves the lineage unchanged."""
+        p = self.params
+        best = self.best_result[0] if self.best_result is not None else None
+        if best is None:
+            return
+        gate_report = None
+        if self._parent_loaded is not None and vdata is not None:
+            gate_report = self._run_gates(best.best_model, vdata)
+        from photon_ml_tpu.registry import ModelRegistry, RefusedCandidate
+
+        registry = ModelRegistry(p.publish_registry)
+        extra = {"task": p.task_type.name}
+        if self._drift_reports:
+            extra["drift"] = {
+                name: rep.as_dict()
+                for name, rep in self._drift_reports.items()
+            }
+        try:
+            info = registry.publish(
+                os.path.join(p.output_dir, "best-model"),
+                parent=(
+                    self._parent_generation.generation
+                    if self._parent_generation is not None
+                    else None
+                ),
+                data_ranges={
+                    "train_input_dirs": list(p.train_input_dirs),
+                    "train_date_range": p.train_date_range,
+                    "train_date_range_days_ago": (
+                        p.train_date_range_days_ago
+                    ),
+                },
+                gate_report=(
+                    gate_report.as_dict() if gate_report is not None
+                    else None
+                ),
+                extra=extra,
+            )
+            self._published_generation = info.generation
+            self.logger.info(
+                "published generation %d (parent %s, signature %s)",
+                info.generation, info.parent, info.signature,
+            )
+        except RefusedCandidate as e:
+            self.logger.warning(
+                "candidate REFUSED by validation gate %s; generation "
+                "lineage unchanged (refusal recorded at %s)",
+                e.verdict, e.refused_dir,
+            )
+
+    def _registry_metrics(self):
+        p = self.params
+        if not (p.retrain_from or p.publish_registry):
+            return None
+        return {
+            "retrain_from": p.retrain_from,
+            "parent_generation": (
+                self._parent_generation.generation
+                if self._parent_generation is not None else None
+            ),
+            "published_generation": self._published_generation,
+            "drift": {
+                name: rep.as_dict()
+                for name, rep in self._drift_reports.items()
+            },
+            "gates": (
+                self._gate_report.as_dict()
+                if self._gate_report is not None else None
+            ),
+        }
+
     # -- run ---------------------------------------------------------------
 
     def _offheap_index_maps(self):
@@ -1097,6 +1348,8 @@ class GameTrainingDriver:
                 name: build_random_effect_dataset(dataset, cfg)
                 for name, cfg in p.random_effect_data_configs.items()
             }
+        self._load_parent()
+        warm_model = self._warm_start_model(dataset, re_datasets)
         vdata = None
         validation_fn = None
         if p.validate_input_dirs:
@@ -1166,7 +1419,10 @@ class GameTrainingDriver:
                         p.feature_name_and_term_set_path
                     ),
                 }
-            prev_model = None
+            # retrain warm start: the aligned parent model seeds the
+            # FIRST (most-regularized) combo exactly like the cross-
+            # combo warm start seeds the rest
+            prev_model = warm_model
             best_orig_idx = None
             build_futures: Dict[int, object] = {}
             try:
@@ -1331,20 +1587,26 @@ class GameTrainingDriver:
                                 p.num_output_files_for_random_effect_model
                             ),
                         )
+        if p.publish_registry and p.model_output_mode != "NONE":
+            with self.timer.time("publish-registry"):
+                self._publish_to_registry(vdata)
         from photon_ml_tpu.reliability import (
             atomic_write_json,
             reliability_metrics,
         )
 
+        payload = {
+            "objective_history": best.objective_history,
+            "validation_history": best.validation_history,
+            "best_metric": best.best_metric,
+            "timers": self.timer.durations,
+            "reliability": reliability_metrics(),
+        }
+        registry_block = self._registry_metrics()
+        if registry_block is not None:
+            payload["registry"] = registry_block
         atomic_write_json(
-            os.path.join(p.output_dir, "metrics.json"),
-            {
-                "objective_history": best.objective_history,
-                "validation_history": best.validation_history,
-                "best_metric": best.best_metric,
-                "timers": self.timer.durations,
-                "reliability": reliability_metrics(),
-            },
+            os.path.join(p.output_dir, "metrics.json"), payload
         )
         sync_processes("outputs-written")
         self.logger.info("timers:\n%s", self.timer.summary())
@@ -1434,6 +1696,31 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "'spill_write:2:EIO,ckpt_save:1:ENOSPC' (seam:nth:error[:times])"
         "; also via PHOTON_FAULT_PLAN. Chaos harness: dev-scripts/"
         "chaos.sh",
+    )
+    ap.add_argument(
+        "--retrain-from", default=None,
+        help="model-registry directory: warm-start FE vectors and "
+        "per-entity RE banks from the latest committed generation with "
+        "drift-safe alignment (new terms zero-init, removed terms "
+        "dropped with accounting, churned entities prior-mean-init; "
+        "bitwise pass-through when nothing drifted)",
+    )
+    ap.add_argument(
+        "--publish-registry", default=None,
+        help="model-registry directory: publish best-model as the next "
+        "generation, gated against the parent on the validation data "
+        "(a failed gate records a named verdict; the candidate is "
+        "never loadable)",
+    )
+    ap.add_argument("--gate-max-auc-drop", type=float, default=0.005)
+    ap.add_argument("--gate-max-rmse-increase", type=float, default=0.01)
+    ap.add_argument(
+        "--gate-max-coef-norm-ratio", type=float, default=10.0
+    )
+    ap.add_argument(
+        "--gate-max-prediction-drift", type=float, default=None,
+        help="mean |candidate - parent| holdout margin bound "
+        "(default: gate off)",
     )
     ap.add_argument(
         "--checkpoint-dir", default=None,
@@ -1596,6 +1883,12 @@ def params_from_args(argv=None) -> GameTrainingParams:
         stream_memory_budget=ns.stream_memory_budget,
         diagnostic_reservoir_rows=ns.diagnostic_reservoir_rows,
         diagnostic_reservoir_bytes=ns.diagnostic_reservoir_bytes,
+        retrain_from=ns.retrain_from,
+        publish_registry=ns.publish_registry,
+        gate_max_auc_drop=ns.gate_max_auc_drop,
+        gate_max_rmse_increase=ns.gate_max_rmse_increase,
+        gate_max_coef_norm_ratio=ns.gate_max_coef_norm_ratio,
+        gate_max_prediction_drift=ns.gate_max_prediction_drift,
     )
 
 

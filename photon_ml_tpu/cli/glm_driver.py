@@ -191,6 +191,26 @@ class GLMParams:
     # IO errors / corruption at named seams, e.g.
     # "chunk_read:3:EIO,ckpt_save:1:ENOSPC". Also via PHOTON_FAULT_PLAN.
     fault_plan: Optional[str] = None
+    # Continuous retraining (registry/): --retrain-from warm-starts the
+    # coefficient vector from the latest committed generation of a model
+    # registry with drift-safe alignment (new vocab terms zero-init,
+    # removed terms dropped with accounting — bitwise pass-through when
+    # nothing drifted); --publish-registry publishes the trained best
+    # model as the next generation, gated against the parent on the
+    # validating directory (AUC/RMSE non-regression, coefficient-norm
+    # sanity, optional prediction-drift bound). A failed gate records a
+    # named terminal verdict and the candidate is never loadable.
+    retrain_from: Optional[str] = None
+    publish_registry: Optional[str] = None
+    gate_max_auc_drop: float = 0.005
+    gate_max_rmse_increase: float = 0.01
+    gate_max_coef_norm_ratio: float = 10.0
+    gate_max_prediction_drift: Optional[float] = None
+    # Append-only per-partition scan/stats cache (registry/stats_cache):
+    # the streaming preprocess scan re-reads ONLY partitions without a
+    # cache entry — for an hourly retrain over appended data, exactly
+    # the new ones (counted in metrics.json scan_cache).
+    scan_cache_dir: Optional[str] = None
 
     def validate(self) -> None:
         """Cross-field checks (Params.validate, Params.scala:200-222)."""
@@ -299,6 +319,36 @@ class GLMParams:
             raise ValueError(
                 "stream-memory-budget requires --streaming true"
             )
+        if self.scan_cache_dir and not self.streaming:
+            raise ValueError(
+                "scan-cache-dir caches the streaming preprocess scan; "
+                "it requires --streaming true"
+            )
+        if self.scan_cache_dir and self.input_format.strip().upper() != (
+            "AVRO"
+        ):
+            raise ValueError(
+                "scan-cache-dir requires the AVRO input format (the "
+                "per-partition moment partials use the native decoder)"
+            )
+        if self.gate_max_coef_norm_ratio <= 0:
+            raise ValueError("gate-max-coef-norm-ratio must be > 0")
+        if (
+            self.retrain_from
+            and self.publish_registry
+            and not self.validate_dir
+        ):
+            raise ValueError(
+                "validation-gated promotion (--retrain-from + "
+                "--publish-registry) requires a validating data "
+                "directory: the gates compare candidate vs parent on a "
+                "held-out stream"
+            )
+        if self.retrain_from and self.distributed == "feature":
+            raise ValueError(
+                "--retrain-from warm starts are not wired through the "
+                "feature-sharded trainers yet; use --distributed auto|off"
+            )
 
 
 def budgeted_reservoir_rows(
@@ -314,6 +364,23 @@ def budgeted_reservoir_rows(
     from photon_ml_tpu.io.streaming import budgeted_rows, sparse_row_bytes
 
     return budgeted_rows(max_rows, budget_bytes, sparse_row_bytes(max_nnz))
+
+
+def _glm_artifact_means(model_dir: str) -> Dict[str, float]:
+    """The coefficient dict {feature key: value} of a published GLM
+    generation (``model.avro``, one best-model record) — the KEY-space
+    view drift-safe alignment consumes."""
+    from photon_ml_tpu.io.avro_codec import read_container
+    from photon_ml_tpu.utils.index_map import feature_key
+
+    path = os.path.join(model_dir, "model.avro")
+    _, records = read_container(path)
+    for record in records:
+        return {
+            feature_key(m["name"], m["term"]): float(m["value"])
+            for m in record["means"]
+        }
+    raise ValueError(f"no model record in {path}")
 
 
 class GLMDriver:
@@ -386,6 +453,14 @@ class GLMDriver:
         self._stream_sample = None
         # tile-schedule cache counters captured after the train stage
         self._schedule_cache_stats: Dict[str, float] = {}
+        # per-partition scan-cache counters (--scan-cache-dir)
+        self._scan_cache_stats: Dict[str, int] = {}
+        # continuous retraining state (--retrain-from / --publish-registry)
+        self._parent_generation = None   # registry.GenerationInfo
+        self._parent_means: Optional[Dict[str, float]] = None
+        self._drift_report = None        # registry.DriftReport
+        self._published_generation: Optional[int] = None
+        self._gate_report = None
 
     # -- stages ------------------------------------------------------------
 
@@ -464,7 +539,40 @@ class GLMDriver:
                     and jax.process_count() == 1
                     and hasattr(fmt, "stream_scan_with_summary")
                 )
-                if use_fused:
+                use_scan_cache = (
+                    p.scan_cache_dir is not None
+                    and jax.process_count() == 1
+                )
+                if use_scan_cache:
+                    # append-only per-partition cache: identical
+                    # (index_map, stats) to the uncached scan, touching
+                    # only partitions without a valid entry — the
+                    # incremental-retrain contract, counted below
+                    from photon_ml_tpu.registry import (
+                        cached_scan_stream,
+                        cached_scan_stream_with_summary,
+                    )
+
+                    if use_fused:
+                        index_map, stats, fused_summary, cache_stats = (
+                            cached_scan_stream_with_summary(
+                                train_paths, fmt, p.scan_cache_dir,
+                                index_map=prebuilt,
+                            )
+                        )
+                    else:
+                        index_map, stats, cache_stats = cached_scan_stream(
+                            train_paths, fmt, p.scan_cache_dir,
+                            index_map=prebuilt,
+                        )
+                    self._scan_cache_stats = cache_stats.as_dict()
+                    self.logger.info(
+                        "scan cache: %d partition(s), %d cached, "
+                        "%d scanned, %d quarantined",
+                        cache_stats.partitions, cache_stats.cached,
+                        cache_stats.scanned, cache_stats.quarantined,
+                    )
+                elif use_fused:
                     index_map, stats, fused_summary = (
                         scan_stream_with_summary(
                             train_paths, fmt, index_map=prebuilt
@@ -691,19 +799,228 @@ class GLMDriver:
             "streaming": p.streaming,
             "constraint_string": p.constraint_string,
         }
+        if p.retrain_from:
+            # the warm start changes the iterate chain: a resumed sweep
+            # must come from the SAME parent generation
+            run_config["retrain_parent_signature"] = (
+                self._parent_generation.signature
+                if self._parent_generation is not None
+                else None
+            )
         guard = PreemptionGuard().install()
         return GridCheckpointer(p.checkpoint_dir, run_config), guard
+
+    # -- continuous retraining (registry/) ----------------------------------
+
+    def _load_parent(self) -> None:
+        """Resolve --retrain-from to the latest committed generation and
+        its coefficient dict (by feature KEY — alignment never trusts
+        indices across vocabularies). A registry with no committed
+        generation is a cold start, not an error: the first cron tick
+        of a retrain loop trains from zeros and publishes generation 1."""
+        p = self.params
+        if not p.retrain_from:
+            return
+        from photon_ml_tpu.registry import ModelRegistry
+
+        registry = ModelRegistry(p.retrain_from)
+        info = registry.latest()
+        if info is None:
+            self.logger.info(
+                "retrain-from registry %s has no committed generation; "
+                "cold start", p.retrain_from,
+            )
+            return
+        self._parent_generation = info
+        self._parent_means = _glm_artifact_means(info.model_dir)
+        self.logger.info(
+            "retraining from generation %d (lineage %s, %d parent "
+            "coefficients, gate verdict %s)",
+            info.generation,
+            registry.lineage(info.generation),
+            len(self._parent_means),
+            info.gate_verdict,
+        )
+
+    def _retrain_initial(self):
+        """The drift-safe warm-start vector in the CURRENT index space
+        (None when not retraining): new terms zero-init, removed terms
+        dropped with accounting, bitwise the parent when nothing
+        drifted. The report lands in metrics.json."""
+        if self._parent_means is None:
+            return None
+        from photon_ml_tpu.registry import DriftReport, align_coefficients
+
+        report = DriftReport()
+        initial = align_coefficients(
+            self._parent_means, self._data.index_map, report=report
+        )
+        self._drift_report = report
+        self.logger.info(
+            "warm-start alignment: %d kept, %d new (zero-init), "
+            "%d dropped%s",
+            report.kept, report.new_zero_init, report.dropped,
+            "" if report.no_drift else " [DRIFT]",
+        )
+        return initial
+
+    def _run_gates(self, candidate_model):
+        """Candidate-vs-parent gates on the validating stream; returns
+        the GateReport whose verdict decides the publish."""
+        import jax.numpy as jnp
+
+        from photon_ml_tpu.registry import (
+            GateConfig,
+            align_coefficients,
+            evaluate_gates,
+        )
+
+        p = self.params
+        config = GateConfig(
+            max_auc_drop=p.gate_max_auc_drop,
+            max_rmse_increase=p.gate_max_rmse_increase,
+            max_coef_norm_ratio=p.gate_max_coef_norm_ratio,
+            max_prediction_drift=p.gate_max_prediction_drift,
+        )
+        # the parent scored through TODAY's featurization: shared terms
+        # contribute identically, vanished terms contribute nothing
+        parent_vec = align_coefficients(
+            self._parent_means, self._data.index_map
+        )
+        candidate_means = np.asarray(candidate_model.means)
+        validate_paths = self._dated_paths(
+            p.validate_dir, p.validate_date_range,
+            p.validate_date_range_days_ago,
+        )
+        if p.streaming:
+            from photon_ml_tpu.io.streaming import scan_stream
+            from photon_ml_tpu.registry.gates import glm_gate_chunks
+
+            _, vstats = scan_stream(
+                validate_paths, self._fmt, index_map=self._data.index_map
+            )
+            chunks = glm_gate_chunks(
+                jnp.asarray(candidate_means),
+                jnp.asarray(parent_vec),
+                validate_paths,
+                self._fmt,
+                self._data.index_map,
+                vstats.max_nnz,
+            )
+        else:
+            from photon_ml_tpu.parallel import overlap
+
+            vdata = self._validation_data
+            cm, pm, labels, weights = overlap.device_get(
+                (
+                    compute_margins(
+                        jnp.asarray(candidate_means), vdata.batch
+                    ),
+                    compute_margins(jnp.asarray(parent_vec), vdata.batch),
+                    vdata.batch.labels,
+                    vdata.batch.weights,
+                )
+            )
+            chunks = [(cm, pm, labels, weights)]
+        report = evaluate_gates(
+            chunks,
+            p.task,
+            config=config,
+            candidate_norm=float(np.linalg.norm(candidate_means)),
+            parent_norm=float(np.linalg.norm(parent_vec)),
+        )
+        self._gate_report = report
+        self.logger.info(
+            "validation gates: %s %s", report.verdict,
+            {k: v.get("passed") for k, v in report.checks.items()},
+        )
+        return report
+
+    def _publish_to_registry(self) -> None:
+        """Publish the trained model as the next generation. A failed
+        gate is an EXPECTED terminal outcome of the retrain loop: the
+        refusal (named verdict) is recorded in the registry and in
+        metrics.json, and the driver exits cleanly without a new
+        generation."""
+        p = self.params
+        if self.best_model is not None:
+            lam, model = self.best_lambda, self.best_model
+        elif len(self.models) == 1:
+            lam, model = next(iter(self.models.items()))
+        else:
+            raise ValueError(
+                "publishing a multi-lambda grid requires a validating "
+                "directory to select the best model"
+            )
+        gate_report = None
+        if self._parent_generation is not None:
+            gate_report = self._run_gates(model)
+        candidate_dir = os.path.join(p.output_dir, "registry-candidate")
+        save_glm_models_avro(
+            {lam: model},
+            os.path.join(candidate_dir, "model.avro"),
+            self._data.index_map,
+        )
+        # the index map rides with the artifact so the NEXT retrain (and
+        # any scorer) aligns by key without this run's output tree
+        self._data.index_map.save(
+            os.path.join(candidate_dir, "feature-index", "index.json")
+        )
+        from photon_ml_tpu.registry import ModelRegistry, RefusedCandidate
+
+        registry = ModelRegistry(p.publish_registry)
+        extra = {
+            "task": p.task.name,
+            "lambda": float(lam),
+            "num_features": int(self._data.num_features),
+        }
+        if self._drift_report is not None:
+            extra["drift"] = self._drift_report.as_dict()
+        try:
+            info = registry.publish(
+                candidate_dir,
+                parent=(
+                    self._parent_generation.generation
+                    if self._parent_generation is not None
+                    else None
+                ),
+                data_ranges={
+                    "train_dir": p.train_dir,
+                    "train_date_range": p.train_date_range,
+                    "train_date_range_days_ago": (
+                        p.train_date_range_days_ago
+                    ),
+                },
+                gate_report=(
+                    gate_report.as_dict() if gate_report is not None
+                    else None
+                ),
+                extra=extra,
+            )
+            self._published_generation = info.generation
+            self.logger.info(
+                "published generation %d (parent %s, signature %s)",
+                info.generation, info.parent, info.signature,
+            )
+        except RefusedCandidate as e:
+            self.logger.warning(
+                "candidate REFUSED by validation gate %s; generation "
+                "lineage unchanged (refusal recorded at %s)",
+                e.verdict, e.refused_dir,
+            )
 
     def train(self) -> None:
         p = self.params
         self.emitter.send(TrainingStartEvent(p.job_name))
         from photon_ml_tpu.utils.profiling import profile_trace
 
+        self._load_parent()
         grid_ckpt, guard = self._grid_checkpoint_setup()
         self._preempted = False
         with self.timer.time("train"), profile_trace(p.profile_dir):
             data = self._data
             mesh = self._mesh()
+            retrain_initial = self._retrain_initial()
             if p.streaming:
                 from photon_ml_tpu.io.streaming import (
                     sparse_row_bytes,
@@ -796,6 +1113,7 @@ class GLMDriver:
                         tile_cache_dir=p.tile_cache_dir,
                         grid_checkpointer=grid_ckpt,
                         preemption_guard=guard,
+                        initial=retrain_initial,
                     )
             elif p.distributed == "feature" and mesh is not None:
                 grid_mode = self._resolved_grid_mode(data.num_features)
@@ -893,6 +1211,7 @@ class GLMDriver:
                         track_models=p.validate_per_iteration,
                         tile_cache_dir=p.tile_cache_dir,
                         grid_checkpointer=grid_ckpt,
+                        initial=retrain_initial,
                     )
                 else:
                     self.models, self.results = train_generalized_linear_model(
@@ -915,6 +1234,7 @@ class GLMDriver:
                         tile_cache_dir=p.tile_cache_dir,
                         grid_checkpointer=grid_ckpt,
                         preemption_guard=guard,
+                        initial=retrain_initial,
                     )
             self._log_results()
         if guard is not None:
@@ -1274,6 +1594,26 @@ class GLMDriver:
             "timers": self.timer.durations,
             "schedule_cache": self._schedule_cache_stats,
         }
+        if self._scan_cache_stats:
+            # the "touched only new partitions" counters (scan cache)
+            payload["scan_cache"] = self._scan_cache_stats
+        if p.retrain_from or p.publish_registry:
+            payload["registry"] = {
+                "retrain_from": p.retrain_from,
+                "parent_generation": (
+                    self._parent_generation.generation
+                    if self._parent_generation is not None else None
+                ),
+                "published_generation": self._published_generation,
+                "drift": (
+                    self._drift_report.as_dict()
+                    if self._drift_report is not None else None
+                ),
+                "gates": (
+                    self._gate_report.as_dict()
+                    if self._gate_report is not None else None
+                ),
+            }
         if p.streaming:
             # the out-of-core contract made observable: configured budget
             # vs the measured host high-water
@@ -1316,6 +1656,11 @@ class GLMDriver:
         if p.diagnostic_mode != DiagnosticMode.NONE and is_coordinator():
             self.diagnose()
         if is_coordinator():
+            if p.publish_registry:
+                # gates + publish run BEFORE metrics so the verdict and
+                # the published generation land in metrics.json
+                with self.timer.time("publish-registry"):
+                    self._publish_to_registry()
             self._write_outputs()
         from photon_ml_tpu.parallel import overlap
 
@@ -1482,6 +1827,38 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "); also via PHOTON_FAULT_PLAN. Chaos harness: dev-scripts/"
         "chaos.sh",
     )
+    ap.add_argument(
+        "--retrain-from", default=None,
+        help="model-registry directory: warm-start the coefficients "
+        "from the latest committed generation with drift-safe "
+        "alignment (new terms zero-init, removed terms dropped with "
+        "accounting; bitwise pass-through when nothing drifted)",
+    )
+    ap.add_argument(
+        "--publish-registry", default=None,
+        help="model-registry directory: publish the trained best model "
+        "as the next generation — gated against the parent on the "
+        "validating directory when --retrain-from resolved one (a "
+        "failed gate records a named verdict; the candidate is never "
+        "loadable)",
+    )
+    ap.add_argument(
+        "--scan-cache-dir", default=None,
+        help="append-only per-partition scan/stats cache: the "
+        "streaming preprocess re-reads ONLY partitions without a "
+        "cache entry (the incremental-retrain fast path; counters in "
+        "metrics.json)",
+    )
+    ap.add_argument("--gate-max-auc-drop", type=float, default=0.005)
+    ap.add_argument("--gate-max-rmse-increase", type=float, default=0.01)
+    ap.add_argument(
+        "--gate-max-coef-norm-ratio", type=float, default=10.0
+    )
+    ap.add_argument(
+        "--gate-max-prediction-drift", type=float, default=None,
+        help="mean |candidate - parent| holdout margin bound "
+        "(default: gate off)",
+    )
     return ap
 
 
@@ -1565,6 +1942,13 @@ def params_from_args(argv=None) -> GLMParams:
         process_id=ns.process_id,
         checkpoint_dir=ns.checkpoint_dir,
         fault_plan=ns.fault_plan,
+        retrain_from=ns.retrain_from,
+        publish_registry=ns.publish_registry,
+        scan_cache_dir=ns.scan_cache_dir,
+        gate_max_auc_drop=ns.gate_max_auc_drop,
+        gate_max_rmse_increase=ns.gate_max_rmse_increase,
+        gate_max_coef_norm_ratio=ns.gate_max_coef_norm_ratio,
+        gate_max_prediction_drift=ns.gate_max_prediction_drift,
         event_listeners=(
             ns.event_listeners.split(",") if ns.event_listeners else []
         ),

@@ -104,6 +104,18 @@ class ServingParams:
     # Admission default: requests that carry no deadline_ms of their
     # own get this one (None = no deadline).
     default_deadline_ms: Optional[float] = None
+    # Continuous retraining (registry/): serve the latest committed
+    # generation of a model registry and hot-swap newly published ones
+    # under live traffic. --auto-rollback flips BACK to the parent
+    # generation (bitwise, reloaded from the registry artifact) and
+    # quarantines the bad one when the post-swap health window
+    # regresses (degraded/shed/error rate over the sliding window).
+    registry_dir: Optional[str] = None
+    registry_poll_s: float = 2.0
+    auto_rollback: bool = True
+    rollback_window: int = 64
+    rollback_min_requests: int = 16
+    rollback_max_unhealthy: float = 0.5
 
     @property
     def stdin_mode(self) -> bool:
@@ -114,8 +126,36 @@ class ServingParams:
         return self.frontend_port is not None
 
     def validate(self) -> None:
-        if not self.game_model_input_dir:
-            raise ValueError("game-model-input-dir is required")
+        if not self.game_model_input_dir and not self.registry_dir:
+            raise ValueError(
+                "game-model-input-dir is required (or --registry-dir to "
+                "serve the latest committed registry generation)"
+            )
+        if self.game_model_input_dir and self.registry_dir:
+            raise ValueError(
+                "choose ONE model source: --game-model-input-dir or "
+                "--registry-dir"
+            )
+        if self.registry_dir and not self.frontend_mode:
+            raise ValueError(
+                "--registry-dir serves live traffic (the watcher swaps "
+                "generations under load); it requires --frontend-port"
+            )
+        if self.registry_dir and self.swap_model_dir:
+            raise ValueError(
+                "--swap-model-dir is the manual swap demonstration; "
+                "with --registry-dir the watcher owns swaps"
+            )
+        if self.registry_poll_s <= 0:
+            raise ValueError("registry-poll-s must be > 0")
+        if not 0 < self.rollback_max_unhealthy <= 1:
+            raise ValueError(
+                "rollback-max-unhealthy must be in (0, 1]"
+            )
+        if self.rollback_window < 1 or self.rollback_min_requests < 1:
+            raise ValueError(
+                "rollback window/min-requests must be >= 1"
+            )
         if not self.output_dir:
             raise ValueError("output-dir is required")
         if not self.request_paths and not self.frontend_mode:
@@ -216,6 +256,10 @@ class ServingDriver:
         self._open_results: Dict[int, tuple] = {}
         self.drain_report = None
         self.interrupted = False
+        # continuous-retraining state (--registry-dir)
+        self.registry = None            # registry.ModelRegistry
+        self.registry_watcher = None    # registry.RegistryWatcher
+        self._registry_generation = None
 
     # -- setup ---------------------------------------------------------------
 
@@ -255,8 +299,25 @@ class ServingDriver:
         from photon_ml_tpu.serving.batcher import request_from_record
 
         p = self.params
+        model_dir = p.game_model_input_dir
+        if p.registry_dir:
+            from photon_ml_tpu.registry import ModelRegistry
+
+            self.registry = ModelRegistry(p.registry_dir)
+            info = self.registry.latest()
+            if info is None:
+                raise ValueError(
+                    f"registry {p.registry_dir} has no committed "
+                    "generation to serve"
+                )
+            self._registry_generation = info
+            model_dir = info.model_dir
+            self.logger.info(
+                "serving registry generation %d (parent %s, gates %s)",
+                info.generation, info.parent, info.gate_verdict,
+            )
         with self.timer.time("load-model"):
-            loaded = load_model_artifact(p.game_model_input_dir)
+            loaded = load_model_artifact(model_dir)
         id_types = sorted(
             {re_t for re_t, _, _ in loaded.random_effects.values()}
             | {
@@ -574,6 +635,28 @@ class ServingDriver:
             extra["outcomes"] = dict(sorted(outcomes.items()))
         if self.drain_report is not None:
             extra["drain"] = self.drain_report.to_dict()
+        if self.registry_watcher is not None:
+            extra["registry"] = {
+                **self.registry_watcher.lineage(),
+                "watcher_history": [
+                    {
+                        "action": r.action,
+                        "registry_generation": r.registry_generation,
+                        "parent": r.parent,
+                        "ok": r.ok,
+                        "error": r.error,
+                    }
+                    for r in self.registry_watcher.history
+                ],
+            }
+        elif self.registry is not None:
+            extra["registry"] = {
+                "registry_path": self.registry.root,
+                "registry_generation": (
+                    self._registry_generation.generation
+                    if self._registry_generation is not None else None
+                ),
+            }
         return extra
 
     def run(self) -> None:
@@ -663,6 +746,40 @@ class ServingDriver:
             if p.swap_model_dir
             else None
         )
+        on_outcome = None
+        lineage_provider = None
+        rollback_handler = None
+        if self.registry is not None:
+            from photon_ml_tpu.registry import (
+                RegistryWatcher,
+                RollbackPolicy,
+            )
+
+            self.registry_watcher = RegistryWatcher(
+                self.registry,
+                self.serving_model,
+                poll_s=p.registry_poll_s,
+                policy=RollbackPolicy(
+                    window=p.rollback_window,
+                    min_requests=p.rollback_min_requests,
+                    max_unhealthy_rate=p.rollback_max_unhealthy,
+                ),
+                auto_rollback=p.auto_rollback,
+                swap_kwargs={
+                    "entity_pad_to": p.entity_pad_to,
+                    "model_id": p.model_id,
+                },
+                logger=self.logger,
+                initial_generation=self._registry_generation,
+            ).start()
+            on_outcome = (
+                lambda ok, degraded, failed:
+                self.registry_watcher.observe_outcome(
+                    degraded=degraded, failed=failed
+                )
+            )
+            lineage_provider = self.registry_watcher.lineage
+            rollback_handler = self.registry_watcher.rollback
         frontend = ServingFrontend(
             batcher,
             self.serving_model,
@@ -672,6 +789,9 @@ class ServingDriver:
             port=p.frontend_port,
             has_response=p.has_response,
             on_completion=on_completion,
+            on_outcome=on_outcome,
+            lineage_provider=lineage_provider,
+            rollback_handler=rollback_handler,
         )
         frontend.start()
         atomic_write_json(
@@ -680,6 +800,13 @@ class ServingDriver:
                 "host": p.frontend_host,
                 "port": frontend.port,
                 "pid": os.getpid(),
+                # the registry this replica follows (null when serving
+                # a fixed artifact): operators and the chaos arms read
+                # it to publish/poke the SAME lineage the service sees
+                "registry": (
+                    self.registry.root if self.registry is not None
+                    else None
+                ),
             },
         )
         self.logger.info(
@@ -698,11 +825,17 @@ class ServingDriver:
                 pass
             self.interrupted = True
             with self.timer.time("drain"):
+                if self.registry_watcher is not None:
+                    # stop promoting before the drain: a swap staged
+                    # into a draining batcher would never serve
+                    self.registry_watcher.stop()
                 frontend.stop_accepting()
                 self.drain_report = batcher.drain(p.drain_timeout_s)
                 frontend.close()
         finally:
             self._restore_signal_handlers(prev)
+            if self.registry_watcher is not None:
+                self.registry_watcher.stop()
             batcher.close()
             overlap.drain_io()
         leaked = frontend.open_connections()
@@ -723,7 +856,11 @@ class ServingDriver:
 
 def build_arg_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="photon-ml-tpu serving")
-    ap.add_argument("--game-model-input-dir", required=True)
+    ap.add_argument(
+        "--game-model-input-dir", default=None,
+        help="GAME model artifact to serve (or --registry-dir to "
+        "follow a model registry's committed generations)",
+    )
     ap.add_argument("--output-dir", required=True)
     ap.add_argument(
         "--request-paths", default=None,
@@ -804,6 +941,38 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="deadline applied to requests that carry none of their "
         "own; enables load shedding under overload",
     )
+    ap.add_argument(
+        "--registry-dir", default=None,
+        help="model-registry directory: serve its latest committed "
+        "generation and hot-swap newly published ones under live "
+        "traffic (requires --frontend-port; the registry path is "
+        "published to frontend.json)",
+    )
+    ap.add_argument(
+        "--registry-poll-s", type=float, default=2.0,
+        help="registry poll period for the generation watcher",
+    )
+    ap.add_argument(
+        "--auto-rollback", default="true",
+        help="roll back to the parent generation (bitwise) and "
+        "quarantine the bad one when the post-swap health window "
+        "regresses",
+    )
+    ap.add_argument(
+        "--rollback-window", type=int, default=64,
+        help="sliding window of post-swap completions judged for "
+        "auto-rollback",
+    )
+    ap.add_argument(
+        "--rollback-min-requests", type=int, default=16,
+        help="minimum post-swap completions before auto-rollback can "
+        "trigger",
+    )
+    ap.add_argument(
+        "--rollback-max-unhealthy", type=float, default=0.5,
+        help="auto-rollback when (degraded+shed+errors)/window exceeds "
+        "this rate",
+    )
     return ap
 
 
@@ -819,7 +988,7 @@ def params_from_args(argv=None) -> ServingParams:
         return str(s).lower() in ("true", "1", "yes")
 
     return ServingParams(
-        game_model_input_dir=ns.game_model_input_dir,
+        game_model_input_dir=ns.game_model_input_dir or "",
         output_dir=ns.output_dir,
         request_paths=(
             []
@@ -861,6 +1030,12 @@ def params_from_args(argv=None) -> ServingParams:
         frontend_port=ns.frontend_port,
         drain_timeout_s=ns.drain_timeout,
         default_deadline_ms=ns.default_deadline_ms,
+        registry_dir=ns.registry_dir,
+        registry_poll_s=ns.registry_poll_s,
+        auto_rollback=truthy(ns.auto_rollback),
+        rollback_window=ns.rollback_window,
+        rollback_min_requests=ns.rollback_min_requests,
+        rollback_max_unhealthy=ns.rollback_max_unhealthy,
     )
 
 

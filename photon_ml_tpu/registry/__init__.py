@@ -1,0 +1,73 @@
+"""Model registry: validation-gated continuous retraining.
+
+- :mod:`registry.registry` — generation-numbered lineage store with
+  crash-atomic publish (stage -> rename -> commit marker), a
+  single-writer lease, refusal records for gate-failed candidates,
+  quarantine for rolled-back generations, and retention GC.
+- :mod:`registry.stats_cache` — append-only per-partition scan/stats
+  cache: incremental retrains re-read only NEW partitions (counted).
+- :mod:`registry.warm_start` — drift-safe alignment of a parent
+  generation's coefficients to a retrain's feature/entity spaces
+  (bitwise pass-through when nothing drifted).
+- :mod:`registry.gates` — candidate-vs-parent promotion gates over a
+  streamed holdout; one named terminal verdict per publish attempt.
+- :mod:`registry.watcher` — serving-side promotion + auto-rollback.
+"""
+
+from photon_ml_tpu.registry.gates import (
+    GateConfig,
+    GateReport,
+    coef_norm_gate,
+    evaluate_gates,
+)
+from photon_ml_tpu.registry.registry import (
+    PUBLISH_SEAM,
+    GenerationInfo,
+    ModelRegistry,
+    RefusedCandidate,
+    RegistryLeaseHeld,
+    content_signature,
+)
+from photon_ml_tpu.registry.stats_cache import (
+    STATS_CACHE_SEAM,
+    PartitionStatsCache,
+    ScanCacheStats,
+    cached_scan_stream,
+    cached_scan_stream_with_summary,
+)
+from photon_ml_tpu.registry.warm_start import (
+    DriftReport,
+    align_coefficients,
+    align_re_bank,
+    warm_start_game_model,
+)
+from photon_ml_tpu.registry.watcher import (
+    HealthWindow,
+    RegistryWatcher,
+    RollbackPolicy,
+)
+
+__all__ = [
+    "PUBLISH_SEAM",
+    "STATS_CACHE_SEAM",
+    "GenerationInfo",
+    "ModelRegistry",
+    "RefusedCandidate",
+    "RegistryLeaseHeld",
+    "content_signature",
+    "PartitionStatsCache",
+    "ScanCacheStats",
+    "cached_scan_stream",
+    "cached_scan_stream_with_summary",
+    "DriftReport",
+    "align_coefficients",
+    "align_re_bank",
+    "warm_start_game_model",
+    "GateConfig",
+    "GateReport",
+    "coef_norm_gate",
+    "evaluate_gates",
+    "HealthWindow",
+    "RegistryWatcher",
+    "RollbackPolicy",
+]

@@ -74,6 +74,8 @@ SEAMS = (
     "serving.model_load",  # serving bank load / hot-swap staging reads
     "serving.frontend.read",   # network front-end per-line reads
     "serving.dispatch",        # micro-batch device dispatch (idempotent)
+    "registry.publish",        # model-registry publish protocol steps
+    "registry.stats_cache",    # per-partition scan/stats cache load/store
 )
 
 _ERRNO = {

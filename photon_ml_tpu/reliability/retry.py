@@ -90,6 +90,15 @@ _POLICIES: Dict[str, RetryPolicy] = {
     # fault retries bitwise, an exhausted budget fails the batch's
     # futures with the seam-named error
     "serving.dispatch": RetryPolicy(max_attempts=3, base_delay_s=0.002),
+    # every publish step is idempotent (stage into a token-unique
+    # directory, rename, marker write), so transient faults retry; an
+    # exhausted budget aborts the publish with NOTHING visible — the
+    # crash-resume path (adopt-or-quarantine of uncommitted dirs)
+    # handles the rest
+    "registry.publish": RetryPolicy(max_attempts=3),
+    # cache seams fall back to a rescan of the partition, so the budget
+    # is shallow like the schedule cache's
+    "registry.stats_cache": RetryPolicy(max_attempts=2),
 }
 
 

@@ -277,6 +277,24 @@ class _Connection:
                     "re_type": re_type,
                     "generation": self.fe.serving_model.generation,
                 })
+            elif str(op) == "rollback":
+                # operator lever: flip back to the parent generation
+                # (registry watcher required — a replay-mode service
+                # has no lineage to roll along)
+                if self.fe.rollback_handler is None:
+                    self.send(_error_response(
+                        obj.get("uid"), "BAD_REQUEST",
+                        "no registry watcher attached: rollback needs "
+                        "generation lineage",
+                    ))
+                    return
+                ok = bool(self.fe.rollback_handler())
+                self.send({
+                    "status": "ok" if ok else "error",
+                    "op": op,
+                    "rolled_back": ok,
+                    "generation": self.fe.serving_model.generation,
+                })
             else:
                 self.send(_error_response(
                     obj.get("uid"), "BAD_REQUEST", f"unknown op {op!r}"
@@ -317,6 +335,9 @@ class ServingFrontend:
         max_line_bytes: int = DEFAULT_MAX_LINE_BYTES,
         writer_queue_max: int = DEFAULT_WRITER_QUEUE,
         on_completion: Optional[Callable[[int], None]] = None,
+        on_outcome: Optional[Callable[[bool, bool, bool], None]] = None,
+        lineage_provider: Optional[Callable[[], Dict]] = None,
+        rollback_handler: Optional[Callable[[], bool]] = None,
     ):
         self.batcher = batcher
         self.serving_model = serving_model
@@ -327,6 +348,12 @@ class ServingFrontend:
         self.max_line_bytes = int(max_line_bytes)
         self.writer_queue_max = int(writer_queue_max)
         self.on_completion = on_completion
+        # continuous-retraining hooks (registry.watcher): per-outcome
+        # health feed (ok, degraded, failed), generation-lineage block
+        # for the status op, and the operator rollback lever
+        self.on_outcome = on_outcome
+        self.lineage_provider = lineage_provider
+        self.rollback_handler = rollback_handler
         self._completed = 0
         self._completed_lock = threading.Lock()
         self._conns: List[_Connection] = []
@@ -409,8 +436,11 @@ class ServingFrontend:
     def status_response(self, op: str = "status") -> Dict[str, object]:
         """Readiness + liveness in one payload: ``ready`` gates traffic
         (bank live, ladder warm), ``alive``/``heartbeat_age_s`` gate
-        restarts (dispatcher beating)."""
-        return {
+        restarts (dispatcher beating). With a registry watcher attached
+        the payload also carries generation LINEAGE (registry
+        generation, parent chain, last swap/rollback outcome) — the
+        operator's one-stop "what exactly is serving right now"."""
+        out = {
             "status": "ok",
             "op": op,
             "ready": bool(
@@ -425,6 +455,23 @@ class ServingFrontend:
             "generation": self.serving_model.generation,
             "queue_depth": self.batcher.queue_depth(),
         }
+        history = getattr(self.serving_model, "swap_history", None)
+        if history:
+            last = history[-1]
+            out["last_swap"] = {
+                "ok": last.ok,
+                "generation": last.generation,
+                "donated": last.donated,
+                "rolled_back": last.rolled_back,
+                "error": last.error,
+            }
+        if self.lineage_provider is not None:
+            try:
+                out["registry"] = self.lineage_provider()
+            except Exception as e:
+                # status must answer even when the watcher is wedged
+                out["registry"] = {"error": str(e)}
+        return out
 
     # -- internals -----------------------------------------------------------
 
@@ -492,8 +539,20 @@ class ServingFrontend:
         try:
             outcome = fut.result(timeout=0)
             resp = _outcome_response(uid, outcome)
+            ok, degraded, failed = (
+                True, bool(getattr(outcome, "degraded", False)), False,
+            )
         except BaseException as e:
             resp = _failure_response(uid, e)
+            ok, degraded, failed = False, False, True
+        hook = self.on_outcome
+        if hook is not None:
+            try:
+                # the registry watcher's post-swap health feed: two
+                # boolean ORs on the response path, never a swap
+                hook(ok, degraded, failed)
+            except Exception:
+                self._note("completion_hook_errors")
         conn._note_pending(-1)
         conn.send(resp)
         with self._completed_lock:

@@ -893,6 +893,7 @@ def train_streaming_glm(
     tile_cache_dir: Optional[str] = None,
     grid_checkpointer=None,
     preemption_guard=None,
+    initial: Optional[Array] = None,
 ):
     """Train a GLM over Avro inputs LARGER than host RAM: every objective
     evaluation streams fixed-shape chunks from disk (io/streaming.py), so
@@ -1033,7 +1034,14 @@ def train_streaming_glm(
     )
     models: Dict[float, GeneralizedLinearModel] = {}
     results: Dict[float, OptResult] = {}
-    current = jnp.zeros((objective.dim,), jnp.float32)
+    # retrain warm start (registry.warm_start): the aligned parent
+    # coefficients seed the FIRST λ exactly like `initial` on the
+    # in-memory paths
+    current = (
+        jnp.asarray(initial, jnp.float32)
+        if initial is not None
+        else jnp.zeros((objective.dim,), jnp.float32)
+    )
     for lam in weights_desc:
         snap = (
             grid_checkpointer.load(lam)

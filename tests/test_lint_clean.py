@@ -234,6 +234,27 @@ class TestLintClean:
                 if s.path.replace(os.sep, "/").endswith(mod)
             ], f"{mod} must not carry allow() suppressions"
 
+    def test_registry_subsystem_covered_and_clean(self, full_report):
+        """ISSUE 10: photon_ml_tpu/registry/ (model registry, stats
+        cache, warm-start alignment, gates, watcher) is in the analyzed
+        set and contributes ZERO baseline entries and ZERO allow()
+        sites — in particular every artifact write in the publish
+        protocol goes through the atomic helpers (PL006) with no
+        except-and-pass, structurally."""
+        registry_files = [
+            f for f in full_report.files
+            if "photon_ml_tpu/registry/" in f.replace(os.sep, "/")
+        ]
+        assert len(registry_files) >= 5, registry_files
+        entries = json.load(open(BASELINE))["entries"]
+        assert not [
+            e for e in entries if "registry" in e["file"]
+        ], "registry code must not be baselined"
+        assert not [
+            s for s in full_report.allow_sites
+            if "photon_ml_tpu/registry/" in s.path.replace(os.sep, "/")
+        ], "registry code must not carry allow() suppressions"
+
     def test_pl007_lands_at_zero(self, full_report):
         """ISSUE 8: the request-path-hygiene rule (no untimed
         Condition.wait / Future.result in serving/) ships with a ZERO

@@ -1,0 +1,913 @@
+"""ISSUE 10: model registry + safe continuous retraining.
+
+- publish protocol: atomic visibility (COMMIT marker), KILL at every
+  ``registry.publish`` seam crossing leaves committed-or-nothing and
+  resume republishes bitwise; idempotent republish; refusal/quarantine/
+  retention semantics; single-writer lease contention + dead-owner
+  takeover.
+- drift-safe warm-start alignment matrix: vocab grow/shrink, entity
+  churn (prior-mean init), no-drift bitwise pins (GLM vector + RE bank).
+- per-partition stats cache: hit/miss counters, appended partitions
+  scan only the new files, identical scan results, corruption
+  quarantine.
+- validation gates: pass/fail verdicts per gate, round-trip through the
+  manifest, refused candidates never loadable.
+- registry watcher: promotion on publish, post-swap health regression
+  auto-rollback restoring the parent bank BITWISE + registry
+  quarantine, frontend status lineage + operator rollback op.
+- driver e2e: GLM + GAME retrain-from/publish round trips.
+"""
+
+import filecmp
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.registry import (
+    DriftReport,
+    GateConfig,
+    GateReport,
+    ModelRegistry,
+    RefusedCandidate,
+    RegistryLeaseHeld,
+    RollbackPolicy,
+    align_coefficients,
+    align_re_bank,
+    cached_scan_stream,
+    cached_scan_stream_with_summary,
+    content_signature,
+    evaluate_gates,
+)
+from photon_ml_tpu.registry.registry import _Lease
+from photon_ml_tpu.utils.index_map import IndexMap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_model(path, payload=b"MODEL-BYTES-1"):
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "model.avro"), "wb") as f:
+        f.write(payload)
+    return path
+
+
+@pytest.fixture()
+def model_dir(tmp_path):
+    return _write_model(str(tmp_path / "candidate"))
+
+
+@pytest.fixture()
+def registry(tmp_path):
+    return ModelRegistry(str(tmp_path / "registry"))
+
+
+class TestPublish:
+    def test_publish_commit_and_lineage(self, registry, model_dir, tmp_path):
+        info = registry.publish(
+            model_dir, data_ranges={"train_dir": "d1"}
+        )
+        assert info.generation == 1
+        assert registry.latest().generation == 1
+        assert registry.latest().gate_verdict == "UNGATED"
+        m2 = _write_model(str(tmp_path / "m2"), b"MODEL-BYTES-2")
+        info2 = registry.publish(m2, parent=1)
+        assert info2.parent == 1
+        assert registry.lineage() == [2, 1]
+        # manifest records the data ranges and the content signature
+        assert info.manifest["data_ranges"] == {"train_dir": "d1"}
+        assert info2.signature == content_signature(m2)
+
+    def test_uncommitted_generation_is_invisible(self, registry, model_dir):
+        info = registry.publish(model_dir)
+        os.unlink(os.path.join(info.path, "COMMIT"))
+        assert registry.latest() is None
+        assert registry.list_generations() == []
+
+    def test_republish_same_content_is_idempotent(
+        self, registry, model_dir
+    ):
+        a = registry.publish(model_dir)
+        b = registry.publish(model_dir)
+        assert (a.generation, a.signature) == (b.generation, b.signature)
+        assert [g.generation for g in registry.list_generations()] == [1]
+
+    def test_refused_candidate_never_loadable(
+        self, registry, model_dir, tmp_path
+    ):
+        registry.publish(model_dir)
+        bad = _write_model(str(tmp_path / "bad"), b"BAD-MODEL")
+        with pytest.raises(RefusedCandidate) as ei:
+            registry.publish(
+                bad, parent=1,
+                gate_report={"verdict": "AUC_REGRESSION", "checks": {}},
+            )
+        assert ei.value.verdict == "AUC_REGRESSION"
+        # the loader view is unchanged; the refusal is on record
+        assert [g.generation for g in registry.list_generations()] == [1]
+        refusals = registry.refused_candidates()
+        assert len(refusals) == 1
+        assert refusals[0]["gates"]["verdict"] == "AUC_REGRESSION"
+        assert refusals[0]["signature"] == content_signature(bad)
+
+    def test_quarantine_hides_generation_and_burns_number(
+        self, registry, model_dir, tmp_path
+    ):
+        registry.publish(model_dir)
+        m2 = _write_model(str(tmp_path / "m2"), b"G2")
+        registry.publish(m2, parent=1)
+        q = registry.quarantine_generation(2, reason="rollback test")
+        assert q is not None and os.path.isdir(q)
+        assert registry.latest().generation == 1
+        with open(os.path.join(q, "quarantine.json")) as f:
+            assert json.load(f)["reason"] == "rollback test"
+        # the number is burned: the next publish is generation 3
+        m3 = _write_model(str(tmp_path / "m3"), b"G3")
+        assert registry.publish(m3, parent=1).generation == 3
+
+    def test_gc_keeps_referenced_parents(self, registry, tmp_path):
+        for i in range(5):
+            m = _write_model(str(tmp_path / f"m{i}"), f"G{i}".encode())
+            parent = registry.latest()
+            registry.publish(
+                m,
+                parent=parent.generation if parent else None,
+            )
+        removed = registry.gc(keep=2)
+        kept = [g.generation for g in registry.list_generations()]
+        # newest 2 plus generation 3 (parent of 4, the oldest retained)
+        assert kept == [3, 4, 5]
+        assert removed == [1, 2]
+
+    def test_missing_model_dir_fails_before_lease(self, registry):
+        with pytest.raises(ValueError, match="does not exist"):
+            registry.publish(str(registry.root) + "/nope")
+
+
+class TestLease:
+    def test_live_holder_wins_second_publisher_loses_cleanly(
+        self, registry, model_dir
+    ):
+        registry._ensure_layout()
+        holder = _Lease(registry.root)
+        holder.acquire()
+        try:
+            with pytest.raises(RegistryLeaseHeld):
+                registry.publish(model_dir)
+            # the loser wrote NOTHING
+            assert registry.list_generations() == []
+            assert os.listdir(registry.generations_dir) == []
+        finally:
+            holder.release()
+        # lease released: publish proceeds
+        assert registry.publish(model_dir).generation == 1
+
+    def test_dead_owner_lease_is_broken(self, registry, model_dir):
+        registry._ensure_layout()
+        import socket
+
+        with open(os.path.join(registry.root, "lease.json"), "w") as f:
+            json.dump(
+                {
+                    "pid": 2 ** 30,  # no such pid
+                    "host": socket.gethostname(),
+                    "token": "dead",
+                },
+                f,
+            )
+        assert registry.publish(model_dir).generation == 1
+
+    def test_torn_lease_file_is_broken(self, registry, model_dir):
+        registry._ensure_layout()
+        with open(os.path.join(registry.root, "lease.json"), "w") as f:
+            f.write('{"pid": 12')  # killed mid-write
+        assert registry.publish(model_dir).generation == 1
+
+
+_PUBLISH_HELPER = """
+import sys
+sys.path.insert(0, {repo!r})
+from photon_ml_tpu.registry import ModelRegistry
+info = ModelRegistry(sys.argv[1]).publish(
+    sys.argv[2], parent=None, data_ranges={{"train_dir": "d"}}
+)
+print(info.generation)
+"""
+
+
+class TestPublishKillMatrix:
+    """Fault-plan KILL at every ``registry.publish`` seam crossing: the
+    loader view is committed-or-nothing, and the resumed publish is
+    bitwise the uninterrupted one. (The registry imports without jax,
+    so each subprocess run is sub-second.)"""
+
+    def _publish(self, reg_dir, model, plan=None):
+        env = dict(os.environ)
+        env.pop("PHOTON_FAULT_PLAN", None)
+        if plan:
+            env["PHOTON_FAULT_PLAN"] = plan
+        return subprocess.run(
+            [sys.executable, "-c",
+             _PUBLISH_HELPER.format(repo=REPO), reg_dir, model],
+            capture_output=True, text=True, env=env, timeout=60,
+        )
+
+    def _tree_equal(self, a, b):
+        for root, _dirs, files in os.walk(a):
+            for f in files:
+                rel = os.path.relpath(os.path.join(root, f), a)
+                if not filecmp.cmp(
+                    os.path.join(a, rel), os.path.join(b, rel),
+                    shallow=False,
+                ):
+                    return False
+        return True
+
+    def test_kill_at_every_crossing_never_partial_resume_bitwise(
+        self, tmp_path
+    ):
+        model = _write_model(str(tmp_path / "model"), b"KILL-MATRIX")
+        ref = str(tmp_path / "reg-ref")
+        r = self._publish(ref, model)
+        assert r.returncode == 0, r.stderr
+        ref_gen = os.path.join(ref, "generations", "g000001")
+
+        saw_kill = 0
+        for n in range(1, 8):
+            reg_dir = str(tmp_path / f"reg-k{n}")
+            r = self._publish(
+                reg_dir, model, plan=f"registry.publish:{n}:KILL"
+            )
+            killed = r.returncode == -9
+            saw_kill += int(killed)
+            vis = [
+                g.generation
+                for g in ModelRegistry(reg_dir).list_generations()
+            ]
+            # committed-or-nothing: NEVER a partial generation
+            assert vis in ([], [1]), (n, vis)
+            if vis == [1]:
+                gen = os.path.join(reg_dir, "generations", "g000001")
+                assert self._tree_equal(ref_gen, gen), n
+            # resume: exactly one generation, bitwise the reference
+            r2 = self._publish(reg_dir, model)
+            assert r2.returncode == 0, (n, r2.stderr)
+            vis2 = [
+                g.generation
+                for g in ModelRegistry(reg_dir).list_generations()
+            ]
+            assert vis2 == [1], (n, vis2)
+            gen = os.path.join(reg_dir, "generations", "g000001")
+            assert self._tree_equal(ref_gen, gen), n
+            if not killed:
+                break  # past the last crossing: plan never fired
+        # the plan actually killed at the real crossings (>= 4:
+        # lease-acquire, stage, rename, commit)
+        assert saw_kill >= 4
+
+    def test_kill_mid_stage_leaves_adoptable_or_invisible_state(
+        self, tmp_path
+    ):
+        """KILL at the commit crossing specifically: the renamed
+        directory exists WITHOUT a marker (invisible), and the resumed
+        publish ADOPTS it (marker-only commit)."""
+        model = _write_model(str(tmp_path / "model"), b"ADOPT-ME")
+        reg_dir = str(tmp_path / "reg")
+        r = self._publish(
+            reg_dir, model, plan="registry.publish:4:KILL"
+        )
+        assert r.returncode == -9
+        gen_dir = os.path.join(reg_dir, "generations", "g000001")
+        assert os.path.isdir(gen_dir)  # renamed...
+        assert not os.path.exists(os.path.join(gen_dir, "COMMIT"))
+        assert ModelRegistry(reg_dir).list_generations() == []
+        model_sig = content_signature(os.path.join(gen_dir, "model"))
+        r2 = self._publish(reg_dir, model)
+        assert r2.returncode == 0, r2.stderr
+        # adopted: the model bytes did not change, only COMMIT appeared
+        assert ModelRegistry(reg_dir).latest().generation == 1
+        assert content_signature(
+            os.path.join(gen_dir, "model")
+        ) == model_sig
+        assert os.path.isfile(os.path.join(gen_dir, "COMMIT"))
+
+
+class TestDriftAlignment:
+    def test_no_drift_is_bitwise(self):
+        imap = IndexMap.build(["a\t", "b\t", "c\t"])
+        parent = {"a\t": 0.1234567, "b\t": -2.5e-8, "c\t": 3.0}
+        report = DriftReport()
+        vec = align_coefficients(parent, imap, report=report)
+        assert report.no_drift
+        expected = np.zeros(3, np.float32)
+        for k, v in parent.items():
+            expected[imap.get_index(k)] = np.float32(v)
+        assert vec.dtype == np.float32
+        assert np.array_equal(vec, expected)
+
+    def test_vocab_grow_zero_inits_new_terms(self):
+        imap = IndexMap.build(["a\t", "b\t", "new\t"])
+        report = DriftReport()
+        vec = align_coefficients(
+            {"a\t": 1.0, "b\t": 2.0}, imap, report=report
+        )
+        assert vec[imap.get_index("new\t")] == 0.0
+        assert report.kept == 2
+        assert report.new_zero_init == 1
+        assert report.dropped == 0
+        assert not report.no_drift
+
+    def test_vocab_shrink_drops_with_accounting(self):
+        imap = IndexMap.build(["a\t"])
+        report = DriftReport()
+        vec = align_coefficients(
+            {"a\t": 1.0, "gone\t": 9.0}, imap, report=report
+        )
+        assert vec.shape == (1,)
+        assert report.dropped == 1
+        assert "gone\t" in report.dropped_keys_sample
+
+    def test_reshuffled_indices_align_by_key(self):
+        """Same keys, different index assignment: values follow keys."""
+        imap = IndexMap.build(["z\t", "a\t", "m\t"])  # sorted: a, m, z
+        vec = align_coefficients(
+            {"a\t": 1.0, "m\t": 2.0, "z\t": 3.0}, imap
+        )
+        assert vec[imap.get_index("a\t")] == 1.0
+        assert vec[imap.get_index("z\t")] == 3.0
+
+    def _re_fixture(self):
+        imap = IndexMap.build(["u0\t", "u1\t", "u2\t"])
+        # projection: every entity sees all three features, global ids
+        # by the map
+        D = 3
+        proj = np.asarray(
+            [[imap.get_index(f"u{j}\t") for j in range(D)]] * 3, np.int32
+        )
+        return imap, proj
+
+    def test_re_bank_no_drift_bitwise(self):
+        imap, proj = self._re_fixture()
+        parent = {
+            "e0": {"u0\t": 0.5, "u1\t": -0.125},
+            "e1": {"u2\t": 7.0},
+            "e2": {"u0\t": 1e-30},
+        }
+        report = DriftReport()
+        bank = align_re_bank(
+            parent, ["e0", "e1", "e2"], proj, imap, report=report
+        )
+        assert report.no_drift
+        assert report.kept_entities == 3
+        expected = np.zeros((3, 3), np.float32)
+        for e, (eid) in enumerate(["e0", "e1", "e2"]):
+            for k, v in parent[eid].items():
+                expected[e, imap.get_index(k)] = np.float32(v)
+        assert np.array_equal(bank, expected)
+
+    def test_re_entity_churn_prior_mean_init(self):
+        imap, proj = self._re_fixture()
+        parent = {
+            "e0": {"u0\t": 1.0},
+            "e1": {"u0\t": 3.0},
+        }
+        proj = np.asarray([proj[0]] * 3, np.int32)
+        report = DriftReport()
+        bank = align_re_bank(
+            parent, ["e0", "e1", "NEW"], proj, imap, report=report
+        )
+        assert report.churned_entities_prior_init == 1
+        # prior mean over the FULL parent population (missing-as-zero):
+        # (1.0 + 3.0) / 2 entities
+        assert bank[2, imap.get_index("u0\t")] == np.float32(2.0)
+        assert not report.no_drift
+
+    def test_re_dropped_entity_accounting(self):
+        imap, proj = self._re_fixture()
+        report = DriftReport()
+        align_re_bank(
+            {"kept": {"u0\t": 1.0}, "gone": {"u0\t": 5.0}},
+            ["kept"], proj[:1], imap, report=report,
+        )
+        assert report.dropped_entities == 1
+        assert report.kept_entities == 1
+
+
+def _write_avro_partitions(dirname, n_files, rows, d=12, k=4, seed=0):
+    from photon_ml_tpu.io import schemas
+    from photon_ml_tpu.io.avro_codec import write_container
+
+    rng = np.random.default_rng(seed)
+    os.makedirs(dirname, exist_ok=True)
+    for fi in range(n_files):
+        recs = []
+        for i in range(rows):
+            ix = rng.integers(0, d, size=k)
+            vs = rng.normal(size=k)
+            recs.append({
+                "uid": f"{fi}-{i}",
+                "label": float(rng.integers(0, 2)),
+                "features": [
+                    {"name": f"f{int(j)}", "term": "", "value": float(v)}
+                    for j, v in zip(ix, vs)
+                ],
+                "offset": 0.0,
+                "weight": 1.0,
+            })
+        write_container(
+            os.path.join(dirname, f"part-{fi:03d}.avro"),
+            schemas.TRAINING_EXAMPLE_AVRO, recs,
+        )
+
+
+class TestStatsCache:
+    def _fmt(self):
+        from photon_ml_tpu.io.input_format import AvroInputDataFormat
+
+        return AvroInputDataFormat(add_intercept=True)
+
+    def test_cached_scan_matches_uncached_exactly(self, tmp_path):
+        train = str(tmp_path / "train")
+        _write_avro_partitions(train, 3, 40)
+        fmt = self._fmt()
+        imap_ref, stats_ref = fmt.stream_scan([train])
+        imap, stats, cs = cached_scan_stream(
+            [train], fmt, str(tmp_path / "cache")
+        )
+        assert dict(imap.items()) == dict(imap_ref.items())
+        assert (stats.num_rows, stats.max_nnz) == (
+            stats_ref.num_rows, stats_ref.max_nnz,
+        )
+        assert cs.partitions == 3 and cs.scanned == 3 and cs.cached == 0
+
+    def test_second_scan_touches_zero_partitions(self, tmp_path):
+        train = str(tmp_path / "train")
+        _write_avro_partitions(train, 3, 40)
+        fmt = self._fmt()
+        cache = str(tmp_path / "cache")
+        cached_scan_stream([train], fmt, cache)
+        _imap, _stats, cs = cached_scan_stream([train], fmt, cache)
+        assert cs.scanned == 0 and cs.cached == 3
+
+    def test_appended_partition_scans_only_the_new_file(self, tmp_path):
+        train = str(tmp_path / "train")
+        _write_avro_partitions(train, 3, 40)
+        fmt = self._fmt()
+        cache = str(tmp_path / "cache")
+        cached_scan_stream([train], fmt, cache)
+        _write_avro_partitions(train, 1, 25, seed=99)  # part-000 rewrite?
+        # seed=99 rewrites part-000: content changed -> rescan of that
+        # one; plus append a genuinely new file
+        _write_avro_partitions(
+            str(tmp_path / "extra"), 1, 25, seed=42
+        )
+        os.replace(
+            str(tmp_path / "extra" / "part-000.avro"),
+            os.path.join(train, "part-900.avro"),
+        )
+        imap, stats, cs = cached_scan_stream([train], fmt, cache)
+        assert cs.partitions == 4
+        assert cs.scanned == 2  # the rewritten file + the appended one
+        assert cs.cached == 2
+        # and the result is still exactly the uncached scan
+        imap_ref, stats_ref = fmt.stream_scan([train])
+        assert dict(imap.items()) == dict(imap_ref.items())
+        assert (stats.num_rows, stats.max_nnz) == (
+            stats_ref.num_rows, stats_ref.max_nnz,
+        )
+
+    def test_corrupt_entry_quarantines_and_rescans(self, tmp_path):
+        train = str(tmp_path / "train")
+        _write_avro_partitions(train, 2, 30)
+        fmt = self._fmt()
+        cache = str(tmp_path / "cache")
+        _imap_ref, stats_ref, _ = cached_scan_stream([train], fmt, cache)
+        vdir = os.path.join(cache, "v1")
+        entry = sorted(os.listdir(vdir))[0]
+        with open(os.path.join(vdir, entry), "w") as f:
+            f.write("{torn json")
+        imap, stats, cs = cached_scan_stream([train], fmt, cache)
+        assert cs.quarantined == 1
+        assert cs.scanned == 1 and cs.cached == 1
+        assert any(
+            name.endswith(".corrupt") for name in os.listdir(vdir)
+        )
+        assert (stats.num_rows, stats.max_nnz) == (
+            stats_ref.num_rows, stats_ref.max_nnz,
+        )
+
+    def test_summary_path_matches_fused_scan(self, tmp_path):
+        train = str(tmp_path / "train")
+        _write_avro_partitions(train, 3, 40)
+        fmt = self._fmt()
+        imap_ref, stats_ref, summary_ref = fmt.stream_scan_with_summary(
+            [train]
+        )
+        imap, stats, summary, cs = cached_scan_stream_with_summary(
+            [train], fmt, str(tmp_path / "cache")
+        )
+        assert dict(imap.items()) == dict(imap_ref.items())
+        assert stats.num_rows == stats_ref.num_rows
+        np.testing.assert_allclose(
+            np.asarray(summary.mean), np.asarray(summary_ref.mean),
+            rtol=1e-6, atol=1e-7,
+        )
+        np.testing.assert_allclose(
+            np.asarray(summary.variance),
+            np.asarray(summary_ref.variance), rtol=1e-5, atol=1e-6,
+        )
+        # warm rerun: zero partitions re-read, same summary
+        imap2, _stats2, summary2, cs2 = cached_scan_stream_with_summary(
+            [train], fmt, str(tmp_path / "cache")
+        )
+        assert cs2.scanned == 0
+        assert np.array_equal(
+            np.asarray(summary.mean), np.asarray(summary2.mean)
+        )
+
+    def test_scan_only_entry_upgrades_for_summary(self, tmp_path):
+        """A cache populated by the scan-only path must rescan for
+        moments (has_moments=False), not serve empty partials."""
+        train = str(tmp_path / "train")
+        _write_avro_partitions(train, 2, 20)
+        fmt = self._fmt()
+        cache = str(tmp_path / "cache")
+        cached_scan_stream([train], fmt, cache)
+        _i, _s, summary, cs = cached_scan_stream_with_summary(
+            [train], fmt, cache
+        )
+        assert cs.scanned == 2  # upgraded, not trusted
+        _iref, _sref, summary_ref = fmt.stream_scan_with_summary([train])
+        np.testing.assert_allclose(
+            np.asarray(summary.mean), np.asarray(summary_ref.mean),
+            rtol=1e-6, atol=1e-7,
+        )
+
+
+class TestGates:
+    def _chunks(self, cand_shift=0.0, n=400, seed=0):
+        rng = np.random.default_rng(seed)
+        z = rng.normal(size=n)
+        y = (1 / (1 + np.exp(-z)) > rng.uniform(size=n)).astype(
+            np.float64
+        )
+        w = np.ones(n)
+        par = z
+        cand = z + cand_shift * rng.normal(size=n)
+        return [(cand, par, y, w)]
+
+    def test_identical_models_pass(self):
+        from photon_ml_tpu.task import TaskType
+
+        report = evaluate_gates(
+            self._chunks(0.0), TaskType.LOGISTIC_REGRESSION,
+            candidate_norm=1.0, parent_norm=1.0,
+        )
+        assert report.verdict == "PASS" and report.passed
+        assert report.checks["auc"]["passed"]
+
+    def test_auc_regression_named_verdict(self):
+        from photon_ml_tpu.task import TaskType
+
+        # candidate = noise: AUC collapses to ~0.5
+        rng = np.random.default_rng(1)
+        chunks = self._chunks(0.0)
+        cand, par, y, w = chunks[0]
+        chunks = [(rng.normal(size=len(y)), par, y, w)]
+        report = evaluate_gates(chunks, TaskType.LOGISTIC_REGRESSION)
+        assert report.verdict == "AUC_REGRESSION"
+        assert not report.checks["auc"]["passed"]
+
+    def test_coef_norm_blowup_named_verdict(self):
+        from photon_ml_tpu.task import TaskType
+
+        report = evaluate_gates(
+            self._chunks(0.0), TaskType.LOGISTIC_REGRESSION,
+            candidate_norm=1e4, parent_norm=1.0,
+        )
+        assert report.verdict == "COEF_NORM_BLOWUP"
+        assert report.checks["coef_norm"]["ratio"] == pytest.approx(1e4)
+
+    def test_prediction_drift_named_verdict(self):
+        from photon_ml_tpu.task import TaskType
+
+        report = evaluate_gates(
+            self._chunks(5.0),
+            TaskType.LOGISTIC_REGRESSION,
+            config=GateConfig(
+                max_auc_drop=1.0, max_prediction_drift=0.1
+            ),
+        )
+        assert report.verdict == "PREDICTION_DRIFT"
+
+    def test_rmse_gate_on_regression_task(self):
+        from photon_ml_tpu.task import TaskType
+
+        rng = np.random.default_rng(2)
+        n = 300
+        y = rng.normal(size=n)
+        par = y + 0.1 * rng.normal(size=n)
+        cand = y + 3.0 * rng.normal(size=n)
+        report = evaluate_gates(
+            [(cand, par, y, np.ones(n))], TaskType.LINEAR_REGRESSION,
+        )
+        assert report.verdict == "RMSE_REGRESSION"
+
+    def test_empty_holdout_refuses(self):
+        from photon_ml_tpu.task import TaskType
+
+        report = evaluate_gates([], TaskType.LOGISTIC_REGRESSION)
+        assert report.verdict == "EMPTY_HOLDOUT"
+
+    def test_report_round_trips_through_manifest(
+        self, registry, model_dir, tmp_path
+    ):
+        """The gate report survives the publish -> manifest -> load
+        round trip verbatim, pass AND fail."""
+        from photon_ml_tpu.task import TaskType
+
+        passing = evaluate_gates(
+            self._chunks(0.0), TaskType.LOGISTIC_REGRESSION,
+            candidate_norm=1.0, parent_norm=1.0,
+        )
+        info = registry.publish(
+            model_dir, gate_report=passing.as_dict()
+        )
+        loaded = GateReport.from_dict(info.manifest["gates"])
+        assert loaded.verdict == "PASS"
+        assert loaded.as_dict() == passing.as_dict()
+        failing = evaluate_gates(
+            self._chunks(0.0), TaskType.LOGISTIC_REGRESSION,
+            candidate_norm=1e6, parent_norm=1.0,
+        )
+        bad = _write_model(str(tmp_path / "bad"), b"BAD")
+        with pytest.raises(RefusedCandidate):
+            registry.publish(bad, parent=1, gate_report=failing.as_dict())
+        rec = registry.refused_candidates()[0]
+        assert GateReport.from_dict(rec["gates"]).verdict == (
+            "COEF_NORM_BLOWUP"
+        )
+
+
+class _StubSwapper:
+    """ServingModel-shaped stub: records swaps, optional failure."""
+
+    def __init__(self):
+        self.swapped_dirs = []
+        self.fail_next = False
+
+    def stage_and_swap(self, model_dir, **kw):
+        from photon_ml_tpu.serving.swap import SwapResult
+
+        self.swapped_dirs.append(model_dir)
+        if self.fail_next:
+            self.fail_next = False
+            return SwapResult(
+                ok=False, generation=0, rolled_back=True, error="boom"
+            )
+        return SwapResult(ok=True, generation=len(self.swapped_dirs))
+
+
+class TestWatcher:
+    def _watcher(self, registry, swapper, **kw):
+        from photon_ml_tpu.registry import RegistryWatcher
+
+        kw.setdefault("poll_s", 30.0)  # poke-driven in tests
+        return RegistryWatcher(registry, swapper, **kw)
+
+    def test_promotes_new_generation(self, registry, model_dir):
+        g1 = registry.publish(model_dir)
+        swapper = _StubSwapper()
+        w = self._watcher(registry, swapper, initial_generation=g1)
+        w._check_registry()
+        assert swapper.swapped_dirs == []  # nothing newer
+        m2 = _write_model(
+            os.path.join(registry.root, os.pardir, "m2"), b"G2"
+        )
+        registry.publish(m2, parent=1)
+        w._check_registry()
+        assert swapper.swapped_dirs == [
+            registry.generation(2).model_dir
+        ]
+        lin = w.lineage()
+        assert lin["registry_generation"] == 2
+        assert lin["parent"] == 1
+        assert lin["lineage"] == [2, 1]
+        assert lin["last_swap"]["action"] == "swap"
+
+    def test_health_regression_rolls_back_and_quarantines(
+        self, registry, model_dir, tmp_path
+    ):
+        g1 = registry.publish(model_dir)
+        m2 = _write_model(str(tmp_path / "m2"), b"G2")
+        registry.publish(m2, parent=1)
+        swapper = _StubSwapper()
+        w = self._watcher(
+            registry, swapper, initial_generation=g1,
+            policy=RollbackPolicy(
+                window=8, min_requests=4, max_unhealthy_rate=0.5
+            ),
+        )
+        w._check_registry()  # promote gen 2, watch window armed
+        assert w._watching_swap
+        for _ in range(6):
+            w.observe_outcome(degraded=True)
+        assert w._rollback_wanted
+        ok = w.rollback(reason="post-swap health regression")
+        assert ok
+        # the bad generation is gone from the loader view, parent rules
+        assert registry.latest().generation == 1
+        assert w.lineage()["registry_generation"] == 1
+        assert w.lineage()["last_swap"]["action"] == "rollback"
+        # the rollback swap targeted the PARENT artifact
+        assert swapper.swapped_dirs[-1] == (
+            registry.generation(1).model_dir
+        )
+        # quarantined generations never re-promote
+        w._check_registry()
+        assert len(swapper.swapped_dirs) == 2
+
+    def test_healthy_window_never_rolls_back(
+        self, registry, model_dir, tmp_path
+    ):
+        g1 = registry.publish(model_dir)
+        m2 = _write_model(str(tmp_path / "m2"), b"G2")
+        registry.publish(m2, parent=1)
+        swapper = _StubSwapper()
+        w = self._watcher(
+            registry, swapper, initial_generation=g1,
+            policy=RollbackPolicy(
+                window=8, min_requests=4, max_unhealthy_rate=0.5
+            ),
+        )
+        w._check_registry()
+        for _ in range(50):
+            w.observe_outcome(degraded=False)
+        assert not w._rollback_wanted
+        assert registry.latest().generation == 2
+
+    def test_rollback_without_parent_is_refused(
+        self, registry, model_dir
+    ):
+        g1 = registry.publish(model_dir)
+        swapper = _StubSwapper()
+        w = self._watcher(registry, swapper, initial_generation=g1)
+        assert w.rollback() is False
+        assert registry.latest().generation == 1
+
+
+class TestServingIntegration:
+    """Watcher + REAL ServingModel banks: promotion under the frontend,
+    bitwise parent restore on rollback, status lineage + rollback op."""
+
+    @pytest.fixture()
+    def game_stack(self, rng, tmp_path):
+        from tests.test_serving import SHARDS, synth_records
+        from photon_ml_tpu.game.data import build_game_dataset
+        from photon_ml_tpu.game.model import (
+            FixedEffectModel, GameModel,
+        )
+        from photon_ml_tpu.game.model_io import (
+            LoadedGameModel, save_game_model,
+        )
+        from photon_ml_tpu.models.coefficients import Coefficients
+        from photon_ml_tpu.models.glm import create_model
+        from photon_ml_tpu.task import TaskType
+        import jax.numpy as jnp
+
+        recs = synth_records(rng)
+        ds = build_game_dataset(recs, [SHARDS[0]], [])
+
+        def save_scaled(path, scale):
+            lm = LoadedGameModel()
+            lm.fixed_effects["global"] = (
+                "g",
+                {
+                    f"g{j}\t": float(rng.normal()) * scale
+                    for j in range(5)
+                },
+            )
+            shard_id, means = lm.fixed_effects["global"]
+            imap = ds.shards[shard_id].index_map
+            wvec = np.zeros((imap.size,), np.float32)
+            for k, v in means.items():
+                i = imap.get_index(k)
+                if i >= 0:
+                    wvec[i] = v
+            gm = GameModel({
+                "global": FixedEffectModel(
+                    create_model(
+                        TaskType.LOGISTIC_REGRESSION,
+                        Coefficients(jnp.asarray(wvec)),
+                    ),
+                    shard_id,
+                )
+            })
+            save_game_model(gm, ds, path)
+            return path
+
+        return ds, save_scaled, str(tmp_path)
+
+    def test_rollback_restores_parent_bank_bitwise(
+        self, game_stack, tmp_path
+    ):
+        from photon_ml_tpu.registry import RegistryWatcher
+        from photon_ml_tpu.serving import ServingModel
+        import jax
+
+        ds, save_scaled, base = game_stack
+        registry = ModelRegistry(os.path.join(base, "registry"))
+        g1_dir = save_scaled(os.path.join(base, "m1"), 1.0)
+        g2_dir = save_scaled(os.path.join(base, "m2"), -2.0)
+        g1 = registry.publish(g1_dir)
+        registry.publish(g2_dir, parent=1)
+
+        imaps = {"g": ds.shards["g"].index_map}
+        widths = {"g": ds.shards["g"].indices.shape[1]}
+        sm = ServingModel.load(
+            g1.model_dir, imaps, widths, ladder=(1, 8)
+        )
+        g1_arrays = jax.tree_util.tree_map(
+            np.asarray, sm.current().arrays
+        )
+        w = RegistryWatcher(
+            registry, sm, poll_s=30.0, initial_generation=g1,
+            policy=RollbackPolicy(
+                window=8, min_requests=4, max_unhealthy_rate=0.5
+            ),
+        )
+        w._check_registry()
+        assert sm.generation == 2
+        for _ in range(6):
+            w.observe_outcome(failed=True)
+        assert w.rollback(reason="test regression")
+        assert registry.latest().generation == 1
+        # the restored bank is BITWISE the original generation-1 bank
+        restored = jax.tree_util.tree_map(
+            np.asarray, sm.current().arrays
+        )
+        flat_a, _ = jax.tree_util.tree_flatten(g1_arrays)
+        flat_b, _ = jax.tree_util.tree_flatten(restored)
+        assert len(flat_a) == len(flat_b)
+        for a, b in zip(flat_a, flat_b):
+            assert np.array_equal(a, b)
+
+    def test_frontend_status_lineage_and_rollback_op(
+        self, game_stack, tmp_path
+    ):
+        from photon_ml_tpu.registry import RegistryWatcher
+        from photon_ml_tpu.serving import (
+            MicroBatcher,
+            ServingFrontend,
+            ServingMetrics,
+            ServingModel,
+        )
+        from tests.test_serving_frontend import Client
+
+        ds, save_scaled, base = game_stack
+        registry = ModelRegistry(os.path.join(base, "registry"))
+        g1 = registry.publish(save_scaled(os.path.join(base, "m1"), 1.0))
+        registry.publish(
+            save_scaled(os.path.join(base, "m2"), -2.0), parent=1
+        )
+        imaps = {"g": ds.shards["g"].index_map}
+        widths = {"g": ds.shards["g"].indices.shape[1]}
+        sm = ServingModel.load(
+            g1.model_dir, imaps, widths, ladder=(1, 8)
+        )
+        watcher = RegistryWatcher(
+            registry, sm, poll_s=30.0, initial_generation=g1,
+        )
+        metrics = ServingMetrics()
+        batcher = MicroBatcher(sm.current, sm.programs, metrics)
+        fe = ServingFrontend(
+            batcher, sm, [],
+            metrics=metrics, port=0,
+            lineage_provider=watcher.lineage,
+            rollback_handler=watcher.rollback,
+        ).start()
+        try:
+            watcher._check_registry()  # promote gen 2
+            c = Client(fe.port)
+            status = c.ask({"op": "status"})
+            assert status["registry"]["registry_generation"] == 2
+            assert status["registry"]["parent"] == 1
+            assert status["registry"]["lineage"] == [2, 1]
+            assert status["last_swap"]["ok"] is True
+            resp = c.ask({"op": "rollback"})
+            assert resp["status"] == "ok" and resp["rolled_back"]
+            status = c.ask({"op": "status"})
+            assert status["registry"]["registry_generation"] == 1
+            assert (
+                status["registry"]["last_swap"]["action"] == "rollback"
+            )
+            assert registry.latest().generation == 1
+            c.close()
+        finally:
+            fe.stop_accepting()
+            batcher.drain(5.0)
+            fe.close()
+            batcher.close()
