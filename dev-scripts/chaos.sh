@@ -24,6 +24,15 @@ cd "$(dirname "$0")/.."
 echo "== chaos matrix (fault injection x both drivers) =="
 python dev-scripts/chaos_matrix.py
 
+echo "== interleaving matrix (deterministic schedules, ISSUE 11) =="
+# the runtime twin of lint rules PL008-PL010: >=200 seeded cooperative
+# schedules over submit/close/swap/rollback on the REAL serving/
+# registry thread plane — every submitted request reaches exactly one
+# terminal outcome, generations stay monotonic under concurrent swaps,
+# at most one rollback per health regression, zero deadlocks. Failures
+# name their seed; replay with InterleaveScheduler(seed=<seed>).
+python dev-scripts/interleave_matrix.py --schedules "${PHOTON_INTERLEAVE_SCHEDULES:-200}"
+
 echo "== reliability overhead gate (injection disabled) =="
 OUT=$(mktemp -t photon-chaos-XXXXXX.json)
 trap 'rm -f "$OUT"' EXIT

@@ -1,8 +1,12 @@
 #!/usr/bin/env bash
 # Static gates, runnable anywhere the package runs:
 #   1. photon-lint — the project-specific JAX hot-path invariants
-#      (readback seam, recompile hazards, spill/IO hygiene); rules and
-#      suppression/baseline mechanics in photon_ml_tpu/lint/.
+#      (readback seam, recompile hazards, spill/IO hygiene) PLUS the
+#      whole-package concurrency pass (PL008 unguarded-shared-state,
+#      PL009 lock-order-inversion, PL010 atomicity-hygiene), which
+#      runs BY DEFAULT (opt out per-invocation with --no-concurrency);
+#      rules and suppression/baseline mechanics in photon_ml_tpu/lint/.
+#      PL009 findings are never baseline-able.
 #   2. ruff — generic hygiene (import order, unused imports/variables,
 #      mutable default args; [tool.ruff] in pyproject.toml). Soft-skips
 #      when ruff is not installed so minimal CI containers still gate

@@ -446,9 +446,12 @@ class GLMDriver:
         self.best_lambda: Optional[float] = None
         self.validation_metrics: Dict[float, Dict[str, float]] = {}
         self.per_iteration_metrics: Dict[float, List[Dict[str, float]]] = {}
-        self._data = None
+        # single-writer published references: set by the (sequential)
+        # train stage before the async summary write is submitted, then
+        # never reassigned while the IO worker can see them
+        self._data = None  # photon: guarded-by(atomic)
         self._norm: Optional[NormalizationContext] = None
-        self._summary = None
+        self._summary = None  # photon: guarded-by(atomic)
         # bounded reservoir sample of a streamed train set (diagnostics)
         self._stream_sample = None
         # tile-schedule cache counters captured after the train stage

@@ -9,19 +9,35 @@ project-specific analyzer over the stdlib ``ast``, no new runtime deps.
 
 Rules (see ``photon_ml_tpu/lint/rules/``):
 
-==========  ===================  ==============================================
-id          slug                 protects
-==========  ===================  ==============================================
-``PL001``   hidden-host-sync     all device->host fetches go through the
-                                 counted ``overlap.device_get`` seam
-``PL002``   recompile-hazard     no jit-of-lambda / jit-in-loop / unhashable
-                                 static_argnums (silent recompilations)
-``PL003``   tracer-leak          no tracers stored on ``self``/globals or
-                                 Python-branched inside jitted bodies
-``PL004``   spill-hygiene        scratch dirs under ``io/`` / GAME streaming
-                                 register for the atexit sweep
-``PL005``   undrained-io         ``submit_io`` scopes reach a ``drain_io``
-==========  ===================  ==============================================
+==========  ======================  ===========================================
+id          slug                    protects
+==========  ======================  ===========================================
+``PL001``   hidden-host-sync        all device->host fetches go through the
+                                    counted ``overlap.device_get`` seam
+``PL002``   recompile-hazard        no jit-of-lambda / jit-in-loop / unhashable
+                                    static_argnums (silent recompilations)
+``PL003``   tracer-leak             no tracers stored on ``self``/globals or
+                                    Python-branched inside jitted bodies
+``PL004``   spill-hygiene           scratch dirs under ``io/`` / GAME streaming
+                                    register for the atexit sweep
+``PL005``   undrained-io            ``submit_io`` scopes reach a ``drain_io``
+``PL006``   reliability-hygiene     artifact writes publish atomically; IO
+                                    failures are never silently swallowed
+``PL007``   request-path-hygiene    no untimed waits in ``serving/``
+``PL008``   unguarded-shared-state  every shared-attr access holds its
+                                    declared/inferred guard (whole-package
+                                    pass; ``# photon: guarded-by(...)``)
+``PL009``   lock-order-inversion    acyclic lock-acquisition order across
+                                    modules — NEVER baseline-able
+``PL010``   atomicity-hygiene       no stale check-then-act across a lock
+                                    release; no callbacks/blocking/foreign
+                                    locks inside Condition-backed sections
+==========  ======================  ===========================================
+
+PL008-PL010 are the concurrency pass (two-pass whole-package analysis:
+class guard maps, the cross-module lock graph, thread-escape); their
+runtime twin is the deterministic interleaving harness in
+``photon_ml_tpu/testing/interleave.py``.
 
 Usage::
 
@@ -38,16 +54,22 @@ fails CI instead of landing silently.
 
 from photon_ml_tpu.lint.core import (
     FileContext,
+    PackageContext,
+    PackageRule,
+    PACKAGE_RULES,
     Report,
     Rule,
     RULES,
     Violation,
+    all_rules,
     analyze_paths,
     analyze_source,
     iter_python_files,
     register,
+    register_package,
 )
 from photon_ml_tpu.lint.baseline import (
+    BaselineRefused,
     apply_baseline,
     baseline_key,
     load_baseline,
@@ -56,14 +78,20 @@ from photon_ml_tpu.lint.baseline import (
 
 __all__ = [
     "FileContext",
+    "PackageContext",
+    "PackageRule",
+    "PACKAGE_RULES",
     "Report",
     "Rule",
     "RULES",
     "Violation",
+    "all_rules",
     "analyze_paths",
     "analyze_source",
     "iter_python_files",
     "register",
+    "register_package",
+    "BaselineRefused",
     "apply_baseline",
     "baseline_key",
     "load_baseline",

@@ -17,7 +17,17 @@ from photon_ml_tpu.lint.core import Report, Violation
 
 BASELINE_VERSION = 1
 
+# Rules whose violations may never be grandfathered. A lock-order
+# inversion (PL009) is a deadlock with a schedule attached — baselining
+# one ships the schedule; write_baseline refuses and load_baseline
+# rejects hand-edited entries.
+NEVER_BASELINE = frozenset({"PL009"})
+
 Key = Tuple[str, str, str]
+
+
+class BaselineRefused(ValueError):
+    """Raised when a violation set contains never-baseline-able rules."""
 
 
 def baseline_key(v: Violation) -> Key:
@@ -35,6 +45,12 @@ def load_baseline(path: str) -> Counter:
         )
     allow: Counter = Counter()
     for e in data.get("entries", []):
+        if e["rule"] in NEVER_BASELINE:
+            raise ValueError(
+                f"baseline {path} grandfathers {e['rule']} "
+                f"({e['file']}) — lock-order inversions are never "
+                "baseline-able; fix the acquisition order instead"
+            )
         allow[(e["file"], e["rule"], e["snippet"])] += int(
             e.get("count", 1)
         )
@@ -42,6 +58,15 @@ def load_baseline(path: str) -> Counter:
 
 
 def write_baseline(path: str, violations: Sequence[Violation]) -> dict:
+    refused = [v for v in violations if v.rule in NEVER_BASELINE]
+    if refused:
+        sites = ", ".join(v.location() for v in refused[:5])
+        raise BaselineRefused(
+            f"{len(refused)} {sorted({v.rule for v in refused})} "
+            f"violation(s) cannot be grandfathered ({sites}"
+            f"{', ...' if len(refused) > 5 else ''}) — fix the lock "
+            "acquisition order; no baseline was written"
+        )
     counts: Counter = Counter(baseline_key(v) for v in violations)
     entries: List[dict] = [
         {"file": f, "rule": r, "snippet": s, "count": c}

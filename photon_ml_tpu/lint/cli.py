@@ -13,11 +13,12 @@ import sys
 from typing import List, Optional, Sequence
 
 from photon_ml_tpu.lint.baseline import (
+    BaselineRefused,
     apply_baseline,
     load_baseline,
     write_baseline,
 )
-from photon_ml_tpu.lint.core import RULES, _load_rules, analyze_paths
+from photon_ml_tpu.lint.core import all_rules, analyze_paths
 
 DEFAULT_BASELINE = ".photon-lint-baseline.json"
 DEFAULT_PATHS = ("photon_ml_tpu", "bench.py")
@@ -32,8 +33,11 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m photon_ml_tpu.lint",
         description=(
             "AST-based invariant checker for the JAX hot path "
-            "(readback seam, recompile hazards, spill/IO hygiene). "
-            "Suppress a line with '# photon: allow(<rule>)'."
+            "(readback seam, recompile hazards, spill/IO hygiene) and "
+            "the thread plane (guard discipline, lock ordering, "
+            "atomicity — a whole-package pass, on by default). "
+            "Suppress a line with '# photon: allow(<rule>)'; declare "
+            "guard discipline with '# photon: guarded-by(<lock>)'."
         ),
     )
     p.add_argument(
@@ -62,15 +66,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--list-rules", action="store_true",
         help="print the rule table and exit",
     )
+    p.add_argument(
+        "--no-concurrency", action="store_true",
+        help="skip the whole-package concurrency pass (PL008-PL010); "
+             "the pass runs by default",
+    )
     return p
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
-        _load_rules()
-        for rule in sorted(RULES.values(), key=lambda r: r.id):
-            print(f"{rule.id}  {rule.slug:20s}  {rule.doc}")
+        for rule in sorted(all_rules().values(), key=lambda r: r.id):
+            print(f"{rule.id}  {rule.slug:24s}  {rule.doc}")
         return 0
 
     paths = args.paths or _default_paths()
@@ -81,14 +89,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         )
         return 2
 
-    report = analyze_paths(paths)
+    report = analyze_paths(paths, package_pass=not args.no_concurrency)
 
     baseline_path = args.baseline or (
         DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None
     )
     if args.write_baseline:
         target = args.baseline or DEFAULT_BASELINE
-        data = write_baseline(target, report.violations)
+        try:
+            data = write_baseline(target, report.violations)
+        except BaselineRefused as e:
+            print(f"photon-lint: {e}", file=sys.stderr)
+            return 2
         print(
             f"photon-lint: wrote {len(data['entries'])} baseline "
             f"entr{'y' if len(data['entries']) == 1 else 'ies'} "

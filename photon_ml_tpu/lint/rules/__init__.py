@@ -1,11 +1,14 @@
 """Rule modules — importing this package registers every rule."""
 
 from photon_ml_tpu.lint.rules import (  # noqa: F401
+    atomicity,
     host_sync,
     io_drain,
+    lock_order,
     recompile,
     reliability,
     request_path,
+    shared_state,
     spill,
     tracer_leak,
 )

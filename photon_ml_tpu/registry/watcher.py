@@ -125,7 +125,17 @@ class RegistryWatcher:
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # guards the lineage/health FLAGS shared between the watcher
+        # thread, the response-path health feed (observe_outcome runs
+        # on frontend connection threads) and the operator rollback op
         self._lock = threading.Lock()
+        # serializes whole promote/rollback protocols (read lineage ->
+        # stage -> flip -> write lineage): the operator rollback op
+        # arrives on a connection thread while the watcher thread may
+        # be mid-promote — without this, both read the same parent and
+        # the loser publishes stale lineage (and two staged swaps race
+        # at the serving model)
+        self._swap_serial = threading.Lock()
         self._window = HealthWindow(self.policy.window)
         # lineage state: which registry generation is live, its parent
         self._live: Optional[GenerationInfo] = initial_generation
@@ -160,9 +170,10 @@ class RegistryWatcher:
     ) -> None:
         """One completed request's health, fed from the driver's
         completion path. Only consulted while a post-swap watch is
-        active — steady-state traffic costs two boolean ORs."""
-        if not self._watching_swap:
-            return
+        active — steady-state traffic costs one locked flag read."""
+        with self._lock:
+            if not self._watching_swap:
+                return
         self._window.observe(degraded or failed)
         n, rate = self._window.snapshot()
         if (
@@ -170,8 +181,13 @@ class RegistryWatcher:
             and rate > self.policy.max_unhealthy_rate
         ):
             # flag for the watcher thread; the completion callback must
-            # never run a swap itself (it holds response-path time)
-            self._rollback_wanted = True
+            # never run a swap itself (it holds response-path time).
+            # Re-check the watch under the lock: a rollback that just
+            # completed cleared it, and re-arming the flag here would
+            # roll back AGAIN off the bad generation's stale window.
+            with self._lock:
+                if self._watching_swap:
+                    self._rollback_wanted = True
             self._wake.set()
 
     # -- status --------------------------------------------------------------
@@ -201,10 +217,12 @@ class RegistryWatcher:
                 "error": last.error,
             }
         n, rate = self._window.snapshot()
+        with self._lock:
+            watching = self._watching_swap
         out["post_swap_window"] = {
             "observed": n,
             "unhealthy_rate": round(rate, 4),
-            "watching": self._watching_swap,
+            "watching": watching,
         }
         return out
 
@@ -221,8 +239,10 @@ class RegistryWatcher:
             if self._stop.is_set():
                 return
             try:
-                if self._rollback_wanted:
+                with self._lock:
+                    wanted = self._rollback_wanted
                     self._rollback_wanted = False
+                if wanted:
                     self.rollback(reason="post-swap health regression")
                     continue
                 self._check_registry()
@@ -246,24 +266,35 @@ class RegistryWatcher:
             "registry: promoting generation %d (parent %s)",
             info.generation, info.parent,
         )
-        res = self.serving_model.stage_and_swap(
-            info.model_dir, **self.swap_kwargs
-        )
-        rec = _SwapRecord(
-            registry_generation=info.generation,
-            parent=info.parent,
-            action="swap",
-            ok=res.ok,
-            error=res.error,
-        )
-        with self._lock:
-            self.history.append(rec)
-            self._last_swap = rec
-            if res.ok:
-                self._live = info
-        if res.ok and self.auto_rollback:
-            self._window.reset()
-            self._watching_swap = True
+        # _swap_serial held across the WHOLE protocol (stage -> flip ->
+        # lineage write): the operator rollback op runs on a connection
+        # thread and must not interleave with a promote — and holding
+        # one outer lock across both _lock sections is what makes the
+        # read-then-write below atomic (PL010)
+        with self._swap_serial:
+            res = self.serving_model.stage_and_swap(
+                info.model_dir, **self.swap_kwargs
+            )
+            rec = _SwapRecord(
+                registry_generation=info.generation,
+                parent=info.parent,
+                action="swap",
+                ok=res.ok,
+                error=res.error,
+            )
+            if res.ok and self.auto_rollback:
+                self._window.reset()
+            with self._lock:
+                self.history.append(rec)
+                self._last_swap = rec
+                if res.ok:
+                    self._live = info
+                    if self.auto_rollback:
+                        # arm AFTER the reset: a straggler completion
+                        # between reset and arming is ignored, never
+                        # counted against the new generation
+                        self._watching_swap = True
+                        self._rollback_wanted = False
         self._log(
             "registry swap -> generation %d: ok=%s%s",
             info.generation, res.ok,
@@ -274,45 +305,50 @@ class RegistryWatcher:
         """Flip back to the live generation's parent (reloaded from the
         registry artifact — bitwise the parent's scores) and quarantine
         the bad generation in the registry. Operator op and the
-        auto-rollback trigger both land here."""
-        with self._lock:
-            live = self._live
-        if live is None or live.parent is None:
-            self._log("rollback requested but no parent generation")
-            return False
-        parent = self.registry.generation(live.parent)
-        if parent is None:
+        auto-rollback trigger both land here — serialized against
+        promotes AND against each other, with the health watch disarmed
+        (and any pending trigger cleared) BEFORE the flip so a stale
+        window from the bad generation can never roll back twice."""
+        with self._swap_serial:
+            with self._lock:
+                live = self._live
+                self._watching_swap = False
+                self._rollback_wanted = False
+            if live is None or live.parent is None:
+                self._log("rollback requested but no parent generation")
+                return False
+            parent = self.registry.generation(live.parent)
+            if parent is None:
+                self._log(
+                    "rollback target generation %d is not loadable",
+                    live.parent,
+                )
+                return False
             self._log(
-                "rollback target generation %d is not loadable",
-                live.parent,
+                "ROLLING BACK generation %d -> parent %d (%s)",
+                live.generation, parent.generation, reason,
             )
-            return False
-        self._watching_swap = False
-        self._log(
-            "ROLLING BACK generation %d -> parent %d (%s)",
-            live.generation, parent.generation, reason,
-        )
-        res = self.serving_model.stage_and_swap(
-            parent.model_dir, **self.swap_kwargs
-        )
-        rec = _SwapRecord(
-            registry_generation=parent.generation,
-            parent=parent.parent,
-            action="rollback",
-            ok=res.ok,
-            error=res.error,
-        )
-        with self._lock:
-            self.history.append(rec)
-            self._last_swap = rec
+            res = self.serving_model.stage_and_swap(
+                parent.model_dir, **self.swap_kwargs
+            )
+            rec = _SwapRecord(
+                registry_generation=parent.generation,
+                parent=parent.parent,
+                action="rollback",
+                ok=res.ok,
+                error=res.error,
+            )
+            with self._lock:
+                self.history.append(rec)
+                self._last_swap = rec
+                if res.ok:
+                    self._live = parent
             if res.ok:
-                self._live = parent
-        if res.ok:
-            q = self.registry.quarantine_generation(
-                live.generation, reason=reason
-            )
-            self._log(
-                "generation %d quarantined in the registry (%s)",
-                live.generation, q,
-            )
-        return res.ok
+                q = self.registry.quarantine_generation(
+                    live.generation, reason=reason
+                )
+                self._log(
+                    "generation %d quarantined in the registry (%s)",
+                    live.generation, q,
+                )
+            return res.ok

@@ -31,7 +31,6 @@ same object.
 
 from __future__ import annotations
 
-import threading
 from typing import Optional
 
 __all__ = [
@@ -61,9 +60,16 @@ class RequestShed(ServingError):
     """Admission refused the request up front: the predicted queue wait
     (or a bounded full-queue wait) already exceeds its deadline. Shed
     requests never occupy a queue slot past their budget and never reach
-    the device."""
+    the device. ``reason`` is the metrics key (``predicted_wait`` /
+    ``queue_full``) — carried on the exception so the accounting can
+    happen OUTSIDE the queue lock (PL010 atomicity-hygiene: no foreign
+    critical section inside the Condition-backed submit lock)."""
 
     code = "SHED"
+
+    def __init__(self, message: str, *, reason: str = "shed"):
+        super().__init__(message)
+        self.reason = reason
 
 
 class DeadlineExceeded(ServingError):
@@ -135,23 +141,27 @@ class AdmissionController:
 
     def __init__(self, alpha: float = 0.2):
         self._alpha = float(alpha)
-        self._lock = threading.Lock()
-        self._per_row_s: Optional[float] = None
+        # single-writer atomic publish: only the dispatcher thread
+        # writes (one plain reference assignment per dispatch), and
+        # submit-side readers take a snapshot — so predicted_wait_s is
+        # LOCK-FREE and safe to call inside the batcher's queue lock
+        # (no foreign critical section under the Condition-backed lock,
+        # PL010)
+        self._per_row_s: Optional[float] = None  # photon: guarded-by(atomic)
 
     def note_dispatch(self, rows: int, busy_s: float) -> None:
         per_row = max(busy_s, 0.0) / max(int(rows), 1)
-        with self._lock:
-            if self._per_row_s is None:
-                self._per_row_s = per_row
-            else:
-                self._per_row_s += self._alpha * (per_row - self._per_row_s)
+        cur = self._per_row_s
+        self._per_row_s = (
+            per_row if cur is None
+            else cur + self._alpha * (per_row - cur)
+        )
 
     def per_row_s(self) -> float:
-        with self._lock:
-            return self._per_row_s or 0.0
+        return self._per_row_s or 0.0
 
     def predicted_wait_s(self, queue_len: int) -> float:
-        with self._lock:
-            if self._per_row_s is None:
-                return 0.0
-            return max(int(queue_len), 0) * self._per_row_s
+        cur = self._per_row_s
+        if cur is None:
+            return 0.0
+        return max(int(queue_len), 0) * cur

@@ -342,8 +342,12 @@ class MicroBatcher:
         self._inflight: List = []  # the take a dispatch is executing
         self._closed = False
         self._draining = False
+        # dispatcher-thread-local scratch (never read off-thread)
         self._re_fail_counts: Dict[str, int] = {}
-        self._last_heartbeat = time.perf_counter()
+        # single-writer atomic publish: only the dispatcher stamps it
+        # (plain float assignment), liveness probes read it bare — a
+        # heartbeat behind a lock would measure the lock, not the loop
+        self._last_heartbeat = time.perf_counter()  # photon: guarded-by(atomic)
         self._worker = threading.Thread(
             target=self._dispatch_loop,
             name="photon-serving-dispatch",
@@ -366,11 +370,13 @@ class MicroBatcher:
 
     @property
     def draining(self) -> bool:
-        return self._draining
+        with self._lock:
+            return self._draining
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        with self._lock:
+            return self._closed
 
     def queue_depth(self) -> int:
         with self._lock:
@@ -402,37 +408,47 @@ class MicroBatcher:
             else self._max_submit_wait_s
         )
         limit = now + wait_budget_s
-        with self._lock:
-            if self._closed or self._draining:
-                raise BatcherClosed("batcher is closed")
-            if request.deadline_ms is not None:
-                predicted = self._admission.predicted_wait_s(
-                    len(self._queue)
-                )
-                if predicted * 1e3 > request.deadline_ms:
-                    if self._metrics is not None:
-                        self._metrics.record_shed("predicted_wait")
-                    raise RequestShed(
-                        f"predicted queue wait {predicted * 1e3:.1f}ms "
-                        f"exceeds deadline {request.deadline_ms:.1f}ms "
-                        f"(queue depth {len(self._queue)})"
-                    )
-            while len(self._queue) >= self._max_queue:
+        # shed accounting happens AFTER the lock is released (PL010
+        # atomicity-hygiene): the metrics object has its own lock, and
+        # a foreign critical section inside the Condition-backed queue
+        # lock stalls the dispatcher and every parked submitter.
+        # predicted_wait_s is lock-free by design (single-writer EWMA).
+        try:
+            with self._lock:
                 if self._closed or self._draining:
                     raise BatcherClosed("batcher is closed")
-                remaining = limit - time.perf_counter()
-                if remaining <= 0:
-                    if self._metrics is not None:
-                        self._metrics.record_shed("queue_full")
-                    raise RequestShed(
-                        f"queue full ({self._max_queue}) past the "
-                        f"request's wait budget {wait_budget_s * 1e3:.1f}ms"
+                if request.deadline_ms is not None:
+                    predicted = self._admission.predicted_wait_s(
+                        len(self._queue)
                     )
-                self._space.wait(timeout=remaining)
-            if self._closed or self._draining:
-                raise BatcherClosed("batcher is closed")
-            self._queue.append((request, fut))
-            self._nonempty.notify()
+                    if predicted * 1e3 > request.deadline_ms:
+                        raise RequestShed(
+                            f"predicted queue wait {predicted * 1e3:.1f}"
+                            f"ms exceeds deadline "
+                            f"{request.deadline_ms:.1f}ms "
+                            f"(queue depth {len(self._queue)})",
+                            reason="predicted_wait",
+                        )
+                while len(self._queue) >= self._max_queue:
+                    if self._closed or self._draining:
+                        raise BatcherClosed("batcher is closed")
+                    remaining = limit - time.perf_counter()
+                    if remaining <= 0:
+                        raise RequestShed(
+                            f"queue full ({self._max_queue}) past the "
+                            "request's wait budget "
+                            f"{wait_budget_s * 1e3:.1f}ms",
+                            reason="queue_full",
+                        )
+                    self._space.wait(timeout=remaining)
+                if self._closed or self._draining:
+                    raise BatcherClosed("batcher is closed")
+                self._queue.append((request, fut))
+                self._nonempty.notify()
+        except RequestShed as e:
+            if self._metrics is not None:
+                self._metrics.record_shed(e.reason)
+            raise
         return fut
 
     def score(
@@ -471,30 +487,36 @@ class MicroBatcher:
         """
         t0 = time.perf_counter()
         deadline = t0 + max(float(timeout_s), 0.0)
+        leftovers: Optional[List] = None  # None = was already closed
+        pending_at_start = 0
         with self._lock:
-            if self._closed:
-                report = DrainReport(duration_s=time.perf_counter() - t0)
-                if self._metrics is not None:
-                    self._metrics.record_drain(report)
-                return report
-            self._draining = True
-            pending_at_start = len(self._queue) + len(self._inflight)
-            # wake blocked submitters (they raise BatcherClosed) and an
-            # idle dispatcher
-            self._nonempty.notify_all()
-            self._space.notify_all()
-            while self._queue or self._inflight:
-                remaining = deadline - time.perf_counter()
-                if remaining <= 0:
-                    break
-                # _space is notified after every take AND after every
-                # dispatch completion, so this wakes as work finishes
-                self._space.wait(timeout=min(remaining, 0.05))
-            leftovers = list(self._queue) + list(self._inflight)
-            self._queue.clear()
-            self._closed = True
-            self._nonempty.notify_all()
-            self._space.notify_all()
+            if not self._closed:
+                self._draining = True
+                pending_at_start = len(self._queue) + len(self._inflight)
+                # wake blocked submitters (they raise BatcherClosed)
+                # and an idle dispatcher
+                self._nonempty.notify_all()
+                self._space.notify_all()
+                while self._queue or self._inflight:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    # _space is notified after every take AND after
+                    # every dispatch completion, so this wakes as work
+                    # finishes
+                    self._space.wait(timeout=min(remaining, 0.05))
+                leftovers = list(self._queue) + list(self._inflight)
+                self._queue.clear()
+                self._closed = True
+                self._nonempty.notify_all()
+                self._space.notify_all()
+        if leftovers is None:
+            # already closed: accounting outside the queue lock (PL010
+            # atomicity-hygiene — record_drain takes the metrics lock)
+            report = DrainReport(duration_s=time.perf_counter() - t0)
+            if self._metrics is not None:
+                self._metrics.record_drain(report)
+            return report
         failed = 0
         for _req, fut in leftovers:
             if _resolve(fut, error=DrainTimeout(

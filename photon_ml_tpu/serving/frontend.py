@@ -553,8 +553,15 @@ class ServingFrontend:
                 hook(ok, degraded, failed)
             except Exception:
                 self._note("completion_hook_errors")
-        conn._note_pending(-1)
+        # ENQUEUE the response BEFORE decrementing pending: the writer
+        # thread's drain check is "pending == 0 and queue empty", so a
+        # decrement-first ordering opens a window where a closing
+        # writer observes both true between our two steps and exits
+        # with the final response still in hand — a silently dropped
+        # response at drain time (pinned by the interleaving harness
+        # test; the schedule is replayable from its seed)
         conn.send(resp)
+        conn._note_pending(-1)
         with self._completed_lock:
             self._completed += 1
             n = self._completed

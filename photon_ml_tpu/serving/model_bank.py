@@ -198,17 +198,32 @@ class ModelBank:
 
     def __post_init__(self):
         if self.quarantined_re_types is None:
-            self.quarantined_re_types = set()
+            self.quarantined_re_types = frozenset()
+        # serializes quarantine WRITERS (operator op on a connection
+        # thread vs the dispatcher's auto-quarantine): without it the
+        # copy-on-write below could lose one of two racing updates
+        self._quarantine_lock = threading.Lock()
 
     def quarantine_re(self, re_type: str) -> None:
         """Mark one random-effect coordinate unusable for this
-        generation; the batcher degrades affected rows to FE-only."""
+        generation; the batcher degrades affected rows to FE-only.
+
+        Copy-on-write publish under a writer lock: writers race
+        (operator op on a connection thread, the dispatcher's
+        auto-quarantine), while the dispatcher READS the set per batch
+        — so writers serialize on ``_quarantine_lock`` and publish a
+        fresh frozenset as one reference assignment. Readers take no
+        lock: they see the old set or the new one, never a set
+        mid-mutation (pinned by the interleaving harness)."""
         if re_type not in self.re_types:
             raise ValueError(
                 f"unknown random-effect type {re_type!r}; "
                 f"known: {self.re_types}"
             )
-        self.quarantined_re_types.add(re_type)
+        with self._quarantine_lock:
+            self.quarantined_re_types = (
+                frozenset(self.quarantined_re_types) | {re_type}
+            )
 
     @property
     def used_shards(self) -> Tuple[str, ...]:

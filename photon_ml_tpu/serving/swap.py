@@ -137,6 +137,16 @@ class ServingModel:
         programs: Optional[ServingPrograms] = None,
     ):
         self._lock = threading.Lock()
+        # Serializes whole stage/flip protocols. Swaps arrive from more
+        # than one thread (registry watcher promote, operator rollback
+        # on a connection thread, driver --swap-after-requests): two
+        # concurrent _flips would both read the same `prev`, both mint
+        # generation prev+1, and on the donated path BOTH would hand
+        # prev's buffers to the refresh program — a use-after-donate.
+        # Staging is slow on purpose (artifact load, program warmup);
+        # holding this lock across it only serializes swaps, never the
+        # request path (dispatch takes dispatch_lock, not this).
+        self._stage_lock = threading.Lock()
         self._bank = bank
         self.programs = programs or ServingPrograms()
         self.programs.ensure_compiled(bank)
@@ -232,50 +242,58 @@ class ServingModel:
         )
         from photon_ml_tpu.reliability.retry import quarantine_artifact
 
-        prev = self.current()
-        try:
-            loaded = io_call(SEAM, _load_model, model_dir, detail=model_dir)
-        except (InjectedCorruption, ValueError) as e:
-            q = quarantine_artifact(model_dir, SEAM)
-            result = SwapResult(
-                ok=False,
-                generation=prev.generation,
-                rolled_back=True,
-                quarantined=q,
-                error=str(e),
-            )
-            self.swap_history.append(result)
-            return result
-        except SeamFailure as e:
-            result = SwapResult(
-                ok=False,
-                generation=prev.generation,
-                rolled_back=True,
-                error=str(e),
-            )
-            self.swap_history.append(result)
-            return result
+        # one swap protocol at a time: `prev` read, staging and the
+        # flip happen under _stage_lock so racing swap requests (the
+        # watcher's promote vs an operator rollback) serialize instead
+        # of both staging against the same predecessor
+        with self._stage_lock:
+            prev = self.current()
+            try:
+                loaded = io_call(
+                    SEAM, _load_model, model_dir, detail=model_dir
+                )
+            except (InjectedCorruption, ValueError) as e:
+                q = quarantine_artifact(model_dir, SEAM)
+                result = SwapResult(
+                    ok=False,
+                    generation=prev.generation,
+                    rolled_back=True,
+                    quarantined=q,
+                    error=str(e),
+                )
+                self.swap_history.append(result)
+                return result
+            except SeamFailure as e:
+                result = SwapResult(
+                    ok=False,
+                    generation=prev.generation,
+                    rolled_back=True,
+                    error=str(e),
+                )
+                self.swap_history.append(result)
+                return result
 
-        staged = build_model_bank(
-            loaded,
-            index_maps=prev.index_maps,
-            shard_widths=prev.shard_widths,
-            generation=prev.generation + 1,
-            entity_pad_to=entity_pad_to,
-            native_index_threshold=native_index_threshold,
-            device=False,  # host arrays: device placement happens below
-            model_id=model_id,
-        )
-        return self._flip(staged)
+            staged = build_model_bank(
+                loaded,
+                index_maps=prev.index_maps,
+                shard_widths=prev.shard_widths,
+                generation=prev.generation + 1,
+                entity_pad_to=entity_pad_to,
+                native_index_threshold=native_index_threshold,
+                device=False,  # host arrays: placement happens below
+                model_id=model_id,
+            )
+            return self._flip(staged)
 
     def swap_to_bank(self, staged: ModelBank) -> SwapResult:
         """Flip to an already-built bank (in-memory publication path —
         e.g. a co-located trainer handing over arrays directly)."""
-        prev = self.current()
-        staged.generation = prev.generation + 1
-        return self._flip(staged)
+        with self._stage_lock:
+            prev = self.current()
+            staged.generation = prev.generation + 1
+            return self._flip(staged)
 
-    def _flip(self, staged: ModelBank) -> SwapResult:
+    def _flip(self, staged: ModelBank) -> SwapResult:  # photon: guarded-by(_stage_lock)
         prev = self.current()
         donated = staged.spec == prev.spec
         if donated:

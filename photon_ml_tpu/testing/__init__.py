@@ -1,0 +1,19 @@
+"""Test-support runtime: the deterministic interleaving harness.
+
+Importable from production-adjacent test code and dev-scripts; never
+imported by the serving/registry modules themselves.
+"""
+
+from photon_ml_tpu.testing.interleave import (
+    DeadlockError,
+    InterleaveScheduler,
+    StepBudgetExceeded,
+    explore,
+)
+
+__all__ = [
+    "DeadlockError",
+    "InterleaveScheduler",
+    "StepBudgetExceeded",
+    "explore",
+]

@@ -1,0 +1,91 @@
+"""PL008 negatives: disciplined shared state — no violations."""
+import queue
+import threading
+
+
+class Disciplined:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue = []
+        self._closed = False
+        self._beat = 0.0  # photon: guarded-by(atomic)
+        self._out = queue.Queue()  # synchronized type: exempt
+        self._stop = threading.Event()  # synchronized type: exempt
+        self._worker = threading.Thread(target=self._loop)
+        self._worker.start()
+
+    def _loop(self):
+        while True:
+            self._beat = 1.0  # atomic publish: plain assignment
+            with self._cond:  # the condition aliases self._lock
+                while not self._queue and not self._closed:
+                    self._cond.wait(timeout=0.1)
+                if self._closed:
+                    return
+                item = self._queue.pop()
+            self._out.put_nowait(item)
+
+    def submit(self, item):
+        with self._lock:
+            self._queue.append(item)
+            self._cond.notify()
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            self._cond.notify_all()
+
+    def heartbeat(self):
+        return self._beat  # atomic read: allowed anywhere
+
+
+class NotConcurrent:
+    """No locks, no threads: plain single-threaded state is exempt."""
+
+    def __init__(self):
+        self.value = 0
+
+    def bump(self):
+        self.value += 1
+
+
+def handoff_via_queue():
+    q = queue.Queue()
+
+    def worker():
+        q.put(1)  # results flow over the queue, nothing escapes bare
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    return q.get()
+
+
+def guarded_escape():
+    lock = threading.Lock()
+    results = {}
+
+    def worker():
+        with lock:
+            results["x"] = 1  # closure side holds the shared lock
+
+    t = threading.Thread(target=worker)
+    t.start()
+    with lock:
+        results["y"] = 2
+    t.join()
+    return results
+
+
+class HelperDiscipline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache = {}
+
+    def _lookup(self, k):  # photon: guarded-by(_lock)
+        return self._cache.get(k)
+
+    def get_value(self, k):
+        with self._lock:
+            return self._lookup(k)

@@ -1,0 +1,92 @@
+"""PL008 positives: nine seeded unguarded-shared-state violations."""
+import threading
+
+
+class BareReadWrite:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flag = False
+
+    def set_flag(self):
+        with self._lock:
+            self._flag = True  # guarded write: establishes the guard
+
+    def bare_write(self):
+        self._flag = False  # VIOLATION 1: bare write of guarded attr
+
+    def bare_read(self):
+        return self._flag  # VIOLATION 2: bare read of guarded attr
+
+
+class AtomicMutation:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0  # photon: guarded-by(atomic)
+
+    def bump(self):
+        self._count += 1  # VIOLATION 3: read-modify-write on atomic
+
+
+class DeclaredGuard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = "idle"  # photon: guarded-by(_lock)
+
+    def ok(self):
+        with self._lock:
+            self._state = "busy"
+
+    def bad(self):
+        return self._state  # VIOLATION 4: declared guard not held
+
+
+class SharedFlag:
+    def __init__(self):
+        self._running = False
+        self._worker = None
+
+    def start(self):
+        self._worker = threading.Thread(target=self._loop)
+        self._worker.start()
+
+    def _loop(self):
+        while self._running:  # VIOLATION 5: thread-side bare read
+            pass
+
+    def stop(self):
+        self._running = False  # VIOLATION 6: caller-side bare write
+
+
+def lambda_target():
+    t = threading.Thread(target=lambda: None)  # VIOLATION 7: lambda
+    t.start()
+    return t
+
+
+def escaped_local():
+    results = {}
+
+    def worker():
+        results["x"] = 1  # mutated bare inside the thread target
+
+    t = threading.Thread(target=worker)
+    t.start()
+    results["y"] = 2  # VIOLATION 8: ...and by the spawning scope
+    t.join()
+    return results
+
+
+class LockExpectedHelper:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+
+    def _get(self, k):  # photon: guarded-by(_lock)
+        return self._items.get(k)
+
+    def caller_ok(self, k):
+        with self._lock:
+            return self._get(k)
+
+    def caller_bad(self, k):
+        return self._get(k)  # VIOLATION 9: lock-expected helper, bare

@@ -1,0 +1,33 @@
+"""PL009 negative: every path acquires in one global order."""
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+
+
+def one():
+    with _A:
+        with _B:
+            pass
+
+
+def two():
+    with _A:
+        with _B:
+            pass
+
+
+class Ordered:
+    def __init__(self):
+        self._outer = threading.Lock()
+        self._inner = threading.Lock()
+
+    def op(self):
+        with self._outer:
+            with self._inner:
+                pass
+
+    def other(self):
+        with self._outer:
+            with self._inner:
+                pass

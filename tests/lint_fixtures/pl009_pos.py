@@ -1,0 +1,18 @@
+"""PL009 positive: a two-lock acquisition-order inversion (one cycle,
+reported at both participating edge sites)."""
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+
+
+def forward():
+    with _A:
+        with _B:  # acquires B while holding A
+            pass
+
+
+def backward():
+    with _B:
+        with _A:  # acquires A while holding B: the inversion
+            pass

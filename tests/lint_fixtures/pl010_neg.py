@@ -1,0 +1,43 @@
+"""PL010 negatives: small, private, actually-atomic critical sections."""
+import threading
+
+
+class Disciplined:
+    def __init__(self, on_done, metrics):
+        self._serial = threading.Lock()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._items = []
+        self.on_done = on_done
+        self._metrics = metrics
+
+    def push(self, item):
+        with self._lock:
+            self._items.append(item)
+            self._cond.notify()
+        self.on_done(item)  # callback AFTER release
+        self._metrics.record_thing()  # foreign lock AFTER release
+
+    def wake(self):
+        with self._cond:  # the condition's own lock is held
+            self._cond.notify_all()
+
+    def protocol(self):
+        # read-then-write across two inner sections is fine when ONE
+        # outer lock provably spans both (the serialize-the-protocol
+        # idiom the watcher uses)
+        with self._serial:
+            with self._lock:
+                n = list(self._items)
+            with self._lock:
+                self._items = []
+            return n
+
+
+class Foreign:
+    def __init__(self):
+        self._flock = threading.Lock()
+
+    def record_thing(self):
+        with self._flock:
+            pass

@@ -1,0 +1,48 @@
+"""PL010 positives: five seeded atomicity-hygiene violations."""
+import threading
+
+
+class CallbackUnderLock:
+    def __init__(self, on_done):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._items = []
+        self.on_done = on_done
+
+    def push(self, item, sock):
+        with self._lock:
+            self._items.append(item)
+            self.on_done(item)  # VIOLATION 1: callback under the lock
+            sock.sendall(b"x")  # VIOLATION 2: blocking under the lock
+            self._cond.notify()
+
+    def wake_wrong(self):
+        self._cond.notify_all()  # VIOLATION 3: notify without the lock
+
+    def check_then_act(self):
+        with self._lock:
+            n = self._items  # read under the lock...
+        count = len(n)
+        with self._lock:
+            self._items = []  # VIOLATION 4: ...stale write after release
+        return count
+
+
+class Foreign:
+    def __init__(self):
+        self._flock = threading.Lock()
+
+    def record_thing(self):
+        with self._flock:
+            pass
+
+
+class CallsForeign:
+    def __init__(self, metrics):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._metrics = metrics
+
+    def submit(self):
+        with self._lock:
+            self._metrics.record_thing()  # VIOLATION 5: foreign lock

@@ -284,6 +284,69 @@ class TestLintClean:
         assert any(f.endswith("frontend.py") for f in serving), serving
         assert any(f.endswith("admission.py") for f in serving), serving
 
+    def test_concurrency_rules_land_at_zero(self, full_report):
+        """ISSUE 11: PL008-PL010 ship with ZERO baseline entries
+        package-wide and ZERO allow() sites in `serving/` and
+        `registry/` — the thread plane's guard discipline is
+        structural from day one. PL009 additionally can never GAIN a
+        baseline entry (write/load both refuse), so the pin here is
+        belt-and-braces."""
+        from photon_ml_tpu.lint import all_rules
+
+        rules = all_rules()
+        for rid in ("PL008", "PL009", "PL010"):
+            assert rid in rules, sorted(rules)
+        entries = [
+            e for e in json.load(open(BASELINE))["entries"]
+            if e["rule"] in ("PL008", "PL009", "PL010")
+        ]
+        assert entries == [], entries
+        slugs = {
+            "PL008", "unguarded-shared-state",
+            "PL009", "lock-order-inversion",
+            "PL010", "atomicity-hygiene",
+        }
+        allows = [
+            s for s in full_report.allow_sites if s.rules & slugs
+        ]
+        assert allows == [], allows
+        for subsystem in ("photon_ml_tpu/serving/",
+                          "photon_ml_tpu/registry/"):
+            assert not [
+                s for s in full_report.allow_sites
+                if subsystem in s.path.replace(os.sep, "/")
+            ], f"{subsystem} must not carry allow() suppressions"
+
+    def test_concurrency_pass_is_enforced_not_decorative(self):
+        """Stripping ONE guard from the real watcher resurfaces PL008:
+        the zero-violation state above is load-bearing analysis, not a
+        rule that never fires on real code."""
+        path = "photon_ml_tpu/registry/watcher.py"
+        src = open(path).read()
+        clean = analyze_source(path, src)
+        assert not [
+            v for v in clean.violations if v.rule == "PL008"
+        ], _fmt(clean.violations)
+        stripped = src.replace(
+            "        with self._lock:\n"
+            "            if not self._watching_swap:\n"
+            "                return",
+            "        if not self._watching_swap:\n"
+            "            return",
+        )
+        assert stripped != src, "watcher guard shape changed; update me"
+        dirty = analyze_source(path, stripped)
+        assert [v for v in dirty.violations if v.rule == "PL008"]
+
+    def test_interleave_harness_is_analyzed(self, full_report):
+        """The testing/ package (interleaving harness) is part of the
+        analyzed set and holds the same bar — its own thread-shared
+        flags carry guarded-by declarations, not suppressions."""
+        files = [f.replace(os.sep, "/") for f in full_report.files]
+        assert any(
+            f.endswith("testing/interleave.py") for f in files
+        ), files
+
     def test_json_lists_allow_sites_with_seam_accounting(self, repo_cwd):
         r = subprocess.run(
             [sys.executable, "-m", "photon_ml_tpu.lint",

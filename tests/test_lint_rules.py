@@ -99,6 +99,40 @@ class TestRuleFixtures:
         # the same untimed waits outside serving/ are not flagged
         assert _violations("pl007_out_of_scope.py") == []
 
+    def test_pl008_positive(self):
+        vs = _violations("pl008_pos.py")
+        # bare write + bare read of an inferred-guard attr, atomic
+        # augwrite, declared-guard miss, thread-shared flag (both
+        # sides), lambda thread target, escaped shared local,
+        # lock-expected helper called bare
+        assert _rules(vs) == ["PL008"] * 9, vs
+
+    def test_pl008_negative(self):
+        # locked accesses, atomic publishes, queue/event handoffs,
+        # guarded escapes, lock-expected helpers called under the lock
+        assert _violations("pl008_neg.py") == []
+
+    def test_pl009_positive(self):
+        vs = _violations("pl009_pos.py")
+        # ONE inversion cycle, reported at BOTH edge sites
+        assert _rules(vs) == ["PL009"] * 2, vs
+        assert all("cycle" in v.message for v in vs)
+
+    def test_pl009_negative(self):
+        assert _violations("pl009_neg.py") == []
+
+    def test_pl010_positive(self):
+        vs = _violations("pl010_pos.py")
+        # callback under a cond-backed lock, blocking call under it,
+        # notify without the lock, check-then-act across a release,
+        # foreign lock-taking method under the wait lock
+        assert _rules(vs) == ["PL010"] * 5, vs
+
+    def test_pl010_negative(self):
+        # callbacks after release, notify under the condition, outer
+        # lock spanning a read-then-write protocol
+        assert _violations("pl010_neg.py") == []
+
 
 class TestSuppression:
     def test_allow_comments_suppress(self):
@@ -142,6 +176,43 @@ class TestSuppression:
             "    return jax.device_get(t)  # plain comment\n"
         )
         assert len(analyze_source("scratch.py", src).violations) == 1
+
+    def test_package_rule_violations_are_suppressable(self):
+        # allow() works on the concurrency pass too (id or slug)
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._flag = False\n"
+            "    def w(self):\n"
+            "        with self._lock:\n"
+            "            self._flag = True\n"
+            "    def r(self):\n"
+            "        return self._flag\n"
+        )
+        assert len(analyze_source("scratch.py", src).violations) == 1
+        allowed = src.replace(
+            "        return self._flag\n",
+            "        return self._flag  "
+            "# photon: allow(unguarded-shared-state)\n",
+        )
+        assert analyze_source("scratch.py", allowed).violations == []
+
+    def test_guarded_by_is_a_declaration_not_a_suppression(self):
+        # annotating an attr does NOT silence it — the declaration is
+        # enforced (naming a non-lock is itself a violation)
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._x = 0  # photon: guarded-by(_nope)\n"
+            "    def w(self):\n"
+            "        self._x = 1\n"
+        )
+        vs = analyze_source("scratch.py", src).violations
+        assert len(vs) == 1 and "not a lock" in vs[0].message
 
 
 class TestSeamAudit:
@@ -222,6 +293,41 @@ class TestBaseline:
         with pytest.raises(ValueError):
             load_baseline(path)
 
+    def test_pl008_pl010_round_trip(self, tmp_path):
+        # the concurrency rules baseline like any other rule...
+        for fixture in ("pl008_pos.py", "pl010_pos.py"):
+            report = _report(fixture)
+            assert report.violations
+            path = str(tmp_path / f"b-{fixture}.json")
+            write_baseline(path, report.violations)
+            fresh = _report(fixture)
+            apply_baseline(fresh, load_baseline(path))
+            assert fresh.violations == []
+            assert fresh.unused_baseline == []
+
+    def test_pl009_refuses_to_baseline(self, tmp_path):
+        # ...except PL009: a lock inversion is never grandfathered
+        from photon_ml_tpu.lint import BaselineRefused
+
+        report = _report("pl009_pos.py")
+        assert report.violations
+        path = str(tmp_path / "b.json")
+        with pytest.raises(BaselineRefused):
+            write_baseline(path, report.violations)
+        assert not os.path.exists(path), "refusal must not write"
+
+    def test_hand_edited_pl009_baseline_entry_rejected(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        json.dump(
+            {"version": 1, "entries": [{
+                "file": "x.py", "rule": "PL009",
+                "snippet": "with a:", "count": 1,
+            }]},
+            open(path, "w"),
+        )
+        with pytest.raises(ValueError, match="never baseline-able"):
+            load_baseline(path)
+
 
 class TestCLI:
     def _run(self, *args, cwd=None):
@@ -268,5 +374,36 @@ class TestCLI:
     def test_list_rules(self):
         r = self._run("--list-rules")
         assert r.returncode == 0
-        for rid in ("PL001", "PL002", "PL003", "PL004", "PL005"):
+        for rid in ("PL001", "PL002", "PL003", "PL004", "PL005",
+                    "PL006", "PL007", "PL008", "PL009", "PL010"):
             assert rid in r.stdout
+        assert "unguarded-shared-state" in r.stdout
+        assert "lock-order-inversion" in r.stdout
+        assert "atomicity-hygiene" in r.stdout
+
+    def test_json_covers_concurrency_rules(self):
+        r = self._run(
+            os.path.join(FIXTURES, "pl008_pos.py"), "--no-baseline",
+            "--json",
+        )
+        data = json.loads(r.stdout)
+        assert r.returncode == 1
+        assert {v["rule"] for v in data["violations"]} == {"PL008"}
+        assert len(data["violations"]) == 9
+
+    def test_no_concurrency_flag_skips_the_package_pass(self):
+        r = self._run(
+            os.path.join(FIXTURES, "pl008_pos.py"), "--no-baseline",
+            "--no-concurrency",
+        )
+        assert r.returncode == 0, r.stdout
+
+    def test_write_baseline_refuses_pl009_with_exit_2(self, tmp_path):
+        target = str(tmp_path / "b.json")
+        r = self._run(
+            os.path.join(FIXTURES, "pl009_pos.py"),
+            "--write-baseline", "--baseline", target,
+        )
+        assert r.returncode == 2
+        assert "never" in r.stderr.lower() or "cannot" in r.stderr.lower()
+        assert not os.path.exists(target)
