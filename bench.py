@@ -100,6 +100,13 @@ def main():
                     "virtual CPU mesh) for the sharded A/B"
                 )
             }
+        # the shard-routing fleet is processes + sockets + host math —
+        # all its contract numbers (conservation, cache hit rate, 0
+        # lowerings, degradation) are valid CPU-side; only the QPS
+        # scaling gate needs cores
+        result["detail"]["shard_routing"] = _shard_routing_config(
+            "shard_routing"
+        )["detail"]
         result["detail"]["note"] = (
             "CPU-only host (accelerator unreachable); kernel-path "
             "microbench and BASELINE suite skipped — see the last "
@@ -2133,6 +2140,393 @@ def _overload_config(name, *, seed=0):
     }
 
 
+SHARD_CHILD_FLAG = "--shard-routing-child"
+
+
+def _shard_routing_shapes():
+    import jax
+
+    on_chip = any(p.platform != "cpu" for p in jax.devices())
+    if on_chip:
+        return {
+            "on_chip": True,
+            "E": 200_000, "d_g": 1 << 18, "d_u": 256,
+            "k_g": 32, "k_u": 16,
+            "n_flood": 8_000, "threads": 32, "n_kill": 2_000,
+            "zipf_a": 1.3, "payload_pool": 4,
+            "note": "chip-class shapes (256k dims, 200k users x 256)",
+        }
+    return {
+        "on_chip": False,
+        "E": 2_000, "d_g": 1 << 14, "d_u": 32,
+        "k_g": 16, "k_u": 8,
+        "n_flood": int(os.environ.get("PHOTON_ROUTING_FLOOD", "1200")),
+        "threads": 8, "n_kill": 400,
+        "zipf_a": 1.3, "payload_pool": 4,
+        "note": "CPU-scaled shapes (16k dims, 2k users x 32)",
+    }
+
+
+def _shard_routing_ids(E):
+    return [f"user{i:06d}" for i in range(E)]
+
+
+def _shard_routing_arrays(seed, shapes):
+    rng = np.random.default_rng(seed)
+    fe = rng.standard_normal(shapes["d_g"]).astype(np.float32) * 0.1
+    re = (
+        rng.standard_normal((shapes["E"], shapes["d_u"]))
+        .astype(np.float32) * 0.1
+    )
+    return fe, re
+
+
+def _shard_routing_shard_configs():
+    from photon_ml_tpu.game.config import FeatureShardConfiguration
+
+    return [
+        FeatureShardConfiguration("g", ["features"]),
+        FeatureShardConfiguration("u", ["userFeatures"]),
+    ]
+
+
+def _shard_routing_child(cfg_text):
+    """One shard-server subprocess for the 14_shard_routing fleet:
+    builds its 1/N slice of the SAME deterministic synthetic bank the
+    parent knows (seed -> arrays, no artifact on disk), serves the
+    routing control plane (topology + two-step swap via a synthetic
+    stager keyed by seed), publishes its port, and on SIGTERM drains
+    and writes its program-cache stats — the parent gates 0 request-
+    path lowerings per shard on exactly that file."""
+    import signal
+    import threading
+
+    from photon_ml_tpu.reliability import atomic_write_json
+    from photon_ml_tpu.serving import (
+        ServingModel,
+        ServingPrograms,
+        ShardServer,
+        bank_from_arrays,
+    )
+    from photon_ml_tpu.utils.index_map import IndexMap
+
+    cfg = json.loads(cfg_text)
+    shapes = cfg["shapes"]
+    s, n = int(cfg["shard"]), int(cfg["count"])
+    ids = _shard_routing_ids(shapes["E"])
+    imaps = {
+        "g": IndexMap({f"g{j}\t": j for j in range(shapes["d_g"])}),
+        "u": IndexMap({f"u{j}\t": j for j in range(shapes["d_u"])}),
+    }
+    widths = {"g": shapes["k_g"], "u": shapes["k_u"]}
+
+    def build(seed):
+        fe, re = _shard_routing_arrays(seed, shapes)
+        return bank_from_arrays(
+            fixed=[("global", "g", fe)],
+            random=[("per-user", "userId", "u", re, ids)],
+            shard_widths=widths,
+            index_maps=imaps,
+            entity_shard=(s, n),
+        )
+
+    sm = ServingModel(
+        build(cfg["seed"]),
+        ServingPrograms(tuple(cfg.get("ladder", (1, 8, 64)))),
+        partial=True,
+        entity_shard=(s, n),
+    )
+
+    def stager(obj):
+        return sm.prepare_swap_bank(build(int(obj["model_dir"])))
+
+    srv = ShardServer(
+        sm,
+        _shard_routing_shard_configs(),
+        (s, n),
+        stager=stager,
+        has_response=False,
+    ).start()
+    out = cfg["out"]
+    os.makedirs(out, exist_ok=True)
+    atomic_write_json(
+        os.path.join(out, "frontend.json"),
+        {"port": srv.port, "pid": os.getpid(), "shard": s, "count": n},
+    )
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_a: stop.set())
+    while not stop.wait(timeout=0.2):
+        pass
+    report = srv.close(drain_timeout_s=5.0)
+    atomic_write_json(
+        os.path.join(out, "metrics.json"),
+        {
+            "programs": sm.programs.stats(),
+            "serving": srv.metrics.snapshot(),
+            "drain": report.to_dict(),
+        },
+    )
+
+
+def _shard_routing_config(name, *, seed=0):
+    """Planet-scale serving bench (ISSUE 12): aggregate QPS vs shard
+    count through the scatter/gather router over REAL shard-server
+    subprocesses, under a zipf (head-skewed) open-loop replay.
+
+    Per fleet size N in {1, 2, 4}: spawn N shard-server processes
+    (each holding 1/N of the RE bank, partial-score mode), connect the
+    router, flood it from ``threads`` submitter threads over a zipf
+    entity draw whose payloads repeat (the hot-entity cache's food),
+    and record aggregate QPS, fan-out p50/p99, cache hit rate and
+    outcome conservation. At N=4 a second, smaller flood runs with one
+    shard SIGKILLed mid-fleet: its entities must degrade FE-only
+    (named, counted) — never a failed run. Children then SIGTERM-drain
+    and report their program caches: the parent records 0 request-path
+    lowerings per shard. Gates in dev-scripts/bench_shard_routing.sh
+    (scaling gate multi-core/chip only — on a 1-core container N
+    processes share one core and the ratio is recorded, not gated).
+    """
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+
+    from photon_ml_tpu.serving import (
+        RoutingPolicy,
+        ShardRouter,
+        ServingError,
+    )
+
+    shapes = _shard_routing_shapes()
+    ids = _shard_routing_ids(shapes["E"])
+    rng = np.random.default_rng(seed)
+    # zipf head draw + a small payload pool per entity: head entities
+    # repeat identical (entity, features) pairs — deterministic score
+    # paths the cache may legally absorb
+    zipf = rng.zipf(shapes["zipf_a"], size=shapes["n_flood"] * 2)
+    entity_draw = (zipf - 1) % shapes["E"]
+    pool = {}
+
+    def record_for(i, j, variant=0):
+        # ``variant`` switches to a disjoint payload universe: the kill
+        # leg uses variant=1 so its records MISS the cache by
+        # construction and the dead shard's entities must hit the wire
+        key = (int(i), int(j) % shapes["payload_pool"], int(variant))
+        rec = pool.get(key)
+        if rec is None:
+            prng = np.random.default_rng(hash(key) & 0x7FFFFFFF)
+            rec = {
+                "uid": f"q{key[0]}-{key[1]}-{key[2]}",
+                "metadataMap": {"userId": ids[key[0]]},
+                "features": [
+                    {"name": f"g{int(g)}", "term": "",
+                     "value": float(prng.standard_normal())}
+                    for g in prng.integers(
+                        0, shapes["d_g"], size=shapes["k_g"] // 2
+                    )
+                ],
+                "userFeatures": [
+                    {"name": f"u{int(u)}", "term": "",
+                     "value": float(prng.standard_normal())}
+                    for u in prng.integers(
+                        0, shapes["d_u"], size=shapes["k_u"] // 2
+                    )
+                ],
+                "offset": 0.0,
+            }
+            pool[key] = rec
+        return rec
+
+    base = tempfile.mkdtemp(prefix="photon-shard-routing-")
+    child_env = dict(os.environ)
+    if not shapes["on_chip"]:
+        child_env["JAX_PLATFORMS"] = "cpu"
+
+    def spawn_fleet(n_shards):
+        procs = []
+        for s in range(n_shards):
+            out = os.path.join(base, f"n{n_shards}-shard{s}")
+            cfg = json.dumps({
+                "shard": s, "count": n_shards, "seed": seed,
+                "shapes": shapes, "out": out, "ladder": [1, 8, 64],
+            })
+            procs.append((out, subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 SHARD_CHILD_FLAG, cfg],
+                env=child_env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT,
+            )))
+        ports = []
+        for out, p in procs:
+            fj = os.path.join(out, "frontend.json")
+            deadline = time.perf_counter() + 180
+            while not os.path.exists(fj):
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        f"shard child died during boot ({out})"
+                    )
+                if time.perf_counter() > deadline:
+                    raise RuntimeError("shard child boot timeout")
+                time.sleep(0.2)
+            ports.append(json.load(open(fj))["port"])
+        return procs, ports
+
+    def flood(router, n_requests, offset, threads, variant=0):
+        it = iter(range(n_requests))
+        it_lock = threading.Lock()
+        counts = {}
+        c_lock = threading.Lock()
+
+        def note(key):
+            with c_lock:
+                counts[key] = counts.get(key, 0) + 1
+
+        def worker():
+            while True:
+                with it_lock:
+                    i = next(it, None)
+                if i is None:
+                    return
+                rec = record_for(
+                    entity_draw[offset + i],
+                    entity_draw[offset + i] + i,
+                    variant,
+                )
+                try:
+                    out = router.score_record(rec)
+                    note("degraded" if out.degraded else "ok")
+                except ServingError as e:
+                    note(f"error:{e.code}")
+
+        ts = [threading.Thread(target=worker) for _ in range(threads)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return counts, time.perf_counter() - t0
+
+    fleets = {}
+    kill_leg = None
+    for n_shards in (1, 2, 4):
+        procs, ports = spawn_fleet(n_shards)
+        router = ShardRouter(
+            [("127.0.0.1", pt) for pt in ports],
+            entity_ids={"userId": ids},
+            shard_configs=_shard_routing_shard_configs(),
+            policy=RoutingPolicy(subrequest_timeout_s=5.0),
+            cache_entries=int(os.environ.get(
+                "PHOTON_ROUTING_CACHE_ENTRIES", "8192"
+            )),
+        )
+        try:
+            router.connect()
+            # tiny warmup so the flood never measures ladder selection
+            flood(router, 16, 0, 4)
+            counts, wall = flood(
+                router, shapes["n_flood"], 16, shapes["threads"]
+            )
+            snap = router.metrics.snapshot()
+            cache = router.cache.snapshot()
+            terminal = sum(counts.values())
+            fleets[str(n_shards)] = {
+                "outcomes": dict(sorted(counts.items())),
+                "terminal": terminal,
+                "submitted": shapes["n_flood"],
+                "wall_s": round(wall, 3),
+                "qps": round(terminal / wall, 1) if wall > 0 else None,
+                "fanout_p50_ms": snap.get("latency_p50_ms"),
+                "fanout_p99_ms": snap.get("latency_p99_ms"),
+                "fanout_mean": snap["fanout_mean"],
+                "subrequests": snap["subrequests"],
+                "hedges": snap["hedges"],
+                "cache": cache,
+                "cache_hit_rate": round(
+                    cache["hits"] / max(cache["hits"] + cache["misses"], 1),
+                    4,
+                ),
+            }
+            if n_shards == 4:
+                # the kill leg: SIGKILL one shard mid-fleet, flood
+                # again — its entities degrade (FE-only, named), the
+                # run never fails
+                procs[3][1].send_signal(signal.SIGKILL)
+                procs[3][1].wait(timeout=30)
+                counts, wall = flood(
+                    router, shapes["n_kill"], shapes["n_flood"] // 2,
+                    shapes["threads"], variant=1,
+                )
+                kill_leg = {
+                    "killed_shard": 3,
+                    "outcomes": dict(sorted(counts.items())),
+                    "terminal": sum(counts.values()),
+                    "submitted": shapes["n_kill"],
+                    "wall_s": round(wall, 3),
+                    "degraded": counts.get("degraded", 0),
+                    "errors": sum(
+                        v for k, v in counts.items()
+                        if k.startswith("error")
+                    ),
+                    "health": [h.snapshot() for h in router.health],
+                }
+        finally:
+            router.close()
+            shard_stats = []
+            for out, p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            for out, p in procs:
+                if p.poll() is None:
+                    try:
+                        p.wait(timeout=60)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                mp = os.path.join(out, "metrics.json")
+                if os.path.exists(mp):
+                    m = json.load(open(mp))
+                    shard_stats.append({
+                        "shard": os.path.basename(out),
+                        "cold_dispatch_compiles": (
+                            m["programs"]["cold_dispatch_compiles"]
+                        ),
+                        "compiled_programs": (
+                            m["programs"]["compiled_programs"]
+                        ),
+                        "dispatches": m["serving"]["dispatches"],
+                    })
+            fleets.setdefault(str(n_shards), {})["shards"] = shard_stats
+
+    q1 = fleets["1"]["qps"] or 1.0
+    q4 = fleets["4"]["qps"] or 0.0
+    scaling = round(q4 / q1, 3)
+    return {
+        "config": name,
+        "metric": "routing_qps_scaling_4x_over_1x",
+        "value": scaling,
+        "unit": "aggregate QPS ratio N=4 / N=1 (details gated)",
+        "detail": {
+            "host": {
+                "cpu_count": os.cpu_count(),
+                "on_chip": shapes["on_chip"],
+            },
+            "shape_note": shapes["note"],
+            "zipf_a": shapes["zipf_a"],
+            "fleets": fleets,
+            "kill_leg": kill_leg,
+            "scaling_4_over_1": scaling,
+            "scaling_2_over_1": round(
+                (fleets["2"]["qps"] or 0.0) / q1, 3
+            ),
+            "data": (
+                "synthetic sharded banks (subprocess fleet) + zipf "
+                "open-loop replay through the router"
+            ),
+        },
+    }
+
+
 def _retrain_config(name, *, n_files=8, rows_per_file=4000, d=2000,
                     k=12, max_iter=30, seed=0):
     """Incremental retrain vs full retrain (ISSUE 10, ROADMAP metric):
@@ -2803,6 +3197,14 @@ def suite(only=None):
         results.append(_retrain_config("13_retrain"))
         print(json.dumps(results[-1]), flush=True)
 
+    # 14: planet-scale serving (ISSUE 12): aggregate QPS vs shard count
+    # through the scatter/gather router over subprocess shard-servers
+    # under a zipf flood, + the SIGKILL-one-shard degradation leg;
+    # gates in dev-scripts/bench_shard_routing.sh.
+    if want("14_shard_routing"):
+        results.append(_shard_routing_config("14_shard_routing"))
+        print(json.dumps(results[-1]), flush=True)
+
     path = "BASELINE_RESULTS.json"
     merged = {}
     if only is not None and os.path.exists(path):
@@ -2834,7 +3236,11 @@ def suite(only=None):
 
 
 if __name__ == "__main__":
-    if "--overlap-ab" in sys.argv:
+    if SHARD_CHILD_FLAG in sys.argv:
+        # one shard-server subprocess of the 14_shard_routing fleet
+        # (spawned by _shard_routing_config; never run by hand)
+        _shard_routing_child(sys.argv[sys.argv.index(SHARD_CHILD_FLAG) + 1])
+    elif "--overlap-ab" in sys.argv:
         print(json.dumps(overlap_ab(full="--full" in sys.argv)))
     elif "--grid-batched" in sys.argv:
         # dev-scripts/bench_grid.sh entry: the batched λ-grid A/B as one
@@ -2864,6 +3270,10 @@ if __name__ == "__main__":
         # dev-scripts/bench_retrain.sh entry: incremental vs full
         # retrain as one JSON line (gates applied by the script)
         print(json.dumps(_retrain_config("retrain")))
+    elif "--shard-routing" in sys.argv:
+        # dev-scripts/bench_shard_routing.sh entry: the scatter/gather
+        # fleet bench as one JSON line (gates applied by the script)
+        print(json.dumps(_shard_routing_config("shard_routing")))
     elif "--suite" in sys.argv:
         only = None
         if "--only" in sys.argv:

@@ -4,8 +4,10 @@
 # seam class (chunk read, spill write/read, cache load/store,
 # checkpoint save, async IO worker, serving model-load/frontend-read/
 # dispatch) — driven end-to-end through the GLM, GAME and serving
-# drivers (replay, stdin deadline mix, and the TCP front-end under
-# flood + mid-flood swap + SIGTERM drain), asserting:
+# drivers (replay, stdin deadline mix, the TCP front-end under
+# flood + mid-flood swap + SIGTERM drain, and the shard-routed
+# scatter/gather fleet under flood + two-step flip + SIGKILL),
+# asserting:
 #
 #   1. every faulted run COMPLETES (transient faults retry; corrupt
 #      cache artifacts quarantine to *.corrupt and rebuild);

@@ -63,6 +63,15 @@ per-arm):
     generation 1 bitwise (scores equal the pre-swap clean scores),
     and the bad generation is quarantined in the registry so it is
     never re-promoted.
+14. **Shard routing under fire (ISSUE 12)** — a 2-shard scatter/gather
+    fleet (real serving_driver subprocesses in --shard-index mode)
+    flooded through the router with a MID-FLOOD router-coordinated
+    two-step generation flip, then a SIGKILL of shard 1 followed by a
+    cache-missing variant flood: every request one terminal outcome,
+    non-degraded routed scores bitwise the clean single-server arm on
+    BOTH sides of the flip, the dead shard's entities degraded to the
+    FE-only reference bitwise (its entities ONLY), and the surviving
+    shard SIGTERM-drains to exit 0 with zero request-path compiles.
 
 Every asserted invariant is printed; any failure exits non-zero.
 """
@@ -612,6 +621,244 @@ def frontend_under_fire_arm(
     )
 
 
+# -- planet-scale serving arm (ISSUE 12) -------------------------------------
+
+
+def shard_routing_arm(
+    base, game_train, model_dir, fe_model, nt_dir, clean_scores
+):
+    """Arm 14: scatter/gather routing under fire — a 2-shard fleet
+    (real serving_driver subprocesses, each holding 1/2 of the RE
+    banks) flooded through the router from concurrent threads, with a
+    mid-flood router-coordinated TWO-STEP generation swap and a
+    mid-flood SIGKILL of shard 1. Invariants:
+
+    - every routed request reaches exactly one terminal outcome
+      (conserved; 0 hung futures);
+    - admitted NON-degraded scores are bitwise the clean single-server
+      arm's — across BOTH generations of the swap (the staged gen-2 is
+      a byte-copy, so bitwise equality must hold on either side of the
+      flip and a mixed-generation gather would still be caught by the
+      router's consistency check);
+    - after the SIGKILL, shard 1's entities answer DEGRADED with the
+      FE-only reference score bitwise — shard 0's entities stay exact;
+    - the surviving shard SIGTERM-drains to exit 0 with zero cold
+      (request-path) compiles.
+    """
+    import threading
+
+    from photon_ml_tpu.game.model_io import load_game_model
+    from photon_ml_tpu.game.config import FeatureShardConfiguration
+    from photon_ml_tpu.serving import (
+        RoutingPolicy,
+        ServingError,
+        ShardRouter,
+    )
+    from photon_ml_tpu import ownership
+
+    records = trace_json_records(game_train)
+    swap_copy = os.path.join(base, "routing-swap-gen2")
+    shutil.copytree(model_dir, swap_copy)
+    # the post-SIGKILL flood uses a VARIANT trace (same entities,
+    # deterministically perturbed feature values): its records miss the
+    # hot-entity cache by construction, so the dead shard's entities
+    # must go to the wire and degrade. References come from the same
+    # single-server stdin path the other serving arms gate against.
+    variants = []
+    for r in records:
+        v = json.loads(json.dumps(r))
+        for bag in ("features", "userFeatures"):
+            for f in v.get(bag) or []:
+                f["value"] = float(f["value"]) * 1.25 + 0.125
+        variants.append(v)
+
+    def stdin_reference(md, out):
+        lines = "\n".join(json.dumps(v) for v in variants) + "\n"
+        run(
+            stream_serving_args(md, out, nt_dir)
+            + ["--request-paths", "-"],
+            stdin_text=lines,
+        )
+        return scores_by_uid(os.path.join(out, "scores"))
+
+    var_clean = stdin_reference(
+        model_dir, os.path.join(base, "routing-var-clean")
+    )
+    var_fe = stdin_reference(
+        fe_model, os.path.join(base, "routing-var-fe")
+    )
+    shard_cfgs = [
+        FeatureShardConfiguration("globalShard", ["features"]),
+        FeatureShardConfiguration("userShard", ["userFeatures"]),
+    ]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = []
+    for s in range(2):
+        out = os.path.join(base, f"routing-shard{s}")
+        procs.append((out, subprocess.Popen(
+            stream_serving_args(model_dir, out, nt_dir) + [
+                "--frontend-port", "0",
+                "--shard-index", str(s), "--shard-count", "2",
+            ],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )))
+    try:
+        ports = []
+        for out, p in procs:
+            fj = os.path.join(out, "frontend.json")
+            deadline = time.time() + 180
+            while not os.path.exists(fj):
+                assert p.poll() is None, p.stdout.read()[-3000:]
+                assert time.time() < deadline, "shard boot timeout"
+                time.sleep(0.2)
+            meta = json.load(open(fj))
+            ports.append(meta["port"])
+            assert meta["shard"]["shard_index"] == len(ports) - 1
+            assert meta["shard"]["rule"] == "entity_code % num_shards"
+        loaded = load_game_model(model_dir)
+        (_rt, _sid, per_entity), = loaded.random_effects.values()
+        ids = sorted(per_entity)
+        router = ShardRouter(
+            [("127.0.0.1", pt) for pt in ports],
+            entity_ids={"userId": ids},
+            shard_configs=shard_cfgs,
+            policy=RoutingPolicy(subrequest_timeout_s=5.0),
+        )
+        router.connect()
+        owners = {
+            r["uid"]: ownership.owner_of(
+                ids.index((r.get("metadataMap") or {}).get("userId")), 2
+            )
+            for r in records
+            if (r.get("metadataMap") or {}).get("userId") in ids
+        }
+
+        def flood(recs, passes):
+            """Concurrent replay; returns (uid, outcome, score,
+            degraded, generation) per request."""
+            results = []
+            res_lock = threading.Lock()
+            it = iter([rec for _p in range(passes) for rec in recs])
+            it_lock = threading.Lock()
+
+            def worker():
+                while True:
+                    with it_lock:
+                        rec = next(it, None)
+                    if rec is None:
+                        return
+                    try:
+                        out = router.score_record(rec)
+                        entry = (rec["uid"], "ok", float(out),
+                                 out.degraded, out.generation)
+                    except ServingError as e:
+                        entry = (rec["uid"], f"error:{e.code}", None,
+                                 False, None)
+                    with res_lock:
+                        results.append(entry)
+
+            threads = [
+                threading.Thread(target=worker) for _ in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(results) == passes * len(recs), (
+                len(results), passes * len(recs),
+            )
+            return results
+
+        # -- phase 1: flood with a MID-FLOOD two-step swap: a swapper
+        # thread stages + commits generation 2 on both shards while 4
+        # workers keep scoring — in-flight gathers straddle the commit
+        # wave (the router's consistency check re-scatters them) and
+        # every score must stay bitwise the clean arm's on BOTH sides
+        # of the flip
+        swap_result = {}
+
+        def swapper():
+            swap_result.update(router.coordinate_swap(swap_copy))
+
+        sw = threading.Thread(target=swapper)
+        sw.start()
+        # keep flooding until the swap lands, then one full pass more:
+        # staging two real shard processes takes seconds, and the flood
+        # must genuinely straddle the commit wave
+        phase1 = []
+        post_swap_passes = 0
+        for _pass in range(500):
+            swap_done_before = bool(swap_result)
+            phase1 += flood(records, passes=1)
+            if swap_done_before:
+                post_swap_passes += 1
+                if post_swap_passes >= 1:
+                    break
+        sw.join()
+        assert swap_result.get("ok"), swap_result
+        assert swap_result["generation"] == 2, swap_result
+        gens = {g for _u, _o, _s, _d, g in phase1}
+        assert gens >= {1, 2}, (
+            f"the flood must straddle the two-step flip, saw {gens}"
+        )
+        for uid, outcome, score, degraded, _gen in phase1:
+            assert outcome == "ok", (uid, outcome)
+            assert not degraded, (uid, "no shard is down yet")
+            assert score == clean_scores[uid], (
+                uid, score, clean_scores[uid]
+            )
+        # -- phase 2: SIGKILL shard 1, then flood a VARIANT trace
+        # (same entities, perturbed features -> cache misses by
+        # construction): shard 1's entities MUST degrade to the
+        # FE-only variant reference bitwise; shard 0's stay exact
+        procs[1][1].send_signal(signal.SIGKILL)
+        procs[1][1].wait(timeout=60)
+        phase2 = flood(variants, passes=1)
+        n_exact = n_deg = 0
+        for uid, outcome, score, degraded, gen in phase2:
+            assert outcome == "ok", (uid, outcome)
+            assert gen == 2, (uid, gen)
+            if degraded:
+                n_deg += 1
+                assert owners.get(uid) == 1, (
+                    f"{uid}: only the SIGKILLed shard's entities may "
+                    "degrade"
+                )
+                assert score == var_fe[uid], (uid, score, var_fe[uid])
+            else:
+                n_exact += 1
+                assert owners.get(uid) != 1, (
+                    f"{uid}: a dead shard's entity cannot score exact "
+                    "without its bank"
+                )
+                assert score == var_clean[uid], (
+                    uid, score, var_clean[uid]
+                )
+        assert n_deg > 0, "SIGKILL produced no degraded outcomes"
+        assert n_exact > 0, "the surviving shard must keep scoring"
+        n_ok = len(phase1) + n_exact
+        # surviving shard drains clean with 0 request-path compiles
+        procs[0][1].send_signal(signal.SIGTERM)
+        stdout, _ = procs[0][1].communicate(timeout=120)
+        assert procs[0][1].returncode == 0, stdout[-3000:]
+        m = json.load(open(os.path.join(procs[0][0], "metrics.json")))
+        assert m["programs"]["cold_dispatch_compiles"] == 0
+        assert m["leaked_connections"] == 0
+        log(
+            f"shard routing: {n_ok} exact bitwise clean arm across "
+            f"generations {sorted(g for g in gens if g)} (two-step "
+            f"flip mid-flood), {n_deg} degraded bitwise FE-only after "
+            "SIGKILL, outcomes conserved, surviving shard drained "
+            "exit 0"
+        )
+    finally:
+        for _out, p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate(timeout=60)
+
+
 # -- continuous-retraining arms (ISSUE 10) ------------------------------------
 
 
@@ -1041,6 +1288,11 @@ def main():
         gate_refusal_arm(base, glm_train)
         auto_rollback_arm(
             base, game_train, model_dir, nt_dir, clean_scores
+        )
+
+        # -- planet-scale serving arm (ISSUE 12) --------------------------
+        shard_routing_arm(
+            base, game_train, model_dir, fe_model, nt_dir, clean_scores
         )
         log("chaos matrix: PASS")
     finally:

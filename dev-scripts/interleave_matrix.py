@@ -44,11 +44,11 @@ class StubPrograms:
         time.sleep(0.002)  # virtual device time per dispatch
         return np.zeros(batch.offsets.shape[0], np.float32)
 
-    def ensure_compiled(self, bank):
+    def ensure_compiled(self, bank, partial=False):
         time.sleep(0.05)  # virtual warmup
         return 0
 
-    def executable(self, spec, B):
+    def executable(self, spec, B, partial=False):
         return object()
 
 

@@ -116,6 +116,18 @@ class ServingParams:
     rollback_window: int = 64
     rollback_min_requests: int = 16
     rollback_max_unhealthy: float = 0.5
+    # Planet-scale serving (ISSUE 12). Shard-server mode: this replica
+    # serves ONE entity shard (--shard-index of --shard-count) in
+    # partial-score mode with the router control ops attached; topology
+    # is published in frontend.json and every status response. Router
+    # mode: --shard-servers host:port,... replays the trace through the
+    # scatter/gather tier instead of a local bank.
+    shard_index: Optional[int] = None
+    shard_count: Optional[int] = None
+    shard_servers: Optional[str] = None
+    hot_cache_entries: int = 4096
+    router_subrequest_timeout_ms: float = 2000.0
+    router_hedge: bool = True
 
     @property
     def stdin_mode(self) -> bool:
@@ -125,7 +137,109 @@ class ServingParams:
     def frontend_mode(self) -> bool:
         return self.frontend_port is not None
 
+    @property
+    def shard_mode(self) -> bool:
+        return self.shard_index is not None or self.shard_count is not None
+
+    @property
+    def router_mode(self) -> bool:
+        return bool(self.shard_servers)
+
+    @property
+    def entity_shard(self):
+        return (
+            (self.shard_index, self.shard_count)
+            if self.shard_mode
+            else None
+        )
+
+    @property
+    def shard_addresses(self):
+        out = []
+        for part in (self.shard_servers or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            host, _, port = part.rpartition(":")
+            out.append((host or "127.0.0.1", int(port)))
+        return out
+
     def validate(self) -> None:
+        if self.shard_mode:
+            if self.shard_index is None or self.shard_count is None:
+                raise ValueError(
+                    "--shard-index and --shard-count go together"
+                )
+            if not (
+                self.shard_count >= 1
+                and 0 <= self.shard_index < self.shard_count
+            ):
+                raise ValueError(
+                    f"need 0 <= shard-index < shard-count, got "
+                    f"{self.shard_index}/{self.shard_count}"
+                )
+            if not self.frontend_mode:
+                raise ValueError(
+                    "a shard-server serves the routing tier over TCP; "
+                    "--shard-index requires --frontend-port"
+                )
+            if self.registry_dir:
+                raise ValueError(
+                    "--shard-index is incompatible with --registry-dir: "
+                    "a watcher-owned swap on one shard would desync the "
+                    "fleet's generations — the router coordinates swaps "
+                    "through the stage/commit ops"
+                )
+            if self.swap_model_dir:
+                raise ValueError(
+                    "--swap-model-dir is incompatible with "
+                    "--shard-index: shard generations flip through the "
+                    "router's two-step stage/commit protocol"
+                )
+        if self.router_mode:
+            if self.shard_mode:
+                raise ValueError(
+                    "a process is a shard-server or a router, not both"
+                )
+            if self.frontend_mode:
+                raise ValueError(
+                    "router mode replays --request-paths through the "
+                    "fleet; it does not serve a frontend itself"
+                )
+            if self.registry_dir:
+                raise ValueError(
+                    "router mode coordinates fleet swaps itself; "
+                    "--registry-dir is the single-server watcher path"
+                )
+            if not self.request_paths:
+                raise ValueError(
+                    "router mode needs --request-paths ('-' for stdin)"
+                )
+            if not self.shard_addresses:
+                raise ValueError(
+                    f"unparseable --shard-servers {self.shard_servers!r}"
+                )
+            if self.swap_model_dir and self.swap_after_requests < 1:
+                raise ValueError(
+                    "swap-model-dir requires --swap-after-requests >= 1"
+                )
+            if not self.game_model_input_dir:
+                raise ValueError(
+                    "router mode needs --game-model-input-dir (the "
+                    "router builds its entity->shard index from the "
+                    "model's entity universe)"
+                )
+            if not self.output_dir:
+                raise ValueError("output-dir is required")
+            if self.mode not in ("closed", "open"):
+                raise ValueError(
+                    f"mode must be closed|open, got {self.mode!r}"
+                )
+            if not self.feature_shards:
+                raise ValueError(
+                    "feature shard configuration is required"
+                )
+            return  # the bank/ladder rules below are shard-side
         if not self.game_model_input_dir and not self.registry_dir:
             raise ValueError(
                 "game-model-input-dir is required (or --registry-dir to "
@@ -210,6 +324,18 @@ class ServingParams:
                     "fixed per-shard feature width baked into the AOT "
                     "program shapes)"
                 )
+
+
+@dataclass
+class _RoutedRequest:
+    """Just enough of a ScoreRequest for the score-artifact writer and
+    the trace evaluators (router mode routes raw records; nothing else
+    needs assembling)."""
+
+    uid: str
+    label: Optional[float]
+    weight: float
+    metadata: Optional[Dict[str, str]]
 
 
 def _parse_widths(text: str, shard_ids: List[str]) -> Dict[str, int]:
@@ -371,19 +497,28 @@ class ServingDriver:
                 widths,
                 entity_pad_to=p.entity_pad_to,
                 model_id=p.model_id,
+                entity_shard=p.entity_shard,
             )
         with self.timer.time("warmup-programs"):
             self.serving_model = ServingModel(
-                bank, ServingPrograms(tuple(p.ladder))
+                bank,
+                ServingPrograms(tuple(p.ladder)),
+                partial=p.shard_mode,
+                entity_shard=p.entity_shard,
             )
         self.logger.info(
             "bank generation %d staged: %d coordinate(s), %.1f MiB on "
-            "device, ladder %s AOT-compiled (%d program(s))",
+            "device, ladder %s AOT-compiled (%d program(s))%s",
             bank.generation,
             len(bank.spec),
             bank.device_bytes() / (1 << 20),
             tuple(p.ladder),
             self.serving_model.programs.stats()["compiled_programs"],
+            (
+                f", entity shard {p.shard_index}/{p.shard_count} "
+                "(partial-score mode)"
+                if p.shard_mode else ""
+            ),
         )
         if dataset is not None:
             with self.timer.time("assemble-requests"):
@@ -665,6 +800,9 @@ class ServingDriver:
 
         p = self.params
         self.logger.info("application: %s", p.application_name)
+        if p.router_mode:
+            self._run_router()
+            return
         requests = self._build()
         self.metrics = ServingMetrics()
         overlap.reset_readback_stats()
@@ -729,6 +867,254 @@ class ServingDriver:
         self.results = [s for _, outcome, s in scored if outcome == "ok"]
         self.logger.info("timers:\n%s", self.timer.summary())
 
+    # -- router mode (--shard-servers) ---------------------------------------
+
+    def _router_entity_ids(self, loaded) -> Dict[str, List[str]]:
+        """The router's only model state: each id type's FULL sorted
+        entity-id universe (position == code == the ownership rule's
+        input). No coefficients are ever loaded router-side."""
+        entity_ids: Dict[str, List[str]] = {}
+        for re_type, _sid, per_entity in loaded.random_effects.values():
+            ids = sorted(per_entity)
+            prev = entity_ids.get(re_type)
+            if prev is not None and prev != ids:
+                raise ValueError(
+                    f"random-effect coordinates disagree on the "
+                    f"{re_type!r} entity set"
+                )
+            entity_ids[re_type] = ids
+        for row_t, col_t, rows, cols in (
+            loaded.matrix_factorizations.values()
+        ):
+            for t, latent in ((row_t, rows), (col_t, cols)):
+                entity_ids.setdefault(t, sorted(latent))
+        return entity_ids
+
+    def _router_records(self):
+        p = self.params
+        if p.stdin_mode:
+            def stdin_records():
+                for line in sys.stdin:
+                    line = line.strip()
+                    if line:
+                        yield json.loads(line)
+
+            return stdin_records()
+        from photon_ml_tpu.io.avro_codec import read_avro_records
+
+        out = []
+        for path in p.request_paths:
+            out.extend(read_avro_records(path))
+        return out
+
+    def _route_one(self, router, record) -> tuple:
+        from photon_ml_tpu.serving import ServingError
+
+        p = self.params
+        try:
+            outcome = router.score_record(
+                record,
+                deadline_ms=record.get("deadline_ms",
+                                       p.default_deadline_ms),
+            )
+            return ("ok", outcome)
+        except ServingError as e:
+            return (f"error:{e.code}", None)
+
+    def _maybe_router_swap(
+        self, router, completed: int, swap_once: threading.Lock
+    ) -> None:
+        p = self.params
+        if (
+            p.swap_model_dir
+            and completed >= p.swap_after_requests
+            and swap_once.acquire(blocking=False)
+        ):
+            with self.timer.time("router-swap"):
+                res = router.coordinate_swap(p.swap_model_dir)
+            self._router_swap_result = res
+            self.logger.info(
+                "router-coordinated two-step swap after %d request(s): "
+                "%s", completed, res,
+            )
+
+    def _run_router(self) -> None:
+        """Replay the trace through the scatter/gather tier: the driver
+        is the THIN router — no device bank, no programs, just the
+        entity->shard index and the fleet connections. Bitwise vs the
+        single-server replay is the acceptance bar; a mid-replay
+        --swap-model-dir runs the two-step fleet flip."""
+        from photon_ml_tpu.game.data import record_response
+        from photon_ml_tpu.parallel import overlap
+        from photon_ml_tpu.reliability import (
+            atomic_write_json,
+            reliability_metrics,
+        )
+        from photon_ml_tpu.serving import (
+            RoutingPolicy,
+            ShardRouter,
+        )
+        from photon_ml_tpu.serving.swap import load_model_artifact
+
+        p = self.params
+        with self.timer.time("load-model"):
+            loaded = load_model_artifact(p.game_model_input_dir)
+        router = ShardRouter(
+            p.shard_addresses,
+            entity_ids=self._router_entity_ids(loaded),
+            shard_configs=p.feature_shards,
+            policy=RoutingPolicy(
+                hedge=p.router_hedge,
+                subrequest_timeout_s=(
+                    p.router_subrequest_timeout_ms / 1e3
+                ),
+            ),
+            cache_entries=p.hot_cache_entries,
+        )
+        with self.timer.time("connect-fleet"):
+            info = router.connect()
+        self.logger.info(
+            "routing over %d shard-server(s), fleet generation %d",
+            info["shards"], info["generation"],
+        )
+        self._router_swap_result = None
+        records = self._router_records()
+        swap_once = threading.Lock()
+        scored: List[tuple] = []
+        out_lock = threading.Lock()
+
+        def _interrupt(signum, frame):
+            self._stop_replay.set()
+            raise KeyboardInterrupt(f"signal {signum}")
+
+        prev = self._install_signal_handlers(_interrupt)
+        try:
+            try:
+                with self.timer.time("serve"):
+                    if p.mode == "closed":
+                        for rec in records:
+                            if self._stop_replay.is_set():
+                                break
+                            outcome, score = self._route_one(router, rec)
+                            scored.append((rec, outcome, score))
+                            self._maybe_router_swap(
+                                router, len(scored), swap_once
+                            )
+                    else:
+                        it = iter(enumerate(records))
+                        it_lock = threading.Lock()
+                        results: Dict[int, tuple] = {}
+                        errors: List[BaseException] = []
+
+                        def worker():
+                            while not self._stop_replay.is_set():
+                                with it_lock:
+                                    try:
+                                        i, rec = next(it)
+                                    except StopIteration:
+                                        return
+                                try:
+                                    outcome, score = self._route_one(
+                                        router, rec
+                                    )
+                                except BaseException as e:
+                                    with out_lock:
+                                        errors.append(e)
+                                    return
+                                with out_lock:
+                                    results[i] = (rec, outcome, score)
+                                    n = len(results)
+                                self._maybe_router_swap(
+                                    router, n, swap_once
+                                )
+
+                        threads = [
+                            threading.Thread(
+                                target=worker,
+                                name=f"photon-router-load-{t}",
+                                daemon=True,
+                            )
+                            for t in range(p.concurrency)
+                        ]
+                        for t in threads:
+                            t.start()
+                        for t in threads:
+                            t.join()
+                        if errors:
+                            raise errors[0]
+                        scored = [results[i] for i in sorted(results)]
+            except KeyboardInterrupt:
+                self.interrupted = True
+                self._stop_replay.set()
+        finally:
+            self._restore_signal_handlers(prev)
+            router.close()
+            overlap.drain_io()
+        if not scored and not self.interrupted:
+            raise ValueError("empty request trace")
+        self.logger.info(
+            "routed %d request(s) in %s mode%s",
+            len(scored), p.mode,
+            " (interrupted)" if self.interrupted else "",
+        )
+        outcomes: Dict[str, int] = {}
+        for _rec, outcome, _s in scored:
+            outcomes[outcome] = outcomes.get(outcome, 0) + 1
+        if p.write_scores and scored:
+            id_types = sorted(router._indexes)
+
+            def shim(rec):
+                meta = {
+                    t: str(v) for t, v in (
+                        (t, rec.get(t) or (rec.get("metadataMap") or {})
+                         .get(t))
+                        for t in id_types
+                    ) if v is not None
+                }
+                return _RoutedRequest(
+                    uid=str(rec.get("uid") or ""),
+                    label=(
+                        record_response(rec, True)
+                        if p.has_response else None
+                    ),
+                    weight=(
+                        1.0 if rec.get("weight") is None
+                        else float(rec["weight"])
+                    ),
+                    metadata=meta or None,
+                )
+
+            with self.timer.time("write-scores"):
+                self._write_scores([
+                    (shim(rec), outcome, score)
+                    for rec, outcome, score in scored
+                ])
+        status = router.status()
+        degraded = sum(
+            1 for _r, o, s in scored
+            if o == "ok" and getattr(s, "degraded", False)
+        )
+        atomic_write_json(
+            os.path.join(p.output_dir, "metrics.json"),
+            {
+                "mode": "router",
+                "interrupted": self.interrupted,
+                "outcomes": dict(sorted(outcomes.items())),
+                "degraded_responses": degraded,
+                "generation": router.generation,
+                "routing": status,
+                "swap": self._router_swap_result,
+                "shard_servers": [
+                    f"{h}:{pt}" for h, pt in p.shard_addresses
+                ],
+                "reliability": reliability_metrics(),
+            },
+        )
+        self.results = [
+            s for _r, outcome, s in scored if outcome == "ok"
+        ]
+        self.logger.info("timers:\n%s", self.timer.summary())
+
     def _run_frontend(self, batcher) -> None:
         """Network-serving main loop: publish the bound port, serve
         until SIGTERM/SIGINT, then the drain protocol — stop accepting,
@@ -780,6 +1166,24 @@ class ServingDriver:
             )
             lineage_provider = self.registry_watcher.lineage
             rollback_handler = self.registry_watcher.rollback
+        extra_ops = None
+        status_extra = None
+        shard_block = None
+        if p.shard_mode:
+            from photon_ml_tpu.serving import make_shard_ops, shard_topology
+
+            extra_ops = make_shard_ops(
+                self.serving_model,
+                p.entity_shard,
+                swap_kwargs={
+                    "entity_pad_to": p.entity_pad_to,
+                    "model_id": p.model_id,
+                },
+            )
+            status_extra = lambda: {  # noqa: E731
+                "shard": shard_topology(self.serving_model, p.entity_shard)
+            }
+            shard_block = shard_topology(self.serving_model, p.entity_shard)
         frontend = ServingFrontend(
             batcher,
             self.serving_model,
@@ -792,6 +1196,8 @@ class ServingDriver:
             on_outcome=on_outcome,
             lineage_provider=lineage_provider,
             rollback_handler=rollback_handler,
+            extra_ops=extra_ops,
+            status_extra=status_extra,
         )
         frontend.start()
         atomic_write_json(
@@ -807,6 +1213,10 @@ class ServingDriver:
                     self.registry.root if self.registry is not None
                     else None
                 ),
+                # shard topology (null off the routing tier): how the
+                # router — and any operator — discovers the fleet
+                # layout without out-of-band config
+                "shard": shard_block,
             },
         )
         self.logger.info(
@@ -973,6 +1383,38 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="auto-rollback when (degraded+shed+errors)/window exceeds "
         "this rate",
     )
+    ap.add_argument(
+        "--shard-index", type=int, default=None,
+        help="serve ONE entity shard of the model (0-based) in "
+        "partial-score mode for the routing tier; requires "
+        "--shard-count and --frontend-port",
+    )
+    ap.add_argument(
+        "--shard-count", type=int, default=None,
+        help="total shard-servers in the fleet (the N of the "
+        "entity_code %% N ownership rule)",
+    )
+    ap.add_argument(
+        "--shard-servers", default=None,
+        help="router mode: comma-separated host:port shard-servers; "
+        "the trace replays through the scatter/gather tier instead of "
+        "a local bank (--swap-model-dir runs the two-step fleet flip)",
+    )
+    ap.add_argument(
+        "--hot-cache-entries", type=int, default=4096,
+        help="router hot-entity cache capacity (generation-keyed LRU "
+        "of partial scores; 0 disables)",
+    )
+    ap.add_argument(
+        "--router-subrequest-timeout-ms", type=float, default=2000.0,
+        help="per-shard sub-request budget for deadline-less requests",
+    )
+    ap.add_argument(
+        "--router-hedge", default="true",
+        help="hedge a slow shard once on a fresh connection inside the "
+        "remaining budget before shedding it (FE-only for its "
+        "entities)",
+    )
     return ap
 
 
@@ -1036,6 +1478,12 @@ def params_from_args(argv=None) -> ServingParams:
         rollback_window=ns.rollback_window,
         rollback_min_requests=ns.rollback_min_requests,
         rollback_max_unhealthy=ns.rollback_max_unhealthy,
+        shard_index=ns.shard_index,
+        shard_count=ns.shard_count,
+        shard_servers=ns.shard_servers,
+        hot_cache_entries=ns.hot_cache_entries,
+        router_subrequest_timeout_ms=ns.router_subrequest_timeout_ms,
+        router_hedge=truthy(ns.router_hedge),
     )
 
 

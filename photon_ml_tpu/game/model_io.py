@@ -69,6 +69,69 @@ def _write_parts(base: str, schema, records, num_files: int) -> None:
         )
 
 
+def save_loaded_game_model(loaded: "LoadedGameModel", out_dir: str) -> str:
+    """Write a host-side :class:`LoadedGameModel` back out in the
+    reference directory layout — the dataset-free publication path
+    (synthetic fleets, republication of a loaded artifact, bench/chaos
+    fixtures). Round-trips bitwise through :func:`load_game_model`:
+    coefficients are plain named floats both ways."""
+    os.makedirs(out_dir, exist_ok=True)
+
+    def _means_record(model_id, means: Dict[str, float]) -> Dict:
+        out = []
+        for key, v in means.items():
+            nm, term = split_feature_key(key)
+            out.append({"name": nm, "term": term, "value": float(v)})
+        return {
+            "modelId": model_id,
+            "modelClass": None,
+            "means": out,
+            "variances": None,
+            "lossFunction": None,
+        }
+
+    for name, (shard_id, means) in loaded.fixed_effects.items():
+        base = os.path.join(out_dir, FIXED_EFFECT, name)
+        _write_lines(os.path.join(base, ID_INFO), [shard_id])
+        write_container(
+            os.path.join(base, COEFFICIENTS, "part-00000.avro"),
+            schemas.BAYESIAN_LINEAR_MODEL_AVRO,
+            [_means_record(name, means)],
+        )
+    for name, (re_type, shard_id, per_entity) in (
+        loaded.random_effects.items()
+    ):
+        base = os.path.join(out_dir, RANDOM_EFFECT, name)
+        _write_lines(os.path.join(base, ID_INFO), [re_type, shard_id])
+        _write_parts(
+            os.path.join(base, COEFFICIENTS),
+            schemas.BAYESIAN_LINEAR_MODEL_AVRO,
+            [
+                _means_record(eid, means)
+                for eid, means in sorted(per_entity.items())
+            ],
+            1,
+        )
+    for name, (row_t, col_t, rows, cols) in (
+        loaded.matrix_factorizations.items()
+    ):
+        base = os.path.join(out_dir, MATRIX_FACTORIZATION, name)
+        _write_lines(os.path.join(base, ID_INFO), [row_t, col_t])
+        for sub, latent in (("row-latent", rows), ("col-latent", cols)):
+            write_container(
+                os.path.join(base, sub, "part-00000.avro"),
+                schemas.LATENT_FACTOR_AVRO,
+                [
+                    {
+                        "effectId": eid,
+                        "latentFactor": [float(x) for x in vec],
+                    }
+                    for eid, vec in sorted(latent.items())
+                ],
+            )
+    return out_dir
+
+
 def save_game_model(
     model: GameModel,
     dataset: GameDataset,

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from photon_ml_tpu import ownership
 from photon_ml_tpu.game.model import RandomEffectModel
 from photon_ml_tpu.game.random_effect import (
     LazyRandomEffectTracker,
@@ -80,9 +81,11 @@ __all__ = [
 
 
 def entity_shard_of(codes, num_shards: int):
-    """The one placement rule, shared by training, streaming and the
-    serving shard loader: entity code -> owning shard."""
-    return np.asarray(codes) % int(num_shards)
+    """The one placement rule, shared by training, streaming, the
+    serving shard loader AND the scatter/gather router: entity code ->
+    owning shard. Delegates to :mod:`photon_ml_tpu.ownership` so no
+    plane can drift from the others."""
+    return ownership.owner_of(np.asarray(codes), int(num_shards))
 
 
 @dataclass(frozen=True)
@@ -95,20 +98,19 @@ class EntityShardSpec:
     @property
     def rows_per_shard(self) -> int:
         """Local bank rows per shard (>= 1 so empty banks stay valid)."""
-        return -(-max(self.num_entities, 1) // self.num_shards)
+        return ownership.rows_per_shard(self.num_entities, self.num_shards)
 
     @property
     def bank_rows(self) -> int:
         return self.num_shards * self.rows_per_shard
 
     def local_of(self, codes):
-        return np.asarray(codes) // self.num_shards
+        return ownership.local_row_of(np.asarray(codes), self.num_shards)
 
     def sharded_row_of(self, codes):
         """Entity code -> row in the sharded [n * E_loc, d] layout."""
-        codes = np.asarray(codes)
-        return (codes % self.num_shards) * self.rows_per_shard + (
-            codes // self.num_shards
+        return ownership.sharded_row_of(
+            np.asarray(codes), self.num_shards, self.rows_per_shard
         )
 
 
@@ -385,9 +387,9 @@ def _build_chunk_score_program(mesh, axis: str, n_dev: int):
     def score_chunk(bank_l, codes, ix, v, valid):
         e_loc = bank_l.shape[0]
         me = lax.axis_index(ax)
-        mine = valid & (codes % n_dev == me)
+        mine = valid & (ownership.owner_of(codes, n_dev) == me)
         lrow = jnp.minimum(
-            jnp.maximum(codes, 0) // n_dev, e_loc - 1
+            ownership.local_row_of(jnp.maximum(codes, 0), n_dev), e_loc - 1
         )
         w_rows = jnp.take(bank_l, jnp.where(mine, lrow, 0), axis=0)
         s = jnp.sum(v * jnp.take_along_axis(w_rows, ix, axis=1), axis=-1)

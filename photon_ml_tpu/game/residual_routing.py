@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from photon_ml_tpu import ownership
 from photon_ml_tpu.game.random_effect_data import RandomEffectDataset
 from photon_ml_tpu.parallel.shuffle import entity_all_to_all
 
@@ -179,7 +180,9 @@ class PodResidualRouter:
         self.num_rows_padded = n_pad
         per_src = n_pad // n_dev
         owner = np.full(n_pad, -1, np.int64)
-        owner[:n] = np.where(codes >= 0, codes % n_dev, -1)
+        owner[:n] = np.where(
+            codes >= 0, ownership.owner_of(codes, n_dev), -1
+        )
 
         # rank of each row among same-owner rows WITHIN its source shard
         # (the row-sharded block it lives in), plus the exact capacity —

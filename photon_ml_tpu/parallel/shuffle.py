@@ -29,6 +29,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from photon_ml_tpu import ownership
 from photon_ml_tpu.parallel.mesh import DATA_AXIS
 
 Array = jnp.ndarray
@@ -81,7 +82,11 @@ def entity_all_to_all(
     )
     def reshard(codes, data):
         n_loc = codes.shape[0]
-        owner = jnp.where(codes >= 0, codes % n_dev, n_dev)  # pad -> n_dev
+        # pad rows -> pseudo-owner n_dev (the trash slot); real rows go
+        # to the shared ownership rule's shard
+        owner = jnp.where(
+            codes >= 0, ownership.owner_of(codes, n_dev), n_dev
+        )
         # Slot of each row within its (this-device -> owner) send buffer:
         # rank among same-owner rows, computed via a stable sort.
         order = jnp.argsort(owner)  # pads sort last
@@ -150,7 +155,9 @@ def reshard_capacity(
         local = codes[s * per_src : (s + 1) * per_src]
         local = local[local >= 0]
         if local.size:
-            counts = np.bincount(local % n_devices, minlength=n_devices)
+            counts = np.bincount(
+                ownership.owner_of(local, n_devices), minlength=n_devices
+            )
             worst = max(worst, int(counts.max()))
     cap = int(np.ceil(worst * slack))
     return max(((cap + 7) // 8) * 8, 8)

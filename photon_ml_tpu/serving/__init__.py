@@ -18,7 +18,13 @@ Seven pieces, composed by ``cli/serving_driver.py``:
 - :mod:`photon_ml_tpu.serving.swap` — zero-copy hot swap of model
   generations with quarantine + rollback on poisoned artifacts;
 - :mod:`photon_ml_tpu.serving.metrics` — p50/p99 latency, QPS,
-  occupancy, shed/deadline/degraded/drain accounting for metrics.json.
+  occupancy, shed/deadline/degraded/drain accounting for metrics.json;
+- :mod:`photon_ml_tpu.serving.shard_server` — the same stack serving
+  ONE entity shard in partial-score mode, plus the router's control
+  plane (topology discovery, two-step generation flip);
+- :mod:`photon_ml_tpu.serving.routing` — the scatter/gather tier in
+  front of a shard-server fleet: ownership-ruled fan-out, bitwise
+  f32 recomposition, per-shard degradation, the hot-entity cache.
 """
 
 from photon_ml_tpu.serving.admission import (  # noqa: F401
@@ -26,6 +32,8 @@ from photon_ml_tpu.serving.admission import (  # noqa: F401
     BatcherClosed,
     DeadlineExceeded,
     DrainTimeout,
+    NoShardAvailable,
+    PartialScore,
     RequestShed,
     ScoreOutcome,
     ServingError,
@@ -51,6 +59,20 @@ from photon_ml_tpu.serving.programs import (  # noqa: F401
     RequestBatch,
     ServingPrograms,
     select_shape,
+)
+from photon_ml_tpu.serving.routing import (  # noqa: F401
+    HotEntityCache,
+    RoutedScore,
+    RouterMetrics,
+    RoutingPolicy,
+    ShardHealth,
+    ShardRouter,
+    TcpShardTransport,
+)
+from photon_ml_tpu.serving.shard_server import (  # noqa: F401
+    ShardServer,
+    make_shard_ops,
+    shard_topology,
 )
 from photon_ml_tpu.serving.swap import (  # noqa: F401
     ServingModel,

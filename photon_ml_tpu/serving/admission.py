@@ -39,7 +39,9 @@ __all__ = [
     "DeadlineExceeded",
     "DrainTimeout",
     "BatcherClosed",
+    "NoShardAvailable",
     "ScoreOutcome",
+    "PartialScore",
     "AdmissionController",
 ]
 
@@ -93,6 +95,15 @@ class BatcherClosed(ServingError):
     code = "CLOSED"
 
 
+class NoShardAvailable(ServingError):
+    """The routing tier could not reach ANY healthy shard-server for
+    the fixed-effect half of a request — degradation needs at least one
+    live shard to compute the FE score, so this is the router's only
+    hard failure (one dead shard degrades, ALL dead shards refuse)."""
+
+    code = "NO_SHARD"
+
+
 class ScoreOutcome(float):
     """A score that is still a ``float`` (bitwise comparisons, numpy
     coercion and the existing parity tests all work unchanged) but
@@ -117,6 +128,46 @@ class ScoreOutcome(float):
     def __repr__(self) -> str:  # float repr + the annotations
         return (
             f"ScoreOutcome({float(self)!r}, degraded={self.degraded}, "
+            f"generation={self.generation})"
+        )
+
+
+class PartialScore:
+    """One shard-server's half of a routed score: the fixed-effect
+    accumulation (every shard holds the full FE banks, so any shard can
+    produce it — bitwise identical across shards) plus this shard's
+    per-coordinate random-effect/MF terms, each an IEEE float32 the
+    router re-sums in spec order. ``terms`` maps coordinate NAME ->
+    term value; a coordinate whose entity this shard does not own (or
+    the model does not know) contributes exactly ``0.0`` — the same
+    zero the single-server program adds, which is what makes the
+    routed recomposition bitwise-equal to the unrouted path.
+
+    Immutable value object; the shard-mode batcher resolves futures
+    with these instead of :class:`ScoreOutcome`.
+    """
+
+    __slots__ = ("fe", "terms", "offset", "degraded", "generation")
+
+    def __init__(
+        self,
+        fe: float,
+        terms,
+        *,
+        offset: float = 0.0,
+        degraded: bool = False,
+        generation: int = 0,
+    ):
+        self.fe = float(fe)
+        self.terms = dict(terms)
+        self.offset = float(offset)
+        self.degraded = bool(degraded)
+        self.generation = int(generation)
+
+    def __repr__(self) -> str:
+        return (
+            f"PartialScore(fe={self.fe!r}, terms={self.terms!r}, "
+            f"offset={self.offset!r}, degraded={self.degraded}, "
             f"generation={self.generation})"
         )
 

@@ -57,6 +57,7 @@ from photon_ml_tpu.serving.admission import (
     BatcherClosed,
     DeadlineExceeded,
     DrainTimeout,
+    PartialScore,
     RequestShed,
     ScoreOutcome,
 )
@@ -65,6 +66,7 @@ from photon_ml_tpu.serving.programs import (
     RequestBatch,
     ServingPrograms,
     select_shape,
+    term_entries,
 )
 
 __all__ = [
@@ -315,6 +317,7 @@ class MicroBatcher:
         admission: Optional[AdmissionController] = None,
         default_deadline_ms: Optional[float] = None,
         max_submit_wait_s: float = DEFAULT_SUBMIT_WAIT_S,
+        partial: Optional[bool] = None,
     ):
         self._bank_ref = bank_ref
         self._programs = programs
@@ -327,6 +330,16 @@ class MicroBatcher:
             swap_lock
             if swap_lock is not None
             else getattr(owner, "dispatch_lock", None)
+        )
+        # shard-server mode: dispatch the scatter/gather partial
+        # program (fe + per-coordinate terms) and resolve futures with
+        # PartialScore instead of ScoreOutcome. Like the swap lock, the
+        # mode is inferred from a bound ServingModel so the safe wiring
+        # is the default wiring.
+        self._partial = (
+            bool(partial)
+            if partial is not None
+            else bool(getattr(owner, "partial", False))
         )
         self._max_wait_s = float(max_wait_s)
         self._max_queue = int(max_queue)
@@ -692,7 +705,12 @@ class MicroBatcher:
                 bank = self._bank_ref()
                 B = select_shape(len(requests), self._programs.ladder)
                 batch, degraded = self._assemble(requests, bank, B)
-                scores_dev = self._programs.score(bank, batch)
+                if self._partial:
+                    # fe + terms fetched as ONE batched transfer — the
+                    # readback budget is unchanged in shard mode
+                    scores_dev = self._programs.score_partial(bank, batch)
+                else:
+                    scores_dev = self._programs.score(bank, batch)
                 # the ONE counted device->host transfer for this batch
                 scores = overlap.device_get(scores_dev)
             return bank, B, degraded, scores
@@ -708,13 +726,27 @@ class MicroBatcher:
         t1 = time.perf_counter()
         self._admission.note_dispatch(rows=len(requests), busy_s=t1 - t0)
         n_degraded = 0
+        if self._partial:
+            fe, terms = scores
+            names = [e[1] for e in term_entries(bank.spec)]
         for i, (req, fut) in enumerate(take):
             deg = bool(degraded[i])
             n_degraded += int(deg)
-            _resolve(fut, result=ScoreOutcome(
-                float(scores[i]), degraded=deg,
-                generation=bank.generation,
-            ))
+            if self._partial:
+                # float(np.float32) is the exact f64 of the f32 bits;
+                # the router coerces back to f32 losslessly
+                _resolve(fut, result=PartialScore(
+                    float(fe[i]),
+                    {n: float(terms[i, j]) for j, n in enumerate(names)},
+                    offset=req.offset,
+                    degraded=deg,
+                    generation=bank.generation,
+                ))
+            else:
+                _resolve(fut, result=ScoreOutcome(
+                    float(scores[i]), degraded=deg,
+                    generation=bank.generation,
+                ))
         if self._metrics is not None:
             if n_degraded:
                 self._metrics.record_degraded(n_degraded)

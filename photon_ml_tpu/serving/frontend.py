@@ -62,6 +62,7 @@ from typing import Callable, Dict, List, Optional
 from photon_ml_tpu.serving.admission import (
     DeadlineExceeded,
     DrainTimeout,
+    PartialScore,
     RequestShed,
     ServingError,
 )
@@ -96,6 +97,19 @@ def _error_response(uid, code: str, message: str) -> Dict[str, object]:
 
 
 def _outcome_response(uid, outcome) -> Dict[str, object]:
+    if isinstance(outcome, PartialScore):
+        # shard-server mode: the scatter/gather half-score. Floats ride
+        # JSON as shortest-round-trip doubles holding exact f32 values,
+        # so the router's recomposition is bitwise.
+        return {
+            "uid": uid,
+            "status": "ok",
+            "partial": True,
+            "fe": outcome.fe,
+            "terms": dict(outcome.terms),
+            "generation": outcome.generation,
+            "degraded": outcome.degraded,
+        }
     return {
         "uid": uid,
         "status": "ok",
@@ -295,6 +309,16 @@ class _Connection:
                     "rolled_back": ok,
                     "generation": self.fe.serving_model.generation,
                 })
+            elif str(op) in self.fe.extra_ops:
+                # extension ops (shard topology / two-step swap):
+                # handler failures become named responses, never a
+                # dropped line or a dead connection
+                try:
+                    resp = self.fe.extra_ops[str(op)](obj)
+                except Exception as e:
+                    resp = _error_response(obj.get("uid"), "INTERNAL",
+                                           str(e))
+                self.send(resp)
             else:
                 self.send(_error_response(
                     obj.get("uid"), "BAD_REQUEST", f"unknown op {op!r}"
@@ -338,6 +362,8 @@ class ServingFrontend:
         on_outcome: Optional[Callable[[bool, bool, bool], None]] = None,
         lineage_provider: Optional[Callable[[], Dict]] = None,
         rollback_handler: Optional[Callable[[], bool]] = None,
+        extra_ops: Optional[Dict[str, Callable[[Dict], Dict]]] = None,
+        status_extra: Optional[Callable[[], Dict]] = None,
     ):
         self.batcher = batcher
         self.serving_model = serving_model
@@ -354,6 +380,12 @@ class ServingFrontend:
         self.on_outcome = on_outcome
         self.lineage_provider = lineage_provider
         self.rollback_handler = rollback_handler
+        # extension seam (serving/shard_server.py): extra control ops
+        # (op name -> handler(request dict) -> response dict, which MUST
+        # echo the request's uid for routed demux) and an extra block
+        # merged into every status payload (shard topology)
+        self.extra_ops = dict(extra_ops or {})
+        self.status_extra = status_extra
         self._completed = 0
         self._completed_lock = threading.Lock()
         self._conns: List[_Connection] = []
@@ -471,6 +503,11 @@ class ServingFrontend:
             except Exception as e:
                 # status must answer even when the watcher is wedged
                 out["registry"] = {"error": str(e)}
+        if self.status_extra is not None:
+            try:
+                out.update(self.status_extra())
+            except Exception as e:
+                out["status_extra_error"] = str(e)
         return out
 
     # -- internals -----------------------------------------------------------

@@ -39,6 +39,8 @@ from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 import numpy as np
 import jax.numpy as jnp
 
+from photon_ml_tpu import ownership
+
 __all__ = [
     "EntityRowIndex",
     "ModelBank",
@@ -67,24 +69,12 @@ def _native_threshold(explicit: Optional[int]) -> int:
     return int(env) if env else NATIVE_INDEX_THRESHOLD
 
 
-def shard_entity_ids(
-    ids: Sequence[str], entity_shard: Optional[Tuple[int, int]]
-) -> List[str]:
-    """One entity SHARD of a sorted entity-id list, by the pod hash rule
-    (game/pod.py): an entity's code is its position in the model's
-    sorted order and its owner is ``code % num_shards`` — identical to
-    the training-side bank placement, so a server loading shard ``s``
-    of a pod-trained model holds exactly the rows device ``s`` trained.
-    ``entity_shard`` is ``(shard_index, num_shards)`` or None (all)."""
-    if entity_shard is None:
-        return list(ids)
-    s, n = entity_shard
-    if not (isinstance(n, int) and n >= 1 and 0 <= s < n):
-        raise ValueError(
-            f"entity_shard must be (shard, num_shards) with "
-            f"0 <= shard < num_shards, got {entity_shard!r}"
-        )
-    return [x for i, x in enumerate(ids) if i % n == s]
+# One entity SHARD of a sorted entity-id list, by the shared ownership
+# rule (photon_ml_tpu/ownership.py — the same placement game/pod.py
+# trains with, so a server loading shard s of a pod-trained model holds
+# exactly the rows device s trained). Re-exported here because the
+# serving loaders are where callers historically found it.
+shard_entity_ids = ownership.shard_entity_ids
 
 
 class EntityRowIndex:
@@ -472,10 +462,8 @@ def bank_from_arrays(
                 f"bank rows {bank.shape[0]} != entity ids {len(ids)}"
             )
         if entity_shard is not None:
-            keep = [
-                i for i in range(len(ids))
-                if i % entity_shard[1] == entity_shard[0]
-            ]
+            s, n_sh = ownership.validate_entity_shard(entity_shard)
+            keep = list(ownership.owned_positions(len(ids), s, n_sh))
             ids = shard_entity_ids(ids, entity_shard)
             bank = bank[keep]
         e_pad = max(_round_up(max(len(ids), 1), entity_pad_to), entity_pad_to)

@@ -36,6 +36,7 @@ __all__ = [
     "ServingPrograms",
     "DEFAULT_LADDER",
     "select_shape",
+    "term_entries",
 ]
 
 # Padded micro-batch shapes, smallest to largest. 1 serves the idle
@@ -106,7 +107,79 @@ def _score_spec(spec, arrays, batch: RequestBatch):
     return total + batch.offsets
 
 
+def term_entries(spec):
+    """The ordered (kind, name, id_types, feature_shard) of every
+    per-entity spec entry — the coordinate slots a
+    :class:`~.admission.PartialScore` carries and the routing tier
+    re-sums. MF entries list both id types and no feature shard (their
+    term is a latent dot product). Order IS the contract: the router
+    adds terms in exactly this sequence, which is the full program's
+    accumulation order."""
+    out = []
+    for entry in spec:
+        if entry[0] == "re":
+            out.append(("re", entry[1], (entry[2],), entry[3]))
+        elif entry[0] == "mf":
+            out.append(("mf", entry[1], (entry[2], entry[3]), None))
+    return tuple(out)
+
+
+def _score_spec_partial(spec, arrays, batch: RequestBatch):
+    """The scatter/gather decomposition of :func:`_score_spec`: the
+    fixed-effect accumulation (identical chain of f32 adds as the full
+    program's FE prefix — every shard holds the full FE banks) and one
+    column per re/mf entry with that coordinate's term (0.0 where the
+    entity code is -1, exactly the zero the full program adds). The
+    router recomposes ``((fe + t_1) + t_2)… + offset`` host-side in
+    float32 — each step exactly-rounded IEEE, so the routed margin is
+    bitwise the single-server one. Offsets are NOT added here: the
+    router owns them (it has the request; sub-requests may fan out to
+    several shards and the offset must be applied exactly once)."""
+    fe = jnp.zeros(batch.offsets.shape, jnp.float32)
+    terms = []
+    for entry in spec:
+        kind, name = entry[0], entry[1]
+        if kind == "fe":
+            shard_id = entry[2]
+            w = arrays[name]
+            fe = fe + jnp.sum(
+                batch.values[shard_id]
+                * jnp.take(w, batch.indices[shard_id], axis=0),
+                axis=-1,
+            )
+        elif kind == "re":
+            re_type, shard_id = entry[2], entry[3]
+            bank = arrays[name]
+            codes = batch.codes[re_type]
+            valid = codes >= 0
+            w_rows = jnp.take(bank, jnp.maximum(codes, 0), axis=0)
+            score = jnp.sum(
+                batch.values[shard_id]
+                * jnp.take_along_axis(
+                    w_rows, batch.indices[shard_id], axis=1
+                ),
+                axis=-1,
+            )
+            terms.append(jnp.where(valid, score, 0.0))
+        else:  # mf
+            row_t, col_t = entry[2], entry[3]
+            R, C = arrays[name]
+            rows = batch.codes[row_t]
+            cols = batch.codes[col_t]
+            valid = (rows >= 0) & (cols >= 0)
+            r = jnp.take(R, jnp.maximum(rows, 0), axis=0)
+            c = jnp.take(C, jnp.maximum(cols, 0), axis=0)
+            terms.append(jnp.where(valid, jnp.sum(r * c, axis=-1), 0.0))
+    stacked = (
+        jnp.stack(terms, axis=1)
+        if terms
+        else jnp.zeros(batch.offsets.shape + (0,), jnp.float32)
+    )
+    return fe, stacked
+
+
 _score_jit = jax.jit(_score_spec, static_argnums=(0,))
+_score_partial_jit = jax.jit(_score_spec_partial, static_argnums=(0,))
 
 
 def _batch_structs(spec, B: int) -> RequestBatch:
@@ -176,12 +249,16 @@ class ServingPrograms:
             self._cache[key] = self._cache.pop(key)
         return exe
 
-    def _get_or_compile(self, spec, arrays, B: int):
+    def _get_or_compile(self, spec, arrays, B: int, *,
+                        partial: bool = False):
         """Returns ``(executable, freshly_compiled)``. Exactly one
-        thread lowers a given (spec, B); losers of the race wait on the
-        winner's event and take the cached result. If the winner's
-        compile raises, waiters retry (and may compile themselves)."""
-        key = (spec, B)
+        thread lowers a given (spec, B, mode); losers of the race wait
+        on the winner's event and take the cached result. If the
+        winner's compile raises, waiters retry (and may compile
+        themselves). ``partial`` selects the scatter/gather program
+        (fe + per-coordinate terms) over the full-margin one — the two
+        families share the LRU, keyed apart."""
+        key = (spec, B, bool(partial))
         while True:
             with self._lock:
                 exe = self._lru_get(key)
@@ -198,7 +275,8 @@ class ServingPrograms:
             while not ev.wait(timeout=0.1):
                 continue
         try:
-            exe = _score_jit.lower(
+            jitted = _score_partial_jit if partial else _score_jit
+            exe = jitted.lower(
                 spec, _array_structs(arrays), _batch_structs(spec, B)
             ).compile()
             with self._lock:
@@ -212,19 +290,24 @@ class ServingPrograms:
                 self._inflight.pop(key, None)
             ev.set()
 
-    def ensure_compiled(self, bank: ModelBank) -> int:
+    def ensure_compiled(self, bank: ModelBank, *,
+                        partial: bool = False) -> int:
         """AOT-compile every ladder shape for this bank's signature;
         returns how many programs were newly compiled (0 when the spec
-        was already warm — the swap-without-recompile case)."""
+        was already warm — the swap-without-recompile case).
+        ``partial`` warms the shard-server program family instead of
+        the full-margin one."""
         fresh = 0
         for B in self.ladder:
-            _, new = self._get_or_compile(bank.spec, bank.arrays, B)
+            _, new = self._get_or_compile(
+                bank.spec, bank.arrays, B, partial=partial
+            )
             fresh += int(new)
         return fresh
 
-    def executable(self, spec, B: int):
+    def executable(self, spec, B: int, *, partial: bool = False):
         with self._lock:
-            return self._lru_get((spec, B))
+            return self._lru_get((spec, B, bool(partial)))
 
     def score(self, bank: ModelBank, batch: RequestBatch) -> jnp.ndarray:
         """Device scores for one padded batch (no readback here — the
@@ -238,6 +321,21 @@ class ServingPrograms:
             with self._lock:
                 self.cold_dispatch_compiles += 1
             exe, _ = self._get_or_compile(bank.spec, bank.arrays, B)
+        return exe(bank.arrays, batch)
+
+    def score_partial(self, bank: ModelBank, batch: RequestBatch):
+        """Device (fe[B], terms[B, R]) for one padded batch — the
+        shard-server half of a routed score. Same zero-recompile
+        contract as :meth:`score` (shard servers warm this family at
+        load/swap-stage time); no readback here either."""
+        B = batch.offsets.shape[0]
+        exe = self.executable(bank.spec, B, partial=True)
+        if exe is None:
+            with self._lock:
+                self.cold_dispatch_compiles += 1
+            exe, _ = self._get_or_compile(
+                bank.spec, bank.arrays, B, partial=True
+            )
         return exe(bank.arrays, batch)
 
     def stats(self) -> Dict[str, int]:

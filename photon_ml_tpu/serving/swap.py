@@ -135,7 +135,20 @@ class ServingModel:
         self,
         bank: ModelBank,
         programs: Optional[ServingPrograms] = None,
+        *,
+        partial: bool = False,
+        entity_shard=None,
     ):
+        from photon_ml_tpu import ownership
+
+        # partial=True is SHARD-SERVER mode: the AOT ladder holds the
+        # scatter/gather program family (fe + per-coordinate terms)
+        # instead of full margins, and entity_shard=(s, n) makes every
+        # staged generation load the same 1/n slice of the entity axis
+        # this server owns (the shared ownership rule) — a swap can
+        # never change which rows this host answers for.
+        self.partial = bool(partial)
+        self.entity_shard = ownership.validate_entity_shard(entity_shard)
         self._lock = threading.Lock()
         # Serializes whole stage/flip protocols. Swaps arrive from more
         # than one thread (registry watcher promote, operator rollback
@@ -149,8 +162,12 @@ class ServingModel:
         self._stage_lock = threading.Lock()
         self._bank = bank
         self.programs = programs or ServingPrograms()
-        self.programs.ensure_compiled(bank)
+        self.programs.ensure_compiled(bank, partial=self.partial)
         self.swap_history = []
+        # a bank staged by prepare_swap, waiting for commit_prepared
+        # (the router-coordinated two-step flip); read/written only
+        # under _stage_lock
+        self._prepared: Optional[ModelBank] = None
         # Mutual exclusion between a DONATING flip and an in-flight
         # dispatch: donation invalidates generation N's device buffers,
         # so the refresh must not run while a dispatch is executing
@@ -181,7 +198,8 @@ class ServingModel:
         if bank.retired:
             return False
         return all(
-            self.programs.executable(bank.spec, B) is not None
+            self.programs.executable(bank.spec, B, partial=self.partial)
+            is not None
             for B in self.programs.ladder
         )
 
@@ -204,6 +222,8 @@ class ServingModel:
         entity_pad_to: int = DEFAULT_ENTITY_PAD,
         native_index_threshold: Optional[int] = None,
         model_id: str = "",
+        partial: bool = False,
+        entity_shard=None,
     ) -> "ServingModel":
         """Initial load: the artifact read runs behind the
         ``serving.model_load`` seam (transient IO errors retry on the
@@ -218,11 +238,14 @@ class ServingModel:
             entity_pad_to=entity_pad_to,
             native_index_threshold=native_index_threshold,
             model_id=model_id,
+            entity_shard=entity_shard,
         )
         programs = (
             ServingPrograms(ladder) if ladder is not None else None
         )
-        return cls(bank, programs)
+        return cls(
+            bank, programs, partial=partial, entity_shard=entity_shard
+        )
 
     def stage_and_swap(
         self,
@@ -282,6 +305,7 @@ class ServingModel:
                 native_index_threshold=native_index_threshold,
                 device=False,  # host arrays: placement happens below
                 model_id=model_id,
+                entity_shard=self.entity_shard,
             )
             return self._flip(staged)
 
@@ -292,6 +316,129 @@ class ServingModel:
             prev = self.current()
             staged.generation = prev.generation + 1
             return self._flip(staged)
+
+    # -- two-step flip (router-coordinated swaps) ---------------------------
+
+    def prepare_swap(
+        self,
+        model_dir: str,
+        *,
+        entity_pad_to: int = DEFAULT_ENTITY_PAD,
+        native_index_threshold: Optional[int] = None,
+        model_id: str = "",
+    ) -> SwapResult:
+        """Step 1 of the router-coordinated two-step flip: load + build
+        the next generation's bank and warm its programs, but DO NOT
+        serve it. The routing tier stages on every shard-server first
+        and only commits once ALL of them staged OK — so a fleet can
+        never serve a mixed-generation gather because one shard's
+        artifact was corrupt. A failed stage quarantines/rolls back
+        exactly like :meth:`stage_and_swap`; a successful one parks the
+        bank for :meth:`commit_prepared` (re-preparing replaces it)."""
+        from photon_ml_tpu.reliability import (
+            InjectedCorruption,
+            SeamFailure,
+            io_call,
+        )
+        from photon_ml_tpu.reliability.retry import quarantine_artifact
+
+        with self._stage_lock:
+            prev = self.current()
+            try:
+                loaded = io_call(
+                    SEAM, _load_model, model_dir, detail=model_dir
+                )
+            except (InjectedCorruption, ValueError) as e:
+                q = quarantine_artifact(model_dir, SEAM)
+                result = SwapResult(
+                    ok=False,
+                    generation=prev.generation,
+                    rolled_back=True,
+                    quarantined=q,
+                    error=str(e),
+                )
+                self.swap_history.append(result)
+                return result
+            except SeamFailure as e:
+                result = SwapResult(
+                    ok=False,
+                    generation=prev.generation,
+                    rolled_back=True,
+                    error=str(e),
+                )
+                self.swap_history.append(result)
+                return result
+            staged = build_model_bank(
+                loaded,
+                index_maps=prev.index_maps,
+                shard_widths=prev.shard_widths,
+                generation=prev.generation + 1,
+                entity_pad_to=entity_pad_to,
+                native_index_threshold=native_index_threshold,
+                device=False,
+                model_id=model_id,
+                entity_shard=self.entity_shard,
+            )
+            return self._park_prepared(staged)
+
+    def prepare_swap_bank(self, staged: ModelBank) -> SwapResult:
+        """Step 1 over an already-built host bank (in-memory publication
+        / synthetic fleets)."""
+        with self._stage_lock:
+            return self._park_prepared(staged)
+
+    def _park_prepared(self, staged: ModelBank) -> SwapResult:  # photon: guarded-by(_stage_lock)
+        # ALL the slow work happens now, while the previous generation
+        # keeps serving: program warmup for the staged spec, and (on
+        # the donating path) the refresh executable's own compile. The
+        # later commit is the same sub-ms flip stage_and_swap performs.
+        prev = self.current()
+        staged.generation = prev.generation + 1
+        # device placement happens NOW too (idempotent for _flip's own
+        # pass): commit must be the sub-ms flip, not a host->device copy
+        staged.arrays = place_on_device(staged.arrays)
+        recompiled = self.programs.ensure_compiled(
+            staged, partial=self.partial
+        )
+        if staged.spec == prev.spec:
+            _refresh_executable(staged.arrays)
+        self._prepared = staged
+        return SwapResult(
+            ok=True,
+            generation=staged.generation,
+            donated=staged.spec == prev.spec,
+            recompiled_programs=recompiled,
+        )
+
+    def commit_prepared(self) -> SwapResult:
+        """Step 2: flip to the bank :meth:`prepare_swap` parked. With
+        nothing prepared (or after :meth:`abort_prepared`) this is a
+        named failure, never a silent no-op — the router treats it as
+        that shard refusing the flip."""
+        with self._stage_lock:
+            staged = self._prepared
+            self._prepared = None
+            prev = self.current()
+            if staged is None:
+                result = SwapResult(
+                    ok=False,
+                    generation=prev.generation,
+                    error="no prepared generation to commit",
+                )
+                self.swap_history.append(result)
+                return result
+            # re-number against the CURRENT generation: another swap
+            # may have landed between prepare and commit
+            staged.generation = prev.generation + 1
+            return self._flip(staged)
+
+    def abort_prepared(self) -> bool:
+        """Drop a parked generation (router abort after a peer shard
+        failed its stage). Returns whether anything was parked."""
+        with self._stage_lock:
+            had = self._prepared is not None
+            self._prepared = None
+            return had
 
     def _flip(self, staged: ModelBank) -> SwapResult:  # photon: guarded-by(_stage_lock)
         prev = self.current()
@@ -306,7 +453,9 @@ class ServingModel:
             # refresh call + reference flip run under dispatch_lock —
             # exclusive with dispatch, because a batch mid-execution
             # must not have its bank donated out from under it.
-            recompiled = self.programs.ensure_compiled(staged)
+            recompiled = self.programs.ensure_compiled(
+                staged, partial=self.partial
+            )
             staged.arrays = place_on_device(staged.arrays)
             refresh = _refresh_executable(staged.arrays)
             with self.dispatch_lock:
@@ -320,7 +469,9 @@ class ServingModel:
             # Every ladder shape compiles BEFORE the flip: a swap can
             # slow staging, never the first post-swap request.
             staged.arrays = place_on_device(staged.arrays)
-            recompiled = self.programs.ensure_compiled(staged)
+            recompiled = self.programs.ensure_compiled(
+                staged, partial=self.partial
+            )
             with self._lock:
                 self._bank = staged
                 prev.retired = True

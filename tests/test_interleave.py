@@ -488,11 +488,11 @@ class _FakePrograms:
     def __init__(self, sched):
         self.sched = sched
 
-    def ensure_compiled(self, bank):
+    def ensure_compiled(self, bank, partial=False):
         time.sleep(0.1)  # warmup takes (virtual) time
         return 0
 
-    def executable(self, spec, B):
+    def executable(self, spec, B, partial=False):
         return object()
 
 

@@ -1,0 +1,111 @@
+"""The shared entity-ownership rule (photon_ml_tpu/ownership.py):
+property tests pinning that every plane that places entities on shards
+— pod training placement, the in-jit shuffle owner computation, the
+serving shard loader, and the routing tier — agrees for random ids.
+A disagreement between any two of these would silently serve (or
+train) a coefficient on the wrong host, so the agreement IS the
+contract, not an implementation detail.
+"""
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import ownership
+from photon_ml_tpu.game.pod import EntityShardSpec, entity_shard_of
+from photon_ml_tpu.serving.model_bank import shard_entity_ids
+
+SHARD_COUNTS = (1, 2, 3, 4, 8)
+
+
+@pytest.fixture
+def codes(rng):
+    return rng.integers(0, 10_000, size=512).astype(np.int64)
+
+
+class TestRule:
+    def test_owner_and_local_row_roundtrip(self, codes):
+        for n in SHARD_COUNTS:
+            owner = ownership.owner_of(codes, n)
+            local = ownership.local_row_of(codes, n)
+            assert np.all((owner >= 0) & (owner < n))
+            assert np.array_equal(owner * 1 + 0, codes % n)
+            # (owner, local) uniquely reconstructs the code
+            assert np.array_equal(local * n + owner, codes)
+
+    def test_scalar_and_array_agree(self, codes):
+        for n in SHARD_COUNTS:
+            arr = ownership.owner_of(codes, n)
+            for i in (0, 17, 101):
+                assert int(arr[i]) == ownership.owner_of(int(codes[i]), n)
+
+    def test_validate_entity_shard(self):
+        assert ownership.validate_entity_shard(None) is None
+        assert ownership.validate_entity_shard((2, 4)) == (2, 4)
+        for bad in ((4, 4), (-1, 4), (0, 0), (1, -2)):
+            with pytest.raises(ValueError, match="entity_shard"):
+                ownership.validate_entity_shard(bad)
+
+
+class TestCallSitesAgree:
+    def test_pod_placement_matches_ownership(self, codes):
+        """game/pod.py's entity_shard_of IS the shared rule."""
+        for n in SHARD_COUNTS:
+            assert np.array_equal(
+                entity_shard_of(codes, n), ownership.owner_of(codes, n)
+            )
+
+    def test_pod_sharded_row_matches_ownership(self, codes):
+        for n in SHARD_COUNTS:
+            spec = EntityShardSpec(
+                num_shards=n, num_entities=int(codes.max()) + 1
+            )
+            assert np.array_equal(
+                spec.sharded_row_of(codes),
+                ownership.sharded_row_of(codes, n, spec.rows_per_shard),
+            )
+            assert np.array_equal(
+                spec.local_of(codes), ownership.local_row_of(codes, n)
+            )
+
+    def test_shuffle_owner_matches_ownership(self, codes):
+        """parallel/shuffle routes a row to the device the shared rule
+        names (jnp path, traced the way entity_all_to_all computes it)."""
+        import jax.numpy as jnp
+
+        for n in SHARD_COUNTS:
+            jcodes = jnp.asarray(codes)
+            owner = jnp.where(
+                jcodes >= 0, ownership.owner_of(jcodes, n), n
+            )
+            assert np.array_equal(
+                np.asarray(owner), ownership.owner_of(codes, n)
+            )
+
+    def test_serving_shard_split_matches_pod_placement(self, rng):
+        """The serving loader's id-list split selects EXACTLY the ids
+        whose code (sorted position) the pod rule assigns to that
+        shard — for random id universes and every shard count."""
+        n_ids = int(rng.integers(1, 400))
+        ids = sorted({f"e{int(x)}" for x in rng.integers(0, 10**6, n_ids)})
+        positions = np.arange(len(ids), dtype=np.int64)
+        for n in SHARD_COUNTS:
+            owners = entity_shard_of(positions, n)
+            for s in range(n):
+                expect = [ids[i] for i in np.nonzero(owners == s)[0]]
+                assert shard_entity_ids(ids, (s, n)) == expect
+            # the shards partition the universe: nothing lost, nothing
+            # duplicated
+            union = [
+                x for s in range(n) for x in shard_entity_ids(ids, (s, n))
+            ]
+            assert sorted(union) == ids
+
+    def test_owned_positions_partition(self):
+        for total in (0, 1, 7, 256):
+            for n in SHARD_COUNTS:
+                seen = sorted(
+                    p
+                    for s in range(n)
+                    for p in ownership.owned_positions(total, s, n)
+                )
+                assert seen == list(range(total))

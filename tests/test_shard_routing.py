@@ -1,0 +1,1176 @@
+"""Planet-scale serving tests (ISSUE 12): the scatter/gather routing
+tier over entity-sharded shard-server fleets.
+
+The acceptance bar: routed scores are BITWISE equal to the
+single-server serving path and the batch scorer at N in {1, 2, 4}
+shards — including across a router-coordinated two-step generation
+flip — while a dead/stalled shard degrades its OWN entities to the
+FE-only score (bitwise) instead of failing anything, and the
+generation-keyed hot-entity cache serves zipf head traffic bitwise and
+never across generations. The interleaving schedule families drive the
+router fan-out/cache/swap plane deterministically: every call terminal,
+zero deadlocks, no cross-generation score ever emitted.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu import ownership
+from photon_ml_tpu.serving import (
+    MicroBatcher,
+    NoShardAvailable,
+    RoutingPolicy,
+    ServingModel,
+    ServingPrograms,
+    ShardRouter,
+    ShardServer,
+    bank_from_arrays,
+    request_from_record,
+    requests_from_dataset,
+)
+from photon_ml_tpu.serving.routing import (
+    FE_SLOT,
+    HotEntityCache,
+    TransportError,
+)
+from photon_ml_tpu.game.data import build_game_dataset
+from photon_ml_tpu.game.model_io import LoadedGameModel
+from tests.test_serving import (
+    SHARDS,
+    _wait_until,
+    batch_reference_scores,
+    make_bank,
+    synth_model,
+    synth_records,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LADDER = (1, 8)
+
+
+def user_ids(lm):
+    """The model's sorted entity universe — the router's index input."""
+    return sorted(lm.random_effects["per-user"][2])
+
+
+def build_fleet(lm, ds, n_shards, *, stager_for=None):
+    """N in-process shard-servers over real sockets, each loading ONE
+    entity shard of the model through the artifact-path bank builder."""
+    servers = []
+    for s in range(n_shards):
+        bank = make_bank(lm, ds, entity_shard=(s, n_shards))
+        sm = ServingModel(
+            bank,
+            ServingPrograms(LADDER),
+            partial=True,
+            entity_shard=(s, n_shards),
+        )
+        servers.append(
+            ShardServer(
+                sm,
+                SHARDS,
+                (s, n_shards),
+                stager=stager_for(s, sm) if stager_for else None,
+            ).start()
+        )
+    return servers
+
+
+def build_router(servers, lm, **kw):
+    kw.setdefault("shard_configs", SHARDS)
+    router = ShardRouter(
+        [("127.0.0.1", srv.port) for srv in servers],
+        entity_ids={"userId": user_ids(lm)},
+        **kw,
+    )
+    router.connect()
+    return router
+
+
+def close_fleet(servers, router=None):
+    if router is not None:
+        router.close()
+    for srv in servers:
+        srv.close()
+
+
+def single_server_scores(lm, ds):
+    bank = make_bank(lm, ds)
+    programs = ServingPrograms(LADDER)
+    programs.ensure_compiled(bank)
+    with MicroBatcher(lambda: bank, programs) as mb:
+        return np.asarray(
+            [mb.score(r) for r in requests_from_dataset(ds, bank)],
+            np.float32,
+        )
+
+
+class TestRoutedParity:
+    @pytest.mark.parametrize("n_shards", [1, 2, 4])
+    def test_routed_bitwise_vs_single_server_and_batch(
+        self, rng, n_shards
+    ):
+        """The acceptance bar at N in {1, 2, 4}: every routed margin is
+        bit-for-bit the batch scorer's AND the single-server request
+        path's, including offsets and the unknown-entity row."""
+        recs = synth_records(rng)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        lm = synth_model(rng)
+        ref_batch = batch_reference_scores(lm, ds)
+        ref_single = single_server_scores(lm, ds)
+        assert np.array_equal(ref_single, ref_batch)
+        servers = build_fleet(lm, ds, n_shards)
+        router = build_router(servers, lm)
+        try:
+            got = [router.score_record(r) for r in recs]
+            assert np.array_equal(
+                np.asarray(got, np.float32), ref_batch
+            ), "routed scores must be bitwise the batch scorer's"
+            # unknown entity (synth_model drops user6): routed is NOT
+            # degraded — same semantics as the single-server path
+            for rec, out in zip(recs, got):
+                if rec["metadataMap"]["userId"] == "user6":
+                    assert out.degraded is False
+            assert all(out.generation == 1 for out in got)
+            # fan-out never exceeds the owners + FE provider (one RE
+            # type here: exactly one shard per request)
+            assert all(out.fanout == 1 for out in got)
+        finally:
+            close_fleet(servers, router)
+
+    def test_partial_recomposition_matches_full_program(self, rng):
+        """Device-level decomposition contract: fe + spec-ordered f32
+        term adds + offset == the full-margin program, bitwise."""
+        recs = synth_records(rng)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        lm = synth_model(rng)
+        bank = make_bank(lm, ds)
+        programs = ServingPrograms(LADDER)
+        reqs = requests_from_dataset(ds, bank)
+        with MicroBatcher(lambda: bank, programs) as mb:
+            full = [mb.score(r) for r in reqs]
+        with MicroBatcher(
+            lambda: bank, programs, partial=True
+        ) as mb:
+            parts = [mb.score(r) for r in reqs]
+        from photon_ml_tpu.serving.programs import term_entries
+
+        names = [e[1] for e in term_entries(bank.spec)]
+        for req, f, p in zip(reqs, full, parts):
+            total = np.float32(p.fe)
+            for name in names:
+                total = np.float32(total + np.float32(p.terms[name]))
+            total = np.float32(total + np.float32(req.offset))
+            assert np.float32(f) == total
+
+    def test_topology_op_and_status_publish_shard_layout(self, rng):
+        """Satellite: operators and the router discover the fleet
+        layout from the wire — shard index/count, the ownership rule,
+        spec term entries — via the topology op AND the status block."""
+        from tests.test_serving_frontend import Client
+
+        recs = synth_records(rng, n=10)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        lm = synth_model(rng)
+        servers = build_fleet(lm, ds, 2)
+        try:
+            c = Client(servers[1].port)
+            topo = c.ask({"op": "topology", "uid": "t1"})
+            assert topo["uid"] == "t1" and topo["status"] == "ok"
+            assert topo["shard_index"] == 1
+            assert topo["shard_count"] == 2
+            assert topo["rule"] == ownership.OWNERSHIP_RULE
+            assert topo["generation"] == 1
+            assert topo["partial"] is True and topo["ready"] is True
+            assert topo["entries"] == [
+                ["re", "per-user", ["userId"], "u"]
+            ]
+            status = c.ask({"op": "status"})
+            assert status["shard"]["shard_index"] == 1
+            assert status["shard"]["rule"] == ownership.OWNERSHIP_RULE
+            c.close()
+        finally:
+            close_fleet(servers)
+
+    def test_misordered_fleet_is_refused(self, rng):
+        """A fleet whose addresses disagree with the shards' own
+        indexes would serve every coefficient from the wrong host —
+        connect() refuses it outright."""
+        recs = synth_records(rng, n=10)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        lm = synth_model(rng)
+        servers = build_fleet(lm, ds, 2)
+        try:
+            router = ShardRouter(
+                [
+                    ("127.0.0.1", servers[1].port),
+                    ("127.0.0.1", servers[0].port),
+                ],
+                entity_ids={"userId": user_ids(lm)},
+                shard_configs=SHARDS,
+            )
+            with pytest.raises(ValueError, match="ownership rule|index"):
+                router.connect()
+            router.close()
+        finally:
+            close_fleet(servers)
+
+    def test_router_requires_sorted_entity_universe(self):
+        with pytest.raises(ValueError, match="SORTED"):
+            ShardRouter(
+                [("127.0.0.1", 1)],
+                entity_ids={"userId": ["b", "a"]},
+            )
+
+
+class TestDegradation:
+    def test_dead_shard_degrades_its_entities_fe_only(self, rng):
+        """One SHARD dies, not the service: its entities answer the
+        FE-only score (bitwise the batch scorer's FE-only path) with
+        degraded=True; the other shard's entities stay exact and
+        non-degraded. Nothing raises."""
+        recs = synth_records(rng)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        lm = synth_model(rng)
+        fe_only = LoadedGameModel()
+        fe_only.fixed_effects = dict(lm.fixed_effects)
+        ref_full = batch_reference_scores(lm, ds)
+        ref_fe = batch_reference_scores(fe_only, ds)
+        servers = build_fleet(lm, ds, 2)
+        router = build_router(
+            servers,
+            lm,
+            policy=RoutingPolicy(subrequest_timeout_s=1.0),
+        )
+        try:
+            servers[1].close()  # SIGKILL-equivalent for its sockets
+            ids = user_ids(lm)
+            for i, rec in enumerate(recs[:20]):
+                uid = rec["metadataMap"]["userId"]
+                out = router.score_record(rec)
+                code = (
+                    ids.index(uid) if uid in ids else -1
+                )
+                owner = (
+                    ownership.owner_of(code, 2) if code >= 0 else None
+                )
+                if owner == 1:
+                    assert out.degraded is True
+                    assert out.degraded_shards == (1,)
+                    assert np.float32(out) == np.float32(ref_fe[i]), i
+                else:
+                    assert out.degraded is False
+                    assert np.float32(out) == np.float32(ref_full[i]), i
+            snap = router.health[1].snapshot()
+            assert snap["failures"] >= 1
+            assert router.health[0].snapshot()["failures"] == 0
+        finally:
+            close_fleet(servers[:1], router)
+
+    def test_stalled_shard_hedged_then_shed_within_budget(self, rng):
+        """A wedged (not dead) shard: the sub-request times out, is
+        hedged once on a fresh connection, then shed — the request
+        still answers inside its own budget, degraded FE-only."""
+        recs = synth_records(rng)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        lm = synth_model(rng)
+        servers = build_fleet(lm, ds, 2)
+        router = build_router(
+            servers,
+            lm,
+            policy=RoutingPolicy(subrequest_timeout_s=0.6),
+        )
+        try:
+            ids = user_ids(lm)
+            rec = next(
+                r for r in recs
+                if r["metadataMap"]["userId"] in ids
+                and ownership.owner_of(
+                    ids.index(r["metadataMap"]["userId"]), 2
+                ) == 1
+            )
+            # wedge shard 1's dispatcher (the donating-swap exclusion
+            # lock: dispatch cannot run while it is held)
+            gate = servers[1].serving_model.dispatch_lock
+            gate.acquire()
+            try:
+                t0 = time.perf_counter()
+                out = router.score_record(rec)
+                elapsed = time.perf_counter() - t0
+            finally:
+                gate.release()
+            assert out.degraded is True and out.degraded_shards == (1,)
+            assert elapsed < 5.0
+            assert router.metrics.snapshot()["hedges"] >= 1
+            # the shard recovers: the same record scores exact now
+            out2 = router.score_record(rec)
+            assert out2.degraded is False
+        finally:
+            close_fleet(servers, router)
+
+    def test_all_shards_down_is_named_refusal(self, rng):
+        recs = synth_records(rng, n=5)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        lm = synth_model(rng)
+        servers = build_fleet(lm, ds, 2)
+        router = build_router(
+            servers,
+            lm,
+            policy=RoutingPolicy(subrequest_timeout_s=0.4),
+        )
+        try:
+            for srv in servers:
+                srv.close()
+            with pytest.raises(NoShardAvailable):
+                router.score_record(recs[0])
+            assert router.metrics.snapshot()["failed"] == 1
+        finally:
+            router.close()
+
+    def test_circuit_breaker_skips_dead_shard_without_waiting(self, rng):
+        """After fail_threshold consecutive failures the breaker opens:
+        requests for that shard's entities degrade IMMEDIATELY (no
+        timeout wait), until the cooldown admits a probe."""
+        recs = synth_records(rng)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        lm = synth_model(rng)
+        servers = build_fleet(lm, ds, 2)
+        router = build_router(
+            servers,
+            lm,
+            policy=RoutingPolicy(
+                subrequest_timeout_s=0.4,
+                fail_threshold=2,
+                cooldown_s=60.0,
+                hedge=False,
+            ),
+        )
+        try:
+            servers[1].close()
+            ids = user_ids(lm)
+            owned = [
+                r for r in recs
+                if r["metadataMap"]["userId"] in ids
+                and ownership.owner_of(
+                    ids.index(r["metadataMap"]["userId"]), 2
+                ) == 1
+            ]
+            for rec in owned[:2]:
+                router.score_record(rec)  # trip the breaker
+            assert not router.health[1].allow()
+            t0 = time.perf_counter()
+            out = router.score_record(owned[2])
+            assert out.degraded is True
+            assert time.perf_counter() - t0 < 0.2, (
+                "an open breaker must shed without waiting out the "
+                "sub-request budget"
+            )
+        finally:
+            close_fleet(servers[:1], router)
+
+
+def synthetic_bank_arrays(rng, *, scale=1.0, E=14, d_g=6, d_u=4):
+    ids = sorted(f"user{i:02d}" for i in range(E))
+    fe_w = (rng.standard_normal(d_g) * scale).astype(np.float32)
+    re_w = (rng.standard_normal((E, d_u)) * scale).astype(np.float32)
+    return ids, fe_w, re_w
+
+
+def synthetic_fleet(arrays, n_shards, *, stagers=None):
+    """In-memory fleet from raw arrays (bank_from_arrays) — the
+    swap-under-traffic rig: ``stagers[s]`` builds shard ``s``'s NEXT
+    generation bank on stage_swap."""
+    from photon_ml_tpu.utils.index_map import IndexMap
+
+    ids, fe_w, re_w = arrays
+    d_g, d_u = fe_w.shape[0], re_w.shape[1]
+    widths = {"g": 4, "u": 4}
+    imaps = {
+        "g": IndexMap({f"g{j}\t": j for j in range(d_g)}),
+        "u": IndexMap({f"u{j}\t": j for j in range(d_u)}),
+    }
+
+    def build(s, n, fe, re):
+        return bank_from_arrays(
+            fixed=[("global", "g", fe)],
+            random=[("per-user", "userId", "u", re, ids)],
+            shard_widths=widths,
+            index_maps=imaps,
+            entity_shard=(s, n),
+        )
+
+    servers = []
+    for s in range(n_shards):
+        sm = ServingModel(
+            build(s, n_shards, fe_w, re_w),
+            ServingPrograms(LADDER),
+            partial=True,
+            entity_shard=(s, n_shards),
+        )
+        stager = None
+        if stagers is not None:
+            stager = stagers(s, sm, build)
+        servers.append(
+            ShardServer(
+                sm, SHARDS, (s, n_shards), stager=stager,
+                has_response=False,
+            ).start()
+        )
+    return servers, build, widths
+
+
+def synthetic_records(rng, ids, n=30, d_g=6, d_u=4):
+    recs = []
+    for i in range(n):
+        recs.append({
+            "uid": f"q{i}",
+            "metadataMap": {"userId": ids[i % len(ids)]},
+            "features": [
+                {"name": f"g{j}", "term": "",
+                 "value": float(rng.standard_normal())}
+                for j in range(3)
+            ],
+            "userFeatures": [
+                {"name": f"u{j}", "term": "",
+                 "value": float(rng.standard_normal())}
+                for j in range(2)
+            ],
+            "offset": float(rng.normal() * 0.1),
+        })
+    return recs
+
+
+def reference_router(arrays, widths):
+    """A single-shard fleet as the bitwise oracle for synthetic banks
+    (the single-server path is itself pinned bitwise vs the batch
+    scorer by tests/test_serving.py)."""
+    ids, _fe, _re = arrays
+    servers, _build, _w = synthetic_fleet(arrays, 1)
+    router = ShardRouter(
+        [("127.0.0.1", servers[0].port)],
+        entity_ids={"userId": ids},
+        shard_configs=SHARDS,
+        cache_entries=0,
+    )
+    router.connect()
+    return servers, router
+
+
+class TestHotEntityCache:
+    def test_replay_serves_from_cache_bitwise_with_zero_fanout(
+        self, rng
+    ):
+        """Zipf head traffic: the second pass over identical records
+        answers entirely from the generation-keyed cache — bitwise the
+        cold pass, fan-out 0."""
+        arrays = synthetic_bank_arrays(rng)
+        ids = arrays[0]
+        servers, _build, _w = synthetic_fleet(arrays, 2)
+        router = build_router_synth(servers, ids)
+        try:
+            recs = synthetic_records(rng, ids)
+            cold = [router.score_record(r) for r in recs]
+            warm = [router.score_record(r) for r in recs]
+            assert np.array_equal(
+                np.asarray(cold, np.float32),
+                np.asarray(warm, np.float32),
+            ), "a cache hit must be bitwise the cold path"
+            assert all(w.cache_hit and w.fanout == 0 for w in warm)
+            snap = router.cache.snapshot()
+            assert snap["hits"] >= len(recs)
+        finally:
+            close_fleet(servers, router)
+
+    def test_degraded_responses_never_populate_the_cache(self, rng):
+        arrays = synthetic_bank_arrays(rng)
+        ids = arrays[0]
+        servers, _build, _w = synthetic_fleet(arrays, 2)
+        router = build_router_synth(
+            servers, ids,
+            policy=RoutingPolicy(subrequest_timeout_s=0.4, hedge=False),
+        )
+        try:
+            servers[1].close()
+            rec = next(
+                r for r in synthetic_records(rng, ids)
+                if ownership.owner_of(
+                    ids.index(r["metadataMap"]["userId"]), 2
+                ) == 1
+            )
+            out1 = router.score_record(rec)
+            assert out1.degraded
+            out2 = router.score_record(rec)
+            assert out2.degraded and not out2.cache_hit
+            assert router.cache.snapshot()["hits"] == 0
+        finally:
+            close_fleet(servers[:1], router)
+
+    def test_swap_commit_purges_cache_and_gen1_never_serves_gen2(
+        self, rng
+    ):
+        """The invalidation contract across a DONATED hot swap (same
+        shapes, new values — exactly the case entity padding
+        preserves): a record cached at gen 1 must answer gen 2's score
+        (bitwise the gen-2 oracle) right after the two-step flip, and
+        the purge is atomic at commit."""
+        rng2 = np.random.default_rng(rng.integers(1 << 30))
+        arrays1 = synthetic_bank_arrays(rng, scale=1.0)
+        ids = arrays1[0]
+        fe2 = (np.asarray(arrays1[1]) * -2.0).astype(np.float32)
+        re2 = (np.asarray(arrays1[2]) * 0.5).astype(np.float32)
+        arrays2 = (ids, fe2, re2)
+
+        def stagers(s, sm, build):
+            def stage(obj):
+                n = sm.entity_shard[1]
+                return sm.prepare_swap_bank(
+                    build(s, n, fe2, re2)
+                )
+
+            return stage
+
+        servers, build, widths = synthetic_fleet(
+            arrays1, 2, stagers=stagers
+        )
+        router = build_router_synth(servers, ids)
+        oracle1_servers, oracle1 = reference_router(arrays1, widths)
+        oracle2_servers, oracle2 = reference_router(arrays2, widths)
+        try:
+            recs = synthetic_records(rng2, ids)
+            ref1 = [oracle1.score_record(r) for r in recs]
+            ref2 = [oracle2.score_record(r) for r in recs]
+            cold = [router.score_record(r) for r in recs]
+            assert np.array_equal(
+                np.asarray(cold, np.float32),
+                np.asarray(ref1, np.float32),
+            )
+            warm = [router.score_record(r) for r in recs]
+            assert all(w.cache_hit for w in warm)
+            res = router.coordinate_swap("synthetic")
+            assert res["ok"], res
+            assert res["generation"] == 2
+            assert res["cache_purged"] > 0, (
+                "commit must purge the stale generation's entries"
+            )
+            after = [router.score_record(r) for r in recs]
+            assert all(a.generation == 2 for a in after)
+            assert not any(a.cache_hit for a in after), (
+                "a gen-1 entry must never answer a gen-2 request"
+            )
+            assert np.array_equal(
+                np.asarray(after, np.float32),
+                np.asarray(ref2, np.float32),
+            ), "post-swap routed scores must be bitwise the gen-2 oracle"
+            assert not np.array_equal(
+                np.asarray(after, np.float32),
+                np.asarray(cold, np.float32),
+            ), "the two generations must actually differ"
+            # and the new generation caches again
+            warm2 = [router.score_record(r) for r in recs]
+            assert all(w.cache_hit for w in warm2)
+            assert np.array_equal(
+                np.asarray(warm2, np.float32),
+                np.asarray(ref2, np.float32),
+            )
+        finally:
+            close_fleet(servers, router)
+            close_fleet(oracle1_servers, oracle1)
+            close_fleet(oracle2_servers, oracle2)
+
+    def test_failed_stage_aborts_fleet_wide_nobody_flips(self, rng):
+        """Two-step flip, phase-1 failure: shard 1 refuses its stage —
+        shard 0's parked generation is aborted, every shard still
+        serves (and reports) generation 1, scores unchanged bitwise."""
+        from photon_ml_tpu.serving.swap import SwapResult
+
+        arrays = synthetic_bank_arrays(rng)
+        ids = arrays[0]
+
+        def stagers(s, sm, build):
+            if s == 0:
+                def stage_ok(obj):
+                    n = sm.entity_shard[1]
+                    return sm.prepare_swap_bank(
+                        build(s, n, arrays[1], arrays[2])
+                    )
+
+                return stage_ok
+
+            def stage_fail(obj):
+                return SwapResult(
+                    ok=False, generation=1, error="poisoned artifact"
+                )
+
+            return stage_fail
+
+        servers, _build, _w = synthetic_fleet(arrays, 2, stagers=stagers)
+        router = build_router_synth(servers, ids)
+        try:
+            recs = synthetic_records(rng, ids, n=8)
+            before = [router.score_record(r) for r in recs]
+            res = router.coordinate_swap("synthetic")
+            assert res["ok"] is False and res["phase"] == "stage"
+            assert res["failed_shard"] == 1
+            assert router.generation == 1
+            # shard 0's parked bank was aborted, not left to leak into
+            # a later commit
+            assert servers[0].serving_model._prepared is None
+            after = [router.score_record(r) for r in recs]
+            assert np.array_equal(
+                np.asarray(before, np.float32),
+                np.asarray(after, np.float32),
+            )
+            assert all(a.generation == 1 for a in after)
+        finally:
+            close_fleet(servers, router)
+
+    def test_cache_unit_lru_and_generation_keying(self):
+        cache = HotEntityCache(max_entries=2)
+        cache.put((1, FE_SLOT, b"a"), 1.5)
+        cache.put((1, "re", b"b"), 2.5)
+        assert cache.get((1, FE_SLOT, b"a")) == 1.5
+        cache.put((1, "re", b"c"), 3.5)  # evicts LRU ((1,"re",b"b"))
+        assert cache.get((1, "re", b"b")) is None
+        assert cache.get((2, FE_SLOT, b"a")) is None, (
+            "generation is part of the key"
+        )
+        assert cache.purge_other_generations(2) == 2
+        assert cache.get((1, FE_SLOT, b"a")) is None
+        snap = cache.snapshot()
+        assert snap["entries"] == 0 and snap["purged"] == 2
+        off = HotEntityCache(max_entries=0)
+        off.put((1, FE_SLOT, b"a"), 1.0)
+        assert off.get((1, FE_SLOT, b"a")) is None
+        assert not off.enabled
+
+
+def build_router_synth(servers, ids, **kw):
+    kw.setdefault("shard_configs", SHARDS)
+    router = ShardRouter(
+        [("127.0.0.1", srv.port) for srv in servers],
+        entity_ids={"userId": ids},
+        **kw,
+    )
+    router.connect()
+    return router
+
+
+# -- interleaving schedule families (satellite 3) -----------------------------
+#
+# The router fan-out/cache/swap plane under the deterministic scheduler
+# (photon_ml_tpu/testing/interleave.py): fake in-process shards whose
+# handlers are pure host math, transports that resolve futures on
+# cooperative threads — so every lock acquisition, future wait and
+# virtual timeout in the REAL ShardRouter is a schedulable preemption
+# point. Invariants over every seeded schedule: every score call
+# reaches exactly one terminal outcome, zero deadlocks, and every
+# emitted margin is bitwise the expected value FOR ITS GENERATION —
+# which is precisely "the cache never serves cross-generation".
+
+IDS16 = sorted(f"user{i:02d}" for i in range(16))
+
+
+class _FakeShard:
+    """One shard's control + scoring plane as pure host f32 math: fe
+    and the per-entity term are deterministic functions of (record,
+    generation), so the verifier can recompute the exact expected
+    margin for whatever generation a response claims."""
+
+    def __init__(self, index: int, count: int):
+        self.index = index
+        self.count = count
+        self.generation = 1
+        self.staged = None
+        self.dead = False
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def fe_of(record, gen: int) -> np.float32:
+        return np.float32(
+            np.float32(gen * 1.25)
+            + np.float32(record["features"][0]["value"])
+        )
+
+    @staticmethod
+    def term_of(record, code: int, gen: int) -> np.float32:
+        return np.float32(
+            np.float32(gen * 10.0 + code)
+            + np.float32(record["userFeatures"][0]["value"])
+        )
+
+    def handle(self, obj):
+        if self.dead:
+            raise TransportError("shard process gone")
+        op = obj.get("op")
+        uid = obj.get("uid")
+        with self._lock:
+            gen = self.generation
+            if op == "topology":
+                return {
+                    "uid": uid, "status": "ok",
+                    "shard_index": self.index,
+                    "shard_count": self.count,
+                    "rule": ownership.OWNERSHIP_RULE,
+                    "generation": gen,
+                    "entries": [["re", "per-user", ["userId"], "u"]],
+                }
+            if op == "stage_swap":
+                self.staged = gen + 1
+                return {"uid": uid, "status": "ok", "ok": True,
+                        "generation": self.staged, "error": ""}
+            if op == "commit_swap":
+                if self.staged is None:
+                    return {"uid": uid, "status": "error", "ok": False,
+                            "generation": gen,
+                            "error": "nothing staged"}
+                self.generation = self.staged
+                self.staged = None
+                return {"uid": uid, "status": "ok", "ok": True,
+                        "generation": self.generation, "error": ""}
+            if op == "abort_swap":
+                had = self.staged is not None
+                self.staged = None
+                return {"uid": uid, "status": "ok", "aborted": had}
+        entity = (obj.get("metadataMap") or {}).get("userId")
+        code = IDS16.index(entity) if entity in IDS16 else -1
+        term = 0.0
+        if code >= 0 and ownership.owner_of(code, self.count) == self.index:
+            term = float(self.term_of(obj, code, gen))
+        return {
+            "uid": obj["uid"], "status": "ok", "partial": True,
+            "fe": float(self.fe_of(obj, gen)),
+            "terms": {"per-user": term},
+            "generation": gen, "degraded": False,
+        }
+
+
+class _FakeTransport:
+    """Resolves each request's future on a (cooperative) thread, so the
+    shard handler interleaves with router code under the scheduler."""
+
+    closed = False
+
+    def __init__(self, shard: _FakeShard):
+        self.shard = shard
+
+    def send_request(self, obj):
+        from concurrent.futures import Future
+
+        fut = Future()
+        snapshot = dict(obj)
+
+        def work():
+            try:
+                fut.set_result(self.shard.handle(snapshot))
+            except BaseException as e:
+                if not fut.done():
+                    fut.set_exception(TransportError(str(e)))
+
+        threading.Thread(target=work, daemon=True).start()
+        return fut
+
+    def request(self, obj, timeout_s):
+        import concurrent.futures as cf
+
+        fut = self.send_request(obj)
+        try:
+            return fut.result(timeout=max(timeout_s, 0.001))
+        except (TimeoutError, cf.TimeoutError):
+            raise TransportError("timeout") from None
+
+    def abandon(self, uid):
+        pass
+
+    def close(self):
+        pass
+
+
+def _interleave_record(i: int) -> dict:
+    return {
+        "uid": f"iv{i}",
+        "metadataMap": {"userId": IDS16[i % len(IDS16)]},
+        "features": [{"name": "g0", "term": "",
+                      "value": 0.125 * (i % 7)}],
+        "userFeatures": [{"name": "u0", "term": "",
+                          "value": 0.25 * (i % 5)}],
+        "offset": 0.0,
+    }
+
+
+def _expected_margin(record, gen: int, *, fe_only: bool) -> np.float32:
+    entity = record["metadataMap"]["userId"]
+    code = IDS16.index(entity)
+    total = _FakeShard.fe_of(record, gen)
+    term = (
+        np.float32(0.0) if fe_only
+        else _FakeShard.term_of(record, code, gen)
+    )
+    total = np.float32(total + term)
+    return np.float32(total + np.float32(record["offset"]))
+
+
+class TestRouterInterleave:
+    N_SHARDS = 2
+
+    def _scenario(self, sched, *, kill_shard: bool):
+        from photon_ml_tpu.serving import ServingError
+
+        results = []
+        failures = []
+        submitted = [0]
+        with sched.patched():
+            shards = [
+                _FakeShard(i, self.N_SHARDS)
+                for i in range(self.N_SHARDS)
+            ]
+            router = ShardRouter(
+                transport_factory=lambda i: _FakeTransport(shards[i]),
+                num_shards=self.N_SHARDS,
+                entity_ids={"userId": IDS16},
+                shard_configs=SHARDS,
+                policy=RoutingPolicy(
+                    subrequest_timeout_s=1.0, cooldown_s=0.5
+                ),
+                cache_entries=64,
+            )
+            def scorer(base):
+                def body():
+                    # repeats on purpose: the cache plane must race the
+                    # swap commit
+                    for k in [0, 1, 2, 0, 1, 2]:
+                        rec = _interleave_record(base + k)
+                        submitted[0] += 1
+                        try:
+                            results.append(
+                                (rec, router.score_record(rec))
+                            )
+                        except ServingError as e:
+                            results.append((rec, e))
+                        except BaseException as e:
+                            failures.append(e)
+                            return
+
+                return body
+
+            def swapper():
+                res = router.coordinate_swap("synthetic")
+                results.append(("swap", res))
+
+            def driver():
+                # connect + spawn on a SCHEDULED task: the harness's
+                # unmanaged main thread never parks, so waits on the
+                # fake transports' futures must happen here
+                router.connect()
+                workers = [
+                    threading.Thread(
+                        target=scorer(4 * t), name=f"scorer{t}"
+                    )
+                    for t in range(3)
+                ]
+                workers.append(
+                    threading.Thread(target=swapper, name="swapper")
+                )
+                if kill_shard:
+                    def killer():
+                        shards[1].dead = True
+
+                    workers.append(
+                        threading.Thread(target=killer, name="killer")
+                    )
+                for w in workers:
+                    w.start()
+
+            sched.spawn(driver, name="driver")
+            sched.run()
+
+        def verify():
+            from photon_ml_tpu.serving import ServingError
+
+            assert not failures, failures[:2]
+            outcomes = [r for r in results if r[0] != "swap"]
+            assert len(outcomes) == submitted[0], (
+                "every score call must reach exactly one terminal "
+                "outcome"
+            )
+            for rec, out in outcomes:
+                if isinstance(out, ServingError):
+                    continue  # a named refusal IS terminal
+                assert out.generation in (1, 2), out.generation
+                entity = rec["metadataMap"]["userId"]
+                code = IDS16.index(entity)
+                owner = ownership.owner_of(code, self.N_SHARDS)
+                want_exact = _expected_margin(
+                    rec, out.generation, fe_only=False
+                )
+                want_fe = _expected_margin(
+                    rec, out.generation, fe_only=True
+                )
+                if out.degraded:
+                    assert kill_shard and owner == 1, (
+                        "only the killed shard's entities may degrade"
+                    )
+                    assert np.float32(out) == want_fe, (
+                        rec["uid"], float(out), float(want_fe),
+                        out.generation,
+                    )
+                else:
+                    # bitwise-correct FOR ITS GENERATION — a cached
+                    # gen-1 slot leaking under gen 2 (or vice versa)
+                    # matches neither generation's expectation
+                    assert np.float32(out) == want_exact, (
+                        rec["uid"], float(out), float(want_exact),
+                        out.generation,
+                    )
+            swaps = [r[1] for r in results if r[0] == "swap"]
+            if swaps and swaps[0]["ok"] and not kill_shard:
+                assert all(s.generation == 2 for s in shards)
+
+        return verify
+
+    def test_fanout_cache_swap_schedules(self):
+        from photon_ml_tpu.testing.interleave import explore
+
+        explore(
+            lambda sched: self._scenario(sched, kill_shard=False),
+            seeds=range(10),
+        )
+
+    def test_fanout_cache_swap_schedules_with_shard_death(self):
+        from photon_ml_tpu.testing.interleave import explore
+
+        explore(
+            lambda sched: self._scenario(sched, kill_shard=True),
+            seeds=range(10, 20),
+        )
+
+
+class TestDriverValidation:
+    def _params(self, **over):
+        from photon_ml_tpu.cli.serving_driver import ServingParams
+
+        base = dict(
+            game_model_input_dir="m",
+            output_dir="o",
+            feature_shards=SHARDS,
+            frontend_port=0,
+            offheap_indexmap_dir="maps",
+            request_nnz_width="4",
+        )
+        base.update(over)
+        return ServingParams(**base)
+
+    def test_shard_mode_validation_rules(self):
+        self._params(shard_index=0, shard_count=2).validate()
+        with pytest.raises(ValueError, match="go together"):
+            self._params(shard_index=0).validate()
+        with pytest.raises(ValueError, match="shard-index < shard-count"):
+            self._params(shard_index=2, shard_count=2).validate()
+        with pytest.raises(ValueError, match="frontend-port"):
+            self._params(
+                shard_index=0, shard_count=2, frontend_port=None,
+                request_paths=["t"],
+            ).validate()
+        with pytest.raises(ValueError, match="registry"):
+            self._params(
+                shard_index=0, shard_count=2,
+                game_model_input_dir="", registry_dir="r",
+            ).validate()
+        with pytest.raises(ValueError, match="two-step"):
+            self._params(
+                shard_index=0, shard_count=2, swap_model_dir="g2",
+                swap_after_requests=5,
+            ).validate()
+
+    def test_router_mode_validation_rules(self):
+        self._params(
+            shard_servers="127.0.0.1:1,127.0.0.1:2",
+            frontend_port=None, request_paths=["t"],
+        ).validate()
+        with pytest.raises(ValueError, match="not both"):
+            self._params(
+                shard_servers="h:1", shard_index=0, shard_count=1,
+            ).validate()
+        with pytest.raises(ValueError, match="frontend"):
+            self._params(
+                shard_servers="h:1", request_paths=["t"],
+            ).validate()
+        with pytest.raises(ValueError, match="request-paths"):
+            self._params(
+                shard_servers="h:1", frontend_port=None,
+            ).validate()
+        with pytest.raises(ValueError, match="entity"):
+            self._params(
+                shard_servers="h:1", frontend_port=None,
+                request_paths=["t"], game_model_input_dir="",
+            ).validate()
+        p = self._params(
+            shard_servers="hostA:12, hostB:13",
+            frontend_port=None, request_paths=["t"],
+        )
+        assert p.shard_addresses == [("hostA", 12), ("hostB", 13)]
+
+
+@pytest.mark.slow
+class TestShardRoutingDriverEndToEnd:
+    def test_router_replay_bitwise_vs_single_server_across_processes(
+        self, tmp_path, rng
+    ):
+        """The operating story: save a real FE+RE artifact, boot N=2
+        shard-server subprocesses (--shard-index/--shard-count), replay
+        the trace through the router driver (--shard-servers), and
+        diff the scores artifact bitwise against the single-server
+        replay of the same trace. Then SIGTERM the fleet: clean drains,
+        0 cold compiles on any shard."""
+        from tests.conftest import game_example_schema
+
+        from photon_ml_tpu.game.model_io import (
+            LoadedGameModel as LGM,
+            save_loaded_game_model,
+        )
+        from photon_ml_tpu.io.avro_codec import (
+            read_avro_records,
+            write_container,
+        )
+        from photon_ml_tpu.io.name_term_list import (
+            save_name_and_term_feature_sets,
+        )
+
+        lm = LGM()
+        lm.fixed_effects["global"] = (
+            "g", {f"g{j}\t": float(rng.normal()) for j in range(5)},
+        )
+        lm.random_effects["per-user"] = (
+            "userId", "u",
+            {
+                f"user{e}": {
+                    f"u{j}\t": float(rng.normal()) for j in range(3)
+                }
+                for e in range(6)
+            },
+        )
+        model_dir = save_loaded_game_model(lm, str(tmp_path / "model"))
+        nt_dir = str(tmp_path / "nt")
+        save_name_and_term_feature_sets(
+            {
+                "features": {f"g{j}\t" for j in range(5)},
+                "userFeatures": {f"u{j}\t" for j in range(3)},
+            },
+            nt_dir,
+        )
+        recs = synth_records(rng, n=50, n_users=7)
+        trace = tmp_path / "trace"
+        write_container(
+            str(trace / "part-0.avro"), game_example_schema(),
+            [
+                {
+                    k: r[k]
+                    for k in ("uid", "response", "metadataMap",
+                              "features", "userFeatures")
+                }
+                for r in recs
+            ],
+        )
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        common = [
+            "--feature-shard-id-to-feature-section-keys-map",
+            "g:features|u:userFeatures",
+            "--feature-name-and-term-set-path", nt_dir,
+            "--request-nnz-width", "g:8|u:8",
+            "--ladder", "1,8",
+        ]
+        ref_out = str(tmp_path / "ref-out")
+        r = subprocess.run(
+            [
+                sys.executable, "-m",
+                "photon_ml_tpu.cli.serving_driver",
+                "--game-model-input-dir", model_dir,
+                "--output-dir", ref_out,
+                "--request-paths", str(trace),
+            ] + common,
+            cwd=REPO, env=env, capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+        procs = []
+        try:
+            for s in range(2):
+                out = str(tmp_path / f"shard{s}")
+                procs.append((out, subprocess.Popen(
+                    [
+                        sys.executable, "-m",
+                        "photon_ml_tpu.cli.serving_driver",
+                        "--game-model-input-dir", model_dir,
+                        "--output-dir", out,
+                        "--frontend-port", "0",
+                        "--shard-index", str(s),
+                        "--shard-count", "2",
+                    ] + common,
+                    cwd=REPO, env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT, text=True,
+                )))
+            ports = []
+            for out, p in procs:
+                fj = os.path.join(out, "frontend.json")
+                assert _wait_until(
+                    lambda: os.path.exists(fj), timeout=120
+                ), "shard-server never published its port"
+                meta = json.load(open(fj))
+                ports.append(meta["port"])
+                assert meta["shard"]["shard_count"] == 2
+                assert meta["shard"]["rule"] == ownership.OWNERSHIP_RULE
+                assert meta["shard"]["partial"] is True
+            rout = str(tmp_path / "router-out")
+            r = subprocess.run(
+                [
+                    sys.executable, "-m",
+                    "photon_ml_tpu.cli.serving_driver",
+                    "--game-model-input-dir", model_dir,
+                    "--output-dir", rout,
+                    "--request-paths", str(trace),
+                    "--mode", "open", "--concurrency", "4",
+                    "--shard-servers",
+                    ",".join(f"127.0.0.1:{p}" for p in ports),
+                    "--feature-shard-id-to-feature-section-keys-map",
+                    "g:features|u:userFeatures",
+                ],
+                cwd=REPO, env=env, capture_output=True, text=True,
+            )
+            assert r.returncode == 0, (
+                r.stdout[-3000:] + r.stderr[-2000:]
+            )
+
+            def scores(d):
+                return {
+                    x["uid"]: x["predictionScore"]
+                    for x in read_avro_records(os.path.join(d, "scores"))
+                }
+
+            ref, got = scores(ref_out), scores(rout)
+            assert set(ref) == set(got)
+            assert not [
+                u for u in ref
+                if np.float32(ref[u]) != np.float32(got[u])
+            ], "routed scores must be bitwise the single-server replay"
+            m = json.load(open(os.path.join(rout, "metrics.json")))
+            assert m["mode"] == "router"
+            assert m["outcomes"] == {"ok": len(recs)}
+            assert m["routing"]["shards"] == 2
+            for out, p in procs:
+                p.send_signal(signal.SIGTERM)
+            for out, p in procs:
+                assert p.wait(timeout=60) == 0
+                sm = json.load(open(os.path.join(out, "metrics.json")))
+                assert sm["programs"]["cold_dispatch_compiles"] == 0
+                assert sm["leaked_connections"] == 0
+        finally:
+            for _out, p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate(timeout=30)
