@@ -16,7 +16,12 @@
 #   3. every injected fault / retry / quarantine is ACCOUNTED in the
 #      run's metrics.json reliability block;
 #   4. with injection disabled, the seam layer costs < 2% of the
-#      spill-read hot path (bench.py --reliability).
+#      spill-read hot path (bench.py --reliability);
+#   5. (ISSUE 13) every fleet process's FLIGHT RECORDER captured the
+#      injected sequence in order — the SIGKILLed shard's auto-dumped
+#      ring survives the kill showing stage->commit, and
+#      check_conservation() (admitted == named terminal outcomes)
+#      holds across the mid-flood generation swap (arm 14's obs leg).
 #
 # CPU-only by design (JAX_PLATFORMS=cpu in the matrix): the seams under
 # test are host-side IO; chip rounds inherit the same code path.

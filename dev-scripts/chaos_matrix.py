@@ -644,6 +644,17 @@ def shard_routing_arm(
       FE-only reference score bitwise — shard 0's entities stay exact;
     - the surviving shard SIGTERM-drains to exit 0 with zero cold
       (request-path) compiles.
+
+    Observability leg (ISSUE 13): every fleet process runs with
+    --obs-dir, and the arm asserts each process's FLIGHT RECORDER
+    captured the injected sequence in order — the SIGKILLed shard's
+    auto-dumped ring shows stage -> commit (persisted at the
+    transition, so it survives the uncatchable kill), the surviving
+    shard's drain dump shows the same order plus a conservation
+    verdict that holds ACROSS the mid-flood swap (admitted == terminal
+    with terminals split over BOTH generations), and the router
+    process's own recorder shows the fleet commit BEFORE the circuit
+    breaker opened on the killed shard.
     """
     import threading
 
@@ -692,6 +703,11 @@ def shard_routing_arm(
         FeatureShardConfiguration("userShard", ["userFeatures"]),
     ]
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    # router-process flight recorder: reset so the sequence assertions
+    # below read THIS arm's transitions, not an earlier arm's
+    from photon_ml_tpu.obs.flight_recorder import reset_flight_recorder
+
+    router_recorder = reset_flight_recorder()
     procs = []
     for s in range(2):
         out = os.path.join(base, f"routing-shard{s}")
@@ -699,6 +715,7 @@ def shard_routing_arm(
             stream_serving_args(model_dir, out, nt_dir) + [
                 "--frontend-port", "0",
                 "--shard-index", str(s), "--shard-count", "2",
+                "--obs-dir", os.path.join(out, "obs"),
             ],
             cwd=REPO, env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
@@ -838,6 +855,20 @@ def shard_routing_arm(
         assert n_deg > 0, "SIGKILL produced no degraded outcomes"
         assert n_exact > 0, "the surviving shard must keep scoring"
         n_ok = len(phase1) + n_exact
+        # -- observability: the SIGKILLed shard's flight recorder was
+        # auto-dumped at its swap transitions, so the ring SURVIVES the
+        # uncatchable kill: complete JSON (atomic write — never torn),
+        # stage -> commit in sequence order
+        kill_dump = json.load(open(
+            os.path.join(procs[1][0], "obs", "flight.json")
+        ))
+        swap_kinds = [
+            e["kind"] for e in kill_dump["events"]
+            if e["kind"].startswith("swap.")
+        ]
+        assert swap_kinds == ["swap.stage", "swap.commit"], swap_kinds
+        seqs = [e["seq"] for e in kill_dump["events"]]
+        assert seqs == sorted(seqs), seqs
         # surviving shard drains clean with 0 request-path compiles
         procs[0][1].send_signal(signal.SIGTERM)
         stdout, _ = procs[0][1].communicate(timeout=120)
@@ -845,12 +876,45 @@ def shard_routing_arm(
         m = json.load(open(os.path.join(procs[0][0], "metrics.json")))
         assert m["programs"]["cold_dispatch_compiles"] == 0
         assert m["leaked_connections"] == 0
+        # -- observability: the surviving shard's drain dump shows the
+        # same ordered two-step flip, and conservation holds ACROSS the
+        # mid-flood swap — every admitted request reached exactly one
+        # terminal outcome, split over BOTH generations
+        cons = m["obs"]["conservation"]
+        assert cons["ok"], cons
+        assert set(cons["terminal_by_generation"]) >= {"1", "2"}, cons
+        drain_dump = json.load(open(
+            os.path.join(procs[0][0], "obs", "flight.json")
+        ))
+        swap_kinds = [
+            e["kind"] for e in drain_dump["events"]
+            if e["kind"].startswith("swap.")
+        ]
+        assert swap_kinds == ["swap.stage", "swap.commit"], swap_kinds
+        assert os.path.exists(
+            os.path.join(procs[0][0], "obs", "trace.json")
+        )
+        # -- observability: the router process's own ring orders the
+        # fleet commit BEFORE the breaker opened on the killed shard
+        router_events = router_recorder.events()
+        kinds = [e["kind"] for e in router_events]
+        assert "swap.fleet_commit" in kinds, kinds
+        assert "circuit.open" in kinds, kinds
+        assert (
+            kinds.index("swap.fleet_commit") < kinds.index("circuit.open")
+        ), kinds
+        opened = [
+            e for e in router_events if e["kind"] == "circuit.open"
+        ]
+        assert all(e["fields"]["shard"] == 1 for e in opened), opened
         log(
             f"shard routing: {n_ok} exact bitwise clean arm across "
             f"generations {sorted(g for g in gens if g)} (two-step "
             f"flip mid-flood), {n_deg} degraded bitwise FE-only after "
             "SIGKILL, outcomes conserved, surviving shard drained "
-            "exit 0"
+            "exit 0; flight recorders of all 3 processes captured "
+            "stage->commit->kill->circuit-open in order, conservation "
+            "held across the swap"
         )
     finally:
         for _out, p in procs:

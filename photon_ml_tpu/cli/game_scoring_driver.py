@@ -59,6 +59,9 @@ class GameScoringParams:
     feature_name_and_term_set_path: Optional[str] = None
     # jax.profiler trace of the scoring pass (SURVEY §7.11)
     profile_dir: Optional[str] = None
+    # Unified telemetry (ISSUE 13): span tracing + flight recorder
+    # under --obs-dir (trace.json / flight.json at exit).
+    obs_dir: Optional[str] = None
     # Persistent content-addressed tile-schedule cache directory
     # (ops/schedule_cache.py), shared with the training drivers so a
     # scoring run over an already-trained dataset reuses its tiled
@@ -192,6 +195,9 @@ class GameScoringDriver:
         )
         self.logger = logger or PhotonLogger(params.output_dir)
         self.timer = Timer()
+        from photon_ml_tpu.obs import ObsSession
+
+        self.obs = ObsSession(params.obs_dir, signal_dump=False)
         self.metrics: Dict[str, float] = {}
 
     def run(self) -> None:
@@ -242,6 +248,7 @@ class GameScoringDriver:
 
         if p.streaming:
             self._run_streaming(model, sorted(id_types), index_maps, input_paths)
+            self.obs.finish()
             sync_processes("scores-written")
             self.logger.info("timers:\n%s", self.timer.summary())
             return
@@ -277,6 +284,7 @@ class GameScoringDriver:
                     {**self.metrics,
                      "reliability": reliability_metrics()},
                 )
+        self.obs.finish()
         sync_processes("scores-written")
         self.logger.info("timers:\n%s", self.timer.summary())
 
@@ -510,6 +518,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--delete-output-dir-if-exists", default="false")
     ap.add_argument("--application-name", default=None)
     ap.add_argument(
+        "--obs-dir", default=None,
+        help="unified telemetry: span tracing + flight recorder; "
+        "trace.json / flight.json land here atomically",
+    )
+    ap.add_argument(
         "--profile-dir", default=None,
         help="write a jax.profiler trace of the scoring pass here",
     )
@@ -567,6 +580,7 @@ def params_from_args(argv=None) -> GameScoringParams:
         ),
         model_id=ns.game_model_id or ns.model_id or "",
         profile_dir=ns.profile_dir,
+        obs_dir=ns.obs_dir,
         tile_cache_dir=ns.tile_cache_dir,
         no_overlap=str(ns.no_overlap).lower() in ("true", "1", "yes"),
         streaming=str(ns.streaming).lower() in ("true", "1", "yes"),

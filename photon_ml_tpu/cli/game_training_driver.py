@@ -232,6 +232,9 @@ class GameTrainingParams:
     # jax.profiler trace of the training combos into this directory
     # (SURVEY §7.11): one trace spanning the coordinate-descent fits.
     profile_dir: Optional[str] = None
+    # Unified telemetry (ISSUE 13): training-span tracing + flight
+    # recorder under --obs-dir (trace.json / flight.json at exit).
+    obs_dir: Optional[str] = None
     # Persistent content-addressed tile-schedule cache directory
     # (ops/schedule_cache.py): GAME sweeps over the same dataset reuse
     # the tiled layout across runs. None falls back to the
@@ -450,6 +453,9 @@ class GameTrainingDriver:
             params.output_dir if is_coordinator() else None
         )
         self.timer = Timer()
+        from photon_ml_tpu.obs import ObsSession
+
+        self.obs = ObsSession(params.obs_dir, signal_dump=False)
         self.results = []
         self.best_result = None
         self.best_config = None
@@ -1319,6 +1325,10 @@ class GameTrainingDriver:
                     "diagnostics": diag,
                 },
                 "reliability": reliability_metrics(),
+                **(
+                    {"obs": self.obs.finish()}
+                    if self.obs.enabled else {}
+                ),
             },
         )
         self.logger.info("timers:\n%s", self.timer.summary())
@@ -1605,6 +1615,9 @@ class GameTrainingDriver:
         registry_block = self._registry_metrics()
         if registry_block is not None:
             payload["registry"] = registry_block
+        obs_summary = self.obs.finish()
+        if obs_summary is not None:
+            payload["obs"] = obs_summary
         atomic_write_json(
             os.path.join(p.output_dir, "metrics.json"), payload
         )
@@ -1730,6 +1743,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument(
         "--profile-dir", default=None,
         help="write a jax.profiler trace of the first training combo here",
+    )
+    ap.add_argument(
+        "--obs-dir", default=None,
+        help="unified telemetry: training-span tracing + flight "
+        "recorder; trace.json / flight.json / metrics_snapshot.json "
+        "land here atomically",
     )
     ap.add_argument(
         "--tile-cache-dir", default=None,
@@ -1875,6 +1894,7 @@ def params_from_args(argv=None) -> GameTrainingParams:
         checkpoint_dir=ns.checkpoint_dir,
         fault_plan=ns.fault_plan,
         profile_dir=ns.profile_dir,
+        obs_dir=ns.obs_dir,
         tile_cache_dir=ns.tile_cache_dir,
         no_overlap=_bool(ns.no_overlap),
         grid_mode=ns.grid_mode,

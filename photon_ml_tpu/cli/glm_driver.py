@@ -149,6 +149,10 @@ class GLMParams:
     # (SURVEY §7.11 upgrade over Timer-only observability); conventionally
     # <output-dir>/profile, viewable in TensorBoard/Perfetto.
     profile_dir: Optional[str] = None
+    # Unified telemetry (ISSUE 13): --obs-dir enables training-span
+    # tracing (CD iterations, per-lambda solves, streaming passes) +
+    # the flight recorder; trace.json/flight.json land here at exit.
+    obs_dir: Optional[str] = None
     # Persistent content-addressed tile-schedule cache directory
     # (ops/schedule_cache.py): warm reruns over the same dataset load the
     # tiled layout instead of paying the multi-second rebuild. None falls
@@ -437,6 +441,9 @@ class GLMDriver:
         self.emitter = emitter or EventEmitter()
         for name in params.event_listeners:
             self.emitter.register_by_name(name)
+        from photon_ml_tpu.obs import ObsSession
+
+        self.obs = ObsSession(params.obs_dir, signal_dump=False)
         self.timer = Timer()
         self.stage = DriverStage.INIT
         self.stage_history: List[DriverStage] = []
@@ -1652,6 +1659,7 @@ class GLMDriver:
             overlap.drain_io()
             sync_processes("outputs-written")
             self.logger.info("preempted: outputs withheld; resume to finish")
+            self.obs.finish(reason="preempted")
             self.emitter.close()
             return
         if p.validate_dir:
@@ -1671,6 +1679,7 @@ class GLMDriver:
         sync_processes("outputs-written")
         self.logger.info("stages: %s", [s.name for s in self.stage_history])
         self.logger.info("timers:\n%s", self.timer.summary())
+        self.obs.finish()
         self.emitter.close()
 
 
@@ -1771,6 +1780,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--profile-dir", default=None,
         help="write a jax.profiler trace of the training stage here "
         "(TensorBoard/Perfetto-viewable)",
+    )
+    ap.add_argument(
+        "--obs-dir", default=None,
+        help="unified telemetry: training-span tracing + flight "
+        "recorder; trace.json (Chrome trace-event), flight.json and "
+        "metrics_snapshot.json land here atomically",
     )
     ap.add_argument(
         "--tile-cache-dir", default=None,
@@ -1933,6 +1948,7 @@ def params_from_args(argv=None) -> GLMParams:
         streaming=_bool(ns.streaming),
         stream_memory_budget=ns.stream_memory_budget,
         profile_dir=ns.profile_dir,
+        obs_dir=ns.obs_dir,
         tile_cache_dir=ns.tile_cache_dir,
         no_overlap=_bool(ns.no_overlap),
         grid_mode=ns.grid_mode,

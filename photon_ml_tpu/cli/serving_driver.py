@@ -128,6 +128,15 @@ class ServingParams:
     hot_cache_entries: int = 4096
     router_subrequest_timeout_ms: float = 2000.0
     router_hedge: bool = True
+    # Unified telemetry plane (ISSUE 13): --obs-dir enables request
+    # tracing (Chrome trace-event JSON), the live metrics registry
+    # ({"op": "metrics"} + periodic atomic snapshots), and the flight
+    # recorder (auto-dumped on swap/rollback transitions + at drain).
+    obs_dir: Optional[str] = None
+    obs_snapshot_s: float = 5.0
+    # Device-timeline co-capture: jax.profiler trace over the serve
+    # phase (replay AND frontend modes), next to the host spans.
+    profile_dir: Optional[str] = None
 
     @property
     def stdin_mode(self) -> bool:
@@ -372,6 +381,16 @@ class ServingDriver:
         )
         self.logger = logger or PhotonLogger(params.output_dir)
         self.timer = Timer()
+        # --obs-dir: one session owns tracing + registry + flight
+        # recorder; the driver's own drain paths call finish() (signal
+        # dumps ride the drain protocol, not a second handler)
+        from photon_ml_tpu.obs import ObsSession
+
+        self.obs = ObsSession(
+            params.obs_dir,
+            snapshot_period_s=params.obs_snapshot_s,
+            signal_dump=False,
+        )
         self.serving_model = None
         self.metrics = None
         self.results: List[float] = []
@@ -805,6 +824,7 @@ class ServingDriver:
             return
         requests = self._build()
         self.metrics = ServingMetrics()
+        self.obs.register_view("serving", self.metrics.snapshot)
         overlap.reset_readback_stats()
         batcher = MicroBatcher(
             self.serving_model.current,
@@ -824,11 +844,15 @@ class ServingDriver:
             self._stop_replay.set()
             raise KeyboardInterrupt(f"signal {signum}")
 
+        from photon_ml_tpu.utils.profiling import profile_trace
+
         prev = self._install_signal_handlers(_interrupt)
         scored = []
         try:
             try:
-                with self.timer.time("serve"):
+                # --profile-dir: device timeline over the serve phase,
+                # co-captured with the host spans (--obs-dir trace.json)
+                with self.timer.time("serve"), profile_trace(p.profile_dir):
                     scored = (
                         self._replay_closed(batcher, requests)
                         if p.mode == "closed"
@@ -860,9 +884,13 @@ class ServingDriver:
             with self.timer.time("write-scores"):
                 self._write_scores(scored)
         eval_metrics = self._evaluate(scored)
+        extra = self._metrics_extra(scored, eval_metrics)
+        obs_summary = self.obs.finish()
+        if obs_summary is not None:
+            extra["obs"] = obs_summary
         self.metrics.write(
             os.path.join(p.output_dir, "metrics.json"),
-            extra=self._metrics_extra(scored, eval_metrics),
+            extra=extra,
         )
         self.results = [s for _, outcome, s in scored if outcome == "ok"]
         self.logger.info("timers:\n%s", self.timer.summary())
@@ -973,6 +1001,7 @@ class ServingDriver:
         )
         with self.timer.time("connect-fleet"):
             info = router.connect()
+        self.obs.register_view("routing", router.status)
         self.logger.info(
             "routing over %d shard-server(s), fleet generation %d",
             info["shards"], info["generation"],
@@ -987,10 +1016,12 @@ class ServingDriver:
             self._stop_replay.set()
             raise KeyboardInterrupt(f"signal {signum}")
 
+        from photon_ml_tpu.utils.profiling import profile_trace
+
         prev = self._install_signal_handlers(_interrupt)
         try:
             try:
-                with self.timer.time("serve"):
+                with self.timer.time("serve"), profile_trace(p.profile_dir):
                     if p.mode == "closed":
                         for rec in records:
                             if self._stop_replay.is_set():
@@ -1094,10 +1125,12 @@ class ServingDriver:
             1 for _r, o, s in scored
             if o == "ok" and getattr(s, "degraded", False)
         )
+        obs_summary = self.obs.finish()
         atomic_write_json(
             os.path.join(p.output_dir, "metrics.json"),
             {
                 "mode": "router",
+                **({"obs": obs_summary} if obs_summary else {}),
                 "interrupted": self.interrupted,
                 "outcomes": dict(sorted(outcomes.items())),
                 "degraded_responses": degraded,
@@ -1198,6 +1231,10 @@ class ServingDriver:
             rollback_handler=rollback_handler,
             extra_ops=extra_ops,
             status_extra=status_extra,
+            metrics_registry=self.obs.registry,
+            flight_dump_path=(
+                self.obs.flight_path if self.obs.enabled else None
+            ),
         )
         frontend.start()
         atomic_write_json(
@@ -1223,14 +1260,20 @@ class ServingDriver:
             "front-end listening on %s:%d (drain budget %.1fs)",
             p.frontend_host, frontend.port, p.drain_timeout_s,
         )
+        from photon_ml_tpu.utils.profiling import profile_trace
+
         shutdown = threading.Event()
         prev = self._install_signal_handlers(
             lambda signum, frame: shutdown.set()
         )
         try:
             try:
-                while not shutdown.wait(timeout=0.2):
-                    pass
+                # --profile-dir: the device timeline of everything the
+                # dispatcher runs while the frontend serves (the trace
+                # closes at SIGTERM, before the drain)
+                with profile_trace(p.profile_dir):
+                    while not shutdown.wait(timeout=0.2):
+                        pass
             except KeyboardInterrupt:
                 pass
             self.interrupted = True
@@ -1253,13 +1296,17 @@ class ServingDriver:
             "drained: %s; open connections after close: %d",
             self.drain_report.to_dict(), leaked,
         )
+        extra = {
+            **self._metrics_extra([], {}),
+            "frontend_completed": frontend.completed(),
+            "leaked_connections": leaked,
+        }
+        obs_summary = self.obs.finish(reason="drain")
+        if obs_summary is not None:
+            extra["obs"] = obs_summary
         self.metrics.write(
             os.path.join(p.output_dir, "metrics.json"),
-            extra={
-                **self._metrics_extra([], {}),
-                "frontend_completed": frontend.completed(),
-                "leaked_connections": leaked,
-            },
+            extra=extra,
         )
         self.logger.info("timers:\n%s", self.timer.summary())
 
@@ -1415,6 +1462,24 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "remaining budget before shedding it (FE-only for its "
         "entities)",
     )
+    ap.add_argument(
+        "--obs-dir", default=None,
+        help="unified telemetry: enable request tracing + the live "
+        "metrics registry + the flight recorder; trace.json / "
+        "flight.json / metrics_snapshot.json land here atomically "
+        "(also exposed live via the {\"op\": \"metrics\"} and "
+        "{\"op\": \"flight\"} control ops)",
+    )
+    ap.add_argument(
+        "--obs-snapshot-s", type=float, default=5.0,
+        help="period of the --obs-dir metrics snapshot writer",
+    )
+    ap.add_argument(
+        "--profile-dir", default=None,
+        help="jax.profiler device-timeline trace over the serve phase "
+        "(replay, frontend and router modes) — co-captured with the "
+        "--obs-dir host spans",
+    )
     return ap
 
 
@@ -1484,6 +1549,9 @@ def params_from_args(argv=None) -> ServingParams:
         hot_cache_entries=ns.hot_cache_entries,
         router_subrequest_timeout_ms=ns.router_subrequest_timeout_ms,
         router_hedge=truthy(ns.router_hedge),
+        obs_dir=ns.obs_dir,
+        obs_snapshot_s=ns.obs_snapshot_s,
+        profile_dir=ns.profile_dir,
     )
 
 

@@ -23,6 +23,8 @@ import numpy as np
 from photon_ml_tpu.game.coordinate import Coordinate
 from photon_ml_tpu.game.data import GameDataset
 from photon_ml_tpu.game.model import GameModel
+from photon_ml_tpu.obs.trace import span as obs_span
+from photon_ml_tpu.obs.trace import start_span
 from photon_ml_tpu.ops.losses import loss_for_task
 from photon_ml_tpu.parallel import overlap
 from photon_ml_tpu.task import TaskType
@@ -196,6 +198,11 @@ class CoordinateDescent:
                 )
 
         for it in range(start_iteration, num_iterations):
+            # obs/trace.py training span: one per CD iteration, with
+            # per-coordinate children below — host wall-clock only (the
+            # async dispatch window, not device time; --profile-dir
+            # carries the device side)
+            it_span = start_span("cd.iteration", iteration=it + 1)
             # Fresh O(C) score sum once per iteration; inside the sweep the
             # residual for each coordinate is total - own score (the
             # KeyValueScore `-` of the reference) and the total is patched
@@ -222,9 +229,15 @@ class CoordinateDescent:
                             self.coordinates[nxt].prepare, models[nxt]
                         )
                 residual = total - scores[name] if len(seq) > 1 else None
-                models[name], tracker = coord.update_model(models[name], residual)
-                trackers[name].append(tracker)
-                new_score = coord.score(models[name])
+                with obs_span(
+                    "cd.update", parent_id=it_span.span_id,
+                    trace_id=it_span.trace_id, coordinate=name,
+                ):
+                    models[name], tracker = coord.update_model(
+                        models[name], residual
+                    )
+                    trackers[name].append(tracker)
+                    new_score = coord.score(models[name])
                 total = (
                     residual + new_score
                     if residual is not None
@@ -248,6 +261,7 @@ class CoordinateDescent:
                 ]
             )
             objective = objective_d.result()
+            it_span.end(objective=objective)
             objective_history.append(objective)
             self.logger.info(
                 "coordinate descent iter %d: objective=%g", it + 1, objective

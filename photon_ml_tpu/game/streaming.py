@@ -57,6 +57,8 @@ from photon_ml_tpu.game.data import (
     record_entity_id,
     record_response,
 )
+from photon_ml_tpu.obs.trace import start_span
+from photon_ml_tpu.obs.trace import traced as obs_traced
 from photon_ml_tpu.io.streaming import (
     make_spill_dir,
     sparse_row_bytes,
@@ -111,6 +113,7 @@ def _stream_records(paths):
         )
 
 
+@obs_traced("streaming.scan")
 def scan_game_stream(
     paths,
     shard_configs: Sequence[FeatureShardConfiguration],
@@ -442,6 +445,7 @@ class ScoreStore:
         return self._mm.reshape(-1)
 
 
+@obs_traced("streaming.stage")
 def stage_game_stream(
     paths,
     shard_configs: Sequence[FeatureShardConfiguration],
@@ -1341,6 +1345,11 @@ class StreamingCoordinateDescent:
                     "checkpoint step %d", latest,
                 )
         for it in range(start_iteration, num_iterations):
+            # obs/trace.py: one span per out-of-core CD iteration (the
+            # in-memory loop has its twin in game/coordinate_descent.py)
+            it_span = start_span(
+                "cd.iteration", iteration=it + 1, streaming=True
+            )
             for name in seq:
                 coord = self.coordinates[name]
                 if residual is not None:
@@ -1384,6 +1393,7 @@ class StreamingCoordinateDescent:
                 objective += self.coordinates[name].regularization_term(
                     states[name]
                 )
+            it_span.end(objective=objective)
             objective_history.append(objective)
             self.logger.info(
                 "streaming coordinate descent iter %d: objective=%g",

@@ -88,6 +88,16 @@ GEN_PREFIX = "g"
 GEN_DIGITS = 6
 
 
+def _flight(kind: str, **fields) -> None:
+    """Publication-protocol transitions land in the process flight
+    recorder (obs/): lease acquire/release, commit, refusal,
+    quarantine — the ordered sequence a kill-mid-publish post-mortem
+    reads back."""
+    from photon_ml_tpu.obs.flight_recorder import flight_recorder
+
+    flight_recorder().record(kind, **fields)
+
+
 class RegistryLeaseHeld(RuntimeError):
     """A live publisher holds the registry lease: this publisher loses
     cleanly, having written nothing."""
@@ -247,6 +257,7 @@ class _Lease:
 
         io_call(PUBLISH_SEAM, _acquire, detail=self.path)
         self.held = True
+        _flight("registry.lease", action="acquire", path=self.path)
 
     def release(self) -> None:
         if not self.held:
@@ -266,6 +277,7 @@ class _Lease:
                     pass
 
         io_call(PUBLISH_SEAM, _release, detail=self.path)
+        _flight("registry.lease", action="release", path=self.path)
 
 
 class ModelRegistry:
@@ -485,6 +497,9 @@ class ModelRegistry:
             {"generation": generation, "signature": signature},
             detail=os.path.join(path, COMMIT),
         )
+        _flight(
+            "registry.publish", generation=generation, signature=signature
+        )
 
     def _refuse(
         self, signature, parent, data_ranges, gate_report, extra
@@ -504,6 +519,7 @@ class ModelRegistry:
             atomic_write_json(os.path.join(refused, MANIFEST), manifest)
 
         io_call(PUBLISH_SEAM, _record, detail=refused)
+        _flight("registry.refuse", verdict=verdict, signature=signature)
         raise RefusedCandidate(verdict, refused)
 
     def refused_candidates(self) -> List[Dict[str, object]]:
@@ -548,6 +564,9 @@ class ModelRegistry:
             )
 
         io_call(PUBLISH_SEAM, _move, detail=dst)
+        _flight(
+            "registry.quarantine", generation=generation, reason=reason
+        )
         return dst
 
     def gc(self, *, keep: int = 5) -> List[int]:

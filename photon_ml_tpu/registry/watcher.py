@@ -26,6 +26,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from photon_ml_tpu.obs.flight_recorder import flight_recorder
 from photon_ml_tpu.registry.registry import GenerationInfo, ModelRegistry
 
 __all__ = ["RollbackPolicy", "HealthWindow", "RegistryWatcher"]
@@ -300,6 +301,10 @@ class RegistryWatcher:
             info.generation, res.ok,
             f" error={res.error}" if res.error else "",
         )
+        flight_recorder().record(
+            "watcher.promote", registry_generation=info.generation,
+            parent=info.parent, ok=res.ok, error=res.error,
+        )
 
     def rollback(self, *, reason: str = "operator request") -> bool:
         """Flip back to the live generation's parent (reloaded from the
@@ -351,4 +356,15 @@ class RegistryWatcher:
                     "generation %d quarantined in the registry (%s)",
                     live.generation, q,
                 )
+            # the rollback is the flight recorder's marquee event: the
+            # record (kind "watcher.*") also triggers the armed
+            # auto-dump, so the ring is on disk the moment the service
+            # rolled back — not only at clean exit
+            flight_recorder().record(
+                "watcher.rollback",
+                from_generation=live.generation,
+                to_generation=parent.generation,
+                reason=reason,
+                ok=res.ok,
+            )
             return res.ok

@@ -195,6 +195,17 @@ class FaultPlan:
                 self._injected[seam] = self._injected.get(seam, 0) + 1
         if fire is None:
             return
+        # flight-recorder event for every TRIGGERED injection (never
+        # for plain crossings — those stay counters), recorded OUTSIDE
+        # the plan lock. A KILL entry records BEFORE the SIGKILL: the
+        # armed auto-dump persists the ring, so the post-mortem shows
+        # the exact crossing that killed the process.
+        from photon_ml_tpu.obs.flight_recorder import flight_recorder
+
+        flight_recorder().record(
+            "fault.crossing", seam=seam, occurrence=n,
+            error=fire.error, detail=detail,
+        )
         if fire.error == "KILL":
             # deterministic kill -9 at this exact crossing: SIGKILL is
             # uncatchable, so nothing below this line runs — exactly the

@@ -51,6 +51,13 @@ from typing import Callable, Dict, List, Mapping, Optional
 
 import numpy as np
 
+from photon_ml_tpu.obs.flight_recorder import flight_recorder
+from photon_ml_tpu.obs.trace import (
+    PARENT_KEY,
+    TRACE_KEY,
+    record_span,
+    tracing_enabled,
+)
 from photon_ml_tpu.parallel import overlap
 from photon_ml_tpu.serving.admission import (
     AdmissionController,
@@ -140,6 +147,11 @@ class ScoreRequest:
     label: Optional[float] = None
     weight: float = 1.0
     metadata: Optional[Dict[str, str]] = None
+    # end-to-end tracing (obs/trace.py): the wire-carried trace id and
+    # the parent span the dispatch-window span nests under. Host-only
+    # annotations — they never touch the device path.
+    trace_id: Optional[str] = None
+    parent_span: Optional[str] = None
     _enqueue_t: float = field(default=0.0, repr=False)
 
     def expired(self, now: float) -> bool:
@@ -233,6 +245,8 @@ def request_from_record(
     wgt = record.get("weight")
     uid = record.get("uid")
     deadline = record.get("deadline_ms")
+    trace_id = record.get(TRACE_KEY)
+    parent_span = record.get(PARENT_KEY)
     meta = {t: e for t, e in entity_ids.items() if e is not None}
     return ScoreRequest(
         uid="" if uid is None else str(uid),
@@ -246,6 +260,8 @@ def request_from_record(
         ),
         weight=1.0 if wgt is None else float(wgt),
         metadata=meta or None,
+        trace_id=None if trace_id is None else str(trace_id),
+        parent_span=None if parent_span is None else str(parent_span),
     )
 
 
@@ -461,7 +477,16 @@ class MicroBatcher:
         except RequestShed as e:
             if self._metrics is not None:
                 self._metrics.record_shed(e.reason)
+            # structured overload event + (refused-before-admission, so
+            # it enters neither side of the conservation ledger)
+            flight_recorder().record("request.shed", reason=e.reason)
             raise
+        # conservation ledger (obs/flight_recorder.py): one admitted
+        # mark per queued request; every resolution site below marks
+        # the matching terminal — check_conservation() is the
+        # every-request-reaches-a-named-outcome invariant. Fed OUTSIDE
+        # the queue lock, like the shed accounting above.
+        flight_recorder().note_admitted()
         return fut
 
     def score(
@@ -537,6 +562,8 @@ class MicroBatcher:
                 f"({timeout_s:.3f}s) ran out"
             )):
                 failed += 1
+        if failed:
+            flight_recorder().note_terminal("drain_timeout", n=failed)
         join_budget = max(deadline - time.perf_counter(), 0.0) + 1.0
         self._worker.join(timeout=join_budget)
         report = DrainReport(
@@ -607,8 +634,12 @@ class MicroBatcher:
                     expired += 1
             else:
                 live.append((req, fut))
-        if expired and self._metrics is not None:
-            self._metrics.record_deadline_expired(expired)
+        if expired:
+            if self._metrics is not None:
+                self._metrics.record_deadline_expired(expired)
+            fr = flight_recorder()
+            fr.record("request.deadline", expired=expired)
+            fr.note_terminal("deadline_exceeded", n=expired)
         return live
 
     def _dispatch_loop(self) -> None:
@@ -622,8 +653,13 @@ class MicroBatcher:
                 if take:
                     self._dispatch(take)
             except BaseException as e:  # resolve, never wedge submitters
+                errored = 0
                 for _req, fut in take:
-                    _resolve(fut, error=e)
+                    errored += int(_resolve(fut, error=e))
+                if errored:
+                    flight_recorder().note_terminal(
+                        "dispatch_error", n=errored
+                    )
             finally:
                 self._finish_take()
 
@@ -726,27 +762,50 @@ class MicroBatcher:
         t1 = time.perf_counter()
         self._admission.note_dispatch(rows=len(requests), busy_s=t1 - t0)
         n_degraded = 0
+        n_ok = 0
         if self._partial:
             fe, terms = scores
             names = [e[1] for e in term_entries(bank.spec)]
+        traced = []
+        collect_traces = tracing_enabled()
         for i, (req, fut) in enumerate(take):
             deg = bool(degraded[i])
             n_degraded += int(deg)
+            if collect_traces and req.trace_id is not None:
+                # per-request trace contexts ride the DISPATCH span as
+                # one attr; the serving.score leaves are synthesized at
+                # export (trace.expand_spans) — the hot path pays one
+                # tuple per traced request, not one span
+                traced.append((req.trace_id, req.parent_span, deg))
             if self._partial:
                 # float(np.float32) is the exact f64 of the f32 bits;
                 # the router coerces back to f32 losslessly
-                _resolve(fut, result=PartialScore(
+                n_ok += int(_resolve(fut, result=PartialScore(
                     float(fe[i]),
                     {n: float(terms[i, j]) for j, n in enumerate(names)},
                     offset=req.offset,
                     degraded=deg,
                     generation=bank.generation,
-                ))
+                )))
             else:
-                _resolve(fut, result=ScoreOutcome(
+                n_ok += int(_resolve(fut, result=ScoreOutcome(
                     float(scores[i]), degraded=deg,
                     generation=bank.generation,
-                ))
+                )))
+        if n_ok:
+            flight_recorder().note_terminal(
+                "ok", generation=bank.generation, n=n_ok
+            )
+        # stamped AFTER the device section from timestamps already in
+        # hand — record_span is a no-op branch when tracing is off and
+        # a lock-free ring append when on, so the locked device section
+        # above acquires nothing new.
+        record_span(
+            "serving.dispatch", t0, t1,
+            shape=B, occupancy=len(requests), generation=bank.generation,
+            partial=self._partial,
+            **({"traces": traced} if traced else {}),
+        )
         if self._metrics is not None:
             if n_degraded:
                 self._metrics.record_degraded(n_degraded)

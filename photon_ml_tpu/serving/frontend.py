@@ -59,6 +59,8 @@ import time
 from concurrent.futures import Future
 from typing import Callable, Dict, List, Optional
 
+from photon_ml_tpu.obs.flight_recorder import flight_recorder
+from photon_ml_tpu.obs.trace import TRACE_KEY, start_span, wire_context
 from photon_ml_tpu.serving.admission import (
     DeadlineExceeded,
     DrainTimeout,
@@ -272,6 +274,38 @@ class _Connection:
             self.fe._note("control")
             if str(op) in _STATUS_OPS:
                 self.send(self.fe.status_response(str(op)))
+            elif str(op) == "metrics":
+                # live wire exposition of the process metrics plane:
+                # the registry's merged snapshot (instruments + every
+                # subsystem view), or Prometheus-style text with
+                # {"format": "prometheus"} — without a registry wired
+                # the op still answers from the serving accumulator
+                self.send(self.fe.metrics_response(obj))
+            elif str(op) == "flight":
+                rec = flight_recorder()
+                self.send({
+                    "uid": obj.get("uid"),
+                    "status": "ok",
+                    "op": op,
+                    "flight": rec.snapshot(),
+                    "conservation": rec.check_conservation(),
+                })
+            elif str(op) == "dump_flight":
+                if not self.fe.flight_dump_path:
+                    self.send(_error_response(
+                        obj.get("uid"), "BAD_REQUEST",
+                        "no flight dump path configured (--obs-dir)",
+                    ))
+                    return
+                path = flight_recorder().dump(
+                    self.fe.flight_dump_path, reason="operator op"
+                )
+                self.send({
+                    "uid": obj.get("uid"),
+                    "status": "ok" if path else "error",
+                    "op": op,
+                    "path": path,
+                })
             elif str(op) == "quarantine_re":
                 # operator lever for graceful degradation: mark one RE
                 # coordinate of the CURRENT generation unusable —
@@ -364,11 +398,20 @@ class ServingFrontend:
         rollback_handler: Optional[Callable[[], bool]] = None,
         extra_ops: Optional[Dict[str, Callable[[Dict], Dict]]] = None,
         status_extra: Optional[Callable[[], Dict]] = None,
+        metrics_registry=None,
+        flight_dump_path: Optional[str] = None,
     ):
         self.batcher = batcher
         self.serving_model = serving_model
         self.shard_configs = shard_configs
         self.metrics = metrics
+        # live telemetry exposition (obs/): {"op": "metrics"} serves
+        # the process registry's merged snapshot (JSON or Prometheus
+        # text); {"op": "flight"} serves the flight-recorder ring +
+        # conservation verdict; {"op": "dump_flight"} persists it to
+        # the operator-configured path (never a wire-supplied one)
+        self.metrics_registry = metrics_registry
+        self.flight_dump_path = flight_dump_path
         self.host = host
         self.has_response = bool(has_response)
         self.max_line_bytes = int(max_line_bytes)
@@ -510,6 +553,36 @@ class ServingFrontend:
                 out["status_extra_error"] = str(e)
         return out
 
+    def metrics_response(self, obj: Dict) -> Dict[str, object]:
+        """The ``{"op": "metrics"}`` payload: the live process registry
+        when one is wired (driver ``--obs-dir`` / explicit ctor arg),
+        otherwise the serving accumulator's snapshot — the op always
+        answers. ``format: "prometheus"`` returns text exposition."""
+        uid = obj.get("uid")
+        fmt = str(obj.get("format") or "json").lower()
+        reg = self.metrics_registry
+        if fmt == "prometheus":
+            if reg is None:
+                return _error_response(
+                    uid, "BAD_REQUEST",
+                    "prometheus exposition needs a metrics registry "
+                    "(--obs-dir)",
+                )
+            return {
+                "uid": uid, "status": "ok", "op": "metrics",
+                "format": "prometheus", "text": reg.prometheus(),
+            }
+        if reg is not None:
+            payload = reg.snapshot()
+        elif self.metrics is not None:
+            payload = {"serving": self.metrics.snapshot()}
+        else:
+            payload = {}
+        return {
+            "uid": uid, "status": "ok", "op": "metrics",
+            "metrics": payload,
+        }
+
     # -- internals -----------------------------------------------------------
 
     def _note(self, event: str, n: int = 1) -> None:
@@ -560,17 +633,39 @@ class ServingFrontend:
             self._note("malformed")
             conn.send(_error_response(uid, "BAD_REQUEST", str(e)))
             return
+        # trace ids are minted HERE, at the edge: a request arriving
+        # with wire context joins the caller's trace (the router's
+        # sub-request path), a bare one roots a fresh trace. The span
+        # covers queue wait + dispatch + demux; the dispatch-window
+        # child is stamped by the batcher under req.parent_span.
+        wire_t, wire_p = wire_context(record)
+        sp = start_span(
+            "frontend.request", trace_id=wire_t, parent_id=wire_p,
+            uid=str(uid) if uid is not None else "",
+        )
+        if sp.trace_id is not None:
+            req.trace_id = sp.trace_id
+            req.parent_span = sp.span_id
+        else:
+            # tracing off on this hop: still RELAY the caller's context
+            # so downstream hops (and the response echo) stay connected
+            req.trace_id, req.parent_span = wire_t, wire_p
         try:
             fut = self.batcher.submit(req)
         except ServingError as e:
+            sp.end(status="refused", error=type(e).__name__)
             conn.send(_failure_response(uid, e))
             return
         conn._note_pending(+1)
         fut.add_done_callback(
-            lambda f, c=conn, u=req.uid: self._on_done(c, u, f)
+            lambda f, c=conn, u=req.uid, t=req.trace_id, s=sp:
+            self._on_done(c, u, f, trace_id=t, span=s)
         )
 
-    def _on_done(self, conn: _Connection, uid: str, fut: Future) -> None:
+    def _on_done(
+        self, conn: _Connection, uid: str, fut: Future,
+        *, trace_id: Optional[str] = None, span=None,
+    ) -> None:
         # runs on the dispatcher (or drain) thread: the future is
         # already terminal, so result(timeout=0) cannot block
         try:
@@ -582,6 +677,12 @@ class ServingFrontend:
         except BaseException as e:
             resp = _failure_response(uid, e)
             ok, degraded, failed = False, False, True
+        if trace_id is not None:
+            # the response echoes the trace id so the client (router or
+            # operator) can stitch both sides of the wire
+            resp[TRACE_KEY] = trace_id
+        if span is not None:
+            span.end(status=str(resp.get("status")), degraded=degraded)
         hook = self.on_outcome
         if hook is not None:
             try:

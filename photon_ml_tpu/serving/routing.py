@@ -70,6 +70,13 @@ from typing import Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 import numpy as np
 
 from photon_ml_tpu import ownership
+from photon_ml_tpu.obs.flight_recorder import flight_recorder
+from photon_ml_tpu.obs.trace import (
+    PARENT_KEY,
+    TRACE_KEY,
+    start_span,
+    wire_context,
+)
 from photon_ml_tpu.serving.admission import NoShardAvailable, ScoreOutcome
 from photon_ml_tpu.serving.model_bank import EntityRowIndex
 
@@ -164,7 +171,11 @@ class ShardHealth:
         self._successes_total = 0
 
     def note(self, ok: bool) -> None:
+        transition = None
         with self._lock:
+            was_open = (
+                self._consecutive_failures >= self._policy.fail_threshold
+            )
             self._window.append(0 if ok else 1)
             if len(self._window) > self._policy.health_window:
                 self._window.pop(0)
@@ -172,6 +183,8 @@ class ShardHealth:
                 self._consecutive_failures = 0
                 self._open_until = 0.0
                 self._successes_total += 1
+                if was_open:
+                    transition = "close"
             else:
                 self._consecutive_failures += 1
                 self._failures_total += 1
@@ -179,6 +192,15 @@ class ShardHealth:
                     self._open_until = (
                         time.monotonic() + self._policy.cooldown_s
                     )
+                    if not was_open:
+                        transition = "open"
+        if transition is not None:
+            # breaker transitions are flight-recorder events (recorded
+            # OUTSIDE this health window's lock — the recorder has its
+            # own); per-call outcomes stay counters, not events
+            flight_recorder().record(
+                f"circuit.{transition}", shard=self.shard_index
+            )
 
     def allow(self) -> bool:
         with self._lock:
@@ -815,6 +837,7 @@ class ShardRouter:
         record: Mapping,
         shards: Sequence[int],
         budget_s: float,
+        trace=None,
     ) -> Dict[int, Optional[Mapping]]:
         """Fan one partial-score sub-request out to ``shards`` and
         gather, bounded by ``budget_s`` overall. ALL first attempts go
@@ -832,7 +855,7 @@ class ShardRouter:
         )
         deadline = t0 + budget_s
         # phase 1: fire every first attempt
-        pending: Dict[int, tuple] = {}  # shard -> (transport, obj, fut)
+        pending: Dict[int, tuple] = {}  # shard -> (transport, obj, fut, span)
         out: Dict[int, Optional[Mapping]] = {}
         for s in shards:
             if not self.health[s].allow():
@@ -841,14 +864,27 @@ class ShardRouter:
             obj = dict(record)
             obj["uid"] = self._next_uid()
             obj["deadline_ms"] = budget_s * 1e3
+            # sub-request span, nested under the router span; the wire
+            # object carries its context so the shard frontend's span
+            # nests under THIS one (dict(record) already relayed any
+            # caller context; an active trace overrides it)
+            sub = start_span(
+                "router.subrequest",
+                trace_id=getattr(trace, "trace_id", None),
+                parent_id=getattr(trace, "span_id", None),
+                shard=s,
+            )
+            if sub.trace_id is not None:
+                obj[TRACE_KEY] = sub.trace_id
+                obj[PARENT_KEY] = sub.span_id
             try:
                 t = self._transport(s)
-                pending[s] = (t, obj, t.send_request(obj))
+                pending[s] = (t, obj, t.send_request(obj), sub)
             except (TransportError, OSError):
-                pending[s] = (None, obj, None)
+                pending[s] = (None, obj, None, sub)
         # phase 2: gather; concurrent attempts overlap, so the per-shard
         # waits share the same absolute deadlines
-        for s, (t, obj, fut) in pending.items():
+        for s, (t, obj, fut, sub) in pending.items():
             resp = None
             if fut is not None:
                 try:
@@ -872,6 +908,7 @@ class ShardRouter:
                 and resp.get("status") == "ok"
                 and "fe" in resp
             )
+            sub.end(ok=ok)
             out[s] = resp if ok else None
             self.health[s].note(ok)
             self.metrics.record_subrequest(s, ok=ok)
@@ -918,9 +955,18 @@ class ShardRouter:
             else self.policy.subrequest_timeout_s
         )
         codes = self._codes_of(record)
+        # the root of the routed request's trace: one trace id per
+        # request, minted here (or joined from the caller's wire
+        # context); every sub-request and every shard-side span nests
+        # under it — "one connected trace per routed request"
+        wire_t, wire_p = wire_context(record)
+        sp = start_span(
+            "router.request", trace_id=wire_t, parent_id=wire_p,
+            uid=str(record.get("uid") or ""),
+        )
         try:
             outcome = self._score_once(
-                record, codes, budget_s, use_cache=True
+                record, codes, budget_s, use_cache=True, trace=sp
             )
             if outcome is None:
                 # generation moved mid-gather (a commit wave passed):
@@ -928,7 +974,7 @@ class ShardRouter:
                 # cold
                 self.metrics.record_generation_retry()
                 outcome = self._score_once(
-                    record, codes, budget_s, use_cache=False
+                    record, codes, budget_s, use_cache=False, trace=sp
                 )
             if outcome is None:
                 # still unsettled after one retry: fleet is mid-flip
@@ -939,6 +985,7 @@ class ShardRouter:
                     "attempts"
                 )
         except NoShardAvailable:
+            sp.end(status="refused")
             self.metrics.record(
                 ok=False,
                 degraded=False,
@@ -947,6 +994,13 @@ class ShardRouter:
                 latency_s=time.perf_counter() - t_start,
             )
             raise
+        sp.end(
+            status="ok",
+            fanout=outcome.fanout,
+            degraded=outcome.degraded,
+            cache_hit=outcome.cache_hit,
+            generation=outcome.generation,
+        )
         self.metrics.record(
             ok=True,
             degraded=outcome.degraded,
@@ -957,7 +1011,8 @@ class ShardRouter:
         return outcome
 
     def _score_once(
-        self, record, codes, budget_s: float, *, use_cache: bool
+        self, record, codes, budget_s: float, *, use_cache: bool,
+        trace=None,
     ) -> Optional[RoutedScore]:
         generation = self.generation
         cache_on = use_cache and self.cache.enabled
@@ -1007,7 +1062,7 @@ class ShardRouter:
             fanout_shards = [fe_shard]
         # -- scatter/gather -----------------------------------------------
         responses = (
-            self._scatter(record, fanout_shards, budget_s)
+            self._scatter(record, fanout_shards, budget_s, trace=trace)
             if fanout_shards else {}
         )
         live = {
@@ -1019,7 +1074,7 @@ class ShardRouter:
             for s in self._fallback_order(record):
                 if s in responses:
                     continue
-                extra = self._scatter(record, [s], budget_s)
+                extra = self._scatter(record, [s], budget_s, trace=trace)
                 if extra[s] is not None:
                     responses.update(extra)
                     live = {s: extra[s]}
@@ -1165,6 +1220,9 @@ class ShardRouter:
                 if resp is None or not resp.get("ok"):
                     for p in staged:
                         self._control(p, {"op": "abort_swap"})
+                    flight_recorder().record(
+                        "swap.fleet_abort", phase="stage", failed_shard=s,
+                    )
                     return {
                         "ok": False,
                         "phase": "stage",
@@ -1210,6 +1268,10 @@ class ShardRouter:
             with self._gen_lock:
                 self._generation = new_gen
                 purged = self.cache.purge_other_generations(new_gen)
+            flight_recorder().record(
+                "swap.fleet_commit", generation=new_gen,
+                shards=self.num_shards, cache_purged=purged,
+            )
             return {
                 "ok": True,
                 "generation": new_gen,

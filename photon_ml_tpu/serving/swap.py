@@ -45,6 +45,7 @@ from typing import Mapping, Optional
 import jax
 import jax.numpy as jnp
 
+from photon_ml_tpu.obs.flight_recorder import flight_recorder
 from photon_ml_tpu.serving.model_bank import (
     DEFAULT_ENTITY_PAD,
     ModelBank,
@@ -285,6 +286,12 @@ class ServingModel:
                     error=str(e),
                 )
                 self.swap_history.append(result)
+                flight_recorder().record(
+                    "swap.abort",
+                    error=result.error,
+                    rolled_back=result.rolled_back,
+                    quarantined=result.quarantined,
+                )
                 return result
             except SeamFailure as e:
                 result = SwapResult(
@@ -294,6 +301,12 @@ class ServingModel:
                     error=str(e),
                 )
                 self.swap_history.append(result)
+                flight_recorder().record(
+                    "swap.abort",
+                    error=result.error,
+                    rolled_back=result.rolled_back,
+                    quarantined=result.quarantined,
+                )
                 return result
 
             staged = build_model_bank(
@@ -358,6 +371,12 @@ class ServingModel:
                     error=str(e),
                 )
                 self.swap_history.append(result)
+                flight_recorder().record(
+                    "swap.abort",
+                    error=result.error,
+                    rolled_back=result.rolled_back,
+                    quarantined=result.quarantined,
+                )
                 return result
             except SeamFailure as e:
                 result = SwapResult(
@@ -367,6 +386,12 @@ class ServingModel:
                     error=str(e),
                 )
                 self.swap_history.append(result)
+                flight_recorder().record(
+                    "swap.abort",
+                    error=result.error,
+                    rolled_back=result.rolled_back,
+                    quarantined=result.quarantined,
+                )
                 return result
             staged = build_model_bank(
                 loaded,
@@ -403,6 +428,10 @@ class ServingModel:
         if staged.spec == prev.spec:
             _refresh_executable(staged.arrays)
         self._prepared = staged
+        flight_recorder().record(
+            "swap.stage", generation=staged.generation,
+            donated=staged.spec == prev.spec,
+        )
         return SwapResult(
             ok=True,
             generation=staged.generation,
@@ -426,6 +455,12 @@ class ServingModel:
                     error="no prepared generation to commit",
                 )
                 self.swap_history.append(result)
+                flight_recorder().record(
+                    "swap.abort",
+                    error=result.error,
+                    rolled_back=result.rolled_back,
+                    quarantined=result.quarantined,
+                )
                 return result
             # re-number against the CURRENT generation: another swap
             # may have landed between prepare and commit
@@ -438,7 +473,9 @@ class ServingModel:
         with self._stage_lock:
             had = self._prepared is not None
             self._prepared = None
-            return had
+        if had:
+            flight_recorder().record("swap.abort", reason="router abort")
+        return had
 
     def _flip(self, staged: ModelBank) -> SwapResult:  # photon: guarded-by(_stage_lock)
         prev = self.current()
@@ -482,6 +519,9 @@ class ServingModel:
             recompiled_programs=recompiled,
         )
         self.swap_history.append(result)
+        flight_recorder().record(
+            "swap.commit", generation=staged.generation, donated=donated,
+        )
         return result
 
 

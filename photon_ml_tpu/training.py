@@ -17,6 +17,7 @@ import jax.numpy as jnp
 
 from photon_ml_tpu.data.batch import Batch
 from photon_ml_tpu.models.glm import GeneralizedLinearModel
+from photon_ml_tpu.obs.trace import span as obs_span
 from photon_ml_tpu.ops.normalization import NormalizationContext
 from photon_ml_tpu.optim.common import BoxConstraints, OptResult
 from photon_ml_tpu.optim.config import (
@@ -254,10 +255,11 @@ def train_generalized_linear_model(
             # stop at the λ boundary: solved λs are snapshotted; the
             # restarted run resumes the sweep here
             break
-        coefficients, result = problem.run(
-            batch, initial=current, reg_weight=lam, mesh=mesh,
-            track_models=track_models,
-        )
+        with obs_span("glm.lambda_solve", reg_weight=lam):
+            coefficients, result = problem.run(
+                batch, initial=current, reg_weight=lam, mesh=mesh,
+                track_models=track_models,
+            )
         models[lam] = problem.create_model(coefficients, normalization)
         results[lam] = result
         if grid_checkpointer is not None:
@@ -481,10 +483,13 @@ def train_grid_batched(
             models[lam] = _model_from_snapshot(task, snap)
             results[lam] = _result_from_snapshot(snap["result"])
         return models, results
-    variances, result = problem.run_grid(
-        batch, weights_desc, initial=initial, mesh=mesh,
-        track_models=track_models,
-    )
+    with obs_span(
+        "glm.grid_solve", grid=len(weights_desc), batched=True
+    ):
+        variances, result = problem.run_grid(
+            batch, weights_desc, initial=initial, mesh=mesh,
+            track_models=track_models,
+        )
 
     from photon_ml_tpu.models.coefficients import Coefficients
 

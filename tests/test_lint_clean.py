@@ -338,6 +338,37 @@ class TestLintClean:
         dirty = analyze_source(path, stripped)
         assert [v for v in dirty.violations if v.rule == "PL008"]
 
+    def test_obs_subsystem_covered_clean_and_host_only(self, full_report):
+        """ISSUE 13: photon_ml_tpu/obs/ (trace, registry, flight
+        recorder, folded events) is in the analyzed set at the
+        zero-baseline bar — ZERO baseline entries and ZERO allow()
+        sites — and is structurally host-arithmetic-only: no obs module
+        imports jax in any form, so no obs code can ever touch a jax
+        value (the PL001 concern made impossible rather than merely
+        clean). Telemetry must never add a device sync, a lowering, or
+        a readback."""
+        obs_files = [
+            f for f in full_report.files
+            if "photon_ml_tpu/obs/" in f.replace(os.sep, "/")
+        ]
+        assert len(obs_files) >= 5, obs_files
+        entries = json.load(open(BASELINE))["entries"]
+        assert not [
+            e for e in entries
+            if "photon_ml_tpu/obs/" in e["file"].replace(os.sep, "/")
+        ], "obs code must not be baselined"
+        assert not [
+            s for s in full_report.allow_sites
+            if "photon_ml_tpu/obs/" in s.path.replace(os.sep, "/")
+        ], "obs code must not carry allow() suppressions"
+        jax_import = re.compile(r"^\s*(import\s+jax|from\s+jax)", re.M)
+        for f in obs_files:
+            src = open(os.path.join(REPO, f)).read()
+            assert not jax_import.search(src), (
+                f"{f}: obs code imports jax — telemetry is host "
+                "arithmetic only"
+            )
+
     def test_interleave_harness_is_analyzed(self, full_report):
         """The testing/ package (interleaving harness) is part of the
         analyzed set and holds the same bar — its own thread-shared
