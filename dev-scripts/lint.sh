@@ -3,11 +3,18 @@
 #   1. photon-lint — the project-specific JAX hot-path invariants
 #      (readback seam, recompile hazards, spill/IO hygiene) PLUS the
 #      whole-package concurrency pass (PL008 unguarded-shared-state,
-#      PL009 lock-order-inversion, PL010 atomicity-hygiene), which
-#      runs BY DEFAULT (opt out per-invocation with --no-concurrency);
-#      rules and suppression/baseline mechanics in photon_ml_tpu/lint/.
-#      PL009 findings are never baseline-able.
-#   2. ruff — generic hygiene (import order, unused imports/variables,
+#      PL009 lock-order-inversion, PL010 atomicity-hygiene) AND the
+#      whole-package SPMD pass (PL011 mesh-axis-discipline, PL012
+#      sharded-bank-host-gather, PL013 reduction-completeness, PL014
+#      donation-hygiene), both ON BY DEFAULT (opt out per-invocation
+#      with --no-concurrency / --no-spmd); rules and suppression/
+#      baseline mechanics in photon_ml_tpu/lint/. PL009 and PL012
+#      findings are never baseline-able.
+#   2. SHARDING.md drift gate — the committed sharding-contract
+#      inventory must match a fresh render of the SPMD pass's entry-
+#      point scan (regenerate with --write-sharding-md). Skipped when
+#      --no-spmd was passed.
+#   3. ruff — generic hygiene (import order, unused imports/variables,
 #      mutable default args; [tool.ruff] in pyproject.toml). Soft-skips
 #      when ruff is not installed so minimal CI containers still gate
 #      on photon-lint.
@@ -15,6 +22,15 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 python -m photon_ml_tpu.lint photon_ml_tpu bench.py "$@"
+
+skip_spmd=0
+for arg in "$@"; do
+    [ "$arg" = "--no-spmd" ] && skip_spmd=1
+done
+if [ "$skip_spmd" = 0 ]; then
+    python -m photon_ml_tpu.lint photon_ml_tpu bench.py \
+        --check-sharding-md SHARDING.md
+fi
 
 if command -v ruff >/dev/null 2>&1; then
     ruff check photon_ml_tpu bench.py tests dev-scripts

@@ -153,6 +153,7 @@ def _zeros_sharded(mesh, rows: int, d: int) -> Array:
 
         fn = _bounded_put(
             _ZEROS_CACHE, key,
+            # photon: sharding(axes=[entity], out=[entity])
             jax.jit(_make, out_shardings=_entity_sharding(mesh)),
         )
     return fn()
@@ -168,6 +169,7 @@ def _replicate(mesh, value: Array) -> Array:
 
         fn = _bounded_put(
             _REPL_CACHE, key,
+            # photon: sharding(axes=[entity], in=[entity], out=[r])
             jax.jit(_ident, out_shardings=NamedSharding(mesh, P())),
         )
     return fn(value)
@@ -224,6 +226,7 @@ class ShardedREBank:
         out = jnp.take(self.data, jnp.asarray(rows, jnp.int32), axis=0)
         return _replicate(self.mesh, out)
 
+    # photon: sharding(export)
     def __array__(self, dtype=None):
         # host materialization is an explicit, counted readback
         host = overlap.device_get(self.to_global())
@@ -291,6 +294,7 @@ def _build_update_program(solvers, kind: str, mesh, axis: str,
     ax = axis
     off_spec = (P(ax), P(ax)) if with_slots else (P(ax),)
 
+    # photon: sharding(axes=[entity], in=?, out=[entity,r,r,r], donates=[0])
     @partial(jax.jit, donate_argnums=_donate_args())
     @partial(
         jax.shard_map,
@@ -338,6 +342,7 @@ def _build_variance_program(solvers, mesh, axis: str,
     ax = axis
     off_spec = (P(ax), P(ax)) if with_slots else (P(ax),)
 
+    # photon: sharding(axes=[entity], in=?, out=[entity], donates=[0])
     @partial(jax.jit, donate_argnums=_donate_args())
     @partial(
         jax.shard_map,
@@ -376,6 +381,7 @@ def _build_chunk_score_program(mesh, axis: str, n_dev: int):
     chunk — never a bank gather, never a host crossing."""
     ax = axis
 
+    # photon: sharding(axes=[entity], in=[entity,r,r,r,r], out=[r])
     @jax.jit
     @partial(
         jax.shard_map,
@@ -406,6 +412,7 @@ def _build_score_program(mesh, axis: str, n_dev: int, cap: int):
     host crossings."""
     ax = axis
 
+    # photon: sharding(axes=[entity], in=[entity,*], out=[entity])
     @jax.jit
     @partial(
         jax.shard_map,
@@ -900,12 +907,14 @@ class PodRandomEffectModel(RandomEffectModel):
         self._var_cache: Optional[Array] = None
 
     @property
+    # photon: sharding(export)
     def bank(self) -> Array:
         if self._bank_cache is None:
             self._bank_cache = self.sharded_bank.to_global()
         return self._bank_cache
 
     @property
+    # photon: sharding(export)
     def variances(self) -> Optional[Array]:
         if self.variances_sharded is None:
             return None

@@ -448,6 +448,7 @@ def _bucket_solver(
 
         donate = (0,) if effective_platform() != "cpu" else ()
 
+        # photon: sharding(axes=[], donates=[0])
         @partial(jax.jit, donate_argnums=donate)
         def fused(bank_full, codes, ix, v, lab, off, w, l1, l2):
             sl = jnp.take(bank_full, codes, axis=0)
@@ -474,6 +475,7 @@ def _bucket_solver(
 
         donate = (0,) if effective_platform() != "cpu" else ()
 
+        # photon: sharding(axes=[], donates=[0])
         @partial(jax.jit, donate_argnums=donate)
         def fused_scan(bank_full, codes_s, ix_s, v_s, lab_s, off_s, w_s,
                        l1, l2):

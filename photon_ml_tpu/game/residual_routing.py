@@ -98,6 +98,7 @@ class ResidualRouter:
         flat_len_ = flat_len
         axis_ = self.axis
 
+        # photon: sharding(axes=[data], in=[data,data,data], out=[data])
         @jax.jit
         @partial(
             jax.shard_map,
@@ -229,6 +230,7 @@ class PodResidualRouter:
         n_dev_ = n_dev
         axis_ = self.axis
 
+        # photon: sharding(axes=[entity], in=[entity,entity], out=[entity])
         @jax.jit
         @partial(
             jax.shard_map,
@@ -248,6 +250,7 @@ class PodResidualRouter:
 
         self._route_in = _route_in
 
+        # photon: sharding(axes=[entity], in=[entity,entity], out=[entity])
         @jax.jit
         @partial(
             jax.shard_map,

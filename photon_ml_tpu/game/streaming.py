@@ -1460,6 +1460,7 @@ class StreamingCoordinateDescent:
             )
         return total
 
+    # photon: sharding(export)
     def _export_model(self, states, variances):
         """States -> a GameModel of the standard model classes, so
         save_game_model and the scoring driver work unchanged on a

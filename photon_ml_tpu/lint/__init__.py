@@ -32,12 +32,29 @@ id          slug                    protects
 ``PL010``   atomicity-hygiene       no stale check-then-act across a lock
                                     release; no callbacks/blocking/foreign
                                     locks inside Condition-backed sections
+``PL011``   mesh-axis-discipline    axis names reference the mesh constants;
+                                    every jit/shard_map entry point carries a
+                                    cross-checked ``# photon: sharding(...)``
+                                    contract (the SHARDING.md inventory)
+``PL012``   sharded-bank-host-      no host/replicated materialization of an
+            gather                  entity-/feature-sharded bank outside a
+                                    declared export/checkpoint scope — NEVER
+                                    baseline-able
+``PL013``   reduction-completeness  shard_map bodies psum what their out_specs
+                                    claim replicated, only over sharded axes
+``PL014``   donation-hygiene        donated arguments are dead after the
+                                    donating call
 ==========  ======================  ===========================================
 
 PL008-PL010 are the concurrency pass (two-pass whole-package analysis:
 class guard maps, the cross-module lock graph, thread-escape); their
 runtime twin is the deterministic interleaving harness in
-``photon_ml_tpu/testing/interleave.py``.
+``photon_ml_tpu/testing/interleave.py``. PL011-PL014 are the SPMD pass
+(``lint/spmd.py``): axis-constant resolution, the mesh entry-point
+inventory behind the generated ``SHARDING.md``
+(``lint/sharding_contracts.py``), sharded-bank taint and per-body
+reduction dataflow. Opt out per-invocation with ``--no-concurrency`` /
+``--no-spmd``.
 
 Usage::
 

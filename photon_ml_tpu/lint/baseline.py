@@ -18,10 +18,20 @@ from photon_ml_tpu.lint.core import Report, Violation
 BASELINE_VERSION = 1
 
 # Rules whose violations may never be grandfathered. A lock-order
-# inversion (PL009) is a deadlock with a schedule attached — baselining
-# one ships the schedule; write_baseline refuses and load_baseline
-# rejects hand-edited entries.
-NEVER_BASELINE = frozenset({"PL009"})
+# inversion (PL009) is a deadlock with a schedule attached, and a host
+# gather of a sharded bank (PL012) silently un-shards the pod story on
+# exactly the paths that only fail at fleet scale — baselining either
+# ships the failure; write_baseline refuses and load_baseline rejects
+# hand-edited entries.
+NEVER_BASELINE = frozenset({"PL009", "PL012"})
+
+_NEVER_BASELINE_WHY = {
+    "PL009": "lock-order inversions are never baseline-able; fix the "
+             "acquisition order instead",
+    "PL012": "sharded-bank host gathers are never baseline-able; make "
+             "the access shard-local or declare a sharding(export) "
+             "scope instead",
+}
 
 Key = Tuple[str, str, str]
 
@@ -48,8 +58,7 @@ def load_baseline(path: str) -> Counter:
         if e["rule"] in NEVER_BASELINE:
             raise ValueError(
                 f"baseline {path} grandfathers {e['rule']} "
-                f"({e['file']}) — lock-order inversions are never "
-                "baseline-able; fix the acquisition order instead"
+                f"({e['file']}) — {_NEVER_BASELINE_WHY[e['rule']]}"
             )
         allow[(e["file"], e["rule"], e["snippet"])] += int(
             e.get("count", 1)
@@ -61,11 +70,14 @@ def write_baseline(path: str, violations: Sequence[Violation]) -> dict:
     refused = [v for v in violations if v.rule in NEVER_BASELINE]
     if refused:
         sites = ", ".join(v.location() for v in refused[:5])
+        why = "; ".join(sorted({
+            _NEVER_BASELINE_WHY[v.rule] for v in refused
+        }))
         raise BaselineRefused(
             f"{len(refused)} {sorted({v.rule for v in refused})} "
             f"violation(s) cannot be grandfathered ({sites}"
-            f"{', ...' if len(refused) > 5 else ''}) — fix the lock "
-            "acquisition order; no baseline was written"
+            f"{', ...' if len(refused) > 5 else ''}) — {why}; no "
+            "baseline was written"
         )
     counts: Counter = Counter(baseline_key(v) for v in violations)
     entries: List[dict] = [

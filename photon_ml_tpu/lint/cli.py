@@ -33,11 +33,15 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m photon_ml_tpu.lint",
         description=(
             "AST-based invariant checker for the JAX hot path "
-            "(readback seam, recompile hazards, spill/IO hygiene) and "
+            "(readback seam, recompile hazards, spill/IO hygiene), "
             "the thread plane (guard discipline, lock ordering, "
-            "atomicity — a whole-package pass, on by default). "
-            "Suppress a line with '# photon: allow(<rule>)'; declare "
-            "guard discipline with '# photon: guarded-by(<lock>)'."
+            "atomicity) and the SPMD plane (mesh-axis discipline, "
+            "sharded-bank host gathers, reduction completeness, "
+            "donation hygiene) — both whole-package passes on by "
+            "default. Suppress a line with '# photon: allow(<rule>)'; "
+            "declare guard discipline with "
+            "'# photon: guarded-by(<lock>)' and sharding contracts "
+            "with '# photon: sharding(axes=..., in=..., out=...)'."
         ),
     )
     p.add_argument(
@@ -71,6 +75,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="skip the whole-package concurrency pass (PL008-PL010); "
              "the pass runs by default",
     )
+    p.add_argument(
+        "--no-spmd", action="store_true",
+        help="skip the whole-package SPMD pass (PL011-PL014 + sharding "
+             "contracts); the pass runs by default",
+    )
+    p.add_argument(
+        "--write-sharding-md", nargs="?", const="SHARDING.md",
+        default=None, metavar="PATH",
+        help="regenerate the sharding-contract inventory (default "
+             "SHARDING.md) from the analyzed paths and exit",
+    )
+    p.add_argument(
+        "--check-sharding-md", nargs="?", const="SHARDING.md",
+        default=None, metavar="PATH",
+        help="exit 1 if the committed sharding inventory drifted from "
+             "a fresh render (the CI drift gate)",
+    )
     return p
 
 
@@ -89,7 +110,34 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         )
         return 2
 
-    report = analyze_paths(paths, package_pass=not args.no_concurrency)
+    if args.write_sharding_md or args.check_sharding_md:
+        from photon_ml_tpu.lint import sharding_contracts as sc
+
+        pkg = sc.package_context(paths)
+        if pkg is None:
+            print("photon-lint: no parseable files", file=sys.stderr)
+            return 2
+        if args.write_sharding_md:
+            content = sc.write_sharding_md(args.write_sharding_md, pkg)
+            n = len(sc.inventory(pkg))
+            print(
+                f"photon-lint: wrote {n} sharding contract(s) "
+                f"({len(content.splitlines())} lines) to "
+                f"{args.write_sharding_md}"
+            )
+            return 0
+        drift = sc.check_sharding_md(args.check_sharding_md, pkg)
+        if drift is not None:
+            print(f"photon-lint: {drift}", file=sys.stderr)
+            return 1
+        print(f"photon-lint: {args.check_sharding_md} is up to date")
+        return 0
+
+    report = analyze_paths(
+        paths,
+        package_pass=not args.no_concurrency,
+        spmd_pass=not args.no_spmd,
+    )
 
     baseline_path = args.baseline or (
         DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None
@@ -124,23 +172,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         exit_code = 2
 
     if args.as_json:
-        print(json.dumps(
-            {
-                "version": 1,
-                "files_checked": len(report.files),
-                "violations": [v.to_dict() for v in report.violations],
-                "baselined": report.baselined,
-                "allow_sites": [
-                    s.to_dict() for s in report.allow_sites
-                ],
-                "unused_baseline": report.unused_baseline,
-                "errors": [
-                    {"file": f, "message": m} for f, m in report.errors
-                ],
-                "exit_code": exit_code,
-            },
-            indent=2,
-        ))
+        payload = {
+            "version": 1,
+            "files_checked": len(report.files),
+            "violations": [v.to_dict() for v in report.violations],
+            "baselined": report.baselined,
+            "allow_sites": [
+                s.to_dict() for s in report.allow_sites
+            ],
+            "unused_baseline": report.unused_baseline,
+            "errors": [
+                {"file": f, "message": m} for f, m in report.errors
+            ],
+            "exit_code": exit_code,
+        }
+        if report.package is not None and not args.no_spmd:
+            from photon_ml_tpu.lint import sharding_contracts as sc
+
+            payload["sharding_contracts"] = sc.inventory(report.package)
+            payload["export_scopes"] = sc.export_scopes(report.package)
+        print(json.dumps(payload, indent=2))
         return exit_code
 
     for f, m in report.errors:

@@ -68,13 +68,16 @@ class Rule:
 class PackageRule:
     """A rule that needs the WHOLE analyzed file set at once — the
     concurrency pass (PL008-PL010) builds per-class guard maps and a
-    cross-module lock-acquisition graph, neither of which exists at
-    single-file granularity."""
+    cross-module lock-acquisition graph, and the SPMD pass (PL011-PL014)
+    builds the package-wide mesh-entry-point inventory; neither exists
+    at single-file granularity. ``group`` names the pass so the CLI can
+    opt out of one without the other (--no-concurrency / --no-spmd)."""
 
     id: str
     slug: str
     doc: str
     check: Callable[["PackageContext"], Iterable[Violation]]
+    group: str = "concurrency"
 
 
 RULES: Dict[str, Rule] = {}
@@ -121,6 +124,16 @@ _ALLOW_RE = re.compile(r"#\s*photon:\s*allow\(\s*([A-Za-z0-9_\-,\s]*?)\s*\)")
 _GUARDED_RE = re.compile(
     r"#\s*photon:\s*guarded-by\(\s*([A-Za-z_][A-Za-z0-9_]*)\s*\)"
 )
+
+# The sharding-contract declaration (SPMD pass, PL011/PL012):
+#   # photon: sharding(axes=[entity], in=[entity,r], out=[r])
+# on (or directly above) the def line of a jit/shard_map mesh entry
+# point declares which mesh axes it maps over and the per-argument
+# partition specs; the bare token ``export`` declares an export/
+# checkpoint scope in which host-materializing a sharded bank (PL012)
+# is legitimate. Like guarded-by, these are DECLARATIONS the analyzer
+# cross-checks against the code — never suppressions.
+_SHARDING_RE = re.compile(r"#\s*photon:\s*sharding\(([^)]*)\)")
 
 
 @dataclass
@@ -177,6 +190,8 @@ class FileContext:
         self._suppressed: Dict[int, Set[str]] = {}
         # line -> guard token from '# photon: guarded-by(<lock>|atomic)'
         self.guard_annotations: Dict[int, str] = {}
+        # line -> raw arg string from '# photon: sharding(<args>)'
+        self.sharding_annotations: Dict[int, str] = {}
         self._scan_comments()
         # import aliases
         self.jax_modules: Set[str] = set()  # names aliasing jax[. ...]
@@ -311,6 +326,9 @@ class FileContext:
             g = _GUARDED_RE.search(tok.string)
             if g:
                 self.guard_annotations[tok.start[0]] = g.group(1)
+            sh = _SHARDING_RE.search(tok.string)
+            if sh:
+                self.sharding_annotations[tok.start[0]] = sh.group(1)
             m = _ALLOW_RE.search(tok.string)
             if not m:
                 continue
@@ -1277,26 +1295,45 @@ class Report:
     # filled by baseline application (cli)
     baselined: int = 0
     unused_baseline: List[dict] = field(default_factory=list)
+    # the PackageContext of the run's second pass (when any package
+    # group ran): the sharding-contract inventory reads it back out
+    package: Optional["PackageContext"] = None
+
+
+def _package_groups(
+    package_pass: bool, spmd_pass: bool,
+) -> Set[str]:
+    groups: Set[str] = set()
+    if package_pass:
+        groups.add("concurrency")
+    if spmd_pass:
+        groups.add("spmd")
+    return groups
 
 
 def _run_package_rules(
-    report: Report, contexts: Sequence[FileContext],
-) -> None:
+    report: Report, contexts: Sequence[FileContext], groups: Set[str],
+) -> Optional["PackageContext"]:
     """The second pass: rules that need every file at once (the
-    concurrency analyzer). Suppressions are honored per owning file."""
-    if not contexts:
-        return
+    concurrency analyzer, the SPMD/sharding-contract analyzer).
+    Suppressions are honored per owning file."""
+    if not contexts or not groups:
+        return None
     pkg = PackageContext(contexts)
     by_path = {ctx.path: ctx for ctx in contexts}
     for rule in PACKAGE_RULES.values():
+        if rule.group not in groups:
+            continue
         for v in rule.check(pkg):
             ctx = by_path.get(v.path)
             if ctx is None or not ctx.suppressed(v):
                 report.violations.append(v)
+    return pkg
 
 
 def analyze_source(
     path: str, source: str, package_pass: bool = True,
+    spmd_pass: bool = True,
 ) -> Report:
     """Run every registered rule over one in-memory source blob (the
     package pass runs degenerately over the single file)."""
@@ -1311,8 +1348,9 @@ def analyze_source(
         for v in rule.check(ctx):
             if not ctx.suppressed(v):
                 report.violations.append(v)
-    if package_pass:
-        _run_package_rules(report, [ctx])
+    report.package = _run_package_rules(
+        report, [ctx], _package_groups(package_pass, spmd_pass)
+    )
     report.allow_sites.extend(ctx.allow_sites)
     report.violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return report
@@ -1320,6 +1358,7 @@ def analyze_source(
 
 def analyze_paths(
     paths: Sequence[str], package_pass: bool = True,
+    spmd_pass: bool = True,
 ) -> Report:
     _load_rules()
     report = Report()
@@ -1343,7 +1382,8 @@ def analyze_paths(
                     report.violations.append(v)
         report.allow_sites.extend(ctx.allow_sites)
         contexts.append(ctx)
-    if package_pass:
-        _run_package_rules(report, contexts)
+    report.package = _run_package_rules(
+        report, contexts, _package_groups(package_pass, spmd_pass)
+    )
     report.violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return report

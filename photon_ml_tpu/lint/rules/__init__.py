@@ -2,10 +2,14 @@
 
 from photon_ml_tpu.lint.rules import (  # noqa: F401
     atomicity,
+    donation,
+    host_gather,
     host_sync,
     io_drain,
     lock_order,
+    mesh_axis,
     recompile,
+    reduction,
     reliability,
     request_path,
     shared_state,

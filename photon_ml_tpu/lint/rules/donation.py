@@ -1,0 +1,201 @@
+"""PL014 donation-hygiene: a donated argument is dead after the call.
+
+``donate_argnums`` hands the argument's buffers to XLA for aliasing —
+on any non-CPU backend the caller's array is INVALIDATED by the call.
+The donated-swap (serving/swap.py) and the grid/pod bank paths do this
+correctly by hand today (rebind-the-result or defensive-copy-first);
+nothing checked it, and the failure mode is a delocalized
+"buffer has been deleted" error (or silent garbage under older
+runtimes) far from the donating call.
+
+Per file, the rule resolves which callables donate:
+
+- ``@partial(jax.jit, donate_argnums=...)`` decorated defs and
+  ``name = jax.jit(f, donate_argnums=...)`` assignments;
+- ``donate_argnums`` values through one level of indirection — a
+  literal tuple, a local variable bound to one (including the
+  ``(0,) if chip else ()`` conditional), or a call to a local helper
+  whose returns are literal tuples (the ``_donate_args()`` pattern:
+  the union of possible donations is checked, so CPU-only runs don't
+  mask the chip hazard);
+- **builders**: a local def that returns a donating callable marks
+  every name assigned from an expression referencing it (directly or
+  through a cache-insert lambda) as donating — the
+  ``_cached_program(..., lambda: _build_update_program(...))`` shape.
+
+At each call through a donating name, a donated POSITIONAL argument
+that is a plain name must either be rebound by the call's own
+assignment targets (the swap idiom: ``bank, stats = fused(bank, ...)``)
+or never referenced again in the enclosing scope. Attribute/subscript
+arguments are not tracked (aliasing through objects is the interleave
+harness's job, not syntax's).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from photon_ml_tpu.lint import spmd
+from photon_ml_tpu.lint.core import (
+    FileContext,
+    PackageContext,
+    PackageRule,
+    Violation,
+    register_package,
+)
+
+
+def _donating_defs(model: spmd.SpmdFileModel) -> Dict[str, List[int]]:
+    """def/assign name -> donated argnums, from the SPMD entry scan."""
+    out: Dict[str, List[int]] = {}
+    for entry in model.entries:
+        if entry.donates:
+            leaf = entry.qualname.rsplit(".", 1)[-1]
+            if leaf and not leaf.startswith("<"):
+                out[leaf] = entry.donates
+    return out
+
+
+def _builder_defs(ctx: FileContext, model: spmd.SpmdFileModel,
+                  donating: Dict[str, List[int]]) -> Dict[str, List[int]]:
+    """Local defs that RETURN a donating callable (by reference)."""
+    out: Dict[str, List[int]] = {}
+    changed = True
+    known = dict(donating)
+    while changed:
+        changed = False
+        for name, fn in model.local_defs.items():
+            if name in known:
+                continue
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Return) or sub.value is None:
+                    continue
+                for leaf in ast.walk(sub.value):
+                    if isinstance(leaf, ast.Name) and leaf.id in known \
+                            and leaf.id != name:
+                        out[name] = known[leaf.id]
+                        known[name] = known[leaf.id]
+                        changed = True
+                        break
+                if name in known:
+                    break
+    return out
+
+
+def _names_in(expr: ast.AST) -> Iterator[str]:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+
+
+def _assign_targets(stmt: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(stmt, ast.Assign):
+        for tgt in stmt.targets:
+            for sub in ast.walk(tgt):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+    return out
+
+
+def _enclosing_stmt(ctx: FileContext, node: ast.AST) -> Optional[ast.AST]:
+    """Nearest enclosing STATEMENT (the Assign/Expr/... the call sits
+    in) — NOT the top-level scope child, so a donating call inside a
+    loop pairs with its own assignment's rebinds."""
+    cur: Optional[ast.AST] = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = ctx.parent(cur)
+    if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    return cur if isinstance(cur, ast.stmt) else None
+
+
+def _file_violations(
+    ctx: FileContext, model: spmd.SpmdFileModel,
+) -> Iterator[Violation]:
+    donating = _donating_defs(model)
+    if donating:
+        donating = dict(donating)
+        donating.update(_builder_defs(ctx, model, donating))
+    if not donating:
+        return
+    scopes = [ctx.tree] + [
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    reported = set()  # (call id, argnum) — scopes overlap on nested defs
+    for scope in scopes:
+        # names in this scope bound from a donating/builder reference
+        local_donating: Dict[str, List[int]] = {}
+        for node in ctx.walk_scope(scope):
+            if not isinstance(node, ast.Assign):
+                continue
+            argnums: Optional[List[int]] = None
+            for ref in _names_in(node.value):
+                if ref in donating:
+                    argnums = donating[ref]
+                    break
+            if argnums:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        local_donating[t.id] = argnums
+        callmap = dict(donating)
+        callmap.update(local_donating)
+        for node in ctx.walk_scope(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Name):
+                continue
+            argnums = callmap.get(node.func.id)
+            if not argnums:
+                continue
+            stmt = _enclosing_stmt(ctx, node)
+            if stmt is None:
+                continue
+            rebound = _assign_targets(stmt)
+            end = getattr(stmt, "end_lineno", stmt.lineno)
+            for i in argnums:
+                if i >= len(node.args) or (id(node), i) in reported:
+                    continue
+                arg = node.args[i]
+                if not isinstance(arg, ast.Name):
+                    continue  # attribute/subscript donation untracked
+                if arg.id in rebound:
+                    continue  # the swap idiom: result replaces donor
+                reported.add((id(node), i))
+                for later in ctx.walk_scope(scope):
+                    if (
+                        isinstance(later, ast.Name)
+                        and later.id == arg.id
+                        and isinstance(later.ctx, ast.Load)
+                        and getattr(later, "lineno", 0) > end
+                    ):
+                        yield ctx.violation(RULE, later, (
+                            f"'{arg.id}' was donated to "
+                            f"'{node.func.id}' (donate_argnums includes "
+                            f"{i}) on line {node.lineno} and is "
+                            "referenced afterwards — on a non-CPU "
+                            "backend its buffer is invalidated by the "
+                            "call; rebind the result over the donor or "
+                            "copy before donating"
+                        ))
+                        break
+
+
+def _check(pkg: PackageContext) -> Iterator[Violation]:
+    idx = spmd.index(pkg)
+    for path in sorted(pkg.contexts):
+        yield from _file_violations(pkg.contexts[path], idx.models[path])
+
+
+RULE = register_package(
+    PackageRule(
+        id="PL014",
+        slug="donation-hygiene",
+        doc="arguments donated via donate_argnums are never referenced "
+            "after the donating call",
+        check=_check,
+        group="spmd",
+    )
+)

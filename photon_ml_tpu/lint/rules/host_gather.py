@@ -1,0 +1,335 @@
+"""PL012 sharded-bank-host-gather: no host (or replicated)
+materialization of an entity-/feature-sharded bank outside a declared
+export/checkpoint scope.
+
+The ROADMAP's multi-host warm-start rule — "alignment must happen
+shard-local, never via a host [E, d] gather" — is currently upheld by
+hand: the pod CD path routes residuals device-side and only the export/
+checkpoint surfaces call ``ShardedREBank.to_global()``. This rule makes
+that structural. Values are tainted as SHARDED when they provably hold a
+sharded bank:
+
+- constructed via ``ShardedREBank(...)`` / ``.zeros(...)`` /
+  ``.from_global(...)``;
+- loaded from a ``.sharded_bank`` / ``.variances_sharded`` attribute
+  (or ``getattr(x, "sharded_bank", ...)``);
+- parameters/returns annotated ``ShardedREBank``;
+- guarded by ``isinstance(x, ShardedREBank)``;
+- returned by a local function the above taints (one-hop, per file);
+- ``self`` inside ``ShardedREBank``'s own methods, and the ``.data``
+  attribute / subscripts of any tainted value.
+
+Sinks on a tainted value — ``.to_global()``, ``device_get`` (raw OR the
+counted ``overlap`` seam: counting a full-bank gather does not make it
+shard-local), ``np.asarray``/``np.array`` — are violations unless the
+enclosing def (or an enclosing scope) is declared
+``# photon: sharding(export)`` (alias ``checkpoint``), or the file IS
+``parallel/overlap.py`` (the seam's own plumbing). The declaration is
+an audited inventory entry (SHARDING.md lists every export scope), not
+a suppression.
+
+Like PL009, PL012 is **never baseline-able**: a host gather on a
+non-export path defeats the sharding story silently at pod scale, so
+``--write-baseline`` refuses (exit 2) and hand-edited PL012 baseline
+entries are rejected at load. Scope: package code
+(``photon_ml_tpu/``) — bench/test parity harnesses legitimately
+materialize replicated views to compare against.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from photon_ml_tpu.lint import spmd
+from photon_ml_tpu.lint.core import (
+    FileContext,
+    PackageContext,
+    PackageRule,
+    Violation,
+    attr_root,
+    call_name,
+    register_package,
+)
+
+_BANK_CLASS = "ShardedREBank"
+_SOURCE_ATTRS = {"sharded_bank", "variances_sharded"}
+_BANK_CLASSMETHODS = {"zeros", "from_global"}
+# jnp reductions produce scalars/rows, not bank-shaped values
+_REDUCING_TAILS = {"sum", "mean", "max", "min", "vdot", "dot", "prod"}
+
+
+def _is_bank_name(expr: ast.AST) -> bool:
+    return (
+        isinstance(expr, ast.Name) and expr.id == _BANK_CLASS
+    ) or (
+        isinstance(expr, ast.Attribute) and expr.attr == _BANK_CLASS
+    )
+
+
+def _annotation_mentions_bank(ann: Optional[ast.AST]) -> bool:
+    if ann is None:
+        return False
+    for sub in ast.walk(ann):
+        if isinstance(sub, ast.Name) and sub.id == _BANK_CLASS:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == _BANK_CLASS:
+            return True
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and _BANK_CLASS in sub.value:
+            return True
+    return False
+
+
+class _FileTaint:
+    """Per-file sharded-bank taint: scope-local name sets plus a
+    name-keyed map of local functions/methods whose RETURN is tainted
+    (one-hop call resolution, fixpointed twice)."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.tainted_fns: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if _annotation_mentions_bank(node.returns):
+                    self.tainted_fns.add(node.name)
+        for _ in range(2):
+            before = len(self.tainted_fns)
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if node.name in self.tainted_fns:
+                    continue
+                env = self.scope_taint(node)
+                for sub in self.ctx.walk_scope(node):
+                    if isinstance(sub, ast.Return) and sub.value is not \
+                            None and self.tainted(sub.value, env):
+                        self.tainted_fns.add(node.name)
+                        break
+            if len(self.tainted_fns) == before:
+                break
+        self._env_cache = {}
+
+    # -- scope environment ---------------------------------------------------
+
+    def _self_is_bank(self, scope: ast.AST) -> bool:
+        for anc in [scope] + list(self.ctx.ancestors(scope)):
+            if isinstance(anc, ast.ClassDef):
+                return anc.name == _BANK_CLASS
+        return False
+
+    def scope_taint(self, scope: ast.AST) -> Set[str]:
+        key = id(scope)
+        cached = self._env_cache.get(key) if hasattr(self, "_env_cache") \
+            else None
+        if cached is not None:
+            return cached
+        env: Set[str] = set()
+        if self._self_is_bank(scope):
+            env.add("self")
+        # annotated parameters
+        if hasattr(scope, "args"):
+            a = scope.args
+            for p in list(a.posonlyargs) + list(a.args) + \
+                    list(a.kwonlyargs):
+                if _annotation_mentions_bank(p.annotation):
+                    env.add(p.arg)
+        # isinstance guards: inside `if isinstance(x, ShardedREBank):`
+        # x is a bank (scope-global over-approximation; the sinks this
+        # rule hunts only appear on the guarded path in practice)
+        for node in self.ctx.walk_scope(scope):
+            if isinstance(node, ast.If) and isinstance(
+                node.test, ast.Call
+            ) and call_name(node.test) == "isinstance" and len(
+                node.test.args
+            ) == 2:
+                tgt, cls = node.test.args
+                if isinstance(tgt, ast.Name) and _is_bank_name_or_tuple(
+                    cls
+                ):
+                    env.add(tgt.id)
+        # assignment fixpoint
+        for _ in range(6):
+            before = len(env)
+            for node in self.ctx.walk_scope(scope):
+                if isinstance(node, ast.Assign):
+                    if self.tainted(node.value, env):
+                        for tgt in node.targets:
+                            _add_target(tgt, env)
+                elif isinstance(node, ast.AnnAssign) and node.value is \
+                        not None:
+                    if self.tainted(node.value, env) or \
+                            _annotation_mentions_bank(node.annotation):
+                        _add_target(node.target, env)
+            if len(env) == before:
+                break
+        if hasattr(self, "_env_cache"):
+            self._env_cache[key] = env
+        return env
+
+    # -- expression classification -------------------------------------------
+
+    def tainted(self, expr: ast.AST, env: Set[str]) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in env
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _SOURCE_ATTRS:
+                return True
+            if expr.attr == "data":
+                return self.tainted(expr.value, env)
+            return False
+        if isinstance(expr, ast.Subscript):
+            return self.tainted(expr.value, env)
+        if isinstance(expr, ast.Call):
+            func = expr.func
+            if _is_bank_name(func):
+                return True
+            if isinstance(func, ast.Attribute) and func.attr in \
+                    _BANK_CLASSMETHODS and _is_bank_name(func.value):
+                return True
+            if call_name(expr) == "getattr" and len(expr.args) >= 2:
+                a1 = expr.args[1]
+                if isinstance(a1, ast.Constant) and a1.value in \
+                        _SOURCE_ATTRS:
+                    return True
+            # one-hop: local function / self-method with tainted return
+            if isinstance(func, ast.Name) and func.id in \
+                    self.tainted_fns:
+                return True
+            if isinstance(func, ast.Attribute) and isinstance(
+                func.value, ast.Name
+            ) and func.value.id in ("self", "cls") and func.attr in \
+                    self.tainted_fns:
+                return True
+            # jnp/np numeric ops propagate bank shape — except scalar
+            # reductions; every other callee is assumed to consume the
+            # bank (a function OF a bank usually reduces it)
+            root = attr_root(func) if isinstance(func, ast.Attribute) \
+                else None
+            if root is not None and (
+                root.id in self.ctx.jax_modules
+                or root.id in self.ctx.numpy_modules
+            ):
+                tail = func.attr if isinstance(func, ast.Attribute) \
+                    else ""
+                if tail in _REDUCING_TAILS:
+                    return False
+                return any(
+                    self.tainted(a, env) for a in expr.args
+                )
+            return False
+        if isinstance(expr, ast.IfExp):
+            return self.tainted(expr.body, env) or self.tainted(
+                expr.orelse, env
+            )
+        if isinstance(expr, (ast.BoolOp,)):
+            return any(self.tainted(v, env) for v in expr.values)
+        if isinstance(expr, ast.BinOp):
+            return self.tainted(expr.left, env) or self.tainted(
+                expr.right, env
+            )
+        if isinstance(expr, ast.Starred):
+            return self.tainted(expr.value, env)
+        return False
+
+
+def _is_bank_name_or_tuple(expr: ast.AST) -> bool:
+    if _is_bank_name(expr):
+        return True
+    if isinstance(expr, ast.Tuple):
+        return any(_is_bank_name(e) for e in expr.elts)
+    return False
+
+
+def _add_target(target: ast.AST, env: Set[str]) -> None:
+    if isinstance(target, ast.Name):
+        env.add(target.id)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        # conservative: a tainted RHS tuple taints every target — the
+        # common shape is `bank, tracker = update(...)` where only the
+        # bank is sharded, but over-tainting a tracker name never
+        # reaches a sink
+        for e in target.elts:
+            _add_target(e, env)
+
+
+def _file_violations(
+    ctx: FileContext, model: spmd.SpmdFileModel,
+) -> Iterator[Violation]:
+    if ctx.path.endswith("parallel/overlap.py"):
+        return
+    if "photon_ml_tpu" not in ctx.path_parts():
+        return
+    src = ctx.source
+    if _BANK_CLASS not in src and "sharded_bank" not in src:
+        return  # fast path: nothing bank-shaped in this file
+    taint = _FileTaint(ctx)
+    scopes = [ctx.tree] + [
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    seen: Set[int] = set()
+    for scope in scopes:
+        env = taint.scope_taint(scope)
+        if not env and not taint.tainted_fns:
+            continue
+        for node in ctx.walk_scope(scope):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            sink = _sink_kind(ctx, node, env, taint)
+            if sink is None:
+                continue
+            seen.add(id(node))
+            if spmd.in_export_scope(ctx, node, model):
+                continue
+            yield ctx.violation(RULE, node, (
+                f"{sink} materializes an entity-/feature-sharded bank "
+                "off its shards — alignment and scoring must stay "
+                "shard-local (ROADMAP: never a host [E, d] gather). "
+                "If this IS an export/checkpoint surface, declare the "
+                "enclosing def '# photon: sharding(export)' so the "
+                "scope is inventoried; otherwise route the access "
+                "through the sharded program family"
+            ))
+
+
+def _sink_kind(ctx: FileContext, call: ast.Call, env,
+               taint: _FileTaint) -> Optional[str]:
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr == "to_global":
+        if taint.tainted(func.value, env):
+            return ".to_global()"
+        return None
+    name = call_name(call)
+    if name == "device_get" and call.args:
+        if taint.tainted(call.args[0], env):
+            return "device_get"
+        return None
+    if isinstance(func, ast.Attribute) and func.attr in (
+        "asarray", "array"
+    ):
+        root = attr_root(func)
+        if root is not None and root.id in ctx.numpy_modules and \
+                call.args and taint.tainted(call.args[0], env):
+            return f"np.{func.attr}"
+    return None
+
+
+def _check(pkg: PackageContext) -> Iterator[Violation]:
+    idx = spmd.index(pkg)
+    for path in sorted(pkg.contexts):
+        yield from _file_violations(pkg.contexts[path], idx.models[path])
+
+
+RULE = register_package(
+    PackageRule(
+        id="PL012",
+        slug="sharded-bank-host-gather",
+        doc="no host/replicated materialization of a sharded bank "
+            "outside a declared export/checkpoint scope (never "
+            "baseline-able)",
+        check=_check,
+        group="spmd",
+    )
+)

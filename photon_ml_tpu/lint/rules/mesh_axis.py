@@ -1,0 +1,248 @@
+"""PL011 mesh-axis-discipline: axis names are constants, and every mesh
+entry point carries a machine-checked sharding contract.
+
+Two failure families this rule turns from runtime XLA errors (or silent
+drift) into lint failures:
+
+1. **Axis-name literals.** Every axis-name string passed to
+   ``lax.psum``/``pmean``/``all_to_all``/``all_gather``/``P(...)``/
+   ``shard_map(..., mesh=...)``/``Mesh(axis_names=...)`` — or bound as
+   an axis-parameter default — must reference a ``parallel/mesh.py``
+   constant (``DATA_AXIS``/``MODEL_AXIS``/``ENTITY_AXIS``). A literal
+   that matches a canonical axis is a drift hazard (renaming the
+   constant silently strands it); a literal that matches nothing is a
+   stale or typo'd axis that would only fail at mesh-binding time.
+   ``parallel/mesh.py`` itself — the one legitimate home of the literal
+   spellings — is exempt.
+
+2. **Sharding contracts.** Every jit/shard_map mesh entry point in
+   package code must carry a ``# photon: sharding(axes=..., in=...,
+   out=...)`` declaration on its def line, and the declaration is
+   CROSS-CHECKED against the code: declared axes must be canonical,
+   importable in the module, cover every axis the specs resolve, and
+   match the number of distinct axis bindings; literal in/out spec
+   lists and resolvable donate_argnums are compared element-wise. A
+   declaration is a contract, never a suppression — a contract that
+   drifts from the code is itself the violation, which is what keeps
+   the generated SHARDING.md (lint/sharding_contracts.py) a trustworthy
+   map for the unified-mesh refactor.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set, Tuple
+
+from photon_ml_tpu.lint import spmd
+from photon_ml_tpu.lint.core import (
+    FileContext,
+    PackageContext,
+    PackageRule,
+    Violation,
+    call_name,
+    register_package,
+)
+
+_CONST_HINT = "DATA_AXIS/MODEL_AXIS/ENTITY_AXIS (photon_ml_tpu.parallel.mesh)"
+
+
+def _literal_violations(ctx: FileContext) -> Iterator[Violation]:
+    if ctx.path.endswith("parallel/mesh.py"):
+        return
+    seen: Set[Tuple[int, int]] = set()
+
+    def flag(node: ast.AST, literal: str):
+        key = (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+        if key in seen or not literal:
+            return None
+        seen.add(key)
+        if literal in spmd.CANONICAL_AXES:
+            msg = (
+                f"axis-name literal '{literal}' — reference the mesh "
+                f"constant instead ({_CONST_HINT}) so a renamed or "
+                "retired axis fails at lint time, not at runtime"
+            )
+        else:
+            msg = (
+                f"unknown mesh axis literal '{literal}' — not one of "
+                f"{'/'.join(spmd.CANONICAL_AXES)}; a stale or typo'd "
+                "axis string binds to nothing and only fails when XLA "
+                "rejects the collective"
+            )
+        return ctx.violation(RULE, node, msg)
+
+    def flag_strings_in(expr: ast.AST):
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Constant) and isinstance(
+                sub.value, str
+            ):
+                v = flag(sub, sub.value)
+                if v:
+                    yield v
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in ("P", "PartitionSpec"):
+                for arg in node.args:
+                    yield from flag_strings_in(arg)
+            elif spmd.is_collective(node):
+                axis_arg = spmd.collective_axis_arg(node)
+                if axis_arg is not None:
+                    yield from flag_strings_in(axis_arg)
+            elif name in ("shard_map", "Mesh", "make_mesh",
+                          "entity_mesh"):
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        yield from flag_strings_in(kw.value)
+                if name == "Mesh" and len(node.args) > 1:
+                    yield from flag_strings_in(node.args[1])
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            params = list(a.posonlyargs) + list(a.args)
+            defaults = list(a.defaults)
+            pairs = list(zip(params[-len(defaults):], defaults)) if \
+                defaults else []
+            pairs += [
+                (p, d) for p, d in zip(a.kwonlyargs, a.kw_defaults)
+                if d is not None
+            ]
+            for p, d in pairs:
+                if spmd.is_axis_param_name(p.arg) and isinstance(
+                    d, ast.Constant
+                ) and isinstance(d.value, str) and d.value:
+                    v = flag(d, d.value)
+                    if v:
+                        yield v
+        elif isinstance(node, ast.BoolOp):
+            names = [
+                v for v in node.values
+                if isinstance(v, ast.Name)
+                and spmd.is_axis_param_name(v.id)
+            ]
+            if names:
+                for v_ in node.values:
+                    if isinstance(v_, ast.Constant) and isinstance(
+                        v_.value, str
+                    ) and v_.value:
+                        v = flag(v_, v_.value)
+                        if v:
+                            yield v
+
+
+def _contract_violations(
+    ctx: FileContext, model: spmd.SpmdFileModel,
+) -> Iterator[Violation]:
+    in_package = "photon_ml_tpu" in ctx.path_parts()
+    available = set(model.axis_env.values())
+    for entry in model.entries:
+        decl = entry.decl
+        if decl is None:
+            if in_package and entry.kind != "declared":
+                yield ctx.violation(RULE, entry.node, (
+                    f"mesh entry point '{entry.qualname}' "
+                    f"({entry.kind}) has no '# photon: sharding(...)' "
+                    "declaration — declare axes/in/out on the def line "
+                    "so the contract is machine-checked and SHARDING.md "
+                    "stays a complete inventory"
+                ))
+            continue
+        for err in decl.errors:
+            yield ctx.violation(RULE, entry.node, (
+                f"sharding declaration on '{entry.qualname}': {err}"
+            ))
+        if decl.axes is None:
+            if not decl.export:
+                yield ctx.violation(RULE, entry.node, (
+                    f"sharding declaration on '{entry.qualname}' names "
+                    "no axes — declare axes=[...] ([] for a mesh-less "
+                    "donation/program entry)"
+                ))
+            continue
+        declared = list(decl.axes)
+        for a in declared:
+            if a not in spmd.CANONICAL_AXES:
+                yield ctx.violation(RULE, entry.node, (
+                    f"sharding declaration on '{entry.qualname}' names "
+                    f"unknown axis '{a}' — not one of "
+                    f"{'/'.join(spmd.CANONICAL_AXES)} (stale or typo'd)"
+                ))
+            elif available and a not in available and \
+                    a not in entry.axes_resolved:
+                # availability is only checkable in modules that bind
+                # at least one axis constant; axis-generic modules
+                # (e.g. the residual router, which takes the axis from
+                # the mesh) declare their conventional axis freely
+                yield ctx.violation(RULE, entry.node, (
+                    f"sharding declaration on '{entry.qualname}' names "
+                    f"axis '{a}' but this module neither imports its "
+                    "mesh constant nor binds it — a contract for an "
+                    "axis the code cannot reference is drift"
+                ))
+        declared_ok = [a for a in declared if a in spmd.CANONICAL_AXES]
+        missing = sorted(entry.axes_resolved - set(declared_ok))
+        if missing:
+            yield ctx.violation(RULE, entry.node, (
+                f"'{entry.qualname}' binds ax{'es' if len(missing) > 1 else 'is'} "
+                f"{'/'.join(missing)} that the sharding declaration "
+                "does not name — the declared contract drifted from "
+                "the code"
+            ))
+        if entry.kind == "shard_map" and entry.in_rendered is not None \
+                and entry.out_rendered is not None:
+            # only fully-literal specs pin the axis count statically —
+            # helper-built specs (and jit out_shardings) contribute to
+            # axes_resolved but can hide axes the body reduces over
+            used = len(entry.axes_resolved) + len(
+                entry.axis_symbols - set(entry.axes_resolved)
+            )
+            if used != len(set(declared_ok)) and not missing:
+                yield ctx.violation(RULE, entry.node, (
+                    f"'{entry.qualname}' declares "
+                    f"{len(set(declared_ok))} ax(es) but the code "
+                    f"binds {used} distinct ax(es)/symbol(s) — the "
+                    "contract drifted from the code"
+                ))
+        mapping = entry.symbol_mapping()
+        for declared_list, rendered, label in (
+            (decl.in_specs, spmd.substitute(entry.in_rendered, mapping),
+             "in"),
+            (decl.out_specs, spmd.substitute(entry.out_rendered, mapping),
+             "out"),
+        ):
+            if declared_list is None or rendered is None:
+                continue
+            if not spmd.specs_match(declared_list, rendered):
+                yield ctx.violation(RULE, entry.node, (
+                    f"'{entry.qualname}' declares {label}="
+                    f"[{','.join(declared_list)}] but the code's "
+                    f"{label}_specs render as [{','.join(rendered)}] — "
+                    "the contract drifted from the code"
+                ))
+        if decl.donates is not None and entry.donates is not None:
+            if decl.donates != entry.donates:
+                yield ctx.violation(RULE, entry.node, (
+                    f"'{entry.qualname}' declares donates="
+                    f"{decl.donates} but the code donates "
+                    f"{entry.donates}"
+                ))
+
+
+def _check(pkg: PackageContext) -> Iterator[Violation]:
+    idx = spmd.index(pkg)
+    for path in sorted(pkg.contexts):
+        ctx = pkg.contexts[path]
+        yield from _literal_violations(ctx)
+        yield from _contract_violations(ctx, idx.models[path])
+
+
+RULE = register_package(
+    PackageRule(
+        id="PL011",
+        slug="mesh-axis-discipline",
+        doc="axis names reference mesh constants; every jit/shard_map "
+            "entry point carries a cross-checked sharding contract",
+        check=_check,
+        group="spmd",
+    )
+)

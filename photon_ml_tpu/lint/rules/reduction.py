@@ -1,0 +1,392 @@
+"""PL013 reduction-completeness: a shard_map body's collectives agree
+with its specs.
+
+Two converse hazards, both silent until runtime (or worse, silently
+wrong under ``check_vma=False``, which every entry point in this repo
+passes for compat-shim reasons):
+
+- **Unreduced replication claim.** An ``out_specs`` entry of ``P()``
+  promises every device returns the SAME value; a returned value that
+  provably derives from a sharded input with no ``psum``/``pmean``/
+  ``pmax``/``pmin``/``all_gather`` over the mapped axis on its dataflow
+  is device-varying — the per-device partials the replication claim
+  papers over.
+- **Unbound reduction.** A ``psum``-family call over an axis that the
+  site's in/out specs never shard multiplies replicated values by the
+  axis size (or binds a stale axis name) — the grid/entity refactors'
+  classic copy-paste failure.
+
+The dataflow is deliberately lightweight (the PL010 altitude): a
+straight-line taint over the mapped body with three states —
+sharded / clean / unknown. Reduction collectives clear taint; calls
+into same-file helpers are resolved ONE hop (a helper that psums over
+the mapped axis discharges the obligation — the repo's objective
+closures do exactly this); any call the analyzer cannot resolve makes
+the result UNKNOWN, and unknown is never flagged. Axis identity is
+symbolic: ``P(ax)`` in the specs binds the psum over ``ax`` in the body
+whether or not ``ax`` resolves to a constant.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from photon_ml_tpu.lint import spmd
+from photon_ml_tpu.lint.core import (
+    FileContext,
+    PackageContext,
+    PackageRule,
+    Violation,
+    attr_root,
+    call_name,
+    register_package,
+)
+
+CLEAN, UNKNOWN, SHARDED = 0, 1, 2
+
+
+def _axis_key(model: spmd.SpmdFileModel, expr: ast.AST,
+              scope: ast.AST) -> Optional[str]:
+    """Stable identity for an axis expression: the canonical value when
+    resolvable, else the symbol name, else None (unresolvable)."""
+    kind, val = model.resolve_axis(expr, scope)
+    if kind in ("const", "literal"):
+        return val
+    if kind == "symbol":
+        return val
+    return None
+
+
+def _spec_axis_keys(model: spmd.SpmdFileModel,
+                    entry: spmd.SpmdEntry) -> Optional[Set[str]]:
+    """Axis identities the site's specs mention; None when the specs
+    are not statically analyzable (computed tuples)."""
+    keys: Set[str] = set()
+    any_known = False
+    for expr in (entry.in_spec_exprs, entry.out_spec_exprs):
+        if expr is None:
+            continue
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call) and call_name(sub) in (
+                "P", "PartitionSpec"
+            ):
+                any_known = True
+                for arg in sub.args:
+                    for leaf in ast.walk(arg):
+                        if isinstance(leaf, (ast.Name, ast.Constant)):
+                            k = _axis_key(model, leaf, sub)
+                            if k:
+                                keys.add(k)
+    if not any_known:
+        return None
+    return keys
+
+
+def _out_spec_list(entry: spmd.SpmdEntry) -> Optional[List[ast.AST]]:
+    expr = entry.out_spec_exprs
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Call) and call_name(expr) in (
+        "P", "PartitionSpec"
+    ):
+        return [expr]
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out = []
+        for e in expr.elts:
+            if isinstance(e, ast.Call) and call_name(e) in (
+                "P", "PartitionSpec"
+            ):
+                out.append(e)
+            else:
+                return None
+        return out
+    return None
+
+
+def _is_replicated_spec(p_call: ast.Call) -> bool:
+    return not p_call.args or all(
+        isinstance(a, ast.Constant) and a.value is None
+        for a in p_call.args
+    )
+
+
+class _BodyTaint:
+    """Three-state taint over one mapped function body."""
+
+    def __init__(self, ctx: FileContext, model: spmd.SpmdFileModel,
+                 fn: ast.FunctionDef, sharded_params: Set[str]):
+        self.ctx = ctx
+        self.model = model
+        self.fn = fn
+        self.env: Dict[str, int] = {p: SHARDED for p in sharded_params}
+        # nested defs are opaque callables (they may close over sharded
+        # state); calling one yields UNKNOWN
+        self.nested: Set[str] = {
+            n.name for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn
+        }
+        for _ in range(6):
+            before = dict(self.env)
+            for node in self.ctx.walk_scope(fn):
+                if isinstance(node, ast.Assign):
+                    st = self.classify(node.value)
+                    for tgt in node.targets:
+                        self._bind(tgt, st)
+                elif isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Name
+                ):
+                    st = max(
+                        self.classify(node.value),
+                        self.env.get(node.target.id, CLEAN),
+                    )
+                    self.env[node.target.id] = st
+                elif isinstance(node, (ast.For,)):
+                    self._bind(node.target, self.classify(node.iter))
+            if self.env == before:
+                break
+
+    def _bind(self, target: ast.AST, state: int) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = max(
+                self.env.get(target.id, CLEAN), state
+            )
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, state)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, state)
+
+    def _helper_reduces(self, name: str) -> Optional[bool]:
+        """Does the same-file helper contain a reduction collective?
+        None when there is no such helper."""
+        target = self.model.local_defs.get(name)
+        if target is None or target is self.fn:
+            return None
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Call) and call_name(sub) in \
+                    spmd.REDUCTIONS:
+                return True
+        return False
+
+    def classify(self, expr: ast.AST) -> int:
+        if isinstance(expr, ast.Constant):
+            return CLEAN
+        if isinstance(expr, ast.Name):
+            if expr.id in self.nested:
+                return UNKNOWN
+            return self.env.get(expr.id, CLEAN)
+        if isinstance(expr, ast.Call):
+            name = call_name(expr)
+            if name in spmd.REDUCTIONS:
+                return CLEAN
+            if name == "axis_index":
+                return SHARDED
+            func = expr.func
+            if isinstance(func, ast.Name):
+                reduces = self._helper_reduces(func.id)
+                if reduces is True:
+                    return CLEAN
+                if reduces is False:
+                    # the helper might reduce two hops down — cap at
+                    # UNKNOWN rather than over-claim SHARDED
+                    return UNKNOWN
+                if func.id in self.nested:
+                    return UNKNOWN
+            root = attr_root(func) if isinstance(func, ast.Attribute) \
+                else None
+            if root is not None and (
+                root.id in self.ctx.jax_modules
+                or root.id in self.ctx.numpy_modules
+                or root.id in ("lax", "jax", "jnp", "np")
+            ):
+                states = [self.classify(a) for a in expr.args] + [
+                    self.classify(k.value) for k in expr.keywords
+                ]
+                return max(states) if states else CLEAN
+            if isinstance(func, ast.Attribute):
+                # method on a value: x.reshape(...), x.at[i].set(v)
+                base = self.classify(func.value)
+                states = [base] + [self.classify(a) for a in expr.args]
+                if base is not UNKNOWN and all(
+                    s in (CLEAN, SHARDED) for s in states
+                ) and self._is_array_method_chain(func):
+                    return max(states)
+            return UNKNOWN
+        if isinstance(expr, ast.Attribute):
+            return self.classify(expr.value)
+        if isinstance(expr, ast.Subscript):
+            return max(
+                self.classify(expr.value), self.classify(expr.slice)
+            )
+        if isinstance(expr, ast.BinOp):
+            return max(
+                self.classify(expr.left), self.classify(expr.right)
+            )
+        if isinstance(expr, ast.UnaryOp):
+            return self.classify(expr.operand)
+        if isinstance(expr, ast.Compare):
+            return max(
+                [self.classify(expr.left)]
+                + [self.classify(c) for c in expr.comparators]
+            )
+        if isinstance(expr, ast.BoolOp):
+            return max(self.classify(v) for v in expr.values)
+        if isinstance(expr, ast.IfExp):
+            return max(
+                self.classify(expr.body), self.classify(expr.orelse)
+            )
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return max(
+                (self.classify(e) for e in expr.elts), default=CLEAN
+            )
+        if isinstance(expr, ast.Starred):
+            return self.classify(expr.value)
+        return UNKNOWN
+
+    def _is_array_method_chain(self, func: ast.Attribute) -> bool:
+        """x.reshape / x.at[...].set / x.astype — shape-preserving
+        array methods whose taint is their receiver's."""
+        return func.attr in {
+            "reshape", "astype", "set", "add", "take", "sum", "max",
+            "min", "mean", "at", "get", "transpose", "ravel",
+        }
+
+
+def _mapped_params(entry: spmd.SpmdEntry,
+                   model: spmd.SpmdFileModel) -> Optional[Set[str]]:
+    """Parameter names of the mapped fn whose in_spec mentions an axis;
+    None when the pairing is not statically determinable."""
+    fn = entry.mapped_fn
+    expr = entry.in_spec_exprs
+    if fn is None or expr is None:
+        return None
+    a = fn.args
+    params = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+    if not isinstance(expr, (ast.Tuple, ast.List)):
+        return None
+    specs = list(expr.elts)
+    if len(specs) != len(params):
+        if a.vararg is None:
+            return None
+        # trailing *rest absorbs the remainder: pair the prefix, and
+        # treat *rest as sharded if ANY remaining spec mentions an axis
+        pass
+    sharded: Set[str] = set()
+
+    def mentions_axis(spec: ast.AST) -> Optional[bool]:
+        if not (isinstance(spec, ast.Call) and call_name(spec) in (
+            "P", "PartitionSpec"
+        )):
+            return None
+        for arg in spec.args:
+            for leaf in ast.walk(arg):
+                if isinstance(leaf, ast.Name):
+                    return True
+                if isinstance(leaf, ast.Constant) and isinstance(
+                    leaf.value, str
+                ):
+                    return True
+        return False
+
+    for p, s in zip(params, specs):
+        m = mentions_axis(s)
+        if m is None:
+            return None
+        if m:
+            sharded.add(p)
+    if a.vararg is not None and len(specs) > len(params):
+        rest = specs[len(params):]
+        for s in rest:
+            if mentions_axis(s):
+                sharded.add(a.vararg.arg)
+                break
+    return sharded
+
+
+def _check_entry(ctx: FileContext, model: spmd.SpmdFileModel,
+                 entry: spmd.SpmdEntry) -> Iterator[Violation]:
+    if entry.kind != "shard_map":
+        return
+    fn = entry.mapped_fn
+    spec_keys = _spec_axis_keys(model, entry)
+    # -- unbound collectives -------------------------------------------------
+    if fn is not None and spec_keys is not None:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or not spmd.is_collective(
+                node
+            ):
+                continue
+            axis_arg = spmd.collective_axis_arg(node)
+            if axis_arg is None:
+                continue
+            # tuple axis args: check each element
+            elems = axis_arg.elts if isinstance(
+                axis_arg, (ast.Tuple, ast.List)
+            ) else [axis_arg]
+            for el in elems:
+                key = _axis_key(model, el, node)
+                if key is None:
+                    continue
+                if key not in spec_keys:
+                    yield ctx.violation(RULE, node, (
+                        f"{call_name(node)} over axis '{key}' inside "
+                        f"'{entry.qualname}', whose in/out specs never "
+                        "shard that axis — a reduction over a "
+                        "replicated (or stale) axis multiplies by the "
+                        "axis size or fails to bind"
+                    ))
+    # -- unreduced replication claims ----------------------------------------
+    if fn is None:
+        return
+    out_specs = _out_spec_list(entry)
+    sharded_params = _mapped_params(entry, model)
+    if out_specs is None or sharded_params is None:
+        return
+    taint = _BodyTaint(ctx, model, fn, sharded_params)
+    for node in ctx.walk_scope(fn):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        ret = node.value
+        rets: List[ast.AST]
+        if isinstance(ret, ast.Tuple) and len(ret.elts) == len(
+            out_specs
+        ):
+            rets = list(ret.elts)
+        elif len(out_specs) == 1:
+            rets = [ret]
+        else:
+            continue
+        for pos, (expr, spec) in enumerate(zip(rets, out_specs)):
+            if not _is_replicated_spec(spec):
+                continue
+            if taint.classify(expr) == SHARDED:
+                yield ctx.violation(RULE, expr, (
+                    f"output {pos} of '{entry.qualname}' claims "
+                    "replication (out_specs P()) but derives from a "
+                    "sharded input with no psum/pmean/all_gather over "
+                    "the mapped axis on its path — every device "
+                    "returns a DIFFERENT value under check_vma=False"
+                ))
+
+
+def _check(pkg: PackageContext) -> Iterator[Violation]:
+    idx = spmd.index(pkg)
+    for path in sorted(pkg.contexts):
+        ctx = pkg.contexts[path]
+        model = idx.models[path]
+        for entry in model.entries:
+            yield from _check_entry(ctx, model, entry)
+
+
+RULE = register_package(
+    PackageRule(
+        id="PL013",
+        slug="reduction-completeness",
+        doc="shard_map bodies psum what their out_specs claim "
+            "replicated, and only over axes the specs shard",
+        check=_check,
+        group="spmd",
+    )
+)

@@ -1,0 +1,173 @@
+"""The sharding-contract registry: every jit/shard_map mesh entry point
+in the package, inventoried into a generated SHARDING.md.
+
+The inventory is the SPMD pass's entry-point scan (lint/spmd.py) merged
+with the ``# photon: sharding(...)`` declarations PL011 cross-checks:
+by the time SHARDING.md generates cleanly, every row has been
+machine-verified against the code it describes. The committed file is
+drift-gated — ``dev-scripts/lint.sh`` regenerates and diffs it, and
+``python -m photon_ml_tpu.lint --check-sharding-md`` exits 1 on any
+stale row — so the unified-mesh refactor starts from a complete,
+trustworthy map of what shards how (the veScale "sharding is an
+explicit, checkable declaration" posture, PAPERS.md).
+
+Rows carry no line numbers on purpose: unrelated edits above an entry
+point must not churn the inventory.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from photon_ml_tpu.lint import spmd
+from photon_ml_tpu.lint.core import PackageContext, analyze_paths
+
+DEFAULT_SHARDING_MD = "SHARDING.md"
+
+_HEADER = """# SHARDING — machine-verified mesh entry-point inventory
+
+**GENERATED FILE — do not edit.** Regenerate with
+`python -m photon_ml_tpu.lint --write-sharding-md` (dev-scripts/lint.sh
+diffs this file against a fresh run and fails CI on drift).
+
+Every jit/shard_map mesh entry point in `photon_ml_tpu/`, as extracted
+by the photon-lint SPMD pass (PL011-PL014) and cross-checked against
+its `# photon: sharding(in=..., out=..., axes=...)` declaration. Spec
+tokens: axis names (`data`/`model`/`entity`), `r` = fully replicated
+(`P()`), `a+b` = multi-axis spec, `?` = statically undeterminable,
+`*` = variadic tail. `donates` lists donated argument positions.
+
+## Entry points
+"""
+
+_EXPORT_HEADER = """
+## Export / checkpoint scopes
+
+Functions declared `# photon: sharding(export)` — the ONLY scopes in
+which PL012 permits materializing an entity-/feature-sharded bank off
+its shards (model export, checkpoint save/restore, parity oracles).
+"""
+
+
+def _fmt_specs(tokens: Optional[List[str]],
+               decl_tokens: Optional[List[str]]) -> str:
+    if tokens is not None:
+        return ",".join(tokens) if tokens else "-"
+    if decl_tokens is not None:
+        return ",".join(decl_tokens) if decl_tokens else "-"
+    return "?"
+
+
+def _entry_row(entry: spmd.SpmdEntry) -> Dict[str, str]:
+    mapping = entry.symbol_mapping()
+    in_r = spmd.substitute(entry.in_rendered, mapping)
+    out_r = spmd.substitute(entry.out_rendered, mapping)
+    decl = entry.decl
+    axes = entry.axes_for_table()
+    donates = entry.donates
+    if donates is None and decl is not None:
+        donates = decl.donates
+    return {
+        "module": entry.path,
+        "entry": entry.qualname,
+        "kind": entry.kind,
+        "axes": ",".join(axes) if axes else "-",
+        "in": _fmt_specs(in_r, decl.in_specs if decl else None),
+        "out": _fmt_specs(out_r, decl.out_specs if decl else None),
+        "donates": (
+            ",".join(str(i) for i in donates) if donates else "-"
+        ),
+        "declared": "yes" if decl is not None else "NO",
+    }
+
+
+def inventory(pkg: PackageContext) -> List[Dict[str, str]]:
+    idx = spmd.index(pkg)
+    rows = [
+        _entry_row(e) for e in idx.all_entries()
+        if "photon_ml_tpu" in e.path.split("/")
+    ]
+    rows.sort(key=lambda r: (r["module"], r["entry"], r["kind"]))
+    return rows
+
+
+def export_scopes(pkg: PackageContext) -> List[Dict[str, str]]:
+    idx = spmd.index(pkg)
+    rows = [
+        {"module": s.path, "scope": s.qualname}
+        for s in idx.all_export_scopes()
+        if "photon_ml_tpu" in s.path.split("/")
+    ]
+    rows.sort(key=lambda r: (r["module"], r["scope"]))
+    return rows
+
+
+def render_markdown(pkg: PackageContext) -> str:
+    rows = inventory(pkg)
+    lines = [_HEADER]
+    lines.append(
+        "| Module | Entry point | Kind | Axes | In | Out | Donates |"
+    )
+    lines.append("|---|---|---|---|---|---|---|")
+    for r in rows:
+        lines.append(
+            f"| {r['module']} | `{r['entry']}` | {r['kind']} | "
+            f"{r['axes']} | `{r['in']}` | `{r['out']}` | "
+            f"{r['donates']} |"
+        )
+    lines.append(f"\n{len(rows)} entry point(s).")
+    scopes = export_scopes(pkg)
+    lines.append(_EXPORT_HEADER)
+    lines.append("| Module | Scope |")
+    lines.append("|---|---|")
+    for s in scopes:
+        lines.append(f"| {s['module']} | `{s['scope']}` |")
+    lines.append(f"\n{len(scopes)} export/checkpoint scope(s).")
+    return "\n".join(lines) + "\n"
+
+
+def package_context(paths: Sequence[str]) -> Optional[PackageContext]:
+    """Analyze ``paths`` and return the run's PackageContext (None when
+    nothing parsed)."""
+    report = analyze_paths(paths, package_pass=False, spmd_pass=True)
+    return report.package
+
+
+def write_sharding_md(path: str, pkg: PackageContext) -> str:
+    content = render_markdown(pkg)
+    import os
+
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(content)
+    os.replace(tmp, path)
+    return content
+
+
+def check_sharding_md(path: str, pkg: PackageContext) -> Optional[str]:
+    """None when the committed file matches a fresh render; else a
+    human-readable drift message."""
+    expected = render_markdown(pkg)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            actual = fh.read()
+    except OSError as e:
+        return f"cannot read {path}: {e}"
+    if actual == expected:
+        return None
+    exp_lines = expected.splitlines()
+    act_lines = actual.splitlines()
+    for i, (a, b) in enumerate(zip(act_lines, exp_lines), 1):
+        if a != b:
+            return (
+                f"{path} is stale (first drift at line {i}):\n"
+                f"  committed: {a}\n"
+                f"  expected:  {b}\n"
+                "regenerate with: python -m photon_ml_tpu.lint "
+                "--write-sharding-md"
+            )
+    return (
+        f"{path} is stale ({len(act_lines)} lines committed, "
+        f"{len(exp_lines)} expected) — regenerate with: "
+        "python -m photon_ml_tpu.lint --write-sharding-md"
+    )

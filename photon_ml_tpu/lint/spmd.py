@@ -1,0 +1,855 @@
+"""The whole-package SPMD model behind PL011-PL014 and SHARDING.md.
+
+Per file this builds, from stdlib ``ast`` alone (no jax import — the
+analyzer keeps running in the minimal CI container):
+
+- an **axis environment**: names that provably hold one of the three
+  canonical mesh axis names (``data`` / ``model`` / ``entity``) — via
+  ``from ...parallel.mesh import DATA_AXIS``-style imports, module
+  constants, local ``ax = axis`` chains and axis-parameter defaults;
+- the **mesh entry points**: every ``shard_map(...)`` site (decorator,
+  direct-call and ``partial(shard_map, ...)(f)`` forms) and every
+  ``jax.jit`` site that pins sharding behavior (``out_shardings`` /
+  ``in_shardings`` / ``donate_argnums`` / ``donate_argnames``, or a
+  module-level jit assignment — the serving program family);
+- the **sharding declarations**: ``# photon: sharding(...)`` comments
+  attached to def lines (or the assignment line for module-level jits).
+  Grammar: comma-separated ``key=value`` items with keys ``axes`` /
+  ``in`` / ``out`` / ``donates`` (value either ``[a,b,...]`` or ``?``),
+  plus the bare tokens ``export`` / ``checkpoint`` marking an export or
+  checkpoint scope (the one place PL012 permits host-materializing a
+  sharded bank). Spec tokens: an axis name, ``r`` (fully replicated,
+  ``P()``), ``?`` (statically undeterminable), ``*`` (variadic tail),
+  and ``a+b`` for multi-axis specs like ``P(data, model)``.
+
+Declarations are contracts, not suppressions: PL011 cross-checks every
+declaration against the code it annotates, and the generated SHARDING.md
+(lint/sharding_contracts.py) is the machine-verified inventory the
+unified-mesh refactor starts from.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from photon_ml_tpu.lint.core import (
+    FileContext,
+    PackageContext,
+    attr_root,
+    call_name,
+)
+
+CANONICAL_AXES = ("data", "model", "entity")
+AXIS_CONSTANTS = {
+    "DATA_AXIS": "data",
+    "MODEL_AXIS": "model",
+    "ENTITY_AXIS": "entity",
+}
+
+# collective -> positional index of the axis-name argument
+COLLECTIVES = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "ppermute": 1,
+    "axis_index": 0,
+    "psum_scatter": 1,
+}
+# collectives whose output is complete across the mapped axis — they
+# discharge PL013's "replicated out_spec needs a reduction" obligation
+REDUCTIONS = {"psum", "pmean", "pmax", "pmin", "all_gather"}
+
+_SHARDING_KW = ("out_shardings", "in_shardings", "donate_argnums",
+                "donate_argnames")
+
+_AXIS_PARAM_RE = re.compile(r"(^axis(_name)?$|_axis(_name)?$)")
+
+
+def is_axis_param_name(name: str) -> bool:
+    return bool(_AXIS_PARAM_RE.search(name))
+
+
+# -- declarations -------------------------------------------------------------
+
+
+@dataclass
+class ShardingDecl:
+    line: int
+    raw: str
+    export: bool = False
+    axes: Optional[List[str]] = None
+    in_specs: Optional[List[str]] = None
+    out_specs: Optional[List[str]] = None
+    donates: Optional[List[int]] = None
+    has_axes_key: bool = False
+    errors: List[str] = field(default_factory=list)
+
+
+def _split_top_level(raw: str) -> List[str]:
+    """Split on commas not nested in brackets."""
+    out, depth, cur = [], 0, []
+    for ch in raw:
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth = max(0, depth - 1)
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur))
+    return [p.strip() for p in out if p.strip()]
+
+
+def _parse_list(value: str) -> Optional[List[str]]:
+    value = value.strip()
+    if value == "?":
+        return None
+    if value.startswith("[") and value.endswith("]"):
+        inner = value[1:-1].strip()
+        if not inner:
+            return []
+        return [t.strip() for t in inner.split(",") if t.strip()]
+    return [value]
+
+
+def parse_sharding_decl(line: int, raw: str) -> ShardingDecl:
+    decl = ShardingDecl(line=line, raw=raw)
+    for item in _split_top_level(raw):
+        if item in ("export", "checkpoint"):
+            decl.export = True
+            continue
+        if "=" not in item:
+            decl.errors.append(f"unparseable token {item!r}")
+            continue
+        key, _, value = item.partition("=")
+        key, value = key.strip(), value.strip()
+        if key == "axes":
+            decl.has_axes_key = True
+            decl.axes = _parse_list(value) or []
+            if value == "?":
+                decl.errors.append("axes may not be '?' — name the axes")
+        elif key == "in":
+            decl.in_specs = _parse_list(value)
+        elif key == "out":
+            decl.out_specs = _parse_list(value)
+        elif key == "donates":
+            toks = _parse_list(value)
+            if toks is None:
+                decl.donates = None
+            else:
+                try:
+                    decl.donates = sorted(int(t) for t in toks)
+                except ValueError:
+                    decl.errors.append(f"non-integer donates item in {value!r}")
+        else:
+            decl.errors.append(f"unknown key {key!r}")
+    return decl
+
+
+# -- spec atoms ---------------------------------------------------------------
+#
+# A rendered spec is a list of per-argument tokens; each token is a "+"
+# join of atoms. Atom forms: a canonical axis name, "r" (replicated),
+# "$<symbol>" for an in-scope name the axis resolution could not pin to
+# a constant (substituted from the declaration when unambiguous), and
+# "?" for anything else.
+
+
+def substitute(tokens: Optional[List[str]],
+               mapping: Dict[str, str]) -> Optional[List[str]]:
+    if tokens is None:
+        return None
+    out = []
+    for tok in tokens:
+        atoms = []
+        for a in tok.split("+"):
+            if a.startswith("$"):
+                atoms.append(mapping.get(a[1:], "?"))
+            else:
+                atoms.append(a)
+        out.append("+".join(atoms))
+    return out
+
+
+def specs_match(declared: List[str], rendered: List[str]) -> bool:
+    """Element-wise compare; '?' (either side) matches anything and a
+    trailing '*' in the declaration absorbs the rest."""
+    di = 0
+    for ri, tok in enumerate(rendered):
+        if di >= len(declared):
+            return False
+        d = declared[di]
+        if d == "*":
+            return True
+        if d != "?" and tok != "?" and d != tok:
+            return False
+        di += 1
+    if di < len(declared):
+        return declared[di] == "*" and di == len(declared) - 1
+    return True
+
+
+# -- entries ------------------------------------------------------------------
+
+
+@dataclass
+class SpmdEntry:
+    path: str
+    qualname: str
+    line: int  # declaration attachment line (def or assignment)
+    kind: str  # "shard_map" | "jit" | "declared"
+    node: ast.AST  # where PL011 reports contract violations
+    axes_resolved: Set[str] = field(default_factory=set)
+    axis_symbols: Set[str] = field(default_factory=set)
+    in_rendered: Optional[List[str]] = None
+    out_rendered: Optional[List[str]] = None
+    donates: Optional[List[int]] = None
+    decl: Optional[ShardingDecl] = None
+    mapped_fn: Optional[ast.FunctionDef] = None
+    in_spec_exprs: Optional[ast.AST] = None
+    out_spec_exprs: Optional[ast.AST] = None
+
+    def symbol_mapping(self) -> Dict[str, str]:
+        """Unambiguous symbol -> axis assignment from the declaration:
+        when exactly one spec symbol stayed unresolved and the
+        declaration names exactly one axis the code did not already
+        resolve, they pair up."""
+        if self.decl is None or self.decl.axes is None:
+            return {}
+        leftover = [a for a in self.decl.axes
+                    if a not in self.axes_resolved]
+        syms = sorted(self.axis_symbols)
+        if len(syms) == 1 and len(leftover) == 1:
+            return {syms[0]: leftover[0]}
+        return {}
+
+    def axes_for_table(self) -> List[str]:
+        axes = set(self.axes_resolved)
+        mapping = self.symbol_mapping()
+        for s in self.axis_symbols:
+            axes.add(mapping.get(s, "?"))
+        if self.decl is not None and self.decl.axes is not None:
+            axes |= {a for a in self.decl.axes if a in CANONICAL_AXES}
+        axes.discard("?")
+        listed = sorted(axes)
+        if not listed and self.axis_symbols:
+            listed = ["?"]
+        return listed
+
+
+@dataclass
+class ExportScope:
+    path: str
+    qualname: str
+    line: int
+    node: ast.AST
+
+
+# -- per-file model -----------------------------------------------------------
+
+
+class SpmdFileModel:
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.axis_env: Dict[str, str] = {}
+        self.entries: List[SpmdEntry] = []
+        self.export_scopes: List[ExportScope] = []
+        self.decls: Dict[int, ShardingDecl] = {
+            line: parse_sharding_decl(line, raw)
+            for line, raw in ctx.sharding_annotations.items()
+        }
+        self._claimed_decl_lines: Set[int] = set()
+        self._claimed_calls: Set[int] = set()
+        self.local_defs: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.local_defs.setdefault(node.name, node)
+        self._scan_axis_env()
+        self._scan_entries()
+        self._attach_orphan_decls()
+
+    # -- axis environment ----------------------------------------------------
+
+    def _scan_axis_env(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name in AXIS_CONSTANTS:
+                        self.axis_env[alias.asname or alias.name] = (
+                            AXIS_CONSTANTS[alias.name]
+                        )
+            elif isinstance(node, ast.Assign):
+                if (
+                    isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                    and node.value.value in CANONICAL_AXES
+                ):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name) and (
+                            tgt.id in AXIS_CONSTANTS
+                            or tgt.id.endswith("_AXIS")
+                        ):
+                            self.axis_env[tgt.id] = node.value.value
+
+    def _enclosing_functions(self, node: ast.AST) -> List[ast.AST]:
+        out = []
+        for anc in self.ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(anc)
+        return out
+
+    def resolve_axis(self, expr: ast.AST,
+                     node_for_scope: ast.AST,
+                     _depth: int = 0) -> Tuple[str, Optional[str]]:
+        """-> (kind, value): ("const", axis) | ("literal", s) |
+        ("symbol", name) | ("unknown", None)."""
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return ("literal", expr.value)
+        if not isinstance(expr, ast.Name) or _depth > 4:
+            return ("unknown", None)
+        name = expr.id
+        if name in self.axis_env:
+            return ("const", self.axis_env[name])
+        for fn in self._enclosing_functions(node_for_scope):
+            # local assignment chain: ax = axis
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == name
+                    for t in sub.targets
+                ):
+                    kind, val = self.resolve_axis(
+                        sub.value, sub, _depth + 1
+                    )
+                    if kind in ("const", "literal", "symbol"):
+                        if kind == "literal" and val in CANONICAL_AXES:
+                            return ("const", val)
+                        if kind == "const":
+                            return ("const", val)
+                        # fall through to param-default resolution
+            # parameter default
+            a = fn.args
+            params = list(a.posonlyargs) + list(a.args)
+            defaults = list(a.defaults)
+            if defaults:
+                for p, d in zip(params[-len(defaults):], defaults):
+                    if p.arg != name:
+                        continue
+                    kind, val = self.resolve_axis(d, fn, _depth + 1)
+                    if kind == "const":
+                        return ("const", val)
+                    if kind == "literal" and val in CANONICAL_AXES:
+                        return ("const", val)
+            kw = list(a.kwonlyargs)
+            for p, d in zip(kw, a.kw_defaults):
+                if d is not None and p.arg == name:
+                    kind, val = self.resolve_axis(d, fn, _depth + 1)
+                    if kind in ("const",):
+                        return ("const", val)
+        return ("symbol", name)
+
+    # -- spec rendering ------------------------------------------------------
+
+    def _is_p_call(self, expr: ast.AST) -> bool:
+        return isinstance(expr, ast.Call) and call_name(expr) in (
+            "P", "PartitionSpec"
+        )
+
+    def render_spec(self, expr: ast.AST, entry: "SpmdEntry") -> Optional[str]:
+        """One P(...) -> token, collecting resolved axes/symbols into
+        the entry; None when the expression is not a literal P call."""
+        if not self._is_p_call(expr):
+            return None
+        atoms: List[str] = []
+
+        def visit(arg):
+            if isinstance(arg, ast.Constant) and arg.value is None:
+                return
+            if isinstance(arg, (ast.Tuple, ast.List)):
+                for e in arg.elts:
+                    visit(e)
+                return
+            kind, val = self.resolve_axis(arg, expr)
+            if kind == "const":
+                atoms.append(val)
+                entry.axes_resolved.add(val)
+            elif kind == "literal":
+                atoms.append(val if val in CANONICAL_AXES else "?")
+                if val in CANONICAL_AXES:
+                    entry.axes_resolved.add(val)
+            elif kind == "symbol":
+                atoms.append(f"${val}")
+                entry.axis_symbols.add(val)
+            else:
+                atoms.append("?")
+
+        for arg in expr.args:
+            visit(arg)
+        return "+".join(atoms) if atoms else "r"
+
+    def render_specs(self, expr: Optional[ast.AST],
+                     entry: "SpmdEntry") -> Optional[List[str]]:
+        if expr is None:
+            return None
+        if self._is_p_call(expr):
+            tok = self.render_spec(expr, entry)
+            return [tok] if tok is not None else None
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out = []
+            for e in expr.elts:
+                tok = self.render_spec(e, entry)
+                if tok is None:
+                    # still harvest axes from nested P calls for the
+                    # axes cross-check, but give up on the arity compare
+                    self._harvest_axes(e, entry)
+                    return None
+                out.append(tok)
+            return out
+        if isinstance(expr, ast.BinOp):  # computed: (...) + off_spec
+            self._harvest_axes(expr, entry)
+            return None
+        self._harvest_axes(expr, entry)
+        return None
+
+    def _harvest_axes(self, expr: ast.AST, entry: "SpmdEntry") -> None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call) and self._is_p_call(sub):
+                self.render_spec(sub, entry)
+
+    # -- donate resolution ---------------------------------------------------
+
+    def resolve_donate(self, expr: ast.AST,
+                       scope_node: ast.AST,
+                       _depth: int = 0) -> Optional[List[int]]:
+        if _depth > 3 or expr is None:
+            return None
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+            return [expr.value]
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out: Set[int] = set()
+            for e in expr.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.add(e.value)
+                else:
+                    return None
+            return sorted(out)
+        if isinstance(expr, ast.IfExp):
+            a = self.resolve_donate(expr.body, scope_node, _depth + 1)
+            b = self.resolve_donate(expr.orelse, scope_node, _depth + 1)
+            if a is None and b is None:
+                return None
+            return sorted(set(a or []) | set(b or []))
+        if isinstance(expr, ast.Name):
+            for fn in self._enclosing_functions(scope_node):
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == expr.id
+                        for t in sub.targets
+                    ):
+                        got = self.resolve_donate(
+                            sub.value, sub, _depth + 1
+                        )
+                        if got is not None:
+                            return got
+            return None
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name):
+            target = self.local_defs.get(expr.func.id)
+            if target is None:
+                return None
+            out: Set[int] = set()
+            for sub in ast.walk(target):
+                if isinstance(sub, ast.Return) and sub.value is not None:
+                    got = self.resolve_donate(sub.value, target, _depth + 1)
+                    if got is not None:
+                        out.update(got)
+            return sorted(out) if out else None
+        return None
+
+    # -- entry extraction ----------------------------------------------------
+
+    def _qualname(self, node: ast.AST, leaf: str) -> str:
+        parts = []
+        for anc in self.ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(anc.name)
+        parts.reverse()
+        parts.append(leaf)
+        return ".".join(parts)
+
+    def _decl_near(self, *lines: int) -> Optional[ShardingDecl]:
+        """The declaration on (or just above) any of the given lines."""
+        candidates: Set[int] = set()
+        for ln in lines:
+            candidates.update((ln, ln - 1, ln - 2))
+        for ln in sorted(candidates, reverse=True):
+            decl = self.decls.get(ln)
+            if decl is not None and ln not in self._claimed_decl_lines:
+                self._claimed_decl_lines.add(ln)
+                return decl
+        return None
+
+    def _resolves_to_shard_map(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id == "shard_map"
+        if isinstance(expr, ast.Attribute):
+            return expr.attr == "shard_map"
+        return False
+
+    def _resolves_to_jit(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id == "jit"
+        if isinstance(expr, ast.Attribute):
+            return expr.attr == "jit"
+        return False
+
+    def _partial_of(self, call: ast.Call, what) -> bool:
+        return (
+            isinstance(call, ast.Call)
+            and call_name(call) in ("partial", "_partial")
+            and bool(call.args)
+            and what(call.args[0])
+        )
+
+    def _shard_map_kwargs(self, call: ast.Call) -> Dict[str, ast.AST]:
+        return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+    def _finish_shard_map(self, entry: SpmdEntry,
+                          kwargs: Dict[str, ast.AST]) -> None:
+        entry.in_spec_exprs = kwargs.get("in_specs")
+        entry.out_spec_exprs = kwargs.get("out_specs")
+        entry.in_rendered = self.render_specs(entry.in_spec_exprs, entry)
+        entry.out_rendered = self.render_specs(entry.out_spec_exprs, entry)
+        an = kwargs.get("axis_names")
+        if an is not None:
+            self._harvest_axis_names(an, entry)
+
+    def _harvest_axis_names(self, expr: ast.AST, entry: SpmdEntry) -> None:
+        for sub in ast.walk(expr):
+            kind, val = self.resolve_axis(sub, expr)
+            if kind == "const":
+                entry.axes_resolved.add(val)
+            elif kind == "literal" and val in CANONICAL_AXES:
+                entry.axes_resolved.add(val)
+
+    def _scan_entries(self) -> None:
+        seen_defs: Set[int] = set()
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_def(node, seen_defs)
+            elif isinstance(node, ast.Assign):
+                self._scan_assign(node)
+        # jit-with-sharding-kwargs calls in ANY position (e.g. as a
+        # cache-insert argument: _bounded_put(..., jax.jit(_make,
+        # out_shardings=...))) — the assignment walk above cannot see
+        # these
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if id(node) in self._claimed_calls:
+                continue
+            if not self._resolves_to_jit(node.func):
+                continue
+            kw = self._shard_map_kwargs(node)
+            if not any(k in kw for k in _SHARDING_KW):
+                continue
+            self._claimed_calls.add(id(node))
+            entry = SpmdEntry(
+                path=self.ctx.path,
+                qualname=self._qualname(node, "<jit>"),
+                line=node.lineno, kind="jit", node=node,
+            )
+            for key in ("out_shardings", "in_shardings"):
+                if key in kw:
+                    self._harvest_axes(kw[key], entry)
+            if "donate_argnums" in kw:
+                entry.donates = self.resolve_donate(
+                    kw["donate_argnums"], node
+                )
+            if node.args and isinstance(node.args[0], ast.Name):
+                entry.mapped_fn = self._nearest_def(node, node.args[0].id)
+            entry.decl = self._decl_near(node.lineno)
+            self.entries.append(entry)
+        # export scopes: any def whose declaration says export
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            decl = self._decl_for_def(node)
+            if decl is not None and decl.export:
+                self.export_scopes.append(ExportScope(
+                    path=self.ctx.path,
+                    qualname=self._qualname(node, node.name),
+                    line=node.lineno, node=node,
+                ))
+
+    def _decl_for_def(self, node) -> Optional[ShardingDecl]:
+        lines = [node.lineno]
+        if node.decorator_list:
+            lines.append(node.decorator_list[0].lineno)
+        candidates: Set[int] = set()
+        for ln in lines:
+            candidates.update((ln, ln - 1))
+        for ln in sorted(candidates):
+            decl = self.decls.get(ln)
+            if decl is not None:
+                return decl
+        return None
+
+    def _scan_def(self, node, seen: Set[int]) -> None:
+        if id(node) in seen or not node.decorator_list:
+            return
+        sm_kwargs: Optional[Dict[str, ast.AST]] = None
+        donate_expr: Optional[ast.AST] = None
+        jit_kwargs: Dict[str, ast.AST] = {}
+        for dec in node.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            if self._partial_of(dec, self._resolves_to_shard_map):
+                kw = self._shard_map_kwargs(dec)
+                if "mesh" in kw:
+                    sm_kwargs = kw
+            elif self._partial_of(dec, self._resolves_to_jit):
+                kw = self._shard_map_kwargs(dec)
+                if any(k in kw for k in _SHARDING_KW):
+                    jit_kwargs = kw
+                    donate_expr = kw.get("donate_argnums")
+        if sm_kwargs is None and not jit_kwargs:
+            return
+        seen.add(id(node))
+        entry = SpmdEntry(
+            path=self.ctx.path,
+            qualname=self._qualname(node, node.name),
+            line=node.lineno,
+            kind="shard_map" if sm_kwargs is not None else "jit",
+            node=node,
+            mapped_fn=node if sm_kwargs is not None else None,
+        )
+        if sm_kwargs is not None:
+            self._finish_shard_map(entry, sm_kwargs)
+        for key in ("out_shardings", "in_shardings"):
+            if key in jit_kwargs:
+                self._harvest_axes(jit_kwargs[key], entry)
+        if donate_expr is not None:
+            entry.donates = self.resolve_donate(donate_expr, node)
+        entry.decl = self._decl_for_def(node)
+        if entry.decl is not None:
+            self._claimed_decl_lines.add(entry.decl.line)
+        self.entries.append(entry)
+
+    def _scan_assign(self, node: ast.Assign) -> None:
+        value = node.value
+        if not isinstance(value, ast.Call):
+            return
+        target = next(
+            (t.id for t in node.targets if isinstance(t, ast.Name)), None
+        )
+        entry: Optional[SpmdEntry] = None
+        # fit = partial(shard_map, mesh=..., ...)(fit)
+        if isinstance(value.func, ast.Call) and self._partial_of(
+            value.func, self._resolves_to_shard_map
+        ):
+            kw = self._shard_map_kwargs(value.func)
+            if "mesh" in kw:
+                entry = SpmdEntry(
+                    path=self.ctx.path,
+                    qualname=self._qualname(
+                        node, target or "<shard_map>"
+                    ),
+                    line=node.lineno, kind="shard_map", node=node,
+                )
+                self._finish_shard_map(entry, kw)
+                if value.args and isinstance(value.args[0], ast.Name):
+                    entry.mapped_fn = self._nearest_def(
+                        node, value.args[0].id
+                    )
+        # f = shard_map(g, mesh=..., ...)
+        elif self._resolves_to_shard_map(value.func):
+            kw = self._shard_map_kwargs(value)
+            if "mesh" in kw:
+                entry = SpmdEntry(
+                    path=self.ctx.path,
+                    qualname=self._qualname(
+                        node, target or "<shard_map>"
+                    ),
+                    line=node.lineno, kind="shard_map", node=node,
+                )
+                self._finish_shard_map(entry, kw)
+                if value.args and isinstance(value.args[0], ast.Name):
+                    entry.mapped_fn = self._nearest_def(
+                        node, value.args[0].id
+                    )
+        # NAME = jax.jit(f, <sharding-relevant kwargs>) — or any
+        # module-level jit assignment (the AOT program families)
+        elif self._resolves_to_jit(value.func):
+            kw = self._shard_map_kwargs(value)
+            module_level = isinstance(self.ctx.parent(node), ast.Module)
+            if any(k in kw for k in _SHARDING_KW) or (
+                module_level and target is not None
+            ):
+                entry = SpmdEntry(
+                    path=self.ctx.path,
+                    qualname=self._qualname(node, target or "<jit>"),
+                    line=node.lineno, kind="jit", node=node,
+                )
+                for key in ("out_shardings", "in_shardings"):
+                    if key in kw:
+                        self._harvest_axes(kw[key], entry)
+                if "donate_argnums" in kw:
+                    entry.donates = self.resolve_donate(
+                        kw["donate_argnums"], node
+                    )
+                if value.args and isinstance(value.args[0], ast.Name):
+                    entry.mapped_fn = self._nearest_def(
+                        node, value.args[0].id
+                    )
+        if entry is None:
+            return
+        self._claimed_calls.add(id(value))
+        entry.decl = self._decl_near(node.lineno)
+        self.entries.append(entry)
+
+    def _nearest_def(self, node: ast.AST,
+                     name: str) -> Optional[ast.FunctionDef]:
+        for fn in self._enclosing_functions(node):
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) \
+                        and sub.name == name:
+                    return sub
+        return self.local_defs.get(name)
+
+    def _attach_orphan_decls(self) -> None:
+        """A sharding declaration on a def with no detected entry point
+        enrolls that def manually (the tiled_sparse batch builders have
+        no jit of their own — device_put placement — but still carry a
+        sharding contract worth inventorying)."""
+        entry_decl_lines = {
+            e.decl.line for e in self.entries if e.decl is not None
+        }
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            decl = self._decl_for_def(node)
+            if decl is None or decl.export:
+                continue
+            if decl.line in entry_decl_lines:
+                continue
+            entry = SpmdEntry(
+                path=self.ctx.path,
+                qualname=self._qualname(node, node.name),
+                line=node.lineno, kind="declared", node=node,
+                decl=decl, mapped_fn=node,
+            )
+            entry_decl_lines.add(decl.line)
+            self.entries.append(entry)
+
+
+# -- package index ------------------------------------------------------------
+
+
+class SpmdIndex:
+    def __init__(self, pkg: PackageContext):
+        self.models: Dict[str, SpmdFileModel] = {}
+        for path, ctx in pkg.contexts.items():
+            self.models[path] = SpmdFileModel(ctx)
+
+    def all_entries(self) -> List[SpmdEntry]:
+        out: List[SpmdEntry] = []
+        for path in sorted(self.models):
+            out.extend(self.models[path].entries)
+        return out
+
+    def all_export_scopes(self) -> List[ExportScope]:
+        out: List[ExportScope] = []
+        for path in sorted(self.models):
+            out.extend(self.models[path].export_scopes)
+        return out
+
+
+def index(pkg: PackageContext) -> SpmdIndex:
+    """The lazily-built, cached SPMD view of one analyzer run."""
+    cached = getattr(pkg, "_spmd_index", None)
+    if cached is None:
+        cached = SpmdIndex(pkg)
+        pkg._spmd_index = cached
+    return cached
+
+
+def file_model(ctx: FileContext) -> SpmdFileModel:
+    cached = getattr(ctx, "_spmd_model", None)
+    if cached is None:
+        cached = SpmdFileModel(ctx)
+        ctx._spmd_model = cached
+    return cached
+
+
+def in_export_scope(ctx: FileContext, node: ast.AST,
+                    model: Optional[SpmdFileModel] = None) -> bool:
+    """Is this node inside a function declared '# photon: sharding(export)'
+    (checking the whole enclosing-def chain)?"""
+    model = model or file_model(ctx)
+    export_nodes = {id(s.node) for s in model.export_scopes}
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if id(cur) in export_nodes:
+            return True
+        cur = ctx.parent(cur)
+    return False
+
+
+def collective_axis_arg(call: ast.Call) -> Optional[ast.AST]:
+    name = call_name(call)
+    pos = COLLECTIVES.get(name)
+    if pos is None:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    if len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def is_collective(call: ast.Call) -> bool:
+    """A jax collective by name, with the module sanity-check that the
+    callee is an attribute (lax.psum / jax.lax.psum) or a bare name
+    imported from jax."""
+    name = call_name(call)
+    if name not in COLLECTIVES:
+        return False
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        root = attr_root(func)
+        return root is not None
+    return True
+
+
+__all__ = [
+    "AXIS_CONSTANTS",
+    "CANONICAL_AXES",
+    "COLLECTIVES",
+    "REDUCTIONS",
+    "ExportScope",
+    "ShardingDecl",
+    "SpmdEntry",
+    "SpmdFileModel",
+    "SpmdIndex",
+    "collective_axis_arg",
+    "file_model",
+    "in_export_scope",
+    "index",
+    "is_axis_param_name",
+    "is_collective",
+    "parse_sharding_decl",
+    "specs_match",
+    "substitute",
+]

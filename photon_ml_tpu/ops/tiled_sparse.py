@@ -39,6 +39,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from photon_ml_tpu.ops.normalization import NormalizationContext, identity_context
+from photon_ml_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 Array = jnp.ndarray
 
@@ -810,6 +811,7 @@ def _concat_cell_schedules(
     return z_sched, g_sched, np.concatenate([p[5] for p in g_parts])
 
 
+# photon: sharding(axes=[data], in=?, out=[data])
 def build_sharded_tiled_batch(
     batch,
     dim: int,
@@ -863,7 +865,7 @@ def build_sharded_tiled_batch(
         weights=wgt,
     )
     if mesh is not None:
-        out = _place_data_sharded(out, mesh, axis or "data")
+        out = _place_data_sharded(out, mesh, axis or DATA_AXIS)
     return out
 
 
@@ -910,6 +912,7 @@ class FeatureShardedTiledBatch(NamedTuple):
     weights: Array
 
 
+# photon: sharding(axes=[data,model], in=?, out=[data+model])
 def feature_shard_tiled_batch(
     batch,
     dim: int,
@@ -918,8 +921,8 @@ def feature_shard_tiled_batch(
     *,
     params: TileParams = TileParams(),
     mesh=None,
-    data_axis: str = "data",
-    model_axis: str = "model",
+    data_axis: str = DATA_AXIS,
+    model_axis: str = MODEL_AXIS,
 ) -> Tuple[FeatureShardedTiledBatch, int]:
     """SparseBatch -> (FeatureShardedTiledBatch, block_dim).
 
@@ -1222,11 +1225,12 @@ def ensure_tiled(
     return out
 
 
+# photon: sharding(axes=[data], in=?, out=[data])
 def ensure_tiled_sharded(
     batch,
     dim: int,
     mesh,
-    axis: str = "data",
+    axis: str = DATA_AXIS,
     *,
     params: Optional[TileParams] = None,
 ) -> TiledSparseBatch:

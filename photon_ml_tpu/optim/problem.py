@@ -138,6 +138,7 @@ class GLMOptimizationProblem:
             from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
+            # photon: sharding(axes=[data], in=[r,data,r,r], out=[r])
             fit = _partial(
                 shard_map,
                 mesh=mesh,
@@ -230,6 +231,7 @@ class GLMOptimizationProblem:
             from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
+            # photon: sharding(axes=[data], in=[r,data,r,r], out=[r])
             fit = _partial(
                 shard_map,
                 mesh=mesh,
@@ -331,6 +333,7 @@ class GLMOptimizationProblem:
 
             objective = self.objective.with_axis(axis)
 
+            # photon: sharding(axes=[data], in=[r,data,r], out=[r])
             @jax.jit
             @_partial(
                 shard_map,
@@ -428,6 +431,7 @@ class GLMOptimizationProblem:
         if self.compute_variances:
             objective = self.objective.with_axis(axis)
 
+            # photon: sharding(axes=[data], in=[r,data,r], out=[r])
             @_partial(
                 shard_map,
                 mesh=mesh,

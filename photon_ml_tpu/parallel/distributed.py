@@ -47,6 +47,7 @@ def data_parallel_value_and_grad(
     coefficients replicated. One psum per evaluation (the treeAggregate)."""
     obj = objective.with_axis(data_axis)
 
+    # photon: sharding(axes=[data], in=[r,data,r], out=[r,r])
     @partial(
         shard_map,
         mesh=mesh,
@@ -74,6 +75,7 @@ def data_parallel_fit_lbfgs(
     Breeze iteration in the reference, SURVEY §3.1)."""
     obj = objective.with_axis(data_axis)
 
+    # photon: sharding(axes=[data], in=[r,data,r], out=[r])
     @partial(
         shard_map,
         mesh=mesh,
@@ -112,6 +114,7 @@ def feature_sharded_value_and_grad(
     """
     loss = objective.loss
 
+    # photon: sharding(axes=[data,model], in=[model,data+model,data,data,data,r], out=[r,model])
     @partial(
         shard_map,
         mesh=mesh,
@@ -202,6 +205,7 @@ def feature_sharded_fit(
     """
     loss = objective.loss
 
+    # photon: sharding(axes=[data,model], in=[model,data+model,data,data,data,r], out=?)
     @partial(
         shard_map,
         mesh=mesh,
@@ -494,6 +498,7 @@ def feature_sharded_sparse_fit_tron(
 
     loss = objective.loss
 
+    # photon: sharding(axes=[data,model], in=?, out=?)
     @partial(
         shard_map,
         mesh=mesh,
@@ -525,6 +530,7 @@ def feature_sharded_sparse_value_and_grad(
     value replicated, grad sharded over ``model_axis``."""
     loss = objective.loss
 
+    # photon: sharding(axes=[data,model], in=?, out=[r,model])
     @partial(
         shard_map,
         mesh=mesh,
@@ -553,6 +559,7 @@ def feature_sharded_sparse_hessian_vector(
     standing in for the executor partitions)."""
     loss = objective.loss
 
+    # photon: sharding(axes=[data,model], in=?, out=[model])
     @partial(
         shard_map,
         mesh=mesh,
@@ -592,6 +599,7 @@ def feature_sharded_sparse_fit(
     """
     loss = objective.loss
 
+    # photon: sharding(axes=[data,model], in=?, out=?)
     @partial(
         shard_map,
         mesh=mesh,
@@ -655,6 +663,7 @@ def feature_sharded_tiled_fit(
     if owlqn:
         from photon_ml_tpu.optim.lbfgs import minimize_owlqn
 
+        # photon: sharding(axes=[data,model], in=?, out=?)
         @partial(
             shard_map,
             mesh=mesh,
@@ -685,6 +694,7 @@ def feature_sharded_tiled_fit(
             )
     else:
 
+        # photon: sharding(axes=[data,model], in=?, out=?)
         @partial(
             shard_map,
             mesh=mesh,
@@ -748,6 +758,7 @@ def feature_sharded_tiled_fit_tron(
     loss = objective.loss
     sched_spec = P((data_axis, model_axis))
 
+    # photon: sharding(axes=[data,model], in=?, out=?)
     @partial(
         shard_map,
         mesh=mesh,
@@ -979,6 +990,7 @@ def _build_feature_sharded_glm_fit(
 
         sched_spec = P((data_axis, model_axis))
 
+        # photon: sharding(axes=[data,model], in=?, out=?)
         @partial(
             shard_map,
             mesh=mesh,
@@ -1020,6 +1032,7 @@ def _build_feature_sharded_glm_fit(
             )
     else:
 
+        # photon: sharding(axes=[data,model], in=?, out=?)
         @partial(
             shard_map,
             mesh=mesh,
@@ -1143,6 +1156,7 @@ def feature_sharded_hessian_diagonal(
 
         sched_spec = P((data_axis, model_axis))
 
+        # photon: sharding(axes=[data,model], in=?, out=[model])
         @partial(
             shard_map,
             mesh=mesh,
@@ -1172,6 +1186,7 @@ def feature_sharded_hessian_diagonal(
             )
     else:
 
+        # photon: sharding(axes=[data,model], in=?, out=[model])
         @partial(
             shard_map,
             mesh=mesh,
@@ -1214,6 +1229,7 @@ def feature_sharded_sparse_fit_owlqn(
 
     loss = objective.loss
 
+    # photon: sharding(axes=[data,model], in=?, out=?)
     @partial(
         shard_map,
         mesh=mesh,

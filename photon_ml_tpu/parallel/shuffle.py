@@ -68,6 +68,7 @@ def entity_all_to_all(
     """
     n_dev = int(mesh.shape[axis])
 
+    # photon: sharding(axes=[data], in=?, out=?)
     @partial(
         shard_map,
         mesh=mesh,

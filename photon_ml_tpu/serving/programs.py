@@ -178,7 +178,9 @@ def _score_spec_partial(spec, arrays, batch: RequestBatch):
     return fe, stacked
 
 
+# photon: sharding(axes=[])
 _score_jit = jax.jit(_score_spec, static_argnums=(0,))
+# photon: sharding(axes=[])
 _score_partial_jit = jax.jit(_score_spec_partial, static_argnums=(0,))
 
 

@@ -76,6 +76,7 @@ def load_model_artifact(model_dir: str):
         ) from e
 
 
+# photon: sharding(axes=[], donates=[0])
 @partial(jax.jit, donate_argnums=(0,))
 def _donating_refresh(old_arrays, new_arrays):
     """Write generation N+1's values into buffers XLA may alias from

@@ -1,0 +1,45 @@
+"""PL012 negative (package-scoped): declared export/checkpoint scopes
+gather legitimately; non-bank values are untouched."""
+
+import numpy as np
+
+from photon_ml_tpu.parallel import overlap
+
+
+class ShardedREBank:
+    def __init__(self, mesh, spec, data):
+        self.data = data
+
+    @classmethod
+    def zeros(cls, mesh, spec, dim) -> "ShardedREBank":
+        return cls(mesh, spec, None)
+
+    def to_global(self):
+        return self.data
+
+
+# photon: sharding(export)
+def export_model(bank):
+    """Model artifacts are host-side by definition."""
+    if isinstance(bank, ShardedREBank):
+        return bank.to_global()
+    return bank
+
+
+# photon: sharding(checkpoint)
+def checkpoint_bank(bank: ShardedREBank):
+    return np.asarray(bank.data)
+
+
+def scalar_readback(bank: ShardedREBank):
+    # a device scalar derived from the bank is not a bank gather
+    term = bank.data if False else None
+    return overlap.device_get(compute_term(term))
+
+
+def compute_term(data):
+    return data
+
+
+def unrelated_numpy(rows):
+    return np.asarray(rows)

@@ -1,0 +1,37 @@
+"""PL012 positive (package-scoped): host gathers of sharded banks on
+paths with no export/checkpoint declaration."""
+
+import numpy as np
+
+from photon_ml_tpu.parallel import overlap
+
+
+class ShardedREBank:
+    def __init__(self, mesh, spec, data):
+        self.data = data
+
+    @classmethod
+    def zeros(cls, mesh, spec, dim) -> "ShardedREBank":
+        return cls(mesh, spec, None)
+
+    def to_global(self):
+        return self.data
+
+
+def undeclared_to_global(bank):
+    if isinstance(bank, ShardedREBank):
+        return bank.to_global()  # replicated [E, d] off the shards
+    return bank
+
+
+def undeclared_device_get(mesh, spec):
+    bank = ShardedREBank.zeros(mesh, spec, 4)
+    return overlap.device_get(bank.data)  # counted, but still a gather
+
+
+class Holder:
+    def __init__(self, sharded_bank):
+        self.sharded_bank = sharded_bank
+
+    def snapshot(self):
+        return np.asarray(self.sharded_bank.data)  # host [E, d]

@@ -1,0 +1,54 @@
+"""PL011 contract positives (package-scoped): a mesh entry point with
+no sharding declaration, and declarations that drifted from the code."""
+
+from functools import partial
+
+import jax
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def undeclared_entry(mesh):
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def vg(w, batch):  # no sharding declaration -> violation
+        return lax.psum(batch.sum() * w.sum(), DATA_AXIS)
+
+    return jax.jit(vg)
+
+
+def typo_axis_declared(mesh):
+    # photon: sharding(axes=[entiy], in=[r,data], out=[r])
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def vg(w, batch):  # declared axis is a typo AND misses 'data'
+        return lax.psum(batch.sum() * w.sum(), DATA_AXIS)
+
+    return jax.jit(vg)
+
+
+def spec_drift_declared(mesh):
+    # photon: sharding(axes=[data], in=[data,data], out=[r])
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def vg(w, batch):  # declared in= does not match the code's specs
+        return lax.psum(batch.sum() * w.sum(), DATA_AXIS)
+
+    return jax.jit(vg)

@@ -1,0 +1,59 @@
+"""PL011 negative: constants everywhere, declarations that match."""
+
+from functools import partial
+
+import jax
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def collective_constant(x):
+    return lax.psum(x, DATA_AXIS)
+
+
+def axis_param_default(batch, axis_name=DATA_AXIS):
+    return jax.device_put(batch), axis_name
+
+
+def boolop_fallback(axis=None):
+    return axis or DATA_AXIS
+
+
+def empty_string_sentinel(axis=""):
+    # an empty default is a sentinel, not an axis literal
+    return axis
+
+
+def data_parallel(mesh):
+    # photon: sharding(axes=[data], in=[r,data], out=[r])
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def vg(w, batch):
+        return lax.psum(batch.sum() * w.sum(), DATA_AXIS)
+
+    return jax.jit(vg)
+
+
+def two_axis(mesh, data_axis=DATA_AXIS, model_axis=MODEL_AXIS):
+    # variadic tail + multi-axis spec tokens in the declaration
+    # photon: sharding(axes=[data,model], in=[model,data+model,*], out=[r])
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(model_axis), P(data_axis, model_axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def vg(w_block, x_block, l2):
+        z = lax.psum(x_block @ w_block, model_axis)
+        return lax.psum(z.sum(), data_axis) + l2
+
+    return jax.jit(vg)

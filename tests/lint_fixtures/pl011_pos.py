@@ -1,0 +1,25 @@
+"""PL011 positive: axis-name literals in every checked position."""
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def partition_spec_literal(mesh):
+    return P("data")  # literal in P(...)
+
+
+def collective_literal(x):
+    return lax.psum(x, "data")  # literal collective axis
+
+
+def stale_axis_literal(x):
+    return lax.all_gather(x, "entiy")  # typo'd axis — binds nothing
+
+
+def axis_param_default(batch, axis_name="model"):
+    return jax.device_put(batch), axis_name
+
+
+def boolop_fallback(axis=None):
+    return axis or "data"  # literal fallback for an axis name

@@ -1,0 +1,62 @@
+"""PL013 negative: complete reductions, psum-through-helper one hop,
+and unknown calls stay unflagged."""
+
+from functools import partial
+
+import jax
+from jax import lax, shard_map
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def _psum_helper(value):
+    return lax.psum(value, DATA_AXIS)
+
+
+def reduced_replication(mesh):
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=(P(), P(DATA_AXIS)),
+        check_vma=False,
+    )
+    def body(w, batch):
+        scores = batch * w  # stays sharded -> sharded out_spec
+        total = lax.psum(jnp.sum(scores), DATA_AXIS)
+        return total, scores
+
+    return jax.jit(body)
+
+
+def psum_through_helper(mesh):
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def body(w, batch):
+        # the reduction lives one call away — still complete
+        return _psum_helper(jnp.sum(batch * w))
+
+    return jax.jit(body)
+
+
+def unknown_call_is_not_flagged(optimize, mesh):
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def body(w, batch):
+        # `optimize` may reduce internally; the analyzer cannot prove
+        # the absence of a psum, so it stays silent
+        return optimize(w, batch)
+
+    return jax.jit(body)

@@ -1,0 +1,44 @@
+"""PL013 positive: a replication claim with no reduction, and a psum
+over an axis the specs never shard."""
+
+from functools import partial
+
+import jax
+from jax import lax, shard_map
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def unreduced_replication(mesh):
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def body(w, batch):
+        partial_sum = jnp.sum(batch * w)  # device-local partial
+        total = lax.psum(partial_sum, DATA_AXIS)
+        return total, partial_sum  # second output claims P() unreduced
+
+    return jax.jit(body)
+
+
+def unbound_axis_psum(mesh):
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS),),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def body(batch):
+        # MODEL_AXIS is not in this site's specs: the psum either
+        # multiplies replicated values or binds a stale axis
+        return lax.psum(jnp.sum(batch), MODEL_AXIS)
+
+    return jax.jit(body)

@@ -1,0 +1,38 @@
+"""PL014 negative: the rebind-the-result swap idiom, conditional
+donation tuples, and defensive copies."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _donate_args():
+    return (0,) if jax.default_backend() != "cpu" else ()
+
+
+@partial(jax.jit, donate_argnums=_donate_args())
+def refresh(old, new):
+    return jnp.where(jnp.bool_(True), new, old)
+
+
+def rebind_swap(bank, new_values):
+    bank = refresh(bank, new_values)  # donor replaced by the result
+    return bank
+
+
+def loop_rebind(bank, updates):
+    for u in updates:
+        bank = refresh(bank, u)
+    return bank
+
+
+def defensive_copy(bank, new_values):
+    data = jnp.array(bank, copy=True)
+    data = refresh(data, new_values)
+    return data, bank  # the caller's bank was never donated
+
+
+def non_donated_position(bank, new_values):
+    out = refresh(jnp.array(bank, copy=True), new_values)
+    return out, new_values  # position 1 is not donated

@@ -1,0 +1,31 @@
+"""PL014 positive: donated arguments referenced after the donating
+call."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def refresh(old, new):
+    return jnp.where(jnp.bool_(True), new, old)
+
+
+def use_after_donate(old_bank, new_bank):
+    out = refresh(old_bank, new_bank)
+    return out, old_bank.shape, old_bank  # old_bank's buffer is gone
+
+
+def _build_donating():
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state, grad):
+        return state - grad
+
+    return step
+
+
+def builder_use_after_donate(state, grad):
+    step = _build_donating()
+    result = step(state, grad)
+    return result + state  # donated through the builder-made callable

@@ -369,6 +369,148 @@ class TestLintClean:
                 "arithmetic only"
             )
 
+    def test_spmd_rules_land_at_zero(self, full_report):
+        """ISSUE 14: PL011-PL014 ship with ZERO baseline entries
+        package-wide and ZERO allow() sites anywhere — the SPMD
+        discipline (axis constants, sharding contracts, shard-local
+        bank access, donation hygiene) is structural from day one.
+        PL012 additionally can never GAIN a baseline entry (write/load
+        both refuse), so the pin here is belt-and-braces."""
+        from photon_ml_tpu.lint import all_rules
+
+        rules = all_rules()
+        for rid in ("PL011", "PL012", "PL013", "PL014"):
+            assert rid in rules, sorted(rules)
+        entries = [
+            e for e in json.load(open(BASELINE))["entries"]
+            if e["rule"] in ("PL011", "PL012", "PL013", "PL014")
+        ]
+        assert entries == [], entries
+        slugs = {
+            "PL011", "mesh-axis-discipline",
+            "PL012", "sharded-bank-host-gather",
+            "PL013", "reduction-completeness",
+            "PL014", "donation-hygiene",
+        }
+        allows = [
+            s for s in full_report.allow_sites if s.rules & slugs
+        ]
+        assert allows == [], allows
+
+    def test_spmd_subsystems_carry_no_allow_sites(self, full_report):
+        """The acceptance bar: serving/, game/, parallel/, registry/
+        and obs/ carry NO allow() suppressions of any rule — the five
+        subsystems the sharding contracts cover hold the zero bar
+        wholesale."""
+        for subsystem in ("photon_ml_tpu/serving/",
+                          "photon_ml_tpu/game/",
+                          "photon_ml_tpu/parallel/",
+                          "photon_ml_tpu/registry/",
+                          "photon_ml_tpu/obs/"):
+            assert not [
+                s for s in full_report.allow_sites
+                if subsystem in s.path.replace(os.sep, "/")
+            ], f"{subsystem} must not carry allow() suppressions"
+
+    def test_sharding_inventory_is_complete(self, full_report):
+        """Every jit/shard_map mesh entry point in the package is
+        present in the contract inventory with a declaration, and the
+        committed SHARDING.md matches a fresh render (the CI drift
+        gate's in-process twin)."""
+        from photon_ml_tpu.lint import sharding_contracts as sc
+
+        assert full_report.package is not None
+        rows = sc.inventory(full_report.package)
+        # the count is asserted exactly: a NEW jit/shard_map entry
+        # point must land here (with a declaration) or fail PL011
+        assert len(rows) == 38, [
+            (r["module"], r["entry"]) for r in rows
+        ]
+        assert all(r["declared"] == "yes" for r in rows), [
+            r for r in rows if r["declared"] != "yes"
+        ]
+        modules = {r["module"] for r in rows}
+        for expected in (
+            "photon_ml_tpu/game/pod.py",
+            "photon_ml_tpu/game/residual_routing.py",
+            "photon_ml_tpu/game/random_effect.py",
+            "photon_ml_tpu/optim/problem.py",
+            "photon_ml_tpu/parallel/distributed.py",
+            "photon_ml_tpu/parallel/shuffle.py",
+            "photon_ml_tpu/ops/tiled_sparse.py",
+            "photon_ml_tpu/serving/programs.py",
+            "photon_ml_tpu/serving/swap.py",
+        ):
+            assert expected in modules, sorted(modules)
+        scopes = sc.export_scopes(full_report.package)
+        assert len(scopes) == 4, scopes
+        drift = sc.check_sharding_md(
+            os.path.join(REPO, "SHARDING.md"), full_report.package
+        )
+        assert drift is None, drift
+
+    def test_stripping_a_sharding_declaration_resurfaces_pl011(self):
+        """The contract layer is enforced, not decorative: removing one
+        real declaration from the pod update program resurfaces the
+        missing-declaration violation."""
+        path = "photon_ml_tpu/game/pod.py"
+        src = open(path).read()
+        decl = ("    # photon: sharding(axes=[entity], in=?, "
+                "out=[entity,r,r,r], donates=[0])\n")
+        assert decl in src, "pod declaration shape changed; update me"
+        clean = analyze_source(path, src)
+        assert not [v for v in clean.violations if v.rule == "PL011"], \
+            _fmt(clean.violations)
+        dirty = analyze_source(path, src.replace(decl, ""))
+        assert [
+            v for v in dirty.violations
+            if v.rule == "PL011" and "no '# photon: sharding" in v.message
+        ]
+
+    def test_stripping_an_export_declaration_resurfaces_pl012(self):
+        """The export scopes are audited declarations: removing the one
+        on the pod model's bank property makes its to_global() a PL012
+        violation again."""
+        path = "photon_ml_tpu/game/pod.py"
+        src = open(path).read()
+        clean = analyze_source(path, src)
+        assert not [v for v in clean.violations if v.rule == "PL012"], \
+            _fmt(clean.violations)
+        stripped = src.replace(
+            "    @property\n"
+            "    # photon: sharding(export)\n"
+            "    def bank(self) -> Array:",
+            "    @property\n"
+            "    def bank(self) -> Array:",
+        )
+        assert stripped != src, "pod bank property changed; update me"
+        dirty = analyze_source(path, stripped)
+        assert [v for v in dirty.violations if v.rule == "PL012"]
+
+    def test_reverting_tiled_sparse_axis_constants_resurfaces_pl011(self):
+        """Round 19's real PL011 findings: the tiled batch builders
+        bound their axis parameters to string literals. Reverting the
+        constant references fails the literal rule again."""
+        path = "photon_ml_tpu/ops/tiled_sparse.py"
+        src = open(path).read()
+        assert 'data_axis: str = DATA_AXIS' in src
+        clean = analyze_source(path, src)
+        assert not [v for v in clean.violations if v.rule == "PL011"], \
+            _fmt(clean.violations)
+        reverted = src.replace(
+            "    data_axis: str = DATA_AXIS,\n"
+            "    model_axis: str = MODEL_AXIS,",
+            '    data_axis: str = "data",\n'
+            '    model_axis: str = "model",',
+        )
+        assert reverted != src
+        dirty = analyze_source(path, reverted)
+        lits = [
+            v for v in dirty.violations
+            if v.rule == "PL011" and "literal" in v.message
+        ]
+        assert len(lits) == 2, _fmt(dirty.violations)
+
     def test_interleave_harness_is_analyzed(self, full_report):
         """The testing/ package (interleaving harness) is part of the
         analyzed set and holds the same bar — its own thread-shared

@@ -133,6 +133,61 @@ class TestRuleFixtures:
         # lock spanning a read-then-write protocol
         assert _violations("pl010_neg.py") == []
 
+    def test_pl011_positive(self):
+        vs = _violations("pl011_pos.py")
+        # P() literal, collective literal, typo'd axis, axis-param
+        # default, BoolOp fallback
+        assert _rules(vs) == ["PL011"] * 5, vs
+        assert sum("unknown mesh axis" in v.message for v in vs) == 1
+
+    def test_pl011_negative(self):
+        # constants everywhere; matching declarations incl. multi-axis
+        # spec tokens and a variadic tail
+        assert _violations("pl011_neg.py") == []
+
+    def test_pl011_contract_positive(self):
+        vs = _violations("photon_ml_tpu/spmd_contract_pos.py")
+        # undeclared entry point, typo'd declared axis (+ the axis it
+        # therefore misses), in= spec drift
+        assert _rules(vs) == ["PL011"] * 4, vs
+        msgs = " | ".join(v.message for v in vs)
+        assert "no '# photon: sharding(...)' declaration" in msgs
+        assert "unknown axis 'entiy'" in msgs
+        assert "does not name" in msgs
+        assert "drifted from the code" in msgs
+
+    def test_pl012_positive(self):
+        vs = _violations("photon_ml_tpu/pl012_pos.py")
+        # undeclared to_global, device_get through the counted seam,
+        # np.asarray of a .sharded_bank attribute
+        assert _rules(vs) == ["PL012"] * 3, vs
+
+    def test_pl012_negative(self):
+        # declared export/checkpoint scopes + scalar readbacks +
+        # non-bank numpy stay silent
+        assert _violations("photon_ml_tpu/pl012_neg.py") == []
+
+    def test_pl013_positive(self):
+        vs = _violations("pl013_pos.py")
+        # unreduced P() output, psum over an axis the specs never shard
+        assert _rules(vs) == ["PL013"] * 2, vs
+
+    def test_pl013_negative(self):
+        # complete reductions, psum-through-helper one hop, unknown
+        # calls unflagged
+        assert _violations("pl013_neg.py") == []
+
+    def test_pl014_positive(self):
+        vs = _violations("pl014_pos.py")
+        # direct use-after-donate + donation through a builder-made
+        # callable
+        assert _rules(vs) == ["PL014"] * 2, vs
+
+    def test_pl014_negative(self):
+        # rebind swap (incl. in-loop), conditional donate tuple via a
+        # local helper, defensive copy, non-donated positions
+        assert _violations("pl014_neg.py") == []
+
 
 class TestSuppression:
     def test_allow_comments_suppress(self):
@@ -328,6 +383,42 @@ class TestBaseline:
         with pytest.raises(ValueError, match="never baseline-able"):
             load_baseline(path)
 
+    def test_pl011_pl013_pl014_round_trip(self, tmp_path):
+        # the SPMD rules baseline like any other rule...
+        for fixture in ("pl011_pos.py", "pl013_pos.py", "pl014_pos.py"):
+            report = _report(fixture)
+            assert report.violations
+            path = str(tmp_path / f"b-{fixture}.json")
+            write_baseline(path, report.violations)
+            fresh = _report(fixture)
+            apply_baseline(fresh, load_baseline(path))
+            assert fresh.violations == []
+            assert fresh.unused_baseline == []
+
+    def test_pl012_refuses_to_baseline(self, tmp_path):
+        # ...except PL012: a sharded-bank host gather is never
+        # grandfathered (the PL009 discipline)
+        from photon_ml_tpu.lint import BaselineRefused
+
+        report = _report("photon_ml_tpu/pl012_pos.py")
+        assert report.violations
+        path = str(tmp_path / "b.json")
+        with pytest.raises(BaselineRefused, match="shard-local"):
+            write_baseline(path, report.violations)
+        assert not os.path.exists(path), "refusal must not write"
+
+    def test_hand_edited_pl012_baseline_entry_rejected(self, tmp_path):
+        path = str(tmp_path / "b.json")
+        json.dump(
+            {"version": 1, "entries": [{
+                "file": "x.py", "rule": "PL012",
+                "snippet": "bank.to_global()", "count": 1,
+            }]},
+            open(path, "w"),
+        )
+        with pytest.raises(ValueError, match="never baseline-able"):
+            load_baseline(path)
+
 
 class TestCLI:
     def _run(self, *args, cwd=None):
@@ -375,11 +466,16 @@ class TestCLI:
         r = self._run("--list-rules")
         assert r.returncode == 0
         for rid in ("PL001", "PL002", "PL003", "PL004", "PL005",
-                    "PL006", "PL007", "PL008", "PL009", "PL010"):
+                    "PL006", "PL007", "PL008", "PL009", "PL010",
+                    "PL011", "PL012", "PL013", "PL014"):
             assert rid in r.stdout
         assert "unguarded-shared-state" in r.stdout
         assert "lock-order-inversion" in r.stdout
         assert "atomicity-hygiene" in r.stdout
+        assert "mesh-axis-discipline" in r.stdout
+        assert "sharded-bank-host-gather" in r.stdout
+        assert "reduction-completeness" in r.stdout
+        assert "donation-hygiene" in r.stdout
 
     def test_json_covers_concurrency_rules(self):
         r = self._run(
@@ -407,3 +503,126 @@ class TestCLI:
         assert r.returncode == 2
         assert "never" in r.stderr.lower() or "cannot" in r.stderr.lower()
         assert not os.path.exists(target)
+
+    def test_write_baseline_refuses_pl012_with_exit_2(self, tmp_path):
+        target = str(tmp_path / "b.json")
+        r = self._run(
+            os.path.join(FIXTURES, "photon_ml_tpu", "pl012_pos.py"),
+            "--write-baseline", "--baseline", target,
+        )
+        assert r.returncode == 2
+        assert "shard-local" in r.stderr
+        assert not os.path.exists(target)
+
+    def test_no_spmd_flag_skips_the_spmd_pass(self):
+        r = self._run(
+            os.path.join(FIXTURES, "pl011_pos.py"), "--no-baseline",
+            "--no-spmd",
+        )
+        assert r.returncode == 0, r.stdout
+        # ...and the concurrency pass still runs independently
+        r = self._run(
+            os.path.join(FIXTURES, "pl008_pos.py"), "--no-baseline",
+            "--no-spmd",
+        )
+        assert r.returncode == 1, r.stdout
+
+    def test_json_covers_spmd_rules_and_contract_table(self):
+        r = self._run(
+            os.path.join(FIXTURES, "photon_ml_tpu",
+                         "spmd_contract_pos.py"),
+            "--no-baseline", "--json",
+        )
+        data = json.loads(r.stdout)
+        assert r.returncode == 1
+        assert {v["rule"] for v in data["violations"]} == {"PL011"}
+        assert len(data["violations"]) == 4
+        # the sharding-contract table rides the json report
+        assert "sharding_contracts" in data
+        entries = data["sharding_contracts"]
+        assert len(entries) == 3
+        assert {e["entry"] for e in entries} == {
+            "undeclared_entry.vg", "typo_axis_declared.vg",
+            "spec_drift_declared.vg",
+        }
+        undeclared = [
+            e for e in entries if e["entry"] == "undeclared_entry.vg"
+        ][0]
+        assert undeclared["declared"] == "NO"
+
+    def test_json_lists_export_scopes(self):
+        r = self._run(
+            os.path.join(FIXTURES, "photon_ml_tpu", "pl012_neg.py"),
+            "--no-baseline", "--json",
+        )
+        data = json.loads(r.stdout)
+        assert r.returncode == 0
+        scopes = {s["scope"] for s in data["export_scopes"]}
+        assert scopes == {"export_model", "checkpoint_bank"}
+
+    def test_sharding_md_check_detects_drift(self, tmp_path):
+        md = tmp_path / "SHARDING.md"
+        fixture = os.path.join(FIXTURES, "photon_ml_tpu",
+                               "spmd_contract_pos.py")
+        r = self._run(fixture, "--write-sharding-md", str(md))
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = self._run(fixture, "--check-sharding-md", str(md))
+        assert r.returncode == 0, r.stdout + r.stderr
+        md.write_text(md.read_text().replace(
+            "undeclared_entry.vg", "renamed_entry.vg"
+        ))
+        r = self._run(fixture, "--check-sharding-md", str(md))
+        assert r.returncode == 1
+        assert "stale" in r.stderr
+
+
+class TestShardingDeclarations:
+    def test_declaration_is_a_contract_not_a_suppression(self):
+        # annotating an entry point does NOT silence PL011 — a wrong
+        # declaration is itself the violation
+        src = (
+            "from functools import partial\n"
+            "import jax\n"
+            "from jax import lax, shard_map\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "DATA_AXIS = 'data'\n"
+            "def f(mesh):\n"
+            "    # photon: sharding(axes=[model], in=[r,data], out=[r])\n"
+            "    @partial(shard_map, mesh=mesh,\n"
+            "             in_specs=(P(), P(DATA_AXIS)), out_specs=P(),\n"
+            "             check_vma=False)\n"
+            "    def vg(w, batch):\n"
+            "        return lax.psum(batch.sum() * w.sum(), DATA_AXIS)\n"
+            "    return jax.jit(vg)\n"
+        )
+        from photon_ml_tpu.lint import analyze_source
+
+        vs = analyze_source("photon_ml_tpu/fake.py", src).violations
+        assert vs and all(v.rule == "PL011" for v in vs)
+
+    def test_parse_grammar(self):
+        from photon_ml_tpu.lint.spmd import parse_sharding_decl
+
+        d = parse_sharding_decl(
+            1, "axes=[data,model], in=[r,data+model,*], out=?, "
+               "donates=[0,2]"
+        )
+        assert d.axes == ["data", "model"]
+        assert d.in_specs == ["r", "data+model", "*"]
+        assert d.out_specs is None
+        assert d.donates == [0, 2]
+        assert not d.export and not d.errors
+        e = parse_sharding_decl(1, "export")
+        assert e.export and e.axes is None and not e.errors
+        bad = parse_sharding_decl(1, "axes=?, frobnicate=[1]")
+        assert bad.errors
+
+    def test_spec_matching_semantics(self):
+        from photon_ml_tpu.lint.spmd import specs_match
+
+        assert specs_match(["r", "data"], ["r", "data"])
+        assert specs_match(["r", "?"], ["r", "entity"])
+        assert specs_match(["entity", "*"], ["entity"] * 6)
+        assert not specs_match(["data"], ["r", "data"])
+        assert not specs_match(["r", "data"], ["r", "model"])
+        assert not specs_match(["r", "data", "r"], ["r", "data"])
