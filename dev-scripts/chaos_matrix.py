@@ -706,8 +706,14 @@ def shard_routing_arm(
     # router-process flight recorder: reset so the sequence assertions
     # below read THIS arm's transitions, not an earlier arm's
     from photon_ml_tpu.obs.flight_recorder import reset_flight_recorder
+    from photon_ml_tpu.obs.trace import set_tracing, tracer
 
     router_recorder = reset_flight_recorder()
+    # fleet-obs leg (ISSUE 15): the router process traces its own
+    # spans while the live collector drains both shard subprocesses'
+    # rings incrementally — merged + verified at the end of the arm
+    set_tracing(True)
+    tracer().clear()
     procs = []
     for s in range(2):
         out = os.path.join(base, f"routing-shard{s}")
@@ -743,6 +749,21 @@ def shard_routing_arm(
             policy=RoutingPolicy(subrequest_timeout_s=5.0),
         )
         router.connect()
+        from photon_ml_tpu.obs.fleet import (
+            FleetCollector,
+            fleet_check_conservation,
+            verify_fleet_trace,
+        )
+
+        collector = FleetCollector(
+            [
+                ("shard0", "127.0.0.1", ports[0]),
+                ("shard1", "127.0.0.1", ports[1]),
+            ],
+            local_name="router",
+            poll_s=0.5,
+            connect_timeout_s=15.0,
+        ).start()
         owners = {
             r["uid"]: ownership.owner_of(
                 ids.index((r.get("metadataMap") or {}).get("userId")), 2
@@ -869,6 +890,62 @@ def shard_routing_arm(
         assert swap_kinds == ["swap.stage", "swap.commit"], swap_kinds
         seqs = [e["seq"] for e in kill_dump["events"]]
         assert seqs == sorted(seqs), seqs
+        # -- fleet observability (ISSUE 15): stop the live collector
+        # (one final drain poll against the survivor), merge all three
+        # processes into ONE skew-corrected timeline, and verify the
+        # stitching contract: every router sub-request parents under
+        # its router request, every shard frontend span joins its
+        # sub-request, every serving.score leaf joins its shard's
+        # dispatch span, timestamps monotone parent->child within the
+        # recorded clock-sync uncertainty. The SIGKILLed shard's spans
+        # survive in the COLLECTOR (polled before the kill).
+        collector.stop(final_poll=True)
+        fleet_flight = collector.collect_flight()
+        stitched = collector.stitched_spans()
+        verdict = verify_fleet_trace(stitched)
+        assert verdict["ok"], verdict["violations"][:5]
+        assert verdict["router_subrequests"] > 0, verdict
+        assert verdict["frontend_requests"] > 0, verdict
+        assert verdict["score_leaves"] > 0, verdict
+        assert {s["member"] for s in stitched} == {
+            "router", "shard0", "shard1",
+        }
+        status = collector.member_status()
+        assert status["shard1"]["spans"] > 0, (
+            "the SIGKILLed shard's pre-kill spans must survive in the "
+            "collector"
+        )
+        fleet_trace = os.path.join(base, "fleet_trace.json")
+        n_events = collector.export(fleet_trace)
+        assert n_events > 0
+        # fleet conservation ACROSS the mid-flood two-step swap + the
+        # SIGKILL: router admitted == Σ shard-attributed + router-local
+        # outcomes; the survivor's live book joins exactly, the killed
+        # shard's last-transition snapshot joins advisorily
+        assert fleet_flight["shard0"]["complete"]
+        assert not fleet_flight["shard1"]["complete"]
+        fleet_cons = fleet_check_conservation(
+            router_recorder.check_conservation(),
+            {
+                name: {
+                    "conservation": fleet_flight[name].get(
+                        "conservation"
+                    ) or {},
+                    "complete": fleet_flight[name]["complete"],
+                    "shard_indices": [i],
+                }
+                for i, name in enumerate(("shard0", "shard1"))
+            },
+        )
+        assert fleet_cons["ok"], fleet_cons
+        assert set(fleet_cons["terminal_by_generation"]) >= {"1", "2"}, (
+            fleet_cons
+        )
+        assert fleet_cons["terminal_by_attribution"].get(
+            "degraded", 0
+        ) >= n_deg, fleet_cons
+        assert fleet_cons["shards"]["shard0"]["join_ok"] is True
+        assert fleet_cons["shards"]["shard1"]["join_ok"] is None
         # surviving shard drains clean with 0 request-path compiles
         procs[0][1].send_signal(signal.SIGTERM)
         stdout, _ = procs[0][1].communicate(timeout=120)
@@ -914,9 +991,14 @@ def shard_routing_arm(
             "SIGKILL, outcomes conserved, surviving shard drained "
             "exit 0; flight recorders of all 3 processes captured "
             "stage->commit->kill->circuit-open in order, conservation "
-            "held across the swap"
+            "held across the swap; fleet collector merged "
+            f"{n_events} trace event(s) from all 3 processes into "
+            "fleet_trace.json (nesting + skew verified) and "
+            "fleet-wide conservation balanced router-admitted == "
+            "Σ shard-attributed + router-local across swap + SIGKILL"
         )
     finally:
+        set_tracing(False)
         for _out, p in procs:
             if p.poll() is None:
                 p.kill()

@@ -137,6 +137,19 @@ class ServingParams:
     # Device-timeline co-capture: jax.profiler trace over the serve
     # phase (replay AND frontend modes), next to the host spans.
     profile_dir: Optional[str] = None
+    # Fleet-scale observability (ISSUE 15). Router mode only:
+    # --fleet-obs-dir runs a live FleetCollector over the shard fleet
+    # (incremental {"op":"trace"} drains on fresh connections, NTP-style
+    # clock-skew normalization) and writes ONE merged fleet_trace.json
+    # + fleet_conservation.json at exit.
+    fleet_obs_dir: Optional[str] = None
+    fleet_poll_s: float = 1.0
+    # Declarative SLOs with multi-window burn-rate alerting: inline
+    # JSON, @file, or "default". Alerts land on the flight-recorder
+    # ring and as registry gauges; with a registry watcher attached the
+    # post-swap health judgment consumes the burn-rate state.
+    slo: Optional[str] = None
+    slo_tick_s: float = 1.0
 
     @property
     def stdin_mode(self) -> bool:
@@ -174,6 +187,21 @@ class ServingParams:
         return out
 
     def validate(self) -> None:
+        if self.fleet_obs_dir and not self.router_mode:
+            raise ValueError(
+                "--fleet-obs-dir is the router-side fleet collector; "
+                "it requires --shard-servers (router mode)"
+            )
+        if self.fleet_poll_s <= 0:
+            raise ValueError("fleet-poll-s must be > 0")
+        if self.slo_tick_s <= 0:
+            raise ValueError("slo-tick-s must be > 0")
+        if self.slo:
+            from photon_ml_tpu.obs.slo import parse_slo_specs
+
+            # parse-time rejection: a typo'd spec must fail the launch,
+            # not silently alert on nothing
+            parse_slo_specs(self.slo)
         if self.shard_mode:
             if self.shard_index is None or self.shard_count is None:
                 raise ValueError(
@@ -405,6 +433,53 @@ class ServingDriver:
         self.registry = None            # registry.ModelRegistry
         self.registry_watcher = None    # registry.RegistryWatcher
         self._registry_generation = None
+        # fleet observability (--fleet-obs-dir / --slo)
+        self.slo_engine = None          # obs.slo.SLOEngine
+        self.fleet_collector = None     # obs.fleet.FleetCollector
+
+    # -- SLO engine (--slo) --------------------------------------------------
+
+    def _start_slo(self, *, router=None):
+        """Start the burn-rate engine over the process registry: bind
+        the live instruments (serving or router plane), register the
+        status view, run the tick thread. Alerts file onto the flight
+        ring and surface as slo_* gauges."""
+        p = self.params
+        if not p.slo:
+            return None
+        from photon_ml_tpu.obs.flight_recorder import flight_recorder
+        from photon_ml_tpu.obs.registry import default_registry
+        from photon_ml_tpu.obs.slo import (
+            SLOEngine,
+            default_router_slos,
+            parse_slo_specs,
+        )
+
+        registry = self.obs.registry or default_registry()
+        if p.slo.strip() == "default" and router is not None:
+            specs = default_router_slos()
+        else:
+            specs = parse_slo_specs(p.slo)
+        if router is not None:
+            router.metrics.bind_registry(registry)
+        elif self.metrics is not None:
+            self.metrics.bind_registry(registry)
+        engine = SLOEngine(registry, specs, recorder=flight_recorder())
+        registry.register_view("slo", engine.status)
+        engine.start(period_s=p.slo_tick_s)
+        self.slo_engine = engine
+        self.logger.info(
+            "SLO engine: %d spec(s), tick %.2fs — %s",
+            len(specs), p.slo_tick_s,
+            ", ".join(s.name for s in specs),
+        )
+        return engine
+
+    def _finish_slo(self) -> Optional[Dict]:
+        if self.slo_engine is None:
+            return None
+        self.slo_engine.stop()
+        return self.slo_engine.status()
 
     # -- setup ---------------------------------------------------------------
 
@@ -789,6 +864,9 @@ class ServingDriver:
             extra["outcomes"] = dict(sorted(outcomes.items()))
         if self.drain_report is not None:
             extra["drain"] = self.drain_report.to_dict()
+        slo_status = self._finish_slo()
+        if slo_status is not None:
+            extra["slo"] = slo_status
         if self.registry_watcher is not None:
             extra["registry"] = {
                 **self.registry_watcher.lineage(),
@@ -825,6 +903,7 @@ class ServingDriver:
         requests = self._build()
         self.metrics = ServingMetrics()
         self.obs.register_view("serving", self.metrics.snapshot)
+        self._start_slo()
         overlap.reset_readback_stats()
         batcher = MicroBatcher(
             self.serving_model.current,
@@ -1006,6 +1085,26 @@ class ServingDriver:
             "routing over %d shard-server(s), fleet generation %d",
             info["shards"], info["generation"],
         )
+        self._start_slo(router=router)
+        if p.fleet_obs_dir:
+            os.makedirs(p.fleet_obs_dir, exist_ok=True)
+            from photon_ml_tpu.obs.fleet import FleetCollector
+
+            # the live fleet collector: incremental {"op":"trace"}
+            # drains over fresh connections against every shard, plus
+            # the router's own local spans — one merged timeline
+            self.fleet_collector = FleetCollector(
+                [
+                    (f"shard{i}", h, pt)
+                    for i, (h, pt) in enumerate(p.shard_addresses)
+                ],
+                local_name="router",
+                poll_s=p.fleet_poll_s,
+            ).start()
+            self.logger.info(
+                "fleet collector polling %d shard(s) every %.2fs -> %s",
+                len(p.shard_addresses), p.fleet_poll_s, p.fleet_obs_dir,
+            )
         self._router_swap_result = None
         records = self._router_records()
         swap_once = threading.Lock()
@@ -1125,12 +1224,16 @@ class ServingDriver:
             1 for _r, o, s in scored
             if o == "ok" and getattr(s, "degraded", False)
         )
+        fleet_block = self._finish_fleet_obs()
+        slo_status = self._finish_slo()
         obs_summary = self.obs.finish()
         atomic_write_json(
             os.path.join(p.output_dir, "metrics.json"),
             {
                 "mode": "router",
                 **({"obs": obs_summary} if obs_summary else {}),
+                **({"fleet_obs": fleet_block} if fleet_block else {}),
+                **({"slo": slo_status} if slo_status else {}),
                 "interrupted": self.interrupted,
                 "outcomes": dict(sorted(outcomes.items())),
                 "degraded_responses": degraded,
@@ -1147,6 +1250,56 @@ class ServingDriver:
             s for _r, outcome, s in scored if outcome == "ok"
         ]
         self.logger.info("timers:\n%s", self.timer.summary())
+
+    def _finish_fleet_obs(self) -> Optional[Dict]:
+        """Stop the collector (one final drain poll), fetch every
+        member's flight book, write fleet_trace.json +
+        fleet_conservation.json, and return the metrics.json block."""
+        if self.fleet_collector is None:
+            return None
+        from photon_ml_tpu.obs.fleet import fleet_check_conservation
+        from photon_ml_tpu.reliability import atomic_write_json
+
+        p = self.params
+        collector = self.fleet_collector
+        collector.stop()
+        flight = collector.collect_flight()
+        books = {
+            f"shard{i}": {
+                "conservation": (
+                    flight.get(f"shard{i}", {}).get("conservation") or {}
+                ),
+                "complete": bool(
+                    flight.get(f"shard{i}", {}).get("complete")
+                ),
+                "shard_indices": [i],
+            }
+            for i in range(len(p.shard_addresses))
+        }
+        router_book = (
+            flight.get("router", {}).get("conservation") or {}
+        )
+        conservation = fleet_check_conservation(router_book, books)
+        trace_path = os.path.join(p.fleet_obs_dir, "fleet_trace.json")
+        n_events = collector.export(
+            trace_path, extra={"conservation_ok": conservation["ok"]}
+        )
+        atomic_write_json(
+            os.path.join(p.fleet_obs_dir, "fleet_conservation.json"),
+            conservation,
+        )
+        self.logger.info(
+            "fleet obs: %d merged trace event(s) -> %s; conservation "
+            "%s", n_events, trace_path,
+            "OK" if conservation["ok"] else "VIOLATED",
+        )
+        return {
+            "fleet_obs_dir": p.fleet_obs_dir,
+            "fleet_trace_path": trace_path,
+            "trace_events": n_events,
+            "members": collector.member_status(),
+            "conservation": conservation,
+        }
 
     def _run_frontend(self, batcher) -> None:
         """Network-serving main loop: publish the bound port, serve
@@ -1190,6 +1343,13 @@ class ServingDriver:
                 },
                 logger=self.logger,
                 initial_generation=self._registry_generation,
+                # --slo: the post-swap health judgment consumes the
+                # burn-rate alert state instead of raw error fractions
+                burn_gate=(
+                    self.slo_engine.any_alert_active
+                    if self.slo_engine is not None
+                    else None
+                ),
             ).start()
             on_outcome = (
                 lambda ok, degraded, failed:
@@ -1480,6 +1640,30 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "(replay, frontend and router modes) — co-captured with the "
         "--obs-dir host spans",
     )
+    ap.add_argument(
+        "--fleet-obs-dir", default=None,
+        help="router mode: run the live fleet collector (incremental "
+        "{\"op\": \"trace\"} drains over fresh connections, clock-skew "
+        "normalized) and write ONE merged fleet_trace.json + "
+        "fleet_conservation.json here at exit",
+    )
+    ap.add_argument(
+        "--fleet-poll-s", type=float, default=1.0,
+        help="fleet collector poll period",
+    )
+    ap.add_argument(
+        "--slo", default=None,
+        help="declarative SLOs with multi-window burn-rate alerting: "
+        "inline JSON (object or list of {name, objective, kind, "
+        "metric, ...}), @file, or 'default'; alerts land on the "
+        "flight-recorder ring and as slo_* registry gauges, and a "
+        "registry watcher consumes the burn-rate state for its "
+        "post-swap health judgment",
+    )
+    ap.add_argument(
+        "--slo-tick-s", type=float, default=1.0,
+        help="SLO engine evaluation period",
+    )
     return ap
 
 
@@ -1552,6 +1736,10 @@ def params_from_args(argv=None) -> ServingParams:
         obs_dir=ns.obs_dir,
         obs_snapshot_s=ns.obs_snapshot_s,
         profile_dir=ns.profile_dir,
+        fleet_obs_dir=ns.fleet_obs_dir,
+        fleet_poll_s=ns.fleet_poll_s,
+        slo=ns.slo,
+        slo_tick_s=ns.slo_tick_s,
     )
 
 

@@ -66,9 +66,12 @@ from photon_ml_tpu.obs.trace import (  # noqa: F401
     Span,
     Tracer,
     chrome_trace_events,
+    epoch,
+    epoch_now,
     export_chrome_trace,
     new_trace_id,
     record_span,
+    reset_tracer,
     set_tracing,
     span,
     start_span,
@@ -77,6 +80,13 @@ from photon_ml_tpu.obs.trace import (  # noqa: F401
     tracing_scope,
     wire_context,
 )
+
+# Fleet-scale observability (ISSUE 15): imported lazily by consumers —
+# photon_ml_tpu.obs.fleet (FleetCollector, stitch/verify/export,
+# fleet_check_conservation, the post-hoc CLI) and photon_ml_tpu.obs.slo
+# (SLOSpec, SLOEngine, parse_slo_specs) are deliberately NOT imported
+# here: the serving hot path imports this package and must not pay for
+# the collector/engine machinery it never uses.
 
 __all__ = ["ObsSession"]
 

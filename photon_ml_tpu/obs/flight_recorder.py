@@ -59,6 +59,16 @@ __all__ = [
 
 DEFAULT_CAPACITY = 4096
 
+
+def _env_capacity() -> int:
+    """Ring bound from PHOTON_FLIGHT_EVENTS (mirroring PHOTON_TRACE /
+    PHOTON_TRACE_SPANS); the chosen bound rides every snapshot/dump as
+    ``capacity`` so drop accounting is interpretable post-hoc."""
+    try:
+        return max(int(os.environ.get("PHOTON_FLIGHT_EVENTS", "")), 1)
+    except ValueError:
+        return DEFAULT_CAPACITY
+
 # Event kinds whose arrival auto-dumps the ring when an auto-dump path
 # is armed: low-frequency protocol transitions. A SIGKILLed process
 # cannot run an exit handler, but its last swap/rollback transition
@@ -75,8 +85,10 @@ class FlightRecorder:
     thread-safe under the recorder's single lock — including dumps, so
     a dump concurrent with event emission is never torn."""
 
-    def __init__(self, capacity: int = DEFAULT_CAPACITY):
-        self.capacity = int(capacity)
+    def __init__(self, capacity: Optional[int] = None):
+        self.capacity = (
+            int(capacity) if capacity is not None else _env_capacity()
+        )
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=self.capacity)
         self._seq = 0  # photon: guarded-by(_lock)
@@ -84,6 +96,7 @@ class FlightRecorder:
         self._admitted = 0  # photon: guarded-by(_lock)
         self._terminal: Dict[str, int] = {}  # photon: guarded-by(_lock)
         self._terminal_by_gen: Dict[str, int] = {}  # photon: guarded-by(_lock)
+        self._terminal_by_attr: Dict[str, int] = {}  # photon: guarded-by(_lock)
         self._auto_dump_path: Optional[str] = None  # photon: guarded-by(_lock)
         self._dumps = 0  # photon: guarded-by(_lock)
         self._dump_errors = 0  # photon: guarded-by(_lock)
@@ -126,14 +139,30 @@ class FlightRecorder:
             self._admitted += int(n)
 
     def note_terminal(
-        self, outcome: str, *, generation: Optional[int] = None, n: int = 1
+        self,
+        outcome: str,
+        *,
+        generation: Optional[int] = None,
+        attribution: Optional[str] = None,
+        n: int = 1,
     ) -> None:
+        """One (or n) named terminal outcome(s). ``attribution`` is the
+        fleet-conservation split: the router stamps every terminal with
+        WHO terminated it (``shard:<i>`` for a wire-served gather keyed
+        by the FE-providing shard, ``cache`` for a zero-fan-out hot-
+        cache hit, ``degraded`` for FE-only outcomes, ``no_shard`` /
+        ``shed`` for refusals), so fleet_check_conservation can balance
+        router admitted == Σ shard-attributed + router-local books."""
         with self._lock:
             self._terminal[outcome] = self._terminal.get(outcome, 0) + int(n)
             gen_key = "none" if generation is None else str(generation)
             self._terminal_by_gen[gen_key] = (
                 self._terminal_by_gen.get(gen_key, 0) + int(n)
             )
+            if attribution is not None:
+                self._terminal_by_attr[attribution] = (
+                    self._terminal_by_attr.get(attribution, 0) + int(n)
+                )
 
     def check_conservation(self) -> Dict[str, object]:
         """``admitted == sum(terminal outcomes)`` — SLO accounting
@@ -155,6 +184,9 @@ class FlightRecorder:
                 "terminal": dict(sorted(self._terminal.items())),
                 "terminal_by_generation": dict(
                     sorted(self._terminal_by_gen.items())
+                ),
+                "terminal_by_attribution": dict(
+                    sorted(self._terminal_by_attr.items())
                 ),
             }
 
@@ -216,6 +248,7 @@ class FlightRecorder:
             self._admitted = 0
             self._terminal = {}
             self._terminal_by_gen = {}
+            self._terminal_by_attr = {}
             self._dumps = 0
             self._dump_errors = 0
 
@@ -234,9 +267,10 @@ def flight_recorder() -> FlightRecorder:
 
 
 def reset_flight_recorder(
-    capacity: int = DEFAULT_CAPACITY,
+    capacity: Optional[int] = None,
 ) -> FlightRecorder:
-    """Fresh process-wide recorder (tests / driver re-entry)."""
+    """Fresh process-wide recorder (tests / driver re-entry); the
+    default capacity re-reads PHOTON_FLIGHT_EVENTS."""
     global _RECORDER
     with _SINGLETON_LOCK:
         _RECORDER = FlightRecorder(capacity)

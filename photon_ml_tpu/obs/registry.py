@@ -277,12 +277,17 @@ class MetricsRegistry:
                 c if c.isalnum() or c == "_" else "_" for c in name
             )
 
-        def sample(name, key, value):
+        def label_parts(key) -> List[str]:
             if key and key != ("",):
-                label_text = ",".join(
-                    part.replace("=", '="', 1) + '"' for part in key
-                )
-                lines.append(f"{name}{{{label_text}}} {value}")
+                return [part.replace("=", '="', 1) + '"' for part in key]
+            return []
+
+        def sample(name, key, value, extra: str = ""):
+            parts = label_parts(key)
+            if extra:
+                parts.append(extra)
+            if parts:
+                lines.append(f"{name}{{{','.join(parts)}}} {value}")
             else:
                 lines.append(f"{name} {value}")
 
@@ -293,14 +298,21 @@ class MetricsRegistry:
                 if inst.kind == "histogram":
                     sample(f"{name}_count", key, v["count"])
                     sample(f"{name}_sum", key, v["sum"])
+                    # _bucket lines merge the series' label set with
+                    # le, exactly like sample() renders it — two label
+                    # sets of one histogram must never emit colliding
+                    # unlabeled {le=...} samples
                     cum = 0
                     for b, n in zip(inst.bounds, v["buckets"]):
                         cum += n
-                        lines.append(
-                            f'{name}_bucket{{le="{b}"}} {cum}'
+                        sample(
+                            f"{name}_bucket", key, cum,
+                            extra=f'le="{b}"',
                         )
                     cum += v["buckets"][-1]
-                    lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+                    sample(
+                        f"{name}_bucket", key, cum, extra='le="+Inf"'
+                    )
                 else:
                     sample(name, key, v)
 

@@ -51,6 +51,7 @@ __all__ = [
     "Span",
     "Tracer",
     "tracer",
+    "reset_tracer",
     "tracing_enabled",
     "set_tracing",
     "tracing_scope",
@@ -62,6 +63,8 @@ __all__ = [
     "TRACES_ATTR",
     "new_trace_id",
     "wire_context",
+    "epoch",
+    "epoch_now",
     "chrome_trace_events",
     "export_chrome_trace",
 ]
@@ -73,19 +76,48 @@ PARENT_KEY = "parent_span"
 
 DEFAULT_MAX_SPANS = 1 << 16
 
+
+def _env_int(name: str, default: int) -> int:
+    """Ring bound from the environment (PHOTON_TRACE_SPANS /
+    PHOTON_FLIGHT_EVENTS, mirroring the PHOTON_TRACE switch)."""
+    try:
+        return max(int(os.environ.get(name, "")), 1)
+    except ValueError:
+        return default
+
+
 # One (wall, perf) epoch per process: every span's perf_counter pair
 # maps onto the wall clock through it, so cross-process traces line up
-# to clock-sync accuracy without per-span time.time() calls.
+# to clock-sync accuracy without per-span time.time() calls. The fleet
+# collector's NTP-style skew estimation measures a remote process's
+# "now" through THIS mapping (see epoch_now), so the offset it derives
+# corrects exactly the timeline the spans are exported on.
 _EPOCH_WALL = time.time()
 _EPOCH_PERF = time.perf_counter()
 
+
+def epoch() -> tuple:
+    """This process's (wall, perf) epoch — the span-time -> wall-clock
+    mapping, served on the wire by the ``{"op": "trace"}`` control op."""
+    return (_EPOCH_WALL, _EPOCH_PERF)
+
+
+def epoch_now() -> float:
+    """"Now" as the span timeline sees it: the wall clock REACHED BY
+    the epoch mapping (not a fresh time.time(), which may have drifted
+    from it) — what the fleet collector's skew estimate must target."""
+    return _EPOCH_WALL + (time.perf_counter() - _EPOCH_PERF)
+
+
 # Id mints. itertools.count.__next__ is atomic (implemented in C), so
-# minting needs no lock; the pid prefix keeps ids unique across a
-# multi-process fleet whose dumps are merged into one timeline.
+# minting needs no lock; the pid + boot-nonce prefix keeps ids unique
+# across a multi-process (and multi-HOST — pids alone can collide
+# across boxes) fleet whose spans are merged into one timeline.
 _TRACE_IDS = itertools.count(1)
 _SPAN_IDS = itertools.count(1)
-_TRACE_PREFIX = f"t{os.getpid():x}-"
-_SPAN_PREFIX = f"s{os.getpid():x}-"
+_PROC_NONCE = os.urandom(3).hex()
+_TRACE_PREFIX = f"t{os.getpid():x}.{_PROC_NONCE}-"
+_SPAN_PREFIX = f"s{os.getpid():x}.{_PROC_NONCE}-"
 
 # Enablement is a single module global: the disabled fast path is one
 # read + branch. set_tracing is the only writer (driver startup / test
@@ -130,7 +162,7 @@ class Span:
 
     __slots__ = (
         "name", "trace_id", "span_id", "parent_id",
-        "t0", "t1", "tid", "attrs", "_tracer",
+        "t0", "t1", "tid", "attrs", "seq", "_tracer",
     )
 
     def __init__(
@@ -150,6 +182,7 @@ class Span:
         self.t1: Optional[float] = None
         self.tid = threading.get_ident()
         self.attrs = dict(attrs) if attrs else {}
+        self.seq = 0  # stamped by Tracer._file when the span is filed
         self._tracer = tracer_obj
 
     def end(self, t1: Optional[float] = None, **attrs) -> "Span":
@@ -174,6 +207,7 @@ class Span:
             "t0": self.t0,
             "t1": self.t1,
             "tid": self.tid,
+            "seq": self.seq,
             "attrs": dict(self.attrs),
         }
 
@@ -189,6 +223,7 @@ class _NullSpan:
     t0 = 0.0
     t1 = 0.0
     tid = 0
+    seq = 0
     attrs: Dict[str, object] = {}
     duration_s = 0.0
 
@@ -212,8 +247,15 @@ class Tracer:
     raises, so snapshots take the whole ring by swap instead).
     """
 
-    def __init__(self, max_spans: int = DEFAULT_MAX_SPANS):
-        self.max_spans = int(max_spans)
+    def __init__(self, max_spans: Optional[int] = None):
+        # ring bound: explicit arg > PHOTON_TRACE_SPANS > default. The
+        # chosen bound rides every export's otherData so post-hoc drop
+        # accounting is interpretable.
+        self.max_spans = (
+            int(max_spans)
+            if max_spans is not None
+            else _env_int("PHOTON_TRACE_SPANS", DEFAULT_MAX_SPANS)
+        )
         # single-writer-per-append ring; appends are GIL-atomic. The
         # reference itself is swapped only under _lock (drain).
         self._ring = deque(maxlen=self.max_spans)  # photon: guarded-by(atomic)
@@ -230,7 +272,13 @@ class Tracer:
         return max(0, self._filed - len(self._ring))
 
     def _file(self, s: Span) -> None:
-        self._filed = next(self._counter)
+        # seq stamps a process-monotone order onto the ring so the
+        # {"op": "trace"} drain can be cursor-keyed: a poll never
+        # duplicates (seq > cursor filter) and never silently drops
+        # (gaps in the seq line are counted eviction). Still lock-free:
+        # the counter bump is C-atomic, the append GIL-atomic.
+        s.seq = next(self._counter)
+        self._filed = s.seq
         self._ring.append(s)
 
     def start(
@@ -276,6 +324,44 @@ class Tracer:
         with self._lock:
             return list(self._ring)
 
+    def read_since(self, cursor: int):
+        """Incremental, cursor-keyed read for the ``{"op": "trace"}``
+        drain: returns ``(spans, new_cursor, dropped)`` where ``spans``
+        are the finished spans with ``seq > cursor`` in a CONTIGUOUS
+        seq run, ``new_cursor`` is the last returned seq (pass it back
+        on the next poll), and ``dropped`` counts spans filed after the
+        cursor but already evicted from the ring before this poll could
+        read them.
+
+        Two subtleties make the contract exact:
+
+        - a span whose seq is minted but whose ring append has not yet
+          landed (the record path is lock-free) would leave a MID-run
+          gap; the run stops there and the next poll picks it up —
+          never skipped, never duplicated;
+        - a cursor AHEAD of the filed count means the ring was reset
+          (drain()/clear()/process restart): the read restarts from the
+          beginning rather than silently returning nothing forever.
+        """
+        cursor = int(cursor)
+        with self._lock:
+            if cursor > self._filed:
+                cursor = 0
+            fresh = sorted(
+                (s for s in self._ring if s.seq > cursor),
+                key=lambda s: s.seq,
+            )
+            if not fresh:
+                return [], cursor, 0
+            # front gap = spans evicted between polls (ring wrapped)
+            dropped = fresh[0].seq - cursor - 1
+            out = [fresh[0]]
+            for s in fresh[1:]:
+                if s.seq != out[-1].seq + 1:
+                    break  # mid gap: a span is mid-file; resume next poll
+                out.append(s)
+            return out, out[-1].seq, max(dropped, 0)
+
     def drain(self) -> List[Span]:
         with self._lock:
             ring, self._ring = self._ring, deque(maxlen=self.max_spans)
@@ -301,6 +387,15 @@ def tracer() -> Tracer:
     return _TRACER
 
 
+def reset_tracer() -> Tracer:
+    """Fresh process-wide tracer, re-reading PHOTON_TRACE_SPANS (tests
+    / driver re-entry). Spans already handed out keep filing into the
+    old ring — a reset mid-traffic loses them, so call it quiescent."""
+    global _TRACER
+    _TRACER = Tracer()
+    return _TRACER
+
+
 def start_span(
     name: str,
     *,
@@ -309,12 +404,25 @@ def start_span(
     **attrs,
 ):
     """Open a span on the process tracer (no-op singleton when tracing
-    is off — the call sites never branch themselves)."""
+    is off — the call sites never branch themselves). Assembled
+    directly: the ``**attrs`` dict is freshly built for this call, so
+    the span owns it without the defensive copy ``Span.__init__``
+    makes — this is the request-path open (router request/sub-request,
+    frontend request), priced by dev-scripts/bench_fleet_obs.sh."""
     if not _ENABLED:
         return NULL_SPAN
-    return _TRACER.start(
-        name, trace_id=trace_id, parent_id=parent_id, attrs=attrs or None
-    )
+    s = Span.__new__(Span)
+    s.name = name
+    s.trace_id = trace_id if trace_id is not None else new_trace_id()
+    s.span_id = _new_span_id()
+    s.parent_id = parent_id
+    s.t0 = time.perf_counter()
+    s.t1 = None
+    s.tid = threading.get_ident()
+    s.attrs = attrs
+    s.seq = 0
+    s._tracer = _TRACER
+    return s
 
 
 def record_span(
@@ -411,6 +519,7 @@ def expand_spans(spans: Iterable[Span]) -> List[Span]:
             child.t0 = s.t0
             child.t1 = s.t1
             child.tid = s.tid
+            child.seq = s.seq
             child.attrs = {
                 "degraded": bool(degraded),
                 "dispatch_span": s.span_id,
@@ -484,6 +593,11 @@ def export_chrome_trace(
         "otherData": {
             "pid": os.getpid(),
             "dropped_spans": _TRACER.dropped,
+            # the configured ring bound (PHOTON_TRACE_SPANS) rides the
+            # artifact so drop accounting is interpretable post-hoc
+            "max_spans": _TRACER.max_spans,
+            "epoch_wall": _EPOCH_WALL,
+            "epoch_perf": _EPOCH_PERF,
             **(extra or {}),
         },
     }

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from photon_ml_tpu.obs.flight_recorder import flight_recorder
 from photon_ml_tpu.registry.registry import GenerationInfo, ModelRegistry
@@ -115,12 +115,19 @@ class RegistryWatcher:
         swap_kwargs: Optional[Dict[str, object]] = None,
         logger=None,
         initial_generation: Optional[GenerationInfo] = None,
+        burn_gate: Optional[Callable[[], bool]] = None,
     ):
         self.registry = registry
         self.serving_model = serving_model
         self.poll_s = max(float(poll_s), 0.05)
         self.policy = policy or RollbackPolicy()
         self.auto_rollback = auto_rollback
+        # SLO integration (obs/slo.py): when set, the post-swap health
+        # judgment consumes BURN-RATE state (typically
+        # SLOEngine.any_alert_active — both windows past threshold)
+        # instead of the window's raw error fraction. The window still
+        # gates on min_requests so a swap is never judged on no data.
+        self.burn_gate = burn_gate
         self.swap_kwargs = dict(swap_kwargs or {})
         self.logger = logger
         self._stop = threading.Event()
@@ -177,10 +184,18 @@ class RegistryWatcher:
                 return
         self._window.observe(degraded or failed)
         n, rate = self._window.snapshot()
-        if (
-            n >= self.policy.min_requests
-            and rate > self.policy.max_unhealthy_rate
-        ):
+        if self.burn_gate is not None:
+            # burn-rate mode: the SLO engine's multi-window verdict
+            # replaces the raw window fraction — min_requests still
+            # applies, so the first post-swap completion cannot roll
+            # back on a stale pre-swap burn
+            try:
+                unhealthy = bool(self.burn_gate())
+            except Exception:
+                unhealthy = False  # a wedged gate must not roll back
+        else:
+            unhealthy = rate > self.policy.max_unhealthy_rate
+        if n >= self.policy.min_requests and unhealthy:
             # flag for the watcher thread; the completion callback must
             # never run a swap itself (it holds response-path time).
             # Re-check the watch under the lock: a rollback that just

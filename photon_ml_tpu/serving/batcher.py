@@ -334,10 +334,15 @@ class MicroBatcher:
         default_deadline_ms: Optional[float] = None,
         max_submit_wait_s: float = DEFAULT_SUBMIT_WAIT_S,
         partial: Optional[bool] = None,
+        recorder=None,
     ):
         self._bank_ref = bank_ref
         self._programs = programs
         self._metrics = metrics
+        # conservation ledger target: the process flight recorder by
+        # default; in-process fleets (tests, bench rigs) pass each
+        # member its OWN recorder so the per-member books stay separate
+        self._flight = recorder if recorder is not None else flight_recorder()
         # exclusion against a DONATING hot swap (see ServingModel.
         # dispatch_lock): inferred from a bound ServingModel.current
         # bank_ref so the safe wiring is the default wiring
@@ -479,14 +484,14 @@ class MicroBatcher:
                 self._metrics.record_shed(e.reason)
             # structured overload event + (refused-before-admission, so
             # it enters neither side of the conservation ledger)
-            flight_recorder().record("request.shed", reason=e.reason)
+            self._flight.record("request.shed", reason=e.reason)
             raise
         # conservation ledger (obs/flight_recorder.py): one admitted
         # mark per queued request; every resolution site below marks
         # the matching terminal — check_conservation() is the
         # every-request-reaches-a-named-outcome invariant. Fed OUTSIDE
         # the queue lock, like the shed accounting above.
-        flight_recorder().note_admitted()
+        self._flight.note_admitted()
         return fut
 
     def score(
@@ -563,7 +568,7 @@ class MicroBatcher:
             )):
                 failed += 1
         if failed:
-            flight_recorder().note_terminal("drain_timeout", n=failed)
+            self._flight.note_terminal("drain_timeout", n=failed)
         join_budget = max(deadline - time.perf_counter(), 0.0) + 1.0
         self._worker.join(timeout=join_budget)
         report = DrainReport(
@@ -637,7 +642,7 @@ class MicroBatcher:
         if expired:
             if self._metrics is not None:
                 self._metrics.record_deadline_expired(expired)
-            fr = flight_recorder()
+            fr = self._flight
             fr.record("request.deadline", expired=expired)
             fr.note_terminal("deadline_exceeded", n=expired)
         return live
@@ -657,7 +662,7 @@ class MicroBatcher:
                 for _req, fut in take:
                     errored += int(_resolve(fut, error=e))
                 if errored:
-                    flight_recorder().note_terminal(
+                    self._flight.note_terminal(
                         "dispatch_error", n=errored
                     )
             finally:
@@ -793,7 +798,7 @@ class MicroBatcher:
                     generation=bank.generation,
                 )))
         if n_ok:
-            flight_recorder().note_terminal(
+            self._flight.note_terminal(
                 "ok", generation=bank.generation, n=n_ok
             )
         # stamped AFTER the device section from timestamps already in

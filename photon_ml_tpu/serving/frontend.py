@@ -52,6 +52,7 @@ Robustness invariants (the "serving under fire" contract):
 from __future__ import annotations
 
 import json
+import os
 import queue
 import socket
 import threading
@@ -59,6 +60,7 @@ import time
 from concurrent.futures import Future
 from typing import Callable, Dict, List, Optional
 
+from photon_ml_tpu.obs import trace as obs_trace
 from photon_ml_tpu.obs.flight_recorder import flight_recorder
 from photon_ml_tpu.obs.trace import TRACE_KEY, start_span, wire_context
 from photon_ml_tpu.serving.admission import (
@@ -281,6 +283,12 @@ class _Connection:
                 # {"format": "prometheus"} — without a registry wired
                 # the op still answers from the serving accumulator
                 self.send(self.fe.metrics_response(obj))
+            elif str(op) == "trace":
+                # incremental span drain for the fleet collector:
+                # cursor/seq-keyed so polls never duplicate or drop
+                # spans, plus the (wall, perf) epoch + epoch-mapped
+                # "now" for NTP-style clock-skew estimation
+                self.send(self.fe.trace_response(obj))
             elif str(op) == "flight":
                 rec = flight_recorder()
                 self.send({
@@ -581,6 +589,40 @@ class ServingFrontend:
         return {
             "uid": uid, "status": "ok", "op": "metrics",
             "metrics": payload,
+        }
+
+    def trace_response(self, obj: Dict) -> Dict[str, object]:
+        """The ``{"op": "trace"}`` payload: the process tracer's spans
+        AFTER the caller's cursor (contiguous seq run; evictions since
+        the last poll are counted in ``dropped``), the process's
+        ``(wall, perf)`` epoch, and an epoch-mapped ``now_perf`` so the
+        caller can run one NTP-style offset estimate per poll. The
+        cursor contract: pass ``cursor`` back verbatim on the next poll
+        — no span is ever sent twice, and a cursor from before a ring
+        reset restarts cleanly from the beginning."""
+        uid = obj.get("uid")
+        try:
+            cursor = int(obj.get("cursor") or 0)
+        except (TypeError, ValueError):
+            return _error_response(
+                uid, "BAD_REQUEST", "cursor must be an integer"
+            )
+        t = obs_trace.tracer()
+        spans, new_cursor, dropped = t.read_since(cursor)
+        epoch_wall, epoch_perf = obs_trace.epoch()
+        return {
+            "uid": uid,
+            "status": "ok",
+            "op": "trace",
+            "pid": os.getpid(),
+            "enabled": obs_trace.tracing_enabled(),
+            "epoch_wall": epoch_wall,
+            "epoch_perf": epoch_perf,
+            "now_perf": time.perf_counter(),
+            "cursor": new_cursor,
+            "dropped": dropped,
+            "max_spans": t.max_spans,
+            "spans": [s.to_dict() for s in spans],
         }
 
     # -- internals -----------------------------------------------------------

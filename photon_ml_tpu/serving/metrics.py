@@ -56,6 +56,33 @@ class ServingMetrics:
         self._frontend: Dict[str, int] = {}
         self._responses: Dict[str, int] = {}
         self._drain: Optional[Dict[str, object]] = None
+        # live registry mirrors (SLO-engine inputs, obs/slo.py): bound
+        # once by bind_registry before traffic, read bare on the record
+        # paths — single-writer plain-reference publishes
+        self._reg_total = None  # photon: guarded-by(atomic)
+        self._reg_bad = None  # photon: guarded-by(atomic)
+        self._reg_latency = None  # photon: guarded-by(atomic)
+
+    def bind_registry(self, registry, *, prefix: str = "serving") -> None:
+        """Mirror the request-path outcomes into live registry
+        instruments: ``<prefix>_requests_total`` (every request that
+        reached a terminal), ``<prefix>_bad_total`` (shed / deadline /
+        degraded — the error-budget burners, labelled by reason) and
+        the ``<prefix>_latency_seconds`` histogram. These are what
+        declarative SLO specs (obs/slo.py) evaluate over; the mirrors
+        feed OUTSIDE this accumulator's lock, so the request path gains
+        one instrument-local lock per event and no nesting."""
+        self._reg_total = registry.counter(
+            f"{prefix}_requests_total",
+            "requests that reached a terminal outcome",
+        )
+        self._reg_bad = registry.counter(
+            f"{prefix}_bad_total",
+            "requests that burned error budget, by reason",
+        )
+        self._reg_latency = registry.histogram(
+            f"{prefix}_latency_seconds", "end-to-end request latency"
+        )
 
     # -- recording -----------------------------------------------------------
 
@@ -90,14 +117,24 @@ class ServingMetrics:
         front) or ``queue_full`` (the bounded full-queue wait expired)."""
         with self._lock:
             self._sheds[reason] = self._sheds.get(reason, 0) + 1
+        if self._reg_total is not None:
+            self._reg_total.inc()
+            self._reg_bad.inc(reason="shed")
 
     def record_deadline_expired(self, n: int = 1) -> None:
         with self._lock:
             self._deadline_expired += int(n)
+        if self._reg_total is not None:
+            self._reg_total.inc(n)
+            self._reg_bad.inc(n, reason="deadline")
 
     def record_degraded(self, n: int = 1) -> None:
         with self._lock:
             self._degraded += int(n)
+        if self._reg_bad is not None:
+            # degraded rows also pass record_latency, which counts them
+            # in the total — only the budget burn is added here
+            self._reg_bad.inc(n, reason="degraded")
 
     def record_re_resolution_failure(self, re_type: str) -> None:
         with self._lock:
@@ -138,6 +175,9 @@ class ServingMetrics:
                     # double the stride for future arrivals
                     self._lat = self._lat[::2]
                     self._stride *= 2
+        if self._reg_total is not None:
+            self._reg_total.inc()
+            self._reg_latency.observe(seconds)
 
     # -- reporting -----------------------------------------------------------
 

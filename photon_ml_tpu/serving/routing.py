@@ -109,7 +109,7 @@ class RoutedScore(ScoreOutcome):
     (0 = served entirely from the hot-entity cache), whether every slot
     came from cache, and which shards degraded to FE-only."""
 
-    __slots__ = ("fanout", "cache_hit", "degraded_shards")
+    __slots__ = ("fanout", "cache_hit", "degraded_shards", "fe_shard")
 
     def __new__(
         cls,
@@ -120,6 +120,7 @@ class RoutedScore(ScoreOutcome):
         fanout: int = 0,
         cache_hit: bool = False,
         degraded_shards: Tuple[int, ...] = (),
+        fe_shard: Optional[int] = None,
     ) -> "RoutedScore":
         self = super().__new__(
             cls, value, degraded=degraded, generation=generation
@@ -127,6 +128,10 @@ class RoutedScore(ScoreOutcome):
         self.fanout = int(fanout)
         self.cache_hit = bool(cache_hit)
         self.degraded_shards = tuple(degraded_shards)
+        # which shard provided the fixed-effect half (None = the hot
+        # cache did): the fleet-conservation attribution key — every
+        # wire-served request is attributed to exactly ONE shard
+        self.fe_shard = None if fe_shard is None else int(fe_shard)
         return self
 
 
@@ -160,7 +165,9 @@ class ShardHealth:
     first success, a still-dead one re-opens on the probe's failure.
     """
 
-    def __init__(self, shard_index: int, policy: RoutingPolicy):
+    def __init__(
+        self, shard_index: int, policy: RoutingPolicy, *, recorder=None
+    ):
         self.shard_index = int(shard_index)
         self._policy = policy
         self._lock = threading.Lock()
@@ -169,6 +176,9 @@ class ShardHealth:
         self._open_until = 0.0
         self._failures_total = 0
         self._successes_total = 0
+        self._flight = (
+            recorder if recorder is not None else flight_recorder()
+        )
 
     def note(self, ok: bool) -> None:
         transition = None
@@ -198,7 +208,7 @@ class ShardHealth:
             # breaker transitions are flight-recorder events (recorded
             # OUTSIDE this health window's lock — the recorder has its
             # own); per-call outcomes stay counters, not events
-            flight_recorder().record(
+            self._flight.record(
                 f"circuit.{transition}", shard=self.shard_index
             )
 
@@ -460,6 +470,30 @@ class RouterMetrics:
         self._generation_retries = 0
         self._first_t: Optional[float] = None
         self._last_t: Optional[float] = None
+        # live registry mirrors (SLO-engine inputs), bound once by
+        # bind_registry before traffic; single-writer plain publishes
+        self._reg_total = None  # photon: guarded-by(atomic)
+        self._reg_bad = None  # photon: guarded-by(atomic)
+        self._reg_latency = None  # photon: guarded-by(atomic)
+
+    def bind_registry(self, registry, *, prefix: str = "router") -> None:
+        """Mirror request outcomes into live registry instruments —
+        ``<prefix>_requests_total`` / ``<prefix>_bad_total`` counters
+        and the ``<prefix>_latency_seconds`` histogram — so SLO specs
+        (obs/slo.py) evaluate over the routed plane. Bind BEFORE
+        traffic: the mirrors are plain single-writer references read
+        bare on the record path."""
+        self._reg_total = registry.counter(
+            f"{prefix}_requests_total", "routed requests completed"
+        )
+        self._reg_bad = registry.counter(
+            f"{prefix}_bad_total",
+            "routed requests that burned error budget "
+            "(failed or degraded)",
+        )
+        self._reg_latency = registry.histogram(
+            f"{prefix}_latency_seconds", "routed request latency"
+        )
 
     def record(
         self,
@@ -471,6 +505,17 @@ class RouterMetrics:
         latency_s: float,
     ) -> None:
         now = time.perf_counter()
+        # registry mirrors first, OUTSIDE our lock (each instrument has
+        # its own; nesting ours around theirs would add a lock edge the
+        # record path does not need)
+        total = self._reg_total
+        if total is not None:
+            total.inc()
+            if not ok:
+                self._reg_bad.inc(reason="failed")
+            elif degraded:
+                self._reg_bad.inc(reason="degraded")
+            self._reg_latency.observe(latency_s)
         with self._lock:
             self._requests += 1
             self._ok += int(ok and not degraded)
@@ -595,6 +640,7 @@ class ShardRouter:
         cache_entries: int = 4096,
         metrics: Optional[RouterMetrics] = None,
         native_index_threshold: Optional[int] = None,
+        recorder=None,
     ):
         if transport_factory is None:
             if not addresses:
@@ -616,6 +662,16 @@ class ShardRouter:
         self._transport_factory = transport_factory
         self.policy = policy or RoutingPolicy()
         self.metrics = metrics or RouterMetrics()
+        # the router's conservation ledger (obs/flight_recorder.py):
+        # every admitted request reaches exactly one ATTRIBUTED
+        # terminal — shard:<i> (wire-served, keyed by the FE provider),
+        # cache (zero fan-out), degraded (FE-only), no_shard/error —
+        # which is what fleet_check_conservation balances against the
+        # shards' own books. Defaults to the process recorder;
+        # in-process fleets pass the router its own.
+        self._flight = (
+            recorder if recorder is not None else flight_recorder()
+        )
         self.cache = HotEntityCache(cache_entries)
         self._indexes: Dict[str, EntityRowIndex] = {}
         for id_type, ids in entity_ids.items():
@@ -714,7 +770,10 @@ class ShardRouter:
             raise ValueError(
                 f"router has no entity-id index for id type(s) {missing}"
             )
-        self.health = [ShardHealth(i, self.policy) for i in range(n)]
+        self.health = [
+            ShardHealth(i, self.policy, recorder=self._flight)
+            for i in range(n)
+        ]
         with self._gen_lock:
             self._generation = int(first["generation"])
         self._connected = True
@@ -955,6 +1014,9 @@ class ShardRouter:
             else self.policy.subrequest_timeout_s
         )
         codes = self._codes_of(record)
+        # conservation ledger: admitted HERE, exactly one attributed
+        # terminal below — the router side of the fleet-wide invariant
+        self._flight.note_admitted()
         # the root of the routed request's trace: one trace id per
         # request, minted here (or joined from the caller's wire
         # context); every sub-request and every shard-side span nests
@@ -986,6 +1048,7 @@ class ShardRouter:
                 )
         except NoShardAvailable:
             sp.end(status="refused")
+            self._flight.note_terminal("no_shard", attribution="no_shard")
             self.metrics.record(
                 ok=False,
                 degraded=False,
@@ -994,12 +1057,36 @@ class ShardRouter:
                 latency_s=time.perf_counter() - t_start,
             )
             raise
+        except Exception:
+            # anything else still reaches a named terminal — an
+            # admitted request with no terminal is exactly the hole
+            # fleet conservation exists to expose
+            sp.end(status="error")
+            self._flight.note_terminal("error", attribution="error")
+            raise
         sp.end(
             status="ok",
             fanout=outcome.fanout,
             degraded=outcome.degraded,
             cache_hit=outcome.cache_hit,
             generation=outcome.generation,
+        )
+        # attribution: degraded outcomes are router-local (FE-only for
+        # at least one slot — no single shard "served" the request);
+        # zero-fan-out requests were served by the hot cache; the rest
+        # key off the shard that provided the FE half. "mixed" (FE from
+        # cache, terms from the wire) stays a router-local bucket so
+        # the shard join's >= direction is never overstated.
+        if outcome.degraded:
+            attribution = "degraded"
+        elif outcome.fanout == 0:
+            attribution = "cache"
+        elif outcome.fe_shard is not None:
+            attribution = f"shard:{outcome.fe_shard}"
+        else:
+            attribution = "mixed"
+        self._flight.note_terminal(
+            "ok", generation=outcome.generation, attribution=attribution
         )
         self.metrics.record(
             ok=True,
@@ -1097,6 +1184,7 @@ class ShardRouter:
         degraded_shards = []
         degraded = False
         fe_from_wire = None
+        fe_shard: Optional[int] = None
         for s, r in responses.items():
             if r is None:
                 degraded_shards.append(s)
@@ -1105,6 +1193,7 @@ class ShardRouter:
                 degraded = True
             if fe_from_wire is None:
                 fe_from_wire = np.float32(r["fe"])
+                fe_shard = s
             terms = r.get("terms") or {}
             for entry in need.get(s, ()):
                 name = entry[1]
@@ -1140,6 +1229,7 @@ class ShardRouter:
             fe = fe_from_wire
         else:
             fe = np.float32(fe_value)
+            fe_shard = None  # the cache provided the FE half
         # -- recompose: the full program's accumulation order, f32 ---------
         total = np.float32(fe)
         for entry in self._entries:
@@ -1165,6 +1255,7 @@ class ShardRouter:
             fanout=len(fanout_shards),
             cache_hit=not fanout_shards,
             degraded_shards=tuple(sorted(degraded_shards)),
+            fe_shard=fe_shard,
         )
 
     def _pick_fe_shard(self, record: Mapping) -> Optional[int]:
@@ -1220,7 +1311,7 @@ class ShardRouter:
                 if resp is None or not resp.get("ok"):
                     for p in staged:
                         self._control(p, {"op": "abort_swap"})
-                    flight_recorder().record(
+                    self._flight.record(
                         "swap.fleet_abort", phase="stage", failed_shard=s,
                     )
                     return {
@@ -1268,7 +1359,7 @@ class ShardRouter:
             with self._gen_lock:
                 self._generation = new_gen
                 purged = self.cache.purge_other_generations(new_gen)
-            flight_recorder().record(
+            self._flight.record(
                 "swap.fleet_commit", generation=new_gen,
                 shards=self.num_shards, cache_purged=purged,
             )

@@ -156,6 +156,7 @@ class ShardServer:
         max_queue: int = 4096,
         default_deadline_ms: Optional[float] = None,
         on_outcome=None,
+        recorder=None,
     ):
         if not serving_model.partial:
             raise ValueError(
@@ -166,12 +167,17 @@ class ShardServer:
         self.entity_shard = ownership.validate_entity_shard(entity_shard)
         self.serving_model = serving_model
         self.metrics = metrics or ServingMetrics()
+        # recorder: this shard's conservation ledger — in-process
+        # fleets (tests/bench) give every member its OWN book so the
+        # fleet-wide check can join them; subprocess shards default to
+        # their process recorder
         self.batcher = MicroBatcher(
             serving_model.current,
             serving_model.programs,
             self.metrics,
             max_queue=max_queue,
             default_deadline_ms=default_deadline_ms,
+            recorder=recorder,
         )
         self.frontend = ServingFrontend(
             self.batcher,

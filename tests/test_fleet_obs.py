@@ -1,0 +1,896 @@
+"""Fleet-scale observability tests (ISSUE 15).
+
+The acceptance bar: a 2-shard TCP fleet replay (REAL shard-server
+subprocesses — separate tracers, separate clocks, separate flight
+books) produces ONE ``fleet_trace.json`` in which every router
+sub-request span parents under its router request span, every shard
+frontend span joins its sub-request, every ``serving.score`` leaf joins
+its shard's dispatch span, and skew-corrected timestamps are monotone
+parent -> child within every trace (to the recorded clock-sync
+uncertainty). Fleet ``check_conservation`` — router admitted == Σ
+shard-attributed terminals + router-local outcomes — passes across a
+mid-flood two-step fleet flip with one SIGKILLed shard, and an injected
+dropped response makes it FAIL. An SLO burn-rate alert fires on an
+induced error burst and appears both as a flight event and a registry
+gauge.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from photon_ml_tpu.game.data import build_game_dataset
+from photon_ml_tpu.obs.fleet import (
+    FleetCollector,
+    fleet_check_conservation,
+    main as fleet_main,
+    stitch_spans,
+    verify_fleet_trace,
+)
+from photon_ml_tpu.obs.flight_recorder import (
+    FlightRecorder,
+    reset_flight_recorder,
+)
+from photon_ml_tpu.obs.registry import MetricsRegistry
+from photon_ml_tpu.obs.slo import (
+    SLOEngine,
+    SLOSpec,
+    default_router_slos,
+    default_serving_slos,
+    parse_slo_specs,
+)
+from photon_ml_tpu.obs.trace import (
+    Tracer,
+    export_chrome_trace,
+    reset_tracer,
+    tracer,
+    tracing_scope,
+)
+from photon_ml_tpu.serving import (
+    MicroBatcher,
+    RoutingPolicy,
+    ServingFrontend,
+    ServingMetrics,
+    ServingModel,
+    ServingPrograms,
+    ShardRouter,
+)
+from tests.test_obs import _Client
+from tests.test_serving import SHARDS, make_bank, synth_model, synth_records
+from tests.test_shard_routing import synthetic_records
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+IDS = sorted(f"user{i:02d}" for i in range(14))
+
+# One synthetic shard-server subprocess: its OWN tracer epoch, flight
+# recorder and wall clock — what makes the stitching/skew machinery
+# testable for real. Banks are seeded, so every shard of a generation
+# agrees bitwise with every other process that builds it.
+SHARD_SCRIPT = r"""
+import json, os, sys, time
+import numpy as np
+from photon_ml_tpu.game.config import FeatureShardConfiguration
+from photon_ml_tpu.serving import (
+    ServingModel, ServingPrograms, ShardServer, bank_from_arrays,
+)
+from photon_ml_tpu.utils.index_map import IndexMap
+
+shard, count = int(sys.argv[1]), int(sys.argv[2])
+E, d_g, d_u = 14, 6, 4
+ids = sorted(f"user{i:02d}" for i in range(E))
+SHARDS = [
+    FeatureShardConfiguration("g", ["features"]),
+    FeatureShardConfiguration("u", ["userFeatures"]),
+]
+imaps = {
+    "g": IndexMap({f"g{j}\t": j for j in range(d_g)}),
+    "u": IndexMap({f"u{j}\t": j for j in range(d_u)}),
+}
+
+def build(gen):
+    rng = np.random.default_rng(1234 + gen)
+    fe = rng.standard_normal(d_g).astype(np.float32)
+    re = rng.standard_normal((E, d_u)).astype(np.float32)
+    return bank_from_arrays(
+        fixed=[("global", "g", fe)],
+        random=[("per-user", "userId", "u", re, ids)],
+        shard_widths={"g": 4, "u": 4},
+        index_maps=imaps,
+        entity_shard=(shard, count),
+    )
+
+sm = ServingModel(
+    build(1), ServingPrograms((1, 8)), partial=True,
+    entity_shard=(shard, count),
+)
+
+def stager(obj):
+    return sm.prepare_swap_bank(build(2))
+
+srv = ShardServer(
+    sm, SHARDS, (shard, count), stager=stager, has_response=False,
+).start()
+print(json.dumps({"port": srv.port, "pid": os.getpid()}), flush=True)
+while True:
+    time.sleep(0.1)
+"""
+
+
+@pytest.fixture(scope="module")
+def shard_fleet():
+    """Two real shard-server subprocesses (tracing ON) + their ports."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PHOTON_TRACE": "1"}
+    procs = []
+    try:
+        for s in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", SHARD_SCRIPT, str(s), "2"],
+                cwd=REPO, env=env, stdout=subprocess.PIPE, text=True,
+            ))
+        meta = []
+        for p in procs:
+            line = p.stdout.readline()
+            assert line, "shard subprocess died before binding"
+            meta.append(json.loads(line))
+        yield procs, meta
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+
+
+# -- the {"op": "trace"} cursor contract over a live socket -------------------
+
+
+@pytest.fixture
+def traced_frontend(rng):
+    recs = synth_records(rng)
+    ds = build_game_dataset(recs, SHARDS, ["userId"])
+    bank = make_bank(synth_model(rng), ds)
+    sm = ServingModel(bank, ServingPrograms((1, 8)))
+    metrics = ServingMetrics()
+    batcher = MicroBatcher(sm.current, sm.programs, metrics)
+    fe = ServingFrontend(batcher, sm, SHARDS, metrics=metrics,
+                         port=0).start()
+    with tracing_scope(True):
+        tracer().clear()
+        yield recs, fe
+    fe.stop_accepting()
+    batcher.drain(10.0)
+    fe.close()
+    batcher.close()
+
+
+class TestTraceOp:
+    def test_cursor_polls_never_duplicate_or_drop(self, traced_frontend):
+        recs, fe = traced_frontend
+        c = _Client(fe.port)
+        try:
+            for r in recs[:5]:
+                assert c.ask(r)["status"] == "ok"
+            r1 = c.ask({"op": "trace", "cursor": 0, "uid": "t1"})
+            assert r1["status"] == "ok" and r1["uid"] == "t1"
+            assert r1["dropped"] == 0
+            assert r1["enabled"] is True
+            assert r1["pid"] == os.getpid()
+            for key in ("epoch_wall", "epoch_perf", "now_perf",
+                        "max_spans"):
+                assert key in r1, key
+            seqs = [s["seq"] for s in r1["spans"]]
+            assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+            assert r1["cursor"] == seqs[-1]
+            names = {s["name"] for s in r1["spans"]}
+            assert "frontend.request" in names
+            assert "serving.dispatch" in names
+            # an immediate re-poll at the cursor returns NOTHING — no
+            # span is ever sent twice
+            r2 = c.ask({"op": "trace", "cursor": r1["cursor"]})
+            assert r2["spans"] == [] and r2["cursor"] == r1["cursor"]
+            # more traffic -> only the NEW spans
+            for r in recs[5:8]:
+                assert c.ask(r)["status"] == "ok"
+            r3 = c.ask({"op": "trace", "cursor": r2["cursor"]})
+            new_seqs = [s["seq"] for s in r3["spans"]]
+            assert new_seqs and min(new_seqs) > r1["cursor"]
+            # union across polls covers every span the tracer retains
+            assert (
+                {s["seq"] for s in r1["spans"]} | set(new_seqs)
+                == {s.seq for s in tracer().snapshot()}
+            )
+            # a cursor from before a ring reset restarts cleanly
+            tracer().clear()
+            for r in recs[8:10]:
+                assert c.ask(r)["status"] == "ok"
+            r4 = c.ask({"op": "trace", "cursor": r3["cursor"]})
+            assert r4["spans"], "reset must replay from the beginning"
+            bad = c.ask({"op": "trace", "cursor": "xyz"})
+            assert bad["status"] == "error"
+            assert bad["error"] == "BAD_REQUEST"
+        finally:
+            c.close()
+
+    def test_evictions_between_polls_are_counted(self, traced_frontend,
+                                                 monkeypatch):
+        recs, fe = traced_frontend
+        monkeypatch.setenv("PHOTON_TRACE_SPANS", "8")
+        t = reset_tracer()
+        try:
+            assert t.max_spans == 8
+            c = _Client(fe.port)
+            try:
+                for r in recs[:12]:  # >8 spans' worth of traffic
+                    assert c.ask(r)["status"] == "ok"
+                resp = c.ask({"op": "trace", "cursor": 0})
+            finally:
+                c.close()
+            assert resp["max_spans"] == 8
+            assert resp["dropped"] > 0, (
+                "ring evictions between polls must be counted"
+            )
+            assert len(resp["spans"]) <= 8
+        finally:
+            monkeypatch.delenv("PHOTON_TRACE_SPANS")
+            reset_tracer()
+
+
+# -- the live collector over a REAL 2-subprocess TCP fleet --------------------
+
+
+class TestFleetCollectorLive:
+    def _flood(self, router, records):
+        out = []
+        for rec in records:
+            out.append(router.score_record(rec))
+        return out
+
+    def test_fleet_trace_and_conservation_across_swap_and_kill(
+        self, shard_fleet, rng, tmp_path
+    ):
+        from photon_ml_tpu import ownership
+
+        procs, meta = shard_fleet
+        ports = [m["port"] for m in meta]
+        router_book = FlightRecorder(capacity=4096)
+        with tracing_scope(True):
+            tracer().clear()
+            router = ShardRouter(
+                [("127.0.0.1", pt) for pt in ports],
+                entity_ids={"userId": IDS},
+                shard_configs=SHARDS,
+                policy=RoutingPolicy(subrequest_timeout_s=5.0),
+                recorder=router_book,
+            )
+            router.connect()
+            collector = FleetCollector(
+                [
+                    ("shard0", "127.0.0.1", ports[0]),
+                    ("shard1", "127.0.0.1", ports[1]),
+                ],
+                local_name="router",
+                connect_timeout_s=10.0,
+            )
+            try:
+                recs = synthetic_records(rng, IDS, n=24)
+                cold = self._flood(router, recs)
+                assert not any(o.degraded for o in cold)
+                ok1 = collector.poll_once()
+                assert all(ok1.values()), ok1
+                # warm pass: identical records answer from the hot
+                # cache (fan-out 0 -> the "cache" attribution bucket)
+                warm = self._flood(router, recs)
+                assert any(o.cache_hit for o in warm)
+                # -- mid-run two-step fleet flip ------------------------
+                res = router.coordinate_swap("synthetic")
+                assert res["ok"] and res["generation"] == 2, res
+                gen2 = self._flood(router, recs)
+                assert all(o.generation == 2 for o in gen2)
+                collector.poll_once()
+                # -- SIGKILL shard 1, flood a variant (cache-missing)
+                # trace: shard 1's entities degrade, shard 0's stay
+                # exact ------------------------------------------------
+                procs[1].kill()
+                procs[1].wait(timeout=30)
+                variants = []
+                for r in recs:
+                    v = json.loads(json.dumps(r))
+                    for bag in ("features", "userFeatures"):
+                        for f in v.get(bag) or []:
+                            f["value"] = float(f["value"]) * 1.25 + 0.5
+                    variants.append(v)
+                after = self._flood(router, variants)
+                owners = {
+                    r["uid"]: ownership.owner_of(
+                        IDS.index(r["metadataMap"]["userId"]), 2
+                    )
+                    for r in recs
+                }
+                n_deg = 0
+                for rec, o in zip(variants, after):
+                    if owners[rec["uid"]] == 1:
+                        assert o.degraded, rec["uid"]
+                        n_deg += 1
+                    else:
+                        assert not o.degraded, rec["uid"]
+                assert n_deg > 0
+                collector.stop(final_poll=True)
+                status = collector.member_status()
+                # the killed shard stopped answering, but everything
+                # polled BEFORE the kill stays merged
+                assert status["shard1"]["errors"] >= 1
+                assert status["shard1"]["spans"] > 0
+                assert status["shard0"]["ring_dropped"] == 0
+                for name in ("shard0", "shard1"):
+                    assert (
+                        status[name]["clock_offset_uncertainty_s"]
+                        is not None
+                    )
+                # -- ONE merged fleet trace, fully verified -------------
+                stitched = collector.stitched_spans()
+                verdict = verify_fleet_trace(stitched)
+                assert verdict["ok"], verdict["violations"]
+                assert verdict["router_subrequests"] > 0
+                assert verdict["frontend_requests"] > 0
+                assert verdict["score_leaves"] > 0
+                members = {s["member"] for s in stitched}
+                assert members == {"router", "shard0", "shard1"}
+                sids = [s["span_id"] for s in stitched]
+                assert len(sids) == len(set(sids)), "namespaced ids collide"
+                # spans from BOTH generations straddle the flip
+                gens = {
+                    s["attrs"].get("generation")
+                    for s in stitched
+                    if s["name"] == "serving.dispatch"
+                }
+                assert {1, 2} <= gens, gens
+                out = str(tmp_path / "fleet_trace.json")
+                n = collector.export(out)
+                data = json.load(open(out))
+                assert len(data["traceEvents"]) == n
+                lanes = {
+                    e["args"]["name"]: e["pid"]
+                    for e in data["traceEvents"]
+                    if e.get("ph") == "M"
+                }
+                assert len(lanes) == 3, lanes
+                assert data["otherData"]["verification"]["ok"]
+                for m in data["otherData"]["members"].values():
+                    assert "clock_offset_s" in m
+                # -- fleet conservation ACROSS the swap + the kill ------
+                flight = collector.collect_flight()
+                assert flight["shard0"]["complete"]
+                assert not flight["shard1"]["complete"]
+                books = {
+                    name: {
+                        "conservation": flight[name].get("conservation")
+                        or {},
+                        "complete": flight[name]["complete"],
+                        "shard_indices": [i],
+                    }
+                    for i, name in enumerate(("shard0", "shard1"))
+                }
+                cons = fleet_check_conservation(
+                    router_book.check_conservation(), books
+                )
+                assert cons["ok"], cons
+                attr = cons["terminal_by_attribution"]
+                assert attr.get("cache", 0) > 0, attr
+                assert attr.get("degraded", 0) >= n_deg, attr
+                assert any(k.startswith("shard:") for k in attr), attr
+                assert sum(attr.values()) == cons["terminal_total"]
+                assert cons["shards"]["shard0"]["join_ok"] is True
+                assert cons["shards"]["shard1"]["join_ok"] is None
+                # per-generation split re-sums across the flip
+                assert set(cons["terminal_by_generation"]) >= {"1", "2"}
+                # -- negative pin: one dropped response breaks it -------
+                router_book.note_admitted()  # admitted, never terminal
+                bad = fleet_check_conservation(
+                    router_book.check_conservation(), books
+                )
+                assert not bad["ok"]
+                assert not bad["router_ok"]
+                # and a doctored shard book (served < attributed) fails
+                # the join on a COMPLETE shard
+                doctored = json.loads(json.dumps(books))
+                doctored["shard0"]["conservation"]["terminal"]["ok"] = 0
+                bad2 = fleet_check_conservation(
+                    {**router_book.check_conservation(),
+                     "admitted": router_book.check_conservation()[
+                         "admitted"] - 1},
+                    doctored,
+                )
+                assert not bad2["ok"]
+                assert bad2["shards"]["shard0"]["join_ok"] is False
+            finally:
+                router.close()
+
+
+class TestDriverFleetObsFinish:
+    def test_finish_writes_fleet_artifacts_and_block(
+        self, shard_fleet, tmp_path
+    ):
+        """The driver's --fleet-obs-dir finalizer: stops the collector,
+        writes fleet_trace.json + fleet_conservation.json, returns the
+        metrics.json block. Runs against the live shard0 subprocess
+        (shard1 may already be dead — an unreachable member must be
+        reported, never crash the finalizer)."""
+        from photon_ml_tpu.cli.serving_driver import (
+            ServingDriver,
+            ServingParams,
+        )
+
+        procs, meta = shard_fleet
+        port0 = meta[0]["port"]
+        assert procs[0].poll() is None, "shard0 must be alive"
+        fo = tmp_path / "fleet-obs"
+        fo.mkdir()
+        d = ServingDriver.__new__(ServingDriver)
+        d.params = ServingParams(
+            shard_servers=f"127.0.0.1:{port0}",
+            fleet_obs_dir=str(fo),
+        )
+        d.logger = type(
+            "L", (), {"info": lambda self, *a, **k: None}
+        )()
+        d.fleet_collector = FleetCollector(
+            [("shard0", "127.0.0.1", port0)],
+            local_name="router",
+            connect_timeout_s=10.0,
+        )
+        d.fleet_collector.poll_once()
+        block = d._finish_fleet_obs()
+        assert block is not None
+        assert os.path.exists(block["fleet_trace_path"])
+        assert os.path.exists(str(fo / "fleet_conservation.json"))
+        assert set(block["members"]) == {"router", "shard0"}
+        assert "conservation" in block
+        data = json.load(open(block["fleet_trace_path"]))
+        assert "verification" in data["otherData"]
+        # a driver without the flag no-ops
+        d2 = ServingDriver.__new__(ServingDriver)
+        d2.fleet_collector = None
+        assert d2._finish_fleet_obs() is None
+
+
+# -- stitching / skew units (deterministic) -----------------------------------
+
+
+def _mk_payload(name, spans, *, offset=0.0, unc=0.001, pid=100):
+    return {
+        "name": name,
+        "pid": pid,
+        "spans": spans,
+        "epoch_wall": 0.0,
+        "epoch_perf": 0.0,
+        "offset_s": offset,
+        "offset_unc_s": unc,
+        "wall_mapped": False,
+    }
+
+
+def _span(name, sid, t0, t1, *, trace="tr1", parent=None, attrs=None):
+    return {
+        "name": name, "trace_id": trace, "span_id": sid,
+        "parent_id": parent, "t0": t0, "t1": t1, "tid": 1, "seq": 1,
+        "attrs": dict(attrs or {}),
+    }
+
+
+class TestStitching:
+    def test_skew_correction_restores_parent_child_monotonicity(self):
+        """A shard whose clock runs 50ms BEHIND emits child spans that
+        LOOK earlier than their router parent; the measured offset must
+        undo exactly that."""
+        skew = 0.050
+        router = [_span("router.request", "r1", 10.000, 10.010),
+                  _span("router.subrequest", "s1", 10.001, 10.009,
+                        parent="r1")]
+        # the shard's clock reads t - skew at true time t: a span that
+        # truly started at 10.002 is stamped 9.952 — before its parent
+        shard = [_span("frontend.request", "f1", 10.002 - skew,
+                       10.008 - skew, parent="s1")]
+        stitched = stitch_spans([
+            _mk_payload("router", router, offset=0.0, unc=0.0),
+            _mk_payload("shard0", shard, offset=-skew, unc=0.0005,
+                        pid=200),
+        ])
+        v = verify_fleet_trace(stitched)
+        assert v["ok"], v["violations"]
+        f1 = next(s for s in stitched if s["span_id"] == "shard0:f1")
+        assert abs(f1["t0"] - 10.002) < 1e-9
+        assert f1["parent_id"] == "router:s1"
+        # WITHOUT the correction the nesting check fails loudly
+        broken = stitch_spans([
+            _mk_payload("router", router, offset=0.0, unc=0.0),
+            _mk_payload("shard0", shard, offset=0.0, unc=0.0005,
+                        pid=200),
+        ])
+        v2 = verify_fleet_trace(broken)
+        assert not v2["ok"]
+        assert any("before its parent" in x for x in v2["violations"])
+
+    def test_dispatch_leaves_expand_and_join_their_member(self):
+        shard = [
+            _span("frontend.request", "f1", 1.0, 1.4, parent="s1"),
+            _span("serving.dispatch", "d1", 1.1, 1.3, trace="td",
+                  attrs={"generation": 1, "shape": 8,
+                         "traces": [["tr1", "f1", False]]}),
+        ]
+        router = [_span("router.request", "r1", 0.9, 1.5),
+                  _span("router.subrequest", "s1", 0.95, 1.45,
+                        parent="r1")]
+        stitched = stitch_spans([
+            _mk_payload("router", router, unc=0.0),
+            _mk_payload("shard0", shard, unc=0.0, pid=2),
+        ])
+        leaves = [s for s in stitched if s["name"] == "serving.score"]
+        assert len(leaves) == 1
+        leaf = leaves[0]
+        assert leaf["member"] == "shard0"
+        assert leaf["parent_id"] == "shard0:f1"
+        assert leaf["attrs"]["dispatch_span"] == "shard0:d1"
+        v = verify_fleet_trace(stitched)
+        assert v["ok"], v["violations"]
+        # a leaf whose dispatch span vanished is a named violation
+        gone = [s for s in stitched if s["name"] != "serving.dispatch"]
+        v2 = verify_fleet_trace(gone)
+        assert not v2["ok"]
+        assert any("dispatch_span" in x for x in v2["violations"])
+
+
+# -- SLO engine ---------------------------------------------------------------
+
+
+class TestSLOEngine:
+    def _avail_spec(self, **kw):
+        base = dict(
+            name="avail", objective=0.9, kind="availability",
+            metric="req_total", bad_metric="req_bad",
+            short_window_s=10.0, long_window_s=60.0, burn_threshold=2.0,
+        )
+        base.update(kw)
+        return SLOSpec(**base).validate()
+
+    def test_burst_fires_alert_as_flight_event_and_gauge(self):
+        reg = MetricsRegistry()
+        total = reg.counter("req_total")
+        bad = reg.counter("req_bad")
+        rec = FlightRecorder(capacity=64)
+        eng = SLOEngine(reg, [self._avail_spec()], recorder=rec)
+        # healthy baseline: 1% errors against a 10% budget
+        t = 0.0
+        for _ in range(70):
+            total.inc(100)
+            bad.inc(1)
+            eng.tick(t)
+            t += 1.0
+        assert not eng.alert_active("avail")
+        assert reg.gauge("slo_alert").value(slo="avail") == 0.0
+        # induced error burst: 80% errors = burn 8 >> threshold 2;
+        # the long window dilutes slower, so keep burning past it
+        fired_at = None
+        for i in range(60):
+            total.inc(100)
+            bad.inc(80)
+            eng.tick(t)
+            t += 1.0
+            if eng.alert_active("avail"):
+                fired_at = i
+                break
+        assert fired_at is not None, "burst never fired the alert"
+        # the alert is BOTH a flight event and a live gauge
+        kinds = [e["kind"] for e in rec.events()]
+        assert "slo.alert" in kinds
+        fields = next(
+            e for e in rec.events() if e["kind"] == "slo.alert"
+        )["fields"]
+        assert fields["slo"] == "avail"
+        assert fields["burn_short"] > 2.0
+        assert reg.gauge("slo_alert").value(slo="avail") == 1.0
+        assert (
+            reg.gauge("slo_burn_rate").value(slo="avail", window="short")
+            > 2.0
+        )
+        # recovery: the SHORT window resets fast -> alert clears (the
+        # multi-window AND), with a clear event on the ring
+        for _ in range(30):
+            total.inc(100)
+            eng.tick(t)
+            t += 1.0
+            if not eng.alert_active("avail"):
+                break
+        assert not eng.alert_active("avail")
+        assert "slo.clear" in [e["kind"] for e in rec.events()]
+        assert reg.gauge("slo_alert").value(slo="avail") == 0.0
+        st = eng.status()
+        assert st["alerts_fired"] == 1
+        assert st["alerts_active"] == []
+
+    def test_short_blip_does_not_page(self):
+        """One transient spike trips the short window but never the
+        long one — the multi-window AND holds the page."""
+        reg = MetricsRegistry()
+        total = reg.counter("req_total")
+        bad = reg.counter("req_bad")
+        eng = SLOEngine(reg, [self._avail_spec()], recorder=None)
+        t = 0.0
+        for _ in range(70):
+            total.inc(100)
+            eng.tick(t)
+            t += 1.0
+        # a 3-tick blip: short burn explodes, long stays dilute
+        for _ in range(3):
+            total.inc(100)
+            bad.inc(80)
+            eng.tick(t)
+            t += 1.0
+        assert (
+            reg.gauge("slo_burn_rate").value(slo="avail", window="short")
+            > 2.0
+        )
+        assert not eng.alert_active("avail")
+
+    def test_latency_slo_over_registry_histogram(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_s", bounds=(0.01, 0.1, 1.0))
+        spec = SLOSpec(
+            name="lat", objective=0.9, kind="latency", metric="lat_s",
+            latency_threshold_s=0.1, short_window_s=10.0,
+            long_window_s=60.0, burn_threshold=2.0,
+        ).validate()
+        eng = SLOEngine(reg, [spec])
+        t = 0.0
+        for _ in range(70):
+            for _ in range(9):
+                h.observe(0.05)
+            h.observe(0.05)
+            eng.tick(t)
+            t += 1.0
+        assert not eng.alert_active("lat")
+        for _ in range(70):
+            for _ in range(5):
+                h.observe(5.0)  # past the 0.1s threshold
+            for _ in range(5):
+                h.observe(0.05)
+            eng.tick(t)
+            t += 1.0
+        assert eng.alert_active("lat")
+        # a threshold that is not a bucket bound is a named refusal
+        bad_spec = SLOSpec(
+            name="lat2", objective=0.9, kind="latency", metric="lat_s",
+            latency_threshold_s=0.07,
+        ).validate()
+        eng2 = SLOEngine(reg, [bad_spec])
+        with pytest.raises(ValueError, match="not a bucket bound"):
+            eng2.tick(0.0)
+
+    def test_spec_parsing(self):
+        specs = parse_slo_specs(
+            '[{"name": "a", "objective": 0.99, '
+            '"metric": "t", "bad_metric": "b"}]'
+        )
+        assert specs[0].name == "a" and specs[0].kind == "availability"
+        assert parse_slo_specs("default") == default_serving_slos()
+        assert default_router_slos()[0].metric == "router_requests_total"
+        with pytest.raises(ValueError, match="unknown SLO spec key"):
+            parse_slo_specs('{"name": "a", "objective": 0.9, '
+                            '"metric": "t", "bad_metric": "b", '
+                            '"shortwindow": 5}')
+        with pytest.raises(ValueError, match="objective"):
+            parse_slo_specs('{"name": "a", "objective": 1.5, '
+                            '"metric": "t", "bad_metric": "b"}')
+        with pytest.raises(ValueError):
+            parse_slo_specs("")
+
+    def test_watcher_burn_gate_replaces_raw_fraction(self):
+        """The serving watcher's post-swap judgment consumes burn-rate
+        state when a gate is wired: raw 100% degraded traffic does NOT
+        trigger while the gate is quiet, and does the moment it burns."""
+        from photon_ml_tpu.registry.watcher import RegistryWatcher
+
+        class _Reg:
+            root = "/dev/null"
+
+        gate_state = {"burning": False}
+        w = RegistryWatcher.__new__(RegistryWatcher)
+        # minimal wiring: no thread, no registry IO — observe_outcome
+        # only touches the window, the flags and the gate
+        from photon_ml_tpu.registry.watcher import (
+            HealthWindow,
+            RollbackPolicy,
+        )
+        import threading
+
+        w.policy = RollbackPolicy(window=8, min_requests=4,
+                                  max_unhealthy_rate=0.5)
+        w.burn_gate = lambda: gate_state["burning"]
+        w._lock = threading.Lock()
+        w._wake = threading.Event()
+        w._window = HealthWindow(8)
+        w._watching_swap = True
+        w._rollback_wanted = False
+        for _ in range(6):
+            w.observe_outcome(degraded=True)
+        assert not w._rollback_wanted, (
+            "raw error fraction must not trigger when a burn gate is "
+            "wired"
+        )
+        gate_state["burning"] = True
+        w.observe_outcome(degraded=True)
+        assert w._rollback_wanted
+        assert w._wake.is_set()
+
+
+# -- ring bounds from the environment (satellite) ------------------------------
+
+
+class TestRingEnvBounds:
+    def test_trace_ring_env_and_bounds_in_export(self, monkeypatch,
+                                                 tmp_path):
+        monkeypatch.setenv("PHOTON_TRACE_SPANS", "16")
+        t = reset_tracer()
+        try:
+            assert t.max_spans == 16
+            with tracing_scope(True):
+                for i in range(40):
+                    t.start(f"s{i}").end()
+            assert len(t) == 16 and t.dropped == 24
+            path = str(tmp_path / "trace.json")
+            export_chrome_trace(path, t.snapshot())
+            other = json.load(open(path))["otherData"]
+            assert other["max_spans"] == 16
+            assert other["dropped_spans"] == 24
+            assert "epoch_wall" in other and "epoch_perf" in other
+        finally:
+            monkeypatch.delenv("PHOTON_TRACE_SPANS")
+            reset_tracer()
+        # garbage env falls back to the default
+        monkeypatch.setenv("PHOTON_TRACE_SPANS", "banana")
+        try:
+            assert reset_tracer().max_spans == Tracer(1 << 16).max_spans
+        finally:
+            monkeypatch.delenv("PHOTON_TRACE_SPANS")
+            reset_tracer()
+
+    def test_flight_ring_env_and_bounds_in_dump(self, monkeypatch,
+                                                tmp_path):
+        monkeypatch.setenv("PHOTON_FLIGHT_EVENTS", "8")
+        try:
+            rec = reset_flight_recorder()
+            assert rec.capacity == 8
+            for i in range(20):
+                rec.record("request.shed", i=i)
+            path = str(tmp_path / "flight.json")
+            rec.dump(path)
+            dump = json.load(open(path))
+            assert dump["capacity"] == 8
+            assert dump["retained"] == 8
+            assert dump["dropped"] == 12
+        finally:
+            monkeypatch.delenv("PHOTON_FLIGHT_EVENTS")
+            reset_flight_recorder()
+
+
+# -- post-hoc merge CLI --------------------------------------------------------
+
+
+class TestPostHocMerge:
+    def _write_dirs(self, tmp_path):
+        """Two fake per-process obs dirs whose dumps nest across the
+        process boundary, plus flight books (the shard's a clean drain,
+        the router's an exit dump)."""
+        router_dir = tmp_path / "router-obs"
+        shard_dir = tmp_path / "shard0-obs"
+        router_dir.mkdir()
+        shard_dir.mkdir()
+        rt = Tracer(64)
+        root = rt.start("router.request", attrs={"uid": "q1"})
+        sub = rt.start(
+            "router.subrequest", trace_id=root.trace_id,
+            parent_id=root.span_id, attrs={"shard": 0},
+        )
+        st = Tracer(64)
+        f = st.start(
+            "frontend.request", trace_id=root.trace_id,
+            parent_id=sub.span_id,
+        )
+        d = st.record(
+            "serving.dispatch", f.t0, f.t0 + 0.001,
+            attrs={"generation": 1, "shape": 1,
+                   "traces": [(root.trace_id, f.span_id, False)]},
+        )
+        f.end()
+        sub.end()
+        root.end()
+        assert d.t1 is not None
+        export_chrome_trace(str(router_dir / "trace.json"),
+                            rt.snapshot())
+        export_chrome_trace(str(shard_dir / "trace.json"), st.snapshot())
+        router_rec = FlightRecorder(64)
+        router_rec.note_admitted()
+        router_rec.note_terminal("ok", generation=1,
+                                 attribution="shard:0")
+        router_rec.record("swap.fleet_commit", generation=1)
+        router_rec.dump(str(router_dir / "flight.json"), reason="exit")
+        shard_rec = FlightRecorder(64)
+        shard_rec.note_admitted()
+        shard_rec.note_terminal("ok", generation=1)
+        shard_rec.record("swap.commit", generation=1)
+        shard_rec.dump(str(shard_dir / "flight.json"), reason="drain")
+        return router_dir, shard_dir
+
+    def test_cli_merges_and_verifies(self, tmp_path, capsys):
+        router_dir, shard_dir = self._write_dirs(tmp_path)
+        out = tmp_path / "merged"
+        rc = fleet_main([str(router_dir), str(shard_dir), "-o",
+                         str(out)])
+        assert rc == 0, capsys.readouterr().out
+        data = json.load(open(out / "fleet_trace.json"))
+        ver = data["otherData"]["verification"]
+        assert ver["ok"], ver["violations"]
+        assert ver["score_leaves"] == 1
+        # flight events ride the merged timeline as instants
+        instants = [e for e in data["traceEvents"] if e.get("ph") == "i"]
+        assert {e["name"] for e in instants} >= {
+            "swap.fleet_commit", "swap.commit",
+        }
+        cons = json.load(open(out / "fleet_conservation.json"))
+        assert cons["ok"], cons
+        assert cons["terminal_by_attribution"] == {"shard:0": 1}
+
+    def test_cli_fails_on_broken_books(self, tmp_path, capsys):
+        router_dir, shard_dir = self._write_dirs(tmp_path)
+        # a dropped response: admitted with no terminal, router-side
+        flight = json.load(open(router_dir / "flight.json"))
+        flight["conservation"]["admitted"] += 1
+        flight["conservation"]["ok"] = False
+        json.dump(flight, open(router_dir / "flight.json", "w"))
+        out = tmp_path / "merged"
+        rc = fleet_main([
+            str(router_dir), str(shard_dir),
+            "--router", "router-obs", "-o", str(out),
+        ])
+        assert rc == 1
+        cons = json.load(open(out / "fleet_conservation.json"))
+        assert not cons["ok"]
+
+
+# -- driver flag validation ----------------------------------------------------
+
+
+class TestDriverValidation:
+    def test_fleet_obs_dir_requires_router_mode(self, tmp_path):
+        from photon_ml_tpu.cli.serving_driver import ServingParams
+
+        p = ServingParams(
+            game_model_input_dir="m", output_dir=str(tmp_path),
+            request_paths=["x"], feature_shards=SHARDS,
+            fleet_obs_dir=str(tmp_path / "fo"),
+        )
+        with pytest.raises(ValueError, match="router mode"):
+            p.validate()
+
+    def test_bad_slo_spec_rejected_at_parse_time(self, tmp_path):
+        from photon_ml_tpu.cli.serving_driver import ServingParams
+
+        p = ServingParams(
+            game_model_input_dir="m", output_dir=str(tmp_path),
+            request_paths=["x"], feature_shards=SHARDS,
+            slo="{not json",
+        )
+        with pytest.raises((ValueError, json.JSONDecodeError)):
+            p.validate()
+
+    def test_slo_default_parses(self, tmp_path):
+        from photon_ml_tpu.cli.serving_driver import ServingParams
+
+        p = ServingParams(
+            game_model_input_dir="m", output_dir=str(tmp_path),
+            request_paths=["x"], feature_shards=SHARDS, slo="default",
+        )
+        # slo validates; the rest of this param set is fine too
+        p.validate()

@@ -295,6 +295,90 @@ class TestMetricsRegistry:
         assert 'lat_bucket{le="+Inf"} 1' in text
         assert "serving_dispatches 7" in text
 
+    def test_histogram_bucket_lines_keep_their_label_set(self):
+        """Satellite (ISSUE 15): two label sets of one histogram used
+        to emit colliding unlabeled {le=...} bucket samples — buckets
+        must merge the series labels with le, consistent with
+        _count/_sum."""
+        r = MetricsRegistry()
+        h = r.histogram("lat", bounds=(0.5,))
+        h.observe(0.1, shard="0")
+        h.observe(0.9, shard="1")
+        text = r.prometheus()
+        assert 'lat_bucket{shard="0",le="0.5"} 1' in text
+        assert 'lat_bucket{shard="0",le="+Inf"} 1' in text
+        assert 'lat_bucket{shard="1",le="0.5"} 0' in text
+        assert 'lat_bucket{shard="1",le="+Inf"} 1' in text
+        # no unlabeled bucket line survives
+        assert 'lat_bucket{le="' not in text
+
+    def test_prometheus_exposition_is_well_formed(self):
+        """Strict line-grammar check over a POPULATED registry (labeled
+        histograms included): every sample parses, no duplicate sample
+        name per label set, buckets cumulative and monotone, +Inf
+        bucket == _count, _count/_sum label-consistent with their
+        buckets."""
+        import re
+
+        r = MetricsRegistry()
+        c = r.counter("reqs")
+        c.inc(3)
+        c.inc(2, shard="0")
+        c.inc(7, shard="1", route="a")
+        g = r.gauge("depth")
+        g.set(4.5)
+        g.set(2.0, shard="0")
+        h = r.histogram("lat", bounds=(0.01, 0.1, 1.0))
+        for v, n in ((0.005, 3), (0.05, 2), (0.5, 4), (5.0, 1)):
+            for _ in range(n):
+                h.observe(v)
+                h.observe(v * 2, shard="1")
+        r.register_view(
+            "serving", lambda: {"dispatches": 7, "nested": {"qps": 1.5}}
+        )
+        text = r.prometheus()
+        line_re = re.compile(
+            r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+            r"(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")"
+            r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*)\})?"
+            r" (-?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|inf))$"
+        )
+        samples = {}
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            m = line_re.match(line)
+            assert m, f"malformed exposition line: {line!r}"
+            name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+            key = (name, tuple(sorted(labels.split(","))) if labels
+                   else ())
+            assert key not in samples, (
+                f"duplicate sample for {name} with labels {labels!r}"
+            )
+            samples[key] = float(value)
+        # histogram invariants per label set: buckets cumulative and
+        # monotone, +Inf == _count, _count/_sum present with the SAME
+        # label set as their buckets
+        by_series = {}
+        for (name, labels), v in samples.items():
+            if not name.startswith("lat_bucket"):
+                continue
+            le = next(p for p in labels if p.startswith('le="'))
+            rest = tuple(p for p in labels if not p.startswith('le="'))
+            by_series.setdefault(rest, []).append((le, v))
+        assert len(by_series) == 2  # unlabeled + shard="1"
+        for rest, buckets in by_series.items():
+            order = {f'le="{b}"': i for i, b in
+                     enumerate(("0.01", "0.1", "1.0", "+Inf"))}
+            buckets.sort(key=lambda bv: order[bv[0]])
+            values = [v for _le, v in buckets]
+            assert values == sorted(values), (rest, values)
+            count = samples[("lat_count", rest)]
+            assert values[-1] == count, (rest, values, count)
+            assert ("lat_sum", rest) in samples
+        assert samples[("serving_dispatches", ())] == 7
+        assert samples[("serving_nested_qps", ())] == 1.5
+
     def test_snapshot_writer_writes_atomically(self, tmp_path):
         r = MetricsRegistry()
         r.counter("n").inc(5)
