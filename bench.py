@@ -2983,6 +2983,402 @@ def _fleet_obs_config(name, *, seed=0):
     }
 
 
+def _wire_config(name, *, seed=0):
+    """photon-wire A/B (ISSUE 17): the SAME closed-loop routed request
+    stream through a REAL 2-shard TCP fleet over the JSON-lines data
+    plane vs the length-prefixed binary plane (negotiated at
+    ``connect()``), paired-alternating passes per house rules.
+
+    The contract being priced: binary framing + raw-float codecs must
+    cut per-request marshalling cost WITHOUT perturbing a single bit of
+    the routed margins. Gates in dev-scripts/bench_wire.sh: bitwise
+    parity between arms on every pass, binary micro codec cost below
+    the JSON micro cost (best-of-reps, measured pre+post the A/B), 0
+    request-path lowerings in BOTH arms, fleet conservation balanced
+    over the shared ledger, and the binary trace drain COMPLETE (every
+    traced request's router.request root reached the collector, 0 ring
+    drops). The QPS speedup gate is multi-core/chip-only; the 1-core
+    container ratio is recorded honestly.
+
+    A writer-coalescing burst leg pipelines a flood of score frames on
+    ONE connection per protocol and reports the walls plus the
+    ``coalesced_responses`` counter delta (responses that shared a
+    sendall with a predecessor) — gated > 0 in bench_wire.sh."""
+    import gc
+    import socket
+
+    import jax
+    import jax._src.test_util as jtu
+
+    from photon_ml_tpu.game.config import FeatureShardConfiguration
+    from photon_ml_tpu.obs.fleet import (
+        FleetCollector,
+        fleet_check_conservation,
+    )
+    from photon_ml_tpu.obs.flight_recorder import FlightRecorder
+    from photon_ml_tpu.obs.trace import tracer, tracing_scope
+    from photon_ml_tpu.serving import (
+        PartialScore,
+        RoutingPolicy,
+        ServingModel,
+        ServingPrograms,
+        ShardRouter,
+        ShardServer,
+        bank_from_arrays,
+    )
+    from photon_ml_tpu.serving import wire
+    from photon_ml_tpu.serving.programs import term_entries
+    from photon_ml_tpu.utils.index_map import IndexMap
+
+    on_chip = any(p.platform != "cpu" for p in jax.devices())
+    if on_chip:
+        E, d_g, d_u = 4096, 1 << 14, 64
+        n_req, passes = 1_000, 3
+    else:
+        E, d_g, d_u = 128, 256, 16
+        n_req, passes = 300, 5
+    # shard widths sized for criteo-width rows (26 + 13 features)
+    widths = {"g": 32, "u": 16}
+    rng = np.random.default_rng(seed)
+    ids = sorted(f"user{i:06d}" for i in range(E))
+    fe_w = rng.standard_normal(d_g).astype(np.float32)
+    re_w = rng.standard_normal((E, d_u)).astype(np.float32)
+    imaps = {
+        "g": IndexMap({f"g{j}\t": j for j in range(d_g)}),
+        "u": IndexMap({f"u{j}\t": j for j in range(d_u)}),
+    }
+    shard_cfgs = [
+        FeatureShardConfiguration("g", ["features"]),
+        FeatureShardConfiguration("u", ["userFeatures"]),
+    ]
+    shard_books = [FlightRecorder(1 << 14) for _ in range(2)]
+    servers = []
+    for s in range(2):
+        bank = bank_from_arrays(
+            fixed=[("global", "g", fe_w)],
+            random=[("per-user", "userId", "u", re_w, ids)],
+            shard_widths=widths,
+            index_maps=imaps,
+            entity_shard=(s, 2),
+        )
+        sm = ServingModel(
+            bank, ServingPrograms((1, 8)), partial=True,
+            entity_shard=(s, 2),
+        )
+        servers.append(ShardServer(
+            sm, shard_cfgs, (s, 2), has_response=False,
+            recorder=shard_books[s],
+        ).start())
+    term_names = tuple(e[1] for e in term_entries(bank.spec))
+    # ONE shared router ledger: both arms' requests land in the same
+    # book, so the fleet conservation join prices the TOTAL stream
+    router_book = FlightRecorder(1 << 14)
+
+    def make_router(wire_mode):
+        return ShardRouter(
+            [("127.0.0.1", srv.port) for srv in servers],
+            entity_ids={"userId": ids},
+            shard_configs=shard_cfgs,
+            policy=RoutingPolicy(subrequest_timeout_s=10.0),
+            cache_entries=0,  # price the WIRE path, not cache replay
+            recorder=router_book,
+            wire=wire_mode,
+        )
+
+    routers = {"json": make_router("json"), "binary": make_router("binary")}
+    negotiated = {}
+    for arm, r in routers.items():
+        negotiated[arm] = r.connect()["wire"]
+    assert negotiated == {"json": "json", "binary": "binary"}, negotiated
+
+    # criteo-width records (39 features/row, the paper's serving
+    # shape): the wire plane is priced on realistic rows, where
+    # per-float text encode/decode is the marshalling tall pole
+    n_g_feat, n_u_feat = 26, 13
+
+    def make_records(n):
+        out = []
+        gj = rng.integers(0, d_g, size=(n, n_g_feat))
+        uj = rng.integers(0, d_u, size=(n, n_u_feat))
+        gv = rng.standard_normal((n, n_g_feat))
+        uv = rng.standard_normal((n, n_u_feat))
+        for i in range(n):
+            out.append({
+                "uid": f"q{i}",
+                "metadataMap": {"userId": ids[i % E]},
+                "features": [
+                    {"name": f"g{int(gj[i, j])}", "term": "",
+                     "value": float(gv[i, j])}
+                    for j in range(n_g_feat)
+                ],
+                "userFeatures": [
+                    {"name": f"u{int(uj[i, j])}", "term": "",
+                     "value": float(uv[i, j])}
+                    for j in range(n_u_feat)
+                ],
+            })
+        return out
+
+    records = make_records(n_req)
+
+    def one_pass(arm):
+        router = routers[arm]
+        lats = []
+        scores = []
+        t0 = time.perf_counter()
+        for rec in records:
+            t = time.perf_counter()
+            scores.append(float(router.score_record(rec)))
+            lats.append(time.perf_counter() - t)
+        wall = time.perf_counter() - t0
+        return wall, lats, scores
+
+    # -- deterministic marshalling micro (best-of-reps, pre AND post the
+    # A/B per the estimator house rules: the codec cost is
+    # deterministic, the min strips scheduler interference, and
+    # measuring again after the flood catches state-dependent drift) ---
+    micro_req = records[0]
+    # the response micro prices EXACTLY what this fleet exchanges: a
+    # gather answer with this bank's term entries, carrying f32-exact
+    # doubles (what scores ARE) whose shortest-round-trip reprs are
+    # long — the per-float text cost the JSON path pays on every answer
+    micro_partial = PartialScore.from_vector(
+        float(np.float32(0.128437)), term_names,
+        rng.standard_normal(len(term_names)).astype(np.float32),
+        generation=1,
+    )
+    micro_head = {
+        "uid": "q0", "status": "ok", "partial": True, "generation": 1,
+        "degraded": False,
+    }
+    micro_resp_bin = dict(micro_head)
+    micro_resp_bin["_wire_partial"] = micro_partial
+    n_micro = 5_000
+
+    def micro_codec():
+        """us per request+response encode/decode round-trip, per arm.
+        The JSON response is built from the PartialScore per iteration
+        — the frontend materializes the terms dict on every gather
+        answer; the binary arm ships the vector straight through."""
+        gc.collect()
+        best = {"json": float("inf"), "binary": float("inf")}
+        buf = bytearray()
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n_micro):
+                line = json.dumps(micro_req).encode() + b"\n"
+                json.loads(line)
+                r = dict(micro_head)
+                nm, vec = micro_partial.term_vector()
+                r["fe"] = micro_partial.fe
+                r["terms"] = dict(zip(nm, vec.tolist()))
+                rline = json.dumps(r).encode() + b"\n"
+                json.loads(rline)
+            best["json"] = min(
+                best["json"], (time.perf_counter() - t0) / n_micro * 1e6
+            )
+            dec = wire.FrameDecoder()
+            t0 = time.perf_counter()
+            for _ in range(n_micro):
+                del buf[:]
+                wire.append_score_request(buf, micro_req)
+                wire.append_response(buf, micro_resp_bin)
+                for mtype, payload in dec.feed(bytes(buf)):
+                    wire.decode_message(mtype, payload)
+            best["binary"] = min(
+                best["binary"], (time.perf_counter() - t0) / n_micro * 1e6
+            )
+        return best
+
+    try:
+        micro_pre = micro_codec()
+        tracer().clear()
+        for arm in ("json", "binary"):
+            one_pass(arm)  # warmup: every program + connection touched
+        router_book.reset()
+        for b in shard_books:
+            b.reset()
+        walls = {"json": [], "binary": []}
+        lats = {"json": [], "binary": []}
+        scores = {"json": [], "binary": []}
+        collector = FleetCollector(
+            [("fleet", "127.0.0.1", servers[0].port)],
+            poll_s=0.05,
+            wire="binary",
+        )
+        with jtu.count_jit_and_pmap_lowerings() as lowerings:
+            for _ in range(passes):
+                for arm in ("json", "binary"):
+                    w, ls, sc = one_pass(arm)
+                    walls[arm].append(w)
+                    lats[arm].extend(ls)
+                    scores[arm].append(sc)
+            # -- binary trace drain: cursor-keyed span batches ride
+            # MSG_TRACE_RESPONSE frames into the live collector --------
+            collector.start()
+            with tracing_scope(True):
+                for rec in records:
+                    routers["binary"].score_record(rec)
+            collector.stop(final_poll=True)
+        # bitwise parity: every pass of each arm must reproduce pass 0
+        # of the JSON arm EXACTLY (float equality, no tolerance)
+        ref = scores["json"][0]
+        parity_ok = all(
+            scores[arm][p] == ref
+            for arm in ("json", "binary")
+            for p in range(passes)
+        )
+        roots = [
+            s for s in collector.stitched_spans()
+            if s["name"] == "router.request"
+        ]
+        status = collector.member_status()["fleet"]
+        conservation = fleet_check_conservation(
+            router_book.check_conservation(),
+            {
+                f"shard{i}": {
+                    "conservation": shard_books[i].check_conservation(),
+                    "complete": True,
+                    "shard_indices": [i],
+                }
+                for i in range(2)
+            },
+        )
+        # -- writer-coalescing burst: ONE connection pipelines a flood
+        # of score frames at shard 0 and drains every response; the
+        # writer thread must batch the backlog into few sendalls
+        # (coalesced_responses counts responses that shared a syscall).
+        # Runs OUTSIDE the lowerings counter: a pipelined burst forms
+        # batch shapes the closed-loop A/B never did. --------------------
+        n_burst = 200
+        burst_payload = {}
+        buf = bytearray()
+        for rec in records[:n_burst]:
+            wire.append_score_request(buf, rec)
+        burst_payload["binary"] = bytes(buf)
+        burst_payload["json"] = "".join(
+            json.dumps(rec, separators=(",", ":")) + "\n"
+            for rec in records[:n_burst]
+        ).encode()
+
+        def one_burst(arm):
+            sock = socket.create_connection(
+                ("127.0.0.1", servers[0].port), timeout=60
+            )
+            try:
+                t0 = time.perf_counter()
+                sock.sendall(burst_payload[arm])
+                if arm == "binary":
+                    dec = wire.FrameDecoder()
+                    got = 0
+                    while got < n_burst:
+                        got += len(dec.feed(sock.recv(1 << 16)))
+                else:
+                    f = sock.makefile("rb")
+                    for _ in range(n_burst):
+                        f.readline()
+                return time.perf_counter() - t0
+            finally:
+                sock.close()
+
+        coalesced0 = servers[0].metrics.snapshot()["frontend"].get(
+            "coalesced_responses", 0
+        )
+        burst_walls = {"json": [], "binary": []}
+        for arm in ("json", "binary"):
+            one_burst(arm)  # warmup: the burst batch shapes compile here
+        for _ in range(3):
+            for arm in ("json", "binary"):
+                burst_walls[arm].append(one_burst(arm))
+        coalesced = servers[0].metrics.snapshot()["frontend"].get(
+            "coalesced_responses", 0
+        ) - coalesced0
+        micro_post = micro_codec()
+    finally:
+        for r in routers.values():
+            r.close()
+        for srv in servers:
+            srv.close()
+    micro = {
+        arm: min(micro_pre[arm], micro_post[arm])
+        for arm in ("json", "binary")
+    }
+    ratios = sorted(
+        j / b for j, b in zip(walls["json"], walls["binary"])
+    )
+    speedup = ratios[len(ratios) // 2]
+    per_req = {arm: float(min(walls[arm])) / n_req * 1e6
+               for arm in ("json", "binary")}
+
+    def p99(samples):
+        return float(np.percentile(np.asarray(samples), 99) * 1e6)
+
+    return {
+        "config": name,
+        "metric": "wire_json_over_binary_wall_ratio",
+        "value": round(speedup, 4),
+        "unit": "x (routed closed-loop, JSON wall / binary wall)",
+        "detail": {
+            "device": str(jax.devices()[0]),
+            "host": {"cpu_count": os.cpu_count(), "on_chip": on_chip},
+            "shards": 2,
+            "requests_per_pass": n_req,
+            "passes_per_arm": passes,
+            "negotiated": negotiated,
+            "json_wall_s": [round(w, 4) for w in walls["json"]],
+            "binary_wall_s": [round(w, 4) for w in walls["binary"]],
+            "pairwise_ratios": [round(r, 4) for r in ratios],
+            "json_qps": round(n_req / min(walls["json"]), 1),
+            "binary_qps": round(n_req / min(walls["binary"]), 1),
+            "json_p99_us": round(p99(lats["json"]), 1),
+            "binary_p99_us": round(p99(lats["binary"]), 1),
+            "per_request_us": {
+                arm: round(v, 2) for arm, v in per_req.items()
+            },
+            "micro_codec_us": {
+                arm: round(micro[arm], 3) for arm in ("json", "binary")
+            },
+            "micro_codec_us_pre": {
+                arm: round(micro_pre[arm], 3)
+                for arm in ("json", "binary")
+            },
+            "micro_codec_us_post": {
+                arm: round(micro_post[arm], 3)
+                for arm in ("json", "binary")
+            },
+            "implied_marshalling_frac": {
+                arm: round(micro[arm] / per_req[arm], 5)
+                for arm in ("json", "binary")
+            },
+            "bitwise_parity": parity_ok,
+            "request_path_lowerings": int(lowerings[0]),
+            "burst": {
+                "pipelined_requests": n_burst,
+                "json_wall_s": [round(w, 4) for w in burst_walls["json"]],
+                "binary_wall_s": [
+                    round(w, 4) for w in burst_walls["binary"]
+                ],
+                "json_best_us_per_req": round(
+                    min(burst_walls["json"]) / n_burst * 1e6, 2
+                ),
+                "binary_best_us_per_req": round(
+                    min(burst_walls["binary"]) / n_burst * 1e6, 2
+                ),
+                "coalesced_responses": int(coalesced),
+            },
+            "trace": {
+                "traced_requests": n_req,
+                "router_request_roots": len(roots),
+                "collector_spans": status["spans"],
+                "ring_dropped": status["ring_dropped"],
+                "errors": status["errors"],
+            },
+            "conservation": conservation,
+            "data": "synthetic 2-shard TCP fleet, closed-loop router",
+        },
+    }
+
+
 def _retrain_config(name, *, n_files=8, rows_per_file=4000, d=2000,
                     k=12, max_iter=30, seed=0):
     """Incremental retrain vs full retrain (ISSUE 10, ROADMAP metric):
@@ -3675,6 +4071,13 @@ def suite(only=None):
         results.append(_fleet_obs_config("16_fleet_observability"))
         print(json.dumps(results[-1]), flush=True)
 
+    # 17: photon-wire (ISSUE 17): binary data plane vs JSON-lines over
+    # a real 2-shard TCP fleet — paired A/B, bitwise parity, micro
+    # codec cost, binary trace drain; gates in dev-scripts/bench_wire.sh.
+    if want("17_wire"):
+        results.append(_wire_config("17_wire"))
+        print(json.dumps(results[-1]), flush=True)
+
     path = "BASELINE_RESULTS.json"
     merged = {}
     if only is not None and os.path.exists(path):
@@ -3744,6 +4147,10 @@ if __name__ == "__main__":
         # dev-scripts/bench_shard_routing.sh entry: the scatter/gather
         # fleet bench as one JSON line (gates applied by the script)
         print(json.dumps(_shard_routing_config("shard_routing")))
+    elif "--wire" in sys.argv:
+        # dev-scripts/bench_wire.sh entry: the binary-vs-JSON wire A/B
+        # as one JSON line (gates applied by the script)
+        print(json.dumps(_wire_config("wire")))
     elif "--fleet-obs" in sys.argv:
         # dev-scripts/bench_fleet_obs.sh entry: the fleet-collector
         # overhead A/B as one JSON line (gates applied by the script)

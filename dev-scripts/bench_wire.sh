@@ -1,0 +1,137 @@
+#!/usr/bin/env bash
+# photon-wire bench (photon_ml_tpu/serving/wire, ISSUE 17): runs
+# bench.py --wire — the SAME closed-loop routed request stream through
+# a REAL 2-shard TCP fleet over the JSON-lines data plane vs the
+# negotiated length-prefixed binary plane, paired-alternating passes —
+# and gates the result.
+#
+# Host-class-aware gates:
+#   - EVERYWHERE (the wire contract, host-independent):
+#       * BITWISE PARITY: every pass of both arms reproduces the same
+#         routed margins EXACTLY (float equality, no tolerance) — the
+#         binary codec must not perturb one bit;
+#       * negotiation: the binary router negotiated "binary", the JSON
+#         router stayed "json";
+#       * zero programs lowered on the request path in BOTH arms
+#         (the wire plane must never compile anything);
+#       * FLEET CONSERVATION over the shared ledger: router admitted
+#         == Σ shard-attributed terminals, joined against each
+#         shard's own book — across BOTH arms' full stream;
+#       * binary trace drain COMPLETE: every traced request's
+#         router.request root reached the FleetCollector over
+#         MSG_TRACE_RESPONSE frames (roots == traced requests,
+#         ring_dropped == 0, errors == 0);
+#       * MARSHALLING: the binary codec round-trip (request
+#         encode+decode + gather-answer encode+decode, best-of-reps,
+#         measured pre+post the A/B) is cheaper than the JSON
+#         round-trip on criteo-width rows.
+#   - MULTI-CORE / CHIP ONLY: the paired A/B wall-clock speedup >=
+#     PHOTON_WIRE_MIN_SPEEDUP (default 1.0 — binary must not lose).
+#     A 1-core container timeshares router, both fleets, and writer
+#     threads on one core, so its A/B ratio is noise-dominated;
+#     recorded honestly, bounded only by a loose floor.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=$(mktemp -t photon-wire-XXXXXX.json)
+trap 'rm -f "$OUT"' EXIT
+
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python bench.py --wire | tail -1 > "$OUT"
+
+python - "$OUT" <<'EOF'
+import json, os, sys
+
+r = json.load(open(sys.argv[1]))
+d = r["detail"]
+print(json.dumps(r, indent=2))
+
+# -- bitwise parity -----------------------------------------------------
+assert d["bitwise_parity"] is True, "routed margins diverged between arms"
+print(
+    f"parity OK: {d['passes_per_arm']} passes x "
+    f"{d['requests_per_pass']} requests bitwise-identical across "
+    "JSON and binary arms"
+)
+
+# -- negotiation --------------------------------------------------------
+assert d["negotiated"] == {"json": "json", "binary": "binary"}, (
+    d["negotiated"]
+)
+print(f"negotiation OK: {d['negotiated']}")
+
+# -- request-path contract ----------------------------------------------
+assert d["request_path_lowerings"] == 0, d["request_path_lowerings"]
+print("contract OK: 0 request-path lowerings across both arms")
+
+# -- fleet conservation (both arms' stream in one ledger) ---------------
+cons = d["conservation"]
+assert cons["ok"], cons
+assert cons["attribution_ok"], cons
+for name, entry in cons["shards"].items():
+    assert entry["join_ok"] is True, (name, entry)
+print(
+    f"fleet conservation OK: admitted {cons['admitted']} == "
+    f"Σ attributed {sum(cons['terminal_by_attribution'].values())} "
+    f"({cons['terminal_by_attribution']}), shard joins exact"
+)
+
+# -- binary trace drain completeness ------------------------------------
+tr = d["trace"]
+assert tr["router_request_roots"] == tr["traced_requests"], tr
+assert tr["ring_dropped"] == 0, tr
+assert tr["errors"] == 0, tr
+print(
+    f"trace drain OK: {tr['router_request_roots']} router.request "
+    f"roots == {tr['traced_requests']} traced requests over binary "
+    f"framing; collector dropped 0"
+)
+
+# -- marshalling micro (host-independent: deterministic, best-of-reps) --
+mj, mb = d["micro_codec_us"]["json"], d["micro_codec_us"]["binary"]
+assert mb < mj, (
+    f"binary codec round-trip {mb}us is not cheaper than JSON {mj}us"
+)
+print(
+    f"marshalling OK: binary {mb}us < JSON {mj}us per request+answer "
+    f"round-trip ({(1 - mb / mj):.1%} cheaper; implied fraction of "
+    f"request wall: {d['implied_marshalling_frac']})"
+)
+
+# -- writer coalescing (both protocols pipelined on one connection) -----
+b = d["burst"]
+assert b["coalesced_responses"] > 0, (
+    "a pipelined burst produced no coalesced writes — the writer "
+    "thread is flushing one response per sendall"
+)
+print(
+    f"coalescing OK: {b['coalesced_responses']} responses shared a "
+    f"sendall across {b['pipelined_requests']}-deep bursts "
+    f"(pipelined best: json {b['json_best_us_per_req']}us/req, "
+    f"binary {b['binary_best_us_per_req']}us/req)"
+)
+
+# -- wall-clock speedup (multi-core / chip only) ------------------------
+multi_core = d["host"]["on_chip"] or (d["host"]["cpu_count"] or 1) > 1
+ab = r["value"]
+if multi_core:
+    gate = float(os.environ.get("PHOTON_WIRE_MIN_SPEEDUP", "1.0"))
+    assert ab >= gate, (
+        f"JSON/binary wall ratio {ab:.4f} below the {gate:.2f}x gate"
+    )
+    print(f"A/B speedup OK: {ab:.4f}x >= {gate:.2f}x")
+else:
+    noise_floor = float(
+        os.environ.get("PHOTON_WIRE_NOISE_FLOOR", "0.70")
+    )
+    assert ab > noise_floor, (
+        f"JSON/binary wall ratio {ab:.4f} below even the 1-core noise "
+        f"floor {noise_floor:.2f} — that is a regression, not jitter"
+    )
+    print(
+        f"A/B recorded (1-core container, router + both shard fleets "
+        f"timeshare one core): {ab:.4f}x (pairwise ratios "
+        f"{d['pairwise_ratios']}); >=1.0x gate applies on "
+        "multi-core/chip hosts"
+    )
+print("bench_wire: PASS")
+EOF

@@ -640,6 +640,11 @@ def shard_routing_arm(
       a byte-copy, so bitwise equality must hold on either side of the
       flip and a mixed-generation gather would still be caught by the
       router's consistency check);
+    - photon-wire leg (ISSUE 17): the flood rides the NEGOTIATED
+      binary data plane (router wire="binary" against real subprocess
+      shards), and a JSON-pinned cross-check router first reproduces
+      the same reference bitwise — so binary == JSON == single-server
+      scorer holds across the mid-flood flip and the SIGKILL;
     - after the SIGKILL, shard 1's entities answer DEGRADED with the
       FE-only reference score bitwise — shard 0's entities stay exact;
     - the surviving shard SIGTERM-drains to exit 0 with zero cold
@@ -747,14 +752,43 @@ def shard_routing_arm(
             entity_ids={"userId": ids},
             shard_configs=shard_cfgs,
             policy=RoutingPolicy(subrequest_timeout_s=5.0),
+            wire="binary",
         )
-        router.connect()
+        info = router.connect()
+        # -- photon-wire leg (ISSUE 17): the whole flood below rides
+        # the negotiated BINARY data plane against real subprocess
+        # shards; a JSON-pinned router first reproduces the
+        # single-server reference bitwise, so binary == JSON == batch
+        # scorer transitively (phase 1 pins the binary side)
+        assert info["wire"] == "binary", info
+        assert router.status()["wire"]["negotiated"] == "binary", (
+            router.status()["wire"]
+        )
+        router_json = ShardRouter(
+            [("127.0.0.1", pt) for pt in ports],
+            entity_ids={"userId": ids},
+            shard_configs=shard_cfgs,
+            policy=RoutingPolicy(subrequest_timeout_s=5.0),
+            wire="json",
+        )
+        assert router_json.connect()["wire"] == "json"
+        try:
+            for rec in records:
+                j = float(router_json.score_record(rec))
+                assert j == clean_scores[rec["uid"]], (
+                    rec["uid"], j, clean_scores[rec["uid"]],
+                )
+        finally:
+            router_json.close()
         from photon_ml_tpu.obs.fleet import (
             FleetCollector,
             fleet_check_conservation,
             verify_fleet_trace,
         )
 
+        # the collector drains both subprocess rings over BINARY
+        # framing (MSG_TRACE_RESPONSE) — the chaos twin of the bench's
+        # trace-drain leg, across a mid-flood swap + SIGKILL
         collector = FleetCollector(
             [
                 ("shard0", "127.0.0.1", ports[0]),
@@ -763,6 +797,7 @@ def shard_routing_arm(
             local_name="router",
             poll_s=0.5,
             connect_timeout_s=15.0,
+            wire="binary",
         ).start()
         owners = {
             r["uid"]: ownership.owner_of(
@@ -989,7 +1024,10 @@ def shard_routing_arm(
             f"generations {sorted(g for g in gens if g)} (two-step "
             f"flip mid-flood), {n_deg} degraded bitwise FE-only after "
             "SIGKILL, outcomes conserved, surviving shard drained "
-            "exit 0; flight recorders of all 3 processes captured "
+            "exit 0; flood rode the NEGOTIATED binary wire (JSON "
+            "cross-check router bitwise-equal first), collector "
+            "drained both rings over binary framing; flight recorders "
+            "of all 3 processes captured "
             "stage->commit->kill->circuit-open in order, conservation "
             "held across the swap; fleet collector merged "
             f"{n_events} trace event(s) from all 3 processes into "

@@ -150,6 +150,17 @@ class ServingParams:
     # post-swap health judgment consumes the burn-rate state.
     slo: Optional[str] = None
     slo_tick_s: float = 1.0
+    # photon-wire (ISSUE 17). Router mode: the data-plane protocol —
+    # "binary" requires every shard to advertise photon-wire framing
+    # (mismatched fleets are refused at connect), "auto" negotiates
+    # binary when the whole fleet speaks it, "json" pins the legacy
+    # plane. Frontends always speak BOTH (first-byte sniffing), so the
+    # flag only routes the router's own connections. --max-frame-bytes
+    # is the shared framing cap (JSON line length == binary frame
+    # length; None resolves PHOTON_MAX_FRAME_BYTES, then 1 MiB) —
+    # published in frontend.json and every status response.
+    wire: str = "auto"
+    max_frame_bytes: Optional[int] = None
 
     @property
     def stdin_mode(self) -> bool:
@@ -194,6 +205,12 @@ class ServingParams:
             )
         if self.fleet_poll_s <= 0:
             raise ValueError("fleet-poll-s must be > 0")
+        if self.wire not in ("json", "binary", "auto"):
+            raise ValueError(
+                f"--wire must be json|binary|auto, got {self.wire!r}"
+            )
+        if self.max_frame_bytes is not None and self.max_frame_bytes <= 0:
+            raise ValueError("--max-frame-bytes must be positive")
         if self.slo_tick_s <= 0:
             raise ValueError("slo-tick-s must be > 0")
         if self.slo:
@@ -1077,13 +1094,14 @@ class ServingDriver:
                 ),
             ),
             cache_entries=p.hot_cache_entries,
+            wire=p.wire,
         )
         with self.timer.time("connect-fleet"):
             info = router.connect()
         self.obs.register_view("routing", router.status)
         self.logger.info(
-            "routing over %d shard-server(s), fleet generation %d",
-            info["shards"], info["generation"],
+            "routing over %d shard-server(s), fleet generation %d, "
+            "%s wire", info["shards"], info["generation"], info["wire"],
         )
         self._start_slo(router=router)
         if p.fleet_obs_dir:
@@ -1310,6 +1328,10 @@ class ServingDriver:
         from photon_ml_tpu.parallel import overlap
         from photon_ml_tpu.reliability import atomic_write_json
         from photon_ml_tpu.serving import ServingFrontend
+        from photon_ml_tpu.serving.wire import (
+            WIRE_PROTOCOLS as wire_protocols,
+            WIRE_VERSION as wire_version,
+        )
 
         p = self.params
         swap_once = threading.Lock()
@@ -1385,6 +1407,7 @@ class ServingDriver:
             host=p.frontend_host,
             port=p.frontend_port,
             has_response=p.has_response,
+            max_frame_bytes=p.max_frame_bytes,
             on_completion=on_completion,
             on_outcome=on_outcome,
             lineage_provider=lineage_provider,
@@ -1414,6 +1437,14 @@ class ServingDriver:
                 # router — and any operator — discovers the fleet
                 # layout without out-of-band config
                 "shard": shard_block,
+                # the wire contract this frontend enforces: protocols
+                # spoken on the port (both, via first-byte sniffing)
+                # and the shared JSON-line/binary-frame cap
+                "wire": {
+                    "protocols": list(wire_protocols),
+                    "version": wire_version,
+                    "max_frame_bytes": frontend.max_frame_bytes,
+                },
             },
         )
         self.logger.info(
@@ -1664,6 +1695,21 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--slo-tick-s", type=float, default=1.0,
         help="SLO engine evaluation period",
     )
+    ap.add_argument(
+        "--wire", default="auto", choices=("json", "binary", "auto"),
+        help="router data-plane protocol: binary requires every shard "
+        "to advertise photon-wire framing (mismatches refused at "
+        "connect), auto negotiates it fleet-wide, json pins the "
+        "legacy JSON-lines plane; frontends always speak both via "
+        "first-byte sniffing",
+    )
+    ap.add_argument(
+        "--max-frame-bytes", type=int, default=None,
+        help="framing cap enforced identically for JSON line lengths "
+        "and binary frame lengths (default: PHOTON_MAX_FRAME_BYTES "
+        "env, then 1 MiB); published in frontend.json and every "
+        "status response",
+    )
     return ap
 
 
@@ -1737,6 +1783,8 @@ def params_from_args(argv=None) -> ServingParams:
         obs_snapshot_s=ns.obs_snapshot_s,
         profile_dir=ns.profile_dir,
         fleet_obs_dir=ns.fleet_obs_dir,
+        wire=ns.wire,
+        max_frame_bytes=ns.max_frame_bytes,
         fleet_poll_s=ns.fleet_poll_s,
         slo=ns.slo,
         slo_tick_s=ns.slo_tick_s,

@@ -113,6 +113,34 @@ def _request_line(
     return json.loads(buf.split(b"\n", 1)[0].decode("utf-8"))
 
 
+def _request_wire(
+    host: str, port: int, obj: Mapping, timeout_s: float
+) -> Dict:
+    """The binary twin of :func:`_request_line`: one MSG_JSON request
+    frame on a fresh connection (the frontend sniffs the magic byte),
+    one decoded response frame back. The trace drain's span batches —
+    the collector's bulk transfer — ride photon-wire's raw float
+    buffers instead of per-float JSON text. Imported lazily so the obs
+    plane stays importable without the serving stack."""
+    from photon_ml_tpu.serving import wire as wirefmt
+
+    out = bytearray()
+    wirefmt.append_json(out, dict(obj))
+    decoder = wirefmt.FrameDecoder(wirefmt.resolve_max_frame_bytes())
+    with socket.create_connection(
+        (host, int(port)), timeout=timeout_s
+    ) as sock:
+        sock.settimeout(timeout_s)
+        sock.sendall(out)
+        while True:
+            chunk = sock.recv(1 << 16)
+            if not chunk:
+                raise ConnectionError("EOF before response frame")
+            frames = decoder.feed(chunk)
+            if frames:
+                return wirefmt.decode_message(*frames[0])
+
+
 class _MemberState:
     """One fleet member's collector-side book. Every field is guarded
     by the owning collector's ``_lock``; the poll path reads the cursor
@@ -169,7 +197,13 @@ class FleetCollector:
         poll_s: float = DEFAULT_POLL_S,
         connect_timeout_s: float = 5.0,
         max_spans_per_member: int = DEFAULT_MAX_SPANS_PER_MEMBER,
+        wire: str = "json",
     ):
+        self.wire = str(wire)
+        if self.wire not in ("json", "binary"):
+            raise ValueError(
+                f"unknown wire protocol {wire!r} (json | binary)"
+            )
         self.poll_s = max(float(poll_s), 0.02)
         self.connect_timeout_s = float(connect_timeout_s)
         self.max_spans_per_member = int(max_spans_per_member)
@@ -216,8 +250,9 @@ class FleetCollector:
             # both c0/c1 are THIS process's epoch-mapped now, so the
             # derived offset lands every member on the collector's own
             # span timeline
+            ask = _request_wire if self.wire == "binary" else _request_line
             c0 = obs_trace.epoch_now()
-            payload = _request_line(
+            payload = ask(
                 m.host, m.port,
                 {"op": "trace", "cursor": cursor, "uid": self._uid(m)},
                 self.connect_timeout_s,

@@ -199,6 +199,12 @@ class Span:
         return (self.t1 if self.t1 is not None else self.t0) - self.t0
 
     def to_dict(self) -> Dict[str, object]:
+        # The span's wire shape — the trace-drain op ships exactly this
+        # dict. The binary drain (serving/wire.py MSG_TRACE_RESPONSE)
+        # relies on two invariants pinned here: ``t0``/``t1`` are the
+        # ONLY float timestamp fields (they ride a raw f64 buffer,
+        # everything else rides the JSON header), and ``t1`` is None
+        # exactly when the span is unfinished (NaN-encoded in flight).
         return {
             "name": self.name,
             "trace_id": self.trace_id,

@@ -33,6 +33,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import numpy as np
+
 __all__ = [
     "ServingError",
     "RequestShed",
@@ -144,10 +146,17 @@ class PartialScore:
     routed recomposition bitwise-equal to the unrouted path.
 
     Immutable value object; the shard-mode batcher resolves futures
-    with these instead of :class:`ScoreOutcome`.
+    with these instead of :class:`ScoreOutcome`. Two storage forms, one
+    contract: the dict form (``__init__``) and the vectorized form
+    (:meth:`from_vector` — name tuple + f32 vector straight out of the
+    dispatch's gathered terms, no per-float dict build on the hot
+    path). ``terms`` materializes the dict lazily; the binary wire's
+    single-pass encoder reads :meth:`term_vector` and never pays for
+    the dict at all.
     """
 
-    __slots__ = ("fe", "terms", "offset", "degraded", "generation")
+    __slots__ = ("fe", "offset", "degraded", "generation",
+                 "_terms", "_names", "_vec")
 
     def __init__(
         self,
@@ -159,10 +168,61 @@ class PartialScore:
         generation: int = 0,
     ):
         self.fe = float(fe)
-        self.terms = dict(terms)
+        self._terms: Optional[dict] = dict(terms)
+        self._names = None
+        self._vec = None
         self.offset = float(offset)
         self.degraded = bool(degraded)
         self.generation = int(generation)
+
+    @classmethod
+    def from_vector(
+        cls,
+        fe: float,
+        names,
+        vec,
+        *,
+        offset: float = 0.0,
+        degraded: bool = False,
+        generation: int = 0,
+    ) -> "PartialScore":
+        """Build from the dispatcher's per-request term row: ``names``
+        in spec order, ``vec`` the matching f32 values. O(1) — the
+        vector is referenced, not copied, and no dict is built."""
+        self = cls.__new__(cls)
+        self.fe = float(fe)
+        self._terms = None
+        self._names = tuple(names)
+        self._vec = np.asarray(vec, dtype=np.float32)
+        self.offset = float(offset)
+        self.degraded = bool(degraded)
+        self.generation = int(generation)
+        return self
+
+    @property
+    def terms(self) -> dict:
+        """NAME -> float term value (exact f64 of each f32, identical
+        to what the JSON wire round-trips). Materialized once on first
+        access for vector-form instances."""
+        t = self._terms
+        if t is None:
+            t = dict(zip(self._names, self._vec.tolist()))
+            self._terms = t
+        return t
+
+    def term_vector(self):
+        """``(names, f32 vector)`` in a stable order — the binary
+        wire's single-copy encode source. Dict-form instances pay the
+        conversion once, here, instead of per encode."""
+        if self._names is None:
+            names = tuple(self._terms)
+            self._vec = np.fromiter(
+                (self._terms[n] for n in names),
+                dtype=np.float32,
+                count=len(names),
+            )
+            self._names = names
+        return self._names, self._vec
 
     def __repr__(self) -> str:
         return (

@@ -770,7 +770,8 @@ class MicroBatcher:
         n_ok = 0
         if self._partial:
             fe, terms = scores
-            names = [e[1] for e in term_entries(bank.spec)]
+            names = tuple(e[1] for e in term_entries(bank.spec))
+            n_terms = len(names)
         traced = []
         collect_traces = tracing_enabled()
         for i, (req, fut) in enumerate(take):
@@ -783,11 +784,14 @@ class MicroBatcher:
                 # tuple per traced request, not one span
                 traced.append((req.trace_id, req.parent_span, deg))
             if self._partial:
-                # float(np.float32) is the exact f64 of the f32 bits;
-                # the router coerces back to f32 losslessly
-                n_ok += int(_resolve(fut, result=PartialScore(
+                # vector form: the f32 term row rides the outcome as-is
+                # (no per-float dict build); the JSON wire materializes
+                # float(np.float32) lazily — the exact f64 of the f32
+                # bits — and the binary wire ships the raw bits
+                n_ok += int(_resolve(fut, result=PartialScore.from_vector(
                     float(fe[i]),
-                    {n: float(terms[i, j]) for j, n in enumerate(names)},
+                    names,
+                    terms[i, :n_terms],
                     offset=req.offset,
                     degraded=deg,
                     generation=bank.generation,

@@ -15,6 +15,15 @@ carry exactly one terminal ``status`` per request line:
   ``DISPATCH_FAILED``, ``CLOSED``, ``INTERNAL``), never a crash and
   never silence.
 
+The same port also speaks photon-wire's length-prefixed binary framing
+(:mod:`photon_ml_tpu.serving.wire`): the reader sniffs each
+connection's FIRST byte — the frame magic selects binary for that
+connection, anything else stays JSON-lines — so old clients and binary
+routers coexist on one accept loop. Binary responses reuse the same
+terminal-status dicts, hot paths (scores, partials, trace drains) ride
+raw little-endian float buffers, and both protocols share one framing
+cap (``max_frame_bytes``).
+
 Control lines ``{"op": "status"|"ready"|"live"}`` answer the lifecycle
 questions without touching the device: **readiness** (bank loaded +
 ladder warm — ``ServingModel.ready()``) says "this replica may take
@@ -71,14 +80,17 @@ from photon_ml_tpu.serving.admission import (
     ServingError,
 )
 from photon_ml_tpu.serving.batcher import MicroBatcher, request_from_record
+from photon_ml_tpu.serving import wire
 
 __all__ = ["ServingFrontend", "READ_SEAM"]
 
 READ_SEAM = "serving.frontend.read"
 
 # Framing cap: a line that exceeds this without a newline is not a
-# request, it is a flood — named error, connection closed.
-DEFAULT_MAX_LINE_BYTES = 1 << 20
+# request, it is a flood — named error, connection closed. The SAME cap
+# refuses a binary frame length (wire.py); both resolve through
+# wire.resolve_max_frame_bytes (explicit > PHOTON_MAX_FRAME_BYTES > 1 MiB).
+DEFAULT_MAX_LINE_BYTES = wire.DEFAULT_MAX_FRAME_BYTES
 # Bounded per-connection response queue (slow-client protection).
 DEFAULT_WRITER_QUEUE = 1024
 # Socket poll period: every blocking socket wait wakes at this beat to
@@ -100,11 +112,23 @@ def _error_response(uid, code: str, message: str) -> Dict[str, object]:
     }
 
 
-def _outcome_response(uid, outcome) -> Dict[str, object]:
+def _outcome_response(uid, outcome, *, binary: bool = False) -> Dict[str, object]:
     if isinstance(outcome, PartialScore):
         # shard-server mode: the scatter/gather half-score. Floats ride
         # JSON as shortest-round-trip doubles holding exact f32 values,
-        # so the router's recomposition is bitwise.
+        # so the router's recomposition is bitwise. On a binary
+        # connection the PartialScore itself rides to the writer thread
+        # (_wire_partial) and its f32 term VECTOR is encoded in one
+        # buffer copy — no per-term dict is ever built.
+        if binary:
+            return {
+                "uid": uid,
+                "status": "ok",
+                "partial": True,
+                "generation": outcome.generation,
+                "degraded": outcome.degraded,
+                "_wire_partial": outcome,
+            }
         return {
             "uid": uid,
             "status": "ok",
@@ -148,15 +172,23 @@ def _failure_response(uid, exc: BaseException) -> Dict[str, object]:
 
 
 class _Connection:
-    """One accepted socket: a reader thread (bounded line framing ->
-    request handling) and a writer thread (bounded queue -> sendall
-    with a send timeout). Either side failing closes both."""
+    """One accepted socket: a reader thread (bounded framing ->
+    request handling) and a writer thread (bounded queue -> coalesced
+    sendall with a send timeout). Either side failing closes both.
+
+    The reader sniffs the connection's FIRST byte: the wire magic
+    selects binary framing for the whole connection; anything else is
+    the JSON-lines protocol, unchanged."""
 
     def __init__(self, frontend: "ServingFrontend", sock: socket.socket,
                  peer: str):
         self.fe = frontend
         self.sock = sock
         self.peer = peer
+        # single-writer atomic publish: the reader thread flips this to
+        # "binary" ONCE at first-byte sniff, before any request (and so
+        # any response the writer could encode) exists on the connection
+        self.proto = "json"  # photon: guarded-by(atomic)
         self.outq: "queue.Queue" = queue.Queue(
             maxsize=frontend.writer_queue_max
         )
@@ -192,6 +224,7 @@ class _Connection:
             self.pending += delta
 
     def _write_loop(self) -> None:
+        out = bytearray()  # reused encode buffer: grows once, kept hot
         while True:
             try:
                 resp = self.outq.get(timeout=POLL_S)
@@ -202,39 +235,80 @@ class _Connection:
                     if drained and self.outq.empty():
                         break
                 continue
-            data = (json.dumps(resp) + "\n").encode("utf-8")
+            # coalesce the backlog: every response already queued rides
+            # the SAME sendall — one syscall per burst, not per response
+            batch = [resp]
+            while True:
+                try:
+                    batch.append(self.outq.get_nowait())
+                except queue.Empty:
+                    break
+            del out[:]
+            if self.proto == "binary":
+                for r in batch:
+                    wire.append_response(out, r)
+            else:
+                for r in batch:
+                    out += json.dumps(r).encode("utf-8")
+                    out += b"\n"
             try:
                 self.sock.settimeout(DEFAULT_SEND_TIMEOUT_S)
-                self.sock.sendall(data)
+                self.sock.sendall(out)
                 self.sock.settimeout(POLL_S)
             except OSError:
                 self.fe._note("connections_dropped_slow")
                 self.closing.set()
                 break
+            if len(batch) > 1:
+                self.fe._note("coalesced_responses", len(batch) - 1)
             if self.fe.metrics is not None:
-                self.fe.metrics.record_response(str(resp.get("status")))
+                for r in batch:
+                    self.fe.metrics.record_response(str(r.get("status")))
         self._shutdown_socket()
 
     # -- request side --------------------------------------------------------
 
     def _read_loop(self) -> None:
+        # first-byte protocol sniff: a binary client's very first byte
+        # is the frame magic — not a legal first byte of any JSON-lines
+        # request — so one byte decides the connection's protocol and
+        # JSON clients keep working unchanged on the same port
+        buf = b""
+        while not self.closing.is_set() and not buf:
+            try:
+                buf = self.sock.recv(1 << 16)
+            except socket.timeout:
+                continue
+            except OSError:
+                self.closing.set()
+                return
+            if not buf:
+                self.closing.set()
+                return  # EOF before any byte
+        if buf and buf[0] == wire.MAGIC:
+            self.proto = "binary"
+            self._read_frames(buf)
+        else:
+            self._read_lines(buf)
+        self.closing.set()
+
+    def _read_lines(self, buf: bytes) -> None:
         from photon_ml_tpu.reliability import (
             InjectedCorruption,
             InjectedFault,
             inject,
         )
 
-        buf = b""
         while not self.closing.is_set():
             nl = buf.find(b"\n")
             if nl < 0:
-                if len(buf) > self.fe.max_line_bytes:
+                if len(buf) > self.fe.max_frame_bytes:
                     # unframed flood: named error, then close — framing
                     # cannot be recovered past the cap
                     self.fe._note("oversized")
                     self.send(_error_response(
                         None, "BAD_REQUEST",
-                        f"line exceeds {self.fe.max_line_bytes} bytes",
+                        f"line exceeds {self.fe.max_frame_bytes} bytes",
                     ))
                     break
                 try:
@@ -260,7 +334,64 @@ class _Connection:
                 self.send(_error_response(None, "READ_FAULT", str(e)))
                 continue
             self._handle_line(line)
-        self.closing.set()
+
+    def _read_frames(self, buf: bytes) -> None:
+        from photon_ml_tpu.reliability import (
+            InjectedCorruption,
+            InjectedFault,
+            inject,
+        )
+
+        decoder = wire.FrameDecoder(self.fe.max_frame_bytes)
+        while not self.closing.is_set():
+            try:
+                frames = decoder.feed(buf)
+            except wire.WireError as e:
+                # framing provably lost (bad magic/version) or a giant
+                # announced length: the binary twin of the oversized
+                # line — named refusal, then close (framing cannot be
+                # recovered; a lying length never buffers its payload)
+                self.fe._note(
+                    "oversized" if e.kind == "oversized" else "malformed"
+                )
+                self.send(_error_response(None, "BAD_REQUEST", str(e)))
+                break
+            for mtype, payload in frames:
+                self.fe._note("lines")
+                try:
+                    # same seam, same cadence: one crossing per frame
+                    inject(READ_SEAM, detail=self.peer)
+                except (InjectedFault, InjectedCorruption, OSError) as e:
+                    self.fe._note("read_faults")
+                    self.send(_error_response(None, "READ_FAULT", str(e)))
+                    continue
+                self._handle_frame(mtype, payload)
+            try:
+                buf = self.sock.recv(1 << 16)
+            except socket.timeout:
+                buf = b""
+                continue
+            except OSError:
+                break
+            if not buf:
+                break  # EOF (a mid-frame disconnect just drops the tail)
+
+    def _handle_frame(self, mtype: int, payload: bytes) -> None:
+        try:
+            if mtype == wire.MSG_SCORE_REQUEST:
+                obj = wire.decode_score_request(payload)
+            elif mtype == wire.MSG_JSON:
+                obj = wire.decode_message(mtype, payload)
+            else:
+                raise wire.WireError(
+                    f"unexpected message type 0x{mtype:02x} on the "
+                    "request side"
+                )
+        except wire.WireError as e:
+            self.fe._note("malformed")
+            self.send(_error_response(None, "BAD_REQUEST", str(e)))
+            return
+        self._handle_obj(obj)
 
     def _handle_line(self, line: bytes) -> None:
         try:
@@ -271,6 +402,9 @@ class _Connection:
             self.fe._note("malformed")
             self.send(_error_response(None, "BAD_REQUEST", str(e)))
             return
+        self._handle_obj(obj)
+
+    def _handle_obj(self, obj: Dict) -> None:
         op = obj.get("op")
         if op is not None:
             self.fe._note("control")
@@ -398,7 +532,8 @@ class ServingFrontend:
         host: str = "127.0.0.1",
         port: int = 0,
         has_response: bool = True,
-        max_line_bytes: int = DEFAULT_MAX_LINE_BYTES,
+        max_line_bytes: Optional[int] = None,
+        max_frame_bytes: Optional[int] = None,
         writer_queue_max: int = DEFAULT_WRITER_QUEUE,
         on_completion: Optional[Callable[[int], None]] = None,
         on_outcome: Optional[Callable[[bool, bool, bool], None]] = None,
@@ -422,7 +557,14 @@ class ServingFrontend:
         self.flight_dump_path = flight_dump_path
         self.host = host
         self.has_response = bool(has_response)
-        self.max_line_bytes = int(max_line_bytes)
+        # ONE framing cap for both protocols (JSON line length == binary
+        # frame length): explicit arg > PHOTON_MAX_FRAME_BYTES env >
+        # 1 MiB. max_line_bytes is the legacy spelling of the same knob.
+        self.max_frame_bytes = wire.resolve_max_frame_bytes(
+            max_frame_bytes if max_frame_bytes is not None
+            else max_line_bytes
+        )
+        self.max_line_bytes = self.max_frame_bytes
         self.writer_queue_max = int(writer_queue_max)
         self.on_completion = on_completion
         # continuous-retraining hooks (registry.watcher): per-outcome
@@ -537,6 +679,14 @@ class ServingFrontend:
             "draining": self._stopped.is_set() or self.batcher.draining,
             "generation": self.serving_model.generation,
             "queue_depth": self.batcher.queue_depth(),
+            # the wire contract: what this frontend speaks (routers
+            # negotiate the data plane from the same block in topology)
+            # and the frame/line cap it enforces
+            "wire": {
+                "protocols": list(wire.WIRE_PROTOCOLS),
+                "version": wire.WIRE_VERSION,
+                "max_frame_bytes": self.max_frame_bytes,
+            },
         }
         history = getattr(self.serving_model, "swap_history", None)
         if history:
@@ -712,7 +862,9 @@ class ServingFrontend:
         # already terminal, so result(timeout=0) cannot block
         try:
             outcome = fut.result(timeout=0)
-            resp = _outcome_response(uid, outcome)
+            resp = _outcome_response(
+                uid, outcome, binary=conn.proto == "binary"
+            )
             ok, degraded, failed = (
                 True, bool(getattr(outcome, "degraded", False)), False,
             )

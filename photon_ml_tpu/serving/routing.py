@@ -77,6 +77,7 @@ from photon_ml_tpu.obs.trace import (
     start_span,
     wire_context,
 )
+from photon_ml_tpu.serving import wire as wirefmt
 from photon_ml_tpu.serving.admission import NoShardAvailable, ScoreOutcome
 from photon_ml_tpu.serving.model_bank import EntityRowIndex
 
@@ -314,22 +315,48 @@ class TransportError(RuntimeError):
 
 
 class TcpShardTransport:
-    """One persistent JSON-lines connection to a shard-server, safe for
-    concurrent callers: requests are multiplexed by uid — senders
-    serialize on a write lock, a reader thread demuxes response lines
-    into per-uid futures. A connection-level failure fails every
-    pending future (the router then degrades/hedges); the transport is
-    single-use after that (the router opens a fresh one).
+    """One persistent connection to a shard-server, safe for concurrent
+    callers: requests are multiplexed by uid — senders serialize on a
+    write lock, a reader thread demuxes responses into per-uid futures.
+    A connection-level failure fails every pending future (the router
+    then degrades/hedges); the transport is single-use after that (the
+    router opens a fresh one).
+
+    ``wire`` picks the protocol for the WHOLE connection: ``"json"``
+    is the JSON-lines plane; ``"binary"`` speaks photon-wire frames
+    (the shard frontend sniffs our first byte) — score sub-requests
+    and partial responses ride raw float buffers, control objects ride
+    MSG_JSON frames, and encodes reuse one per-transport buffer.
     """
 
-    def __init__(self, host: str, port: int, *, connect_timeout_s: float = 5.0):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        connect_timeout_s: float = 5.0,
+        wire: str = "json",
+        max_frame_bytes: Optional[int] = None,
+    ):
         self.host = host
         self.port = int(port)
+        self.wire = str(wire)
+        if self.wire not in wirefmt.WIRE_PROTOCOLS:
+            raise ValueError(
+                f"unknown wire protocol {wire!r} "
+                f"(know {wirefmt.WIRE_PROTOCOLS})"
+            )
+        self.max_frame_bytes = wirefmt.resolve_max_frame_bytes(
+            max_frame_bytes
+        )
         self._sock = socket.create_connection(
             (host, int(port)), timeout=connect_timeout_s
         )
         self._sock.settimeout(POLL_S)
         self._send_lock = threading.Lock()
+        # reused per-connection encode buffer; mutated ONLY under
+        # _send_lock (the same lock that orders the sendalls)
+        self._encode_buf = bytearray()
         self._lock = threading.Lock()  # guards _pending
         self._pending: Dict[str, Future] = {}
         self.unmatched_responses = 0
@@ -346,7 +373,8 @@ class TcpShardTransport:
         return self._closed.is_set()
 
     def send_request(self, obj: Mapping) -> Future:
-        """Ship one JSON line; the returned future resolves with the
+        """Ship one request (a JSON line or a binary frame, per the
+        connection's protocol); the returned future resolves with the
         response object for ``obj['uid']`` (callers wait with their own
         timeout — PL007)."""
         uid = obj["uid"]
@@ -357,10 +385,22 @@ class TcpShardTransport:
                     f"connection to {self.host}:{self.port} is closed"
                 )
             self._pending[uid] = fut
-        data = (json.dumps(obj) + "\n").encode("utf-8")
         try:
-            with self._send_lock:
-                self._sock.sendall(data)
+            if self.wire == "binary":
+                with self._send_lock:
+                    buf = self._encode_buf
+                    del buf[:]
+                    if "op" in obj:
+                        # control objects ride MSG_JSON frames — same
+                        # framing, no hot-path codec needed
+                        wirefmt.append_json(buf, obj)
+                    else:
+                        wirefmt.append_score_request(buf, obj)
+                    self._sock.sendall(buf)
+            else:
+                data = (json.dumps(obj) + "\n").encode("utf-8")
+                with self._send_lock:
+                    self._sock.sendall(data)
         except OSError as e:
             with self._lock:
                 self._pending.pop(uid, None)
@@ -390,6 +430,9 @@ class TcpShardTransport:
             ) from None
 
     def _read_loop(self) -> None:
+        if self.wire == "binary":
+            self._read_frames()
+            return
         buf = b""
         while not self._closed.is_set():
             nl = buf.find(b"\n")
@@ -414,18 +457,50 @@ class TcpShardTransport:
             except (ValueError, UnicodeDecodeError):
                 self.unmatched_responses += 1
                 continue
-            uid = resp.get("uid")
-            with self._lock:
-                fut = self._pending.pop(uid, None) if uid else None
-            if fut is None:
-                # a response for an abandoned/unknown uid (e.g. a
-                # hedged-away attempt, or a shard-side READ_FAULT whose
-                # uid was lost): counted, dropped — the owning attempt
-                # recovers through its own timeout
-                self.unmatched_responses += 1
+            self._dispatch_response(resp)
+
+    def _read_frames(self) -> None:
+        decoder = wirefmt.FrameDecoder(self.max_frame_bytes)
+        while not self._closed.is_set():
+            try:
+                chunk = self._sock.recv(1 << 16)
+            except socket.timeout:
                 continue
-            if not fut.done():
-                fut.set_result(resp)
+            except OSError as e:
+                self._fail_all(e)
+                return
+            if not chunk:
+                self._fail_all(ConnectionError("EOF from shard"))
+                return
+            try:
+                frames = decoder.feed(chunk)
+            except wirefmt.WireError as e:
+                # framing lost on a multiplexed connection: nothing
+                # downstream is decodable — fail every pending future
+                # (the router degrades/hedges per shard, as for EOF)
+                self._fail_all(e)
+                return
+            for mtype, payload in frames:
+                try:
+                    resp = wirefmt.decode_message(mtype, payload)
+                except wirefmt.WireError:
+                    self.unmatched_responses += 1
+                    continue
+                self._dispatch_response(resp)
+
+    def _dispatch_response(self, resp: Mapping) -> None:
+        uid = resp.get("uid")
+        with self._lock:
+            fut = self._pending.pop(uid, None) if uid else None
+        if fut is None:
+            # a response for an abandoned/unknown uid (e.g. a
+            # hedged-away attempt, or a shard-side READ_FAULT whose
+            # uid was lost): counted, dropped — the owning attempt
+            # recovers through its own timeout
+            self.unmatched_responses += 1
+            return
+        if not fut.done():
+            fut.set_result(resp)
 
     def _fail_all(self, exc: BaseException) -> None:
         self._closed.set()
@@ -641,7 +716,18 @@ class ShardRouter:
         metrics: Optional[RouterMetrics] = None,
         native_index_threshold: Optional[int] = None,
         recorder=None,
+        wire: str = "json",
     ):
+        self.wire = str(wire)
+        if self.wire not in ("json", "binary", "auto"):
+            raise ValueError(
+                f"unknown wire mode {wire!r} (json | binary | auto)"
+            )
+        # the NEGOTIATED data-plane protocol: starts json, settled at
+        # connect() from the fleet's topology advertisements.
+        # single-writer atomic publish — connect() is the only writer
+        # (one plain assignment, before it marks the router connected)
+        self._data_wire = "json"  # photon: guarded-by(atomic)
         if transport_factory is None:
             if not addresses:
                 raise ValueError(
@@ -650,16 +736,27 @@ class ShardRouter:
             addrs = [(h, int(p)) for h, p in addresses]
 
             def transport_factory(i, _addrs=addrs):
+                # data plane: reads the negotiated protocol at BUILD
+                # time, so transports (re)opened after connect() speak
+                # whatever the fleet agreed on
+                return TcpShardTransport(*_addrs[i], wire=self._data_wire)
+
+            def control_factory(i, _addrs=addrs):
+                # control plane: always fresh JSON connections — swap
+                # staging and topology discovery predate (and outlive)
+                # any data-plane negotiation
                 return TcpShardTransport(*_addrs[i])
 
             self.num_shards = len(addrs)
         else:
+            control_factory = transport_factory
             self.num_shards = (
                 int(num_shards)
                 if num_shards is not None
                 else (len(addresses) if addresses else None)
             )
         self._transport_factory = transport_factory
+        self._control_factory = control_factory
         self.policy = policy or RoutingPolicy()
         self.metrics = metrics or RouterMetrics()
         # the router's conservation ledger (obs/flight_recorder.py):
@@ -721,11 +818,18 @@ class ShardRouter:
         if n is None:
             raise ValueError("fleet size unknown: pass addresses")
         for i in range(n):
-            t = self._transport(i)
-            resp = t.request(
-                {"op": "topology", "uid": self._next_uid()},
-                CONTROL_TIMEOUT_S,
-            )
+            # topology is fetched over the control plane (fresh JSON
+            # connections): the data plane's protocol is not yet known
+            # — it is negotiated from these very advertisements
+            t = self._control_factory(i)
+            try:
+                resp = t.request(
+                    {"op": "topology", "uid": self._next_uid()},
+                    CONTROL_TIMEOUT_S,
+                )
+            finally:
+                if hasattr(t, "close"):
+                    t.close()
             if resp.get("status") != "ok":
                 raise ValueError(f"shard {i} topology refused: {resp}")
             topos.append(resp)
@@ -770,6 +874,38 @@ class ShardRouter:
             raise ValueError(
                 f"router has no entity-id index for id type(s) {missing}"
             )
+        # -- wire negotiation: the data plane goes binary only when the
+        # WHOLE fleet advertises it. A router pinned to binary facing a
+        # JSON-only shard is refused outright — a wire-protocol
+        # mismatch is a fleet-layout mismatch, the same class of error
+        # as a misordered shard.
+        json_only = [
+            i for i, topo in enumerate(topos)
+            if "binary" not in (
+                (topo.get("wire") or {}).get("protocols") or ("json",)
+            )
+        ]
+        if self.wire == "binary" and json_only:
+            raise ValueError(
+                "wire-protocol mismatch: router requires the binary "
+                f"data plane but shard(s) {json_only} advertise JSON "
+                "only"
+            )
+        negotiated = (
+            "binary"
+            if self.wire in ("binary", "auto") and not json_only
+            else "json"
+        )
+        if negotiated != self._data_wire:
+            self._data_wire = negotiated
+            # drop any pre-negotiation data transports; the next
+            # sub-request rebuilds them on the negotiated protocol
+            with self._conn_lock:
+                stale = list(self._transports.values())
+                self._transports.clear()
+            for t in stale:
+                if hasattr(t, "close"):
+                    t.close()
         self.health = [
             ShardHealth(i, self.policy, recorder=self._flight)
             for i in range(n)
@@ -781,6 +917,7 @@ class ShardRouter:
             "shards": n,
             "generation": int(first["generation"]),
             "entries": [list(e) for e in self._entries],
+            "wire": negotiated,
         }
 
     @property
@@ -1373,11 +1510,13 @@ class ShardRouter:
         """One control op on a FRESH connection: staging a generation
         can take seconds, and running it on the multiplexed data
         connection would stall every in-flight score sub-request behind
-        the shard frontend's per-connection reader."""
+        the shard frontend's per-connection reader. Control stays JSON
+        regardless of the negotiated data plane — status/swap tooling
+        must work against ANY fleet member, negotiated or not."""
         obj = dict(obj)
         obj["uid"] = self._next_uid()
         try:
-            t = self._transport_factory(shard)
+            t = self._control_factory(shard)
         except (TransportError, OSError):
             return None
         try:
@@ -1398,6 +1537,10 @@ class ShardRouter:
             "shards": self.num_shards,
             "generation": self.generation,
             "rule": ownership.OWNERSHIP_RULE,
+            "wire": {
+                "requested": self.wire,
+                "negotiated": self._data_wire,
+            },
             "health": [h.snapshot() for h in self.health],
             "cache": self.cache.snapshot(),
             "router": self.metrics.snapshot(),

@@ -30,6 +30,7 @@ from __future__ import annotations
 from typing import Callable, Dict, Optional, Tuple
 
 from photon_ml_tpu import ownership
+from photon_ml_tpu.serving import wire
 from photon_ml_tpu.serving.batcher import MicroBatcher
 from photon_ml_tpu.serving.frontend import ServingFrontend
 from photon_ml_tpu.serving.metrics import ServingMetrics
@@ -63,6 +64,12 @@ def shard_topology(
         "re_types": list(bank.re_types),
         "partial": serving_model.partial,
         "ready": serving_model.ready(),
+        # wire advertisement: the router negotiates the data plane from
+        # this block at connect() — a shard without it is JSON-only
+        "wire": {
+            "protocols": list(wire.WIRE_PROTOCOLS),
+            "version": wire.WIRE_VERSION,
+        },
     }
 
 
@@ -157,6 +164,7 @@ class ShardServer:
         default_deadline_ms: Optional[float] = None,
         on_outcome=None,
         recorder=None,
+        max_frame_bytes: Optional[int] = None,
     ):
         if not serving_model.partial:
             raise ValueError(
@@ -187,6 +195,7 @@ class ShardServer:
             host=host,
             port=port,
             has_response=has_response,
+            max_frame_bytes=max_frame_bytes,
             on_outcome=on_outcome,
             extra_ops=make_shard_ops(
                 serving_model,

@@ -1,0 +1,874 @@
+"""photon-wire tests (ISSUE 17): the length-prefixed binary wire plane.
+
+The acceptance bar: binary-framed scores are BITWISE the JSON-lines
+path's and the batch scorer's at N in {1, 2, 4} shards; the frontend
+sniffs the connection's first byte so JSON and binary clients coexist
+on one port; the router NEGOTIATES the data plane from the topology
+advertisement and a binary-pinned router refuses a JSON-only shard
+with a named error; every malformed-binary-frame shape in the fuzz
+corpus (lying lengths, truncated frames, giant lengths, mid-frame
+disconnects, unknown types, bad versions) is a named BAD_REQUEST —
+never a crash or a stuck reader; the framing cap is ONE rule enforced
+identically for JSON lines and binary frames; and the cursor-keyed
+trace drain rides MSG_TRACE_RESPONSE frames into a FleetCollector
+with an exact merge.
+"""
+
+import json
+import math
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from photon_ml_tpu.game.data import build_game_dataset
+from photon_ml_tpu.obs.fleet import FleetCollector
+from photon_ml_tpu.obs.trace import tracer, tracing_scope
+from photon_ml_tpu.serving import (
+    MicroBatcher,
+    PartialScore,
+    ServingFrontend,
+    ServingMetrics,
+    ServingModel,
+    ServingPrograms,
+    ShardRouter,
+)
+from photon_ml_tpu.serving import wire
+from tests.test_serving import (
+    SHARDS,
+    batch_reference_scores,
+    make_bank,
+    synth_model,
+    synth_records,
+)
+from tests.test_serving_frontend import Client
+from tests.test_shard_routing import (
+    build_fleet,
+    build_router,
+    close_fleet,
+)
+
+
+class BinClient:
+    """One binary-framing client connection: frames out, frames in."""
+
+    def __init__(self, port, timeout=15.0, max_frame_bytes=None):
+        self.sock = socket.create_connection(
+            ("127.0.0.1", port), timeout=timeout
+        )
+        self.dec = wire.FrameDecoder(
+            wire.resolve_max_frame_bytes(max_frame_bytes)
+        )
+        self.pending = []
+
+    def send_raw(self, data: bytes):
+        self.sock.sendall(data)
+
+    def send(self, obj, *, score=False):
+        buf = bytearray()
+        if score:
+            wire.append_score_request(buf, obj)
+        else:
+            wire.append_json(buf, obj)
+        self.sock.sendall(buf)
+
+    def recv_frame(self):
+        """The next raw (msg_type, payload), or None on EOF."""
+        while not self.pending:
+            try:
+                chunk = self.sock.recv(1 << 16)
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            self.pending.extend(self.dec.feed(chunk))
+        return self.pending.pop(0)
+
+    def recv(self):
+        frame = self.recv_frame()
+        if frame is None:
+            return None
+        return wire.decode_message(*frame)
+
+    def ask(self, obj, *, score=False):
+        self.send(obj, score=score)
+        return self.recv()
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture
+def front(rng):
+    """Full-margin serving stack on an ephemeral port + its records."""
+    recs = synth_records(rng)
+    ds = build_game_dataset(recs, SHARDS, ["userId"])
+    lm = synth_model(rng)
+    bank = make_bank(lm, ds)
+    sm = ServingModel(bank, ServingPrograms((1, 8)))
+    metrics = ServingMetrics()
+    batcher = MicroBatcher(sm.current, sm.programs, metrics)
+    fe = ServingFrontend(
+        batcher, sm, SHARDS, metrics=metrics, port=0
+    ).start()
+    yield recs, ds, lm, metrics, fe
+    fe.stop_accepting()
+    batcher.drain(10.0)
+    fe.close()
+    batcher.close()
+
+
+# -- the codec in isolation ---------------------------------------------------
+
+
+class TestCodec:
+    def test_score_request_roundtrip_matches_json(self):
+        rng = np.random.default_rng(7)
+        rec = {
+            "uid": "q1",
+            "deadline_ms": 250.0,
+            "metadataMap": {"userId": "user3"},
+            "features": [
+                {"name": f"g{i}", "term": "", "value": float(v)}
+                for i, v in enumerate(rng.standard_normal(8))
+            ],
+            "userFeatures": [
+                {"name": "u0", "term": "t", "value": -1.5},
+            ],
+        }
+        buf = bytearray()
+        wire.append_score_request(buf, rec)
+        frames = wire.FrameDecoder().feed(bytes(buf))
+        assert len(frames) == 1
+        assert frames[0][0] == wire.MSG_SCORE_REQUEST
+        got = wire.decode_message(*frames[0])
+        # the binary round-trip must reproduce EXACTLY what a JSON
+        # round-trip of the same record produces — same doubles
+        assert got == json.loads(json.dumps(rec))
+
+    @pytest.mark.parametrize("bag", [
+        # generic fallback shapes: extra key, missing term, the column
+        # separator inside a name, non-string name, bool/str/nested
+        # values, an un-listable value — all must still round-trip
+        [{"name": "a", "term": "", "value": 1.5, "extra": 2}],
+        [{"name": "a", "value": 1.5}],
+        [{"name": "a\x1fb", "term": "", "value": 1.5}],
+        [{"name": 3, "term": "", "value": 1.5}],
+        [{"name": "a", "term": "", "value": True}],
+        [{"name": "a", "term": "", "value": "str"}],
+        [{"name": "a", "term": "", "value": [1.0, 2.0]}],
+        [{"name": "a", "term": "", "value": 2 ** 400}],
+        ["not-a-dict"],
+        [],
+    ])
+    def test_nonstandard_bags_roundtrip(self, bag):
+        rec = {"uid": "q", "features": bag}
+        buf = bytearray()
+        wire.append_score_request(buf, rec)
+        got = wire.decode_message(*wire.FrameDecoder().feed(bytes(buf))[0])
+        assert got == json.loads(json.dumps(rec))
+
+    def test_int_values_ride_as_doubles(self):
+        # the existing strip contract: numeric values ride the f64
+        # tail, so ints come back as the equal float (score-identical:
+        # the batcher floats every value anyway)
+        rec = {"uid": "q", "features": [
+            {"name": "a", "term": "", "value": 7},
+        ]}
+        buf = bytearray()
+        wire.append_score_request(buf, rec)
+        got = wire.decode_message(*wire.FrameDecoder().feed(bytes(buf))[0])
+        assert got["features"][0]["value"] == 7.0
+        assert isinstance(got["features"][0]["value"], float)
+
+    def test_score_response_roundtrip_exact_f32(self):
+        score = float(np.float32(0.1))  # long shortest-round-trip repr
+        resp = {"uid": "q", "status": "ok", "score": score,
+                "degraded": False, "generation": 3}
+        buf = bytearray()
+        wire.append_response(buf, resp)
+        (mtype, payload), = wire.FrameDecoder().feed(bytes(buf))
+        assert mtype == wire.MSG_SCORE_RESPONSE
+        assert wire.decode_message(mtype, payload) == resp
+
+    def test_partial_response_matches_json_form(self):
+        names = ("per-user", "per-item")
+        vec = np.asarray([0.25, -1.125], dtype=np.float32)
+        ps = PartialScore.from_vector(
+            float(np.float32(0.7)), names, vec, generation=2
+        )
+        head = {"uid": "q", "status": "ok", "partial": True,
+                "generation": 2, "degraded": False}
+        json_form = dict(head)
+        json_form["fe"] = ps.fe
+        json_form["terms"] = dict(ps.terms)
+        resp = dict(head)
+        resp["_wire_partial"] = ps
+        buf = bytearray()
+        wire.append_response(buf, resp)
+        (mtype, payload), = wire.FrameDecoder().feed(bytes(buf))
+        assert mtype == wire.MSG_PARTIAL_RESPONSE
+        # decoded binary == what the JSON path would have produced,
+        # double for double
+        assert wire.decode_message(mtype, payload) == json.loads(
+            json.dumps(json_form)
+        )
+
+    def test_trace_response_roundtrip_with_unfinished_span(self):
+        resp = {
+            "uid": "t", "status": "ok", "op": "trace", "cursor": 9,
+            "dropped": 0,
+            "spans": [
+                {"seq": 1, "name": "a", "t0": 1.25, "t1": 2.5},
+                {"seq": 2, "name": "b", "t0": 3.125, "t1": None},
+            ],
+        }
+        buf = bytearray()
+        wire.append_response(buf, resp)
+        (mtype, payload), = wire.FrameDecoder().feed(bytes(buf))
+        assert mtype == wire.MSG_TRACE_RESPONSE
+        assert wire.decode_message(mtype, payload) == resp
+
+    def test_control_responses_ride_msg_json(self):
+        resp = {"uid": "q", "status": "error", "error": "BAD_REQUEST",
+                "message": "nope"}
+        buf = bytearray()
+        wire.append_response(buf, resp)
+        (mtype, payload), = wire.FrameDecoder().feed(bytes(buf))
+        assert mtype == wire.MSG_JSON
+        assert wire.decode_message(mtype, payload) == resp
+
+    def test_decoder_streams_partial_frames(self):
+        buf = bytearray()
+        wire.append_json(buf, {"op": "status"})
+        wire.append_json(buf, {"op": "metrics"})
+        dec = wire.FrameDecoder()
+        out = []
+        for i in range(len(buf)):  # one byte at a time
+            out.extend(dec.feed(bytes(buf[i:i + 1])))
+        assert [m for m, _p in out] == [wire.MSG_JSON, wire.MSG_JSON]
+        assert dec.pending_bytes == 0
+
+    def test_decoder_named_failures(self):
+        dec = wire.FrameDecoder(max_frame_bytes=1024)
+        with pytest.raises(wire.WireError, match="framing lost"):
+            dec.feed(b"\x00" * 7)
+        dec = wire.FrameDecoder(max_frame_bytes=1024)
+        bad_version = struct.pack("<BBBI", wire.MAGIC, 99, wire.MSG_JSON, 0)
+        with pytest.raises(wire.WireError, match="wire version"):
+            dec.feed(bad_version)
+        dec = wire.FrameDecoder(max_frame_bytes=1024)
+        giant = struct.pack(
+            "<BBBI", wire.MAGIC, wire.WIRE_VERSION, wire.MSG_JSON, 1 << 30
+        )
+        with pytest.raises(wire.WireError, match="exceeds") as ei:
+            dec.feed(giant)  # refused from the HEADER — nothing buffered
+        assert ei.value.kind == "oversized"
+        with pytest.raises(wire.WireError, match="unknown message type"):
+            wire.decode_message(0x7F, b"")
+
+    def test_lying_payload_lengths_are_named_errors(self):
+        # inner header length overruns the frame
+        payload = struct.pack("<I", 999) + b"{}"
+        with pytest.raises(wire.WireError, match="overruns"):
+            wire.decode_score_request(payload)
+        # float tail shorter than _wire_bags promises
+        head = json.dumps(
+            {"features": [{"name": "a"}], "_wire_bags": {"features": 1}}
+        ).encode()
+        payload = struct.pack("<I", len(head)) + head + b"\x00" * 4
+        with pytest.raises(wire.WireError, match="float buffer"):
+            wire.decode_score_request(payload)
+        # _wire_cols without a matching count
+        head = json.dumps(
+            {"_wire_bags": {}, "_wire_cols": {"features": ["a", ""]}}
+        ).encode()
+        payload = struct.pack("<I", len(head)) + head
+        with pytest.raises(wire.WireError, match="_wire"):
+            wire.decode_score_request(payload)
+        # column entry count disagrees with the bag count
+        head = json.dumps({
+            "_wire_bags": {"features": 2},
+            "_wire_cols": {"features": ["a", ""]},
+        }).encode()
+        payload = (
+            struct.pack("<I", len(head)) + head + b"\x00" * 16
+        )
+        with pytest.raises(wire.WireError, match="promised 2"):
+            wire.decode_score_request(payload)
+
+    def test_resolve_max_frame_bytes(self, monkeypatch):
+        monkeypatch.delenv(wire.MAX_FRAME_BYTES_ENV, raising=False)
+        assert wire.resolve_max_frame_bytes() == wire.DEFAULT_MAX_FRAME_BYTES
+        monkeypatch.setenv(wire.MAX_FRAME_BYTES_ENV, "4096")
+        assert wire.resolve_max_frame_bytes() == 4096
+        # explicit beats env
+        assert wire.resolve_max_frame_bytes(512) == 512
+        with pytest.raises(ValueError, match="positive"):
+            wire.resolve_max_frame_bytes(0)
+
+
+# -- first-byte sniffing: both protocols on ONE port --------------------------
+
+
+class TestFrontendSniffing:
+    def test_binary_scores_bitwise_match_json_clients(self, front):
+        recs, ds, lm, metrics, fe = front
+        ref = batch_reference_scores(lm, ds)
+        jc, bc = Client(fe.port), BinClient(fe.port)
+        try:
+            for i in (0, 7, 23):
+                jr = jc.ask(recs[i])
+                bc.send(recs[i], score=True)
+                mtype, payload = bc.recv_frame()
+                # the hot-path response codec, not a JSON fallback
+                assert mtype == wire.MSG_SCORE_RESPONSE
+                br = wire.decode_message(mtype, payload)
+                assert br == jr, "binary response must equal JSON's"
+                assert np.float32(br["score"]) == ref[i]
+        finally:
+            jc.close()
+            bc.close()
+
+    def test_mixed_protocol_clients_concurrently(self, front):
+        recs, ds, lm, metrics, fe = front
+        ref = batch_reference_scores(lm, ds)
+        errors = []
+
+        def json_worker(idx):
+            c = Client(fe.port)
+            try:
+                for i in idx:
+                    r = c.ask(recs[i])
+                    assert r["status"] == "ok", r
+                    assert np.float32(r["score"]) == ref[i], i
+            except BaseException as e:  # noqa: BLE001 - collected
+                errors.append(e)
+            finally:
+                c.close()
+
+        def bin_worker(idx):
+            c = BinClient(fe.port)
+            try:
+                for i in idx:
+                    r = c.ask(recs[i], score=True)
+                    assert r["status"] == "ok", r
+                    assert np.float32(r["score"]) == ref[i], i
+            except BaseException as e:  # noqa: BLE001 - collected
+                errors.append(e)
+            finally:
+                c.close()
+
+        threads = [
+            threading.Thread(target=json_worker, args=(range(0, 30),)),
+            threading.Thread(target=bin_worker, args=(range(30, 60),)),
+            threading.Thread(target=json_worker, args=(range(15, 45),)),
+            threading.Thread(target=bin_worker, args=(range(0, 60, 2),)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+
+    def test_control_ops_and_status_advertise_wire(self, front):
+        recs, ds, lm, metrics, fe = front
+        bc = BinClient(fe.port)
+        jc = Client(fe.port)
+        try:
+            for status in (
+                bc.ask({"op": "status"}),
+                jc.ask({"op": "status"}),
+            ):
+                assert status["status"] == "ok"
+                assert status["wire"]["protocols"] == ["json", "binary"]
+                assert status["wire"]["version"] == wire.WIRE_VERSION
+                assert (
+                    status["wire"]["max_frame_bytes"] == fe.max_frame_bytes
+                )
+            m = bc.ask({"op": "metrics"})
+            assert m["status"] == "ok"
+        finally:
+            bc.close()
+            jc.close()
+
+    def test_pipelined_burst_coalesces_and_demuxes(self, front):
+        """Writer coalescing: a pipelined burst on one connection gets
+        every response exactly once (uids demux), for BOTH protocols,
+        and backlog drains through batched sendalls."""
+        recs, ds, lm, metrics, fe = front
+        ref = {r["uid"]: s for r, s in zip(
+            recs, batch_reference_scores(lm, ds)
+        )}
+        bc = BinClient(fe.port)
+        try:
+            buf = bytearray()
+            for r in recs[:40]:
+                wire.append_score_request(buf, r)
+            bc.send_raw(bytes(buf))
+            got = {}
+            for _ in range(40):
+                r = bc.recv()
+                assert r["status"] == "ok", r
+                got[r["uid"]] = np.float32(r["score"])
+            assert got == {r["uid"]: ref[r["uid"]] for r in recs[:40]}
+        finally:
+            bc.close()
+        jc = Client(fe.port)
+        try:
+            jc.send_line(
+                ("\n".join(json.dumps(r) for r in recs[:40]) + "\n")
+                .encode()
+            )
+            got = {}
+            for _ in range(40):
+                r = jc.recv()
+                assert r["status"] == "ok", r
+                got[r["uid"]] = np.float32(r["score"])
+            assert got == {r["uid"]: ref[r["uid"]] for r in recs[:40]}
+        finally:
+            jc.close()
+
+
+# -- malformed-binary-frame fuzz corpus ---------------------------------------
+
+
+class TestBinaryFuzz:
+    def _score_ok(self, fe, rec):
+        """The server is still alive: a fresh connection scores."""
+        c = BinClient(fe.port)
+        try:
+            r = c.ask(rec, score=True)
+            assert r is not None and r["status"] == "ok", r
+        finally:
+            c.close()
+
+    def test_giant_announced_length_is_named_refusal(self, front):
+        recs, ds, lm, metrics, fe = front
+        c = BinClient(fe.port)
+        try:
+            c.send_raw(struct.pack(
+                "<BBBI", wire.MAGIC, wire.WIRE_VERSION, wire.MSG_JSON,
+                1 << 30,
+            ))
+            r = c.recv()
+            assert r["status"] == "error" and r["error"] == "BAD_REQUEST"
+            assert "exceeds" in r["message"]
+            assert c.recv() is None  # framing lost -> connection closed
+        finally:
+            c.close()
+        assert metrics.snapshot()["frontend"]["oversized"] >= 1
+        self._score_ok(fe, recs[0])
+
+    def test_bad_version_is_named_refusal(self, front):
+        recs, ds, lm, metrics, fe = front
+        c = BinClient(fe.port)
+        try:
+            c.send_raw(struct.pack(
+                "<BBBI", wire.MAGIC, 99, wire.MSG_JSON, 0
+            ))
+            r = c.recv()
+            assert r["status"] == "error" and r["error"] == "BAD_REQUEST"
+            assert "wire version" in r["message"]
+            assert c.recv() is None
+        finally:
+            c.close()
+        self._score_ok(fe, recs[0])
+
+    def test_framing_lost_mid_stream_is_named_refusal(self, front):
+        recs, ds, lm, metrics, fe = front
+        c = BinClient(fe.port)
+        try:
+            r = c.ask({"op": "status"})
+            assert r["status"] == "ok"  # the connection served traffic
+            c.send_raw(b"garbage-after-a-valid-frame")
+            r = c.recv()
+            assert r["status"] == "error" and r["error"] == "BAD_REQUEST"
+            assert "framing lost" in r["message"]
+            assert c.recv() is None
+        finally:
+            c.close()
+        self._score_ok(fe, recs[0])
+
+    def test_lying_inner_length_keeps_connection_alive(self, front):
+        """Payload-level lies are per-REQUEST errors: the frame
+        boundary is intact, so the connection survives and the next
+        frame answers normally."""
+        recs, ds, lm, metrics, fe = front
+        c = BinClient(fe.port)
+        try:
+            payload = struct.pack("<I", 999) + b"{}"
+            frame = struct.pack(
+                "<BBBI", wire.MAGIC, wire.WIRE_VERSION,
+                wire.MSG_SCORE_REQUEST, len(payload),
+            ) + payload
+            c.send_raw(frame)
+            r = c.recv()
+            assert r["status"] == "error" and r["error"] == "BAD_REQUEST"
+            assert "overruns" in r["message"]
+            # same connection, next frame: a real score
+            r2 = c.ask(recs[0], score=True)
+            assert r2["status"] == "ok"
+        finally:
+            c.close()
+        assert metrics.snapshot()["frontend"]["malformed"] >= 1
+
+    def test_unknown_message_type_keeps_connection_alive(self, front):
+        recs, ds, lm, metrics, fe = front
+        c = BinClient(fe.port)
+        try:
+            c.send_raw(struct.pack(
+                "<BBBI", wire.MAGIC, wire.WIRE_VERSION, 0x7F, 0
+            ))
+            r = c.recv()
+            assert r["status"] == "error" and r["error"] == "BAD_REQUEST"
+            assert "unexpected message type" in r["message"]
+            r2 = c.ask(recs[0], score=True)
+            assert r2["status"] == "ok"
+        finally:
+            c.close()
+
+    def test_response_types_refused_on_request_side(self, front):
+        recs, ds, lm, metrics, fe = front
+        c = BinClient(fe.port)
+        try:
+            resp = bytearray()
+            wire.append_response(resp, {
+                "uid": "q", "status": "ok", "score": 0.5,
+            })
+            c.send_raw(bytes(resp))  # MSG_SCORE_RESPONSE at the server
+            r = c.recv()
+            assert r["status"] == "error" and r["error"] == "BAD_REQUEST"
+            assert "request side" in r["message"]
+        finally:
+            c.close()
+
+    def test_mid_frame_disconnect_never_wedges_the_server(self, front):
+        recs, ds, lm, metrics, fe = front
+        whole = bytearray()
+        wire.append_score_request(whole, recs[0])
+        for cut in (3, 7, len(whole) // 2, len(whole) - 1):
+            c = BinClient(fe.port)
+            c.send_raw(bytes(whole[:cut]))
+            c.close()  # mid-frame EOF: the tail is just dropped
+        self._score_ok(fe, recs[0])
+        # no reader thread is stuck: the frontend drains to zero conns
+        deadline = 50
+        while fe.open_connections() > 0 and deadline:
+            import time
+
+            time.sleep(0.1)
+            deadline -= 1
+        assert fe.open_connections() == 0
+
+    def test_non_magic_garbage_takes_the_json_lane(self, front):
+        recs, ds, lm, metrics, fe = front
+        c = Client(fe.port)
+        try:
+            c.send_line(b"\x02not json either\n")
+            r = c.recv()
+            assert r["status"] == "error" and r["error"] == "BAD_REQUEST"
+        finally:
+            c.close()
+        self._score_ok(fe, recs[0])
+
+
+# -- the ONE framing cap, both protocols --------------------------------------
+
+
+class TestFrameCap:
+    @pytest.fixture
+    def capped(self, rng):
+        recs = synth_records(rng)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        bank = make_bank(synth_model(rng), ds)
+        sm = ServingModel(bank, ServingPrograms((1, 8)))
+        metrics = ServingMetrics()
+        batcher = MicroBatcher(sm.current, sm.programs, metrics)
+        fe = ServingFrontend(
+            batcher, sm, SHARDS, metrics=metrics, port=0,
+            max_frame_bytes=2048,
+        ).start()
+        yield recs, metrics, fe
+        fe.stop_accepting()
+        batcher.drain(10.0)
+        fe.close()
+        batcher.close()
+
+    def test_cap_refuses_json_line_and_binary_frame_alike(self, capped):
+        recs, metrics, fe = capped
+        assert fe.max_frame_bytes == 2048
+        jc = Client(fe.port)
+        try:
+            jc.send_line(b"{" + b" " * 4096)  # no newline before cap
+            r = jc.recv()
+            assert r["error"] == "BAD_REQUEST"
+            assert "exceeds 2048 bytes" in r["message"]
+        finally:
+            jc.close()
+        bc = BinClient(fe.port)
+        try:
+            bc.send_raw(struct.pack(
+                "<BBBI", wire.MAGIC, wire.WIRE_VERSION, wire.MSG_JSON,
+                4096,
+            ))
+            r = bc.recv()
+            assert r["error"] == "BAD_REQUEST"
+            assert "exceeds 2048" in r["message"]
+        finally:
+            bc.close()
+        assert metrics.snapshot()["frontend"]["oversized"] >= 2
+        # the cap is published where operators look
+        c = Client(fe.port)
+        try:
+            assert c.ask({"op": "status"})["wire"]["max_frame_bytes"] == 2048
+        finally:
+            c.close()
+
+    def test_env_cap_applies_when_unset(self, rng, monkeypatch):
+        monkeypatch.setenv(wire.MAX_FRAME_BYTES_ENV, "8192")
+        recs = synth_records(rng, n=4)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        bank = make_bank(synth_model(rng), ds)
+        sm = ServingModel(bank, ServingPrograms((1, 8)))
+        batcher = MicroBatcher(sm.current, sm.programs, ServingMetrics())
+        fe = ServingFrontend(batcher, sm, SHARDS, port=0)
+        try:
+            assert fe.max_frame_bytes == 8192
+            assert fe.max_line_bytes == 8192  # legacy alias, same rule
+        finally:
+            fe.close()
+            batcher.close()
+
+    def test_driver_flags(self):
+        from photon_ml_tpu.cli.serving_driver import params_from_args
+
+        p = params_from_args([
+            "--game-model-input-dir", "m",
+            "--output-dir", "o",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "g:features",
+            "--wire", "binary",
+            "--max-frame-bytes", "65536",
+        ])
+        assert p.wire == "binary"
+        assert p.max_frame_bytes == 65536
+        p2 = params_from_args([
+            "--game-model-input-dir", "m",
+            "--output-dir", "o",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "g:features",
+        ])
+        assert p2.wire == "auto"
+        assert p2.max_frame_bytes is None
+        bad = params_from_args([
+            "--game-model-input-dir", "m",
+            "--output-dir", "o",
+            "--feature-shard-id-to-feature-section-keys-map",
+            "g:features",
+            "--max-frame-bytes", "0",
+        ])
+        with pytest.raises(ValueError, match="max-frame-bytes"):
+            bad.validate()
+
+
+# -- negotiation + routed parity ----------------------------------------------
+
+
+def _strip_wire_advertisement(server):
+    """Make one shard LOOK like a pre-wire build: its topology answer
+    loses the ``wire`` block (the negotiation treats that as
+    JSON-only)."""
+    orig = server.frontend.extra_ops["topology"]
+
+    def legacy_topology(obj):
+        out = orig(obj)
+        out.pop("wire", None)
+        return out
+
+    server.frontend.extra_ops["topology"] = legacy_topology
+
+
+class TestNegotiation:
+    def test_binary_router_refuses_json_only_shard_by_name(self, rng):
+        recs = synth_records(rng)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        lm = synth_model(rng)
+        servers = build_fleet(lm, ds, 2)
+        _strip_wire_advertisement(servers[1])
+        try:
+            with pytest.raises(
+                ValueError, match=r"wire-protocol mismatch.*\[1\]"
+            ):
+                build_router(servers, lm, wire="binary")
+        finally:
+            close_fleet(servers)
+
+    def test_auto_falls_back_to_json_on_mixed_fleet(self, rng):
+        recs = synth_records(rng)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        lm = synth_model(rng)
+        ref = batch_reference_scores(lm, ds)
+        servers = build_fleet(lm, ds, 2)
+        _strip_wire_advertisement(servers[0])
+        router = None
+        try:
+            router = build_router(servers, lm, wire="auto")
+            st = router.status()["wire"]
+            assert st == {"requested": "auto", "negotiated": "json"}
+            got = [router.score_record(r) for r in recs[:16]]
+            assert np.array_equal(
+                np.asarray(got, np.float32), ref[:16]
+            )
+        finally:
+            close_fleet(servers, router)
+
+    def test_auto_negotiates_binary_on_uniform_fleet(self, rng):
+        recs = synth_records(rng, n=8)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        lm = synth_model(rng)
+        servers = build_fleet(lm, ds, 2)
+        router = None
+        try:
+            router = build_router(servers, lm, wire="auto")
+            assert router.status()["wire"] == {
+                "requested": "auto", "negotiated": "binary",
+            }
+        finally:
+            close_fleet(servers, router)
+
+    def test_topology_advertises_protocols(self, rng):
+        recs = synth_records(rng, n=8)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        lm = synth_model(rng)
+        servers = build_fleet(lm, ds, 1)
+        try:
+            c = Client(servers[0].port)
+            topo = c.ask({"op": "topology", "uid": "t"})
+            assert topo["wire"]["protocols"] == ["json", "binary"]
+            assert topo["wire"]["version"] == wire.WIRE_VERSION
+            c.close()
+        finally:
+            close_fleet(servers)
+
+
+class TestRoutedParityBinary:
+    @pytest.mark.parametrize("n_shards", [1, 2, 4])
+    def test_binary_routed_bitwise_vs_json_and_batch(self, rng, n_shards):
+        """The acceptance bar: binary-wire routed margins are BITWISE
+        the JSON-wire router's AND the batch scorer's at N in
+        {1, 2, 4} shards."""
+        recs = synth_records(rng)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        lm = synth_model(rng)
+        ref = batch_reference_scores(lm, ds)
+        servers = build_fleet(lm, ds, n_shards)
+        r_bin = r_json = None
+        try:
+            r_bin = build_router(servers, lm, wire="binary")
+            r_json = build_router(servers, lm, wire="json")
+            assert r_bin.status()["wire"]["negotiated"] == "binary"
+            assert r_json.status()["wire"]["negotiated"] == "json"
+            got_b = [float(r_bin.score_record(r)) for r in recs]
+            got_j = [float(r_json.score_record(r)) for r in recs]
+            assert got_b == got_j, (
+                "binary and JSON data planes must agree bitwise"
+            )
+            assert np.array_equal(np.asarray(got_b, np.float32), ref)
+        finally:
+            close_fleet(servers)
+            for r in (r_bin, r_json):
+                if r is not None:
+                    r.close()
+
+
+# -- binary trace drain -------------------------------------------------------
+
+
+class TestBinaryTraceDrain:
+    def test_trace_op_over_binary_and_collector_merge_exact(self, front):
+        recs, ds, lm, metrics, fe = front
+        with tracing_scope(True):
+            tracer().clear()
+            collector = FleetCollector(
+                [("m0", "127.0.0.1", fe.port)],
+                poll_s=0.05,
+                wire="binary",
+            ).start()
+            jc = Client(fe.port)
+            try:
+                for r in recs[:6]:
+                    assert jc.ask(r)["status"] == "ok"
+            finally:
+                jc.close()
+            collector.stop(final_poll=True)
+            # cursor-keyed drain over MSG_TRACE_RESPONSE, by hand: the
+            # drained spans carry their float timestamps losslessly
+            bc = BinClient(fe.port)
+            try:
+                bc.send({"op": "trace", "cursor": 0, "uid": "t1"})
+                mtype, payload = bc.recv_frame()
+                assert mtype == wire.MSG_TRACE_RESPONSE
+                drained = wire.decode_message(mtype, payload)
+                assert drained["status"] == "ok"
+                assert drained["uid"] == "t1"
+                assert drained["dropped"] == 0
+                spans = drained["spans"]
+                assert spans, "trace drain must return the recorded spans"
+                for s in spans:
+                    assert isinstance(s["t0"], float)
+                    assert s["t1"] is None or isinstance(s["t1"], float)
+                    assert not (
+                        isinstance(s["t1"], float) and math.isnan(s["t1"])
+                    )
+                roots = [
+                    s for s in spans if s["name"] == "frontend.request"
+                ]
+                assert len(roots) == 6
+            finally:
+                bc.close()
+        # the live collector's merge is EXACT: every request root
+        # arrived, nothing dropped, no poll errors
+        status = collector.member_status()["m0"]
+        assert status["errors"] == 0
+        assert status["ring_dropped"] == 0
+        stitched = collector.stitched_spans()
+        assert len([
+            s for s in stitched if s["name"] == "frontend.request"
+        ]) == 6
+
+
+# -- the shard data plane, end to end over binary -----------------------------
+
+
+class TestShardDataPlane:
+    def test_partial_responses_ride_partial_frames(self, rng):
+        """A shard-server answers the router's score sub-requests with
+        MSG_PARTIAL_RESPONSE frames on a binary connection — the
+        vectorized codec, not a JSON fallback — and the payload equals
+        the JSON path's, double for double."""
+        recs = synth_records(rng)
+        ds = build_game_dataset(recs, SHARDS, ["userId"])
+        lm = synth_model(rng)
+        servers = build_fleet(lm, ds, 1)
+        try:
+            jc = Client(servers[0].port)
+            bc = BinClient(servers[0].port)
+            try:
+                jr = jc.ask(recs[0])
+                assert jr["status"] == "ok" and jr["partial"] is True
+                bc.send(recs[0], score=True)
+                mtype, payload = bc.recv_frame()
+                assert mtype == wire.MSG_PARTIAL_RESPONSE
+                br = wire.decode_message(mtype, payload)
+                assert br == jr
+            finally:
+                jc.close()
+                bc.close()
+        finally:
+            close_fleet(servers)
