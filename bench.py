@@ -697,7 +697,7 @@ def _run_tpu_test_tier():
         tests_dir = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "tests"
         )
-        for fn in os.listdir(tests_dir):
+        for fn in sorted(os.listdir(tests_dir)):
             if fn.endswith(".py"):
                 with open(os.path.join(tests_dir, fn)) as f:
                     src = f.read()
@@ -2251,7 +2251,7 @@ def _shard_routing_child(cfg_text):
     os.makedirs(out, exist_ok=True)
     atomic_write_json(
         os.path.join(out, "frontend.json"),
-        {"port": srv.port, "pid": os.getpid(), "shard": s, "count": n},
+        {"port": srv.port, "pid": os.getpid(), "shard": s, "count": n},  # photon: entropy(discovery artifact; pid names the live shard process)
     )
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
@@ -2315,7 +2315,15 @@ def _shard_routing_config(name, *, seed=0):
         key = (int(i), int(j) % shapes["payload_pool"], int(variant))
         rec = pool.get(key)
         if rec is None:
-            prng = np.random.default_rng(hash(key) & 0x7FFFFFFF)
+            import zlib
+
+            # crc32, not hash(): flood payloads must be identical
+            # across the parent and the relaunched child processes
+            # (PYTHONHASHSEED differs), or cache-hit accounting drifts
+            seed = zlib.crc32(
+                f"{key[0]}:{key[1]}:{key[2]}".encode("utf-8")
+            )
+            prng = np.random.default_rng(seed & 0x7FFFFFFF)
             rec = {
                 "uid": f"q{key[0]}-{key[1]}-{key[2]}",
                 "metadataMap": {"userId": ids[key[0]]},

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Twin-run determinism gate (chaos-style), runnable anywhere the
+# package runs: every shipped artifact class is produced TWICE in
+# fresh subprocesses under different PYTHONHASHSEEDs (0 vs 4242) and
+# perturbed TZs (UTC vs Pacific/Kiritimati), then byte-diffed. Any
+# divergence exits nonzero and names the first differing file + byte
+# offset. The matrix (photon_ml_tpu/testing/determinism_targets.py):
+#
+#   metrics_json      run-summary / metrics JSON family
+#   wire_frames       one frame per photon-wire message family
+#   registry_publish  manifest + content signature + COMMIT marker
+#   avro_container    Avro object container (deterministic sync marker)
+#   sharding_md       SPMD contract inventory renderer
+#   fleet_trace       merged fleet timeline
+#
+# This is the runtime twin of lint's determinism pass (PL015-PL018):
+# lint proves no unordered iteration / undeclared ambient entropy
+# reaches a writer; this gate proves the composed writers actually
+# emit identical bytes. The per-class results + runtimes land in
+# $OUT/determinism_gate.json for CI artifact upload.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${OUT:-/tmp/photon_determinism}"
+rm -rf "$OUT"
+mkdir -p "$OUT"
+
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m \
+    photon_ml_tpu.testing.determinism \
+    --matrix --out "$OUT" --report "$OUT/determinism_gate.json" "$@"

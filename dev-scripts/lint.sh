@@ -6,10 +6,16 @@
 #      PL009 lock-order-inversion, PL010 atomicity-hygiene) AND the
 #      whole-package SPMD pass (PL011 mesh-axis-discipline, PL012
 #      sharded-bank-host-gather, PL013 reduction-completeness, PL014
-#      donation-hygiene), both ON BY DEFAULT (opt out per-invocation
-#      with --no-concurrency / --no-spmd); rules and suppression/
-#      baseline mechanics in photon_ml_tpu/lint/. PL009 and PL012
-#      findings are never baseline-able.
+#      donation-hygiene) AND the whole-package determinism pass
+#      (PL015 unordered-iteration-to-artifact, PL016 ambient-entropy-
+#      in-artifact with the '# photon: entropy(<reason>)' declaration
+#      grammar, PL017 float-accumulation-order, PL018 wire-contract
+#      completeness), all ON BY DEFAULT (opt out per-invocation with
+#      --no-concurrency / --no-spmd / --no-determinism); rules and
+#      suppression/baseline mechanics in photon_ml_tpu/lint/. PL009,
+#      PL012, PL016 and PL018 findings are never baseline-able. The
+#      determinism pass's runtime twin is dev-scripts/determinism.sh
+#      (hash-seed twin-run byte-diff over every artifact class).
 #   2. SHARDING.md drift gate — the committed sharding-contract
 #      inventory must match a fresh render of the SPMD pass's entry-
 #      point scan (regenerate with --write-sharding-md). Skipped when

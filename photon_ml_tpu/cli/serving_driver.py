@@ -1422,7 +1422,7 @@ class ServingDriver:
         frontend.start()
         atomic_write_json(
             os.path.join(p.output_dir, "frontend.json"),
-            {
+            {  # photon: entropy(discovery artifact; pid names the live process for operators and chaos arms)
                 "host": p.frontend_host,
                 "port": frontend.port,
                 "pid": os.getpid(),

@@ -616,7 +616,7 @@ class PodRandomEffectProblem:
             self.mesh, self.spec_for(dataset), dataset.local_dim
         )
 
-    def pod_view(self, dataset: RandomEffectDataset) -> _PodView:
+    def pod_view(self, dataset: RandomEffectDataset) -> _PodView:  # photon: entropy(id-keyed device-view memo; weakref-pinned, never serialized)
         """The sharded device view, built once per dataset (weakref-keyed
         like the base problem's device caches)."""
         key = id(dataset)

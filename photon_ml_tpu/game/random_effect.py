@@ -606,7 +606,7 @@ class RandomEffectOptimizationProblem:
         # tables + jitted all_to_all scatter; weakref like _device_cache)
         self._router_cache: Dict[int, Tuple[object, object]] = {}
 
-    def _router_for(self, dataset):
+    def _router_for(self, dataset):  # photon: entropy(id-keyed router memo; weakref-pinned, never serialized)
         import weakref
 
         key = id(dataset)
@@ -663,7 +663,7 @@ class RandomEffectOptimizationProblem:
             floats += e_b * s_b * s_b
         return floats * itemsize <= self.dense_bytes_budget
 
-    def _bucket_device_args(self, bucket, with_values=True) -> List[Array]:
+    def _bucket_device_args(self, bucket, with_values=True) -> List[Array]:  # photon: entropy(id-keyed device-array memo; weakref-pinned, never serialized)
         """Device-resident (mesh-sharded if configured) static arrays for a
         bucket, transferred once and reused across update_bank calls. The
         cache holds a weakref: device copies die with the bucket.

@@ -44,6 +44,21 @@ id          slug                    protects
                                     claim replicated, only over sharded axes
 ``PL014``   donation-hygiene        donated arguments are dead after the
                                     donating call
+``PL015``   unordered-iteration-    set/listdir/glob iteration order never
+            to-artifact             reaches a serialization or digest sink
+                                    without ``sorted()``
+``PL016``   ambient-entropy-in-     clocks/pids/uuids/``hash()`` never reach
+            artifact                signatures, manifests, cache keys or wire
+                                    payloads undeclared
+                                    (``# photon: entropy(<reason>)``) — NEVER
+                                    baseline-able
+``PL017``   float-accumulation-     host-side ``sum()``/``fsum``/``np.sum``
+            order                   over unordered collections iterates a
+                                    declared canonical order
+``PL018``   wire-contract-          every ``MSG_*`` type has encoder, decoder,
+            completeness            dispatch and fuzz-corpus entry; every
+                                    ``WireError`` kind a frontend mapping —
+                                    NEVER baseline-able
 ==========  ======================  ===========================================
 
 PL008-PL010 are the concurrency pass (two-pass whole-package analysis:
@@ -53,8 +68,13 @@ runtime twin is the deterministic interleaving harness in
 (``lint/spmd.py``): axis-constant resolution, the mesh entry-point
 inventory behind the generated ``SHARDING.md``
 (``lint/sharding_contracts.py``), sharded-bank taint and per-body
-reduction dataflow. Opt out per-invocation with ``--no-concurrency`` /
-``--no-spmd``.
+reduction dataflow. PL015-PL018 are the determinism pass
+(``lint/determinism.py``): unordered/entropy taint into artifact sinks,
+the ``# photon: entropy(<reason>)`` declaration grammar, and the
+machine-built wire-message inventory; their runtime twin is the
+hash-seed twin-run harness in ``photon_ml_tpu/testing/determinism.py``.
+Opt out per-invocation with ``--no-concurrency`` / ``--no-spmd`` /
+``--no-determinism``.
 
 Usage::
 

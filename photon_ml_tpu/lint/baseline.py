@@ -22,8 +22,11 @@ BASELINE_VERSION = 1
 # gather of a sharded bank (PL012) silently un-shards the pod story on
 # exactly the paths that only fail at fleet scale — baselining either
 # ships the failure; write_baseline refuses and load_baseline rejects
-# hand-edited entries.
-NEVER_BASELINE = frozenset({"PL009", "PL012"})
+# hand-edited entries. Ambient entropy in an artifact (PL016) rots the
+# very signatures the bitwise gates compare, and a half-wired message
+# type (PL018) is a protocol hole — both have declaration/contract
+# mechanisms instead of grandfathering.
+NEVER_BASELINE = frozenset({"PL009", "PL012", "PL016", "PL018"})
 
 _NEVER_BASELINE_WHY = {
     "PL009": "lock-order inversions are never baseline-able; fix the "
@@ -31,6 +34,11 @@ _NEVER_BASELINE_WHY = {
     "PL012": "sharded-bank host gathers are never baseline-able; make "
              "the access shard-local or declare a sharding(export) "
              "scope instead",
+    "PL016": "ambient entropy in artifacts is never baseline-able; "
+             "derive the value from content or declare it with "
+             "'# photon: entropy(<reason>)' instead",
+    "PL018": "wire-contract holes are never baseline-able; wire the "
+             "missing encoder/decoder/dispatch/corpus leg instead",
 }
 
 Key = Tuple[str, str, str]

@@ -37,11 +37,14 @@ def build_parser() -> argparse.ArgumentParser:
             "the thread plane (guard discipline, lock ordering, "
             "atomicity) and the SPMD plane (mesh-axis discipline, "
             "sharded-bank host gathers, reduction completeness, "
-            "donation hygiene) — both whole-package passes on by "
-            "default. Suppress a line with '# photon: allow(<rule>)'; "
-            "declare guard discipline with "
-            "'# photon: guarded-by(<lock>)' and sharding contracts "
-            "with '# photon: sharding(axes=..., in=..., out=...)'."
+            "donation hygiene) and the determinism plane (unordered "
+            "iteration into artifacts, ambient entropy in signatures, "
+            "float accumulation order, wire-contract completeness) — "
+            "all whole-package passes on by default. Suppress a line "
+            "with '# photon: allow(<rule>)'; declare guard discipline "
+            "with '# photon: guarded-by(<lock>)', sharding contracts "
+            "with '# photon: sharding(axes=..., in=..., out=...)' and "
+            "legitimate entropy with '# photon: entropy(<reason>)'."
         ),
     )
     p.add_argument(
@@ -79,6 +82,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-spmd", action="store_true",
         help="skip the whole-package SPMD pass (PL011-PL014 + sharding "
              "contracts); the pass runs by default",
+    )
+    p.add_argument(
+        "--no-determinism", action="store_true",
+        help="skip the whole-package determinism pass (PL015-PL018 + "
+             "entropy declarations + wire contract); the pass runs by "
+             "default",
     )
     p.add_argument(
         "--write-sharding-md", nargs="?", const="SHARDING.md",
@@ -137,6 +146,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         paths,
         package_pass=not args.no_concurrency,
         spmd_pass=not args.no_spmd,
+        determinism_pass=not args.no_determinism,
     )
 
     baseline_path = args.baseline or (
@@ -191,6 +201,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
             payload["sharding_contracts"] = sc.inventory(report.package)
             payload["export_scopes"] = sc.export_scopes(report.package)
+        if report.package is not None and not args.no_determinism:
+            from photon_ml_tpu.lint import determinism
+
+            contract = determinism.wire_contract(report.package)
+            payload["wire_contract"] = (
+                contract.to_dict() if contract is not None else None
+            )
+            payload["entropy_declarations"] = (
+                determinism.entropy_inventory(report.package)
+            )
         print(json.dumps(payload, indent=2))
         return exit_code
 

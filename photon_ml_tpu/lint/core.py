@@ -135,6 +135,18 @@ _GUARDED_RE = re.compile(
 # cross-checks against the code — never suppressions.
 _SHARDING_RE = re.compile(r"#\s*photon:\s*sharding\(([^)]*)\)")
 
+# The entropy declaration (determinism pass, PL016):
+#   def snapshot(self):  # photon: entropy(live wall-clock timestamp)
+#   _PROC_NONCE = ...    # photon: entropy(per-boot trace-id nonce)
+# on the def line of a function (or on a module-level statement)
+# declares that ambient entropy — wall clocks, pids, uuids, hash
+# randomization, object identity — reaching an artifact, digest, cache
+# key or wire payload in that scope is INTENTIONAL, and names why.
+# Like guarded-by and sharding(), this is an enforced claim, never a
+# suppression: a declaration whose scope mints no entropy that reaches
+# a sink is itself a violation (stale declaration).
+_ENTROPY_RE = re.compile(r"#\s*photon:\s*entropy\(([^)]*)\)")
+
 
 @dataclass
 class AllowSite:
@@ -177,7 +189,7 @@ class FileContext:
     links, enclosing scopes, import aliases, suppressions, and a local
     (per-scope) jax-value taint."""
 
-    def __init__(self, path: str, source: str):
+    def __init__(self, path: str, source: str):  # photon: entropy(id-keyed AST parent links; in-memory analysis index, never serialized)
         self.path = norm_path(path)
         self.source = source
         self.lines = source.splitlines()
@@ -192,6 +204,8 @@ class FileContext:
         self.guard_annotations: Dict[int, str] = {}
         # line -> raw arg string from '# photon: sharding(<args>)'
         self.sharding_annotations: Dict[int, str] = {}
+        # line -> reason string from '# photon: entropy(<reason>)'
+        self.entropy_annotations: Dict[int, str] = {}
         self._scan_comments()
         # import aliases
         self.jax_modules: Set[str] = set()  # names aliasing jax[. ...]
@@ -204,7 +218,7 @@ class FileContext:
 
     # -- structure ----------------------------------------------------------
 
-    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:  # photon: entropy(id-keyed AST parent lookup; in-memory only)
         return self._parents.get(id(node))
 
     def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
@@ -329,6 +343,11 @@ class FileContext:
             sh = _SHARDING_RE.search(tok.string)
             if sh:
                 self.sharding_annotations[tok.start[0]] = sh.group(1)
+            # anchored: the comment must BE the declaration — prose that
+            # merely mentions the grammar is not a claim
+            en = _ENTROPY_RE.match(tok.string)
+            if en:
+                self.entropy_annotations[tok.start[0]] = en.group(1).strip()
             m = _ALLOW_RE.search(tok.string)
             if not m:
                 continue
@@ -364,7 +383,7 @@ class FileContext:
 
     # -- local jax-value taint ----------------------------------------------
 
-    def jax_taint(
+    def jax_taint(  # photon: entropy(id-keyed per-scope taint memo; in-memory only)
         self, scope: ast.AST, include_params: bool = False,
         exclude_params: Sequence[str] = (),
     ) -> Set[str]:
@@ -1301,13 +1320,15 @@ class Report:
 
 
 def _package_groups(
-    package_pass: bool, spmd_pass: bool,
+    package_pass: bool, spmd_pass: bool, determinism_pass: bool = True,
 ) -> Set[str]:
     groups: Set[str] = set()
     if package_pass:
         groups.add("concurrency")
     if spmd_pass:
         groups.add("spmd")
+    if determinism_pass:
+        groups.add("determinism")
     return groups
 
 
@@ -1333,7 +1354,7 @@ def _run_package_rules(
 
 def analyze_source(
     path: str, source: str, package_pass: bool = True,
-    spmd_pass: bool = True,
+    spmd_pass: bool = True, determinism_pass: bool = True,
 ) -> Report:
     """Run every registered rule over one in-memory source blob (the
     package pass runs degenerately over the single file)."""
@@ -1349,7 +1370,8 @@ def analyze_source(
             if not ctx.suppressed(v):
                 report.violations.append(v)
     report.package = _run_package_rules(
-        report, [ctx], _package_groups(package_pass, spmd_pass)
+        report, [ctx],
+        _package_groups(package_pass, spmd_pass, determinism_pass),
     )
     report.allow_sites.extend(ctx.allow_sites)
     report.violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
@@ -1358,7 +1380,7 @@ def analyze_source(
 
 def analyze_paths(
     paths: Sequence[str], package_pass: bool = True,
-    spmd_pass: bool = True,
+    spmd_pass: bool = True, determinism_pass: bool = True,
 ) -> Report:
     _load_rules()
     report = Report()
@@ -1383,7 +1405,8 @@ def analyze_paths(
         report.allow_sites.extend(ctx.allow_sites)
         contexts.append(ctx)
     report.package = _run_package_rules(
-        report, contexts, _package_groups(package_pass, spmd_pass)
+        report, contexts,
+        _package_groups(package_pass, spmd_pass, determinism_pass),
     )
     report.violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return report

@@ -1,8 +1,11 @@
 """Rule modules — importing this package registers every rule."""
 
 from photon_ml_tpu.lint.rules import (  # noqa: F401
+    artifact_order,
     atomicity,
     donation,
+    entropy,
+    float_order,
     host_gather,
     host_sync,
     io_drain,
@@ -15,4 +18,5 @@ from photon_ml_tpu.lint.rules import (  # noqa: F401
     shared_state,
     spill,
     tracer_leak,
+    wire_contract,
 )

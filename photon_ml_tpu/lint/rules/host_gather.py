@@ -119,7 +119,7 @@ class _FileTaint:
                 return anc.name == _BANK_CLASS
         return False
 
-    def scope_taint(self, scope: ast.AST) -> Set[str]:
+    def scope_taint(self, scope: ast.AST) -> Set[str]:  # photon: entropy(id-keyed per-scope env memo; in-memory only)
         key = id(scope)
         cached = self._env_cache.get(key) if hasattr(self, "_env_cache") \
             else None

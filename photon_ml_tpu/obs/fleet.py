@@ -976,7 +976,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ok = verification["ok"] and (
         conservation is None or conservation["ok"]
     )
-    print(json.dumps({
+    print(json.dumps({  # photon: entropy(operator-facing merge report; carries live merge wall-time by design)
         "fleet_trace": trace_out,
         "events": n_events,
         "members": len(dumps),

@@ -200,7 +200,7 @@ class FlightRecorder:
         with self._lock:
             self._auto_dump_path = path
 
-    def snapshot(self) -> Dict[str, object]:
+    def snapshot(self) -> Dict[str, object]:  # photon: entropy(live telemetry snapshot; pid attributes the dump to its process)
         with self._lock:
             return {
                 "pid": os.getpid(),

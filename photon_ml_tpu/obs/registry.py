@@ -240,7 +240,7 @@ class MetricsRegistry:
         with self._lock:
             return list(self._instruments.values()), dict(self._views)
 
-    def snapshot(self) -> Dict[str, object]:
+    def snapshot(self) -> Dict[str, object]:  # photon: entropy(live metrics surface; ts is the scrape timestamp by contract)
         """The live merged surface: registry instruments + every view.
         A failing view reports its error in place — one wedged
         subsystem must not take down the metrics op."""

@@ -92,8 +92,8 @@ def _env_int(name: str, default: int) -> int:
 # collector's NTP-style skew estimation measures a remote process's
 # "now" through THIS mapping (see epoch_now), so the offset it derives
 # corrects exactly the timeline the spans are exported on.
-_EPOCH_WALL = time.time()
-_EPOCH_PERF = time.perf_counter()
+_EPOCH_WALL = time.time()  # photon: entropy(per-boot span-epoch anchor; the wall/perf pair IS the timeline contract)
+_EPOCH_PERF = time.perf_counter()  # photon: entropy(per-boot span-epoch anchor; paired with _EPOCH_WALL)
 
 
 def epoch() -> tuple:
@@ -115,9 +115,9 @@ def epoch_now() -> float:
 # across boxes) fleet whose spans are merged into one timeline.
 _TRACE_IDS = itertools.count(1)
 _SPAN_IDS = itertools.count(1)
-_PROC_NONCE = os.urandom(3).hex()
-_TRACE_PREFIX = f"t{os.getpid():x}.{_PROC_NONCE}-"
-_SPAN_PREFIX = f"s{os.getpid():x}.{_PROC_NONCE}-"
+_PROC_NONCE = os.urandom(3).hex()  # photon: entropy(boot nonce; id uniqueness across hosts REQUIRES per-process randomness)
+_TRACE_PREFIX = f"t{os.getpid():x}.{_PROC_NONCE}-"  # photon: entropy(pid+nonce id prefix; cross-process uniqueness, not content)
+_SPAN_PREFIX = f"s{os.getpid():x}.{_PROC_NONCE}-"  # photon: entropy(pid+nonce id prefix; cross-process uniqueness, not content)
 
 # Enablement is a single module global: the disabled fast path is one
 # read + branch. set_tracing is the only writer (driver startup / test
@@ -581,7 +581,7 @@ def chrome_trace_events(spans: Iterable[Span]) -> List[Dict[str, object]]:
     return out
 
 
-def export_chrome_trace(
+def export_chrome_trace(  # photon: entropy(trace artifact; pid + boot epoch attribute the timeline to its process by design)
     path: str,
     spans: Optional[Iterable[Span]] = None,
     *,

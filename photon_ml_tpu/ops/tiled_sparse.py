@@ -1183,7 +1183,7 @@ _TILED_CACHE = _ScheduleLRU(_TILED_CACHE_MAX)
 _SHARDED_CACHE = _ScheduleLRU(_SHARDED_CACHE_MAX)
 
 
-def ensure_tiled(
+def ensure_tiled(  # photon: entropy(id-keyed tiling memo; weakref-pinned, never serialized)
     batch,
     dim: int,
     *,
@@ -1226,7 +1226,7 @@ def ensure_tiled(
 
 
 # photon: sharding(axes=[data], in=?, out=[data])
-def ensure_tiled_sharded(
+def ensure_tiled_sharded(  # photon: entropy(id-keyed tiling memo; weakref-pinned, never serialized)
     batch,
     dim: int,
     mesh,

@@ -69,7 +69,7 @@ class GLMOptimizationProblem:
             return None
         return jnp.ones((self.objective.dim,)).at[self.intercept_index].set(0.0)
 
-    def _get_fit(self, track_models: bool, mesh=None, axis: str = ""):
+    def _get_fit(self, track_models: bool, mesh=None, axis: str = ""):  # photon: entropy(id(mesh)-keyed jit-program memo; in-memory only)
         """Jitted fit program (optionally shard_mapped over ``mesh``),
         cached so repeat `run` calls skip re-tracing the optimizer
         while_loop.
@@ -153,7 +153,7 @@ class GLMOptimizationProblem:
         cache[key] = (fit, mesh)
         return fit
 
-    def _get_grid_fit(self, track_models: bool, mesh=None, axis: str = ""):
+    def _get_grid_fit(self, track_models: bool, mesh=None, axis: str = ""):  # photon: entropy(id(mesh)-keyed jit-program memo; in-memory only)
         """Jitted GRID fit: ``fit(w0_bank, batch, l1_vec, l2_vec)`` runs
         ``vmap(minimize_lbfgs/owlqn/tron)`` over a [G, d] coefficient bank
         — the whole λ grid as ONE XLA program (1 compile, 1 optimizer

@@ -213,7 +213,7 @@ class _Lease:
             return True
         return True
 
-    def _try_create(self) -> bool:
+    def _try_create(self) -> bool:  # photon: entropy(lease identity payload; pid+host name the holder, uniqueness is the point)
         import socket
 
         payload = json.dumps({

@@ -185,11 +185,16 @@ def _bypassed() -> bool:
 
 def _backoff_s(policy: RetryPolicy, seam: str, attempt: int) -> float:
     import random
+    import zlib
 
     delay = min(
         policy.base_delay_s * (2.0 ** (attempt - 1)), policy.max_delay_s
     )
-    u = random.Random(hash((seam, attempt))).random()
+    # crc32, not hash(): the builtin is PYTHONHASHSEED-randomized, so
+    # the per-(seam, attempt) jitter schedule — which tests and reruns
+    # rely on being reproducible — would differ per process
+    seed = zlib.crc32(f"{seam}:{attempt}".encode("utf-8"))
+    u = random.Random(seed).random()
     return delay * (1.0 + policy.jitter * u)
 
 

@@ -741,7 +741,7 @@ class ServingFrontend:
             "metrics": payload,
         }
 
-    def trace_response(self, obj: Dict) -> Dict[str, object]:
+    def trace_response(self, obj: Dict) -> Dict[str, object]:  # photon: entropy(live trace-poll payload; epoch/now mapping is the protocol)
         """The ``{"op": "trace"}`` payload: the process tracer's spans
         AFTER the caller's cursor (contiguous seq run; evictions since
         the last poll are counted in ``dropped``), the process's

@@ -481,6 +481,19 @@ class TcpShardTransport:
                 self._fail_all(e)
                 return
             for mtype, payload in frames:
+                # response-side dispatch: only response frame types may
+                # resolve pending futures. Without this allowlist a
+                # request-type frame (MSG_SCORE_REQUEST) carrying a uid
+                # would decode fine and complete a caller's future with
+                # a request echo — protocol confusion, not an error.
+                if mtype not in (
+                    wirefmt.MSG_JSON,
+                    wirefmt.MSG_SCORE_RESPONSE,
+                    wirefmt.MSG_PARTIAL_RESPONSE,
+                    wirefmt.MSG_TRACE_RESPONSE,
+                ):
+                    self.unmatched_responses += 1
+                    continue
                 try:
                     resp = wirefmt.decode_message(mtype, payload)
                 except wirefmt.WireError:

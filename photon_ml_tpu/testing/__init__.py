@@ -1,9 +1,19 @@
-"""Test-support runtime: the deterministic interleaving harness.
+"""Test-support runtime: the deterministic interleaving harness and the
+hash-seed twin-run reproducibility harness.
 
 Importable from production-adjacent test code and dev-scripts; never
 imported by the serving/registry modules themselves.
 """
 
+from photon_ml_tpu.testing.determinism import (
+    TwinRunError,
+    TwinRunResult,
+    byte_diff_trees,
+    run_matrix,
+    run_target,
+    stable_seed,
+    twin_run,
+)
 from photon_ml_tpu.testing.interleave import (
     DeadlockError,
     InterleaveScheduler,
@@ -15,5 +25,12 @@ __all__ = [
     "DeadlockError",
     "InterleaveScheduler",
     "StepBudgetExceeded",
+    "TwinRunError",
+    "TwinRunResult",
+    "byte_diff_trees",
     "explore",
+    "run_matrix",
+    "run_target",
+    "stable_seed",
+    "twin_run",
 ]

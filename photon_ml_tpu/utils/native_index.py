@@ -268,7 +268,7 @@ def build_partitioned_index(
 
     os.makedirs(directory, exist_ok=True)
     parts: List[List[str]] = [[] for _ in range(num_partitions)]
-    for key in set(keys):
+    for key in sorted(set(keys)):
         parts[zlib.crc32(key.encode("utf-8")) % num_partitions].append(key)
     for p, part_keys in enumerate(parts):
         build_store(

@@ -1,0 +1,41 @@
+"""PL015 negative: the same writer shapes with the order pinned."""
+
+import json
+import os
+
+from photon_ml_tpu.reliability import atomic_write_json
+
+
+def dump_feature_names(path, names):
+    uniq = set(names)
+    atomic_write_json(path, {"features": sorted(uniq)})
+
+
+def dump_listing(root):
+    files = sorted(os.listdir(root))
+    return json.dumps({"files": files})
+
+
+def dump_union(path, a, b):
+    merged = set(a).union(b)
+    return json.dumps(sorted(merged))
+
+
+def write_parts(path, parts):
+    lines = []
+    for p in sorted(set(parts)):
+        lines.append(str(p))
+    atomic_write_json(path, lines)
+
+
+def count_only(path, parts):
+    # order-erasing reductions are fine: the set never orders bytes
+    atomic_write_json(path, {"n": len(set(parts))})
+
+
+def membership_walk(parts):
+    # iterating a set in a scope that writes NOTHING is not a finding
+    total = 0
+    for p in set(parts):
+        total += 1
+    return total

@@ -1,0 +1,28 @@
+"""PL015 positive: unordered iteration order reaching artifact bytes."""
+
+import json
+import os
+
+from photon_ml_tpu.reliability import atomic_write_json
+
+
+def dump_feature_names(path, names):
+    uniq = set(names)
+    atomic_write_json(path, {"features": [n for n in uniq]})
+
+
+def dump_listing(root):
+    files = os.listdir(root)
+    return json.dumps({"files": files})
+
+
+def dump_union(path, a, b):
+    merged = set(a).union(b)
+    return json.dumps(list(merged))
+
+
+def write_parts(path, parts):
+    lines = []
+    for p in set(parts):
+        lines.append(str(p))
+    atomic_write_json(path, lines)

@@ -1,0 +1,41 @@
+"""PL016 negative: declared entropy, durations, decisions, content-
+derived seeds and hash-probe keys are all clean."""
+
+import json
+import os
+import random
+import time
+import zlib
+
+from photon_ml_tpu.reliability import atomic_write_json
+
+_CACHE = {}
+
+
+def write_discovery(path):
+    atomic_write_json(path, {"pid": os.getpid()})  # photon: entropy(discovery artifact; pid names the live process)
+
+
+def write_lease(path):  # photon: entropy(lease identity payload; uniqueness is the point)
+    atomic_write_json(path, {"pid": os.getpid(), "token": "t"})
+
+
+def elapsed(path, t0):
+    # clock MINUS clock is a duration — content, not entropy
+    dt = time.perf_counter() - t0
+    return json.dumps({"elapsed_s": dt})
+
+
+def expired(deadline):
+    # a clock COMPARISON yields a decision, not entropy content
+    return time.monotonic() >= deadline
+
+
+def lookup_by_content(key):
+    # builtin hash() as a hashability probe / dict key is the dict's
+    # own business — only PYTHONHASHSEED-exposed ARTIFACTS are findings
+    return _CACHE.get(hash(key))
+
+
+def stable_draw(name):
+    return random.Random(zlib.crc32(name.encode("utf-8"))).random()

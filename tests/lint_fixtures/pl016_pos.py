@@ -1,0 +1,45 @@
+"""PL016 positive: ambient entropy reaching artifacts, cache keys and
+seeds — plus one stale and one reasonless declaration."""
+
+import json
+import os
+import random
+import socket
+import time
+
+from photon_ml_tpu.reliability import atomic_write_json
+
+_CACHE = {}
+
+
+def write_summary(path):
+    atomic_write_json(path, {"pid": os.getpid()})
+
+
+def render_status():
+    return json.dumps({"ts": time.time()})
+
+
+def seeded_draw():
+    return random.Random(time.time()).random()
+
+
+def lookup(obj):
+    return _CACHE.get(id(obj))
+
+
+def store(obj, value):
+    _CACHE[id(obj)] = value
+
+
+def describe():
+    return {"host": socket.gethostname()}
+
+
+def stale_claim(path, payload):
+    # photon: entropy(this line consumes nothing)
+    atomic_write_json(path, payload)
+
+
+def reasonless(path):  # photon: entropy()
+    atomic_write_json(path, {"pid": os.getpid()})

@@ -1,0 +1,24 @@
+"""PL017 negative: accumulation with the order pinned (or over ordered
+containers to begin with)."""
+
+import math
+
+import numpy as np
+
+
+def total_weight(weights):
+    vals = set(weights)
+    return sum(sorted(vals))
+
+
+def exact_total(weights):
+    vals = frozenset(weights)
+    return math.fsum(sorted(vals))
+
+
+def np_total(bucket_values):
+    return np.sum(np.asarray(bucket_values))
+
+
+def list_total(values):
+    return sum(values)

@@ -1,0 +1,21 @@
+"""PL017 positive: order-dependent float accumulation over unordered
+iterables."""
+
+import math
+
+import numpy as np
+
+
+def total_weight(weights):
+    vals = set(weights)
+    return sum(vals)
+
+
+def exact_total(weights):
+    vals = frozenset(weights)
+    return math.fsum(vals)
+
+
+def np_total(bucket_values):
+    bucket = set(bucket_values)
+    return np.sum([x for x in bucket])

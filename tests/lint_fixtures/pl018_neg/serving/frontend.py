@@ -1,0 +1,19 @@
+"""The pl018_neg frontend: every wire type routed, every error kind
+named."""
+
+
+def route(mtype, wire):
+    if mtype == wire.MSG_JSON:
+        return "json"
+    if mtype == wire.MSG_SCORE:
+        return "score"
+    return "refused"
+
+
+def classify(err):
+    kind = getattr(err, "kind", "")
+    if kind == "malformed":
+        return "BAD_REQUEST"
+    if kind == "oversized":
+        return "PAYLOAD_TOO_LARGE"
+    return "ERROR"

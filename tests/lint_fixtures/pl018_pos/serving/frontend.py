@@ -1,0 +1,18 @@
+"""The pl018_pos frontend: routes everything EXCEPT the orphan type
+and maps only the 'malformed' error kind."""
+
+
+def route(mtype, wire):
+    if mtype == wire.MSG_JSON:
+        return "json"
+    if mtype == wire.MSG_SCORE:
+        return "score"
+    if mtype == wire.MSG_DUP:
+        return "dup"
+    return "refused"
+
+
+def classify(err):
+    if getattr(err, "kind", "") == "malformed":
+        return "BAD_REQUEST"
+    return "ERROR"

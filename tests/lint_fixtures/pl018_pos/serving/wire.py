@@ -1,0 +1,44 @@
+"""PL018 positive: a duplicate wire value, an orphan message type with
+no encoder/decoder/dispatch, and an unmapped WireError kind."""
+
+MAGIC = 0xF7
+MSG_JSON = 0x01
+MSG_SCORE = 0x02
+MSG_DUP = 0x02
+MSG_ORPHAN = 0x03
+
+
+class WireError(ValueError):
+    def __init__(self, message, *, kind="malformed"):
+        super().__init__(message)
+        self.kind = kind
+
+
+def append_frame(buf, msg_type, *parts):
+    buf.append(msg_type)
+    for p in parts:
+        buf.extend(p)
+
+
+def append_json(buf, obj):
+    append_frame(buf, MSG_JSON, b"{}")
+
+
+def append_score(buf):
+    append_frame(buf, MSG_SCORE, b"")
+
+
+def append_dup(buf):
+    append_frame(buf, MSG_DUP, b"")
+
+
+def decode_message(msg_type, payload):
+    if len(payload) > 1 << 20:
+        raise WireError("frame too large", kind="oversized")
+    if msg_type == MSG_JSON:
+        return {}
+    if msg_type == MSG_SCORE:
+        return {}
+    if msg_type == MSG_DUP:
+        return {}
+    raise WireError("unknown message type")

@@ -1,0 +1,171 @@
+"""Twin-run determinism harness tests (ISSUE 19 satellite 4).
+
+Three layers:
+
+* unit — ``stable_seed``, ``byte_diff_trees``, ``run_target`` dispatch,
+  ``_child_env`` hygiene;
+* positive control — the harness MUST catch the intentionally
+  hash-order-dependent writer (``control_hash_order``). A twin run that
+  reports it byte-identical means the harness itself is broken;
+* regression — the real PL016 defect this round fixed (hash()-seeded
+  retry jitter) stays fixed ACROSS interpreters: two children under
+  different ``PYTHONHASHSEED`` values must draw the same backoff.
+
+The full six-class gate matrix lives in ``dev-scripts/determinism.sh``;
+one representative gate class (the wire-frame family) is twin-run here
+so the tier-1 suite exercises the subprocess plumbing end to end.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from photon_ml_tpu.testing import determinism as det
+from photon_ml_tpu.testing import determinism_targets as dt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestUnits:
+    def test_stable_seed_is_process_stable_and_distinct(self):
+        # crc32 of the joined text: same parts -> same seed, every
+        # process, every PYTHONHASHSEED
+        import zlib
+
+        s = det.stable_seed("seam", 3)
+        assert s == zlib.crc32(b"seam:3")
+        assert det.stable_seed("seam", 3) == s
+        assert det.stable_seed("seam", 4) != s
+
+    def test_byte_diff_trees_identical(self, tmp_path):
+        for run in ("a", "b"):
+            d = tmp_path / run / "sub"
+            d.mkdir(parents=True)
+            (d / "x.json").write_bytes(b'{"k": 1}')
+            (tmp_path / run / "y.bin").write_bytes(b"\x00\x01")
+        assert det.byte_diff_trees(
+            str(tmp_path / "a"), str(tmp_path / "b")
+        ) is None
+
+    def test_byte_diff_trees_names_file_and_offset(self, tmp_path):
+        for run, tail in (("a", b"AB"), ("b", b"AC")):
+            d = tmp_path / run
+            d.mkdir()
+            (d / "same.bin").write_bytes(b"equal")
+            (d / "diff.bin").write_bytes(b"xx" + tail)
+        msg = det.byte_diff_trees(str(tmp_path / "a"), str(tmp_path / "b"))
+        assert msg == (
+            "diff.bin: first byte divergence at offset 3 (4 vs 4 bytes)"
+        ), msg
+
+    def test_byte_diff_trees_missing_file(self, tmp_path):
+        (tmp_path / "a").mkdir()
+        (tmp_path / "b").mkdir()
+        (tmp_path / "a" / "only.txt").write_bytes(b"x")
+        msg = det.byte_diff_trees(str(tmp_path / "a"), str(tmp_path / "b"))
+        assert msg == "only.txt: present only in the first run", msg
+        msg = det.byte_diff_trees(str(tmp_path / "b"), str(tmp_path / "a"))
+        assert msg == "only.txt: present only in the second run", msg
+
+    def test_run_target_unknown_name(self, tmp_path):
+        with pytest.raises(KeyError, match="unknown determinism target"):
+            det.run_target("no_such_artifact", str(tmp_path))
+
+    def test_child_env_hygiene(self):
+        # builds the child environment without mutating the parent's
+        before = dict(os.environ)
+        env = det._child_env("4242", "Pacific/Kiritimati")
+        assert dict(os.environ) == before
+        assert env["PYTHONHASHSEED"] == "4242"
+        assert env["TZ"] == "Pacific/Kiritimati"
+        assert det._REPO_ROOT in env["PYTHONPATH"].split(os.pathsep)
+
+    def test_gate_matrix_excludes_the_control(self):
+        # the positive control must never ride in the gate set: it is
+        # built to diverge, and the gate exits nonzero on divergence
+        assert "control_hash_order" not in dt.TARGETS
+        assert "control_hash_order" in dt.ALL_TARGETS
+        assert set(dt.ALL_TARGETS) == set(dt.TARGETS) | set(
+            dt.CONTROL_TARGETS
+        )
+
+    def test_twin_run_surfaces_child_failure(self, tmp_path):
+        # a crashing child is a TwinRunError (harness defect), never a
+        # quiet "identical" verdict over two empty trees
+        with pytest.raises(det.TwinRunError, match="no_such_artifact"):
+            det.twin_run("no_such_artifact", base_dir=str(tmp_path))
+
+
+class TestPositiveControl:
+    def test_harness_catches_hash_order_dependent_writer(self, tmp_path):
+        res = det.twin_run("control_hash_order", base_dir=str(tmp_path))
+        assert res.identical is False
+        assert res.divergence is not None
+        assert res.divergence.startswith("control.txt:"), res.divergence
+        # and the result serializes for the gate report
+        d = res.to_dict()
+        assert d["target"] == "control_hash_order"
+        assert d["identical"] is False
+
+
+class TestGateClasses:
+    @pytest.mark.slow
+    def test_wire_frames_twin_run_is_byte_identical(self, tmp_path):
+        res = det.twin_run("wire_frames", base_dir=str(tmp_path))
+        assert res.identical, res.divergence
+        frames = os.path.join(
+            str(tmp_path), "wire_frames.run0", "frames.bin"
+        )
+        assert os.path.getsize(frames) > 0
+
+    @pytest.mark.slow
+    def test_full_matrix_is_byte_identical(self, tmp_path):
+        report = det.run_matrix(
+            str(tmp_path),
+            report_path=str(tmp_path / "gate.json"),
+        )
+        assert report["ok"] is True, report
+        assert sorted(report["classes"]) == sorted(dt.TARGETS)
+        assert os.path.exists(tmp_path / "gate.json")
+
+
+class TestSeedRegressions:
+    def _child_eval(self, code: str, seed: str) -> str:
+        env = det._child_env(seed, "UTC")
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env,
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        return proc.stdout.strip()
+
+    def test_fixed_seeds_identical_across_hash_seeds(self):
+        """The real defects PL016 caught, re-run across interpreters:
+        the retry plane's backoff jitter (was hash((seam, attempt))-
+        seeded — differed per process) and bench's flood-payload PRNG
+        (was hash(key)-seeded — parent vs relaunched child built
+        different payloads, drifting cache-hit accounting). Both crc32
+        fixes must draw identically in two children with different
+        PYTHONHASHSEEDs. One child pair covers both fixes."""
+        code = (
+            "import zlib, numpy as np\n"
+            "from photon_ml_tpu.reliability.retry import "
+            "RetryPolicy, _backoff_s\n"
+            "p = RetryPolicy()\n"
+            "print([round(_backoff_s(p, 'chunk_read', a), 12) "
+            "for a in (1, 2, 3)])\n"
+            "key = ('warm', 3, 128)\n"
+            "seed = zlib.crc32("
+            "f'{key[0]}:{key[1]}:{key[2]}'.encode('utf-8'))\n"
+            "prng = np.random.default_rng(seed & 0x7FFFFFFF)\n"
+            "print(prng.integers(0, 2**31, size=8).tolist())"
+        )
+        a = self._child_eval(code, "0")
+        b = self._child_eval(code, "4242")
+        assert a == b, (a, b)

@@ -530,6 +530,195 @@ class TestLintClean:
             f.endswith("testing/interleave.py") for f in files
         ), files
 
+    def test_determinism_rules_land_at_zero(self, full_report):
+        """ISSUE 19: PL015-PL018 ship with ZERO baseline entries
+        package-wide and ZERO allow() sites anywhere — artifact-order
+        and entropy discipline is structural, expressed through fixes
+        and '# photon: entropy(<reason>)' declarations, never through
+        suppressions. PL016/PL018 additionally can never GAIN a
+        baseline entry (write/load both refuse)."""
+        from photon_ml_tpu.lint import all_rules
+
+        rules = all_rules()
+        for rid in ("PL015", "PL016", "PL017", "PL018"):
+            assert rid in rules, sorted(rules)
+        entries = [
+            e for e in json.load(open(BASELINE))["entries"]
+            if e["rule"] in ("PL015", "PL016", "PL017", "PL018")
+        ]
+        assert entries == [], entries
+        slugs = {
+            "PL015", "unordered-iteration-to-artifact",
+            "PL016", "ambient-entropy-in-artifact",
+            "PL017", "float-accumulation-order",
+            "PL018", "wire-contract-completeness",
+        }
+        allows = [
+            s for s in full_report.allow_sites if s.rules & slugs
+        ]
+        assert allows == [], allows
+
+    def test_stripping_an_entropy_declaration_resurfaces_pl016(self):
+        """The declaration grammar is enforced, not decorative:
+        removing the span-epoch declaration from the tracer makes its
+        epoch exports PL016 violations again."""
+        path = "photon_ml_tpu/obs/trace.py"
+        src = open(path).read()
+        decl = ("  # photon: entropy(per-boot span-epoch anchor; "
+                "the wall/perf pair IS the timeline contract)")
+        assert decl in src, "trace epoch declaration changed; update me"
+        clean = analyze_source(path, src)
+        assert not [v for v in clean.violations if v.rule == "PL016"], \
+            _fmt(clean.violations)
+        dirty = analyze_source(path, src.replace(decl, ""))
+        assert [
+            v for v in dirty.violations
+            if v.rule == "PL016" and "time.time()" in v.message
+        ], _fmt(dirty.violations)
+
+    def test_reverting_retry_jitter_seed_resurfaces_pl016(self):
+        """Regression pin for the real defect PL016 caught on its first
+        package run: the backoff jitter was seeded from builtin
+        hash((seam, attempt)) — PYTHONHASHSEED-randomized, so the
+        'deterministic' retry schedule differed per process. Reverting
+        the crc32 fix resurfaces the finding."""
+        path = "photon_ml_tpu/reliability/retry.py"
+        src = open(path).read()
+        fixed = 'seed = zlib.crc32(f"{seam}:{attempt}".encode("utf-8"))'
+        assert fixed in src, "retry jitter seed changed; update me"
+        clean = analyze_source(path, src)
+        assert not [v for v in clean.violations if v.rule == "PL016"], \
+            _fmt(clean.violations)
+        dirty = analyze_source(
+            path, src.replace(fixed, "seed = hash((seam, attempt))")
+        )
+        assert [
+            v for v in dirty.violations
+            if v.rule == "PL016" and "seeds Random" in v.message
+        ], _fmt(dirty.violations)
+
+    def test_reverting_bench_flood_seed_resurfaces_pl016(self):
+        """Same pin for the flood-payload generator: hash(key)-seeded
+        default_rng meant parent and relaunched child processes built
+        DIFFERENT payloads for the same key, drifting cache-hit
+        accounting."""
+        path = "bench.py"
+        src = open(path).read()
+        fixed = ("seed = zlib.crc32(\n"
+                 '                f"{key[0]}:{key[1]}:{key[2]}"'
+                 '.encode("utf-8")\n'
+                 "            )")
+        assert fixed in src, "bench flood seed changed; update me"
+        # no clean-half re-analysis of bench.py here (it is the largest
+        # file in the run): test_determinism_rules_land_at_zero already
+        # proves the fixed tree carries zero PL016
+        dirty = analyze_source(
+            path, src.replace(fixed, "seed = hash(key)")
+        )
+        assert [
+            v for v in dirty.violations
+            if v.rule == "PL016" and "default_rng" in v.message
+        ], _fmt(dirty.violations)
+
+    def test_unsorting_the_signature_walk_resurfaces_pl015(self):
+        """The PL015 pin on the lineage-critical artifact: the registry
+        content signature digests a sorted os.walk. Dropping the sort
+        makes the digest OS-iteration-order dependent — the same tree
+        would sign differently across hosts — and the analyzer flags
+        the walk again."""
+        path = "photon_ml_tpu/registry/registry.py"
+        src = open(path).read()
+        fixed = "for root, dirs, files in sorted(os.walk(model_dir)):"
+        assert fixed in src, "signature walk changed; update me"
+        clean = analyze_source(path, src)
+        assert not [v for v in clean.violations if v.rule == "PL015"], \
+            _fmt(clean.violations)
+        dirty = analyze_source(
+            path,
+            src.replace(
+                fixed, "for root, dirs, files in os.walk(model_dir):"
+            ),
+        )
+        assert [
+            v for v in dirty.violations
+            if v.rule == "PL015" and "os.walk" in v.message
+        ], _fmt(dirty.violations)
+
+    def test_reverting_native_index_partition_sort_resurfaces_pl015(self):
+        """Round 22's real PL015 finding: the partitioned index builder
+        iterated ``set(keys)`` straight into the per-partition stores,
+        so the same key set produced byte-different index files per
+        process. Reverting the sort resurfaces the finding."""
+        path = "photon_ml_tpu/utils/native_index.py"
+        src = open(path).read()
+        fixed = ("    for key in sorted(set(keys)):\n"
+                 "        parts[zlib.crc32")
+        assert fixed in src, "partition loop changed; update me"
+        clean = analyze_source(path, src)
+        assert not [v for v in clean.violations if v.rule == "PL015"], \
+            _fmt(clean.violations)
+        dirty = analyze_source(
+            path,
+            src.replace(
+                fixed,
+                "    for key in set(keys):\n        parts[zlib.crc32",
+            ),
+        )
+        assert [
+            v for v in dirty.violations
+            if v.rule == "PL015" and "set(...)" in v.message
+        ], _fmt(dirty.violations)
+
+    def test_stripping_routing_allowlist_resurfaces_pl018(self, tmp_path):
+        """The transport fix PL018 forced: without the response-type
+        allowlist in _read_frames, routing.py references NO response
+        MSG_* constants — the dispatch leg flags all three response
+        types (and the original protocol-confusion hole returns)."""
+        import shutil
+
+        serving = os.path.join(REPO, "photon_ml_tpu", "serving")
+        pkg_dir = tmp_path / "serving"
+        pkg_dir.mkdir()
+        for name in ("wire.py", "frontend.py", "routing.py"):
+            shutil.copy(os.path.join(serving, name), pkg_dir / name)
+        clean = analyze_paths([str(pkg_dir)])
+        assert not [v for v in clean.violations if v.rule == "PL018"], \
+            _fmt(clean.violations)
+        routing_src = (pkg_dir / "routing.py").read_text()
+        allowlist = (
+            "                if mtype not in (\n"
+            "                    wirefmt.MSG_JSON,\n"
+            "                    wirefmt.MSG_SCORE_RESPONSE,\n"
+            "                    wirefmt.MSG_PARTIAL_RESPONSE,\n"
+            "                    wirefmt.MSG_TRACE_RESPONSE,\n"
+            "                ):\n"
+            "                    self.unmatched_responses += 1\n"
+            "                    continue\n"
+        )
+        assert allowlist in routing_src, "routing allowlist changed"
+        (pkg_dir / "routing.py").write_text(
+            routing_src.replace(allowlist, "")
+        )
+        dirty = analyze_paths([str(pkg_dir)])
+        undispatched = {
+            v.message.split(" ", 1)[0]
+            for v in dirty.violations
+            if v.rule == "PL018" and "never dispatched" in v.message
+        }
+        assert undispatched == {
+            "MSG_SCORE_RESPONSE", "MSG_PARTIAL_RESPONSE",
+            "MSG_TRACE_RESPONSE",
+        }, _fmt(dirty.violations)
+
+    def test_determinism_harness_is_analyzed(self, full_report):
+        """The twin-run harness and its artifact targets are part of
+        the analyzed set and hold the zero bar themselves — the gate
+        that checks determinism is checked for determinism."""
+        files = [f.replace(os.sep, "/") for f in full_report.files]
+        for mod in ("testing/determinism.py",
+                    "testing/determinism_targets.py"):
+            assert any(f.endswith(mod) for f in files), (mod, files)
+
     def test_json_lists_allow_sites_with_seam_accounting(self, repo_cwd):
         r = subprocess.run(
             [sys.executable, "-m", "photon_ml_tpu.lint",

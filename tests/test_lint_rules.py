@@ -188,6 +188,58 @@ class TestRuleFixtures:
         # local helper, defensive copy, non-donated positions
         assert _violations("pl014_neg.py") == []
 
+    def test_pl015_positive(self):
+        vs = _violations("pl015_pos.py")
+        # set payload into atomic_write_json, listdir into json.dumps,
+        # set-algebra into json.dumps, for-over-set in a writer scope
+        assert _rules(vs) == ["PL015"] * 4, vs
+
+    def test_pl015_negative(self):
+        # same shapes sorted(); order-erasing reductions; iterating a
+        # set in a scope that writes nothing
+        assert _violations("pl015_neg.py") == []
+
+    def test_pl016_positive(self):
+        vs = _violations("pl016_pos.py")
+        # pid artifact, two clock payloads, id() cache get + store,
+        # hostname return, one stale + one reasonless declaration
+        assert _rules(vs) == ["PL016"] * 8, vs
+        msgs = " | ".join(v.message for v in vs)
+        assert "stale entropy declaration" in msgs
+        assert "without a reason" in msgs
+        # the declaration grammar is a CLAIM, not a suppression
+        assert all(not v.suppressable for v in vs)
+
+    def test_pl016_negative(self):
+        # declared sites (site-line and def-line), durations, clock
+        # comparisons, hash()-keying, content-derived seeds
+        assert _violations("pl016_neg.py") == []
+
+    def test_pl017_positive(self):
+        vs = _violations("pl017_pos.py")
+        # sum/math.fsum/np.sum over unordered iterables
+        assert _rules(vs) == ["PL017"] * 3, vs
+
+    def test_pl017_negative(self):
+        assert _violations("pl017_neg.py") == []
+
+    def test_pl018_positive(self):
+        vs = _violations("pl018_pos")
+        # duplicate wire value, orphan encoder/decoder/dispatch,
+        # unmapped WireError kind (the fixture package has no tests
+        # tree, so the corpus leg correctly stays out of scope)
+        assert _rules(vs) == ["PL018"] * 5, vs
+        msgs = " | ".join(v.message for v in vs)
+        assert "reuses wire value" in msgs
+        assert "no encoder" in msgs
+        assert "no decoder branch" in msgs
+        assert "never dispatched" in msgs
+        assert "'oversized' has no frontend mapping" in msgs
+        assert all(not v.suppressable for v in vs)
+
+    def test_pl018_negative(self):
+        assert _violations("pl018_neg") == []
+
 
 class TestSuppression:
     def test_allow_comments_suppress(self):
@@ -419,6 +471,60 @@ class TestBaseline:
         with pytest.raises(ValueError, match="never baseline-able"):
             load_baseline(path)
 
+    def test_pl015_pl017_round_trip(self, tmp_path):
+        # the order rules baseline like any other rule...
+        for fixture in ("pl015_pos.py", "pl017_pos.py"):
+            report = _report(fixture)
+            assert report.violations
+            path = str(tmp_path / f"b-{fixture}.json")
+            write_baseline(path, report.violations)
+            fresh = _report(fixture)
+            apply_baseline(fresh, load_baseline(path))
+            assert fresh.violations == []
+            assert fresh.unused_baseline == []
+
+    def test_pl016_refuses_to_baseline(self, tmp_path):
+        # ...except PL016: entropy in artifacts is declared or fixed,
+        # never grandfathered (the PL009/PL012 discipline)
+        from photon_ml_tpu.lint import BaselineRefused
+
+        report = _report("pl016_pos.py")
+        assert report.violations
+        path = str(tmp_path / "b.json")
+        with pytest.raises(BaselineRefused, match="entropy"):
+            write_baseline(path, report.violations)
+        assert not os.path.exists(path), "refusal must not write"
+
+    def test_pl018_refuses_to_baseline(self, tmp_path):
+        # ...and PL018: a half-wired message type is a protocol hole,
+        # not debt to inherit
+        from photon_ml_tpu.lint import BaselineRefused
+
+        report = _report("pl018_pos")
+        assert report.violations
+        path = str(tmp_path / "b.json")
+        with pytest.raises(BaselineRefused, match="wire"):
+            write_baseline(path, report.violations)
+        assert not os.path.exists(path), "refusal must not write"
+
+    def test_hand_edited_pl016_pl018_baseline_entries_rejected(
+        self, tmp_path
+    ):
+        for rule, snippet in (
+            ("PL016", "os.getpid()"),
+            ("PL018", "MSG_ORPHAN = 0x03"),
+        ):
+            path = str(tmp_path / f"b-{rule}.json")
+            json.dump(
+                {"version": 1, "entries": [{
+                    "file": "x.py", "rule": rule,
+                    "snippet": snippet, "count": 1,
+                }]},
+                open(path, "w"),
+            )
+            with pytest.raises(ValueError, match="never baseline-able"):
+                load_baseline(path)
+
 
 class TestCLI:
     def _run(self, *args, cwd=None):
@@ -467,7 +573,8 @@ class TestCLI:
         assert r.returncode == 0
         for rid in ("PL001", "PL002", "PL003", "PL004", "PL005",
                     "PL006", "PL007", "PL008", "PL009", "PL010",
-                    "PL011", "PL012", "PL013", "PL014"):
+                    "PL011", "PL012", "PL013", "PL014", "PL015",
+                    "PL016", "PL017", "PL018"):
             assert rid in r.stdout
         assert "unguarded-shared-state" in r.stdout
         assert "lock-order-inversion" in r.stdout
@@ -476,6 +583,10 @@ class TestCLI:
         assert "sharded-bank-host-gather" in r.stdout
         assert "reduction-completeness" in r.stdout
         assert "donation-hygiene" in r.stdout
+        assert "unordered-iteration-to-artifact" in r.stdout
+        assert "ambient-entropy-in-artifact" in r.stdout
+        assert "float-accumulation-order" in r.stdout
+        assert "wire-contract-completeness" in r.stdout
 
     def test_json_covers_concurrency_rules(self):
         r = self._run(
@@ -526,6 +637,83 @@ class TestCLI:
             "--no-spmd",
         )
         assert r.returncode == 1, r.stdout
+
+    def test_no_determinism_flag_skips_the_pass(self):
+        r = self._run(
+            os.path.join(FIXTURES, "pl015_pos.py"), "--no-baseline",
+            "--no-determinism",
+        )
+        assert r.returncode == 0, r.stdout
+        # ...and the concurrency pass still runs independently
+        r = self._run(
+            os.path.join(FIXTURES, "pl008_pos.py"), "--no-baseline",
+            "--no-determinism",
+        )
+        assert r.returncode == 1, r.stdout
+
+    def test_write_baseline_refuses_pl016_with_exit_2(self, tmp_path):
+        target = str(tmp_path / "b.json")
+        r = self._run(
+            os.path.join(FIXTURES, "pl016_pos.py"),
+            "--write-baseline", "--baseline", target,
+        )
+        assert r.returncode == 2
+        assert "entropy" in r.stderr
+        assert not os.path.exists(target)
+
+    def test_write_baseline_refuses_pl018_with_exit_2(self, tmp_path):
+        target = str(tmp_path / "b.json")
+        r = self._run(
+            os.path.join(FIXTURES, "pl018_pos"),
+            "--write-baseline", "--baseline", target,
+        )
+        assert r.returncode == 2
+        assert "wire" in r.stderr
+        assert not os.path.exists(target)
+
+    def test_json_carries_wire_contract_inventory(self):
+        r = self._run(
+            os.path.join(FIXTURES, "pl018_pos"), "--no-baseline",
+            "--json",
+        )
+        data = json.loads(r.stdout)
+        assert r.returncode == 1
+        assert {v["rule"] for v in data["violations"]} == {"PL018"}
+        contract = data["wire_contract"]
+        names = {m["name"] for m in contract["messages"]}
+        assert names == {"MSG_JSON", "MSG_SCORE", "MSG_DUP", "MSG_ORPHAN"}
+        orphan = [
+            m for m in contract["messages"] if m["name"] == "MSG_ORPHAN"
+        ][0]
+        assert orphan["encoders"] == []
+        assert orphan["decoded"] is False
+        assert orphan["dispatch"] == []
+        assert contract["error_kinds"] == {
+            "malformed": True, "oversized": False,
+        }
+
+    def test_json_carries_entropy_declaration_table(self):
+        r = self._run(
+            os.path.join(FIXTURES, "pl016_neg.py"), "--no-baseline",
+            "--json",
+        )
+        data = json.loads(r.stdout)
+        assert r.returncode == 0
+        decls = data["entropy_declarations"]
+        assert decls, "declared sites must ride the json report"
+        reasons = {d["reason"] for d in decls}
+        assert any("discovery artifact" in x for x in reasons)
+        assert any("lease identity" in x for x in reasons)
+
+    def test_json_omits_determinism_tables_when_opted_out(self):
+        r = self._run(
+            os.path.join(FIXTURES, "pl016_neg.py"), "--no-baseline",
+            "--json", "--no-determinism",
+        )
+        data = json.loads(r.stdout)
+        assert r.returncode == 0
+        assert "wire_contract" not in data
+        assert "entropy_declarations" not in data
 
     def test_json_covers_spmd_rules_and_contract_table(self):
         r = self._run(

@@ -51,6 +51,49 @@ from tests.test_shard_routing import (
 )
 
 
+def _hdr(obj, tail=b""):
+    """A header-prefixed payload in the shape every non-JSON decoder
+    splits: 4-byte little-endian header length, JSON header, float tail."""
+    hj = json.dumps(obj).encode("utf-8")
+    return struct.pack("<I", len(hj)) + hj + tail
+
+
+# The malformed-payload fuzz corpus, keyed by message type. This dict is
+# half of a machine-checked contract: photon-lint PL018 cross-checks its
+# keys against wire.py's MSG_* inventory (a new message type without a
+# corpus entry fails lint, package-wide), and TestFuzzCorpus proves every
+# payload here is REFUSED by decode_message with a named WireError —
+# never a crash, never a silent partial decode.
+WIRE_FUZZ_CORPUS = {
+    wire.MSG_JSON: [
+        b"{",  # truncated JSON
+        b"[1, 2]",  # not an object
+        b"\xff\xfe\x00",  # not UTF-8
+    ],
+    wire.MSG_SCORE_REQUEST: [
+        struct.pack("<I", 999) + b"{}",  # header length overruns frame
+        _hdr({"_wire_bags": "nope"}),  # _wire_bags must be an object
+        _hdr(
+            {"features": [{"name": "a"}], "_wire_bags": {"features": 1}},
+            b"\x00" * 4,
+        ),  # float tail shorter than the bag counts promise
+    ],
+    wire.MSG_SCORE_RESPONSE: [
+        b"\x00",  # too short for the header-length word
+        _hdr({}),  # no f32 score tail
+    ],
+    wire.MSG_PARTIAL_RESPONSE: [
+        _hdr({}),  # header lacks names
+        _hdr({"names": ["a", "b"]}, b"\x00" * 4),  # tail < 1 + len(names)
+    ],
+    wire.MSG_TRACE_RESPONSE: [
+        _hdr({}),  # header lacks spans
+        _hdr({"spans": [{}]}),  # no span-times tail
+        _hdr({"spans": [1]}, b"\x00" * 16),  # span is not an object
+    ],
+}
+
+
 class BinClient:
     """One binary-framing client connection: frames out, frames in."""
 
@@ -311,6 +354,50 @@ class TestCodec:
         assert wire.resolve_max_frame_bytes(512) == 512
         with pytest.raises(ValueError, match="positive"):
             wire.resolve_max_frame_bytes(0)
+
+
+class TestFuzzCorpus:
+    """The WIRE_FUZZ_CORPUS contract, runtime half. Lint (PL018) proves
+    the corpus KEYS track wire.py's MSG_* inventory; these tests prove
+    the corpus VALUES are live ammunition — every payload refused with
+    a named WireError, through the bare codec and the stream decoder."""
+
+    def test_corpus_covers_every_message_type(self):
+        # the same inventory derivation PL018 performs: module-level
+        # MSG_* integer constants in wire.py
+        inventory = {
+            v
+            for k, v in vars(wire).items()
+            if k.startswith("MSG_") and isinstance(v, int)
+        }
+        assert set(WIRE_FUZZ_CORPUS) == inventory
+        # and no message type shares a wire value with another
+        assert len(inventory) == sum(
+            1 for k in vars(wire) if k.startswith("MSG_")
+        )
+
+    def test_every_corpus_payload_is_a_named_refusal(self):
+        for mtype, payloads in WIRE_FUZZ_CORPUS.items():
+            assert payloads, f"empty corpus list for 0x{mtype:02x}"
+            for payload in payloads:
+                with pytest.raises(wire.WireError):
+                    wire.decode_message(mtype, payload)
+
+    def test_corpus_payloads_survive_framing_then_refuse(self):
+        # framing is content-blind: every corpus payload rides a frame
+        # intact and still dies as a WireError at decode_message — the
+        # refusal happens at the codec, never as a stream wedge
+        for mtype, payloads in WIRE_FUZZ_CORPUS.items():
+            for payload in payloads:
+                buf = bytearray()
+                wire.append_frame(buf, mtype, payload)
+                frames = wire.FrameDecoder().feed(bytes(buf))
+                assert len(frames) == 1
+                got_type, got_payload = frames[0]
+                assert got_type == mtype
+                assert bytes(got_payload) == payload
+                with pytest.raises(wire.WireError):
+                    wire.decode_message(got_type, got_payload)
 
 
 # -- first-byte sniffing: both protocols on ONE port --------------------------
