@@ -1534,6 +1534,188 @@ def _pod_game_config(name, *, n=16384, E=2048, d=32, k=8, iters=3, seed=0):
     }
 
 
+def _unified_mesh_config(name, *, n=4096, E=512, d=16, k=6, iters=2,
+                         seed=0):
+    """Unified (grid × entity) mesh A/B (game/unified.py): the whole
+    G-member λ-grid over an entity-sharded GAME model as ONE
+    jitted/shard_mapped program vs the sequential-composed legacy sweep
+    (G per-λ pod CD runs on the same entity mesh).
+
+    Emits the round artifact's contract + wall accounting: per-λ
+    objective/bank parity vs the sequential pod oracle, the unified
+    sweep's readback count (must equal the CD iteration count — ONE
+    batched readback per iteration covers every member), relowerings on
+    a warmed same-shape run with DIFFERENT λ values (must be 0), the
+    P(grid, entity) per-device bank bytes, and wall-clock both ways.
+    Gates live in dev-scripts/bench_unified_mesh.sh (host-class-aware:
+    parity + readback/lowering contracts everywhere; the >= 1.2x
+    wall-clock gate at G >= 4 is multi-core/chip-only — a 1-core host
+    runs every virtual device sequentially, so the one-program win is
+    dispatch overhead only and the figure is recorded, not gated)."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_ml_tpu.game.config import (
+        ProjectorType,
+        RandomEffectDataConfiguration,
+    )
+    from photon_ml_tpu.game.coordinate import (
+        FixedEffectCoordinate,
+        PodRandomEffectCoordinate,
+    )
+    from photon_ml_tpu.game.coordinate_descent import CoordinateDescent
+    from photon_ml_tpu.game.data import EntityIndex, GameDataset, ShardData
+    from photon_ml_tpu.game.random_effect import (
+        RandomEffectOptimizationProblem,
+    )
+    from photon_ml_tpu.game.random_effect_data import (
+        build_random_effect_dataset,
+    )
+    from photon_ml_tpu.game.unified import run_game_grid
+    from photon_ml_tpu.ops.losses import LOGISTIC
+    from photon_ml_tpu.optim.config import (
+        OptimizerConfig,
+        RegularizationContext,
+        RegularizationType,
+    )
+    from photon_ml_tpu.optim.problem import create_glm_problem
+    from photon_ml_tpu.parallel import overlap
+    from photon_ml_tpu.parallel.mesh import entity_mesh
+    from photon_ml_tpu.parallel.unified_mesh import resolve_mesh
+    from photon_ml_tpu.task import TaskType
+    from photon_ml_tpu.utils.index_map import IndexMap, feature_key
+
+    rng = np.random.default_rng(seed)
+    codes = rng.integers(0, E, size=n).astype(np.int32)
+    ix = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    v = rng.normal(size=(n, k)).astype(np.float32)
+    lab = (rng.uniform(size=n) > 0.5).astype(np.float32)
+    w = np.ones(n, np.float32)
+    off = (rng.normal(size=n) * 0.1).astype(np.float32)
+    imap = IndexMap.build(
+        (feature_key(f"f{i}", "") for i in range(d)), add_intercept=False
+    )
+    ds = GameDataset(
+        uids=[str(i) for i in range(n)],
+        labels=lab, offsets=off, weights=w,
+        shards={"s": ShardData(ix, v, imap, None)},
+        entity_codes={"user": codes},
+        entity_indexes={
+            "user": EntityIndex.build("user", [f"e{i:06d}" for i in range(E)])
+        },
+        num_real_rows=n,
+    )
+    red = build_random_effect_dataset(
+        ds,
+        RandomEffectDataConfiguration(
+            random_effect_type="user", feature_shard_id="s",
+            projector_type=ProjectorType.IDENTITY,
+        ),
+    )
+    task = TaskType.LOGISTIC_REGRESSION
+    fe_problem = create_glm_problem(
+        task, ds.shards["s"].dim, config=OptimizerConfig(max_iter=5)
+    )
+
+    def re_problem(lam=1.0):
+        return RandomEffectOptimizationProblem(
+            LOGISTIC, OptimizerConfig(max_iter=5),
+            RegularizationContext(RegularizationType.L2), reg_weight=lam,
+        )
+
+    lambdas = [0.1, 0.5, 1.0, 2.0]
+    n_dev = len(jax.devices())
+    n_ent = 2 if n_dev >= 2 else 1
+    plan = resolve_mesh(grid_size=len(lambdas), entity_shards=n_ent)
+
+    def run_unified(lams, num_iterations):
+        return run_game_grid(
+            plan, ds, red, fe_problem, re_problem(), lams,
+            feature_shard_id="s", fe_reg_weight=0.1,
+            num_iterations=num_iterations,
+        )
+
+    def run_sequential(lams, num_iterations):
+        out = []
+        for lam in lams:
+            coords = {
+                "fixed": FixedEffectCoordinate(
+                    name="fixed", dataset=ds, problem=fe_problem,
+                    feature_shard_id="s", reg_weight=0.1,
+                ),
+                "per-user": PodRandomEffectCoordinate(
+                    name="per-user", dataset=ds, re_dataset=red,
+                    problem=re_problem(lam), mesh=entity_mesh(n_ent),
+                ),
+            }
+            out.append(CoordinateDescent(coords, ds, task).run(
+                num_iterations
+            ))
+        return out
+
+    # warm both program families, then time
+    run_unified(lambdas, 1)
+    run_sequential(lambdas, 1)
+    t0 = time.perf_counter()
+    res = run_unified(lambdas, iters)
+    uni_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    refs = run_sequential(lambdas, iters)
+    seq_s = time.perf_counter() - t0
+
+    bank_diff = 0.0
+    obj_rel = 0.0
+    for gi, ref in enumerate(refs):
+        got = np.asarray(res.re_bank.member_global(gi))
+        want_bank = np.asarray(ref.model.models["per-user"].bank)
+        bank_diff = max(bank_diff, float(np.max(np.abs(got - want_bank))))
+        got_obj = np.asarray([h[gi] for h in res.objective_history])
+        want_obj = np.asarray(ref.objective_history)
+        obj_rel = max(obj_rel, float(np.max(
+            np.abs(got_obj - want_obj) / np.maximum(np.abs(want_obj), 1e-9)
+        )))
+
+    with overlap.overlap_scope(True):
+        overlap.reset_readback_stats()
+        run_unified(lambdas, iters)
+        readbacks = overlap.readback_stats()
+
+    import jax._src.test_util as jtu
+    with jtu.count_jit_and_pmap_lowerings() as count:
+        run_unified([0.2, 0.7, 1.5, 3.0], iters)
+    relowerings = int(count[0])
+
+    return {
+        "config": name,
+        "metric": "unified_mesh_speedup",
+        "value": round(seq_s / max(uni_s, 1e-9), 3),
+        "unit": (
+            f"sequential/unified wall ratio, G={len(lambdas)} x "
+            f"{n_ent} entity shards x {iters} CD iterations"
+        ),
+        "detail": {
+            "n": n, "entities": E, "dim": d,
+            "grid_size": len(lambdas),
+            "entity_shards": plan.entity_shards,
+            "grid_rows": plan.grid_rows,
+            "cd_iterations": iters,
+            "unified_wall_s": round(uni_s, 4),
+            "sequential_wall_s": round(seq_s, 4),
+            "speedup": round(seq_s / max(uni_s, 1e-9), 3),
+            "bank_max_abs_diff": bank_diff,
+            "objective_max_rel_diff": obj_rel,
+            "unified_readbacks": readbacks,
+            "relowerings_warm": relowerings,
+            "per_device_bank_bytes": res.re_bank.per_device_bytes(),
+            "host": {
+                "cpu_count": os.cpu_count(),
+                "devices": n_dev,
+                "platform": jax.devices()[0].platform,
+            },
+        },
+    }
+
+
 def _reliability_config(name, *, n_chunks=8, rows=65536, k=16,
                         passes=10, seed=0):
     """Reliability-layer overhead A/B (round 11): the spill-read/write
@@ -4086,6 +4268,15 @@ def suite(only=None):
         results.append(_wire_config("17_wire"))
         print(json.dumps(results[-1]), flush=True)
 
+    # 18: unified mesh (ISSUE 20): the whole λ-grid over an
+    # entity-sharded GAME model as ONE shard_mapped program vs G
+    # sequential pod CD sweeps — parity, 1-readback/iteration,
+    # 0-relowering, per-device bank bytes, wall both ways; gates in
+    # dev-scripts/bench_unified_mesh.sh.
+    if want("18_unified_mesh"):
+        results.append(_unified_mesh_config("18_unified_mesh"))
+        print(json.dumps(results[-1]), flush=True)
+
     path = "BASELINE_RESULTS.json"
     merged = {}
     if only is not None and os.path.exists(path):
@@ -4143,6 +4334,10 @@ if __name__ == "__main__":
         # dev-scripts/bench_streaming_game.sh entry: the streamed GAME
         # CD A/B as one JSON line (gates applied by the script)
         print(json.dumps(_streaming_game_config("streaming_game")))
+    elif "--unified-mesh" in sys.argv:
+        # dev-scripts/bench_unified_mesh.sh entry: the unified-mesh A/B
+        # as one JSON line (gates applied by the script)
+        print(json.dumps(_unified_mesh_config("unified_mesh")))
     elif "--pod-game" in sys.argv:
         # dev-scripts/bench_pod_game.sh entry: the entity-sharded GAME
         # A/B as one JSON line (gates applied by the script)

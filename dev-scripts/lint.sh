@@ -16,6 +16,10 @@
 #      PL012, PL016 and PL018 findings are never baseline-able. The
 #      determinism pass's runtime twin is dev-scripts/determinism.sh
 #      (hash-seed twin-run byte-diff over every artifact class).
+#      The SPMD pass covers the unified-mesh plane (parallel/
+#      unified_mesh.py, game/unified.py) at ZERO baseline and ZERO
+#      allows — every grid-sharded program carries a machine-checked
+#      '# photon: sharding(...)' contract like the pod plane.
 #   2. SHARDING.md drift gate — the committed sharding-contract
 #      inventory must match a fresh render of the SPMD pass's entry-
 #      point scan (regenerate with --write-sharding-md). Skipped when

@@ -494,14 +494,34 @@ class GameTrainingDriver:
     # -- coordinates -------------------------------------------------------
 
     def _mesh(self):
-        """Data-parallel/entity-parallel mesh over all visible devices;
-        None when single-device or --distributed off. In "feature" mode
-        this is the 1-D mesh the RANDOM-EFFECT banks shard over; the
-        fixed effect gets its own 2-D mesh from _fe_mesh."""
-        from photon_ml_tpu.parallel.mesh import maybe_make_mesh
+        """Data-parallel/entity-parallel mesh; None when single-device or
+        --distributed off. In "feature" mode this is the 1-D mesh the
+        RANDOM-EFFECT banks shard over; the fixed effect gets its own
+        2-D mesh from _fe_mesh.
+
+        A PARTIAL pod entity mesh (--entity-shards N < visible devices)
+        restricts the data mesh to the same N devices: CD row currency
+        (scores, residuals) is committed to the entity device set, and
+        jit refuses `residual + new_score` across two device sets."""
+        from photon_ml_tpu.parallel.mesh import (
+            DATA_AXIS,
+            make_mesh,
+            maybe_make_mesh,
+        )
 
         mode = self.params.distributed
-        return maybe_make_mesh("auto" if mode == "feature" else mode)
+        mesh = maybe_make_mesh("auto" if mode == "feature" else mode)
+        pod = self._entity_mesh()
+        if (
+            mesh is None
+            or pod is None
+            or pod.devices.size >= mesh.devices.size
+        ):
+            return mesh
+        devs = list(pod.devices.flat)
+        if len(devs) < 2:
+            return None
+        return make_mesh((len(devs),), (DATA_AXIS,), devs)
 
     def _entity_mesh(self):
         """Pod-scale entity mesh (--entity-shards), or None for the
@@ -515,13 +535,38 @@ class GameTrainingDriver:
     def _fe_mesh(self):
         """Mesh for the fixed-effect solves: the 2-D (data, model) mesh in
         "feature" mode (feature-sharded coefficients inside the GAME CD),
-        the shared 1-D data mesh otherwise."""
-        from photon_ml_tpu.parallel.mesh import maybe_make_mesh
+        the shared 1-D data mesh otherwise. Like _mesh, a partial pod
+        entity mesh restricts the device set (the FE's row scores feed
+        the pod residual)."""
+        from photon_ml_tpu.parallel.mesh import (
+            DATA_AXIS,
+            MODEL_AXIS,
+            make_mesh,
+            maybe_make_mesh,
+        )
 
         p = self.params
-        if p.distributed == "feature":
-            return maybe_make_mesh("feature", p.model_shards)
-        return self._mesh()
+        if p.distributed != "feature":
+            return self._mesh()
+        mesh = maybe_make_mesh("feature", p.model_shards)
+        pod = self._entity_mesh()
+        if (
+            mesh is None
+            or pod is None
+            or pod.devices.size >= mesh.devices.size
+        ):
+            return mesh
+        devs = list(pod.devices.flat)
+        m = p.model_shards if p.model_shards is not None else 2
+        if len(devs) % m != 0:
+            raise ValueError(
+                f"model_shards={m} does not divide the {len(devs)}-device "
+                "entity mesh (--entity-shards restricts the fixed "
+                "effect's (data, model) mesh to the pod device set)"
+            )
+        return make_mesh(
+            (len(devs) // m, m), (DATA_AXIS, MODEL_AXIS), devs
+        )
 
     def _build_coordinates(
         self,
@@ -607,11 +652,15 @@ class GameTrainingDriver:
 
         Batchable when: one FE coordinate, no random effects, 1 CD
         iteration (a single-coordinate CD iteration IS one GLM solve),
-        no down-sampling / checkpointing / feature-sharded FE, and every
-        combo identical except the FE regWeight. Then the whole sweep
-        collapses into training.train_grid_batched's engine — one
-        vmapped program for all G combos (--grid-mode; auto applies the
-        memory-budget fallback).
+        no checkpointing, and every combo identical except the FE
+        regWeight. Then the whole sweep collapses into
+        training.train_grid_batched's engine — one vmapped program for
+        all G combos (--grid-mode; auto applies the memory-budget
+        fallback). The feature-sharded FE batches too
+        (feature_sharded_glm_fit(grid=True): a [G, d_pad] bank over the
+        (data, model) mesh), and down-sampling composes when every combo
+        shares the rate — the draw is λ-independent, so one weight
+        rewrite serves the whole grid.
         """
         p = self.params
         if p.grid_mode == "sequential":
@@ -622,7 +671,6 @@ class GameTrainingDriver:
             or p.factored_re_configs
             or p.num_iterations != 1
             or p.checkpoint_dir is not None
-            or p.distributed == "feature"
             or p.retrain_from is not None  # warm start needs the
             # sequential sweep's initial_model seam
             or len(combos) <= 1
@@ -635,7 +683,7 @@ class GameTrainingDriver:
             if (
                 cfg.optimizer_config != base.optimizer_config
                 or cfg.regularization != base.regularization
-                or cfg.down_sampling_rate != 1.0
+                or cfg.down_sampling_rate != base.down_sampling_rate
             ):
                 return None
         lambdas = [combo[name].reg_weight for combo in combos]

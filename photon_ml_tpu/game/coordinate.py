@@ -274,11 +274,17 @@ class FixedEffectCoordinate(Coordinate):
             sharded=sharded, fit=fit, hdiag=hdiag, dim=dim, d_pad=d_pad,
             rows_total=rows_total, use_owlqn=use_owlqn, l1_mask=l1_mask,
             extras_tail=extras_tail, with_norm=with_norm,
+            meta=meta, layout=layout,
         )
         self.__dict__["_fs_state"] = state
         return state
 
-    def _update_model_feature_sharded(self, model, residual):
+    def _refresh_sharded_rows(self, residual):
+        """Re-pad and re-place the per-update row vectors (offsets — the
+        residual currency — and, when down-sampling, the draw's weights)
+        against the cached sharded layout. Shared by the sequential
+        update and the λ-grid solve so the two row paths cannot
+        diverge."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from photon_ml_tpu.parallel.mesh import DATA_AXIS
@@ -317,6 +323,11 @@ class FixedEffectCoordinate(Coordinate):
             )
             sharded = sharded._replace(weights=_place_rows(w_new))
         st["sharded"] = sharded  # keep the freshest placement cached
+        return sharded
+
+    def _update_model_feature_sharded(self, model, residual):
+        st = self._feature_sharded_state()
+        sharded = self._refresh_sharded_rows(residual)
 
         initial = model.model.means if model is not None else None
         w0 = jnp.zeros((st["d_pad"],), jnp.float32)
@@ -350,30 +361,32 @@ class FixedEffectCoordinate(Coordinate):
     def update_model_grid(self, reg_weights):
         """Batched λ tuning for this fixed effect: solve EVERY grid
         weight in ONE vmapped program (training.train_grid_batched's
-        engine, GLMOptimizationProblem.run_grid) instead of one
-        warm-started solve per combo — the GAME grid sweep's FE λ axis
-        collapses to 1 compile / 1 optimizer loop / 1 dispatch. Replicated
-        and data-parallel solves only (the feature-sharded FE keeps the
-        sequential sweep), no down-sampling, cold starts per member.
+        engine — GLMOptimizationProblem.run_grid on the replicated and
+        data-parallel layouts, feature_sharded_glm_fit(grid=True) on the
+        feature-sharded (data, model) mesh) instead of one warm-started
+        solve per combo — the GAME grid sweep's FE λ axis collapses to 1
+        compile / 1 optimizer loop / 1 dispatch. Down-sampling composes:
+        the draw is λ-independent (one shared weight rewrite, same PRNG
+        stream as the sequential path), so the whole grid solves against
+        the same sampled batch. Cold starts per member.
 
         Returns ``[(FixedEffectModel, OptResult), ...]`` aligned with
         ``reg_weights``; result scalars stay device-resident for the
         caller's batched fetch.
         """
         if self._is_feature_sharded():
-            raise ValueError(
-                "batched FE grid tuning does not support the "
-                "feature-sharded mesh; use the sequential sweep"
-            )
-        if self.down_sampling_rate < 1.0:
-            raise ValueError(
-                "batched FE grid tuning does not compose with "
-                "down-sampling"
-            )
+            return self._update_model_grid_feature_sharded(reg_weights)
         from photon_ml_tpu.models.coefficients import Coefficients
         from photon_ml_tpu.optim.common import OptResult, Tracker
 
         batch = self._batch(None)
+        if self.down_sampling_rate < 1.0:
+            from photon_ml_tpu.data.sampler import down_sample
+
+            batch = down_sample(
+                jax.random.PRNGKey(self.sampler_seed), batch,
+                self.down_sampling_rate, self.problem.task,
+            )
         variances, result = self.problem.run_grid(
             batch, [float(w) for w in reg_weights], mesh=self.mesh
         )
@@ -389,6 +402,86 @@ class FixedEffectCoordinate(Coordinate):
                 ),
                 OptResult(
                     coefficients=result.coefficients[i],
+                    value=result.value[i],
+                    grad_norm=result.grad_norm[i],
+                    iterations=result.iterations[i],
+                    reason=result.reason[i],
+                    tracker=Tracker(
+                        values=tracker.values[i],
+                        grad_norms=tracker.grad_norms[i],
+                        count=tracker.count[i],
+                        coefs=(
+                            tracker.coefs[i]
+                            if tracker.coefs is not None else None
+                        ),
+                    ),
+                ),
+            ))
+        return out
+
+    def _update_model_grid_feature_sharded(self, reg_weights):
+        """The λ-grid solve on the (data, model) mesh: ONE
+        feature_sharded_glm_fit(grid=True) dispatch covers every member
+        — a [G, d_pad] coefficient bank (replicated grid axis, feature
+        blocks sharded over "model"), [G] l1/l2 vectors, and the cached
+        tile/entry layout walked once per data pass for the whole grid."""
+        from photon_ml_tpu.models.coefficients import Coefficients
+        from photon_ml_tpu.optim.common import OptResult, Tracker
+        from photon_ml_tpu.optim.config import OptimizerType
+        from photon_ml_tpu.optim.problem import _VARIANCE_EPSILON
+        from photon_ml_tpu.parallel.distributed import (
+            feature_sharded_glm_fit,
+        )
+
+        st = self._feature_sharded_state()
+        problem = self.problem
+        use_tron = problem.config.optimizer_type == OptimizerType.TRON
+        grid_fit = feature_sharded_glm_fit(
+            problem.objective, self.mesh, st["meta"], layout=st["layout"],
+            optimizer=(
+                "tron" if use_tron
+                else ("owlqn" if st["use_owlqn"] else "lbfgs")
+            ),
+            max_iter=problem.config.max_iter,
+            tol=problem.config.tolerance,
+            history=problem.config.lbfgs_history,
+            max_cg=problem.config.tron_max_cg,
+            with_norm=st["with_norm"], with_box=problem.box is not None,
+            grid=True,
+        )
+        weights = [float(w) for w in reg_weights]
+        G = len(weights)
+        splits = [problem.regularization.split(w) for w in weights]
+        l1_vec = jnp.asarray([s[0] for s in splits], jnp.float32)
+        l2_vec = jnp.asarray([s[1] for s in splits], jnp.float32)
+        # same row currency as the sequential sharded update: dataset
+        # offsets (no residual at grid-tuning time) + the sampled draw
+        sharded = self._refresh_sharded_rows(None)
+        w0_bank = jnp.zeros((G, st["d_pad"]), jnp.float32)
+        extras = (
+            [l1_vec, st["l1_mask"]] if st["use_owlqn"] else []
+        ) + st["extras_tail"]
+        result = grid_fit(w0_bank, sharded, l2_vec, *extras)
+        out = []
+        tracker = result.tracker
+        norm_extras = st["extras_tail"][:2] if st["with_norm"] else []
+        for i in range(G):
+            var_i = None
+            if st["hdiag"] is not None:
+                hd = st["hdiag"](
+                    result.coefficients[i], sharded,
+                    jnp.float32(splits[i][1]), *norm_extras
+                )
+                var_i = (1.0 / (hd + _VARIANCE_EPSILON))[: st["dim"]]
+            coef_i = result.coefficients[i][: st["dim"]]
+            coefficients = Coefficients(coef_i, var_i)
+            out.append((
+                FixedEffectModel(
+                    problem.create_model(coefficients),
+                    self.feature_shard_id,
+                ),
+                OptResult(
+                    coefficients=coef_i,
                     value=result.value[i],
                     grad_norm=result.grad_norm[i],
                     iterations=result.iterations[i],

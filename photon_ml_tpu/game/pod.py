@@ -470,16 +470,21 @@ class _PodView:
     ONCE per (dataset, mesh) and reused every CD iteration — only the
     residual values move after that."""
 
-    def __init__(self, mesh, dataset: RandomEffectDataset, base_problem):
+    def __init__(self, mesh, dataset: RandomEffectDataset, base_problem,
+                 axis: Optional[str] = None):
         self.mesh = mesh
-        axis = mesh.axis_names[0]
+        # default: 1-D pod mesh. The unified (grid, entity) mesh passes
+        # axis explicitly — row currency and blocks shard over the
+        # entity axis and replicate over the grid axis, so this same
+        # view (and the router's static tables) serves every λ member.
+        axis = axis or mesh.axis_names[0]
         self.axis = axis
         n_dev = int(mesh.shape[axis])
         self.n_dev = n_dev
         self.num_rows = int(dataset.row_entity_codes.shape[0])
         self.spec = EntityShardSpec(n_dev, dataset.num_entities)
         e_loc = self.spec.rows_per_shard
-        sharding = _entity_sharding(mesh)
+        sharding = NamedSharding(mesh, P(axis))
 
         codes = np.asarray(dataset.row_entity_codes, np.int64)
         self.router = PodResidualRouter(mesh, codes, axis=axis)

@@ -1,0 +1,784 @@
+"""Unified (grid × entity) GAME training: one program, four axes.
+
+The pod path (game/pod.py) trains ONE entity-sharded GAME model; a
+λ-grid sweep over it runs G sequential pod CD loops — G dispatches per
+block per iteration, G all_to_alls per exchange, G host readbacks. This
+module generalizes every pod currency by one leading grid axis so the
+WHOLE sweep is one shard_mapped program family on the
+``parallel/unified_mesh.py`` (grid, entity) mesh:
+
+- :class:`GridShardedREBank` — the pod ``[N·E_loc, d]`` bank becomes
+  ``[G_pad, N·E_loc, d]`` sharded ``P(grid, entity)``: member g's bank
+  rows live on grid row ``g // G_loc``, entity-hash-sharded exactly
+  like the pod layout (same ownership rule, same padding semantics).
+- Grid programs — the pod update/score/route-in programs with a
+  ``vmap`` over the member axis INSIDE the shard_map body: the solver
+  cores run batched under the masked ``lax.while_loop`` (a converged
+  λ's rows freeze bit-stable while stragglers run on), the tile/block
+  schedule is walked ONCE per grid, and each residual exchange is ONE
+  ``all_to_all`` on ``[G_loc, n_dev, cap]`` blocks (``split_axis=1``)
+  — the pod exchange amortized over the grid axis.
+- :class:`UnifiedGridREProblem` — PodRandomEffectProblem's twin over
+  the grid bank; reuses the UNCHANGED :class:`~photon_ml_tpu.game.pod.
+  _PodView` (router tables, scoring slots and solver blocks are
+  λ-independent, so one view serves every member).
+- :func:`run_game_grid` — the unified coordinate-descent trainer: a
+  G-member λ-grid over (fixed effect + entity-sharded random effect)
+  with the exact CD residual algebra of game/coordinate_descent.py,
+  one batched readback per CD iteration and zero re-lowerings after
+  the first (tests/test_unified_mesh.py pins both).
+
+Scope bounds (documented, not silent): the fixed effect runs the
+replicated/grid-batched solve (sparse scatter objective) with its
+coefficient bank replicated — the feature-sharded FE sweep stays on
+the (data, model) mesh family — and per-member variance banks are not
+computed by the unified RE update (run the pod variance pass on a
+member's bank after unpacking when needed).
+"""
+
+from __future__ import annotations
+
+import weakref
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.game.pod import (
+    EntityShardSpec,
+    PodRandomEffectModel,
+    ShardedREBank,
+    _N_REASONS,
+    _PodView,
+    _bounded_put,
+    _cached_program,
+    _donate_args,
+    _mesh_key,
+    per_device_bytes,
+)
+from photon_ml_tpu.game.random_effect import RandomEffectTracker
+from photon_ml_tpu.optim.common import CONVERGENCE_REASON_NAMES
+from photon_ml_tpu.parallel import overlap
+from photon_ml_tpu.parallel.mesh import ENTITY_AXIS, GRID_AXIS
+from photon_ml_tpu.parallel.unified_mesh import MeshPlan
+
+Array = jnp.ndarray
+
+__all__ = [
+    "GridShardedREBank",
+    "UnifiedGridREProblem",
+    "UnifiedGridGameResult",
+    "run_game_grid",
+]
+
+
+def _grid_entity_sharding(mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(GRID_AXIS, ENTITY_AXIS))
+
+
+# Grid-bank builders keyed by (mesh, shape): the jit out_shardings
+# create/re-shard banks ON DEVICE — no [G, E, d] host array on any
+# training path (PL012 discipline; the checkpoint plane materializes
+# member views only inside its declared scopes).
+_GRID_ZEROS_CACHE: dict = {}
+_MEMBER_SLICE_CACHE: dict = {}
+_RESHARD_CACHE: dict = {}
+
+
+def _zeros_grid_sharded(mesh, g_pad: int, rows: int, d: int) -> Array:
+    key = (_mesh_key(mesh), g_pad, rows, d)
+    fn = _GRID_ZEROS_CACHE.get(key)
+    if fn is None:
+
+        def _make(g=g_pad, rows=rows, d=d):
+            return jnp.zeros((g, rows, d), jnp.float32)
+
+        fn = _bounded_put(
+            _GRID_ZEROS_CACHE, key,
+            # photon: sharding(axes=[grid,entity], out=[grid+entity])
+            jax.jit(_make, out_shardings=_grid_entity_sharding(mesh)),
+        )
+    return fn()
+
+
+def _member_slice(mesh):
+    """(data, g) -> member g's [rows, d] bank, entity-sharded (a 1-D
+    P(entity) spec on the 2-D mesh replicates over the grid rows, so
+    the slice is immediately usable by every pod program)."""
+    key = _mesh_key(mesh)
+    fn = _MEMBER_SLICE_CACHE.get(key)
+    if fn is None:
+
+        def _take(data, g):
+            return jnp.take(data, g, axis=0)
+
+        fn = _bounded_put(
+            _MEMBER_SLICE_CACHE, key,
+            # photon: sharding(axes=[grid,entity], in=[grid+entity,r], out=[entity])
+            jax.jit(_take, out_shardings=NamedSharding(mesh, P(ENTITY_AXIS))),
+        )
+    return fn
+
+
+def _reshard_grid(mesh):
+    """Identity jit whose out_shardings re-shard a grid bank onto
+    P(grid, entity) — the checkpoint-restore seam (device-side
+    re-shard; the host never holds the sharded layout)."""
+    key = _mesh_key(mesh)
+    fn = _RESHARD_CACHE.get(key)
+    if fn is None:
+
+        def _ident(a):
+            return a
+
+        fn = _bounded_put(
+            _RESHARD_CACHE, key,
+            # photon: sharding(axes=[grid,entity], in=[r], out=[grid+entity])
+            jax.jit(_ident, out_shardings=_grid_entity_sharding(mesh)),
+        )
+    return fn
+
+
+class GridShardedREBank:
+    """A λ-grid of entity-sharded random-effect banks as ONE array:
+    ``data`` is ``[G_pad, n_shards * E_loc, d]`` sharded
+    ``P(grid, entity)``. Member g uses the SAME hash placement as the
+    pod bank (entity ``e`` at row ``(e % n) * E_loc + e // n``);
+    padding members (index >= ``grid_size``) run inert duplicates of
+    the last λ and are dropped at unpack."""
+
+    __slots__ = ("mesh", "spec", "grid_size", "data")
+
+    def __init__(self, mesh, spec: EntityShardSpec, grid_size: int,
+                 data: Array):
+        self.mesh = mesh
+        self.spec = spec
+        self.grid_size = int(grid_size)
+        self.data = data
+
+    @property
+    def dim(self) -> int:
+        return int(self.data.shape[2])
+
+    @property
+    def grid_padded(self) -> int:
+        return int(self.data.shape[0])
+
+    @classmethod
+    def zeros(cls, mesh, spec: EntityShardSpec, grid_size: int,
+              grid_padded: int, dim: int) -> "GridShardedREBank":
+        return cls(
+            mesh, spec, grid_size,
+            _zeros_grid_sharded(mesh, grid_padded, spec.bank_rows, dim),
+        )
+
+    @classmethod
+    def from_member_globals(
+        cls, mesh, spec: EntityShardSpec, grid_size: int, banks,
+    ) -> "GridShardedREBank":
+        """[E, d] entity-code-ordered member banks -> the grid-sharded
+        layout. The hash gather runs on device and the single
+        out_shardings re-shard places it — the restore path's twin of
+        ``ShardedREBank.from_global`` (list shorter than G_pad is
+        padded by repeating the last member)."""
+        banks = [jnp.asarray(b, jnp.float32) for b in banks]
+        if not banks:
+            raise ValueError("empty member bank list")
+        rows = np.arange(spec.bank_rows, dtype=np.int64)
+        e = (rows % spec.rows_per_shard) * spec.num_shards + (
+            rows // spec.rows_per_shard
+        )
+        valid = e < spec.num_entities
+        safe = np.minimum(e, max(spec.num_entities - 1, 0))
+        stacked = jnp.stack(banks)
+        gathered = jnp.take(stacked, jnp.asarray(safe, jnp.int32), axis=1)
+        gathered = jnp.where(jnp.asarray(valid)[None, :, None], gathered, 0.0)
+        return cls(mesh, spec, grid_size, _reshard_grid(mesh)(gathered))
+
+    def member(self, g: int) -> ShardedREBank:
+        """Member g's bank as a pod ShardedREBank (device-side slice,
+        still entity-sharded — export/validation scoring reuse every
+        pod consumer unchanged)."""
+        data = _member_slice(self.mesh)(self.data, jnp.int32(g))
+        return ShardedREBank(self.mesh, self.spec, data)
+
+    # photon: sharding(export)
+    def member_global(self, g: int) -> Array:
+        """Replicated [E, d] view of member g (export / checkpoint /
+        parity oracles only — the CD hot path never calls this)."""
+        return self.member(g).to_global()
+
+    # photon: sharding(export)
+    def snapshot(self) -> np.ndarray:
+        """Host copy of the RAW [G_pad, rows, d] sharded layout for the
+        checkpoint plane (GridCheckpointer.save_grid_bank). The rows
+        stay in hash placement — no per-member [E, d] gather in either
+        direction; :meth:`restore` re-shards device-side."""
+        return np.asarray(self.data)
+
+    def layout(self) -> Dict[str, int]:
+        """Marker metadata guarding a snapshot against restore onto a
+        different mesh/shard layout (the row hash placement depends on
+        the entity-shard count)."""
+        return {
+            "grid_size": self.grid_size,
+            "grid_padded": self.grid_padded,
+            "num_shards": self.spec.num_shards,
+            "num_entities": self.spec.num_entities,
+            "dim": self.dim,
+        }
+
+    @classmethod
+    def restore(cls, mesh, spec: EntityShardSpec, grid_size: int,
+                data) -> "GridShardedREBank":
+        """Checkpoint restore: place a :meth:`snapshot` array back onto
+        ``P(grid, entity)`` through the cached identity jit's
+        ``out_shardings`` — the re-shard happens device-side and the
+        host never reorders rows out of hash placement."""
+        arr = jnp.asarray(data, jnp.float32)
+        if arr.ndim != 3 or int(arr.shape[1]) != spec.bank_rows:
+            raise ValueError(
+                f"snapshot shape {tuple(arr.shape)} does not match the "
+                f"{spec.num_shards}-shard bank layout "
+                f"({spec.bank_rows} rows)"
+            )
+        return cls(mesh, spec, grid_size, _reshard_grid(mesh)(arr))
+
+    def per_device_bytes(self) -> int:
+        return per_device_bytes(self.data)
+
+
+# ---------------------------------------------------------------------------
+# grid-batched sharded programs
+# ---------------------------------------------------------------------------
+#
+# The pod programs with ONE extra leading axis: member banks/slots ride
+# P(grid, entity), the per-entity block data stays P(entity) (shared by
+# every member — it is λ-independent), and the member vmap runs INSIDE
+# the shard_map body so each device solves only (its grid row × its
+# entity shard). Collectives: entity-axis psum/pmax AFTER the member
+# vmap; ONE all_to_all per exchange on [G_loc, n_dev, cap] blocks.
+
+
+def _build_grid_route_in(mesh, n_dev: int, cap: int):
+    """Hop 1 for the whole grid: [G_pad, n_pad] per-member residual
+    rows -> [G_pad, n_dev * cap] routed slot banks. The slot scatter is
+    member-batched; the exchange is ONE all_to_all with the member axis
+    riding along (``split_axis=1`` on the [G_loc, n_dev, cap] blocks)."""
+    num_slots = n_dev * cap
+
+    # photon: sharding(axes=[grid,entity], in=[grid+entity,entity], out=[grid+entity])
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(GRID_AXIS, ENTITY_AXIS), P(ENTITY_AXIS)),
+        out_specs=P(GRID_AXIS, ENTITY_AXIS),
+        check_vma=False,
+    )
+    def route_in(vals, pos):
+        def one(v):
+            buf = jnp.zeros((num_slots + 1,), v.dtype)
+            return buf.at[pos].set(v, mode="drop")[:-1]
+
+        slabs = jax.vmap(one)(vals)  # [G_loc, num_slots]
+        blocks = slabs.reshape(slabs.shape[0], n_dev, cap)
+        routed = lax.all_to_all(
+            blocks, ENTITY_AXIS, split_axis=1, concat_axis=1, tiled=False
+        )
+        return routed.reshape(slabs.shape[0], -1)
+
+    return route_in
+
+
+def _build_grid_update_program(solvers, kind: str, mesh):
+    """Grid-batched sharded bucket update: each device runs the vmapped
+    per-entity solver for ITS G_loc members on ITS entity shard's block
+    rows — G·E solves in one dispatch. Per-member (l1, l2) ride [G_pad]
+    vectors sharded over the grid axis; tracker stats come back as
+    per-member vectors (entity-psum'd after the member vmap). The bank
+    is donated off-CPU like the pod program."""
+    core = getattr(solvers, kind)
+
+    # photon: sharding(axes=[grid,entity], in=[grid+entity,entity,entity,entity,entity,entity,entity,entity,grid+entity,grid,grid], out=[grid+entity,grid,grid,grid], donates=[0])
+    @partial(jax.jit, donate_argnums=_donate_args())
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(GRID_AXIS, ENTITY_AXIS), P(ENTITY_AXIS), P(ENTITY_AXIS),
+            P(ENTITY_AXIS), P(ENTITY_AXIS), P(ENTITY_AXIS), P(ENTITY_AXIS),
+            P(ENTITY_AXIS), P(GRID_AXIS, ENTITY_AXIS), P(GRID_AXIS),
+            P(GRID_AXIS),
+        ),
+        out_specs=(
+            P(GRID_AXIS, ENTITY_AXIS), P(GRID_AXIS), P(GRID_AXIS),
+            P(GRID_AXIS),
+        ),
+        check_vma=False,
+    )
+    def fused(bank_g, lrow, valid, ix, v, lab, w, offslot, slots, l1, l2):
+        e_loc = bank_g.shape[1]
+        safe = jnp.minimum(lrow, e_loc - 1)
+        idx = jnp.where(valid, lrow, e_loc)  # pad lanes drop out of bounds
+
+        def one(bank_l, slots_m, l1_m, l2_m):
+            off = jnp.where(
+                offslot >= 0, jnp.take(slots_m, jnp.maximum(offslot, 0)), 0.0
+            )
+            sl = jnp.where(
+                valid[:, None], jnp.take(bank_l, safe, axis=0), 0.0
+            )
+            new_sl, iters, reasons = core(sl, ix, v, lab, off, w, l1_m, l2_m)
+            bank_l = bank_l.at[idx].set(new_sl, mode="drop")
+            vi = jnp.where(valid, iters, 0)
+            r = jnp.where(valid, reasons, _N_REASONS)
+            # equality-sum instead of bincount: batches cleanly under
+            # the member vmap (bincount's gather-scatter does not)
+            counts = jnp.sum(
+                (r[:, None] == jnp.arange(_N_REASONS + 1)[None, :])
+                .astype(jnp.int32),
+                axis=0,
+            )[:_N_REASONS]
+            return bank_l, jnp.sum(vi), jnp.max(vi), counts
+
+        bank_g, it_sum, it_max, counts = jax.vmap(one)(bank_g, slots, l1, l2)
+        it_sum = lax.psum(it_sum, ENTITY_AXIS)
+        it_max = lax.pmax(it_max, ENTITY_AXIS)
+        counts = lax.psum(counts, ENTITY_AXIS)
+        return bank_g, it_sum, it_max, counts
+
+    return fused
+
+
+def _build_grid_score_program(mesh, n_dev: int, cap: int):
+    """Hop 2 for the whole grid, fused with member-batched local
+    scoring: each owner scores its slots against each of its G_loc
+    member bank slices, then ONE reverse all_to_all lands every
+    member's scores back at the sending rows — [G_pad, n_pad] out."""
+    num_slots = n_dev * cap
+
+    # photon: sharding(axes=[grid,entity], in=[grid+entity,entity,entity,entity,entity,entity], out=[grid+entity])
+    @jax.jit
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(GRID_AXIS, ENTITY_AXIS), P(ENTITY_AXIS), P(ENTITY_AXIS),
+            P(ENTITY_AXIS), P(ENTITY_AXIS), P(ENTITY_AXIS),
+        ),
+        out_specs=P(GRID_AXIS, ENTITY_AXIS),
+        check_vma=False,
+    )
+    def score(bank_g, slot_lrow, slot_ix, slot_v, slot_valid, send_pos):
+        e_loc = bank_g.shape[1]
+        safe = jnp.minimum(slot_lrow, e_loc - 1)
+
+        def one(bank_l):
+            w_rows = jnp.take(bank_l, safe, axis=0)
+            s = jnp.sum(
+                slot_v * jnp.take_along_axis(w_rows, slot_ix, axis=1),
+                axis=-1,
+            )
+            return jnp.where(slot_valid, s, 0.0)
+
+        s = jax.vmap(one)(bank_g)  # [G_loc, num_slots]
+        blocks = s.reshape(s.shape[0], n_dev, cap)
+        back = lax.all_to_all(
+            blocks, ENTITY_AXIS, split_axis=1, concat_axis=1, tiled=False
+        ).reshape(s.shape[0], -1)
+        safe_p = jnp.minimum(send_pos, num_slots - 1)
+        return jnp.where(
+            send_pos[None, :] < num_slots, back[:, safe_p], 0.0
+        )
+
+    return score
+
+
+# ---------------------------------------------------------------------------
+# the grid problem
+# ---------------------------------------------------------------------------
+
+
+class UnifiedGridREProblem:
+    """λ-grid × entity-sharded twin of PodRandomEffectProblem: ONE
+    [G_pad, N·E_loc, d] bank, per-member (l1, l2) from
+    ``regularization.split(reg_weights[g])``, the pod _PodView reused
+    verbatim (its router tables, scoring slots and solver blocks are
+    member-independent), and every update/score/exchange grid-batched.
+
+    ``base`` must carry ``mesh=None`` like the pod problem — placement
+    is owned by the unified mesh plan."""
+
+    def __init__(self, base, plan: MeshPlan,
+                 reg_weights: Sequence[float]):
+        if base.mesh is not None:
+            raise ValueError(
+                "UnifiedGridREProblem wraps a mesh-less base problem; "
+                "placement is owned by the unified mesh plan"
+            )
+        mesh = plan.mesh
+        names = tuple(getattr(mesh, "axis_names", ()))
+        if GRID_AXIS not in names or ENTITY_AXIS not in names:
+            raise ValueError(
+                f"unified mesh must carry ({GRID_AXIS!r}, {ENTITY_AXIS!r}) "
+                f"axes, got {names!r}"
+            )
+        weights = [float(w) for w in reg_weights]
+        if len(weights) != plan.grid_size:
+            raise ValueError(
+                f"{len(weights)} reg weights for a grid of "
+                f"{plan.grid_size} members"
+            )
+        self.base = base
+        self.plan = plan
+        self.mesh = mesh
+        self.num_shards = int(mesh.shape[ENTITY_AXIS])
+        self.reg_weights = weights
+        padded = plan.pad_members(weights)
+        splits = [base.regularization.split(w) for w in padded]
+        grid_sharding = NamedSharding(mesh, P(GRID_AXIS))
+        self._l1 = jax.device_put(
+            jnp.asarray([s[0] for s in splits], jnp.float32), grid_sharding
+        )
+        self._l2 = jax.device_put(
+            jnp.asarray([s[1] for s in splits], jnp.float32), grid_sharding
+        )
+        self._views: Dict[int, tuple] = {}
+
+    def spec_for(self, dataset) -> EntityShardSpec:
+        return EntityShardSpec(self.num_shards, dataset.num_entities)
+
+    def init_bank(self, dataset) -> GridShardedREBank:
+        return GridShardedREBank.zeros(
+            self.mesh, self.spec_for(dataset), self.plan.grid_size,
+            self.plan.grid_padded, dataset.local_dim,
+        )
+
+    def pod_view(self, dataset) -> _PodView:  # photon: entropy(id-keyed device-view memo; weakref-pinned, never serialized)
+        key = id(dataset)
+        hit = self._views.get(key)
+        if hit is not None and hit[0]() is dataset:
+            return hit[1]
+        view = _PodView(self.mesh, dataset, self.base, axis=ENTITY_AXIS)
+        cache = self._views
+        ref = weakref.ref(dataset, lambda _, k=key, c=cache: c.pop(k, None))
+        cache[key] = (ref, view)
+        return view
+
+    def prepare(self, dataset) -> None:
+        self.pod_view(dataset)
+
+    def route_in(self, view: _PodView, residual_bank: Array) -> Array:
+        """[G_pad, n] per-member residual/offset rows -> routed
+        [G_pad, n_dev * cap] slot banks, ONE all_to_all for the grid."""
+        router = view.router
+        off = jnp.asarray(residual_bank, jnp.float32)
+        if off.shape[1] != router.num_rows_padded:
+            off = jnp.concatenate(
+                [
+                    off,
+                    jnp.zeros(
+                        (off.shape[0],
+                         router.num_rows_padded - off.shape[1]),
+                        jnp.float32,
+                    ),
+                ],
+                axis=1,
+            )
+        fn = _cached_program(
+            ("grid_route_in", _mesh_key(self.mesh), router.n_dev,
+             router.cap),
+            lambda: _build_grid_route_in(
+                self.mesh, router.n_dev, router.cap
+            ),
+        )
+        return fn(off, router._send_pos)
+
+    def update_bank(
+        self,
+        bank: GridShardedREBank,
+        dataset,
+        residual_bank: Array,
+        defer_tracker: bool = False,
+    ):
+        """One grid-batched cross-replica bank update.
+        ``residual_bank`` is the [G_pad(, or G), n] per-member
+        offsets-plus-residual rows. Returns ``(new_bank, trackers)``
+        where trackers is a per-member list of RandomEffectTracker
+        (or, with ``defer_tracker``, a Deferred resolving to it for
+        the CD loop's one batched readback)."""
+        view = self.pod_view(dataset)
+        if residual_bank.shape[0] != self.plan.grid_padded:
+            raise ValueError(
+                f"residual bank carries {residual_bank.shape[0]} members, "
+                f"expected the padded grid {self.plan.grid_padded}"
+            )
+        slots = self.route_in(view, residual_bank)  # hop 1, whole grid
+        solvers = self.base._solvers
+        data = bank.data
+        if _donate_args():
+            # defensive copy so the fused updates can DONATE the bank
+            # shards while the caller's reference stays valid
+            data = jnp.array(data, copy=True)
+        n_reals: List[int] = []
+        stat_vecs: List[Array] = []
+        for blk in view.blocks:
+            fused = _cached_program(
+                ("grid_update", _mesh_key(self.mesh), blk.kind),
+                lambda kind=blk.kind: _build_grid_update_program(
+                    solvers, kind, self.mesh
+                ),
+            )
+            data, it_sum, it_max, counts = fused(
+                data, blk.lrow, blk.valid, blk.ix, blk.v, blk.lab, blk.w,
+                blk.offslot, slots, self._l1, self._l2,
+            )
+            n_reals.append(blk.num_real)
+            # [G_pad, 2 + R] per block: (iter_sum, iter_max, counts...)
+            stat_vecs.append(
+                jnp.concatenate(
+                    [it_sum[:, None], it_max[:, None], counts], axis=1
+                )
+            )
+        new_bank = GridShardedREBank(
+            self.mesh, bank.spec, bank.grid_size, data
+        )
+        if not stat_vecs:
+            trackers = [
+                RandomEffectTracker(0, 0.0, 0, {})
+                for _ in range(bank.grid_size)
+            ]
+            return new_bank, trackers
+
+        total = max(sum(n_reals), 1)
+        g = bank.grid_size
+
+        def _finalize(all_stats, total=total, g=g):
+            # all_stats [B, G_pad, 2 + R]; padding members dropped
+            out = []
+            for m in range(g):
+                s = all_stats[:, m, :]
+                count_vec = s[:, 2:].sum(axis=0)
+                counts_dict: Dict[str, int] = {
+                    CONVERGENCE_REASON_NAMES.get(code, "?"): int(cnt)
+                    for code, cnt in enumerate(count_vec)
+                    if cnt
+                }
+                out.append(RandomEffectTracker(
+                    num_entities=total,
+                    iterations_mean=float(s[:, 0].sum()) / total,
+                    iterations_max=int(s[:, 1].max()),
+                    reason_counts=counts_dict,
+                ))
+            return out
+
+        deferred = overlap.Deferred(jnp.stack(stat_vecs), _finalize)
+        if defer_tracker and not deferred.done:
+            return new_bank, deferred
+        return new_bank, deferred.result()
+
+    def score(self, bank: GridShardedREBank, dataset) -> Array:
+        """[G_pad, n_pad] row-aligned scores for every member at once
+        (rows beyond the real row count are 0, like the pod path)."""
+        view = self.pod_view(dataset)
+        fn = _cached_program(
+            ("grid_score", _mesh_key(self.mesh), view.n_dev,
+             view.router.cap),
+            lambda: _build_grid_score_program(
+                self.mesh, view.n_dev, view.router.cap
+            ),
+        )
+        return fn(
+            bank.data, view.slot_lrow, view.slot_ix, view.slot_v,
+            view.slot_valid, view.router._send_pos,
+        )
+
+    def regularization_term_device(self, bank: GridShardedREBank) -> Array:
+        """[G_pad] per-member reg terms over the sharded grid bank —
+        one device vector joining the CD iteration's batched readback."""
+        data = bank.data
+        term = 0.5 * self._l2 * jnp.sum(data * data, axis=(1, 2))
+        return term + self._l1 * jnp.sum(jnp.abs(data), axis=(1, 2))
+
+
+# ---------------------------------------------------------------------------
+# the unified coordinate-descent trainer
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _fe_grid_scores(w_bank: Array, batch) -> Array:
+    """[G_pad, n] scores of every member's FE coefficients (module-level
+    jit: one lowering serves every run_game_grid call of this shape —
+    the 0-relowering contract the tests pin)."""
+    from photon_ml_tpu.models.glm import compute_scores
+
+    return jax.vmap(lambda w: compute_scores(w, batch))(w_bank)
+
+
+@partial(jax.jit, static_argnames=("loss", "fe_l1", "fe_l2"))
+def _grid_objective(
+    total_bank, fe_bank, re_reg_vec, base_off, labels, weights,
+    *, loss, fe_l1, fe_l2,
+) -> Array:
+    """[G_pad] per-member CD objectives: weighted loss over the summed
+    scores plus the FE reg term plus the (device-resident) RE reg
+    vector — the grid twin of CoordinateDescent._objective_deferred."""
+    z = total_bank + base_off[None, :]
+    val = jnp.sum(
+        weights[None, :] * loss.value(z, labels[None, :]), axis=1
+    )
+    fe_reg = 0.5 * fe_l2 * jnp.sum(fe_bank * fe_bank, axis=1)
+    if fe_l1:
+        fe_reg = fe_reg + fe_l1 * jnp.sum(jnp.abs(fe_bank), axis=1)
+    return val + fe_reg + re_reg_vec
+
+
+@dataclass
+class UnifiedGridGameResult:
+    """Per-member outcome of one unified grid CD run. ``fe_banks`` is
+    the final [G_pad, d] fixed-effect coefficient bank (device);
+    ``re_bank`` the final grid-sharded RE bank; histories/trackers are
+    aligned with ``re_reg_weights`` (padding members dropped)."""
+
+    plan: MeshPlan
+    re_reg_weights: List[float]
+    fe_banks: Array
+    re_bank: GridShardedREBank
+    objective_history: List[List[float]] = field(default_factory=list)
+    fe_trackers: List[object] = field(default_factory=list)
+    re_trackers: List[List[RandomEffectTracker]] = field(default_factory=list)
+
+    def fe_means(self, g: int) -> Array:
+        return self.fe_banks[g]
+
+    def re_member(self, g: int) -> ShardedREBank:
+        return self.re_bank.member(g)
+
+    def re_model(self, g: int, re_dataset) -> PodRandomEffectModel:
+        return PodRandomEffectModel(
+            self.re_bank.member(g),
+            re_dataset,
+            re_dataset.config.random_effect_type,
+            re_dataset.config.feature_shard_id,
+        )
+
+
+def run_game_grid(
+    plan: MeshPlan,
+    dataset,
+    re_dataset,
+    fe_problem,
+    re_problem,
+    re_reg_weights: Sequence[float],
+    *,
+    feature_shard_id: str,
+    fe_reg_weight: float = 0.0,
+    num_iterations: int = 2,
+    down_sampling_rate: float = 1.0,
+    sampler_seed: int = 0,
+) -> UnifiedGridGameResult:
+    """λ-grid GAME coordinate descent as ONE program family.
+
+    Runs the exact residual algebra of
+    :class:`~photon_ml_tpu.game.coordinate_descent.CoordinateDescent`
+    over (fixed effect, entity-sharded random effect) for EVERY member
+    of ``re_reg_weights`` simultaneously: the FE solves batch through
+    ``GLMOptimizationProblem.run_grid`` with a per-member offsets bank,
+    the RE updates/scores run the grid-sharded pod programs, and each
+    CD iteration issues ONE batched readback (the [G] objective vector
+    plus the RE tracker stats) — instead of G sequential pod CD loops.
+
+    Per-member semantics match the sequential pod loop: same warm
+    starts (each member from its own previous coefficients), same
+    down-sampling draw (λ-independent, one draw shared by the grid),
+    same objective accounting (loss + FE reg + RE reg per member).
+    """
+    from photon_ml_tpu.data.sampler import down_sample
+    from photon_ml_tpu.parallel.mesh import ensure_data_sharded
+
+    mesh = plan.mesh
+    G = plan.grid_size
+    g_pad = plan.grid_padded
+    uni = UnifiedGridREProblem(re_problem, plan, re_reg_weights)
+    view = uni.pod_view(re_dataset)
+    re_bank = uni.init_bank(re_dataset)
+
+    batch = dataset.batch_for_shard(feature_shard_id)
+    if down_sampling_rate < 1.0:
+        # one λ-independent draw, same PRNG stream as the sequential
+        # coordinate (weights-only rewrite; the layout is untouched)
+        batch = down_sample(
+            jax.random.PRNGKey(sampler_seed), batch, down_sampling_rate,
+            fe_problem.task,
+        )
+    batch = ensure_data_sharded(batch, mesh, ENTITY_AXIS)
+    n_pad = int(batch.labels.shape[0])
+    if n_pad != view.router.num_rows_padded:
+        raise ValueError(
+            f"row padding mismatch: batch {n_pad} vs router "
+            f"{view.router.num_rows_padded}"
+        )
+    base_off = jnp.asarray(batch.offsets, jnp.float32)
+    fe_weights = [float(fe_reg_weight)] * g_pad
+    fe_l1, fe_l2 = fe_problem.regularization.split(float(fe_reg_weight))
+    loss = fe_problem.objective.loss
+
+    fe_bank = jnp.zeros((g_pad, fe_problem.objective.dim), jnp.float32)
+    fe_scores = jnp.zeros((g_pad, n_pad), jnp.float32)
+    re_scores = jnp.zeros((g_pad, n_pad), jnp.float32)
+
+    result = UnifiedGridGameResult(
+        plan=plan,
+        re_reg_weights=[float(w) for w in re_reg_weights],
+        fe_banks=fe_bank,
+        re_bank=re_bank,
+    )
+    fe_result = None
+    for _ in range(int(num_iterations)):
+        total = fe_scores + re_scores
+        # -- fixed effect: residual = total - own; one batched solve
+        residual = total - fe_scores
+        _, fe_result = fe_problem.run_grid(
+            batch, fe_weights, initial=fe_bank, mesh=mesh,
+            offsets_bank=base_off[None, :] + residual,
+        )
+        fe_bank = fe_result.coefficients
+        fe_scores = _fe_grid_scores(fe_bank, batch)
+        total = residual + fe_scores
+        # -- random effect: grid-sharded update + fused score exchange
+        residual = total - re_scores
+        re_bank, tracker_d = uni.update_bank(
+            re_bank, re_dataset, base_off[None, :] + residual,
+            defer_tracker=True,
+        )
+        re_scores = uni.score(re_bank, re_dataset)
+        total = residual + re_scores
+        # -- one batched readback: [G] objective + RE tracker stats
+        obj_vec = _grid_objective(
+            total, fe_bank, uni.regularization_term_device(re_bank),
+            base_off, batch.labels, batch.weights,
+            loss=loss, fe_l1=fe_l1, fe_l2=fe_l2,
+        )
+        obj_d = overlap.Deferred(
+            obj_vec, lambda a, g=G: [float(x) for x in a[:g]]
+        )
+        fetch = [obj_d]
+        if hasattr(tracker_d, "result"):
+            fetch.append(tracker_d)
+        overlap.fetch_all(fetch)
+        result.objective_history.append(obj_d.result())
+        result.fe_trackers.append(fe_result)
+        result.re_trackers.append(
+            tracker_d.result() if hasattr(tracker_d, "result")
+            else tracker_d
+        )
+    result.fe_banks = fe_bank
+    result.re_bank = re_bank
+    return result

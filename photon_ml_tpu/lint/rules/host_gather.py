@@ -9,8 +9,10 @@ checkpoint surfaces call ``ShardedREBank.to_global()``. This rule makes
 that structural. Values are tainted as SHARDED when they provably hold a
 sharded bank:
 
-- constructed via ``ShardedREBank(...)`` / ``.zeros(...)`` /
-  ``.from_global(...)``;
+- constructed via ``ShardedREBank(...)`` / ``GridShardedREBank(...)``
+  (the unified-mesh λ-grid bank, game/unified.py) or their
+  ``.zeros(...)`` / ``.from_global(...)`` /
+  ``.from_member_globals(...)`` / ``.restore(...)`` classmethods;
 - loaded from a ``.sharded_bank`` / ``.variances_sharded`` attribute
   (or ``getattr(x, "sharded_bank", ...)``);
 - parameters/returns annotated ``ShardedREBank``;
@@ -52,18 +54,19 @@ from photon_ml_tpu.lint.core import (
     register_package,
 )
 
-_BANK_CLASS = "ShardedREBank"
+_BANK_CLASSES = {"ShardedREBank", "GridShardedREBank"}
 _SOURCE_ATTRS = {"sharded_bank", "variances_sharded"}
-_BANK_CLASSMETHODS = {"zeros", "from_global"}
+_BANK_CLASSMETHODS = {"zeros", "from_global", "from_member_globals",
+                      "restore"}
 # jnp reductions produce scalars/rows, not bank-shaped values
 _REDUCING_TAILS = {"sum", "mean", "max", "min", "vdot", "dot", "prod"}
 
 
 def _is_bank_name(expr: ast.AST) -> bool:
     return (
-        isinstance(expr, ast.Name) and expr.id == _BANK_CLASS
+        isinstance(expr, ast.Name) and expr.id in _BANK_CLASSES
     ) or (
-        isinstance(expr, ast.Attribute) and expr.attr == _BANK_CLASS
+        isinstance(expr, ast.Attribute) and expr.attr in _BANK_CLASSES
     )
 
 
@@ -71,12 +74,12 @@ def _annotation_mentions_bank(ann: Optional[ast.AST]) -> bool:
     if ann is None:
         return False
     for sub in ast.walk(ann):
-        if isinstance(sub, ast.Name) and sub.id == _BANK_CLASS:
+        if isinstance(sub, ast.Name) and sub.id in _BANK_CLASSES:
             return True
-        if isinstance(sub, ast.Attribute) and sub.attr == _BANK_CLASS:
+        if isinstance(sub, ast.Attribute) and sub.attr in _BANK_CLASSES:
             return True
         if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
-                and _BANK_CLASS in sub.value:
+                and any(c in sub.value for c in _BANK_CLASSES):
             return True
     return False
 
@@ -116,7 +119,7 @@ class _FileTaint:
     def _self_is_bank(self, scope: ast.AST) -> bool:
         for anc in [scope] + list(self.ctx.ancestors(scope)):
             if isinstance(anc, ast.ClassDef):
-                return anc.name == _BANK_CLASS
+                return anc.name in _BANK_CLASSES
         return False
 
     def scope_taint(self, scope: ast.AST) -> Set[str]:  # photon: entropy(id-keyed per-scope env memo; in-memory only)
@@ -262,7 +265,8 @@ def _file_violations(
     if "photon_ml_tpu" not in ctx.path_parts():
         return
     src = ctx.source
-    if _BANK_CLASS not in src and "sharded_bank" not in src:
+    if all(c not in src for c in _BANK_CLASSES) and \
+            "sharded_bank" not in src:
         return  # fast path: nothing bank-shaped in this file
     taint = _FileTaint(ctx)
     scopes = [ctx.tree] + [

@@ -43,7 +43,10 @@ from photon_ml_tpu.lint.core import (
     register_package,
 )
 
-_CONST_HINT = "DATA_AXIS/MODEL_AXIS/ENTITY_AXIS (photon_ml_tpu.parallel.mesh)"
+_CONST_HINT = (
+    "DATA_AXIS/MODEL_AXIS/ENTITY_AXIS/GRID_AXIS "
+    "(photon_ml_tpu.parallel.mesh)"
+)
 
 
 def _literal_violations(ctx: FileContext) -> Iterator[Violation]:

@@ -33,7 +33,7 @@ diffs this file against a fresh run and fails CI on drift).
 Every jit/shard_map mesh entry point in `photon_ml_tpu/`, as extracted
 by the photon-lint SPMD pass (PL011-PL014) and cross-checked against
 its `# photon: sharding(in=..., out=..., axes=...)` declaration. Spec
-tokens: axis names (`data`/`model`/`entity`), `r` = fully replicated
+tokens: axis names (`data`/`model`/`entity`/`grid`), `r` = fully replicated
 (`P()`), `a+b` = multi-axis spec, `?` = statically undeterminable,
 `*` = variadic tail. `donates` lists donated argument positions.
 

@@ -42,11 +42,12 @@ from photon_ml_tpu.lint.core import (
     call_name,
 )
 
-CANONICAL_AXES = ("data", "model", "entity")
+CANONICAL_AXES = ("data", "model", "entity", "grid")
 AXIS_CONSTANTS = {
     "DATA_AXIS": "data",
     "MODEL_AXIS": "model",
     "ENTITY_AXIS": "entity",
+    "GRID_AXIS": "grid",
 }
 
 # collective -> positional index of the axis-name argument

@@ -48,6 +48,21 @@ _FIT_CACHE: dict = {}
 _FIT_CACHE_MAX = 32
 
 
+def _row_axis(mesh) -> str:
+    """The mesh axis example rows shard over: the data axis when the
+    mesh has one; on the unified (grid, entity) mesh rows ride the
+    entity axis (the pod row convention — residual currency and the
+    two-hop exchange stay entity-aligned); else the first axis."""
+    from photon_ml_tpu.parallel.mesh import DATA_AXIS, ENTITY_AXIS
+
+    names = tuple(mesh.axis_names)
+    if DATA_AXIS in names:
+        return DATA_AXIS
+    if ENTITY_AXIS in names:
+        return ENTITY_AXIS
+    return names[0]
+
+
 @dataclass(frozen=True)
 class GLMOptimizationProblem:
     """One (task, optimizer, regularization) training problem over a
@@ -69,10 +84,12 @@ class GLMOptimizationProblem:
             return None
         return jnp.ones((self.objective.dim,)).at[self.intercept_index].set(0.0)
 
-    def _get_fit(self, track_models: bool, mesh=None, axis: str = ""):  # photon: entropy(id(mesh)-keyed jit-program memo; in-memory only)
+    # photon: entropy(id(mesh)-keyed jit-program memo; in-memory only)
+    def _get_fit(self, track_models: bool, mesh=None, axis: str = "",
+                 grid: bool = False, with_offsets: bool = False):
         """Jitted fit program (optionally shard_mapped over ``mesh``),
-        cached so repeat `run` calls skip re-tracing the optimizer
-        while_loop.
+        cached so repeat `run`/`run_grid` calls skip re-tracing the
+        optimizer while_loop.
 
         Tracing the L-BFGS while_loop over the tiled objective costs
         seconds of host time (the schedules are ~16.7M-entry pytrees);
@@ -84,97 +101,32 @@ class GLMOptimizationProblem:
         reg weights stay TRACED arguments, so a whole lambda grid is one
         compile. The cache entry pins the mesh so an id-recycled mesh
         cannot alias a stale program.
+
+        ``grid`` builds the GRID variant: ``fit(w0_bank, batch, l1_vec,
+        l2_vec)`` runs ``vmap(minimize_lbfgs/owlqn/tron)`` over a [G, d]
+        coefficient bank — the whole λ grid as ONE XLA program (1
+        compile, 1 optimizer loop, 1 dispatch for G solves). Per-member
+        convergence is active-masked by the batched ``lax.while_loop``
+        itself: jax's batching rule selects each member's carry only
+        while its own cond holds, so a converged λ's state
+        (coefficients, reason, tracker) is frozen bit-stable while the
+        loop runs on for the stragglers, and the loop exits when all G
+        are done. The objective's data pass evaluates the whole bank
+        fused: the scatter objective batches into one
+        (n×d)@(d×G)-shaped gather/contract under vmap, and the tiled
+        objective's Pallas passes swap in the flat fused grid pass via
+        custom_vmap (ops.tiled_sparse._bilinear_pass_auto) — one
+        schedule walk for the whole grid. With ``with_offsets`` the
+        grid program takes a fifth [G, n] per-member offsets bank
+        (row-sharded under a mesh) and each member solves against
+        ``batch._replace(offsets=...)`` — the unified-mesh GAME trainer's
+        residual currency.
         """
         import jax
 
         key = (
-            self.objective,
-            self.config,
-            self.regularization,
-            self.box,
-            self.intercept_index,
-            track_models,
-            id(mesh) if mesh is not None else None,
-            axis,
-        )
-        try:
-            hash(key)
-            cache = _FIT_CACHE
-        except TypeError:
-            if "_local_fit_cache" not in self.__dict__:
-                object.__setattr__(self, "_local_fit_cache", {})
-            cache = self._local_fit_cache
-            key = (track_models, id(mesh) if mesh is not None else None, axis)
-        hit = cache.get(key)
-        if hit is not None:
-            return hit[0]
-        optimize = make_optimizer(
-            self.config,
-            self.regularization,
-            loss_has_hessian=self.objective.loss.has_hessian,
-            box=self.box,
-            l1_mask=self._l1_mask(),
-            track_coefficients=track_models,
-        )
-        needs_hvp = self.config.optimizer_type == OptimizerType.TRON
-        objective = (
-            self.objective if mesh is None else self.objective.with_axis(axis)
-        )
-
-        def fit(w0, batch, l1, l2):
-            def vg(w):
-                return objective.value_and_gradient(w, batch, l2)
-
-            def hvp(w, d):
-                return objective.hessian_vector(w, d, batch, l2)
-
-            return optimize(
-                vg, w0, l1_weight=l1, hvp_fn=hvp if needs_hvp else None
-            )
-
-        if mesh is not None:
-            from functools import partial as _partial
-
-            from jax import shard_map
-            from jax.sharding import PartitionSpec as P
-
-            # photon: sharding(axes=[data], in=[r,data,r,r], out=[r])
-            fit = _partial(
-                shard_map,
-                mesh=mesh,
-                in_specs=(P(), P(axis), P(), P()),
-                out_specs=P(),
-                check_vma=False,
-            )(fit)
-        fit = jax.jit(fit)
-
-        while len(cache) >= _FIT_CACHE_MAX:
-            cache.pop(next(iter(cache)))
-        cache[key] = (fit, mesh)
-        return fit
-
-    def _get_grid_fit(self, track_models: bool, mesh=None, axis: str = ""):  # photon: entropy(id(mesh)-keyed jit-program memo; in-memory only)
-        """Jitted GRID fit: ``fit(w0_bank, batch, l1_vec, l2_vec)`` runs
-        ``vmap(minimize_lbfgs/owlqn/tron)`` over a [G, d] coefficient bank
-        — the whole λ grid as ONE XLA program (1 compile, 1 optimizer
-        loop, 1 dispatch for G solves).
-
-        Per-member convergence is active-masked by the batched
-        ``lax.while_loop`` itself: jax's batching rule selects each
-        member's carry only while its own cond holds, so a converged λ's
-        state (coefficients, reason, tracker) is frozen bit-stable while
-        the loop runs on for the stragglers, and the loop exits when all
-        G are done. The objective's data pass evaluates the whole bank
-        fused: the scatter objective batches into one (n×d)@(d×G)-shaped
-        gather/contract under vmap, and the tiled objective's Pallas
-        passes swap in the flat fused grid pass via custom_vmap
-        (ops.tiled_sparse._bilinear_pass_auto) — one schedule walk for
-        the whole grid. Cached like :meth:`_get_fit`.
-        """
-        import jax
-
-        key = (
-            "grid",
+            "grid" if grid else "fit",
+            with_offsets,
             self.objective,
             self.config,
             self.regularization,
@@ -192,7 +144,7 @@ class GLMOptimizationProblem:
                 object.__setattr__(self, "_local_fit_cache", {})
             cache = self._local_fit_cache
             key = (
-                "grid", track_models,
+                "grid" if grid else "fit", with_offsets, track_models,
                 id(mesh) if mesh is not None else None, axis,
             )
         hit = cache.get(key)
@@ -211,19 +163,36 @@ class GLMOptimizationProblem:
             self.objective if mesh is None else self.objective.with_axis(axis)
         )
 
-        def fit(w0_bank, batch, l1_vec, l2_vec):
-            def run_one(w0, l1, l2):
-                def vg(w):
-                    return objective.value_and_gradient(w, batch, l2)
+        def solve_one(w0, batch, l1, l2):
+            def vg(w):
+                return objective.value_and_gradient(w, batch, l2)
 
-                def hvp(w, d):
-                    return objective.hessian_vector(w, d, batch, l2)
+            def hvp(w, d):
+                return objective.hessian_vector(w, d, batch, l2)
 
-                return optimize(
-                    vg, w0, l1_weight=l1, hvp_fn=hvp if needs_hvp else None
-                )
+            return optimize(
+                vg, w0, l1_weight=l1, hvp_fn=hvp if needs_hvp else None
+            )
 
-            return jax.vmap(run_one)(w0_bank, l1_vec, l2_vec)
+        if not grid:
+            fit = solve_one
+        elif with_offsets:
+
+            def fit(w0_bank, batch, l1_vec, l2_vec, off_bank):
+                def run_one(w0, l1, l2, off):
+                    return solve_one(
+                        w0, batch._replace(offsets=off), l1, l2
+                    )
+
+                return jax.vmap(run_one)(w0_bank, l1_vec, l2_vec, off_bank)
+
+        else:
+
+            def fit(w0_bank, batch, l1_vec, l2_vec):
+                def run_one(w0, l1, l2):
+                    return solve_one(w0, batch, l1, l2)
+
+                return jax.vmap(run_one)(w0_bank, l1_vec, l2_vec)
 
         if mesh is not None:
             from functools import partial as _partial
@@ -231,11 +200,14 @@ class GLMOptimizationProblem:
             from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
-            # photon: sharding(axes=[data], in=[r,data,r,r], out=[r])
+            in_specs = (P(), P(axis), P(), P())
+            if grid and with_offsets:
+                in_specs = in_specs + (P(None, axis),)
+            # photon: sharding(axes=[data], in=?, out=[r])
             fit = _partial(
                 shard_map,
                 mesh=mesh,
-                in_specs=(P(), P(axis), P(), P()),
+                in_specs=in_specs,
                 out_specs=P(),
                 check_vma=False,
             )(fit)
@@ -246,6 +218,82 @@ class GLMOptimizationProblem:
         cache[key] = (fit, mesh)
         return fit
 
+    # photon: entropy(id(mesh)-keyed jit-program memo; in-memory only)
+    def _get_hdiag(self, mesh=None, axis: str = "", grid: bool = False,
+                   with_offsets: bool = False):
+        """Jitted Hessian-diagonal pass (variance computation), cached
+        like :meth:`_get_fit` — one builder for all four call sites
+        (single/grid × replicated/sharded). Grid signature:
+        ``hdiag(w_bank, batch, l2_vec[, off_bank])``."""
+        import jax
+
+        key = (
+            "hdiag", grid, with_offsets, self.objective,
+            id(mesh) if mesh is not None else None, axis,
+        )
+        try:
+            hash(key)
+            cache = _FIT_CACHE
+        except TypeError:
+            if "_local_fit_cache" not in self.__dict__:
+                object.__setattr__(self, "_local_fit_cache", {})
+            cache = self._local_fit_cache
+            key = (
+                "hdiag", grid, with_offsets,
+                id(mesh) if mesh is not None else None, axis,
+            )
+        hit = cache.get(key)
+        if hit is not None:
+            return hit[0]
+        objective = (
+            self.objective if mesh is None else self.objective.with_axis(axis)
+        )
+
+        def one(w, batch, l2):
+            return objective.hessian_diagonal(w, batch, l2)
+
+        if not grid:
+            hdiag = one
+        elif with_offsets:
+
+            def hdiag(w_bank, batch, l2_vec, off_bank):
+                return jax.vmap(
+                    lambda w, l2, off: one(
+                        w, batch._replace(offsets=off), l2
+                    )
+                )(w_bank, l2_vec, off_bank)
+
+        else:
+
+            def hdiag(w_bank, batch, l2_vec):
+                return jax.vmap(lambda w, l2: one(w, batch, l2))(
+                    w_bank, l2_vec
+                )
+
+        if mesh is not None:
+            from functools import partial as _partial
+
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            in_specs = (P(), P(axis), P())
+            if grid and with_offsets:
+                in_specs = in_specs + (P(None, axis),)
+            # photon: sharding(axes=[data], in=?, out=[r])
+            hdiag = _partial(
+                shard_map,
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=P(),
+                check_vma=False,
+            )(hdiag)
+        hdiag = jax.jit(hdiag)
+
+        while len(cache) >= _FIT_CACHE_MAX:
+            cache.pop(next(iter(cache)))
+        cache[key] = (hdiag, mesh)
+        return hdiag
+
     def run_grid(
         self,
         batch: Batch,
@@ -253,6 +301,7 @@ class GLMOptimizationProblem:
         initial: Optional[Array] = None,
         mesh=None,
         track_models: bool = False,
+        offsets_bank: Optional[Array] = None,
     ):
         """Solve the whole λ grid in ONE batched program.
 
@@ -263,6 +312,12 @@ class GLMOptimizationProblem:
         reg_weights[i]); ``variances_bank`` is None unless
         ``compute_variances`` (the Hdiag pass is a second program — the
         1-compile contract covers the fit itself).
+
+        ``offsets_bank`` ([G, n]) gives each member its OWN row offsets
+        (``batch.offsets`` is ignored): the unified-mesh GAME trainer's
+        per-member residual currency, where member g's fixed effect
+        solves against base offsets + its own residual. Columns short of
+        the (padded) batch row count are zero-extended.
 
         Unlike :meth:`run` driven sequentially, members do NOT warm-start
         from each other — every λ starts from ``initial`` (see the README
@@ -282,6 +337,17 @@ class GLMOptimizationProblem:
                     w0, (G, self.objective.dim)
                 )
             )
+        with_offsets = offsets_bank is not None
+
+        def _pad_offsets(rows: int) -> Array:
+            off = jnp.asarray(offsets_bank, jnp.float32)
+            if off.shape[1] < rows:
+                off = jnp.concatenate(
+                    [off, jnp.zeros((off.shape[0], rows - off.shape[1]),
+                                    jnp.float32)],
+                    axis=1,
+                )
+            return off
 
         if mesh is None:
             from photon_ml_tpu.data.batch import SparseBatch
@@ -294,28 +360,25 @@ class GLMOptimizationProblem:
                 batch, SparseBatch
             ):
                 batch = ensure_tiled(batch, self.objective.dim)
-            fit = self._get_grid_fit(track_models)
-            result = fit(w0_bank, batch, l1_vec, l2_vec)
+            fit = self._get_fit(
+                track_models, grid=True, with_offsets=with_offsets
+            )
+            extras = (
+                (_pad_offsets(int(batch.offsets.shape[0])),)
+                if with_offsets else ()
+            )
+            result = fit(w0_bank, batch, l1_vec, l2_vec, *extras)
             variances = None
             if self.compute_variances:
-                import jax
-
-                hdiag = jax.jit(jax.vmap(
-                    lambda w, l2: self.objective.hessian_diagonal(
-                        w, batch, l2
-                    )
-                ))(result.coefficients, l2_vec)
+                hdiag = self._get_hdiag(
+                    grid=True, with_offsets=with_offsets
+                )(result.coefficients, batch, l2_vec, *extras)
                 variances = 1.0 / (hdiag + _VARIANCE_EPSILON)
             return variances, result
 
-        from functools import partial as _partial
+        from photon_ml_tpu.parallel.mesh import ensure_data_sharded
 
-        from jax import shard_map
-        from jax.sharding import PartitionSpec as P
-
-        from photon_ml_tpu.parallel.mesh import DATA_AXIS, ensure_data_sharded
-
-        axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
+        axis = _row_axis(mesh)
         from photon_ml_tpu.ops.tiled_sparse import (
             TiledGLMObjective,
             ensure_tiled_sharded,
@@ -325,31 +388,26 @@ class GLMOptimizationProblem:
             sharded = ensure_tiled_sharded(batch, self.objective.dim, mesh, axis)
         else:
             sharded = ensure_data_sharded(batch, mesh, axis)
-        fit = self._get_grid_fit(track_models, mesh=mesh, axis=axis)
-        result = fit(w0_bank, sharded, l1_vec, l2_vec)
+        fit = self._get_fit(
+            track_models, mesh=mesh, axis=axis, grid=True,
+            with_offsets=with_offsets,
+        )
+        extras = ()
+        if with_offsets:
+            from jax import device_put
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            off = _pad_offsets(int(sharded.offsets.shape[0]))
+            extras = (
+                device_put(off, NamedSharding(mesh, P(None, axis))),
+            )
+        result = fit(w0_bank, sharded, l1_vec, l2_vec, *extras)
         variances = None
         if self.compute_variances:
-            import jax
-
-            objective = self.objective.with_axis(axis)
-
-            # photon: sharding(axes=[data], in=[r,data,r], out=[r])
-            @jax.jit
-            @_partial(
-                shard_map,
-                mesh=mesh,
-                in_specs=(P(), P(axis), P()),
-                out_specs=P(),
-                check_vma=False,
-            )
-            def _hdiag_grid(w_bank, b, l2v):
-                import jax as _jax
-
-                return _jax.vmap(
-                    lambda w, l2_: objective.hessian_diagonal(w, b, l2_)
-                )(w_bank, l2v)
-
-            hdiag = _hdiag_grid(result.coefficients, sharded, l2_vec)
+            hdiag = self._get_hdiag(
+                mesh=mesh, axis=axis, grid=True, with_offsets=with_offsets
+            )(result.coefficients, sharded, l2_vec, *extras)
             variances = 1.0 / (hdiag + _VARIANCE_EPSILON)
         return variances, result
 
@@ -407,14 +465,9 @@ class GLMOptimizationProblem:
                 variances = 1.0 / (hdiag + _VARIANCE_EPSILON)
             return Coefficients(result.coefficients, variances), result
 
-        from functools import partial as _partial
+        from photon_ml_tpu.parallel.mesh import ensure_data_sharded
 
-        from jax import shard_map
-        from jax.sharding import PartitionSpec as P
-
-        from photon_ml_tpu.parallel.mesh import DATA_AXIS, ensure_data_sharded
-
-        axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
+        axis = _row_axis(mesh)
         from photon_ml_tpu.ops.tiled_sparse import TiledGLMObjective, ensure_tiled_sharded
 
         if isinstance(self.objective, TiledGLMObjective):
@@ -429,20 +482,9 @@ class GLMOptimizationProblem:
 
         variances = None
         if self.compute_variances:
-            objective = self.objective.with_axis(axis)
-
-            # photon: sharding(axes=[data], in=[r,data,r], out=[r])
-            @_partial(
-                shard_map,
-                mesh=mesh,
-                in_specs=(P(), P(axis), P()),
-                out_specs=P(),
-                check_vma=False,
+            hdiag = self._get_hdiag(mesh=mesh, axis=axis)(
+                result.coefficients, sharded, jnp.float32(l2)
             )
-            def _hdiag(w, b, l2_):
-                return objective.hessian_diagonal(w, b, l2_)
-
-            hdiag = _hdiag(result.coefficients, sharded, jnp.float32(l2))
             variances = 1.0 / (hdiag + _VARIANCE_EPSILON)
         return Coefficients(result.coefficients, variances), result
 

@@ -493,30 +493,15 @@ def feature_sharded_sparse_fit_tron(
     the reference's hottest distributed loop (one treeAggregate round-trip
     per CG iteration, SURVEY §3.2) becomes a while_loop whose every CG
     step is two psums over ICI. L2/none only (TRON+L1 is rejected by the
-    optimizer factory, matching OptimizerFactory.scala:49-86)."""
-    from photon_ml_tpu.optim.tron import minimize_tron
+    optimizer factory, matching OptimizerFactory.scala:49-86).
 
-    loss = objective.loss
-
-    # photon: sharding(axes=[data,model], in=?, out=?)
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=_sparse_shard_specs(model_axis, data_axis),
-        out_specs=_opt_result_specs(model_axis),
-        check_vma=False,
+    Thin wrapper over :func:`feature_sharded_glm_fit` (the one sharded
+    program family) preserving this entry point's historical defaults."""
+    return feature_sharded_glm_fit(
+        objective, mesh, layout="sparse", optimizer="tron",
+        data_axis=data_axis, model_axis=model_axis,
+        max_iter=max_iter, tol=tol, max_cg=max_cg,
     )
-    def fit(w0_block, b, l2):
-        vg = _sparse_block_vg(loss, b, l2, model_axis, data_axis)
-        factory = _sparse_block_hvp_factory(
-            loss, b, l2, model_axis, data_axis
-        )
-        return minimize_tron(
-            vg, None, w0_block, max_iter=max_iter, tol=tol, max_cg=max_cg,
-            axis_name=model_axis, hvp_factory=factory,
-        )
-
-    return jax.jit(fit)
 
 
 def feature_sharded_sparse_value_and_grad(
@@ -596,25 +581,15 @@ def feature_sharded_sparse_fit(
     Per evaluation: one psum of partial margins over the model axis + one
     psum of the block gradient over the data axis; gradient and optimizer
     state never leave their block's devices.
+
+    Thin wrapper over :func:`feature_sharded_glm_fit` (the one sharded
+    program family) preserving this entry point's historical defaults.
     """
-    loss = objective.loss
-
-    # photon: sharding(axes=[data,model], in=?, out=?)
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=_sparse_shard_specs(model_axis, data_axis),
-        out_specs=_opt_result_specs(model_axis),
-        check_vma=False,
+    return feature_sharded_glm_fit(
+        objective, mesh, layout="sparse", optimizer="lbfgs",
+        data_axis=data_axis, model_axis=model_axis,
+        max_iter=max_iter, tol=tol, history=history,
     )
-    def fit(w0_block, b, l2):
-        return minimize_lbfgs(
-            _sparse_block_vg(loss, b, l2, model_axis, data_axis),
-            w0_block, max_iter=max_iter, tol=tol, history=history,
-            axis_name=model_axis,
-        )
-
-    return jax.jit(fit)
 
 
 def feature_sharded_tiled_fit(
@@ -642,87 +617,16 @@ def feature_sharded_tiled_fit(
     pattern per evaluation: one psum of partial margins over "model", one
     psum of the block gradient over "data" — identical to the scatter
     layout, so the optimizer and convergence rules are unchanged.
+
+    Thin wrapper over :func:`feature_sharded_glm_fit` (the one sharded
+    program family) preserving this entry point's historical defaults.
     """
-    from photon_ml_tpu.ops.tiled_sparse import tiled_block_local_vg
-    from photon_ml_tpu.utils.backend import effective_platform
-
-    if interpret is None:
-        interpret = effective_platform() == "cpu"
-    loss = objective.loss
-    sched_spec = P((data_axis, model_axis))
-    base_specs = (
-        P(model_axis),  # w0 block
-        sched_spec,  # z_sched (_Schedule pytree prefix)
-        sched_spec,  # g_sched
-        P(data_axis),  # labels
-        P(data_axis),  # offsets
-        P(data_axis),  # weights
-        P(),  # l2
+    return feature_sharded_glm_fit(
+        objective, mesh, meta, layout="tiled",
+        optimizer="owlqn" if owlqn else "lbfgs",
+        data_axis=data_axis, model_axis=model_axis,
+        max_iter=max_iter, tol=tol, history=history, interpret=interpret,
     )
-
-    if owlqn:
-        from photon_ml_tpu.optim.lbfgs import minimize_owlqn
-
-        # photon: sharding(axes=[data,model], in=?, out=?)
-        @partial(
-            shard_map,
-            mesh=mesh,
-            in_specs=base_specs + (P(), P(model_axis)),
-            out_specs=_opt_result_specs(model_axis),
-            check_vma=False,
-        )
-        def _fit(w0_block, z_sched, g_sched, labels, offsets, weights, l2,
-                 l1, l1_mask_block):
-            from photon_ml_tpu.ops.tiled_sparse import FeatureShardedTiledBatch
-
-            cell = FeatureShardedTiledBatch(
-                meta, z_sched, g_sched, labels, offsets, weights
-            )
-            vg = tiled_block_local_vg(
-                loss, cell, data_axis, model_axis, l2, interpret=interpret
-            )
-            return minimize_owlqn(
-                vg, w0_block, l1, max_iter=max_iter, tol=tol,
-                history=history, l1_mask=l1_mask_block,
-                axis_name=model_axis,
-            )
-
-        def fit(w0, batch, l2, l1, l1_mask):
-            return _fit(
-                w0, batch.z_sched, batch.g_sched, batch.labels,
-                batch.offsets, batch.weights, l2, l1, l1_mask,
-            )
-    else:
-
-        # photon: sharding(axes=[data,model], in=?, out=?)
-        @partial(
-            shard_map,
-            mesh=mesh,
-            in_specs=base_specs,
-            out_specs=_opt_result_specs(model_axis),
-            check_vma=False,
-        )
-        def _fit(w0_block, z_sched, g_sched, labels, offsets, weights, l2):
-            from photon_ml_tpu.ops.tiled_sparse import FeatureShardedTiledBatch
-
-            cell = FeatureShardedTiledBatch(
-                meta, z_sched, g_sched, labels, offsets, weights
-            )
-            vg = tiled_block_local_vg(
-                loss, cell, data_axis, model_axis, l2, interpret=interpret
-            )
-            return minimize_lbfgs(
-                vg, w0_block, max_iter=max_iter, tol=tol, history=history,
-                axis_name=model_axis,
-            )
-
-        def fit(w0, batch, l2):
-            return _fit(
-                w0, batch.z_sched, batch.g_sched, batch.labels,
-                batch.offsets, batch.weights, l2,
-            )
-
-    return jax.jit(fit)
 
 
 def feature_sharded_tiled_fit_tron(
@@ -744,53 +648,15 @@ def feature_sharded_tiled_fit_tron(
     10B-coefficient layout. Collective pattern per CG step: one psum of
     the direction's partial margins over "model" + one psum of the block
     Hv over "data" — identical to the scatter TRON, so convergence rules
-    are unchanged. L2/none only (TRON+L1 rejected by the factory)."""
-    from photon_ml_tpu.optim.tron import minimize_tron
-    from photon_ml_tpu.ops.tiled_sparse import (
-        FeatureShardedTiledBatch,
-        tiled_block_local_hvp_factory,
-        tiled_block_local_vg,
+    are unchanged. L2/none only (TRON+L1 rejected by the factory).
+
+    Thin wrapper over :func:`feature_sharded_glm_fit` (the one sharded
+    program family) preserving this entry point's historical defaults."""
+    return feature_sharded_glm_fit(
+        objective, mesh, meta, layout="tiled", optimizer="tron",
+        data_axis=data_axis, model_axis=model_axis,
+        max_iter=max_iter, tol=tol, max_cg=max_cg, interpret=interpret,
     )
-    from photon_ml_tpu.utils.backend import effective_platform
-
-    if interpret is None:
-        interpret = effective_platform() == "cpu"
-    loss = objective.loss
-    sched_spec = P((data_axis, model_axis))
-
-    # photon: sharding(axes=[data,model], in=?, out=?)
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(
-            P(model_axis), sched_spec, sched_spec,
-            P(data_axis), P(data_axis), P(data_axis), P(),
-        ),
-        out_specs=_opt_result_specs(model_axis),
-        check_vma=False,
-    )
-    def _fit(w0_block, z_sched, g_sched, labels, offsets, weights, l2):
-        cell = FeatureShardedTiledBatch(
-            meta, z_sched, g_sched, labels, offsets, weights
-        )
-        vg = tiled_block_local_vg(
-            loss, cell, data_axis, model_axis, l2, interpret=interpret
-        )
-        factory = tiled_block_local_hvp_factory(
-            loss, cell, data_axis, model_axis, l2, interpret=interpret
-        )
-        return minimize_tron(
-            vg, None, w0_block, max_iter=max_iter, tol=tol, max_cg=max_cg,
-            axis_name=model_axis, hvp_factory=factory,
-        )
-
-    def fit(w0, batch, l2):
-        return _fit(
-            w0, batch.z_sched, batch.g_sched, batch.labels,
-            batch.offsets, batch.weights, l2,
-        )
-
-    return jax.jit(fit)
 
 
 # Jitted feature-sharded fit programs shared across builder calls: a
@@ -1224,25 +1090,12 @@ def feature_sharded_sparse_fit_owlqn(
     full [d_pad] 0/1 vector — 0 exempts a slot, e.g. the intercept — split
     over the model axis like w); the L1 term lives in the optimizer
     (pseudo-gradient/orthant rules are elementwise over the local block,
-    scalars psum — same recipe as L-BFGS)."""
-    from photon_ml_tpu.optim.lbfgs import minimize_owlqn
+    scalars psum — same recipe as L-BFGS).
 
-    loss = objective.loss
-
-    # photon: sharding(axes=[data,model], in=?, out=?)
-    @partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=_sparse_shard_specs(model_axis, data_axis)
-        + (P(), P(model_axis)),
-        out_specs=_opt_result_specs(model_axis),
-        check_vma=False,
+    Thin wrapper over :func:`feature_sharded_glm_fit` (the one sharded
+    program family) preserving this entry point's historical defaults."""
+    return feature_sharded_glm_fit(
+        objective, mesh, layout="sparse", optimizer="owlqn",
+        data_axis=data_axis, model_axis=model_axis,
+        max_iter=max_iter, tol=tol, history=history,
     )
-    def fit(w0_block, b, l2, l1, l1_mask_block):
-        return minimize_owlqn(
-            _sparse_block_vg(loss, b, l2, model_axis, data_axis),
-            w0_block, l1, max_iter=max_iter, tol=tol, history=history,
-            l1_mask=l1_mask_block, axis_name=model_axis,
-        )
-
-    return jax.jit(fit)

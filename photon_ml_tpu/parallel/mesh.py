@@ -32,6 +32,12 @@ MODEL_AXIS = "model"
 # all_to_alls instead of host gathers. Distinct from DATA_AXIS so an
 # entity mesh can coexist with a (data, model) FE mesh in one driver.
 ENTITY_AXIS = "entity"
+# Unified-mesh λ-grid axis (parallel/unified_mesh.py): grid members
+# (regularization weights) shard over this axis so a [G, ...] coefficient
+# bank / optimizer-state bank is P(grid, entity)-sharded and the whole
+# sweep runs as ONE shard_mapped program. Orthogonal to the other three:
+# a (grid, entity) mesh trains G entity-sharded GAME members at once.
+GRID_AXIS = "grid"
 
 
 def make_mesh(

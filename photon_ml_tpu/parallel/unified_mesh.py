@@ -1,0 +1,148 @@
+"""Unified (data × feature × entity × grid) mesh-shape policy.
+
+One mesh, four axis roles (parallel/mesh.py constants):
+
+- ``data``    — example rows. On the unified mesh the ENTITY axis doubles
+  as the row axis for row-aligned currency (residuals, scores): rows are
+  sharded over the entity axis exactly like the pod path, so the
+  two-hop residual exchange stays one all_to_all per CD iteration.
+- ``model``   — feature/coefficient blocks (the feature-sharded FE solve).
+  ``feature_blocks`` records the requested block count; the unified GAME
+  grid program keeps the FE member solves replicated (feature_blocks=1)
+  and the (data, model) mesh family covers the sharded-FE sweep.
+- ``entity``  — hash-sharded random-effect banks (game/pod.py ownership
+  rule: entity ``e`` lives on shard ``e % N`` at local row ``e // N``).
+- ``grid``    — λ-grid members. A [G, ...] coefficient/optimizer bank is
+  ``P(grid, entity)``-sharded so the whole regularization sweep runs as
+  ONE shard_mapped program (game/unified.py), the tile schedule is
+  walked once per grid, and the entity all_to_all is amortized across
+  the grid axis.
+
+:func:`resolve_mesh` is the one driver policy seam: given the device
+pool, the grid size G, the requested entity shard count N and the
+per-member bank footprint, it picks the (grid_rows, entity_shards) mesh
+shape, preferring grid rows that divide G (no padding members) and
+reporting the per-device bank bytes against the memory budget — the
+entity-sharded twin of ``training.resolve_grid_mode``'s replicated
+accounting.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_ml_tpu.parallel.mesh import ENTITY_AXIS, GRID_AXIS, make_mesh
+
+__all__ = ["MeshPlan", "resolve_mesh"]
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """Resolved unified-mesh shape for one λ-grid × entity-sharded run.
+
+    ``mesh`` carries axes ``(grid, entity)`` with shape
+    ``(grid_rows, entity_shards)`` over the first
+    ``grid_rows * entity_shards`` devices. ``members_per_row`` is the
+    per-grid-row member count G_loc = ceil(G / grid_rows); the bank's
+    leading axis is padded to ``grid_padded = grid_rows * G_loc``
+    (padding members run inert copies of the last λ and are dropped at
+    unpack)."""
+
+    mesh: Mesh
+    grid_size: int
+    grid_rows: int
+    entity_shards: int
+    feature_blocks: int
+    members_per_row: int
+    per_device_bank_bytes: int
+    budget_bytes: Optional[int]
+
+    @property
+    def grid_padded(self) -> int:
+        return self.grid_rows * self.members_per_row
+
+    @property
+    def fits_budget(self) -> bool:
+        return (
+            self.budget_bytes is None
+            or self.per_device_bank_bytes <= self.budget_bytes
+        )
+
+    def grid_entity_sharding(self) -> NamedSharding:
+        """Sharding of a [G_pad, n_shards * E_loc, ...] bank: members
+        over the grid axis, bank rows over the entity axis."""
+        return NamedSharding(self.mesh, P(GRID_AXIS, ENTITY_AXIS))
+
+    def pad_members(self, values):
+        """Pad a per-member list to ``grid_padded`` by repeating the
+        last member (inert duplicates, dropped at unpack)."""
+        values = list(values)
+        if not values:
+            raise ValueError("empty member list")
+        while len(values) < self.grid_padded:
+            values.append(values[-1])
+        return values
+
+
+def resolve_mesh(
+    devices=None,
+    grid_size: int = 1,
+    entity_shards: Optional[int] = None,
+    feature_blocks: Optional[int] = None,
+    budget: Optional[int] = None,
+    *,
+    member_bank_bytes: int = 0,
+) -> MeshPlan:
+    """Pick the (grid, entity) mesh shape for a G-member λ-grid over an
+    N-entity-sharded GAME model.
+
+    Policy: the entity axis gets exactly ``entity_shards`` devices
+    (default 1 — replicated-bank semantics on a 1-wide axis); the grid
+    axis gets the largest row count that (a) fits the remaining device
+    pool and (b) divides G when any divisor fits, so no padding members
+    run. ``member_bank_bytes`` (one member's bank + optimizer state,
+    e.g. ``training.grid_bank_bytes(1, dim, ...)``) feeds the per-device
+    accounting: under P(grid, entity) each device holds
+    ``G_loc * bytes / N`` — the ~1/(R·N) footprint the replicated budget
+    check cannot see."""
+    devices = list(devices if devices is not None else jax.devices())
+    n_dev = len(devices)
+    if grid_size < 1:
+        raise ValueError(f"grid_size must be >= 1, got {grid_size}")
+    n_ent = 1 if entity_shards is None or entity_shards == 0 else (
+        n_dev if entity_shards == -1 else int(entity_shards)
+    )
+    if not 1 <= n_ent <= n_dev:
+        raise ValueError(
+            f"entity_shards {entity_shards} out of range for {n_dev} "
+            "visible devices"
+        )
+    blocks = 1 if feature_blocks is None else int(feature_blocks)
+    if blocks < 1:
+        raise ValueError(f"feature_blocks must be >= 1, got {feature_blocks}")
+
+    usable = max(1, n_dev // n_ent)
+    divisors = [r for r in range(1, usable + 1) if grid_size % r == 0]
+    grid_rows = max(divisors) if divisors else min(usable, grid_size)
+    members_per_row = -(-grid_size // grid_rows)
+    per_device = (members_per_row * int(member_bank_bytes)) // max(n_ent, 1)
+
+    mesh = make_mesh(
+        (grid_rows, n_ent),
+        (GRID_AXIS, ENTITY_AXIS),
+        devices[: grid_rows * n_ent],
+    )
+    return MeshPlan(
+        mesh=mesh,
+        grid_size=int(grid_size),
+        grid_rows=int(grid_rows),
+        entity_shards=int(n_ent),
+        feature_blocks=blocks,
+        members_per_row=int(members_per_row),
+        per_device_bank_bytes=int(per_device),
+        budget_bytes=None if budget is None else int(budget),
+    )

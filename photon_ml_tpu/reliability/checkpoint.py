@@ -131,6 +131,71 @@ class GridCheckpointer:
         }
         return out
 
+    # -- unified-mesh grid banks (game/unified.py) ----------------------------
+    #
+    # The sharded λ-grid bank snapshots in its RAW [G_pad, rows, d]
+    # hash-placement layout (GridShardedREBank.snapshot, a declared
+    # export scope); restore hands the loaded array to
+    # GridShardedREBank.restore, whose jit out_shardings re-shard it
+    # device-side — neither direction builds a host [E, d] view. The
+    # marker records the layout so a snapshot cannot silently restore
+    # onto a different entity-shard count.
+
+    def _grid_base(self, name: str) -> str:
+        safe = "".join(
+            c if c.isalnum() or c in "-_" else "_" for c in str(name)
+        )
+        return os.path.join(self.directory, f"grid-bank-{safe}")
+
+    def has_grid_bank(self, name: str) -> bool:
+        return _read_marker(self._grid_base(name) + ".json") is not None
+
+    def save_grid_bank(
+        self, name: str, bank_snapshot: np.ndarray,
+        layout: Dict[str, int],
+    ) -> None:
+        """Commit a GridShardedREBank.snapshot() array (same tmp+rename
+        npz then atomic-marker protocol as the per-λ snapshots)."""
+        base = self._grid_base(name)
+        _save_npz(base + ".npz", {"bank": np.asarray(bank_snapshot)})
+        io_call(
+            "ckpt_save", atomic_write_json, base + ".json",
+            {"name": str(name), "layout": {
+                k: int(v) for k, v in layout.items()
+            }},
+            detail=base + ".json",
+        )
+
+    def load_grid_bank(
+        self, name: str, expect_layout: Optional[Dict[str, int]] = None,
+    ) -> Optional[Tuple[np.ndarray, Dict[str, int]]]:
+        """(snapshot, layout) for a committed grid bank, or None. With
+        ``expect_layout``, a committed snapshot whose recorded layout
+        disagrees raises — restoring hash-placed rows onto a different
+        shard count would scramble entity ownership silently."""
+        base = self._grid_base(name)
+        marker = _read_marker(base + ".json")
+        if marker is None:
+            return None
+        layout = {
+            k: int(v) for k, v in dict(marker.get("layout") or {}).items()
+        }
+        if expect_layout is not None:
+            mismatched = {
+                k: (layout.get(k), int(v))
+                for k, v in expect_layout.items()
+                if layout.get(k) != int(v)
+            }
+            if mismatched:
+                raise ValueError(
+                    f"grid-bank snapshot {name!r} was written under a "
+                    f"different layout: {mismatched} (recorded vs "
+                    "expected); re-run with the original mesh shape or "
+                    "start fresh"
+                )
+        arrays = _load_npz(base + ".npz")
+        return arrays["bank"], layout
+
 
 class StreamingCDCheckpointer:
     """Per-iteration snapshots of the streamed GAME coordinate-descent

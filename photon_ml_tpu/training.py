@@ -284,16 +284,21 @@ def grid_bank_bytes(
     dim: int,
     optimizer_type: OptimizerType = OptimizerType.LBFGS,
     history: int = 10,
+    entity_shards: int = 1,
 ) -> int:
-    """Estimated device bytes for the batched grid's [G, d] coefficient
-    bank plus the vmapped optimizer's per-member state (L-BFGS memory is
-    the dominant term: the [m, d] s/y buffers; TRON carries the CG
-    vectors instead)."""
+    """Estimated PER-DEVICE bytes for the batched grid's [G, d]
+    coefficient bank plus the vmapped optimizer's per-member state
+    (L-BFGS memory is the dominant term: the [m, d] s/y buffers; TRON
+    carries the CG vectors instead). Under the unified mesh's
+    P(grid, entity) placement the bank rows split over ``entity_shards``
+    devices, so each device holds ~1/N of the replicated-bank
+    footprint; ``entity_shards=1`` is the replicated/1-D figure."""
     if optimizer_type == OptimizerType.TRON:
         vectors_per_member = 12  # w, g + CG s/r/d/hd + trial w/g + slack
     else:
         vectors_per_member = 2 * history + 8
-    return int(num_weights) * vectors_per_member * int(dim) * 4
+    total = int(num_weights) * vectors_per_member * int(dim) * 4
+    return -(-total // max(1, int(entity_shards)))
 
 
 def resolve_grid_mode(
@@ -305,6 +310,7 @@ def resolve_grid_mode(
     history: int = 10,
     memory_budget_bytes: int = DEFAULT_GRID_MEMORY_BUDGET,
     streaming: bool = False,
+    entity_shards: int = 1,
 ) -> str:
     """Resolve ``--grid-mode {batched,sequential,auto}`` to a concrete
     path. ``auto`` picks batched when the grid has >1 member, the data
@@ -312,7 +318,11 @@ def resolve_grid_mode(
     sequential default), and the G×d state bank fits the budget;
     everything else falls back to sequential. An explicit ``batched``
     with streaming input is a configuration error (the host-driven
-    streamed optimizers cannot vmap over disk passes)."""
+    streamed optimizers cannot vmap over disk passes).
+
+    ``entity_shards`` feeds the unified-mesh accounting: under
+    P(grid, entity) each device holds ~1/N of the bank, so the budget
+    comparison uses the per-device figure (grid_bank_bytes)."""
     if mode not in ("batched", "sequential", "auto"):
         raise ValueError(
             f"unknown grid mode {mode!r}; expected batched | sequential "
@@ -333,7 +343,9 @@ def resolve_grid_mode(
         return "batched"
     if num_weights <= 1:
         return "sequential"
-    bank = grid_bank_bytes(num_weights, dim, optimizer_type, history)
+    bank = grid_bank_bytes(
+        num_weights, dim, optimizer_type, history, entity_shards
+    )
     return "batched" if bank <= memory_budget_bytes else "sequential"
 
 

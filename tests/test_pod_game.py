@@ -642,6 +642,116 @@ class TestEntityShardPolicy:
         with pytest.raises(ValueError, match="plain random-effect"):
             params.validate()
 
+    @staticmethod
+    def _driver(out_dir, **kw):
+        from photon_ml_tpu.cli.game_training_driver import (
+            GameTrainingDriver,
+            GameTrainingParams,
+        )
+        from photon_ml_tpu.game.config import (
+            FeatureShardConfiguration,
+            FixedEffectDataConfiguration,
+        )
+
+        return GameTrainingDriver(GameTrainingParams(
+            train_input_dirs=["x"],
+            output_dir=str(out_dir),
+            feature_shards=[
+                FeatureShardConfiguration("g", ["features"])
+            ],
+            fixed_effect_data_configs={
+                "fe": FixedEffectDataConfiguration("g")
+            },
+            fixed_effect_opt_configs={"fe": "10,1e-6,0.1,1,LBFGS,L2"},
+            random_effect_data_configs={
+                "re": RandomEffectDataConfiguration("user", "g")
+            },
+            random_effect_opt_configs={"re": "10,1e-6,0.1,1,LBFGS,L2"},
+            **kw,
+        ))
+
+    def test_partial_entity_mesh_restricts_data_mesh(self, tmp_path):
+        """--entity-shards N < visible devices: the driver's data and FE
+        meshes must span EXACTLY the pod entity device set. CD row
+        currency (scores, residuals) is committed to the entity
+        devices, and jit refuses `residual + new_score` across two
+        device sets (regression: distributed=auto + entity_shards=2
+        used to build an 8-device data mesh next to the 2-device pod
+        mesh and crash in the first CD iteration)."""
+        d = self._driver(
+            tmp_path / "a", distributed="auto", entity_shards=2
+        )
+        pod_ids = [dev.id for dev in d._entity_mesh().devices.flat]
+        assert [dev.id for dev in d._mesh().devices.flat] == pod_ids
+        assert [dev.id for dev in d._fe_mesh().devices.flat] == pod_ids
+
+        # full entity mesh: the data mesh spans all devices unchanged
+        d = self._driver(
+            tmp_path / "b", distributed="auto", entity_shards=-1
+        )
+        assert d._mesh().devices.size == len(jax.devices())
+
+        # 1-entity-shard run is effectively single-device: no data mesh
+        # (unmeshed FE scores follow the pod placement)
+        d = self._driver(
+            tmp_path / "c", distributed="auto", entity_shards=1
+        )
+        assert d._mesh() is None
+
+        # feature mode: the 2-D (data, model) FE mesh restricts too
+        d = self._driver(
+            tmp_path / "d",
+            distributed="feature", entity_shards=4, model_shards=2,
+        )
+        fe = d._fe_mesh()
+        assert sorted(dev.id for dev in fe.devices.flat) == sorted(
+            dev.id for dev in d._entity_mesh().devices.flat
+        )
+        assert fe.shape["model"] == 2
+        with pytest.raises(ValueError, match="does not divide"):
+            self._driver(
+                tmp_path / "e",
+                distributed="feature", entity_shards=3, model_shards=2,
+            )._fe_mesh()
+
+    def test_driver_auto_distributed_partial_shards(self, tmp_path, rng):
+        """The regression flow end to end: in-memory driver,
+        distributed=auto, entity_shards=2 of 8."""
+        from test_streaming_game import (
+            FE_DATA, RE_DATA, SHARDS, _write_game_files,
+        )
+
+        from photon_ml_tpu.cli.game_training_driver import (
+            GameTrainingDriver,
+            GameTrainingParams,
+        )
+
+        train = str(tmp_path / "train")
+        _write_game_files(train, rng, n_files=1, rows_per_file=120)
+        params = GameTrainingParams(
+            train_input_dirs=[train],
+            output_dir=str(tmp_path / "out"),
+            task_type=TaskType.LOGISTIC_REGRESSION,
+            feature_shards=SHARDS,
+            fixed_effect_data_configs=dict(FE_DATA),
+            fixed_effect_opt_configs={
+                "global": "20,1e-6,0.5,1,LBFGS,L2"
+            },
+            random_effect_data_configs=dict(RE_DATA),
+            random_effect_opt_configs={
+                "per-user": "20,1e-6,1.0,1,LBFGS,L2"
+            },
+            num_iterations=2,
+            distributed="auto",
+            entity_shards=2,
+        )
+        GameTrainingDriver(params).run()
+        m = json.load(
+            open(os.path.join(params.output_dir, "metrics.json"))
+        )
+        h = m["objective_history"]
+        assert len(h) == 2 and h[1] <= h[0] + 1e-6
+
 
 # ---------------------------------------------------------------------------
 # serving: one entity shard of a sharded model
